@@ -1,0 +1,18 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up rebuild of the capabilities of Deeplearning4j (reference:
+/root/reference, Java/ND4J/cuDNN/Spark) designed TPU-first:
+
+- compute is JAX/XLA: every train/inference step is a single traced,
+  compiled XLA program (the reference's per-layer ND4J calls + cuDNN
+  helper seam collapse into XLA fusion),
+- parameters are pytrees with flat-view utilities (the reference's
+  load-bearing flat param/gradient views, ``nn/api/Model.java:108``),
+- distribution is ``jax.sharding`` over a device Mesh with in-step
+  collectives over ICI (replacing ParallelWrapper and Spark
+  ParameterAveragingTrainingMaster),
+- long sequences use masking/TBPTT (parity) plus mesh sequence
+  parallelism and ring attention (extensions).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,7 @@
+"""Neural-network core: configs, layers, containers, updaters, solvers.
+
+Rebuild of the reference's ``deeplearning4j-nn`` module (SURVEY.md §2.1)
+on JAX: layer configs are serializable dataclasses, layer impls are pure
+init/apply function pairs, and the containers (MultiLayerNetwork,
+ComputationGraph) compile whole train steps to single XLA programs.
+"""

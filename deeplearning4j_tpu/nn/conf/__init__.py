@@ -1,0 +1,8 @@
+from deeplearning4j_tpu.nn.conf.configuration import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    BackpropType,
+    OptimizationAlgorithm,
+)
+from deeplearning4j_tpu.nn.conf import layers  # noqa: F401
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401

@@ -1,0 +1,253 @@
+"""Declarative layer configurations.
+
+Parity: ``nn/conf/layers/*.java`` — 21 Jackson-serializable layer config
+types with per-layer overrides of global hyperparameters
+(``NeuralNetConfiguration.java:84-86``). Here each config is a frozen
+dataclass registered in a polymorphic type registry (the analog of the
+reference's Jackson ``registerSubtypes`` :320, including user-defined
+custom layers).
+
+All fields with value ``None`` inherit the global default from the
+enclosing :class:`~deeplearning4j_tpu.nn.conf.NeuralNetConfiguration`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple, Type
+
+_LAYER_REGISTRY: Dict[str, Type["Layer"]] = {}
+
+
+def register_layer(cls: Type["Layer"]) -> Type["Layer"]:
+    """Register a layer config type for serialization (the custom-layer
+    seam tested by the reference's ``TestCustomLayers.java``)."""
+    _LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def layer_from_dict(d: Dict[str, Any]) -> "Layer":
+    d = dict(d)
+    type_name = d.pop("@type")
+    cls = _LAYER_REGISTRY[type_name]
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    kwargs = {k: v for k, v in d.items() if k in field_names}
+    # tuples arrive from JSON as lists
+    for f in dataclasses.fields(cls):
+        if f.name in kwargs and isinstance(kwargs[f.name], list):
+            kwargs[f.name] = tuple(kwargs[f.name])
+    return cls(**kwargs)
+
+
+@dataclasses.dataclass(frozen=True)
+class Layer:
+    """Base layer config (``nn/conf/layers/Layer.java``)."""
+
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    bias_init: Optional[float] = None
+    dist_mean: float = 0.0
+    dist_std: float = 1.0
+    dropout: Optional[float] = None  # keep DL4J semantics: probability of RETAINING is 1-dropout? see layers/base.py
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    # per-layer updater overrides
+    learning_rate: Optional[float] = None
+    momentum: Optional[float] = None
+    updater: Optional[str] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"@type": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v is not None and v != f.default:
+                d[f.name] = list(v) if isinstance(v, tuple) else v
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class FeedForwardLayer(Layer):
+    """Base for layers with explicit nIn/nOut
+    (``nn/conf/layers/FeedForwardLayer.java``)."""
+
+    n_in: Optional[int] = None  # auto-wired from InputType when None
+    n_out: Optional[int] = None
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class DenseLayer(FeedForwardLayer):
+    """``nn/conf/layers/DenseLayer.java`` — z = x·W + b, activation."""
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class OutputLayer(FeedForwardLayer):
+    """``nn/conf/layers/OutputLayer.java`` — dense + loss function."""
+
+    loss_function: str = "mcxent"
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class RnnOutputLayer(FeedForwardLayer):
+    """``nn/conf/layers/RnnOutputLayer.java`` — per-timestep output + loss,
+    honoring a [batch, T] label mask."""
+
+    loss_function: str = "mcxent"
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class LossLayer(Layer):
+    """``nn/conf/layers/LossLayer.java`` — loss without params (identity
+    or activation-only forward)."""
+
+    loss_function: str = "mse"
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class ConvolutionLayer(FeedForwardLayer):
+    """``nn/conf/layers/ConvolutionLayer.java``.
+
+    NHWC; kernel [kh, kw, inC, outC]. n_in = input channels. The
+    reference's ``cudnnAlgoMode`` knob has no analog — algorithm choice
+    belongs to XLA on TPU.
+    """
+
+    kernel_size: Tuple[int, int] = (5, 5)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"  # truncate|same (reference ConvolutionMode)
+
+
+class PoolingType:
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class SubsamplingLayer(Layer):
+    """``nn/conf/layers/SubsamplingLayer.java`` — max/avg/sum pooling."""
+
+    pooling_type: str = PoolingType.MAX
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    pnorm: int = 2
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class BatchNormalization(FeedForwardLayer):
+    """``nn/conf/layers/BatchNormalization.java`` — train-time batch stats
+    + moving averages for inference, optional learned gamma/beta."""
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    is_minibatch: bool = True
+    lock_gamma_beta: bool = False
+    gamma: float = 1.0
+    beta: float = 0.0
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class LocalResponseNormalization(Layer):
+    """``nn/conf/layers/LocalResponseNormalization.java`` — cross-channel
+    LRN (cuDNN slot in the reference; a fused reduce window here)."""
+
+    k: float = 2.0
+    n: float = 5.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class GravesLSTM(FeedForwardLayer):
+    """``nn/conf/layers/GravesLSTM.java`` — LSTM with peephole connections
+    (Graves 2013 formulation, matching ``LSTMHelpers.java``)."""
+
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class GravesBidirectionalLSTM(GravesLSTM):
+    """``nn/conf/layers/GravesBidirectionalLSTM.java`` — fwd+bwd LSTMs,
+    outputs summed (reference semantics)."""
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class EmbeddingLayer(FeedForwardLayer):
+    """``nn/conf/layers/EmbeddingLayer.java`` — index lookup as one-hot
+    matmul (MXU-friendly gather; input is int indices [batch] or
+    [batch, 1])."""
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class AutoEncoder(FeedForwardLayer):
+    """``nn/conf/layers/AutoEncoder.java`` — denoising autoencoder for
+    layerwise pretraining."""
+
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss_function: str = "mse"
+
+
+class RBMHiddenUnit:
+    BINARY = "binary"
+    RECTIFIED = "rectified"
+    GAUSSIAN = "gaussian"
+    SOFTMAX = "softmax"
+
+
+class RBMVisibleUnit:
+    BINARY = "binary"
+    GAUSSIAN = "gaussian"
+    LINEAR = "linear"
+    SOFTMAX = "softmax"
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class RBM(FeedForwardLayer):
+    """``nn/conf/layers/RBM.java`` — restricted Boltzmann machine trained
+    by contrastive divergence (pretrain path)."""
+
+    hidden_unit: str = RBMHiddenUnit.BINARY
+    visible_unit: str = RBMVisibleUnit.BINARY
+    k: int = 1  # CD-k steps
+    loss_function: str = "reconstruction_crossentropy"
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class ActivationLayer(Layer):
+    """``nn/conf/layers/ActivationLayer.java`` — parameterless activation."""
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class DropoutLayer(FeedForwardLayer):
+    """``nn/conf/layers/DropoutLayer.java`` — dropout as its own layer."""
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class GlobalPoolingLayer(Layer):
+    """Global pooling over time (RNN) or space (CNN). Extension the
+    reference gained in 0.7; needed for masked sequence classification."""
+
+    pooling_type: str = PoolingType.MAX

@@ -1,0 +1,137 @@
+"""Layer implementation protocol + registry + shared helpers.
+
+Parity anchor: ``nn/layers/BaseLayer.java`` (preOutput :354,
+backpropGradient :145 — the latter intentionally absent here, see package
+docstring) and ``util/Dropout.java``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+
+_IMPL_REGISTRY: Dict[Type[L.Layer], Type["LayerImpl"]] = {}
+
+
+def register_impl(conf_cls: Type[L.Layer]):
+    def deco(impl_cls):
+        _IMPL_REGISTRY[conf_cls] = impl_cls
+        impl_cls.conf_cls = conf_cls
+        return impl_cls
+
+    return deco
+
+
+def build_layer(global_conf: NeuralNetConfiguration, layer_conf: L.Layer, name: str) -> "LayerImpl":
+    """Instantiate the impl for a layer config (the reference resolved this
+    via ``Layer.instantiate``; custom layers register with
+    :func:`register_impl`)."""
+    for cls in type(layer_conf).__mro__:
+        if cls in _IMPL_REGISTRY:
+            return _IMPL_REGISTRY[cls](global_conf, layer_conf, name)
+    raise ValueError(f"no implementation registered for {type(layer_conf).__name__}")
+
+
+def apply_dropout(x: jnp.ndarray, rate: float, rng: jax.Array) -> jnp.ndarray:
+    """Inverted dropout (``util/Dropout.java``): each unit dropped with
+    probability ``rate``, survivors scaled by 1/(1-rate) so inference
+    needs no rescale."""
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+class LayerImpl:
+    """A layer = pure ``init_params`` + ``forward``.
+
+    ``forward(params, x, state, train, rng) -> (out, new_state)``.
+    ``state`` carries non-trainable variables (batch-norm moving stats,
+    RNN last-step carry for ``rnnTimeStep``); pure so the container can
+    trace it into one XLA program.
+    """
+
+    conf_cls: Type[L.Layer] = L.Layer
+
+    def __init__(self, global_conf: NeuralNetConfiguration, conf: L.Layer, name: str):
+        self.gc = global_conf
+        self.conf = conf
+        self.name = name
+
+    # -- config resolution helpers --
+    @property
+    def activation(self) -> str:
+        return self.conf.activation or self.gc.activation
+
+    @property
+    def weight_init(self) -> str:
+        return self.conf.weight_init or self.gc.weight_init
+
+    @property
+    def bias_init(self) -> float:
+        return self.conf.bias_init if self.conf.bias_init is not None else self.gc.bias_init
+
+    @property
+    def dropout_rate(self) -> float:
+        return self.conf.dropout if self.conf.dropout is not None else self.gc.dropout
+
+    @property
+    def l1(self) -> float:
+        return self.conf.l1 if self.conf.l1 is not None else self.gc.l1
+
+    @property
+    def l2(self) -> float:
+        return self.conf.l2 if self.conf.l2 is not None else self.gc.l2
+
+    # -- protocol --
+    def init_params(self, key: jax.Array) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def init_state(self) -> Dict[str, Any]:
+        return {}
+
+    def num_params(self) -> int:
+        import numpy as np
+
+        key = jax.random.PRNGKey(0)
+        return int(sum(np.prod(v.shape) for v in self.init_params(key).values()))
+
+    def forward(
+        self,
+        params: Dict[str, jnp.ndarray],
+        x: jnp.ndarray,
+        state: Dict[str, Any],
+        train: bool,
+        rng: Optional[jax.Array] = None,
+        mask: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+        raise NotImplementedError
+
+    def maybe_dropout_input(self, x: jnp.ndarray, train: bool, rng: Optional[jax.Array]) -> jnp.ndarray:
+        """The reference applies dropout to a layer's *input* activations
+        (``BaseLayer.preOutput`` → ``Dropout.applyDropout``)."""
+        rate = self.dropout_rate
+        if train and rate > 0.0 and rng is not None:
+            return apply_dropout(x, rate, rng)
+        return x
+
+    def regularization_penalty(self, params: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        """L1/L2 score term (``BaseLayer.calcL2/calcL1``; weights only, not
+        biases — reference convention)."""
+        pen = jnp.asarray(0.0, jnp.float32)
+        if self.l2 > 0.0:
+            for k, v in params.items():
+                if k != "b":
+                    pen = pen + 0.5 * self.l2 * jnp.sum(v.astype(jnp.float32) ** 2)
+        if self.l1 > 0.0:
+            for k, v in params.items():
+                if k != "b":
+                    pen = pen + self.l1 * jnp.sum(jnp.abs(v.astype(jnp.float32)))
+        return pen
+
+    def has_loss(self) -> bool:
+        return False

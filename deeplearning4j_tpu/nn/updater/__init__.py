@@ -1,0 +1,10 @@
+from deeplearning4j_tpu.nn.updater.updaters import (  # noqa: F401
+    Updater,
+    UpdaterConfig,
+    GradientNormalization,
+    LearningRatePolicy,
+    init_updater_state,
+    apply_updater,
+    effective_learning_rate,
+    normalize_gradient,
+)

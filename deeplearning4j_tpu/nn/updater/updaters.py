@@ -1,0 +1,234 @@
+"""Per-variable gradient-transform updaters.
+
+Parity surface: the reference's updater stack —
+``nn/conf/Updater.java:9-18`` (SGD, ADAM, ADAGRAD, ADADELTA, NESTEROVS,
+RMSPROP, NONE), ``nn/updater/BaseUpdater.java:30`` (update :67, postApply
+L1/L2 regularization :93, applyLrDecayPolicy :120, preApply gradient
+normalization :163).
+
+TPU-first design: the reference kept updater state in mutable flat ND4J
+views and ran the transform as a separate host-dispatched pass. Here each
+updater is a *pure function* ``(grad, state, lr, iteration) -> (update,
+state')`` traced into the same XLA program as forward+backward, so the
+whole optimizer fuses into the train step (one device program per
+iteration, zero host round-trips). Learning-rate decay policies are
+computed *inside* the step from the iteration counter carried in the
+optimizer state, so jit never retraces as lr changes (SURVEY.md §7 hard
+part (f)).
+
+Sign convention: like the reference's ``StepFunction`` (params -=
+update), :func:`apply_updater` returns the quantity to SUBTRACT from the
+parameters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Updater(str, enum.Enum):
+    SGD = "sgd"
+    ADAM = "adam"
+    ADAGRAD = "adagrad"
+    ADADELTA = "adadelta"
+    NESTEROVS = "nesterovs"
+    RMSPROP = "rmsprop"
+    NONE = "none"
+
+
+class GradientNormalization(str, enum.Enum):
+    """``nn/conf/GradientNormalization`` in the reference; applied pre-update
+    (``BaseUpdater.preApply`` :163)."""
+
+    NONE = "none"
+    RENORMALIZE_L2_PER_LAYER = "renormalize_l2_per_layer"
+    RENORMALIZE_L2_PER_PARAM_TYPE = "renormalize_l2_per_param_type"
+    CLIP_ELEMENTWISE_ABSOLUTE_VALUE = "clip_elementwise_absolute_value"
+    CLIP_L2_PER_LAYER = "clip_l2_per_layer"
+    CLIP_L2_PER_PARAM_TYPE = "clip_l2_per_param_type"
+
+
+class LearningRatePolicy(str, enum.Enum):
+    """``nn/conf/LearningRatePolicy`` — lr decay applied per iteration
+    (``BaseUpdater.applyLrDecayPolicy`` :120)."""
+
+    NONE = "none"
+    EXPONENTIAL = "exponential"  # lr * decayRate^iter
+    INVERSE = "inverse"  # lr / (1 + decayRate*iter)^power
+    POLY = "poly"  # lr * (1 - iter/maxIter)^power
+    SIGMOID = "sigmoid"  # lr / (1 + exp(-decayRate*(iter - steps)))
+    STEP = "step"  # lr * decayRate^floor(iter/steps)
+    SCHEDULE = "schedule"  # explicit {iteration: lr} map
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdaterConfig:
+    """Static (trace-time) updater hyperparameters for one variable."""
+
+    updater: Updater = Updater.SGD
+    learning_rate: float = 1e-1
+    momentum: float = 0.9  # nesterovs
+    momentum_schedule: Optional[Dict[int, float]] = None
+    adam_mean_decay: float = 0.9
+    adam_var_decay: float = 0.999
+    rho: float = 0.95  # adadelta
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+    # lr decay policy
+    lr_policy: LearningRatePolicy = LearningRatePolicy.NONE
+    lr_policy_decay_rate: float = 0.0
+    lr_policy_power: float = 0.0
+    lr_policy_steps: float = 1.0
+    lr_schedule: Optional[Dict[int, float]] = None
+    max_iterations: int = 1  # for POLY
+
+    def __post_init__(self):
+        object.__setattr__(self, "updater", Updater(self.updater))
+        object.__setattr__(self, "lr_policy", LearningRatePolicy(self.lr_policy))
+
+
+def effective_learning_rate(cfg: UpdaterConfig, iteration: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """In-step lr as a traced function of the iteration counter.
+
+    ``dtype``: scalar-math precision — float32 in production; promoted to
+    float64 when gradients are f64 (gradient-check mode).
+    """
+    lr = jnp.asarray(cfg.learning_rate, dtype)
+    it = iteration.astype(dtype)
+    p = cfg.lr_policy
+    if p is LearningRatePolicy.NONE:
+        return lr
+    if p is LearningRatePolicy.EXPONENTIAL:
+        return lr * jnp.power(cfg.lr_policy_decay_rate, it)
+    if p is LearningRatePolicy.INVERSE:
+        return lr / jnp.power(1.0 + cfg.lr_policy_decay_rate * it, cfg.lr_policy_power)
+    if p is LearningRatePolicy.POLY:
+        frac = jnp.clip(it / max(cfg.max_iterations, 1), 0.0, 1.0)
+        return lr * jnp.power(1.0 - frac, cfg.lr_policy_power)
+    if p is LearningRatePolicy.SIGMOID:
+        return lr / (1.0 + jnp.exp(-cfg.lr_policy_decay_rate * (it - cfg.lr_policy_steps)))
+    if p is LearningRatePolicy.STEP:
+        return lr * jnp.power(cfg.lr_policy_decay_rate, jnp.floor(it / cfg.lr_policy_steps))
+    if p is LearningRatePolicy.SCHEDULE:
+        # piecewise-constant: lr takes the value of the largest schedule key <= iter
+        sched = sorted((cfg.lr_schedule or {}).items())
+        out = lr
+        for k, v in sched:
+            out = jnp.where(it >= k, jnp.asarray(v, dtype), out)
+        return out
+    raise ValueError(f"unknown lr policy {p}")
+
+
+def _effective_momentum(cfg: UpdaterConfig, iteration: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    mu = jnp.asarray(cfg.momentum, dtype)
+    if cfg.momentum_schedule:
+        it = iteration.astype(dtype)
+        for k, v in sorted(cfg.momentum_schedule.items()):
+            mu = jnp.where(it >= k, jnp.asarray(v, dtype), mu)
+    return mu
+
+
+def init_updater_state(cfg: UpdaterConfig, param: jnp.ndarray) -> Dict[str, Any]:
+    """Zero-initialized per-variable state (the reference's ``viewArray``
+    slices, ``MultiLayerUpdater.java:22``)."""
+    u = cfg.updater
+    z = lambda: jnp.zeros_like(param)
+    if u is Updater.ADAM:
+        return {"m": z(), "v": z()}
+    if u is Updater.ADAGRAD:
+        return {"h": z()}
+    if u is Updater.ADADELTA:
+        return {"msg": z(), "msdx": z()}
+    if u is Updater.NESTEROVS:
+        return {"v": z()}
+    if u is Updater.RMSPROP:
+        return {"cache": z()}
+    return {}
+
+
+def apply_updater(
+    cfg: UpdaterConfig,
+    grad: jnp.ndarray,
+    state: Dict[str, Any],
+    iteration: jnp.ndarray,
+) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Compute the (to-be-subtracted) update and the new state.
+
+    Formulas match the reference's ND4J learning impls (Sgd, Adam,
+    AdaGrad, AdaDelta, Nesterovs, RmsProp) so parity tests against
+    hand-computed values (``nn/updater/TestUpdaters.java``) carry over.
+    """
+    u = cfg.updater
+    # scalar math in the gradient's precision (>= f32): f64 under
+    # gradient-check mode, f32 in production steps
+    sdtype = jnp.promote_types(grad.dtype, jnp.float32)
+    lr = effective_learning_rate(cfg, iteration, dtype=sdtype)
+    eps = cfg.epsilon
+    if u is Updater.SGD:
+        return lr * grad, state
+    if u is Updater.NONE:
+        return grad, state
+    if u is Updater.ADAM:
+        t = iteration.astype(sdtype) + 1.0
+        b1, b2 = cfg.adam_mean_decay, cfg.adam_var_decay
+        m = b1 * state["m"] + (1.0 - b1) * grad
+        v = b2 * state["v"] + (1.0 - b2) * grad * grad
+        alpha = lr * jnp.sqrt(1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
+        return alpha * m / (jnp.sqrt(v) + eps), {"m": m, "v": v}
+    if u is Updater.ADAGRAD:
+        h = state["h"] + grad * grad
+        return lr * grad / (jnp.sqrt(h) + eps), {"h": h}
+    if u is Updater.ADADELTA:
+        rho = cfg.rho
+        msg = rho * state["msg"] + (1.0 - rho) * grad * grad
+        update = grad * jnp.sqrt(state["msdx"] + eps) / jnp.sqrt(msg + eps)
+        msdx = rho * state["msdx"] + (1.0 - rho) * update * update
+        return update, {"msg": msg, "msdx": msdx}
+    if u is Updater.NESTEROVS:
+        mu = _effective_momentum(cfg, iteration, dtype=sdtype)
+        v_prev = state["v"]
+        v = mu * v_prev - lr * grad
+        # reference Nesterovs: update = mu*vPrev - (1+mu)*vNew
+        update = mu * v_prev - (1.0 + mu) * v
+        return update, {"v": v}
+    if u is Updater.RMSPROP:
+        d = cfg.rms_decay
+        cache = d * state["cache"] + (1.0 - d) * grad * grad
+        return lr * grad / (jnp.sqrt(cache) + eps), {"cache": cache}
+    raise ValueError(f"unknown updater {u}")
+
+
+def normalize_gradient(
+    norm_type: GradientNormalization,
+    grads: Dict[str, jnp.ndarray],
+    threshold: float = 1.0,
+) -> Dict[str, jnp.ndarray]:
+    """Pre-update gradient normalization over one layer's gradient dict
+    (``BaseUpdater.preApply`` :163). ``grads`` maps param-name -> grad."""
+    nt = GradientNormalization(norm_type)
+    if nt is GradientNormalization.NONE:
+        return grads
+    if nt is GradientNormalization.CLIP_ELEMENTWISE_ABSOLUTE_VALUE:
+        return {k: jnp.clip(g, -threshold, threshold) for k, g in grads.items()}
+    if nt in (GradientNormalization.RENORMALIZE_L2_PER_LAYER, GradientNormalization.CLIP_L2_PER_LAYER):
+        sq = sum(jnp.sum(g * g) for g in grads.values())
+        norm = jnp.sqrt(sq + 1e-12)
+        if nt is GradientNormalization.RENORMALIZE_L2_PER_LAYER:
+            scale = 1.0 / norm
+        else:
+            scale = jnp.where(norm > threshold, threshold / norm, 1.0)
+        return {k: g * scale for k, g in grads.items()}
+    # per-param-type variants
+    out = {}
+    for k, g in grads.items():
+        norm = jnp.sqrt(jnp.sum(g * g) + 1e-12)
+        if nt is GradientNormalization.RENORMALIZE_L2_PER_PARAM_TYPE:
+            out[k] = g / norm
+        else:
+            out[k] = g * jnp.where(norm > threshold, threshold / norm, 1.0)
+    return out

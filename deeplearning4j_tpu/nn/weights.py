@@ -1,0 +1,85 @@
+"""Weight initialization schemes.
+
+Parity: ``nn/weights/WeightInit.java:47-57`` + ``WeightInitUtil.java`` in
+the reference (XAVIER, RELU, UNIFORM, ...). Implemented as pure functions
+of a jax PRNG key — the reference mutated a global ND4J RNG; functional
+keys are what makes multi-host replicated init deterministic on TPU.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class WeightInit(str, enum.Enum):
+    ZERO = "zero"
+    ONES = "ones"
+    UNIFORM = "uniform"  # U(-1/sqrt(fanIn), 1/sqrt(fanIn))
+    NORMALIZED = "normalized"  # U(-1,1) / fanIn  (legacy DL4J "NORMALIZED")
+    XAVIER = "xavier"  # N(0, 2/(fanIn+fanOut))
+    XAVIER_UNIFORM = "xavier_uniform"  # U(+-sqrt(6/(fanIn+fanOut)))
+    XAVIER_FAN_IN = "xavier_fan_in"  # N(0, 1/fanIn)
+    RELU = "relu"  # He: N(0, 2/fanIn)
+    RELU_UNIFORM = "relu_uniform"  # U(+-sqrt(6/fanIn))
+    SIGMOID_UNIFORM = "sigmoid_uniform"  # U(+-4*sqrt(6/(fanIn+fanOut)))
+    LECUN_NORMAL = "lecun_normal"  # N(0, 1/fanIn)
+    DISTRIBUTION = "distribution"  # explicit (mean, std) normal
+    NORMAL = "normal"  # N(0, 1/sqrt(fanIn))
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    scheme: Union[str, WeightInit],
+    fan_in: float,
+    fan_out: float,
+    dist_mean: float = 0.0,
+    dist_std: float = 1.0,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Initialize a weight tensor of ``shape``.
+
+    ``fan_in``/``fan_out`` are passed explicitly (for conv kernels the
+    caller computes receptive-field fans as the reference's
+    ``ConvolutionParamInitializer`` does).
+    """
+    s = WeightInit(scheme)
+    shape = tuple(int(d) for d in shape)
+    if s is WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if s is WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if s is WeightInit.UNIFORM:
+        a = 1.0 / np.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s is WeightInit.NORMALIZED:
+        return jax.random.uniform(key, shape, dtype, -1.0, 1.0) / fan_in
+    if s is WeightInit.XAVIER:
+        std = np.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if s is WeightInit.XAVIER_UNIFORM:
+        a = np.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s in (WeightInit.XAVIER_FAN_IN, WeightInit.LECUN_NORMAL):
+        std = np.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s is WeightInit.RELU:
+        std = np.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s is WeightInit.RELU_UNIFORM:
+        a = np.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s is WeightInit.SIGMOID_UNIFORM:
+        a = 4.0 * np.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s is WeightInit.DISTRIBUTION:
+        return dist_mean + dist_std * jax.random.normal(key, shape, dtype)
+    if s is WeightInit.NORMAL:
+        std = 1.0 / np.sqrt(fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    raise ValueError(f"unknown weight init {scheme}")

@@ -1,0 +1,11 @@
+"""Functional op layer — the role ND4J/libnd4j plays for the reference.
+
+Everything here is a pure jax-traceable function. Where DL4J routed each
+call through ``INDArray``/``Nd4j.getExecutioner()`` (one native kernel per
+op), here ops are composed in Python and fused by XLA into the enclosing
+jitted step, which is the TPU-correct design: elementwise work fuses into
+the surrounding matmuls/convs instead of round-tripping HBM.
+"""
+
+from deeplearning4j_tpu.ops.activations import Activation, activate  # noqa: F401
+from deeplearning4j_tpu.ops.losses import LossFunction, compute_loss  # noqa: F401

@@ -1,0 +1,93 @@
+"""Activation functions.
+
+Parity with the reference's activation set (DL4J 0.6.1 string-keyed
+activations applied via ND4J transform ops, see
+``nn/layers/BaseLayer.java`` ``activate``/``preOutput`` and
+``org.nd4j.linalg.api.ops.impl.transforms``). TPU note: these are plain
+jax functions so XLA fuses them into the preceding matmul/conv — the
+reference paid one kernel launch + HBM round-trip per activation.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class Activation(str, enum.Enum):
+    """String-keyed activation registry (reference: DL4J ``activation("relu")``)."""
+
+    IDENTITY = "identity"
+    SIGMOID = "sigmoid"
+    TANH = "tanh"
+    RELU = "relu"
+    LEAKYRELU = "leakyrelu"
+    SOFTMAX = "softmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    ELU = "elu"
+    HARDTANH = "hardtanh"
+    HARDSIGMOID = "hardsigmoid"
+    CUBE = "cube"
+    RATIONALTANH = "rationaltanh"
+    RRELU = "rrelu"  # treated as leakyrelu at inference; randomized slope in train
+    GELU = "gelu"  # extension beyond the reference (modern models need it)
+    SILU = "silu"  # extension (swish)
+
+
+def _rationaltanh(x: jnp.ndarray) -> jnp.ndarray:
+    # Rational approximation of tanh used by DL4J (ND4J RationalTanh op):
+    # f(x) = 1.7159 * tanh_approx(2x/3) with tanh_approx(y) =
+    #        sign(y) * (1 - 1/(1 + |y| + y^2 + 1.41645 y^4))
+    y = 2.0 * x / 3.0
+    a = jnp.abs(y)
+    approx = jnp.sign(y) * (1.0 - 1.0 / (1.0 + a + y * y + 1.41645 * (y**4)))
+    return 1.7159 * approx
+
+
+_FUNCS: dict[Activation, Callable[[jnp.ndarray], jnp.ndarray]] = {
+    Activation.IDENTITY: lambda x: x,
+    Activation.SIGMOID: jax.nn.sigmoid,
+    Activation.TANH: jnp.tanh,
+    Activation.RELU: jax.nn.relu,
+    Activation.LEAKYRELU: lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
+    Activation.SOFTPLUS: jax.nn.softplus,
+    Activation.SOFTSIGN: jax.nn.soft_sign,
+    Activation.ELU: jax.nn.elu,
+    Activation.HARDTANH: lambda x: jnp.clip(x, -1.0, 1.0),
+    Activation.HARDSIGMOID: jax.nn.hard_sigmoid,
+    Activation.CUBE: lambda x: x**3,
+    Activation.RATIONALTANH: _rationaltanh,
+    Activation.RRELU: lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
+    Activation.GELU: lambda x: jax.nn.gelu(x, approximate=False),
+    Activation.SILU: jax.nn.silu,
+}
+
+
+def activate(name: Union[str, Activation], x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Apply activation ``name`` to ``x``.
+
+    ``softmax`` normalizes over ``axis`` (default last = feature dim; the
+    reference's 2d [batch, nOut] softmax along dim 1).
+    """
+    act = Activation(name)
+    if act is Activation.SOFTMAX:
+        return jax.nn.softmax(x, axis=axis)
+    return _FUNCS[act](x)
+
+
+def activation_gradient(name: Union[str, Activation], x: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise derivative d act(x) / dx (softmax excluded — its backprop
+    is handled jointly with the loss, as in the reference output layer).
+
+    Exists for parity tests against hand-math (BackPropMLPTest-style);
+    production backprop is ``jax.grad`` through :func:`activate`.
+    """
+    act = Activation(name)
+    if act is Activation.SOFTMAX:
+        raise ValueError("softmax gradient is handled jointly with the loss")
+    grad = jax.vmap(jax.grad(lambda v: _FUNCS[act](v)))
+    return grad(x.reshape(-1)).reshape(x.shape)

@@ -1,0 +1,34 @@
+"""Test harness: force an 8-device CPU-backed virtual mesh.
+
+This is the TPU analog of the reference's ``local[N]`` fake Spark cluster
+(``BaseSparkTest.java:90``, SURVEY.md §4): multi-device semantics are
+exercised without real chips by splitting the host CPU into 8 XLA
+devices. Must run before the first ``import jax``.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Persistent compilation cache: the test box has one CPU core, so XLA
+# compile time dominates the suite; cache executables across runs.
+jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax_comp_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+# Gradient checks are finite-difference vs analytic (the reference runs
+# them in double precision, GradientCheckUtil.java); enable x64 so the
+# same tolerances hold.
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

@@ -1,0 +1,119 @@
+"""Unit tests for the functional op layer (activations, losses, inits).
+
+Mirrors the reference's ND4J-op-level unit coverage (SURVEY.md §4:
+construct small inputs, assert hand-computed values).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.activations import Activation, activate, activation_gradient
+from deeplearning4j_tpu.ops.losses import LossFunction, compute_loss
+from deeplearning4j_tpu.nn.weights import WeightInit, init_weights
+
+
+class TestActivations:
+    def test_relu(self):
+        x = jnp.array([-2.0, -0.5, 0.0, 1.5])
+        np.testing.assert_allclose(activate("relu", x), [0, 0, 0, 1.5])
+
+    def test_sigmoid_values(self):
+        x = jnp.array([0.0])
+        np.testing.assert_allclose(activate("sigmoid", x), [0.5])
+
+    def test_softmax_rows_sum_to_one(self):
+        x = jnp.arange(12.0).reshape(3, 4)
+        s = activate("softmax", x)
+        np.testing.assert_allclose(jnp.sum(s, axis=-1), np.ones(3), rtol=1e-6)
+
+    def test_hardtanh(self):
+        x = jnp.array([-5.0, -0.3, 0.3, 5.0])
+        np.testing.assert_allclose(activate("hardtanh", x), [-1, -0.3, 0.3, 1])
+
+    def test_cube(self):
+        np.testing.assert_allclose(activate("cube", jnp.array([2.0])), [8.0])
+
+    @pytest.mark.parametrize("name", [a for a in Activation if a is not Activation.SOFTMAX])
+    def test_gradient_matches_jax(self, name):
+        x = jnp.linspace(-2.0, 2.0, 7)
+        g = activation_gradient(name, x)
+        g_ref = jax.vmap(jax.grad(lambda v: activate(name, v)))(x)
+        np.testing.assert_allclose(g, g_ref, rtol=1e-6, atol=1e-6)
+
+    def test_all_finite_on_extremes(self):
+        x = jnp.array([-50.0, 50.0])
+        for a in Activation:
+            y = activate(a, x)
+            assert bool(jnp.all(jnp.isfinite(y))), a
+
+
+class TestLosses:
+    def test_mse_hand_computed(self):
+        # DL4J convention: sum of squared error over features, mean over batch
+        labels = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+        preds = jnp.array([[0.5, 0.5], [0.0, 1.0]])
+        val = compute_loss("mse", labels, preds)
+        np.testing.assert_allclose(val, (0.25 + 0.25) / 2.0, rtol=1e-6)
+
+    def test_mcxent_one_hot(self):
+        labels = jnp.array([[1.0, 0.0]])
+        preds = jnp.array([[0.25, 0.75]])
+        np.testing.assert_allclose(compute_loss("mcxent", labels, preds), -np.log(0.25), rtol=1e-5)
+
+    def test_mcxent_from_logits_matches_softmax_path(self):
+        key = jax.random.PRNGKey(0)
+        logits = jax.random.normal(key, (4, 5))
+        labels = jax.nn.one_hot(jnp.array([0, 2, 4, 1]), 5)
+        a = compute_loss("mcxent", labels, jax.nn.softmax(logits), from_logits=False)
+        b = compute_loss("mcxent", labels, logits, from_logits=True)
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+    def test_xent_from_logits_matches_sigmoid_path(self):
+        logits = jnp.array([[0.3, -1.2, 2.0]])
+        labels = jnp.array([[1.0, 0.0, 1.0]])
+        a = compute_loss("xent", labels, jax.nn.sigmoid(logits), from_logits=False)
+        b = compute_loss("xent", labels, logits, from_logits=True)
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+    def test_mask_excludes_examples(self):
+        labels = jnp.array([[1.0], [1.0]])
+        preds = jnp.array([[0.0], [1.0]])
+        mask = jnp.array([1.0, 0.0])
+        # only first example counts: (1-0)^2 = 1
+        np.testing.assert_allclose(compute_loss("mse", labels, preds, mask=mask), 1.0)
+
+    @pytest.mark.parametrize("name", list(LossFunction))
+    def test_all_losses_finite_and_scalar(self, name):
+        key = jax.random.PRNGKey(3)
+        labels = jax.nn.softmax(jax.random.normal(key, (6, 4)))
+        preds = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(4), (6, 4)))
+        v = compute_loss(name, labels, preds)
+        assert v.shape == ()
+        assert bool(jnp.isfinite(v))
+
+
+class TestWeightInit:
+    def test_zero_ones(self):
+        k = jax.random.PRNGKey(0)
+        assert float(jnp.sum(init_weights(k, (3, 3), "zero", 3, 3))) == 0.0
+        assert float(jnp.sum(init_weights(k, (3, 3), "ones", 3, 3))) == 9.0
+
+    def test_xavier_std(self):
+        k = jax.random.PRNGKey(1)
+        w = init_weights(k, (500, 500), WeightInit.XAVIER, 500, 500)
+        expected = np.sqrt(2.0 / 1000.0)
+        assert abs(float(jnp.std(w)) - expected) < 0.1 * expected
+
+    def test_uniform_bounds(self):
+        k = jax.random.PRNGKey(2)
+        w = init_weights(k, (100, 100), WeightInit.UNIFORM, 100, 100)
+        a = 1.0 / np.sqrt(100)
+        assert float(jnp.max(jnp.abs(w))) <= a
+
+    def test_deterministic_given_key(self):
+        k = jax.random.PRNGKey(7)
+        w1 = init_weights(k, (4, 4), "xavier", 4, 4)
+        w2 = init_weights(k, (4, 4), "xavier", 4, 4)
+        np.testing.assert_array_equal(w1, w2)

@@ -1,0 +1,132 @@
+"""Updater math vs hand-computed values — port of the reference's
+``nn/updater/TestUpdaters.java`` doctrine (SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.updater import (
+    GradientNormalization,
+    LearningRatePolicy,
+    Updater,
+    UpdaterConfig,
+    apply_updater,
+    effective_learning_rate,
+    init_updater_state,
+    normalize_gradient,
+)
+
+
+def _step(cfg, grad, state, it=0):
+    return apply_updater(cfg, jnp.asarray(grad), state, jnp.asarray(it))
+
+
+class TestUpdaterMath:
+    def test_sgd(self):
+        cfg = UpdaterConfig(updater="sgd", learning_rate=0.5)
+        upd, _ = _step(cfg, [2.0, -4.0], {})
+        np.testing.assert_allclose(upd, [1.0, -2.0])
+
+    def test_none_passthrough(self):
+        cfg = UpdaterConfig(updater="none")
+        upd, _ = _step(cfg, [3.0], {})
+        np.testing.assert_allclose(upd, [3.0])
+
+    def test_adam_first_step_hand_math(self):
+        lr, b1, b2, eps = 0.1, 0.9, 0.999, 1e-8
+        cfg = UpdaterConfig(updater="adam", learning_rate=lr, adam_mean_decay=b1,
+                            adam_var_decay=b2, epsilon=eps)
+        g = np.array([0.5, -1.0])
+        st = init_updater_state(cfg, jnp.asarray(g))
+        upd, st2 = _step(cfg, g, st, it=0)
+        m = (1 - b1) * g
+        v = (1 - b2) * g * g
+        alpha = lr * np.sqrt(1 - b2) / (1 - b1)
+        np.testing.assert_allclose(upd, alpha * m / (np.sqrt(v) + eps), rtol=3e-5)  # pow() on this backend has ~1e-5 noise
+        np.testing.assert_allclose(st2["m"], m, rtol=1e-6)
+        np.testing.assert_allclose(st2["v"], v, rtol=1e-6)
+
+    def test_adagrad_accumulates(self):
+        cfg = UpdaterConfig(updater="adagrad", learning_rate=0.1, epsilon=1e-8)
+        g = np.array([1.0, 2.0])
+        st = init_updater_state(cfg, jnp.asarray(g))
+        upd1, st = _step(cfg, g, st)
+        np.testing.assert_allclose(upd1, 0.1 * g / (np.abs(g) + 1e-8), rtol=1e-6)
+        _, st = _step(cfg, g, st)
+        np.testing.assert_allclose(st["h"], 2 * g * g, rtol=1e-6)
+
+    def test_nesterov_mu_zero_is_sgd(self):
+        cfg = UpdaterConfig(updater="nesterovs", learning_rate=0.2, momentum=0.0)
+        g = np.array([1.0])
+        st = init_updater_state(cfg, jnp.asarray(g))
+        upd, _ = _step(cfg, g, st)
+        np.testing.assert_allclose(upd, [0.2], rtol=1e-6)
+
+    def test_nesterov_momentum_hand_math(self):
+        mu, lr = 0.9, 0.1
+        cfg = UpdaterConfig(updater="nesterovs", learning_rate=lr, momentum=mu)
+        g = np.array([1.0])
+        st = init_updater_state(cfg, jnp.asarray(g))
+        upd, st = _step(cfg, g, st)
+        v1 = -lr * g  # mu*0 - lr*g
+        np.testing.assert_allclose(upd, mu * 0 - (1 + mu) * v1, rtol=1e-6)
+        np.testing.assert_allclose(st["v"], v1, rtol=1e-6)
+
+    def test_rmsprop_hand_math(self):
+        lr, d, eps = 0.01, 0.95, 1e-8
+        cfg = UpdaterConfig(updater="rmsprop", learning_rate=lr, rms_decay=d, epsilon=eps)
+        g = np.array([2.0])
+        st = init_updater_state(cfg, jnp.asarray(g))
+        upd, st = _step(cfg, g, st)
+        cache = (1 - d) * g * g
+        np.testing.assert_allclose(upd, lr * g / (np.sqrt(cache) + eps), rtol=1e-6)
+
+    def test_adadelta_no_lr_dependence(self):
+        cfg = UpdaterConfig(updater="adadelta", rho=0.95, epsilon=1e-6)
+        g = np.array([1.5])
+        st = init_updater_state(cfg, jnp.asarray(g))
+        upd, st2 = _step(cfg, g, st)
+        msg = 0.05 * g * g
+        expected = g * np.sqrt(0.0 + 1e-6) / np.sqrt(msg + 1e-6)
+        np.testing.assert_allclose(upd, expected, rtol=1e-5)
+
+
+class TestLrPolicies:
+    def test_exponential(self):
+        cfg = UpdaterConfig(learning_rate=1.0, lr_policy="exponential", lr_policy_decay_rate=0.5)
+        np.testing.assert_allclose(effective_learning_rate(cfg, jnp.asarray(2)), 0.25, rtol=1e-5)
+
+    def test_step(self):
+        cfg = UpdaterConfig(learning_rate=1.0, lr_policy="step", lr_policy_decay_rate=0.1,
+                            lr_policy_steps=10.0)
+        np.testing.assert_allclose(effective_learning_rate(cfg, jnp.asarray(25)), 0.01, rtol=1e-5)
+
+    def test_schedule_map(self):
+        cfg = UpdaterConfig(learning_rate=0.1, lr_policy="schedule",
+                            lr_schedule={5: 0.01, 10: 0.001})
+        np.testing.assert_allclose(effective_learning_rate(cfg, jnp.asarray(0)), 0.1)
+        np.testing.assert_allclose(effective_learning_rate(cfg, jnp.asarray(7)), 0.01)
+        np.testing.assert_allclose(effective_learning_rate(cfg, jnp.asarray(100)), 0.001)
+
+    def test_poly(self):
+        cfg = UpdaterConfig(learning_rate=1.0, lr_policy="poly", lr_policy_power=2.0,
+                            max_iterations=10)
+        np.testing.assert_allclose(effective_learning_rate(cfg, jnp.asarray(5)), 0.25, rtol=1e-5)
+
+
+class TestGradientNormalization:
+    def test_clip_elementwise(self):
+        g = {"W": jnp.array([3.0, -0.2]), "b": jnp.array([-9.0])}
+        out = normalize_gradient(GradientNormalization.CLIP_ELEMENTWISE_ABSOLUTE_VALUE, g, 1.0)
+        np.testing.assert_allclose(out["W"], [1.0, -0.2])
+        np.testing.assert_allclose(out["b"], [-1.0])
+
+    def test_renormalize_l2_per_layer(self):
+        g = {"W": jnp.array([3.0]), "b": jnp.array([4.0])}
+        out = normalize_gradient(GradientNormalization.RENORMALIZE_L2_PER_LAYER, g)
+        np.testing.assert_allclose(out["W"], [0.6], rtol=1e-5)
+        np.testing.assert_allclose(out["b"], [0.8], rtol=1e-5)
+
+    def test_clip_l2_per_layer_noop_when_small(self):
+        g = {"W": jnp.array([0.1])}
+        out = normalize_gradient(GradientNormalization.CLIP_L2_PER_LAYER, g, threshold=5.0)
+        np.testing.assert_allclose(out["W"], [0.1], rtol=1e-6)
