@@ -1,0 +1,70 @@
+"""DataSet / MultiDataSet containers.
+
+Parity: ND4J's ``DataSet`` (features, labels, feature mask, label mask)
+and ``MultiDataSet`` (arrays of each) — the currency of every fit/eval
+API in the reference (SURVEY.md §0 critical dependencies).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataSet:
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int) -> Tuple["DataSet", "DataSet"]:
+        a = self[:n_train]
+        b = self[n_train:]
+        return a, b
+
+    def shuffle(self, seed: Optional[int] = None) -> "DataSet":
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self.num_examples())
+        return self[perm]
+
+    def __getitem__(self, idx) -> "DataSet":
+        return DataSet(
+            features=self.features[idx],
+            labels=self.labels[idx],
+            features_mask=None if self.features_mask is None else self.features_mask[idx],
+            labels_mask=None if self.labels_mask is None else self.labels_mask[idx],
+        )
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        n = self.num_examples()
+        return [self[i:i + batch_size] for i in range(0, n, batch_size)]
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        return DataSet(
+            features=np.concatenate([d.features for d in datasets]),
+            labels=np.concatenate([d.labels for d in datasets]),
+            features_mask=(np.concatenate([d.features_mask for d in datasets])
+                           if datasets[0].features_mask is not None else None),
+            labels_mask=(np.concatenate([d.labels_mask for d in datasets])
+                         if datasets[0].labels_mask is not None else None),
+        )
+
+
+@dataclasses.dataclass
+class MultiDataSet:
+    """Multi-input/multi-output sample batch (ComputationGraph currency)."""
+
+    features: List[np.ndarray]
+    labels: List[np.ndarray]
+    features_masks: Optional[List[Optional[np.ndarray]]] = None
+    labels_masks: Optional[List[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])

@@ -1,0 +1,37 @@
+"""Iris dataset iterator.
+
+Parity: ``base/IrisUtils.java`` + ``datasets/fetchers/IrisDataFetcher.java``
++ ``datasets/iterator/impl/IrisDataSetIterator.java`` (the reference
+ships ``iris.dat`` as a resource; here the equivalent public copy comes
+from scikit-learn, already in the image). Features are min-max scaled to
+[0,1] as the reference's fetcher does; labels one-hot (3 classes).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+
+def load_iris_dataset(normalize: bool = True, shuffle_seed: int | None = None) -> DataSet:
+    from sklearn.datasets import load_iris
+
+    raw = load_iris()
+    x = raw.data.astype(np.float64)
+    if normalize:
+        x = (x - x.min(axis=0)) / (x.max(axis=0) - x.min(axis=0))
+    y = np.eye(3, dtype=np.float64)[raw.target]
+    ds = DataSet(x, y)
+    if shuffle_seed is not None:
+        ds = ds.shuffle(shuffle_seed)
+    return ds
+
+
+class IrisDataSetIterator(ListDataSetIterator):
+    """``IrisDataSetIterator(batch, numExamples)`` API parity."""
+
+    def __init__(self, batch: int = 150, num_examples: int = 150, seed: int = 6):
+        ds = load_iris_dataset(shuffle_seed=seed)[:num_examples]
+        super().__init__(ds, batch)

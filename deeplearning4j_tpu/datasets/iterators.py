@@ -1,0 +1,218 @@
+"""DataSetIterator hierarchy + async host→device prefetch.
+
+Parity: ``datasets/iterator/`` in the reference —
+``BaseDatasetIterator``, ``AsyncDataSetIterator`` (:36-76, background
+thread + blocking queue), ``MultipleEpochsIterator``. The async iterator
+is the host-side feed that keeps the TPU from stalling between steps:
+the worker thread stages upcoming minibatches while the chip runs the
+current one (the reference's device-affinity queue maps to
+``jax.device_put`` staging).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator over minibatch DataSets (``DataSetIterator`` contract:
+    hasNext/next/reset/batch/totalExamples)."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    def async_supported(self) -> bool:
+        return True
+
+
+class ListDataSetIterator(DataSetIterator):
+    """``ListDataSetIterator`` — minibatches from an in-memory DataSet."""
+
+    def __init__(self, data: DataSet, batch_size: int = 32, shuffle: bool = False, seed: int = 0):
+        self._data = data
+        self._batch = batch_size
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self._pos = 0
+        self._order = np.arange(data.num_examples())
+        self.reset()
+
+    def reset(self):
+        self._pos = 0
+        if self._shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            self._order = rng.permutation(self._data.num_examples())
+            self._epoch += 1
+
+    def has_next(self):
+        return self._pos < self._data.num_examples()
+
+    def next(self):
+        idx = self._order[self._pos:self._pos + self._batch]
+        self._pos += self._batch
+        return self._data[idx]
+
+    def batch(self):
+        return self._batch
+
+    def total_examples(self):
+        return self._data.num_examples()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background prefetch (``AsyncDataSetIterator.java:36-76``): a worker
+    thread pulls from the wrapped iterator into a bounded queue so batch
+    preparation overlaps device compute. ``MultiLayerNetwork.fit`` wraps
+    its iterator in this automatically (``MultiLayerNetwork.java:1032``
+    behavior)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, wrapped: DataSetIterator, queue_size: int = 4):
+        self._wrapped = wrapped
+        self._queue_size = queue_size
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._peeked: Optional[object] = None
+        self._exhausted = False
+        self._needs_reset = False  # thread starts lazily on first pull
+
+    def _worker(self, q: "queue.Queue", stop: threading.Event):
+        try:
+            while not stop.is_set() and self._wrapped.has_next():
+                item = self._wrapped.next()
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        finally:
+            try:
+                q.put_nowait(self._SENTINEL)
+            except queue.Full:
+                pass
+
+    def _start(self):
+        if self._needs_reset:
+            self._wrapped.reset()
+            self._needs_reset = False
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self._queue_size)
+        self._thread = threading.Thread(target=self._worker,
+                                        args=(self._queue, self._stop), daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()  # worker exits without draining the source
+            self._thread.join()
+        self._thread = None
+        self._peeked = None
+        self._exhausted = False
+        self._needs_reset = True
+
+    def has_next(self):
+        if self._peeked is not None:
+            return True
+        if self._exhausted:
+            return False
+        if self._thread is None:
+            self._start()
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            self._exhausted = True
+            return False
+        self._peeked = item
+        return True
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        item = self._peeked
+        self._peeked = None
+        return item
+
+    def batch(self):
+        return self._wrapped.batch()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """``MultipleEpochsIterator`` — replays the wrapped iterator N times."""
+
+    def __init__(self, epochs: int, wrapped: DataSetIterator):
+        self._epochs = epochs
+        self._wrapped = wrapped
+        self._epoch = 0
+
+    def reset(self):
+        self._epoch = 0
+        self._wrapped.reset()
+
+    def has_next(self):
+        if self._wrapped.has_next():
+            return True
+        if self._epoch + 1 < self._epochs:
+            self._epoch += 1
+            self._wrapped.reset()
+            return self._wrapped.has_next()
+        return False
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        return self._wrapped.next()
+
+    def batch(self):
+        return self._wrapped.batch()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """``SamplingDataSetIterator`` — random with-replacement minibatches."""
+
+    def __init__(self, data: DataSet, batch_size: int, total_batches: int, seed: int = 0):
+        self._data = data
+        self._batch = batch_size
+        self._total = total_batches
+        self._rng = np.random.default_rng(seed)
+        self._count = 0
+
+    def reset(self):
+        self._count = 0
+
+    def has_next(self):
+        return self._count < self._total
+
+    def next(self):
+        self._count += 1
+        idx = self._rng.integers(0, self._data.num_examples(), self._batch)
+        return self._data[idx]
+
+    def batch(self):
+        return self._batch

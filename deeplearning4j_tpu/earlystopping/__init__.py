@@ -1,0 +1,12 @@
+from deeplearning4j_tpu.earlystopping.earlystopping import (  # noqa: F401
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+    DataSetLossCalculator,
+)

@@ -1,0 +1,243 @@
+"""Early stopping: configuration, termination conditions, savers, trainer.
+
+Parity: ``earlystopping/`` (22 files, SURVEY.md §2.1) —
+``EarlyStoppingConfiguration``, epoch/iteration termination conditions,
+score calculators, model savers (memory/disk), and
+``trainer/BaseEarlyStoppingTrainer.java:46`` driving train-epoch →
+evaluate → maybe-save-best → maybe-terminate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, List, Optional
+
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+# ---------------------------------------------------------------- conditions
+
+class EpochTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class IterationTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, last_score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch, score):
+        return epoch + 1 >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop after N epochs without (min-delta) improvement."""
+
+    def __init__(self, max_epochs_without_improvement: int, min_improvement: float = 0.0):
+        self.patience = max_epochs_without_improvement
+        self.min_improvement = min_improvement
+        self.best = float("inf")
+        self.since = 0
+
+    def initialize(self):
+        self.best = float("inf")
+        self.since = 0
+
+    def terminate(self, epoch, score):
+        if score < self.best - self.min_improvement:
+            self.best = score
+            self.since = 0
+        else:
+            self.since += 1
+        return self.since >= self.patience
+
+
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self.start = time.time()
+
+    def initialize(self):
+        self.start = time.time()
+
+    def terminate(self, last_score):
+        return (time.time() - self.start) > self.max_seconds
+
+
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Terminate if score exceeds a bound (divergence guard)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, last_score):
+        return last_score > self.max_score or last_score != last_score  # NaN
+
+
+# ------------------------------------------------------------------- savers
+
+class InMemoryModelSaver:
+    """``saver/InMemoryModelSaver.java``."""
+
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, model, score):
+        self._best = (model.clone() if hasattr(model, "clone") else model, score)
+
+    def save_latest_model(self, model, score):
+        self._latest = (model, score)
+
+    def get_best_model(self):
+        return self._best[0] if self._best else None
+
+    def get_latest_model(self):
+        return self._latest[0] if self._latest else None
+
+
+class LocalFileModelSaver:
+    """``saver/LocalFileModelSaver.java`` — zip checkpoints on disk."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name):
+        return os.path.join(self.dir, name)
+
+    def save_best_model(self, model, score):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        write_model(model, self._path("bestModel.zip"))
+
+    def save_latest_model(self, model, score):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        write_model(model, self._path("latestModel.zip"))
+
+    def get_best_model(self):
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+        return restore_model(self._path("bestModel.zip"))
+
+    def get_latest_model(self):
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+        return restore_model(self._path("latestModel.zip"))
+
+
+# ---------------------------------------------------------- score calculators
+
+class DataSetLossCalculator:
+    """``scorecalc/DataSetLossCalculator.java`` — average loss over an
+    iterator (eval mode)."""
+
+    def __init__(self, iterator: DataSetIterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, model) -> float:
+        total, n = 0.0, 0
+        self.iterator.reset()
+        for ds in self.iterator:
+            total += model.score(ds) * ds.num_examples()
+            n += ds.num_examples()
+        return total / n if (self.average and n) else total
+
+
+# -------------------------------------------------------------- configuration
+
+@dataclasses.dataclass
+class EarlyStoppingConfiguration:
+    epoch_termination_conditions: List[EpochTerminationCondition] = dataclasses.field(default_factory=list)
+    iteration_termination_conditions: List[IterationTerminationCondition] = dataclasses.field(default_factory=list)
+    score_calculator: Optional[DataSetLossCalculator] = None
+    model_saver: object = dataclasses.field(default_factory=InMemoryModelSaver)
+    save_last_model: bool = False
+    evaluate_every_n_epochs: int = 1
+
+
+@dataclasses.dataclass
+class EarlyStoppingResult:
+    termination_reason: str
+    termination_details: str
+    total_epochs: int
+    best_model_epoch: int
+    best_model_score: float
+    best_model: object
+
+
+class EarlyStoppingTrainer:
+    """``trainer/BaseEarlyStoppingTrainer.java:46`` driver for
+    MultiLayerNetwork and ComputationGraph alike."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model, train_iterator: DataSetIterator):
+        self.config = config
+        self.model = model
+        self.iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.epoch_termination_conditions:
+            c.initialize()
+        for c in cfg.iteration_termination_conditions:
+            c.initialize()
+        best_score = float("inf")
+        best_epoch = -1
+        epoch = 0
+        reason, details = "EpochTerminationCondition", ""
+        while True:
+            self.iterator.reset()
+            stop_iter = False
+            for ds in self.iterator:
+                self.model.fit(ds)
+                last = self.model.score()
+                for c in cfg.iteration_termination_conditions:
+                    if c.terminate(last):
+                        reason = "IterationTerminationCondition"
+                        details = type(c).__name__
+                        stop_iter = True
+                        break
+                if stop_iter:
+                    break
+            if stop_iter:
+                break
+            # score/save only every N epochs; termination checked EVERY
+            # epoch (reference semantics — MaxEpochs must not overshoot)
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                if cfg.score_calculator is not None:
+                    score = cfg.score_calculator.calculate_score(self.model)
+                else:
+                    score = self.model.score()
+                if score < best_score:
+                    best_score = score
+                    best_epoch = epoch
+                    cfg.model_saver.save_best_model(self.model, score)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(self.model, score)
+            terminated = False
+            for c in cfg.epoch_termination_conditions:
+                if c.terminate(epoch, score if epoch % cfg.evaluate_every_n_epochs == 0
+                               else best_score):
+                    reason = "EpochTerminationCondition"
+                    details = type(c).__name__
+                    terminated = True
+                    break
+            if terminated:
+                break
+            epoch += 1
+        return EarlyStoppingResult(
+            termination_reason=reason, termination_details=details,
+            total_epochs=epoch + 1, best_model_epoch=best_epoch,
+            best_model_score=best_score,
+            best_model=cfg.model_saver.get_best_model() or self.model)

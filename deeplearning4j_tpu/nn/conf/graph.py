@@ -1,0 +1,215 @@
+"""Graph vertex configurations + functional vertex ops.
+
+Parity: ``nn/conf/graph/*.java`` + ``nn/graph/vertex/impl/*.java`` —
+the 9 non-layer DAG ops plus the 2 rnn vertices (SURVEY.md §2.1 "Graph
+vertices"). In the reference each vertex has hand-written
+doForward/doBackward; here each is a pure function over its input
+arrays (backprop via jax.grad), so a vertex config IS its
+implementation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+import jax.numpy as jnp
+
+_VERTEX_REGISTRY: Dict[str, Type["GraphVertex"]] = {}
+
+
+def register_vertex(cls):
+    _VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def vertex_from_dict(d: Dict[str, Any]) -> "GraphVertex":
+    d = dict(d)
+    name = d.pop("@type")
+    if name == "PreprocessorVertex":
+        from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_from_dict
+        return _VERTEX_REGISTRY[name](preprocessor=preprocessor_from_dict(d["preprocessor"]))
+    for k, v in d.items():
+        if isinstance(v, list):
+            d[k] = tuple(v)
+    return _VERTEX_REGISTRY[name](**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphVertex:
+    """A parameterless DAG op: forward(inputs, masks) -> output."""
+
+    def forward(self, inputs: List[jnp.ndarray],
+                masks: Optional[List[Optional[jnp.ndarray]]] = None) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"@type": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            d[f.name] = list(v) if isinstance(v, tuple) else v
+        return d
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class MergeVertex(GraphVertex):
+    """``MergeVertex.java`` — concatenate along the feature axis (last
+    here; the reference's dim-1 in NCHW/[b,f,t] maps to last in
+    NHWC/[b,t,f])."""
+
+    def forward(self, inputs, masks=None):
+        return jnp.concatenate(inputs, axis=-1)
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class ElementWiseVertex(GraphVertex):
+    """``ElementWiseVertex.java`` — Add / Subtract / Product / Max."""
+
+    op: str = "add"
+
+    def forward(self, inputs, masks=None):
+        out = inputs[0]
+        for x in inputs[1:]:
+            if self.op == "add":
+                out = out + x
+            elif self.op == "subtract":
+                out = out - x
+            elif self.op == "product":
+                out = out * x
+            elif self.op == "max":
+                out = jnp.maximum(out, x)
+            else:
+                raise ValueError(self.op)
+        return out
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class SubsetVertex(GraphVertex):
+    """``SubsetVertex.java`` — feature-range slice [from, to] inclusive."""
+
+    from_index: int = 0
+    to_index: int = 0
+
+    def forward(self, inputs, masks=None):
+        return inputs[0][..., self.from_index:self.to_index + 1]
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class StackVertex(GraphVertex):
+    """``StackVertex.java`` — stack along batch axis (examples appended)."""
+
+    def forward(self, inputs, masks=None):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class UnstackVertex(GraphVertex):
+    """``UnstackVertex.java`` — take the i-th of ``stack_size`` equal
+    batch-axis chunks."""
+
+    from_index: int = 0
+    stack_size: int = 1
+
+    def forward(self, inputs, masks=None):
+        x = inputs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_index * step:(self.from_index + 1) * step]
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class L2NormalizeVertex(GraphVertex):
+    """``L2NormalizeVertex.java`` — x / ||x||₂ per example."""
+
+    eps: float = 1e-8
+
+    def forward(self, inputs, masks=None):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        n = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + self.eps)
+        return x / n
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class L2Vertex(GraphVertex):
+    """``L2Vertex.java`` — pairwise L2 distance between two inputs → [b, 1]."""
+
+    eps: float = 1e-8
+
+    def forward(self, inputs, masks=None):
+        a, b = inputs
+        axes = tuple(range(1, a.ndim))
+        return jnp.sqrt(jnp.sum((a - b) ** 2, axis=axes) + self.eps)[:, None]
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class ScaleVertex(GraphVertex):
+    """``ScaleVertex.java`` — multiply by a fixed scalar."""
+
+    scale: float = 1.0
+
+    def forward(self, inputs, masks=None):
+        return inputs[0] * self.scale
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class ShiftVertex(GraphVertex):
+    """``ShiftVertex.java`` — add a fixed scalar."""
+
+    shift: float = 0.0
+
+    def forward(self, inputs, masks=None):
+        return inputs[0] + self.shift
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class PreprocessorVertex(GraphVertex):
+    """``PreprocessorVertex.java`` — wrap an InputPreProcessor as a vertex."""
+
+    preprocessor: Any = None
+
+    def forward(self, inputs, masks=None):
+        return self.preprocessor(inputs[0])
+
+    def to_dict(self):
+        return {"@type": "PreprocessorVertex", "preprocessor": self.preprocessor.to_dict()}
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class LastTimeStepVertex(GraphVertex):
+    """``rnn/LastTimeStepVertex.java`` — [b,t,f] -> [b,f] at each
+    example's last unmasked step (mask of the named input)."""
+
+    mask_input: Optional[str] = None
+
+    def forward(self, inputs, masks=None):
+        x = inputs[0]
+        mask = masks[0] if masks else None
+        if mask is None:
+            return x[:, -1, :]
+        idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """``rnn/DuplicateToTimeSeriesVertex.java`` — [b,f] -> [b,t,f],
+    t taken from a reference input named in config (second input here)."""
+
+    ref_input: Optional[str] = None
+
+    def forward(self, inputs, masks=None):
+        x, ref = inputs
+        t = ref.shape[1]
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[-1]))

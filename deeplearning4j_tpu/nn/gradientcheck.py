@@ -1,0 +1,124 @@
+"""Finite-difference gradient checker — the framework's correctness oracle.
+
+Parity: ``gradientcheck/GradientCheckUtil.java:36`` (checkGradients MLN
+:57, CG :170) and the test doctrine of SURVEY.md §4: perturb each
+parameter, central-difference the score, compare to the analytic
+gradient.
+
+Backend note: this environment's CPU transcendentals (tanh/sigmoid/pow)
+carry ~1e-8 absolute noise even at f64, so the checker defaults to
+epsilon=1e-4 (noise/2h ≈ 5e-5 absolute on the numeric gradient) and a
+relative-error threshold of 1e-2 with an absolute floor — looser than
+the reference's 1e-3/f64 but sound for these primitives. Pure
+matmul+relu+softmax paths check much tighter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class GradCheckResult:
+    ok: bool
+    max_rel_error: float
+    n_checked: int
+    n_failed: int
+    failures: List[str]
+
+
+def check_gradients_fn(
+    loss_fn: Callable[[jnp.ndarray], jnp.ndarray],
+    flat_params: jnp.ndarray,
+    epsilon: float = 1e-4,
+    max_rel_error: float = 1e-2,
+    min_abs_error: float = 1e-5,
+    subset: Optional[int] = None,
+    seed: int = 0,
+) -> GradCheckResult:
+    """Check d loss / d params for a scalar loss over a flat f64 vector.
+
+    ``subset``: check only N randomly chosen indices (for big nets).
+    """
+    flat_params = jnp.asarray(flat_params, jnp.float64)
+    loss_jit = jax.jit(loss_fn)
+    analytic = np.asarray(jax.jit(jax.grad(loss_fn))(flat_params))
+    n = flat_params.shape[0]
+    idxs = np.arange(n)
+    if subset is not None and subset < n:
+        idxs = np.random.default_rng(seed).choice(n, subset, replace=False)
+
+    base = np.asarray(flat_params)
+    failures: List[str] = []
+    max_rel = 0.0
+    for i in idxs:
+        p_plus = base.copy()
+        p_plus[i] += epsilon
+        p_minus = base.copy()
+        p_minus[i] -= epsilon
+        numeric = (float(loss_jit(jnp.asarray(p_plus))) - float(loss_jit(jnp.asarray(p_minus)))) / (2 * epsilon)
+        a = float(analytic[i])
+        abs_err = abs(a - numeric)
+        denom = max(abs(a), abs(numeric))
+        rel = abs_err / denom if denom > 0 else 0.0
+        if abs_err > min_abs_error and rel > max_rel:
+            max_rel = rel
+        if rel > max_rel_error and abs_err > min_abs_error:
+            failures.append(f"param[{i}]: analytic={a:.3e} numeric={numeric:.3e} rel={rel:.3e}")
+    return GradCheckResult(
+        ok=not failures,
+        max_rel_error=max_rel,
+        n_checked=len(idxs),
+        n_failed=len(failures),
+        failures=failures[:25],
+    )
+
+
+def check_gradients(model, ds, epsilon: float = 1e-4, max_rel_error: float = 1e-2,
+                    min_abs_error: float = 1e-5, subset: Optional[int] = None,
+                    train: bool = False) -> GradCheckResult:
+    """Gradient-check a MultiLayerNetwork (or any model exposing
+    ``params`` pytree + ``_score_fn``) on a DataSet, in f64.
+
+    ``train=True`` checks the training-mode graph (batch-norm batch
+    statistics, like the reference's BN gradient checks) with a fixed
+    dropout key — only valid when dropout is 0 (randomness would break
+    finite differences).
+    """
+    params64 = jax.tree.map(lambda v: v.astype(jnp.float64), model.params)
+    flat, unravel = jax.flatten_util.ravel_pytree(params64)
+    x = jnp.asarray(ds.features, jnp.float64)
+    y = jnp.asarray(ds.labels, jnp.float64)
+    fmask = jnp.asarray(ds.features_mask, jnp.float64) if ds.features_mask is not None else None
+    lmask = jnp.asarray(ds.labels_mask, jnp.float64) if ds.labels_mask is not None else None
+    rng = jax.random.PRNGKey(0) if train else None
+
+    def loss(v):
+        return model._score_fn(unravel(v), model.states, x, y, train, rng, fmask, lmask)[0]
+
+    return check_gradients_fn(loss, flat, epsilon, max_rel_error, min_abs_error, subset)
+
+
+def check_gradients_graph(graph, mds, epsilon: float = 1e-4, max_rel_error: float = 1e-2,
+                          min_abs_error: float = 1e-5, subset: Optional[int] = None,
+                          train: bool = False) -> GradCheckResult:
+    """Gradient-check a ComputationGraph on a MultiDataSet
+    (``GradientCheckUtil.checkGradients`` CG overload :170)."""
+    params64 = jax.tree.map(lambda v: v.astype(jnp.float64), graph.params)
+    flat, unravel = jax.flatten_util.ravel_pytree(params64)
+    inputs, labels, fmasks, lmasks = graph._tensors(mds)
+    to64 = lambda d: {k: v.astype(jnp.float64) for k, v in d.items()}
+    inputs, labels, fmasks, lmasks = to64(inputs), to64(labels), to64(fmasks), to64(lmasks)
+    rng = jax.random.PRNGKey(0) if train else None
+
+    def loss(v):
+        return graph._score_fn(unravel(v), graph.states, inputs, labels, train, rng,
+                               fmasks, lmasks)[0]
+
+    return check_gradients_fn(loss, flat, epsilon, max_rel_error, min_abs_error, subset)

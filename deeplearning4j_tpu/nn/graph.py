@@ -1,0 +1,356 @@
+"""ComputationGraph — the DAG model container.
+
+Parity: ``nn/graph/ComputationGraph.java:74`` (init :264, Kahn
+topological sort w/ cycle detection :844-880, computeGradientAndScore
+:884, fit(MultiDataSet) :677) and
+``nn/conf/ComputationGraphConfiguration.java`` (GraphBuilder API).
+
+As with MultiLayerNetwork, the whole DAG iteration — every vertex
+forward in topological order, loss over all output layers, backward,
+updaters — is traced into ONE XLA program; vertex hops have no dispatch
+cost (XLA fuses across them), where the reference paid per-vertex ND4J
+op dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+
+import deeplearning4j_tpu.nn.layers  # noqa: F401  (registers layer impls)
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import GraphVertex, vertex_from_dict
+from deeplearning4j_tpu.nn.conf.layers import layer_from_dict
+from deeplearning4j_tpu.nn.layers.base import build_layer
+from deeplearning4j_tpu.nn.updater import (
+    GradientNormalization,
+    apply_updater,
+    init_updater_state,
+    normalize_gradient,
+)
+
+
+@dataclasses.dataclass
+class VertexDef:
+    name: str
+    kind: str  # "input" | "layer" | "op"
+    inputs: List[str]
+    layer: Optional[L.Layer] = None
+    vertex: Optional[GraphVertex] = None
+
+
+@dataclasses.dataclass
+class ComputationGraphConfiguration:
+    conf: NeuralNetConfiguration
+    vertices: List[VertexDef]
+    outputs: List[str]
+
+    class GraphBuilder:
+        """``ComputationGraphConfiguration.GraphBuilder`` fluent API."""
+
+        def __init__(self, conf: Optional[NeuralNetConfiguration] = None):
+            self._conf = conf or NeuralNetConfiguration()
+            self._vertices: List[VertexDef] = []
+            self._outputs: List[str] = []
+
+        def add_inputs(self, *names: str) -> "ComputationGraphConfiguration.GraphBuilder":
+            for n in names:
+                self._vertices.append(VertexDef(n, "input", []))
+            return self
+
+        def add_layer(self, name: str, layer: L.Layer, *inputs: str):
+            self._vertices.append(VertexDef(name, "layer", list(inputs), layer=layer))
+            return self
+
+        def add_vertex(self, name: str, vertex: GraphVertex, *inputs: str):
+            self._vertices.append(VertexDef(name, "op", list(inputs), vertex=vertex))
+            return self
+
+        def set_outputs(self, *names: str):
+            self._outputs = list(names)
+            return self
+
+        def build(self) -> "ComputationGraphConfiguration":
+            import copy
+            return ComputationGraphConfiguration(
+                conf=self._conf, vertices=copy.deepcopy(self._vertices),
+                outputs=list(self._outputs))
+
+    @staticmethod
+    def builder(conf: Optional[NeuralNetConfiguration] = None):
+        return ComputationGraphConfiguration.GraphBuilder(conf)
+
+    # -------- serialization --------
+
+    def to_json(self) -> str:
+        def vd(v: VertexDef):
+            d = {"name": v.name, "kind": v.kind, "inputs": v.inputs}
+            if v.layer is not None:
+                d["layer"] = v.layer.to_dict()
+            if v.vertex is not None:
+                d["vertex"] = v.vertex.to_dict()
+            return d
+
+        return json.dumps({
+            "conf": self.conf.to_dict(),
+            "vertices": [vd(v) for v in self.vertices],
+            "outputs": self.outputs,
+        }, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        d = json.loads(s)
+        verts = [VertexDef(
+            name=v["name"], kind=v["kind"], inputs=v["inputs"],
+            layer=layer_from_dict(v["layer"]) if "layer" in v else None,
+            vertex=vertex_from_dict(v["vertex"]) if "vertex" in v else None,
+        ) for v in d["vertices"]]
+        return ComputationGraphConfiguration(
+            conf=NeuralNetConfiguration.from_dict(d["conf"]),
+            vertices=verts, outputs=d["outputs"])
+
+
+def topological_order(vertices: Sequence[VertexDef]) -> List[str]:
+    """Kahn's algorithm with cycle detection
+    (``ComputationGraph.java:844-880``)."""
+    by_name = {v.name: v for v in vertices}
+    for v in vertices:
+        for i in v.inputs:
+            if i not in by_name:
+                raise ValueError(f"vertex '{v.name}' references unknown input '{i}'")
+    in_deg = {v.name: len(v.inputs) for v in vertices}
+    children: Dict[str, List[str]] = {v.name: [] for v in vertices}
+    for v in vertices:
+        for i in v.inputs:
+            children[i].append(v.name)
+    queue = [n for n, d in in_deg.items() if d == 0]
+    order: List[str] = []
+    while queue:
+        n = queue.pop(0)
+        order.append(n)
+        for c in children[n]:
+            in_deg[c] -= 1
+            if in_deg[c] == 0:
+                queue.append(c)
+    if len(order) != len(vertices):
+        cyc = [n for n, d in in_deg.items() if d > 0]
+        raise ValueError(f"cycle detected in graph involving {cyc}")
+    return order
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.gc = conf.conf
+        self.defs = {v.name: v for v in conf.vertices}
+        self.order = topological_order(conf.vertices)
+        self.input_names = [v.name for v in conf.vertices if v.kind == "input"]
+        self.output_names = conf.outputs
+        if not self.output_names:
+            raise ValueError("graph has no outputs set")
+        self.impls = {}
+        for v in conf.vertices:
+            if v.kind == "layer":
+                self.impls[v.name] = build_layer(self.gc, v.layer, v.name)
+        # output layers that carry loss
+        self.loss_outputs = [n for n in self.output_names
+                             if n in self.impls and self.impls[n].has_loss()]
+        if not self.loss_outputs:
+            raise ValueError("at least one output must be an output/loss layer")
+        self.params = None
+        self.states = None
+        self.opt_state = None
+        self.listeners: List[Callable] = []
+        self._score = float("nan")
+        self._dtype = jnp.float32
+        self._jits: Dict[Any, Callable] = {}
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, dtype=jnp.float32) -> "ComputationGraph":
+        self._dtype = dtype
+        key = jax.random.PRNGKey(self.gc.seed)
+        self.params, self.states, upd = {}, {}, {}
+        names = sorted(self.impls.keys())
+        keys = jax.random.split(key, max(1, len(names)))
+        for name, k in zip(names, keys):
+            impl = self.impls[name]
+            p = {n: v.astype(dtype) for n, v in impl.init_params(k).items()}
+            self.params[name] = p
+            self.states[name] = impl.init_state()
+            ucfg = self.gc.updater_config_for(impl.conf)
+            upd[name] = {n: init_updater_state(ucfg, v) for n, v in p.items()}
+        self.opt_state = {"step": jnp.zeros((), jnp.int32), "updater": upd}
+        self._jits = {}
+        return self
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+
+    # -------------------------------------------------------- functional core
+
+    def _forward_all(self, params, states, inputs: Dict[str, jnp.ndarray],
+                     train: bool, rng, fmasks: Dict[str, jnp.ndarray]):
+        acts: Dict[str, jnp.ndarray] = {}
+        masks: Dict[str, Optional[jnp.ndarray]] = {}
+        new_states = dict(states)
+        for vi, name in enumerate(self.order):
+            v = self.defs[name]
+            if v.kind == "input":
+                acts[name] = inputs[name]
+                masks[name] = fmasks.get(name)
+            elif v.kind == "layer":
+                impl = self.impls[name]
+                x = acts[v.inputs[0]]
+                m = masks[v.inputs[0]]
+                lrng = jax.random.fold_in(rng, vi) if rng is not None else None
+                out, ns = impl.forward(params[name], x, states[name], train, lrng, mask=m)
+                acts[name] = out
+                new_states[name] = ns
+                # rnn layers preserve mask; pooling over time consumes it
+                masks[name] = m if out.ndim == 3 else None
+            else:
+                ins = [acts[i] for i in v.inputs]
+                ms = [masks[i] for i in v.inputs]
+                acts[name] = v.vertex.forward(ins, ms)
+                masks[name] = ms[0] if acts[name].ndim == 3 else None
+        return acts, masks, new_states
+
+    def _score_fn(self, params, states, inputs, labels: Dict[str, jnp.ndarray],
+                  train: bool, rng, fmasks, lmasks):
+        """Σ output-layer losses + L1/L2 (``computeGradientAndScore`` :884,
+        score summed over output layers :895-908)."""
+        acts, masks, new_states = self._forward_all(params, states, inputs, train, rng, fmasks)
+        score = None
+        for vi, name in enumerate(self.loss_outputs):
+            v = self.defs[name]
+            impl = self.impls[name]
+            x = acts[v.inputs[0]]
+            lrng = jax.random.fold_in(rng, 10_000 + vi) if rng is not None else None
+            lmask = lmasks.get(name) if lmasks else None
+            s = impl.score(params[name], x, labels[name], states[name], train, lrng, mask=lmask)
+            score = s if score is None else score + s
+        for name, impl in self.impls.items():
+            score = score + impl.regularization_penalty(params[name]).astype(score.dtype)
+        return score, new_states
+
+    def _make_train_step(self):
+        gn, ucfgs = {}, {}
+        for name, impl in self.impls.items():
+            gn[name] = (GradientNormalization(self.gc.resolve(impl.conf, "gradient_normalization")),
+                        self.gc.resolve(impl.conf, "gradient_normalization_threshold"))
+            ucfgs[name] = self.gc.updater_config_for(impl.conf)
+
+        def step(params, opt_state, states, inputs, labels, fmasks, lmasks, rng_key):
+            it = opt_state["step"]
+            rng = jax.random.fold_in(rng_key, it)
+
+            def loss(p):
+                return self._score_fn(p, states, inputs, labels, True, rng, fmasks, lmasks)
+
+            (score, new_states), grads = jax.value_and_grad(loss, has_aux=True)(params)
+            new_params, new_upd = {}, {}
+            for name, impl in self.impls.items():
+                nt, thr = gn[name]
+                g = normalize_gradient(nt, grads[name], thr)
+                new_params[name], new_upd[name] = {}, {}
+                for pname, gval in g.items():
+                    u, ust = apply_updater(ucfgs[name], gval, opt_state["updater"][name][pname], it)
+                    new_params[name][pname] = params[name][pname] - u.astype(params[name][pname].dtype)
+                    new_upd[name][pname] = ust
+            return new_params, {"step": it + 1, "updater": new_upd}, new_states, score
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # ----------------------------------------------------------------- train
+
+    def _to_mds(self, data) -> MultiDataSet:
+        if isinstance(data, DataSet):
+            return MultiDataSet(
+                features=[data.features], labels=[data.labels],
+                features_masks=[data.features_mask] if data.features_mask is not None else None,
+                labels_masks=[data.labels_mask] if data.labels_mask is not None else None)
+        return data
+
+    def _tensors(self, mds: MultiDataSet):
+        """Features map positionally onto ``add_inputs`` order; labels and
+        label masks onto ``set_outputs`` order (loss outputs selected by
+        name from that alignment)."""
+        inputs = {n: jnp.asarray(f, self._dtype) for n, f in zip(self.input_names, mds.features)}
+        by_output = dict(zip(self.output_names, mds.labels))
+        labels = {n: jnp.asarray(by_output[n], self._dtype) for n in self.loss_outputs}
+        fmasks = {}
+        if mds.features_masks:
+            for n, m in zip(self.input_names, mds.features_masks):
+                if m is not None:
+                    fmasks[n] = jnp.asarray(m, self._dtype)
+        lmasks = {}
+        if mds.labels_masks:
+            for n, m in zip(self.output_names, mds.labels_masks):
+                if m is not None and n in self.loss_outputs:
+                    lmasks[n] = jnp.asarray(m, self._dtype)
+        return inputs, labels, fmasks, lmasks
+
+    def fit(self, data: Union[DataSet, MultiDataSet], epochs: int = 1) -> None:
+        """``fit(MultiDataSet)`` :677."""
+        if self.params is None:
+            self.init()
+        mds = self._to_mds(data)
+        if "train" not in self._jits:
+            self._jits["train"] = self._make_train_step()
+        step = self._jits["train"]
+        rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
+        inputs, labels, fmasks, lmasks = self._tensors(mds)
+        for _ in range(epochs):
+            for _ in range(max(1, self.gc.iterations)):
+                self.params, self.opt_state, self.states, score = step(
+                    self.params, self.opt_state, self.states, inputs, labels, fmasks, lmasks, rng_key)
+                self._score = float(score)
+                for cb in self.listeners:
+                    cb(self, int(self.opt_state["step"]), self._score)
+
+    # ------------------------------------------------------------- inference
+
+    def outputs(self, *features: np.ndarray,
+                features_masks: Optional[Dict[str, np.ndarray]] = None) -> List[np.ndarray]:
+        """``ComputationGraph.outputs`` — activations of all graph outputs."""
+        inputs = {n: jnp.asarray(f, self._dtype) for n, f in zip(self.input_names, features)}
+        fmasks = {k: jnp.asarray(v, self._dtype) for k, v in (features_masks or {}).items()}
+        key = ("outputs", tuple(sorted(fmasks)))
+        if key not in self._jits:
+            self._jits[key] = jax.jit(
+                lambda p, s, i, fm: self._forward_all(p, s, i, False, None, fm)[0])
+        acts = self._jits[key](self.params, self.states, inputs, fmasks)
+        return [np.asarray(acts[n]) for n in self.output_names]
+
+    def output(self, *features: np.ndarray) -> np.ndarray:
+        return self.outputs(*features)[0]
+
+    def score(self, data=None) -> float:
+        if data is None:
+            return self._score
+        mds = self._to_mds(data)
+        inputs, labels, fmasks, lmasks = self._tensors(mds)
+        return float(self._score_fn(self.params, self.states, inputs, labels,
+                                    False, None, fmasks, lmasks)[0])
+
+    # ----------------------------------------------------- flat param views
+
+    def params_flat(self) -> np.ndarray:
+        flat, _ = jax.flatten_util.ravel_pytree(self.params)
+        return np.asarray(flat)
+
+    def set_params_flat(self, vec: np.ndarray) -> None:
+        _, unravel = jax.flatten_util.ravel_pytree(self.params)
+        self.params = unravel(jnp.asarray(vec, self._dtype))
+
+    def num_params(self) -> int:
+        return int(self.params_flat().shape[0])

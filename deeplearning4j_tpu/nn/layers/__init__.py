@@ -10,3 +10,9 @@ XLA emits TPU kernels for conv/pool/norm directly.
 """
 
 from deeplearning4j_tpu.nn.layers.base import LayerImpl, build_layer  # noqa: F401
+from deeplearning4j_tpu.nn.layers import (  # noqa: F401  (registers impls)
+    convolution,
+    feedforward,
+    normalization,
+    recurrent,
+)

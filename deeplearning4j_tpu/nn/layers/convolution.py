@@ -1,0 +1,88 @@
+"""Convolution + subsampling (pooling) layers.
+
+Parity: ``nn/layers/convolution/ConvolutionLayer.java:45`` and
+``subsampling/SubsamplingLayer.java:50`` plus their cuDNN helpers
+(``CudnnConvolutionHelper.java:51``, ``CudnnSubsamplingHelper.java``).
+
+TPU-first: the reference's im2col + gemm (CPU) / cuDNN descriptor-and-
+workspace machinery (GPU) collapses into a single
+``lax.conv_general_dilated`` / ``lax.reduce_window`` — XLA picks the MXU
+tiling, so there is no algo-mode knob and no workspace management. NHWC
+layout (TPU-native; the reference is NCHW).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers.base import LayerImpl, register_impl
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import activate
+
+_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def _padding(conf) -> object:
+    if getattr(conf, "convolution_mode", "truncate") == "same":
+        return "SAME"
+    ph, pw = conf.padding
+    return [(ph, ph), (pw, pw)]
+
+
+@register_impl(L.ConvolutionLayer)
+class ConvolutionImpl(LayerImpl):
+    def init_params(self, key: jax.Array) -> Dict[str, jnp.ndarray]:
+        c = self.conf
+        kh, kw = c.kernel_size
+        # receptive-field fans (ConvolutionParamInitializer convention)
+        fan_in = c.n_in * kh * kw
+        fan_out = c.n_out * kh * kw
+        W = init_weights(key, (kh, kw, c.n_in, c.n_out), self.weight_init,
+                         fan_in, fan_out, c.dist_mean, c.dist_std)
+        b = jnp.full((c.n_out,), self.bias_init, jnp.float32)
+        return {"W": W, "b": b}
+
+    def forward(self, params, x, state, train, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        z = jax.lax.conv_general_dilated(
+            x, params["W"].astype(x.dtype),
+            window_strides=self.conf.stride,
+            padding=_padding(self.conf),
+            dimension_numbers=_DIMS,
+        ) + params["b"].astype(x.dtype)
+        return activate(self.activation, z), state
+
+
+@register_impl(L.SubsamplingLayer)
+class SubsamplingImpl(LayerImpl):
+    """Max/avg/sum/p-norm pooling via ``lax.reduce_window`` (the XLA op
+    the cuDNN pooling descriptor becomes on TPU)."""
+
+    def forward(self, params, x, state, train, rng=None, mask=None):
+        c = self.conf
+        kh, kw = c.kernel_size
+        sh, sw = c.stride
+        ph, pw = c.padding
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        pt = c.pooling_type
+        if pt == L.PoolingType.MAX:
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+        elif pt in (L.PoolingType.AVG, L.PoolingType.SUM):
+            out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+            if pt == L.PoolingType.AVG:
+                out = out / (kh * kw)
+        elif pt == L.PoolingType.PNORM:
+            p = float(c.pnorm)
+            out = jax.lax.reduce_window(jnp.abs(x) ** p, 0.0, jax.lax.add, window, strides, pads)
+            out = out ** (1.0 / p)
+        else:
+            raise ValueError(f"unknown pooling type {pt}")
+        return out, state

@@ -1,0 +1,83 @@
+"""Batch normalization + local response normalization.
+
+Parity: ``nn/layers/normalization/BatchNormalization.java:38`` (+
+``CudnnBatchNormalizationHelper.java``) and
+``LocalResponseNormalization.java`` (+ cuDNN LRN helper). On TPU both
+are plain fused XLA elementwise/reduce graphs; the moving statistics are
+non-trainable state threaded through the compiled train step (the
+reference mutated layer fields).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers.base import LayerImpl, register_impl
+
+
+@register_impl(L.BatchNormalization)
+class BatchNormalizationImpl(LayerImpl):
+    """Normalizes over batch (FF [b,f]) or batch+space (CNN NHWC
+    [b,h,w,c], per channel)."""
+
+    def init_params(self, key) -> Dict[str, jnp.ndarray]:
+        c = self.conf
+        n = c.n_out
+        if c.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.full((n,), c.gamma, jnp.float32),
+                "beta": jnp.full((n,), c.beta, jnp.float32)}
+
+    def init_state(self):
+        n = self.conf.n_out
+        return {"mean": jnp.zeros((n,), jnp.float32),
+                "var": jnp.ones((n,), jnp.float32)}
+
+    def forward(self, params, x, state, train, rng=None, mask=None):
+        c = self.conf
+        axes = tuple(range(x.ndim - 1))  # (0,) ff / (0,1,2) nhwc
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            d = jnp.asarray(c.decay, jnp.float32)
+            new_state = {
+                "mean": d * state["mean"] + (1 - d) * mean.astype(jnp.float32),
+                "var": d * state["var"] + (1 - d) * var.astype(jnp.float32),
+            }
+        else:
+            mean, var = state["mean"].astype(x.dtype), state["var"].astype(x.dtype)
+            new_state = state
+        xhat = (x - mean) / jnp.sqrt(var + c.eps)
+        if c.lock_gamma_beta:
+            out = c.gamma * xhat + c.beta
+        else:
+            out = params["gamma"].astype(x.dtype) * xhat + params["beta"].astype(x.dtype)
+        return out, new_state
+
+    def regularization_penalty(self, params):
+        return jnp.asarray(0.0, jnp.float32)  # reference: no l1/l2 on BN params
+
+
+@register_impl(L.LocalResponseNormalization)
+class LocalResponseNormalizationImpl(LayerImpl):
+    """Cross-channel LRN on NHWC: y = x / (k + alpha*Σ_window x²)^beta,
+    window of ``n`` adjacent channels (``LocalResponseNormalization.java``).
+    Implemented as a channel-axis reduce_window — one fused XLA op."""
+
+    def forward(self, params, x, state, train, rng=None, mask=None):
+        c = self.conf
+        n = int(c.n)
+        half = n // 2
+        sq = x * x
+        s = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, 1, 1, n),
+            window_strides=(1, 1, 1, 1),
+            # asymmetric for even n so output channels == input channels
+            padding=((0, 0), (0, 0), (0, 0), (half, n - 1 - half)),
+        )
+        return x / jnp.power(c.k + c.alpha * s, c.beta), state

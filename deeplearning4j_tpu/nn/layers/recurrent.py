@@ -1,0 +1,153 @@
+"""Recurrent layers: Graves LSTM (+ bidirectional).
+
+Parity: ``nn/layers/recurrent/LSTMHelpers.java:43`` — Graves (2013)
+LSTM with peephole connections. The reference runs an explicit Java
+loop per timestep with one gemm each for forward (:131,:144) and a
+second reverse loop for backprop (:272,:402). Here the recurrence is a
+``lax.scan`` (XLA while-loop) over [b,t,f]; backprop-through-time is the
+scan's transpose, generated and fused by XLA — the BASELINE.json
+north-star "CudnnLSTMHelper → XLA while-loop" slot.
+
+Param layout (vs ``GravesLSTMParamInitializer.java:95-112``): reference
+packs input W [nIn, 4nL], recurrent W [nL, 4nL+3] (last 3 columns =
+peepholes), bias [4nL]. Here peepholes are separate named params
+(wci/wcf/wco) — same math, cleaner pytree. Gate order in the packed
+4nL axis: [input, forget, output, block].
+
+Masking: at masked timesteps the carry is held and the output zeroed
+(variable-length semantics of ``TimeSeriesUtils``/masking tests).
+
+``rnnTimeStep`` streaming state (``BaseRecurrentLayer`` stateMap) is the
+(h, c) carry stored in the layer's non-trainable state.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers.base import LayerImpl, register_impl
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import activate
+
+
+def _lstm_params(key, n_in, n_out, weight_init, dist_mean, dist_std, forget_bias):
+    kx, kr = jax.random.split(key)
+    Wx = init_weights(kx, (n_in, 4 * n_out), weight_init, n_in, n_out, dist_mean, dist_std)
+    Wr = init_weights(kr, (n_out, 4 * n_out), weight_init, n_out, n_out, dist_mean, dist_std)
+    b = jnp.zeros((4 * n_out,), jnp.float32)
+    # forget-gate section [n_out:2n_out] init (GravesLSTM.forgetGateBiasInit)
+    b = b.at[n_out:2 * n_out].set(forget_bias)
+    return {
+        "Wx": Wx, "Wr": Wr, "b": b,
+        "wci": jnp.zeros((n_out,), jnp.float32),
+        "wcf": jnp.zeros((n_out,), jnp.float32),
+        "wco": jnp.zeros((n_out,), jnp.float32),
+    }
+
+
+def _lstm_scan(p, x, h0, c0, gate_act: str, block_act: str, mask=None, reverse=False):
+    """Run the LSTM over time. x: [b,t,f]; returns (outputs [b,t,n], (h,c)).
+
+    One gemm per step on [b, 4n] (the reference's :144 gemm), with the
+    input-to-gate projection for ALL timesteps hoisted out of the scan as
+    a single [b*t, f]·[f, 4n] matmul — MXU-friendly: the big matmul is
+    batched over time, only the small recurrent gemm stays sequential.
+    """
+    n = h0.shape[-1]
+    xg = jnp.einsum("btf,fg->btg", x, p["Wx"]) + p["b"]  # [b,t,4n]
+    xg_t = jnp.swapaxes(xg, 0, 1)  # [t,b,4n]
+    mask_t = None if mask is None else jnp.swapaxes(mask, 0, 1)  # [t,b]
+
+    def step(carry, inp):
+        h, c = carry
+        if mask_t is None:
+            g = inp
+            m = None
+        else:
+            g, m = inp
+        g = g + h @ p["Wr"]
+        i = activate(gate_act, g[:, :n] + c * p["wci"])
+        f = activate(gate_act, g[:, n:2 * n] + c * p["wcf"])
+        blk = activate(block_act, g[:, 3 * n:])
+        c_new = f * c + i * blk
+        o = activate(gate_act, g[:, 2 * n:3 * n] + c_new * p["wco"])
+        h_new = o * activate(block_act, c_new)
+        if m is not None:
+            mm = m[:, None].astype(h_new.dtype)
+            c_new = mm * c_new + (1 - mm) * c
+            out = mm * h_new
+            h_new = mm * h_new + (1 - mm) * h
+        else:
+            out = h_new
+        return (h_new, c_new), out
+
+    xs = xg_t if mask_t is None else (xg_t, mask_t)
+    (h, c), out_t = jax.lax.scan(step, (h0, c0), xs, reverse=reverse)
+    return jnp.swapaxes(out_t, 0, 1), (h, c)
+
+
+@register_impl(L.GravesLSTM)
+class GravesLSTMImpl(LayerImpl):
+    def init_params(self, key) -> Dict[str, jnp.ndarray]:
+        c = self.conf
+        return _lstm_params(key, c.n_in, c.n_out, self.weight_init,
+                            c.dist_mean, c.dist_std, c.forget_gate_bias_init)
+
+    def init_state(self):
+        # streaming (rnnTimeStep) carry; zeros mean "no history"
+        return {}
+
+    def forward(self, params, x, state, train, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        b = x.shape[0]
+        n = self.conf.n_out
+        h0 = jnp.zeros((b, n), x.dtype)
+        c0 = jnp.zeros((b, n), x.dtype)
+        out, _ = _lstm_scan(params, x, h0, c0, self.conf.gate_activation,
+                            self.activation, mask)
+        return out, state
+
+    def rnn_time_step(self, params, x, state):
+        """Single-step stateful inference (``rnnTimeStep``,
+        ``MultiLayerNetwork.java:1233`` stateMap semantics).
+        x: [b, f] one timestep; state holds (h, c)."""
+        b = x.shape[0]
+        n = self.conf.n_out
+        h = state.get("h", jnp.zeros((b, n), x.dtype))
+        c = state.get("c", jnp.zeros((b, n), x.dtype))
+        out, (h2, c2) = _lstm_scan(params, x[:, None, :], h, c,
+                                   self.conf.gate_activation, self.activation)
+        return out[:, 0, :], {"h": h2, "c": c2}
+
+
+@register_impl(L.GravesBidirectionalLSTM)
+class GravesBidirectionalLSTMImpl(LayerImpl):
+    """Forward + backward LSTM, outputs summed
+    (``GravesBidirectionalLSTM.java:218`` ``fwdOutput.addi(backOutput)``)."""
+
+    def init_params(self, key):
+        c = self.conf
+        kf, kb = jax.random.split(key)
+        pf = _lstm_params(kf, c.n_in, c.n_out, self.weight_init,
+                          c.dist_mean, c.dist_std, c.forget_gate_bias_init)
+        pb = _lstm_params(kb, c.n_in, c.n_out, self.weight_init,
+                          c.dist_mean, c.dist_std, c.forget_gate_bias_init)
+        return {**{f"f_{k}": v for k, v in pf.items()},
+                **{f"b_{k}": v for k, v in pb.items()}}
+
+    def forward(self, params, x, state, train, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        b = x.shape[0]
+        n = self.conf.n_out
+        pf = {k[2:]: v for k, v in params.items() if k.startswith("f_")}
+        pb = {k[2:]: v for k, v in params.items() if k.startswith("b_")}
+        h0 = jnp.zeros((b, n), x.dtype)
+        c0 = jnp.zeros((b, n), x.dtype)
+        out_f, _ = _lstm_scan(pf, x, h0, c0, self.conf.gate_activation, self.activation, mask)
+        out_b, _ = _lstm_scan(pb, x, h0, c0, self.conf.gate_activation, self.activation, mask,
+                              reverse=True)
+        return out_f + out_b, state

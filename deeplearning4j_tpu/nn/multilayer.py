@@ -1,0 +1,283 @@
+"""MultiLayerNetwork — the sequential-stack model container.
+
+Parity: ``nn/multilayer/MultiLayerNetwork.java:77`` (init :347,
+feedForward :618, fit(DataSetIterator) :1028, backprop :1084). The
+reference's fit path dispatched dozens of ND4J/cuDNN kernels per
+iteration from a host loop (call stack SURVEY.md §3.1); here the entire
+iteration — forward, backward (jax.grad), gradient normalization,
+updater transform, parameter update, score — is ONE jitted XLA program
+with donated parameter buffers. The host loop only feeds batches.
+
+Flat parameter/gradient views (``Model.setParamsViewArray``,
+``nn/api/Model.java:108``) survive as the ``params_flat`` /
+``set_params_flat`` API over the params pytree (ravel_pytree), which is
+what checkpointing and the distributed parameter plane use.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator, DataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+import deeplearning4j_tpu.nn.layers  # noqa: F401  (registers layer impls)
+from deeplearning4j_tpu.nn.layers.base import build_layer
+from deeplearning4j_tpu.nn.updater import (
+    GradientNormalization,
+    apply_updater,
+    init_updater_state,
+    normalize_gradient,
+)
+
+Params = Dict[str, Dict[str, jnp.ndarray]]
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.gc = conf.conf
+        self.impls = [build_layer(self.gc, lc, f"layer{i}") for i, lc in enumerate(conf.layers)]
+        if not self.impls:
+            raise ValueError("empty layer list")
+        self.out = self.impls[-1]
+        if not self.out.has_loss():
+            raise ValueError("last layer must be an output/loss layer")
+        self.params: Optional[Params] = None
+        self.states: Optional[Dict[str, Any]] = None
+        self.opt_state: Optional[Dict[str, Any]] = None
+        self.listeners: List[Callable[["MultiLayerNetwork", int, float], None]] = []
+        self._score: float = float("nan")
+        self._dtype = jnp.float32
+        self._jits: Dict[Any, Callable] = {}
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, dtype=jnp.float32) -> "MultiLayerNetwork":
+        """Build params / updater state (``MultiLayerNetwork.init`` :347 +
+        ``initGradientsView`` :436 — gradient buffers here are implicit in
+        jax.grad)."""
+        self._dtype = dtype
+        key = jax.random.PRNGKey(self.gc.seed)
+        keys = jax.random.split(key, len(self.impls))
+        self.params = {}
+        self.states = {}
+        upd = {}
+        for impl, k in zip(self.impls, keys):
+            p = {n: v.astype(dtype) for n, v in impl.init_params(k).items()}
+            self.params[impl.name] = p
+            self.states[impl.name] = impl.init_state()
+            ucfg = self.gc.updater_config_for(impl.conf)
+            upd[impl.name] = {n: init_updater_state(ucfg, v) for n, v in p.items()}
+        self.opt_state = {"step": jnp.zeros((), jnp.int32), "updater": upd}
+        self._jits = {}
+        return self
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    # -------------------------------------------------------- functional core
+
+    def _forward(self, params: Params, states, x, train: bool, rng, fmask):
+        """All-layer forward; returns (activations per layer, new states)."""
+        acts = []
+        new_states = {}
+        for i, impl in enumerate(self.impls):
+            pre = self.conf.input_preprocessors.get(i)
+            if pre is not None:
+                x = pre(x)
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            x, ns = impl.forward(params[impl.name], x, states[impl.name], train, lrng, mask=fmask)
+            new_states[impl.name] = ns
+            acts.append(x)
+        return acts, new_states
+
+    def _score_fn(self, params: Params, states, x, y, train: bool, rng, fmask, lmask):
+        """Data loss (output layer) + L1/L2 penalties — the quantity
+        ``computeGradientAndScore`` minimizes (SURVEY.md §3.1)."""
+        new_states = {}
+        for i, impl in enumerate(self.impls[:-1]):
+            pre = self.conf.input_preprocessors.get(i)
+            if pre is not None:
+                x = pre(x)
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            x, ns = impl.forward(params[impl.name], x, states[impl.name], train, lrng, mask=fmask)
+            new_states[impl.name] = ns
+        i_out = len(self.impls) - 1
+        pre = self.conf.input_preprocessors.get(i_out)
+        if pre is not None:
+            x = pre(x)
+        lrng = jax.random.fold_in(rng, i_out) if rng is not None else None
+        score = self.out.score(params[self.out.name], x, y, states[self.out.name], train, lrng, mask=lmask)
+        new_states[self.out.name] = states[self.out.name]
+        for impl in self.impls:
+            score = score + impl.regularization_penalty(params[impl.name]).astype(score.dtype)
+        return score, new_states
+
+    def _make_train_step(self, has_fmask: bool, has_lmask: bool):
+        """One fully-fused optimization iteration."""
+        gn_specs = []
+        for impl in self.impls:
+            nt = GradientNormalization(self.gc.resolve(impl.conf, "gradient_normalization"))
+            thr = self.gc.resolve(impl.conf, "gradient_normalization_threshold")
+            gn_specs.append((nt, thr))
+        ucfgs = [self.gc.updater_config_for(impl.conf) for impl in self.impls]
+
+        def step(params, opt_state, states, x, y, fmask, lmask, rng_key):
+            it = opt_state["step"]
+            rng = jax.random.fold_in(rng_key, it)
+
+            def loss(p):
+                return self._score_fn(p, states, x, y, True, rng,
+                                      fmask if has_fmask else None,
+                                      lmask if has_lmask else None)
+
+            (score, new_states), grads = jax.value_and_grad(loss, has_aux=True)(params)
+            new_params: Params = {}
+            new_upd: Dict[str, Any] = {}
+            for impl, (nt, thr), ucfg in zip(self.impls, gn_specs, ucfgs):
+                name = impl.name
+                g = normalize_gradient(nt, grads[name], thr)
+                new_params[name] = {}
+                new_upd[name] = {}
+                for pname, gval in g.items():
+                    upd, ust = apply_updater(ucfg, gval, opt_state["updater"][name][pname], it)
+                    new_params[name][pname] = params[name][pname] - upd.astype(params[name][pname].dtype)
+                    new_upd[name][pname] = ust
+            return new_params, {"step": it + 1, "updater": new_upd}, new_states, score
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _get_jit(self, kind: str, **flags):
+        key = (kind, tuple(sorted(flags.items())))
+        if key not in self._jits:
+            if kind == "train":
+                self._jits[key] = self._make_train_step(flags["fm"], flags["lm"])
+            elif kind == "output":
+                self._jits[key] = jax.jit(
+                    lambda p, s, x, fm: self._forward(p, s, x, False, None, fm)[0][-1])
+            elif kind == "score":
+                self._jits[key] = jax.jit(
+                    lambda p, s, x, y, fm, lm: self._score_fn(
+                        p, s, x, y, False, None,
+                        fm if flags["fm"] else None,
+                        lm if flags["lm"] else None)[0])
+        return self._jits[key]
+
+    # ----------------------------------------------------------------- train
+
+    def fit(self, data: Union[DataSet, DataSetIterator, np.ndarray],
+            labels: Optional[np.ndarray] = None,
+            batch_size: Optional[int] = None) -> None:
+        """Train: per minibatch run ``conf.iterations`` compiled steps
+        (``fit(DataSetIterator)`` :1028; iterator auto-wrapped in async
+        prefetch as at :1032)."""
+        if self.params is None:
+            self.init()
+        if isinstance(data, np.ndarray) or isinstance(data, jnp.ndarray):
+            data = DataSet(np.asarray(data), np.asarray(labels))
+        if isinstance(data, DataSet):
+            if batch_size is not None:
+                data = ListDataSetIterator(data, batch_size)
+            else:
+                self._fit_batch(data)
+                return
+        it = data
+        if it.async_supported():
+            it = AsyncDataSetIterator(it)
+        for ds in it:
+            self._fit_batch(ds)
+
+    def _fit_batch(self, ds: DataSet) -> None:
+        rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
+        fm = ds.features_mask is not None
+        lm = ds.labels_mask is not None
+        step = self._get_jit("train", fm=fm, lm=lm)
+        x = jnp.asarray(ds.features, self._dtype)
+        y = jnp.asarray(ds.labels, self._dtype)
+        fmask = jnp.asarray(ds.features_mask, self._dtype) if fm else jnp.zeros((), self._dtype)
+        lmask = jnp.asarray(ds.labels_mask, self._dtype) if lm else jnp.zeros((), self._dtype)
+        for _ in range(max(1, self.gc.iterations)):
+            self.params, self.opt_state, self.states, score = step(
+                self.params, self.opt_state, self.states, x, y, fmask, lmask, rng_key)
+            self._score = float(score)
+            it_num = int(self.opt_state["step"])
+            for cb in self.listeners:
+                cb(self, it_num, self._score)
+
+    # ------------------------------------------------------------- inference
+
+    def output(self, x: np.ndarray, train: bool = False,
+               features_mask: Optional[np.ndarray] = None) -> np.ndarray:
+        """``MultiLayerNetwork.output`` :696 — train=False freezes dropout
+        and uses BN moving stats."""
+        assert not train, "use fit() for training-mode passes"
+        fn = self._get_jit("output", fm=features_mask is not None)
+        fmask = jnp.asarray(features_mask, self._dtype) if features_mask is not None else None
+        return np.asarray(fn(self.params, self.states, jnp.asarray(x, self._dtype), fmask))
+
+    def feed_forward(self, x: np.ndarray, train: bool = False) -> List[np.ndarray]:
+        """All per-layer activations (``feedForward`` :618)."""
+        acts, _ = self._forward(self.params, self.states, jnp.asarray(x, self._dtype),
+                                train, jax.random.PRNGKey(0) if train else None, None)
+        return [np.asarray(a) for a in acts]
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.argmax(self.output(x), axis=-1)
+
+    def score(self, ds: Optional[DataSet] = None) -> float:
+        """Loss on a DataSet (eval mode), or the last training score."""
+        if ds is None:
+            return self._score
+        fm = ds.features_mask is not None
+        lm = ds.labels_mask is not None
+        fn = self._get_jit("score", fm=fm, lm=lm)
+        return float(fn(self.params, self.states,
+                        jnp.asarray(ds.features, self._dtype),
+                        jnp.asarray(ds.labels, self._dtype),
+                        jnp.asarray(ds.features_mask, self._dtype) if fm else jnp.zeros((), self._dtype),
+                        jnp.asarray(ds.labels_mask, self._dtype) if lm else jnp.zeros((), self._dtype)))
+
+    # ----------------------------------------------------- flat param views
+
+    def params_flat(self) -> np.ndarray:
+        """Single flat parameter vector (``Model.params()`` contract)."""
+        flat, _ = jax.flatten_util.ravel_pytree(self.params)
+        return np.asarray(flat)
+
+    def set_params_flat(self, vec: np.ndarray) -> None:
+        _, unravel = jax.flatten_util.ravel_pytree(self.params)
+        self.params = unravel(jnp.asarray(vec, self._dtype))
+
+    def num_params(self) -> int:
+        return int(self.params_flat().shape[0])
+
+    # ------------------------------------------------------------- utilities
+
+    def gradient_and_score(self, ds: DataSet) -> Tuple[Params, float]:
+        """Analytic gradients + score in eval mode (no dropout) — the
+        gradient-check entry point (``computeGradientAndScore``)."""
+
+        def loss(p):
+            return self._score_fn(p, self.states, jnp.asarray(ds.features, self._dtype),
+                                  jnp.asarray(ds.labels, self._dtype), False, None,
+                                  jnp.asarray(ds.features_mask, self._dtype) if ds.features_mask is not None else None,
+                                  jnp.asarray(ds.labels_mask, self._dtype) if ds.labels_mask is not None else None)[0]
+
+        score, grads = jax.value_and_grad(loss)(self.params)
+        return grads, float(score)
+
+    def clone(self) -> "MultiLayerNetwork":
+        other = MultiLayerNetwork(self.conf)
+        if self.params is not None:
+            other._dtype = self._dtype
+            other.params = jax.tree.map(lambda v: v, self.params)
+            other.states = jax.tree.map(lambda v: v, self.states)
+            other.opt_state = jax.tree.map(lambda v: v, self.opt_state)
+        return other

@@ -1,0 +1,6 @@
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    IterationListener,
+    ScoreIterationListener,
+    PerformanceListener,
+    CollectScoresIterationListener,
+)

@@ -1,0 +1,79 @@
+"""Iteration listeners — training callbacks.
+
+Parity: ``optimize/api/IterationListener.java`` +
+``optimize/listeners/`` (ScoreIterationListener, PerformanceListener,
+CollectScoresIterationListener, ParamAndGradientIterationListener).
+Containers call listeners as ``listener(model, iteration, score)``; the
+classes below also keep the reference's ``iterationDone`` method name.
+
+TPU note: reading the score forces a device→host sync; listeners that
+print every iteration throttle via ``frequency`` so the host stays ahead
+of the device queue.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    def __call__(self, model, iteration: int, score: float):
+        self.iteration_done(model, iteration, score)
+
+    def iteration_done(self, model, iteration: int, score: float):
+        raise NotImplementedError
+
+
+class ScoreIterationListener(IterationListener):
+    """``ScoreIterationListener`` — log score every N iterations."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.n = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.n == 0:
+            logger.info("Score at iteration %d is %s", iteration, score)
+
+
+class PerformanceListener(IterationListener):
+    """``PerformanceListener`` — iterations/sec + examples/sec."""
+
+    def __init__(self, frequency: int = 1, report_examples: bool = True):
+        self.frequency = max(1, frequency)
+        self.report_examples = report_examples
+        self._last_time: Optional[float] = None
+        self._last_iter = 0
+        self.last_iters_per_sec: float = float("nan")
+        self.last_examples_per_sec: float = float("nan")
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            di = iteration - self._last_iter
+            if dt > 0 and di > 0:
+                self.last_iters_per_sec = di / dt
+                batch = getattr(model, "last_batch_size", None)
+                if batch:
+                    self.last_examples_per_sec = self.last_iters_per_sec * batch
+                logger.info("iteration %d: %.2f iter/sec, score %s",
+                            iteration, self.last_iters_per_sec, score)
+        if iteration % self.frequency == 0:
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class CollectScoresIterationListener(IterationListener):
+    """``CollectScoresIterationListener`` — record (iteration, score)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, score))

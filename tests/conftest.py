@@ -8,12 +8,17 @@ devices. Must run before the first ``import jax``.
 
 import os
 
+# NOTE: this box's sitecustomize pre-imports jax before conftest runs, so
+# plain env-var assignment is too late for JAX_PLATFORMS; use the config
+# API as well (backends initialize lazily, so this still lands in time).
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the test box has one CPU core, so XLA
 # compile time dominates the suite; cache executables across runs.

@@ -1,0 +1,187 @@
+"""Evaluation metrics, checkpoint round-trip, early stopping, listeners.
+
+Ports of ``EvaluationTests``, ``ModelSerializerTest.java``,
+``earlystopping`` tests (SURVEY.md §4).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iris import load_iris_dataset
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.eval import Evaluation, ROC, RegressionEvaluation
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize import CollectScoresIterationListener, ScoreIterationListener
+from deeplearning4j_tpu.util.model_serializer import (
+    restore_multi_layer_network,
+    write_model,
+)
+
+
+def _small_net(seed=1):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(0.3).updater("adam")
+            .activation("tanh").list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax", loss_function="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestEvaluation:
+    def test_perfect_predictions(self):
+        e = Evaluation(3)
+        labels = np.eye(3)[[0, 1, 2, 0]]
+        e.eval(labels, labels)
+        assert e.accuracy() == 1.0
+        assert e.f1() == 1.0
+
+    def test_known_confusion(self):
+        e = Evaluation(2)
+        labels = np.eye(2)[[0, 0, 1, 1]]
+        preds = np.eye(2)[[0, 1, 1, 1]]
+        e.eval(labels, preds)
+        assert e.accuracy() == 0.75
+        assert e.confusion.get_count(0, 1) == 1
+        # class-1: tp=2 fp=1 fn=0
+        assert e.precision(1) == pytest.approx(2 / 3)
+        assert e.recall(1) == 1.0
+
+    def test_time_series_masked(self):
+        e = Evaluation(2)
+        labels = np.zeros((1, 3, 2))
+        labels[0, :, 0] = 1
+        preds = np.zeros((1, 3, 2))
+        preds[0, 0, 0] = 1  # correct
+        preds[0, 1, 1] = 1  # wrong
+        preds[0, 2, 1] = 1  # wrong but masked
+        mask = np.array([[1, 1, 0.0]])
+        e.eval(labels, preds, mask=mask)
+        assert e.confusion.counts.sum() == 2
+        assert e.accuracy() == 0.5
+
+    def test_meta_attribution(self):
+        e = Evaluation(2)
+        labels = np.eye(2)[[0, 1]]
+        preds = np.eye(2)[[1, 1]]
+        e.eval(labels, preds, meta=["exA", "exB"])
+        assert e.get_meta(0, 1) == ["exA"]
+        assert e.get_meta(1, 1) == ["exB"]
+
+
+class TestROC:
+    def test_separable_auc_is_one(self):
+        roc = ROC(threshold_steps=50)
+        labels = np.array([0, 0, 0, 1, 1, 1])
+        scores = np.array([0.1, 0.2, 0.3, 0.7, 0.8, 0.9])
+        roc.eval(labels, scores)
+        assert roc.calculate_auc() == pytest.approx(1.0, abs=0.02)
+
+    def test_random_auc_half(self):
+        rng = np.random.default_rng(0)
+        roc = ROC(threshold_steps=100)
+        labels = rng.integers(0, 2, 2000)
+        scores = rng.random(2000)
+        roc.eval(labels, scores)
+        assert roc.calculate_auc() == pytest.approx(0.5, abs=0.05)
+
+
+class TestRegressionEvaluation:
+    def test_known_values(self):
+        r = RegressionEvaluation(2)
+        labels = np.array([[1.0, 2.0], [3.0, 4.0]])
+        preds = np.array([[1.5, 2.0], [2.5, 3.0]])
+        r.eval(labels, preds)
+        assert r.mean_squared_error(0) == pytest.approx(0.25)
+        assert r.mean_absolute_error(0) == pytest.approx(0.5)
+        assert r.mean_absolute_error(1) == pytest.approx(0.5)
+
+    def test_perfect_r2(self):
+        r = RegressionEvaluation(1)
+        y = np.linspace(0, 1, 10)[:, None]
+        r.eval(y, y)
+        assert r.r_squared(0) == pytest.approx(1.0)
+        assert r.pearson_correlation(0) == pytest.approx(1.0)
+
+
+class TestModelSerializer:
+    def test_round_trip_identical_outputs(self, tmp_path):
+        net = _small_net()
+        ds = load_iris_dataset(shuffle_seed=1)
+        net.fit(ListDataSetIterator(ds, 50))
+        path = os.path.join(tmp_path, "model.zip")
+        write_model(net, path)
+        net2 = restore_multi_layer_network(path)
+        np.testing.assert_allclose(net2.output(ds.features), net.output(ds.features),
+                                   rtol=1e-6)
+        # updater state restored: continued training matches
+        assert int(net2.opt_state["step"]) == int(net.opt_state["step"])
+        net.fit(ds[:32])
+        net2.fit(ds[:32])
+        np.testing.assert_allclose(net2.params_flat(), net.params_flat(), rtol=1e-5)
+
+    def test_wrong_type_raises(self, tmp_path):
+        from deeplearning4j_tpu.util.model_serializer import restore_computation_graph
+        net = _small_net()
+        path = os.path.join(tmp_path, "model.zip")
+        write_model(net, path)
+        with pytest.raises(ValueError, match="MultiLayerNetwork"):
+            restore_computation_graph(path)
+
+
+class TestEarlyStopping:
+    def test_max_epochs_and_best_model(self):
+        net = _small_net()
+        ds = load_iris_dataset(shuffle_seed=2)
+        train, test = ds.split_test_and_train(120)
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(8)],
+            score_calculator=DataSetLossCalculator(ListDataSetIterator(test, 30)),
+            model_saver=InMemoryModelSaver())
+        result = EarlyStoppingTrainer(cfg, net, ListDataSetIterator(train, 40)).fit()
+        assert result.total_epochs == 8
+        assert result.termination_reason == "EpochTerminationCondition"
+        assert result.best_model is not None
+        assert result.best_model_score < 2.0
+
+    def test_divergence_guard(self):
+        net = _small_net()
+        ds = load_iris_dataset()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(50)],
+            iteration_termination_conditions=[MaxScoreIterationTerminationCondition(1e-9)])
+        result = EarlyStoppingTrainer(cfg, net, ListDataSetIterator(ds, 50)).fit()
+        assert result.termination_reason == "IterationTerminationCondition"
+
+    def test_score_improvement_patience(self):
+        c = ScoreImprovementEpochTerminationCondition(2)
+        c.initialize()
+        assert not c.terminate(0, 1.0)
+        assert not c.terminate(1, 1.1)   # no improvement x1
+        assert c.terminate(2, 1.2)       # no improvement x2 -> stop
+
+
+class TestListeners:
+    def test_collect_scores(self):
+        net = _small_net()
+        coll = CollectScoresIterationListener()
+        net.set_listeners(coll, ScoreIterationListener(5))
+        ds = load_iris_dataset()
+        for _ in range(5):
+            net.fit(ds)
+        assert len(coll.scores) == 5
+        assert coll.scores[-1][1] < coll.scores[0][1]  # learning

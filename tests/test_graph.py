@@ -1,0 +1,190 @@
+"""ComputationGraph tests — ports of
+``TestComputationGraphNetwork.java`` + ``GradientCheckTestsComputationGraph.java``."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iris import load_iris_dataset
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import (
+    ElementWiseVertex,
+    L2NormalizeVertex,
+    L2Vertex,
+    LastTimeStepVertex,
+    MergeVertex,
+    StackVertex,
+    SubsetVertex,
+    UnstackVertex,
+)
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer,
+    GravesLSTM,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.gradientcheck import check_gradients_graph
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph,
+    ComputationGraphConfiguration,
+    topological_order,
+    VertexDef,
+)
+
+
+def _conf(**kw):
+    c = NeuralNetConfiguration(seed=42, activation="tanh", weight_init="xavier")
+    for k, v in kw.items():
+        setattr(c, k, v)
+    return c
+
+
+class TestTopology:
+    def test_topological_order(self):
+        verts = [
+            VertexDef("in", "input", []),
+            VertexDef("c", "op", ["a", "b"]),
+            VertexDef("a", "op", ["in"]),
+            VertexDef("b", "op", ["a"]),
+        ]
+        order = topological_order(verts)
+        assert order.index("in") < order.index("a") < order.index("b") < order.index("c")
+
+    def test_cycle_detection(self):
+        verts = [
+            VertexDef("in", "input", []),
+            VertexDef("a", "op", ["in", "b"]),
+            VertexDef("b", "op", ["a"]),
+        ]
+        with pytest.raises(ValueError, match="cycle"):
+            topological_order(verts)
+
+    def test_unknown_input(self):
+        with pytest.raises(ValueError, match="unknown input"):
+            topological_order([VertexDef("a", "op", ["ghost"])])
+
+
+class TestGraphTraining:
+    def test_iris_mlp_as_graph(self):
+        conf = (ComputationGraphConfiguration.builder(_conf(learning_rate=0.5, updater="nesterovs"))
+                .add_inputs("in")
+                .add_layer("dense", DenseLayer(n_in=4, n_out=16, activation="relu",
+                                               weight_init="relu"), "in")
+                .add_layer("out", OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                              loss_function="mcxent"), "dense")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        ds = load_iris_dataset(shuffle_seed=6)
+        for _ in range(150):
+            g.fit(ds)
+        acc = float(np.mean(np.argmax(g.output(ds.features), axis=1) ==
+                            np.argmax(ds.labels, axis=1)))
+        assert acc >= 0.95, acc
+
+    def test_multi_input_merge_gradcheck(self, rng):
+        conf = (ComputationGraphConfiguration.builder(_conf())
+                .add_inputs("in1", "in2")
+                .add_layer("d1", DenseLayer(n_in=3, n_out=4), "in1")
+                .add_layer("d2", DenseLayer(n_in=2, n_out=3), "in2")
+                .add_vertex("merge", MergeVertex(), "d1", "d2")
+                .add_layer("out", OutputLayer(n_in=7, n_out=2, activation="softmax",
+                                              loss_function="mcxent"), "merge")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init(dtype=jnp.float64)
+        mds = MultiDataSet(
+            features=[rng.standard_normal((5, 3)), rng.standard_normal((5, 2))],
+            labels=[np.eye(2)[rng.integers(0, 2, 5)]])
+        res = check_gradients_graph(g, mds)
+        assert res.ok, "; ".join(res.failures[:3])
+
+    def test_multi_output_gradcheck(self, rng):
+        conf = (ComputationGraphConfiguration.builder(_conf())
+                .add_inputs("in")
+                .add_layer("shared", DenseLayer(n_in=4, n_out=5), "in")
+                .add_layer("out1", OutputLayer(n_in=5, n_out=2, activation="softmax",
+                                               loss_function="mcxent"), "shared")
+                .add_layer("out2", OutputLayer(n_in=5, n_out=3, activation="identity",
+                                               loss_function="mse"), "shared")
+                .set_outputs("out1", "out2")
+                .build())
+        g = ComputationGraph(conf).init(dtype=jnp.float64)
+        mds = MultiDataSet(
+            features=[rng.standard_normal((6, 4))],
+            labels=[np.eye(2)[rng.integers(0, 2, 6)], rng.standard_normal((6, 3))])
+        res = check_gradients_graph(g, mds)
+        assert res.ok, "; ".join(res.failures[:3])
+
+    def test_residual_block_gradcheck(self, rng):
+        """Skip connection via ElementWiseVertex add (ResNet pattern)."""
+        conf = (ComputationGraphConfiguration.builder(_conf())
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_in=4, n_out=4), "in")
+                .add_vertex("res", ElementWiseVertex(op="add"), "d1", "in")
+                .add_layer("out", OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                              loss_function="mcxent"), "res")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init(dtype=jnp.float64)
+        mds = MultiDataSet(features=[rng.standard_normal((5, 4))],
+                           labels=[np.eye(2)[rng.integers(0, 2, 5)]])
+        res = check_gradients_graph(g, mds)
+        assert res.ok, "; ".join(res.failures[:3])
+
+    def test_lstm_last_timestep_vertex(self, rng):
+        conf = (ComputationGraphConfiguration.builder(_conf())
+                .add_inputs("in")
+                .add_layer("lstm", GravesLSTM(n_in=3, n_out=4), "in")
+                .add_vertex("last", LastTimeStepVertex(mask_input="in"), "lstm")
+                .add_layer("out", OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                              loss_function="mcxent"), "last")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init(dtype=jnp.float64)
+        x = rng.standard_normal((3, 6, 3))
+        mask = np.ones((3, 6))
+        mask[1, 3:] = 0
+        y = np.eye(2)[rng.integers(0, 2, 3)]
+        mds = MultiDataSet(features=[x], labels=[y], features_masks=[mask])
+        res = check_gradients_graph(g, mds, subset=100)
+        assert res.ok, "; ".join(res.failures[:3])
+
+
+class TestVertexOps:
+    def test_subset_stack_unstack(self, rng):
+        x = jnp.asarray(rng.standard_normal((4, 6)))
+        sub = SubsetVertex(from_index=1, to_index=3).forward([x])
+        np.testing.assert_allclose(np.asarray(sub), np.asarray(x)[:, 1:4])
+        a, b = x[:2], x[2:]
+        st = StackVertex().forward([a, b])
+        np.testing.assert_allclose(np.asarray(st), np.asarray(x))
+        u = UnstackVertex(from_index=1, stack_size=2).forward([st])
+        np.testing.assert_allclose(np.asarray(u), np.asarray(b))
+
+    def test_l2_vertices(self, rng):
+        a = jnp.asarray(rng.standard_normal((3, 4)))
+        b = jnp.asarray(rng.standard_normal((3, 4)))
+        d = L2Vertex().forward([a, b])
+        expected = np.linalg.norm(np.asarray(a) - np.asarray(b), axis=1)
+        np.testing.assert_allclose(np.asarray(d)[:, 0], expected, rtol=1e-5)
+        n = L2NormalizeVertex().forward([a])
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(n), axis=1), 1.0, rtol=1e-5)
+
+
+class TestGraphSerialization:
+    def test_json_round_trip(self):
+        conf = (ComputationGraphConfiguration.builder(_conf())
+                .add_inputs("in1", "in2")
+                .add_layer("d1", DenseLayer(n_in=3, n_out=4), "in1")
+                .add_vertex("merge", MergeVertex(), "d1", "in2")
+                .add_layer("out", OutputLayer(n_in=6, n_out=2, activation="softmax",
+                                              loss_function="mcxent"), "merge")
+                .set_outputs("out")
+                .build())
+        s = conf.to_json()
+        conf2 = ComputationGraphConfiguration.from_json(s)
+        assert conf2.to_json() == s
+        g1 = ComputationGraph(conf).init()
+        g2 = ComputationGraph(conf2).init()
+        np.testing.assert_array_equal(g1.params_flat(), g2.params_flat())

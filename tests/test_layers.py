@@ -1,0 +1,231 @@
+"""Layer-breadth tests: CNN / BN / LRN / LSTM / embedding / autoencoder.
+
+Ports of ``CNNGradientCheckTest.java``, ``BNGradientCheckTest.java``,
+``LRNGradientCheckTests.java``, ``GradientCheckTests`` LSTM cases and
+``GradientCheckTestsMasking.java`` (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    AutoEncoder,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    LocalResponseNormalization,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _net(layers, input_type=None, **conf_kw):
+    b = NeuralNetConfiguration.builder().seed(42)
+    for k, v in conf_kw.items():
+        b = getattr(b, k)(v)
+    lb = b.list()
+    for l in layers:
+        lb = lb.layer(l)
+    if input_type is not None:
+        lb = lb.set_input_type(input_type)
+    return MultiLayerNetwork(lb.build()).init(dtype=jnp.float64)
+
+
+def _assert_gc(net, ds, train=False, subset=None):
+    res = check_gradients(net, ds, subset=subset, train=train)
+    assert res.ok, f"{res.n_failed}/{res.n_checked} failed; " + "; ".join(res.failures[:3])
+
+
+class TestCNNGradients:
+    def test_conv_pool_dense(self, rng):
+        net = _net(
+            [ConvolutionLayer(n_out=2, kernel_size=(2, 2), stride=(1, 1)),
+             SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+             OutputLayer(n_out=3, activation="softmax", loss_function="mcxent")],
+            input_type=InputType.convolutional(6, 6, 1),
+            activation="tanh", weight_init="xavier")
+        x = rng.standard_normal((4, 6, 6, 1))
+        y = np.eye(3)[rng.integers(0, 3, 4)]
+        _assert_gc(net, DataSet(x, y))
+
+    def test_conv_same_mode_avg_pool(self, rng):
+        net = _net(
+            [ConvolutionLayer(n_out=2, kernel_size=(3, 3), stride=(1, 1), convolution_mode="same"),
+             SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2), pooling_type="avg"),
+             OutputLayer(n_out=2, activation="softmax", loss_function="mcxent")],
+            input_type=InputType.convolutional(4, 4, 2),
+            activation="relu", weight_init="xavier")
+        x = rng.standard_normal((3, 4, 4, 2))
+        y = np.eye(2)[rng.integers(0, 2, 3)]
+        _assert_gc(net, DataSet(x, y))
+
+    def test_shapes_lenet_style(self, rng):
+        net = _net(
+            [ConvolutionLayer(n_out=4, kernel_size=(5, 5)),
+             SubsamplingLayer(),
+             ConvolutionLayer(n_out=6, kernel_size=(5, 5)),
+             SubsamplingLayer(),
+             DenseLayer(n_out=10),
+             OutputLayer(n_out=10, activation="softmax", loss_function="mcxent")],
+            input_type=InputType.convolutional(28, 28, 1),
+            activation="relu", weight_init="relu")
+        x = rng.standard_normal((2, 28, 28, 1))
+        out = net.output(x)
+        assert out.shape == (2, 10)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+
+class TestBatchNorm:
+    def test_bn_gradcheck_train_mode(self, rng):
+        net = _net(
+            [DenseLayer(n_in=4, n_out=5),
+             BatchNormalization(n_in=5, n_out=5),
+             OutputLayer(n_in=5, n_out=3, activation="softmax", loss_function="mcxent")],
+            activation="tanh")
+        x = rng.standard_normal((8, 4))
+        y = np.eye(3)[rng.integers(0, 3, 8)]
+        _assert_gc(net, DataSet(x, y), train=True)
+
+    def test_bn_moving_stats_update_and_freeze(self, rng):
+        net = _net(
+            [BatchNormalization(n_in=3, n_out=3),
+             OutputLayer(n_in=3, n_out=2, activation="softmax", loss_function="mcxent")])
+        x = rng.standard_normal((16, 3)) * 3.0 + 1.0
+        y = np.eye(2)[rng.integers(0, 2, 16)]
+        st0 = net.states["layer0"]
+        np.testing.assert_array_equal(np.asarray(st0["mean"]), 0.0)
+        net.fit(DataSet(x, y))
+        st1 = net.states["layer0"]
+        assert float(np.abs(np.asarray(st1["mean"])).sum()) > 0  # stats moved
+        # eval output must use moving stats (deterministic, no batch dependence)
+        o1 = net.output(x[:4])
+        o2 = net.output(np.concatenate([x[:4], x[4:8] * 10]))[:4]
+        np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+
+class TestLRN:
+    def test_lrn_gradcheck(self, rng):
+        net = _net(
+            [ConvolutionLayer(n_out=6, kernel_size=(2, 2)),
+             LocalResponseNormalization(),
+             OutputLayer(n_out=2, activation="softmax", loss_function="mcxent")],
+            input_type=InputType.convolutional(4, 4, 1),
+            activation="tanh")
+        x = rng.standard_normal((3, 4, 4, 1))
+        y = np.eye(2)[rng.integers(0, 2, 3)]
+        _assert_gc(net, DataSet(x, y), subset=60)
+
+
+class TestLSTM:
+    def test_lstm_gradcheck(self, rng):
+        net = _net(
+            [GravesLSTM(n_in=3, n_out=4),
+             RnnOutputLayer(n_in=4, n_out=2, activation="softmax", loss_function="mcxent")],
+            activation="tanh")
+        x = rng.standard_normal((3, 5, 3))
+        y = np.eye(2)[rng.integers(0, 2, (3, 5))]
+        _assert_gc(net, DataSet(x, y))
+
+    def test_bidirectional_lstm_gradcheck(self, rng):
+        net = _net(
+            [GravesBidirectionalLSTM(n_in=3, n_out=3),
+             RnnOutputLayer(n_in=3, n_out=2, activation="softmax", loss_function="mcxent")],
+            activation="tanh")
+        x = rng.standard_normal((2, 4, 3))
+        y = np.eye(2)[rng.integers(0, 2, (2, 4))]
+        _assert_gc(net, DataSet(x, y), subset=120)
+
+    def test_lstm_masking_gradcheck(self, rng):
+        """GradientCheckTestsMasking: variable-length sequences."""
+        net = _net(
+            [GravesLSTM(n_in=3, n_out=4),
+             RnnOutputLayer(n_in=4, n_out=2, activation="softmax", loss_function="mcxent")],
+            activation="tanh")
+        x = rng.standard_normal((3, 6, 3))
+        y = np.eye(2)[rng.integers(0, 2, (3, 6))]
+        mask = np.ones((3, 6))
+        mask[0, 4:] = 0
+        mask[2, 2:] = 0
+        ds = DataSet(x, y, features_mask=mask, labels_mask=mask)
+        _assert_gc(net, ds, subset=120)
+
+    def test_masked_steps_do_not_affect_output(self, rng):
+        net = _net(
+            [GravesLSTM(n_in=2, n_out=3),
+             RnnOutputLayer(n_in=3, n_out=2, activation="softmax", loss_function="mcxent")])
+        x = rng.standard_normal((1, 5, 2))
+        mask = np.array([[1, 1, 1, 0, 0.0]])
+        x2 = x.copy()
+        x2[0, 3:] = 99.0  # garbage in masked region
+        o1 = net.output(x, features_mask=mask)
+        o2 = net.output(x2, features_mask=mask)
+        np.testing.assert_allclose(o1[0, :3], o2[0, :3], rtol=1e-6)
+
+    def test_rnn_time_step_matches_full_forward(self, rng):
+        from deeplearning4j_tpu.nn.layers.base import build_layer
+        net = _net(
+            [GravesLSTM(n_in=2, n_out=3),
+             RnnOutputLayer(n_in=3, n_out=2, activation="softmax", loss_function="mcxent")])
+        impl = net.impls[0]
+        params = net.params["layer0"]
+        x = jnp.asarray(rng.standard_normal((2, 4, 2)))
+        full, _ = impl.forward(params, x, {}, False)
+        state = {}
+        for t in range(4):
+            step_out, state = impl.rnn_time_step(params, x[:, t, :], state)
+            np.testing.assert_allclose(np.asarray(step_out), np.asarray(full[:, t, :]),
+                                       rtol=1e-5, atol=1e-8)
+
+
+class TestEmbedding:
+    def test_embedding_forward_is_row_lookup(self, rng):
+        net = _net(
+            [EmbeddingLayer(n_in=7, n_out=4, activation="identity"),
+             OutputLayer(n_in=4, n_out=3, activation="softmax", loss_function="mcxent")])
+        W = np.asarray(net.params["layer0"]["W"])
+        idx = np.array([[2], [5]])
+        acts = net.feed_forward(idx.astype(np.float64))
+        np.testing.assert_allclose(acts[0], W[[2, 5]], rtol=1e-6)
+
+
+class TestGlobalPooling:
+    def test_masked_mean_pooling(self, rng):
+        net = _net(
+            [GravesLSTM(n_in=2, n_out=3),
+             GlobalPoolingLayer(pooling_type="avg"),
+             OutputLayer(n_in=3, n_out=2, activation="softmax", loss_function="mcxent")])
+        x = rng.standard_normal((2, 5, 2))
+        mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1.0]])
+        y = np.eye(2)[[0, 1]]
+        ds = DataSet(x, y, features_mask=mask)
+        _assert_gc(net, ds, subset=80)
+
+
+class TestAutoEncoderPretrain:
+    def test_pretrain_loss_decreases(self, rng):
+        from deeplearning4j_tpu.nn.layers.feedforward import AutoEncoderImpl
+        from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration as NNC
+        gc = NNC(seed=1, activation="sigmoid", weight_init="xavier")
+        conf = AutoEncoder(n_in=8, n_out=4, corruption_level=0.0)
+        impl = AutoEncoderImpl(gc, conf, "ae")
+        params = impl.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng.random((16, 8)))
+        loss_fn = jax.jit(lambda p: impl.pretrain_loss(p, x, None))
+        g_fn = jax.jit(jax.grad(lambda p: impl.pretrain_loss(p, x, None)))
+        l0 = float(loss_fn(params))
+        for _ in range(200):
+            g = g_fn(params)
+            params = jax.tree.map(lambda p, gg: p - 1.0 * gg, params, g)
+        assert float(loss_fn(params)) < l0 * 0.8

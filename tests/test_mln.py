@@ -1,0 +1,176 @@
+"""End-to-end slice tests: config → MLN → train.
+
+Ports of the reference test doctrine (SURVEY.md §4):
+- ``BackPropMLPTest.java``: one SGD step vs hand-rolled numpy math
+- ``GradientCheckTests.java``: finite differences vs analytic
+- ``MultiLayerTest.java``: small net learns Iris
+- ``NeuralNetConfigurationTest.java``: JSON round-trip equality
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator, load_iris_dataset
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _mlp_conf(n_in=4, n_hidden=5, n_out=3, activation="sigmoid", lr=0.1, updater="sgd",
+              l1=0.0, l2=0.0, seed=42):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater(updater)
+            .activation(activation).weight_init("xavier").l1(l1).l2(l2)
+            .list()
+            .layer(DenseLayer(n_in=n_in, n_out=n_hidden))
+            .layer(OutputLayer(n_in=n_hidden, n_out=n_out, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestBackPropMLPHandMath:
+    """One full SGD iteration vs hand-computed numpy (BackPropMLPTest)."""
+
+    def test_single_step_matches_hand_math(self):
+        conf = _mlp_conf(activation="sigmoid", lr=0.1)
+        net = MultiLayerNetwork(conf).init(dtype=jnp.float64)
+        rng = np.random.default_rng(0)
+        x = rng.random((10, 4))
+        y = np.eye(3)[rng.integers(0, 3, 10)]
+
+        W0 = np.asarray(net.params["layer0"]["W"]).copy()
+        b0 = np.asarray(net.params["layer0"]["b"]).copy()
+        W1 = np.asarray(net.params["layer1"]["W"]).copy()
+        b1 = np.asarray(net.params["layer1"]["b"]).copy()
+
+        net.fit(DataSet(x, y))
+
+        # hand math (f64)
+        z1 = x @ W0 + b0
+        a1 = _sigmoid(z1)
+        z2 = a1 @ W1 + b1
+        e = np.exp(z2 - z2.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        n = x.shape[0]
+        score = -np.mean(np.sum(y * np.log(p), axis=1))
+        dz2 = (p - y) / n
+        gW1 = a1.T @ dz2
+        gb1 = dz2.sum(axis=0)
+        da1 = dz2 @ W1.T
+        dz1 = da1 * a1 * (1 - a1)
+        gW0 = x.T @ dz1
+        gb0 = dz1.sum(axis=0)
+
+        np.testing.assert_allclose(net.score(), score, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(net.params["layer1"]["W"]), W1 - 0.1 * gW1, rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(net.params["layer1"]["b"]), b1 - 0.1 * gb1, rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(net.params["layer0"]["W"]), W0 - 0.1 * gW0, rtol=1e-4, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(net.params["layer0"]["b"]), b0 - 0.1 * gb0, rtol=1e-4, atol=1e-7)
+
+
+class TestGradientChecks:
+    """GradientCheckTests.java analog — the correctness oracle."""
+
+    def _run(self, activation, updater="sgd", l1=0.0, l2=0.0):
+        conf = _mlp_conf(activation=activation, l1=l1, l2=l2)
+        net = MultiLayerNetwork(conf).init(dtype=jnp.float64)
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((8, 4))
+        y = np.eye(3)[rng.integers(0, 3, 8)]
+        res = check_gradients(net, DataSet(x, y))
+        assert res.ok, f"act={activation} l1={l1} l2={l2}: {res.n_failed}/{res.n_checked} failed; " + \
+            "; ".join(res.failures[:3])
+
+    def test_mlp_tanh(self):
+        self._run("tanh")
+
+    def test_mlp_relu(self):
+        self._run("relu")
+
+    def test_mlp_sigmoid_l2(self):
+        self._run("sigmoid", l2=0.01)
+
+    def test_mlp_l1(self):
+        self._run("tanh", l1=0.01)
+
+
+class TestIrisTraining:
+    """MultiLayerTest-style integration: Iris MLP reaches high accuracy."""
+
+    def test_iris_mlp_learns(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(12345).learning_rate(0.5).updater("nesterovs").momentum(0.9)
+                .activation("relu").weight_init("relu")
+                .list()
+                .layer(DenseLayer(n_out=16))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss_function="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        assert conf.layers[0].n_in == 4  # auto-wired
+        net = MultiLayerNetwork(conf).init()
+        ds = load_iris_dataset(shuffle_seed=6)
+        first_score = None
+        for _ in range(150):
+            net.fit(ds)
+            if first_score is None:
+                first_score = net.score()
+        preds = net.predict(ds.features)
+        acc = float(np.mean(preds == np.argmax(ds.labels, axis=1)))
+        assert acc >= 0.95, f"accuracy {acc}"
+        assert net.score() < first_score / 3
+
+    def test_iris_via_iterator_and_adam(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).learning_rate(0.02).updater("adam")
+                .activation("tanh").list()
+                .layer(DenseLayer(n_in=4, n_out=10))
+                .layer(OutputLayer(n_in=10, n_out=3, activation="softmax",
+                                   loss_function="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        it = IrisDataSetIterator(batch=50)
+        for _ in range(60):
+            net.fit(it)
+        ds = load_iris_dataset(shuffle_seed=6)
+        acc = float(np.mean(net.predict(ds.features) == np.argmax(ds.labels, axis=1)))
+        assert acc >= 0.9, f"accuracy {acc}"
+
+
+class TestFlatParamViews:
+    def test_round_trip(self):
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        flat = net.params_flat()
+        assert flat.ndim == 1 and flat.size == net.num_params()
+        net2 = MultiLayerNetwork(_mlp_conf()).init()
+        net2.set_params_flat(flat)
+        np.testing.assert_array_equal(net2.params_flat(), flat)
+        x = np.random.default_rng(0).random((4, 4))
+        np.testing.assert_allclose(net.output(x), net2.output(x), rtol=1e-6)
+
+
+class TestConfSerialization:
+    def test_json_round_trip(self):
+        conf = _mlp_conf(l2=0.01, updater="adam")
+        s = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(s)
+        assert conf2.to_json() == s
+        # and the deserialized conf builds an identical network
+        n1 = MultiLayerNetwork(conf).init()
+        n2 = MultiLayerNetwork(conf2).init()
+        np.testing.assert_array_equal(n1.params_flat(), n2.params_flat())
+
+    def test_builder_typo_surfaces_at_build(self):
+        b = NeuralNetConfiguration.builder().learning_rate(0.1).bogus_field(3)
+        try:
+            b.build()
+            assert False, "expected TypeError"
+        except TypeError:
+            pass
