@@ -1,0 +1,68 @@
+"""Benchmark: LeNet-MNIST training throughput on one TPU chip.
+
+BASELINE.json config #1 (LeNet MNIST via MultiLayerNetwork) measured as
+examples/sec/chip using the device-resident ``fit_scan`` path (whole
+epoch = one XLA program; the host dispatches once per epoch).
+``vs_baseline`` is achieved_MFU / 0.30 — the BASELINE.json north-star
+target ("≥30% MFU on v5e"); >1.0 means the north star is met. The
+reference publishes no numbers of its own (BASELINE.md), so the
+hardware ceiling is the bar.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BATCH = 2048
+EPOCH_EXAMPLES = BATCH * 8
+MEASURE_EPOCHS = 6
+
+# v5e bf16 peak ~197 TFLOP/s; f32 ~half. Default compute dtype is f32.
+PEAK_FLOPS = 98.5e12
+
+
+def lenet_train_flops_per_example() -> float:
+    """Analytic FLOPs per training example (fwd = 2*MACs, train ~ 3x fwd):
+    conv1 5x5x1x20 @24x24, conv2 5x5x20x50 @8x8, dense 800->500, out 500->10."""
+    macs = (24 * 24 * 20 * 25
+            + 8 * 8 * 50 * 25 * 20
+            + 800 * 500
+            + 500 * 10)
+    return 3.0 * 2.0 * macs
+
+
+def main():
+    import jax
+    import __graft_entry__ as ge
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.mnist import load_mnist
+
+    net = ge._flagship()
+    ds = load_mnist(train=True, num_examples=EPOCH_EXAMPLES)
+    data = DataSet(ds.features.reshape(-1, 28, 28, 1), ds.labels)
+
+    net.fit_scan(data, BATCH, epochs=1)  # compile + warmup
+    jax.block_until_ready(net.params)
+
+    t0 = time.perf_counter()
+    scores = net.fit_scan(data, BATCH, epochs=MEASURE_EPOCHS)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+
+    n_examples = MEASURE_EPOCHS * (EPOCH_EXAMPLES // BATCH) * BATCH
+    examples_per_sec = n_examples / dt
+    mfu = examples_per_sec * lenet_train_flops_per_example() / PEAK_FLOPS
+    assert np.isfinite(scores).all()
+    print(json.dumps({
+        "metric": "lenet_mnist_train_examples_per_sec_per_chip",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(mfu / 0.30, 6),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Distributed training over the TPU device mesh.
+
+Replaces the reference's two data-parallel planes (SURVEY.md §2.6):
+
+- ``ParallelWrapper`` (single-node multi-GPU threads + periodic
+  ``Nd4j.averageAndPropagate``) and
+- Spark ``ParameterAveragingTrainingMaster`` (broadcast → mapPartitions
+  → RDD.aggregate tree-reduce)
+
+with ``jax.sharding`` over a ``Mesh``: the SAME compiled train step runs
+data-parallel when the batch is sharded over the ``data`` axis — XLA
+inserts the gradient all-reduce over ICI inside the step (there is no
+separate communication phase to schedule, overlap is the compiler's
+job). Parameter-averaging semantics (``averagingFrequency > 1``) are
+kept for parity via shard_map-isolated local steps + periodic pmean.
+
+Extensions with no reference counterpart: tensor parallelism via
+parameter PartitionSpecs (``model`` axis), sequence parallelism / ring
+attention for long context (``ring_attention.py``), multi-host DCN via
+``jax.distributed`` initialization.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import MeshContext, make_mesh  # noqa: F401
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401

@@ -1,0 +1,71 @@
+"""Device-mesh construction + sharding helpers.
+
+The mesh is the TPU-native replacement for the reference's cluster
+topology (Spark executors / ParallelWrapper threads). Axis convention:
+
+- ``data``  — batch (data parallelism; gradient all-reduce rides ICI)
+- ``model`` — tensor parallelism (dense/conv channel sharding)
+- ``seq``   — sequence parallelism (ring attention block axis)
+
+Multi-host: call ``jax.distributed.initialize()`` before ``make_mesh``
+and the same code spans hosts — device order follows
+``jax.devices()``, DCN-connected slices become outer mesh dims.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from {axis: size}. Sizes must multiply to the device
+    count; a single ``{"data": N}`` axis is the default (pure DP)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {"data": len(devices)}
+    sizes = list(axes.values())
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(f"mesh axes {axes} need {np.prod(sizes)} devices, "
+                         f"have {len(devices)}")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+@dataclasses.dataclass
+class MeshContext:
+    """A mesh + canonical shardings (the distributed plumbing handle)."""
+
+    mesh: Mesh
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharded(self, ndim: int = 2, axis: str = "data") -> NamedSharding:
+        """Shard dim 0 (batch) over ``axis``, replicate the rest."""
+        return NamedSharding(self.mesh, P(axis, *([None] * (ndim - 1))))
+
+    def shard_batch(self, *arrays):
+        """Place host arrays with batch dim sharded over ``data``
+        (the broadcast+partition step of the reference's
+        ``NetBroadcastTuple``/repartition plane, done by the runtime)."""
+        n = self.data_axis_size()
+        out = []
+        for a in arrays:
+            if a is None:
+                out.append(None)
+            else:
+                if np.shape(a)[0] % n != 0:
+                    raise ValueError(
+                        f"batch size {np.shape(a)[0]} not divisible by data axis "
+                        f"size {n}; pad or trim the batch")
+                out.append(jax.device_put(a, self.batch_sharded(np.ndim(a))))
+        return out
+
+    def data_axis_size(self) -> int:
+        return self.mesh.shape.get("data", 1)

@@ -174,3 +174,18 @@ class TestConfSerialization:
             assert False, "expected TypeError"
         except TypeError:
             pass
+
+
+class TestFitScan:
+    def test_scan_matches_per_step_fit(self):
+        """Device-resident scanned epoch == per-step fit (same math)."""
+        import jax.numpy as jnp
+        ds = load_iris_dataset(shuffle_seed=3)[:96]
+        a = MultiLayerNetwork(_mlp_conf(lr=0.2)).init()
+        b = MultiLayerNetwork(_mlp_conf(lr=0.2)).init()
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        for _ in range(2):
+            a.fit(ListDataSetIterator(ds, 32))
+        scores = b.fit_scan(ds, 32, epochs=2)
+        assert scores.shape == (6,)
+        np.testing.assert_allclose(a.params_flat(), b.params_flat(), rtol=1e-5, atol=1e-7)
