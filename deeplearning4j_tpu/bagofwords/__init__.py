@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.bagofwords.vectorizer import BagOfWordsVectorizer, TfidfVectorizer  # noqa: F401

@@ -1,0 +1,71 @@
+"""Bag-of-words + TF-IDF vectorizers over a VocabCache.
+
+Parity: ``bagofwords/vectorizer/BagOfWordsVectorizer.java`` /
+``TfidfVectorizer.java`` — fit a vocab over a corpus, then transform
+texts to count / tf-idf vectors (optionally labeled DataSets).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models.word2vec.vocab import VocabCache
+from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory, TokenizerFactory
+
+
+class BagOfWordsVectorizer:
+    def __init__(self, min_word_frequency: int = 1,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 stop_words: Sequence[str] = ()):
+        self.min_word_frequency = min_word_frequency
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.stop_words = frozenset(stop_words)
+        self.vocab: Optional[VocabCache] = None
+        self._doc_freq: Optional[np.ndarray] = None
+        self._n_docs = 0
+
+    def _tokens(self, text: str) -> List[str]:
+        return [t for t in self.tokenizer_factory.create(text).get_tokens()
+                if t not in self.stop_words]
+
+    def fit(self, texts: Iterable[str]) -> "BagOfWordsVectorizer":
+        token_lists = [self._tokens(t) for t in texts]
+        self.vocab = VocabCache.build_from_sentences(token_lists, self.min_word_frequency)
+        v = self.vocab.num_words()
+        self._doc_freq = np.zeros(v, np.int64)
+        self._n_docs = len(token_lists)
+        for toks in token_lists:
+            for i in {self.vocab.index_of(t) for t in toks if self.vocab.has_token(t)}:
+                self._doc_freq[i] += 1
+        return self
+
+    def transform(self, text: str) -> np.ndarray:
+        vec = np.zeros(self.vocab.num_words(), np.float32)
+        for t in self._tokens(text):
+            i = self.vocab.index_of(t)
+            if i >= 0:
+                vec[i] += 1.0
+        return vec
+
+    def fit_transform(self, texts: Sequence[str]) -> np.ndarray:
+        self.fit(texts)
+        return np.stack([self.transform(t) for t in texts])
+
+    def vectorize(self, texts: Sequence[str], labels: Sequence[int],
+                  num_classes: Optional[int] = None) -> DataSet:
+        x = np.stack([self.transform(t) for t in texts])
+        n = num_classes or (max(labels) + 1)
+        y = np.eye(n, dtype=np.float32)[np.asarray(labels)]
+        return DataSet(x, y)
+
+
+class TfidfVectorizer(BagOfWordsVectorizer):
+    """tf-idf weighting: tf * log(N / df) (``TfidfVectorizer.java``)."""
+
+    def transform(self, text: str) -> np.ndarray:
+        tf = super().transform(text)
+        idf = np.log(np.maximum(self._n_docs, 1) / np.maximum(self._doc_freq, 1))
+        return (tf * idf).astype(np.float32)

@@ -1,0 +1,86 @@
+"""KD-tree for exact nearest-neighbor queries.
+
+Parity: ``clustering/kdtree/KDTree.java`` (SURVEY.md §2.3; also
+``vptree/`` fills the same role for metric spaces — the batched
+brute-force path in ``WordVectors.words_nearest`` is the TPU-preferred
+alternative for bulk queries, this host structure serves single-point
+lookups).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("point", "index", "axis", "left", "right")
+
+    def __init__(self, point, index, axis):
+        self.point = point
+        self.index = index
+        self.axis = axis
+        self.left: Optional["_Node"] = None
+        self.right: Optional["_Node"] = None
+
+
+class KDTree:
+    def __init__(self, points: np.ndarray):
+        self.points = np.asarray(points, np.float64)
+        idx = np.arange(len(self.points))
+        self.root = self._build(idx, 0)
+
+    def _build(self, idx: np.ndarray, depth: int) -> Optional[_Node]:
+        if len(idx) == 0:
+            return None
+        axis = depth % self.points.shape[1]
+        order = idx[np.argsort(self.points[idx, axis])]
+        mid = len(order) // 2
+        node = _Node(self.points[order[mid]], int(order[mid]), axis)
+        node.left = self._build(order[:mid], depth + 1)
+        node.right = self._build(order[mid + 1:], depth + 1)
+        return node
+
+    def nn(self, query: np.ndarray) -> Tuple[int, float]:
+        """Nearest neighbor (index, distance)."""
+        q = np.asarray(query, np.float64)
+        best = [None, np.inf]
+
+        def visit(node):
+            if node is None:
+                return
+            d = float(np.linalg.norm(node.point - q))
+            if d < best[1]:
+                best[0], best[1] = node.index, d
+            diff = q[node.axis] - node.point[node.axis]
+            near, far = (node.left, node.right) if diff < 0 else (node.right, node.left)
+            visit(near)
+            if abs(diff) < best[1]:
+                visit(far)
+
+        visit(self.root)
+        return best[0], best[1]
+
+    def knn(self, query: np.ndarray, k: int) -> List[Tuple[int, float]]:
+        q = np.asarray(query, np.float64)
+        heap: List[Tuple[float, int]] = []  # max-heap via negation
+
+        import heapq
+
+        def visit(node):
+            if node is None:
+                return
+            d = float(np.linalg.norm(node.point - q))
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.index))
+            elif d < -heap[0][0]:
+                heapq.heapreplace(heap, (-d, node.index))
+            diff = q[node.axis] - node.point[node.axis]
+            near, far = (node.left, node.right) if diff < 0 else (node.right, node.left)
+            visit(near)
+            if len(heap) < k or abs(diff) < -heap[0][0]:
+                visit(far)
+
+        visit(self.root)
+        return sorted(((i, -nd) for nd, i in heap), key=lambda t: t[1])

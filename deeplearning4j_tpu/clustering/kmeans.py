@@ -1,0 +1,93 @@
+"""K-means clustering.
+
+Parity: ``clustering/kmeans/KMeansClustering.java`` + the cluster
+framework (``ClusterSet``/``Point``) it sits on (SURVEY.md §2.3).
+
+TPU formulation: the assign step is one [n,d]x[d,k] distance matmul +
+argmin and the update step a segment-sum — both inside a single jitted
+``lax.while_loop`` with a convergence predicate, so the whole clustering
+runs on-device (the reference iterated point-lists on the JVM heap).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class KMeansClustering:
+    def __init__(self, k: int, max_iterations: int = 100, tol: float = 1e-6,
+                 distance: str = "euclidean", seed: int = 123):
+        if distance not in ("euclidean", "cosine", "manhattan"):
+            raise ValueError(distance)
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.distance = distance
+        self.seed = seed
+        self.centers: Optional[np.ndarray] = None
+        self.iterations_run: int = 0
+
+    def _distances(self, x: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+        if self.distance == "euclidean":
+            return (jnp.sum(x * x, 1)[:, None] - 2.0 * x @ c.T
+                    + jnp.sum(c * c, 1)[None, :])
+        if self.distance == "cosine":
+            xn = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+            cn = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
+            return 1.0 - xn @ cn.T
+        return jnp.sum(jnp.abs(x[:, None, :] - c[None, :, :]), axis=-1)
+
+    def fit(self, data: np.ndarray) -> "KMeansClustering":
+        x = jnp.asarray(data, jnp.float32)
+        n = x.shape[0]
+        if n < self.k:
+            raise ValueError(f"{n} points < k={self.k}")
+        rng = np.random.default_rng(self.seed)
+        # k-means++ seeding (host-side): robust to the bad random-init
+        # local optima the plain reference seeding falls into
+        xn = np.asarray(x, np.float64)
+        centers = [xn[rng.integers(n)]]
+        for _ in range(self.k - 1):
+            d2 = np.min(((xn[:, None, :] - np.asarray(centers)[None]) ** 2).sum(-1), axis=1)
+            probs = d2 / max(d2.sum(), 1e-12)
+            centers.append(xn[rng.choice(n, p=probs)])
+        init = jnp.asarray(np.asarray(centers), x.dtype)
+
+        def assign(c):
+            return jnp.argmin(self._distances(x, c), axis=1)
+
+        def update(labels):
+            one_hot = jax.nn.one_hot(labels, self.k, dtype=x.dtype)  # [n,k]
+            sums = one_hot.T @ x
+            counts = jnp.sum(one_hot, axis=0)[:, None]
+            return jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), 0.0)
+
+        def cond(carry):
+            c, prev_c, i = carry
+            return (i < self.max_iterations) & (jnp.max(jnp.abs(c - prev_c)) > self.tol)
+
+        def body(carry):
+            c, _, i = carry
+            c_new = update(assign(c))
+            # keep empty clusters at their previous center
+            c_new = jnp.where(jnp.all(c_new == 0.0, axis=1, keepdims=True), c, c_new)
+            return c_new, c, i + 1
+
+        final_c, _, iters = jax.lax.while_loop(
+            cond, body, (init, init + 2 * self.tol, jnp.asarray(0)))
+        self.centers = np.asarray(final_c)
+        self.iterations_run = int(iters)
+        return self
+
+    def predict(self, data: np.ndarray) -> np.ndarray:
+        x = jnp.asarray(data, jnp.float32)
+        return np.asarray(jnp.argmin(self._distances(x, jnp.asarray(self.centers)), axis=1))
+
+    def inertia(self, data: np.ndarray) -> float:
+        x = jnp.asarray(data, jnp.float32)
+        d = self._distances(x, jnp.asarray(self.centers))
+        return float(jnp.sum(jnp.min(d, axis=1)))

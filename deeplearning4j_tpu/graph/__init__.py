@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.graph.graph import Graph, Vertex, Edge  # noqa: F401
+from deeplearning4j_tpu.graph.walks import RandomWalkIterator, WeightedRandomWalkIterator  # noqa: F401
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk  # noqa: F401

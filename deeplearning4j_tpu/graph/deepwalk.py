@@ -1,0 +1,89 @@
+"""DeepWalk — skip-gram embeddings over random walks.
+
+Parity: ``models/deepwalk/DeepWalk.java:31`` (skip-gram with
+hierarchical softmax over walk windows, ``GraphHuffman`` tree keyed by
+vertex degree, ``InMemoryGraphLookupTable``). Serialization matches
+``models/loader/GraphVectorSerializer.java`` (text rows of vertex id +
+vector).
+
+TPU formulation: walks are sequences of vertex-id tokens, so training
+reuses the batched SequenceVectors HS/SGNS steps verbatim — the reference
+duplicated the word2vec math for graphs; here it is literally the same
+compiled kernel.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.graph.walks import RandomWalkIterator
+from deeplearning4j_tpu.models.embeddings.lookup_table import WordVectors
+from deeplearning4j_tpu.models.sequencevectors.engine import SequenceVectors
+
+
+class DeepWalk:
+    def __init__(self, vector_size: int = 100, window_size: int = 5,
+                 walk_length: int = 40, walks_per_vertex: int = 2,
+                 learning_rate: float = 0.025, epochs: int = 1,
+                 use_hierarchic_softmax: bool = True, negative: int = 5,
+                 batch_size: int = 2048, seed: int = 123):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.use_hs = use_hierarchic_softmax
+        self.negative = negative
+        self.batch_size = batch_size
+        self.seed = seed
+        self._sv: Optional[SequenceVectors] = None
+        self.graph: Optional[Graph] = None
+
+    def fit(self, graph: Graph, walk_iterator: Optional[RandomWalkIterator] = None):
+        self.graph = graph
+        it = walk_iterator or RandomWalkIterator(
+            graph, self.walk_length, self.seed, self.walks_per_vertex)
+        walks = [[str(v) for v in walk] for walk in it]
+        self._sv = SequenceVectors(
+            vector_length=self.vector_size, window=self.window_size,
+            epochs=self.epochs, learning_rate=self.learning_rate,
+            negative=self.negative, use_hierarchic_softmax=self.use_hs,
+            batch_size=self.batch_size, seed=self.seed)
+        self._sv.fit(walks)
+
+    def get_vertex_vector(self, v: int) -> np.ndarray:
+        return self._sv.word_vectors().get_word_vector(str(v))
+
+    def similarity(self, a: int, b: int) -> float:
+        return self._sv.word_vectors().similarity(str(a), str(b))
+
+    def verts_nearest(self, v: int, n: int = 10) -> List[int]:
+        return [int(w) for w in self._sv.word_vectors().words_nearest(str(v), n)]
+
+    def save(self, path: str):
+        """``GraphVectorSerializer.writeGraphVectors`` — 'id v1 v2 ...'."""
+        wv = self._sv.word_vectors()
+        with open(path, "w") as f:
+            for i in range(self.graph.num_vertices()):
+                if wv.has_word(str(i)):
+                    vec = " ".join(f"{x:.6f}" for x in wv.get_word_vector(str(i)))
+                    f.write(f"{i} {vec}\n")
+
+    @staticmethod
+    def load(path: str, graph: Graph) -> "WordVectors":
+        from deeplearning4j_tpu.models.word2vec.vocab import VocabCache
+        ids, vecs = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                ids.append(parts[0])
+                vecs.append([float(x) for x in parts[1:]])
+        vocab = VocabCache()
+        for k, i in enumerate(ids):
+            vocab.add_token(i, len(ids) - k)
+        vocab.finish()
+        return WordVectors(vocab, np.asarray(vecs, np.float32))

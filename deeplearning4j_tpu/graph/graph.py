@@ -1,0 +1,75 @@
+"""In-memory graph structure + loaders.
+
+Parity: ``deeplearning4j-graph``'s ``api/IGraph.java``,
+``graph/Graph.java``, ``data/GraphLoader.java`` (edge-list files) —
+SURVEY.md §2.4.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Vertex:
+    idx: int
+    value: Any = None
+
+
+@dataclasses.dataclass
+class Edge:
+    frm: int
+    to: int
+    weight: float = 1.0
+    directed: bool = False
+
+
+class Graph:
+    """Adjacency-list graph (``graph/Graph.java``)."""
+
+    def __init__(self, num_vertices: int, directed: bool = False):
+        self.directed = directed
+        self.vertices = [Vertex(i) for i in range(num_vertices)]
+        self._adj: List[List[Tuple[int, float]]] = [[] for _ in range(num_vertices)]
+
+    def num_vertices(self) -> int:
+        return len(self.vertices)
+
+    def add_edge(self, frm: int, to: int, weight: float = 1.0):
+        self._adj[frm].append((to, weight))
+        if not self.directed:
+            self._adj[to].append((frm, weight))
+
+    def get_connected_vertices(self, v: int) -> List[int]:
+        return [t for t, _ in self._adj[v]]
+
+    def get_connected_with_weights(self, v: int) -> List[Tuple[int, float]]:
+        return list(self._adj[v])
+
+    def degree(self, v: int) -> int:
+        return len(self._adj[v])
+
+
+def load_edge_list(path: str, num_vertices: Optional[int] = None,
+                   directed: bool = False, delimiter: Optional[str] = None) -> Graph:
+    """``GraphLoader.loadUndirectedGraphEdgeListFile`` — 'from to [weight]'
+    lines."""
+    edges = []
+    max_v = -1
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(delimiter)
+            a, b = int(parts[0]), int(parts[1])
+            w = float(parts[2]) if len(parts) > 2 else 1.0
+            edges.append((a, b, w))
+            max_v = max(max_v, a, b)
+    g = Graph(num_vertices or (max_v + 1), directed)
+    for a, b, w in edges:
+        g.add_edge(a, b, w)
+    return g

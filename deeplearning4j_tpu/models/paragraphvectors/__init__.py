@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.models.paragraphvectors.paragraphvectors import ParagraphVectors  # noqa: F401

@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.models.sequencevectors.engine import SequenceVectors  # noqa: F401

@@ -1,0 +1,286 @@
+"""SequenceVectors — the generic embedding trainer.
+
+Parity: ``models/sequencevectors/SequenceVectors.java:48`` (fit
+:159-280) with the learning algorithms of
+``models/embeddings/learning/impl/elements/`` (SkipGram :31, CBOW) and
+``.../sequence/`` (DBOW, DM for paragraph vectors).
+
+TPU-first reformulation (SURVEY.md §7.9): the reference trains via
+Hogwild — an ``AsyncSequencer`` feeding N lock-free
+``VectorCalculationsThread``s doing one-row axpy updates (:914, :1008).
+That design is pure host-side pointer chasing and cannot feed a matrix
+unit. Here training-pair generation stays on the host (numpy,
+vectorized) and the math runs as BATCHED device steps:
+
+- one jitted step consumes [B] centers, [B] contexts, [B,K] negatives
+  (and/or padded Huffman codes/points) and applies sparse
+  ``.at[idx].add`` scatter updates to syn0/syn1 — thousands of
+  reference "iterations" per XLA dispatch,
+- identical math to word2vec SGNS/HS: the batch IS the Hogwild razor —
+  within-batch index collisions accumulate (scatter-add) instead of
+  racing, which is the deterministic version of what Hogwild converges
+  to stochastically,
+- linear lr decay over total expected pairs, computed host-side per
+  batch (scalar input, no retrace).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.embeddings.lookup_table import InMemoryLookupTable, WordVectors
+from deeplearning4j_tpu.models.word2vec.vocab import Huffman, VocabCache
+
+
+# --------------------------------------------------------------- device steps
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _sgns_step(syn0, syn1neg, centers, contexts, negatives, lr):
+    """Skip-gram negative-sampling batch update (SkipGram.iterateSample
+    :204 neg-sampling branch, batched). Returns (syn0', syn1neg', loss)."""
+    v = syn0[centers]                       # [B, d]
+    u_pos = syn1neg[contexts]               # [B, d]
+    u_neg = syn1neg[negatives]              # [B, K, d]
+    s_pos = jnp.sum(v * u_pos, axis=-1)     # [B]
+    s_neg = jnp.einsum("bd,bkd->bk", v, u_neg)
+    # negatives that collide with the true context are skipped (word2vec
+    # semantics: a sampled negative equal to the target is discarded)
+    neg_ok = (negatives != contexts[:, None]).astype(s_neg.dtype)
+    # maximize log σ(s_pos) + Σ log σ(-s_neg)
+    g_pos = 1.0 - jax.nn.sigmoid(s_pos)     # [B]
+    g_neg = -jax.nn.sigmoid(s_neg) * neg_ok  # [B, K]
+    dv = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+    du_pos = g_pos[:, None] * v
+    du_neg = g_neg[..., None] * v[:, None, :]
+    syn0 = syn0.at[centers].add(lr * dv)
+    syn1neg = syn1neg.at[contexts].add(lr * du_pos)
+    syn1neg = syn1neg.at[negatives].add(lr * du_neg)
+    loss = -jnp.mean(jnp.log(jax.nn.sigmoid(s_pos) + 1e-10)
+                     + jnp.sum(jnp.log(jax.nn.sigmoid(-s_neg) + 1e-10) * neg_ok, axis=-1))
+    return syn0, syn1neg, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _hs_step(syn0, syn1, centers, codes, points, code_mask, lr):
+    """Hierarchical-softmax batch update (SkipGram.iterateSample :204 HS
+    branch, batched over padded Huffman paths)."""
+    v = syn0[centers]                       # [B, d]
+    u = syn1[points]                        # [B, L, d]
+    s = jnp.einsum("bd,bld->bl", v, u)      # [B, L]
+    # label = 1 - code; g = (label - σ(s)) masked
+    g = (1.0 - codes - jax.nn.sigmoid(s)) * code_mask
+    dv = jnp.einsum("bl,bld->bd", g, u)
+    du = g[..., None] * v[:, None, :]
+    syn0 = syn0.at[centers].add(lr * dv)
+    syn1 = syn1.at[points].add(lr * du)
+    p = jax.nn.sigmoid(jnp.where(codes > 0, -s, s))
+    loss = -jnp.sum(jnp.log(p + 1e-10) * code_mask) / jnp.maximum(jnp.sum(code_mask), 1.0)
+    return syn0, syn1, loss
+
+
+# ------------------------------------------------------------------- sampling
+
+def skipgram_pairs(sentences_idx: List[np.ndarray], window: int,
+                   rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized (center, context) pair generation with the reference's
+    reduced-window sampling (random b in [1, window] per center)."""
+    cs, xs = [], []
+    for s in sentences_idx:
+        n = len(s)
+        if n < 2:
+            continue
+        b = rng.integers(1, window + 1, n)
+        for i in range(n):
+            lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
+            for j in range(lo, hi):
+                if j != i:
+                    cs.append(s[i])
+                    xs.append(s[j])
+    if not cs:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    return np.asarray(cs, np.int32), np.asarray(xs, np.int32)
+
+
+def cbow_pairs(sentences_idx, window, rng, pad_idx):
+    """(context-window [B, 2w], center [B]) with pad for short windows."""
+    ctxs, cs, masks = [], [], []
+    W = 2 * window
+    for s in sentences_idx:
+        n = len(s)
+        if n < 2:
+            continue
+        b = rng.integers(1, window + 1, n)
+        for i in range(n):
+            lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
+            ctx = [s[j] for j in range(lo, hi) if j != i]
+            if not ctx:
+                continue
+            pad = W - len(ctx)
+            ctxs.append(ctx + [pad_idx] * pad)
+            masks.append([1.0] * len(ctx) + [0.0] * pad)
+            cs.append(s[i])
+    if not cs:
+        z = np.zeros((0, W))
+        return z.astype(np.int32), np.zeros(0, np.int32), z.astype(np.float32)
+    return (np.asarray(ctxs, np.int32), np.asarray(cs, np.int32),
+            np.asarray(masks, np.float32))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_sgns_step(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr):
+    """CBOW with negative sampling: mean of context vectors predicts the
+    center (CBOW.java batched)."""
+    vc = syn0[ctx] * ctx_mask[..., None]            # [B, W, d]
+    denom = jnp.maximum(jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0)
+    h = jnp.sum(vc, axis=1) / denom                 # [B, d]
+    u_pos = syn1neg[centers]
+    u_neg = syn1neg[negatives]
+    s_pos = jnp.sum(h * u_pos, axis=-1)
+    s_neg = jnp.einsum("bd,bkd->bk", h, u_neg)
+    neg_ok = (negatives != centers[:, None]).astype(s_neg.dtype)
+    g_pos = 1.0 - jax.nn.sigmoid(s_pos)
+    g_neg = -jax.nn.sigmoid(s_neg) * neg_ok
+    dh = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+    dctx = (dh / denom)[:, None, :] * ctx_mask[..., None]
+    syn0 = syn0.at[ctx].add(lr * dctx)
+    syn1neg = syn1neg.at[centers].add(lr * (g_pos[:, None] * h))
+    syn1neg = syn1neg.at[negatives].add(lr * (g_neg[..., None] * h[:, None, :]))
+    loss = -jnp.mean(jnp.log(jax.nn.sigmoid(s_pos) + 1e-10)
+                     + jnp.sum(jnp.log(jax.nn.sigmoid(-s_neg) + 1e-10) * neg_ok, axis=-1))
+    return syn0, syn1neg, loss
+
+
+# --------------------------------------------------------------------- engine
+
+class SequenceVectors:
+    """Generic embedding trainer over tokenized sequences.
+
+    elements_learning_algorithm: "skipgram" | "cbow";
+    use_hierarchic_softmax / negative (sample count) select the
+    objective, mirroring the reference builder knobs.
+    """
+
+    def __init__(self, vector_length: int = 100, window: int = 5,
+                 min_word_frequency: int = 1, epochs: int = 1,
+                 learning_rate: float = 0.025, min_learning_rate: float = 1e-4,
+                 negative: int = 5, use_hierarchic_softmax: bool = False,
+                 subsampling: float = 0.0, batch_size: int = 4096,
+                 elements_learning_algorithm: str = "skipgram", seed: int = 123):
+        self.vector_length = vector_length
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax
+        self.subsampling = subsampling
+        self.batch_size = batch_size
+        self.algo = elements_learning_algorithm
+        self.seed = seed
+        self.vocab: Optional[VocabCache] = None
+        self.lookup_table: Optional[InMemoryLookupTable] = None
+        self.huffman: Optional[Huffman] = None
+        self._loss_history: List[float] = []
+
+    # -- vocab --
+
+    def build_vocab(self, token_lists: Iterable[List[str]]):
+        self.vocab = VocabCache.build_from_sentences(token_lists, self.min_word_frequency)
+        self.lookup_table = InMemoryLookupTable(self.vocab, self.vector_length, self.seed)
+        self.lookup_table.reset_weights()
+        if self.use_hs:
+            self.huffman = Huffman(self.vocab)
+
+    def _to_indices(self, token_lists: Sequence[List[str]],
+                    rng: np.random.Generator) -> List[np.ndarray]:
+        out = []
+        total = max(self.vocab.total_word_count(), 1)
+        freqs = self.vocab.word_frequencies() / total
+        for toks in token_lists:
+            idx = [self.vocab.index_of(t) for t in toks]
+            idx = [i for i in idx if i >= 0]
+            if self.subsampling > 0:
+                # reference subsampling: P(keep) = sqrt(t/f) + t/f
+                keep = []
+                for i in idx:
+                    f = freqs[i]
+                    p = min(1.0, (np.sqrt(f / self.subsampling) + 1) * self.subsampling / f)
+                    if rng.random() < p:
+                        keep.append(i)
+                idx = keep
+            out.append(np.asarray(idx, np.int32))
+        return out
+
+    # -- training --
+
+    def fit(self, token_lists: Sequence[List[str]]):
+        if self.vocab is None:
+            self.build_vocab(token_lists)
+        lt = self.lookup_table
+        rng = np.random.default_rng(self.seed)
+        syn0 = jnp.asarray(lt.syn0)
+        syn1 = jnp.asarray(lt.syn1) if self.use_hs else jnp.asarray(lt.syn1neg)
+        neg_table = lt.negative_table() if not self.use_hs else None
+        if self.use_hs:
+            codes = jnp.asarray(self.huffman.codes)
+            points = jnp.asarray(self.huffman.points)
+            lens = self.huffman.code_lengths
+            mask_np = (np.arange(codes.shape[1])[None, :] < lens[:, None]).astype(np.float32)
+            cmask = jnp.asarray(mask_np)
+
+        # estimated total steps for linear lr decay
+        sentences = list(token_lists)
+        est_pairs_per_epoch = max(1, sum(len(s) for s in sentences) * self.window)
+        total_steps = max(1, (est_pairs_per_epoch * self.epochs) // self.batch_size)
+        step_i = 0
+
+        for _ in range(self.epochs):
+            idx_lists = self._to_indices(sentences, rng)
+            if self.algo == "cbow":
+                ctx, centers, cmask_b = cbow_pairs(idx_lists, self.window, rng, 0)
+                order = rng.permutation(len(centers))
+                ctx, centers, cmask_b = ctx[order], centers[order], cmask_b[order]
+            else:
+                centers, contexts = skipgram_pairs(idx_lists, self.window, rng)
+                order = rng.permutation(len(centers))
+                centers, contexts = centers[order], contexts[order]
+            B = self.batch_size
+            for s in range(0, len(centers), B):
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1.0 - step_i / total_steps))
+                lr = jnp.float32(lr)
+                cb = centers[s:s + B]
+                if len(cb) == 0:
+                    continue
+                if self.algo == "cbow":
+                    negs = rng.choice(neg_table, (len(cb), self.negative))
+                    syn0, syn1, loss = _cbow_sgns_step(
+                        syn0, syn1, jnp.asarray(ctx[s:s + B]), jnp.asarray(cmask_b[s:s + B]),
+                        jnp.asarray(cb), jnp.asarray(negs, jnp.int32), lr)
+                elif self.use_hs:
+                    xb = contexts[s:s + B]
+                    syn0, syn1, loss = _hs_step(
+                        syn0, syn1, jnp.asarray(cb), codes[jnp.asarray(xb)],
+                        points[jnp.asarray(xb)], cmask[jnp.asarray(xb)], lr)
+                else:
+                    negs = rng.choice(neg_table, (len(cb), self.negative))
+                    syn0, syn1, loss = _sgns_step(
+                        syn0, syn1, jnp.asarray(cb), jnp.asarray(contexts[s:s + B]),
+                        jnp.asarray(negs, jnp.int32), lr)
+                step_i += 1
+                if step_i % 10 == 0:
+                    self._loss_history.append(float(loss))
+        lt.syn0 = np.asarray(syn0)
+        if self.use_hs:
+            lt.syn1 = np.asarray(syn1)
+        else:
+            lt.syn1neg = np.asarray(syn1)
+
+    def word_vectors(self) -> WordVectors:
+        return WordVectors(self.vocab, self.lookup_table.syn0)

@@ -1,0 +1,8 @@
+from deeplearning4j_tpu.models.word2vec.vocab import VocabCache, VocabWord, Huffman  # noqa: F401
+
+
+def __getattr__(name):  # lazy: avoids vocab<->lookup_table import cycle
+    if name == "Word2Vec":
+        from deeplearning4j_tpu.models.word2vec.word2vec import Word2Vec
+        return Word2Vec
+    raise AttributeError(name)

@@ -1,0 +1,144 @@
+"""Vocabulary cache + Huffman coding for hierarchical softmax.
+
+Parity: ``models/word2vec/wordstore/VocabCache`` +
+``models/word2vec/VocabWord`` + ``models/word2vec/Huffman.java``. The
+Huffman build emits fixed-width padded code/point arrays so the whole
+vocab's tree data lives in two dense device arrays (the batched-HS
+formulation needs rectangular tensors, not per-word lists).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+MAX_CODE_LENGTH = 40
+
+
+@dataclasses.dataclass
+class VocabWord:
+    word: str
+    count: int = 1
+    index: int = -1
+    codes: Optional[List[int]] = None   # Huffman code bits
+    points: Optional[List[int]] = None  # inner-node indices
+
+
+class VocabCache:
+    """Word store: counts, frequency-ordered indices, containment."""
+
+    def __init__(self, min_word_frequency: int = 1):
+        self.min_word_frequency = min_word_frequency
+        self._words: Dict[str, VocabWord] = {}
+        self._index: List[VocabWord] = []
+
+    def add_token(self, word: str, count: int = 1):
+        if word in self._words:
+            self._words[word].count += count
+        else:
+            self._words[word] = VocabWord(word, count)
+
+    def finish(self) -> "VocabCache":
+        """Apply min-frequency filter and assign frequency-descending
+        indices (the reference's vocab construction ordering)."""
+        kept = [w for w in self._words.values() if w.count >= self.min_word_frequency]
+        kept.sort(key=lambda w: (-w.count, w.word))
+        self._index = kept
+        self._words = {w.word: w for w in kept}
+        for i, w in enumerate(kept):
+            w.index = i
+        return self
+
+    def has_token(self, word: str) -> bool:
+        return word in self._words
+
+    def index_of(self, word: str) -> int:
+        return self._words[word].index if word in self._words else -1
+
+    def word_at_index(self, i: int) -> str:
+        return self._index[i].word
+
+    def word_for(self, word: str) -> Optional[VocabWord]:
+        return self._words.get(word)
+
+    def num_words(self) -> int:
+        return len(self._index)
+
+    def total_word_count(self) -> int:
+        return sum(w.count for w in self._index)
+
+    def words(self) -> List[str]:
+        return [w.word for w in self._index]
+
+    def word_frequencies(self) -> np.ndarray:
+        return np.array([w.count for w in self._index], np.int64)
+
+    @staticmethod
+    def build_from_sentences(token_lists: Iterable[List[str]],
+                             min_word_frequency: int = 1) -> "VocabCache":
+        vc = VocabCache(min_word_frequency)
+        for toks in token_lists:
+            for t in toks:
+                vc.add_token(t)
+        return vc.finish()
+
+
+class Huffman:
+    """``Huffman.java`` — binary-tree coding over word frequencies;
+    assigns codes/points to every VocabWord and exposes them as padded
+    dense arrays for the batched device HS step."""
+
+    def __init__(self, vocab: VocabCache):
+        self.vocab = vocab
+        self._build()
+
+    def _build(self):
+        n = self.vocab.num_words()
+        if n == 0:
+            self.codes = np.zeros((0, MAX_CODE_LENGTH), np.float32)
+            self.points = np.zeros((0, MAX_CODE_LENGTH), np.int32)
+            self.code_lengths = np.zeros((0,), np.int32)
+            return
+        counts = self.vocab.word_frequencies()
+        # heap of (count, tiebreak, node_id); leaves 0..n-1, internal n..2n-2
+        heap = [(int(c), i, i) for i, c in enumerate(counts)]
+        heapq.heapify(heap)
+        parent = {}
+        bit = {}
+        next_id = n
+        while len(heap) > 1:
+            c1, _, a = heapq.heappop(heap)
+            c2, _, b = heapq.heappop(heap)
+            parent[a] = next_id
+            parent[b] = next_id
+            bit[a] = 0
+            bit[b] = 1
+            heapq.heappush(heap, (c1 + c2, next_id, next_id))
+            next_id += 1
+        root = heap[0][2]
+        codes = np.zeros((n, MAX_CODE_LENGTH), np.float32)
+        points = np.zeros((n, MAX_CODE_LENGTH), np.int32)
+        lengths = np.zeros((n,), np.int32)
+        for i in range(n):
+            path_bits, path_nodes = [], []
+            node = i
+            while node != root:
+                path_bits.append(bit[node])
+                path_nodes.append(parent[node] - n)  # internal-node index
+                node = parent[node]
+            path_bits.reverse()
+            path_nodes.reverse()
+            L = min(len(path_bits), MAX_CODE_LENGTH)
+            lengths[i] = L
+            codes[i, :L] = path_bits[:L]
+            points[i, :L] = path_nodes[:L]
+            w = self.vocab._index[i]
+            w.codes = path_bits[:L]
+            w.points = path_nodes[:L]
+        self.codes = codes
+        self.points = points
+        self.code_lengths = lengths
+        self.num_inner = max(0, next_id - n)

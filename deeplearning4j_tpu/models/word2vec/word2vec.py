@@ -1,0 +1,64 @@
+"""Word2Vec — user-facing builder over SequenceVectors.
+
+Parity: ``models/word2vec/Word2Vec.java:31`` (builder knobs: layerSize,
+windowSize, minWordFrequency, iterations/epochs, learningRate,
+negativeSample, useHierarchicSoftmax, sampling, tokenizerFactory).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.models.sequencevectors.engine import SequenceVectors
+from deeplearning4j_tpu.text.sentenceiterator import SentenceIterator
+from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory, TokenizerFactory
+
+
+class Word2Vec(SequenceVectors):
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 1, epochs: int = 1,
+                 learning_rate: float = 0.025, min_learning_rate: float = 1e-4,
+                 negative_sample: int = 5, use_hierarchic_softmax: bool = False,
+                 sampling: float = 0.0, batch_size: int = 4096,
+                 elements_learning_algorithm: str = "skipgram",
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 seed: int = 123):
+        super().__init__(
+            vector_length=layer_size, window=window_size,
+            min_word_frequency=min_word_frequency, epochs=epochs,
+            learning_rate=learning_rate, min_learning_rate=min_learning_rate,
+            negative=negative_sample, use_hierarchic_softmax=use_hierarchic_softmax,
+            subsampling=sampling, batch_size=batch_size,
+            elements_learning_algorithm=elements_learning_algorithm, seed=seed)
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+
+    def _tokenize(self, corpus) -> List[List[str]]:
+        if isinstance(corpus, SentenceIterator):
+            sentences = list(corpus)
+        else:
+            sentences = list(corpus)
+        out = []
+        for s in sentences:
+            if isinstance(s, str):
+                out.append(self.tokenizer_factory.create(s).get_tokens())
+            else:
+                out.append(list(s))
+        return out
+
+    def fit(self, corpus: Union[SentenceIterator, Iterable[str], Sequence[List[str]]]):
+        super().fit(self._tokenize(corpus))
+
+    # WordVectors-style convenience delegation
+    def _wv(self):
+        return self.word_vectors()
+
+    def get_word_vector(self, word: str) -> np.ndarray:
+        return self._wv().get_word_vector(word)
+
+    def similarity(self, a: str, b: str) -> float:
+        return self._wv().similarity(a, b)
+
+    def words_nearest(self, word, n: int = 10):
+        return self._wv().words_nearest(word, n)

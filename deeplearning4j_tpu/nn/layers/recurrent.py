@@ -102,14 +102,19 @@ class GravesLSTMImpl(LayerImpl):
         return {}
 
     def forward(self, params, x, state, train, rng=None, mask=None):
+        """When ``state`` carries an ("h","c") pair (TBPTT mode,
+        ``doTruncatedBPTT`` :1175 / ``rnnActivateUsingStoredState``), the
+        scan starts from it and the final carry is returned as the new
+        state; otherwise zeros with no carry (standard mode)."""
         x = self.maybe_dropout_input(x, train, rng)
         b = x.shape[0]
         n = self.conf.n_out
-        h0 = jnp.zeros((b, n), x.dtype)
-        c0 = jnp.zeros((b, n), x.dtype)
-        out, _ = _lstm_scan(params, x, h0, c0, self.conf.gate_activation,
-                            self.activation, mask)
-        return out, state
+        tbptt = isinstance(state, dict) and "h" in state
+        h0 = state["h"].astype(x.dtype) if tbptt else jnp.zeros((b, n), x.dtype)
+        c0 = state["c"].astype(x.dtype) if tbptt else jnp.zeros((b, n), x.dtype)
+        out, (h, c) = _lstm_scan(params, x, h0, c0, self.conf.gate_activation,
+                                 self.activation, mask)
+        return out, ({"h": h, "c": c} if tbptt else state)
 
     def rnn_time_step(self, params, x, state):
         """Single-step stateful inference (``rnnTimeStep``,

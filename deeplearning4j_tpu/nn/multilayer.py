@@ -194,7 +194,78 @@ class MultiLayerNetwork:
         for ds in it:
             self._fit_batch(ds)
 
+    # --------------------------------------------------------------- tbptt
+
+    def _recurrent_impls(self):
+        from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTMImpl
+        return [i for i in self.impls if isinstance(i, GravesLSTMImpl)]
+
+    def _fit_tbptt(self, ds: DataSet) -> None:
+        """Truncated BPTT (``doTruncatedBPTT`` :1175): the sequence is cut
+        into ``tbptt_fwd_length`` chunks; the LSTM carry crosses chunks as
+        non-trainable state (gradients stop at chunk boundaries because
+        the carry enters the compiled step as data)."""
+        T = ds.features.shape[1]
+        L = self.conf.tbptt_fwd_length
+        b = ds.features.shape[0]
+        rec = self._recurrent_impls()
+        if not rec:
+            raise ValueError("TBPTT configured but no recurrent layers present")
+        saved = {}
+        for impl in rec:
+            saved[impl.name] = self.states[impl.name]
+            n = impl.conf.n_out
+            self.states[impl.name] = {"h": jnp.zeros((b, n), self._dtype),
+                                      "c": jnp.zeros((b, n), self._dtype)}
+        try:
+            for t0 in range(0, T, L):
+                sl = slice(t0, t0 + L)
+                chunk = DataSet(
+                    ds.features[:, sl], ds.labels[:, sl],
+                    None if ds.features_mask is None else ds.features_mask[:, sl],
+                    None if ds.labels_mask is None else ds.labels_mask[:, sl])
+                self._fit_batch(chunk)
+        finally:
+            # clear carries after fit (rnnClearPreviousState semantics)
+            for impl in rec:
+                self.states[impl.name] = saved[impl.name]
+
+    # ------------------------------------------------------- streaming rnn
+
+    def rnn_time_step(self, x: np.ndarray) -> np.ndarray:
+        """Stateful streaming inference (``rnnTimeStep``,
+        ``MultiLayerNetwork.java:1233``): feed one timestep [b, f] (or a
+        short [b, t, f] burst), keep LSTM state across calls."""
+        x = np.asarray(x)
+        burst = x.ndim == 3
+        steps = x.shape[1] if burst else 1
+        if not hasattr(self, "_rnn_state") or self._rnn_state is None:
+            self._rnn_state = {}
+        outs = []
+        for t in range(steps):
+            xt = jnp.asarray(x[:, t] if burst else x, self._dtype)
+            for impl in self.impls:
+                if hasattr(impl, "rnn_time_step"):
+                    st = self._rnn_state.get(impl.name, {})
+                    xt, st = impl.rnn_time_step(self.params[impl.name], xt, st)
+                    self._rnn_state[impl.name] = st
+                else:
+                    xt, _ = impl.forward(self.params[impl.name], xt,
+                                         self.states[impl.name], False, None)
+            outs.append(np.asarray(xt))
+        return np.stack(outs, axis=1) if burst else outs[0]
+
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_state = {}
+
     def _fit_batch(self, ds: DataSet) -> None:
+        if (self.conf.backprop_type == "truncated_bptt" and ds.features.ndim == 3
+                and ds.features.shape[1] > self.conf.tbptt_fwd_length):
+            self._fit_tbptt(ds)
+            return
+        self._fit_batch_inner(ds)
+
+    def _fit_batch_inner(self, ds: DataSet) -> None:
         rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
         fm = ds.features_mask is not None
         lm = ds.labels_mask is not None

@@ -1,0 +1,176 @@
+"""Outer optimization drivers: SGD, line gradient descent, conjugate
+gradient, L-BFGS with backtracking line search.
+
+Parity: ``optimize/Solver.java:41-55``, ``solvers/BaseOptimizer.java:51``,
+``StochasticGradientDescent.java:38-72``, ``BackTrackLineSearch.java``,
+``solvers/LBFGS.java``, ``ConjugateGradient.java``,
+``LineGradientDescent.java``.
+
+The SGD hot path lives inside the containers' compiled step (SURVEY §3.1
+maps onto one XLA program); the classic full-batch optimizers here drive
+a jitted loss/grad oracle over the flat parameter view from a host loop
+— they are line-search methods whose control flow is inherently
+data-dependent, so the host loop is the right altitude (each oracle call
+is still one fused device program).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+
+
+class BackTrackLineSearch:
+    """``BackTrackLineSearch.java`` — Armijo backtracking with step
+    growth, on a scalar loss along a search direction."""
+
+    def __init__(self, max_iterations: int = 5, c1: float = 1e-4,
+                 shrink: float = 0.5, initial_step: float = 1.0):
+        self.max_iterations = max_iterations
+        self.c1 = c1
+        self.shrink = shrink
+        self.initial_step = initial_step
+
+    def optimize(self, loss_fn, x: np.ndarray, direction: np.ndarray,
+                 f0: float, g0: np.ndarray) -> Tuple[float, float]:
+        """Returns (step, f_new)."""
+        slope = float(np.dot(g0, direction))
+        if slope >= 0:  # not a descent direction — fall back to -grad
+            direction = -g0
+            slope = float(np.dot(g0, direction))
+        step = self.initial_step
+        f_new = f0
+        for _ in range(self.max_iterations):
+            f_new = float(loss_fn(x + step * direction))
+            if np.isfinite(f_new) and f_new <= f0 + self.c1 * step * slope:
+                return step, f_new
+            step *= self.shrink
+        return step, f_new
+
+
+class _FlatOracle:
+    """Jitted loss+grad over the flat parameter view of a model batch."""
+
+    def __init__(self, model, ds):
+        # f64 when available (CPU gradcheck-grade line searches); TPU has
+        # no x64 — use f32 there instead of warn-and-truncate
+        dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        params_cast = jax.tree.map(lambda v: v.astype(dt), model.params)
+        self.flat0, self.unravel = jax.flatten_util.ravel_pytree(params_cast)
+        x = jnp.asarray(ds.features, dt)
+        y = jnp.asarray(ds.labels, dt)
+        fm = jnp.asarray(ds.features_mask, dt) if ds.features_mask is not None else None
+        lm = jnp.asarray(ds.labels_mask, dt) if ds.labels_mask is not None else None
+
+        def loss(v):
+            return model._score_fn(self.unravel(v), model.states, x, y, False, None, fm, lm)[0]
+
+        self.loss = jax.jit(loss)
+        self.value_and_grad = jax.jit(jax.value_and_grad(loss))
+
+    def set_back(self, model, flat: np.ndarray):
+        model.params = jax.tree.map(lambda a, b: b.astype(a.dtype),
+                                    model.params, self.unravel(jnp.asarray(flat)))
+
+
+def line_gradient_descent(oracle: _FlatOracle, iterations: int) -> Tuple[np.ndarray, float]:
+    """``LineGradientDescent.java`` — steepest descent + line search."""
+    x = np.asarray(oracle.flat0)
+    ls = BackTrackLineSearch()
+    f = float(oracle.loss(jnp.asarray(x)))
+    for _ in range(iterations):
+        f, g = oracle.value_and_grad(jnp.asarray(x))
+        f, g = float(f), np.asarray(g)
+        step, f = ls.optimize(oracle.loss, x, -g, f, g)
+        x = x - step * g
+    return x, f
+
+
+def conjugate_gradient(oracle: _FlatOracle, iterations: int) -> Tuple[np.ndarray, float]:
+    """``ConjugateGradient.java`` — Polak-Ribière with automatic restart."""
+    x = np.asarray(oracle.flat0)
+    ls = BackTrackLineSearch()
+    f, g = oracle.value_and_grad(jnp.asarray(x))
+    f, g = float(f), np.asarray(g)
+    d = -g
+    for _ in range(iterations):
+        step, f = ls.optimize(oracle.loss, x, d, f, g)
+        x = x + step * d
+        f_new, g_new = oracle.value_and_grad(jnp.asarray(x))
+        f, g_new = float(f_new), np.asarray(g_new)
+        beta = max(0.0, float(np.dot(g_new, g_new - g) / max(np.dot(g, g), 1e-30)))
+        d = -g_new + beta * d
+        g = g_new
+    return x, f
+
+
+def lbfgs(oracle: _FlatOracle, iterations: int, memory: int = 10) -> Tuple[np.ndarray, float]:
+    """``LBFGS.java`` — limited-memory BFGS two-loop recursion."""
+    x = np.asarray(oracle.flat0)
+    ls = BackTrackLineSearch()
+    f, g = oracle.value_and_grad(jnp.asarray(x))
+    f, g = float(f), np.asarray(g)
+    s_hist, y_hist = [], []
+    for _ in range(iterations):
+        # two-loop recursion
+        q = g.copy()
+        alphas = []
+        for s, y in reversed(list(zip(s_hist, y_hist))):
+            rho = 1.0 / max(float(np.dot(y, s)), 1e-30)
+            a = rho * float(np.dot(s, q))
+            alphas.append((a, rho, s, y))
+            q -= a * y
+        if y_hist:
+            s, y = s_hist[-1], y_hist[-1]
+            q *= float(np.dot(s, y)) / max(float(np.dot(y, y)), 1e-30)
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(np.dot(y, q))
+            q += (a - b) * s
+        d = -q
+        step, f = ls.optimize(oracle.loss, x, d, f, g)
+        x_new = x + step * d
+        f_new, g_new = oracle.value_and_grad(jnp.asarray(x_new))
+        f_new, g_new = float(f_new), np.asarray(g_new)
+        s_vec, y_vec = x_new - x, g_new - g
+        if float(np.dot(s_vec, y_vec)) > 1e-10:
+            s_hist.append(s_vec)
+            y_hist.append(y_vec)
+            if len(s_hist) > memory:
+                s_hist.pop(0)
+                y_hist.pop(0)
+        x, f, g = x_new, f_new, g_new
+    return x, f
+
+
+class Solver:
+    """``optimize/Solver.java`` — dispatches on
+    ``conf.optimization_algo``; for SGD the containers' compiled step is
+    the implementation, the classic methods run here."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def optimize(self, ds, iterations: Optional[int] = None) -> float:
+        from deeplearning4j_tpu.nn.conf.configuration import OptimizationAlgorithm as OA
+
+        algo = self.model.gc.optimization_algo
+        iters = iterations or max(1, self.model.gc.iterations)
+        if algo == OA.STOCHASTIC_GRADIENT_DESCENT:
+            self.model.fit(ds)
+            return self.model.score()
+        oracle = _FlatOracle(self.model, ds)
+        if algo == OA.LINE_GRADIENT_DESCENT:
+            x, f = line_gradient_descent(oracle, iters)
+        elif algo == OA.CONJUGATE_GRADIENT:
+            x, f = conjugate_gradient(oracle, iters)
+        elif algo == OA.LBFGS:
+            x, f = lbfgs(oracle, iters)
+        else:
+            raise ValueError(f"unknown optimization algorithm {algo}")
+        oracle.set_back(self.model, x)
+        self.model._score = f
+        return f

@@ -1,0 +1,14 @@
+from deeplearning4j_tpu.text.tokenization import (  # noqa: F401
+    DefaultTokenizer,
+    DefaultTokenizerFactory,
+    CommonPreprocessor,
+    LowCasePreprocessor,
+    StemmingPreprocessor,
+)
+from deeplearning4j_tpu.text.sentenceiterator import (  # noqa: F401
+    SentenceIterator,
+    CollectionSentenceIterator,
+    LineSentenceIterator,
+    FileSentenceIterator,
+    BasicLineIterator,
+)

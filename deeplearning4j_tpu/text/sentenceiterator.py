@@ -1,0 +1,160 @@
+"""Sentence / document iterators.
+
+Parity: ``text/sentenceiterator/`` (12 classes) — the corpus-feeding
+SPI: ``SentenceIterator`` (nextSentence/hasNext/reset + preprocessor),
+collection/line/file-backed implementations, and the labeled-document
+variant used by ParagraphVectors (``documentiterator/LabelAwareIterator``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable, List, Optional, Tuple
+
+
+class SentencePreProcessor:
+    def pre_process(self, sentence: str) -> str:
+        raise NotImplementedError
+
+
+class SentenceIterator:
+    def __init__(self, preprocessor: Optional[SentencePreProcessor] = None):
+        self._pre = preprocessor
+
+    def set_pre_processor(self, pre: SentencePreProcessor):
+        self._pre = pre
+
+    def _apply(self, s: str) -> str:
+        return self._pre.pre_process(s) if self._pre else s
+
+    def next_sentence(self) -> str:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_sentence()
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    def __init__(self, sentences: List[str], preprocessor=None):
+        super().__init__(preprocessor)
+        self._sentences = list(sentences)
+        self._i = 0
+
+    def next_sentence(self) -> str:
+        s = self._sentences[self._i]
+        self._i += 1
+        return self._apply(s)
+
+    def has_next(self) -> bool:
+        return self._i < len(self._sentences)
+
+    def reset(self) -> None:
+        self._i = 0
+
+
+class LineSentenceIterator(SentenceIterator):
+    """One sentence per line of a file (``LineSentenceIterator``)."""
+
+    def __init__(self, path: str, preprocessor=None):
+        super().__init__(preprocessor)
+        self._path = path
+        self._fh = None
+        self._next = None
+        self.reset()
+
+    def reset(self):
+        if self._fh:
+            self._fh.close()
+        self._fh = open(self._path, "r", encoding="utf-8", errors="replace")
+        self._advance()
+
+    def _advance(self):
+        line = self._fh.readline()
+        self._next = line.rstrip("\n") if line else None
+
+    def has_next(self):
+        return self._next is not None
+
+    def next_sentence(self):
+        s = self._next
+        self._advance()
+        return self._apply(s)
+
+
+BasicLineIterator = LineSentenceIterator  # reference alias
+
+
+class FileSentenceIterator(SentenceIterator):
+    """All lines of all files under a directory (``FileSentenceIterator``)."""
+
+    def __init__(self, directory: str, preprocessor=None):
+        super().__init__(preprocessor)
+        self._dir = directory
+        self.reset()
+
+    def reset(self):
+        self._files = sorted(
+            os.path.join(r, f)
+            for r, _, fs in os.walk(self._dir) for f in fs)
+        self._lines: List[str] = []
+        self._fi = 0
+        self._li = 0
+        self._load_next_file()
+
+    def _load_next_file(self):
+        self._lines, self._li = [], 0
+        while self._fi < len(self._files) and not self._lines:
+            with open(self._files[self._fi], encoding="utf-8", errors="replace") as f:
+                self._lines = [l.rstrip("\n") for l in f if l.strip()]
+            self._fi += 1
+
+    def has_next(self):
+        return self._li < len(self._lines)
+
+    def next_sentence(self):
+        s = self._lines[self._li]
+        self._li += 1
+        if self._li >= len(self._lines):
+            self._load_next_file()
+        return self._apply(s)
+
+
+class LabelledDocument:
+    """``documentiterator/LabelledDocument`` — content + labels."""
+
+    def __init__(self, content: str, labels: List[str]):
+        self.content = content
+        self.labels = list(labels)
+
+
+class LabelAwareIterator:
+    """``documentiterator/LabelAwareIterator`` — documents with labels
+    (the ParagraphVectors input SPI)."""
+
+    def __init__(self, documents: Iterable[Tuple[str, List[str]]]):
+        self._docs = [LabelledDocument(c, l) for c, l in documents]
+        self._i = 0
+
+    def has_next(self) -> bool:
+        return self._i < len(self._docs)
+
+    def next_document(self) -> LabelledDocument:
+        d = self._docs[self._i]
+        self._i += 1
+        return d
+
+    def reset(self):
+        self._i = 0
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_document()

@@ -1,0 +1,128 @@
+"""Tokenizer SPI + default tokenizers and token preprocessors.
+
+Parity: ``text/tokenization/`` in the reference
+(``TokenizerFactory``/``Tokenizer`` SPI, ``DefaultTokenizer``,
+``CommonPreprocessor``, ``LowCasePreProcessor``, stemming via
+``EndingPreProcessor``-style suffix rules). The UIMA/Kuromoji/Korean
+tokenizers of the reference are vendored third-party pipelines; their
+SPI seam is reproduced here so custom tokenizers plug in the same way.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Iterator, List, Optional
+
+
+class TokenPreProcess:
+    """``tokenization/tokenizer/TokenPreProcess`` — per-token transform."""
+
+    def pre_process(self, token: str) -> str:
+        raise NotImplementedError
+
+
+class LowCasePreprocessor(TokenPreProcess):
+    def pre_process(self, token: str) -> str:
+        return token.lower()
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """``CommonPreprocessor`` — lowercase + strip punctuation/digits."""
+
+    _PUNCT = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token.lower())
+
+
+class StemmingPreprocessor(TokenPreProcess):
+    """Suffix-stripping stemmer (``EndingPreProcessor`` rules)."""
+
+    def pre_process(self, token: str) -> str:
+        t = token.lower()
+        for suf in ("ing", "ed", "es", "s", "ly"):
+            if t.endswith(suf) and len(t) > len(suf) + 2:
+                return t[: -len(suf)]
+        return t
+
+
+class Tokenizer:
+    """``Tokenizer`` SPI: hasMoreTokens/nextToken/getTokens."""
+
+    def __init__(self, tokens: List[str], preprocessor: Optional[TokenPreProcess] = None):
+        self._tokens = tokens
+        self._pre = preprocessor
+        self._i = 0
+
+    def set_token_pre_processor(self, pre: TokenPreProcess):
+        self._pre = pre
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+    def has_more_tokens(self) -> bool:
+        return self._i < len(self._tokens)
+
+    def next_token(self) -> str:
+        t = self._tokens[self._i]
+        self._i += 1
+        return self._pre.pre_process(t) if self._pre else t
+
+    def get_tokens(self) -> List[str]:
+        out = []
+        while self.has_more_tokens():
+            t = self.next_token()
+            if t:
+                out.append(t)
+        return out
+
+
+class DefaultTokenizer(Tokenizer):
+    """Whitespace/word-boundary tokenizer (``DefaultTokenizer``)."""
+
+    _SPLIT = re.compile(r"\s+")
+
+    def __init__(self, text: str, preprocessor: Optional[TokenPreProcess] = None):
+        super().__init__([t for t in self._SPLIT.split(text.strip()) if t], preprocessor)
+
+
+class TokenizerFactory:
+    """``TokenizerFactory`` SPI."""
+
+    def create(self, text: str) -> Tokenizer:
+        raise NotImplementedError
+
+    def set_token_pre_processor(self, pre: TokenPreProcess):
+        self._pre = pre
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    def __init__(self, preprocessor: Optional[TokenPreProcess] = None):
+        self._pre = preprocessor
+
+    def create(self, text: str) -> Tokenizer:
+        return DefaultTokenizer(text, self._pre)
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    """``NGramTokenizerFactory`` — emits n-grams of the base tokens."""
+
+    def __init__(self, base: TokenizerFactory, min_n: int, max_n: int):
+        self._base = base
+        self._min = min_n
+        self._max = max_n
+
+    def create(self, text: str) -> Tokenizer:
+        toks = self._base.create(text).get_tokens()
+        grams: List[str] = []
+        for n in range(self._min, self._max + 1):
+            for i in range(len(toks) - n + 1):
+                grams.append(" ".join(toks[i:i + n]))
+        return Tokenizer(grams)
+
+
+# English stopwords (the reference ships a stopwords resource file)
+STOP_WORDS = frozenset("""a an and are as at be but by for if in into is it no not of on or such
+that the their then there these they this to was will with i you he she we his her its our your
+from has have had do does did so than too very can could should would may might must am been being
+""".split())

@@ -1,0 +1,146 @@
+"""DeepWalk, k-means, KD-tree, t-SNE tests — ports of the reference's
+``deeplearning4j-graph`` tests and clustering/plot coverage."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering
+from deeplearning4j_tpu.graph import DeepWalk, Graph, RandomWalkIterator, WeightedRandomWalkIterator
+from deeplearning4j_tpu.graph.graph import load_edge_list
+from deeplearning4j_tpu.plot import TSNE
+
+
+def _two_cliques(n=8):
+    """Two n-cliques joined by a single bridge edge."""
+    g = Graph(2 * n)
+    for base in (0, n):
+        for i in range(n):
+            for j in range(i + 1, n):
+                g.add_edge(base + i, base + j)
+    g.add_edge(0, n)
+    return g
+
+
+class TestGraph:
+    def test_adjacency(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2)
+        assert g.get_connected_vertices(1) == [0, 2]
+        assert g.degree(0) == 1
+
+    def test_directed(self):
+        g = Graph(2, directed=True)
+        g.add_edge(0, 1)
+        assert g.get_connected_vertices(0) == [1]
+        assert g.get_connected_vertices(1) == []
+
+    def test_edge_list_loader(self, tmp_path):
+        p = os.path.join(tmp_path, "edges.txt")
+        with open(p, "w") as f:
+            f.write("# comment\n0 1\n1 2 2.5\n")
+        g = load_edge_list(p)
+        assert g.num_vertices() == 3
+        assert g.get_connected_with_weights(1) == [(0, 1.0), (2, 2.5)]
+
+    def test_random_walks(self):
+        g = _two_cliques(4)
+        walks = list(RandomWalkIterator(g, walk_length=5, seed=1))
+        assert len(walks) == 8
+        for w in walks:
+            assert len(w) == 6
+            for a, b in zip(w, w[1:]):
+                assert b in g.get_connected_vertices(a) or a == b
+
+    def test_weighted_walks_prefer_heavy_edges(self):
+        g = Graph(3)
+        g.add_edge(0, 1, 100.0)
+        g.add_edge(0, 2, 0.01)
+        it = WeightedRandomWalkIterator(g, walk_length=1, seed=0, walks_per_vertex=50)
+        firsts = [w[1] for w in it if w[0] == 0]
+        assert firsts.count(1) > firsts.count(2)
+
+
+class TestDeepWalk:
+    def test_clique_structure_embeds(self):
+        g = _two_cliques(8)
+        dw = DeepWalk(vector_size=16, window_size=4, walk_length=20,
+                      walks_per_vertex=8, epochs=3, learning_rate=0.05,
+                      batch_size=256, seed=3)
+        dw.fit(g)
+        in_clique = dw.similarity(1, 2)
+        cross = dw.similarity(1, 9)
+        assert in_clique > cross, (in_clique, cross)
+
+    def test_save_load(self, tmp_path):
+        g = _two_cliques(4)
+        dw = DeepWalk(vector_size=8, walk_length=8, epochs=1, batch_size=128)
+        dw.fit(g)
+        p = os.path.join(tmp_path, "dw.txt")
+        dw.save(p)
+        wv = DeepWalk.load(p, g)
+        np.testing.assert_allclose(wv.get_word_vector("3"),
+                                   dw.get_vertex_vector(3), atol=1e-5)
+
+
+class TestKMeans:
+    def test_separated_blobs(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal((0, 0), 0.2, (50, 2))
+        b = rng.normal((5, 5), 0.2, (50, 2))
+        c = rng.normal((0, 5), 0.2, (50, 2))
+        x = np.concatenate([a, b, c])
+        km = KMeansClustering(k=3, seed=4).fit(x)
+        labels = km.predict(x)
+        # each blob maps to exactly one cluster
+        for blob in (labels[:50], labels[50:100], labels[100:]):
+            assert len(set(blob.tolist())) == 1
+        assert len({labels[0], labels[50], labels[100]}) == 3
+
+    def test_cosine_distance(self):
+        x = np.array([[1, 0], [2, 0], [0, 1], [0, 3.0]])
+        km = KMeansClustering(k=2, distance="cosine", seed=1).fit(x)
+        l = km.predict(x)
+        assert l[0] == l[1] and l[2] == l[3] and l[0] != l[2]
+
+    def test_k_larger_than_n_raises(self):
+        with np.testing.assert_raises(ValueError):
+            KMeansClustering(k=5).fit(np.zeros((3, 2)))
+
+
+class TestKDTree:
+    def test_nn_matches_brute_force(self):
+        rng = np.random.default_rng(1)
+        pts = rng.standard_normal((200, 3))
+        tree = KDTree(pts)
+        for _ in range(20):
+            q = rng.standard_normal(3)
+            i, d = tree.nn(q)
+            bi = int(np.argmin(np.linalg.norm(pts - q, axis=1)))
+            assert i == bi
+
+    def test_knn_sorted(self):
+        rng = np.random.default_rng(2)
+        pts = rng.standard_normal((100, 2))
+        tree = KDTree(pts)
+        q = np.zeros(2)
+        res = tree.knn(q, 5)
+        dists = [d for _, d in res]
+        assert dists == sorted(dists)
+        brute = np.sort(np.linalg.norm(pts - q, axis=1))[:5]
+        np.testing.assert_allclose(dists, brute, rtol=1e-9)
+
+
+class TestTSNE:
+    def test_blobs_stay_separated(self):
+        rng = np.random.default_rng(3)
+        a = rng.normal(0, 0.3, (30, 10))
+        b = rng.normal(4, 0.3, (30, 10))
+        x = np.concatenate([a, b])
+        emb = TSNE(perplexity=10, n_iter=300, seed=5).fit_transform(x)
+        assert emb.shape == (60, 2)
+        ca, cb = emb[:30].mean(0), emb[30:].mean(0)
+        spread = max(emb[:30].std(), emb[30:].std())
+        assert np.linalg.norm(ca - cb) > 2 * spread

@@ -1,0 +1,234 @@
+"""NLP vertical tests — ports of the reference's word2vec sanity tests
+(nearest neighbors of trained vectors), tokenizer unit tests, serializer
+round-trips (SURVEY.md §4 NLP row).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.bagofwords import BagOfWordsVectorizer, TfidfVectorizer
+from deeplearning4j_tpu.models.embeddings.serializer import (
+    read_full_model,
+    read_word_vectors,
+    read_word_vectors_binary,
+    write_full_model,
+    write_word_vectors,
+    write_word_vectors_binary,
+)
+from deeplearning4j_tpu.models.glove import Glove
+from deeplearning4j_tpu.models.paragraphvectors import ParagraphVectors
+from deeplearning4j_tpu.models.word2vec import Huffman, VocabCache, Word2Vec
+from deeplearning4j_tpu.text.sentenceiterator import (
+    CollectionSentenceIterator,
+    LineSentenceIterator,
+)
+from deeplearning4j_tpu.text.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizer,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+
+
+def _toy_corpus(n_repeats=200, seed=0):
+    """Two topic clusters: fruit words co-occur, vehicle words co-occur."""
+    rng = np.random.default_rng(seed)
+    fruit = ["apple", "banana", "cherry", "mango"]
+    vehicle = ["car", "truck", "bus", "train"]
+    sents = []
+    for _ in range(n_repeats):
+        f = list(rng.permutation(fruit))
+        v = list(rng.permutation(vehicle))
+        sents.append(" ".join(f))
+        sents.append(" ".join(v))
+    return sents
+
+
+def _wide_corpus(n=600, seed=0, words_per_topic=12, sent_len=6):
+    """Larger two-topic corpus (sampled sentences). The 4-word permuted
+    corpus is degenerate for CBOW: every context word in a sentence gets
+    an identical gradient, so only the shared component trains."""
+    rng = np.random.default_rng(seed)
+    ta = [f"a{i}" for i in range(words_per_topic)]
+    tb = [f"b{i}" for i in range(words_per_topic)]
+    return [" ".join(rng.choice(ta if rng.random() < 0.5 else tb,
+                                sent_len, replace=False)) for _ in range(n)]
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        t = DefaultTokenizer("Hello World  foo")
+        assert t.get_tokens() == ["Hello", "World", "foo"]
+
+    def test_common_preprocessor(self):
+        f = DefaultTokenizerFactory(CommonPreprocessor())
+        assert f.create("Hello, World! 123").get_tokens() == ["hello", "world"]
+
+    def test_ngrams(self):
+        f = NGramTokenizerFactory(DefaultTokenizerFactory(), 1, 2)
+        toks = f.create("a b c").get_tokens()
+        assert toks == ["a", "b", "c", "a b", "b c"]
+
+    def test_sentence_iterators(self, tmp_path):
+        ci = CollectionSentenceIterator(["one", "two"])
+        assert list(ci) == ["one", "two"]
+        assert list(ci) == ["one", "two"]  # reset works
+        p = os.path.join(tmp_path, "f.txt")
+        with open(p, "w") as f:
+            f.write("l1\nl2\nl3\n")
+        li = LineSentenceIterator(p)
+        assert list(li) == ["l1", "l2", "l3"]
+
+
+class TestVocabHuffman:
+    def test_vocab_ordering_and_filter(self):
+        vc = VocabCache.build_from_sentences(
+            [["a", "a", "a", "b", "b", "c"]], min_word_frequency=2)
+        assert vc.num_words() == 2
+        assert vc.word_at_index(0) == "a"
+        assert vc.index_of("c") == -1
+
+    def test_huffman_codes_prefix_free(self):
+        vc = VocabCache.build_from_sentences(
+            [["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]])
+        h = Huffman(vc)
+        codes = {}
+        for i in range(vc.num_words()):
+            L = int(h.code_lengths[i])
+            codes[vc.word_at_index(i)] = tuple(h.codes[i, :L].astype(int))
+        # most frequent word gets shortest code
+        assert len(codes["a"]) <= len(codes["d"])
+        # prefix-free
+        cs = list(codes.values())
+        for i, a in enumerate(cs):
+            for j, b in enumerate(cs):
+                if i != j:
+                    assert a != b[:len(a)]
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("kwargs", [
+        dict(negative_sample=5),
+        dict(negative_sample=0, use_hierarchic_softmax=True),
+    ])
+    def test_topic_clusters(self, kwargs):
+        kw = dict(layer_size=24, window_size=3, epochs=12, learning_rate=0.025,
+                  batch_size=128, seed=7)
+        kw.update(kwargs)
+        w2v = Word2Vec(**kw)
+        w2v.fit(_toy_corpus())
+        # in-topic similarity must beat cross-topic
+        in_topic = w2v.similarity("apple", "banana")
+        cross = w2v.similarity("apple", "car")
+        assert in_topic > cross + 0.1, (in_topic, cross, kwargs)
+
+    def test_cbow_topic_clusters(self):
+        w2v = Word2Vec(layer_size=32, window_size=3, epochs=15, learning_rate=0.05,
+                       batch_size=256, seed=7,
+                       elements_learning_algorithm="cbow", negative_sample=5)
+        w2v.fit(_wide_corpus())
+        ins = np.mean([w2v.similarity("a0", x) for x in ["a1", "a2", "a3"]])
+        crs = np.mean([w2v.similarity("a0", x) for x in ["b1", "b2", "b3"]])
+        assert ins > crs + 0.1, (ins, crs)
+
+    def test_words_nearest(self):
+        w2v = Word2Vec(layer_size=24, window_size=3, epochs=15, learning_rate=0.025,
+                       batch_size=128, seed=3)
+        w2v.fit(_toy_corpus())
+        nearest = w2v.words_nearest("apple", 3)
+        assert set(nearest) <= {"banana", "cherry", "mango"}, nearest
+
+
+class TestSerializer:
+    def _small_wv(self):
+        w2v = Word2Vec(layer_size=8, epochs=2, seed=1)
+        w2v.fit(_toy_corpus(30))
+        return w2v
+
+    def test_text_round_trip(self, tmp_path):
+        w2v = self._small_wv()
+        wv = w2v.word_vectors()
+        p = os.path.join(tmp_path, "vec.txt")
+        write_word_vectors(wv, p)
+        wv2 = read_word_vectors(p)
+        np.testing.assert_allclose(wv2.get_word_vector("apple"),
+                                   wv.get_word_vector("apple"), atol=1e-5)
+
+    def test_binary_round_trip(self, tmp_path):
+        w2v = self._small_wv()
+        wv = w2v.word_vectors()
+        p = os.path.join(tmp_path, "vec.bin")
+        write_word_vectors_binary(wv, p)
+        wv2 = read_word_vectors_binary(p)
+        np.testing.assert_allclose(wv2.get_word_vector("truck"),
+                                   wv.get_word_vector("truck"), atol=1e-6)
+
+    def test_full_model_round_trip(self, tmp_path):
+        w2v = self._small_wv()
+        p = os.path.join(tmp_path, "model.zip")
+        write_full_model(w2v, p)
+        w2v2 = read_full_model(p)
+        assert w2v2.vocab.words() == w2v.vocab.words()
+        np.testing.assert_allclose(w2v2.lookup_table.syn0, w2v.lookup_table.syn0)
+
+
+class TestParagraphVectors:
+    def test_doc_labels_cluster(self):
+        docs = []
+        for i in range(40):
+            docs.append(("apple banana cherry mango apple banana", [f"fruit_{i % 2}"]))
+            docs.append(("car truck bus train car truck", [f"vehicle_{i % 2}"]))
+        pv = ParagraphVectors(layer_size=16, epochs=8, learning_rate=0.025,
+                              batch_size=128, seed=2)
+        pv.fit(docs)
+        f0, f1 = pv.get_label_vector("fruit_0"), pv.get_label_vector("fruit_1")
+        v0 = pv.get_label_vector("vehicle_0")
+        cos = lambda a, b: float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+        assert cos(f0, f1) > cos(f0, v0)
+
+    def test_infer_vector_close_to_label(self):
+        rng = np.random.default_rng(1)
+        ta = [f"a{i}" for i in range(12)]
+        tb = [f"b{i}" for i in range(12)]
+        docs = []
+        for _ in range(60):
+            docs.append((" ".join(rng.choice(ta, 6, replace=False)), ["topicA"]))
+            docs.append((" ".join(rng.choice(tb, 6, replace=False)), ["topicB"]))
+        pv = ParagraphVectors(layer_size=24, epochs=10, learning_rate=0.025,
+                              batch_size=128, seed=4)
+        pv.fit(docs)
+        assert pv.predict("a1 a2 a3 a4") == "topicA"
+        assert pv.predict("b1 b2 b3 b4") == "topicB"
+
+
+class TestGlove:
+    def test_loss_decreases_and_clusters(self):
+        g = Glove(layer_size=16, window=3, epochs=20, learning_rate=0.1,
+                  batch_size=2048, seed=5)
+        g.fit(_toy_corpus(100))
+        assert g.loss_history[-1] < g.loss_history[0]
+        assert g.similarity("apple", "banana") > g.similarity("apple", "car")
+
+
+class TestVectorizers:
+    def test_bow_counts(self):
+        v = BagOfWordsVectorizer()
+        v.fit(["a b a", "b c"])
+        vec = v.transform("a a c")
+        assert vec[v.vocab.index_of("a")] == 2
+        assert vec[v.vocab.index_of("c")] == 1
+
+    def test_tfidf_downweights_common(self):
+        v = TfidfVectorizer()
+        v.fit(["common rare1", "common rare2", "common rare3"])
+        vec = v.transform("common rare1")
+        assert vec[v.vocab.index_of("rare1")] > vec[v.vocab.index_of("common")]
+
+    def test_vectorize_to_dataset(self):
+        v = TfidfVectorizer()
+        v.fit(["x y", "z w"])
+        ds = v.vectorize(["x y", "z w"], [0, 1])
+        assert ds.features.shape == (2, 4)
+        assert ds.labels.shape == (2, 2)

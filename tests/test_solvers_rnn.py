@@ -1,0 +1,122 @@
+"""Classic solvers, TBPTT, rnnTimeStep — ports of ``TestOptimizers``,
+``MultiLayerTestRNN.java`` TBPTT equivalence tests (SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iris import load_iris_dataset
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, GravesLSTM, OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.solvers import Solver
+
+
+def _mlp(algo, seed=7):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).optimization_algo(algo).iterations(20)
+            .activation("tanh").learning_rate(0.1).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init(dtype=jnp.float64)
+
+
+class TestClassicSolvers:
+    @pytest.mark.parametrize("algo", ["lbfgs", "conjugate_gradient", "line_gradient_descent"])
+    def test_full_batch_convergence(self, algo):
+        net = _mlp(algo)
+        ds = load_iris_dataset(shuffle_seed=4)
+        s0 = net.score(ds)
+        f = Solver(net).optimize(ds, iterations=25)
+        assert f < s0 / 2, f"{algo}: {s0} -> {f}"
+        acc = float(np.mean(net.predict(ds.features) == np.argmax(ds.labels, axis=1)))
+        assert acc > 0.9, f"{algo}: acc {acc}"
+
+    def test_lbfgs_beats_plain_gd_on_same_budget(self):
+        ds = load_iris_dataset(shuffle_seed=4)
+        a = _mlp("lbfgs")
+        fa = Solver(a).optimize(ds, iterations=15)
+        b = _mlp("line_gradient_descent")
+        fb = Solver(b).optimize(ds, iterations=15)
+        assert fa <= fb * 1.2  # lbfgs at least competitive
+
+
+class TestTBPTT:
+    def _seq_conf(self, backprop_type="standard", tbptt_len=5):
+        b = (NeuralNetConfiguration.builder()
+             .seed(3).learning_rate(0.05).updater("adam").activation("tanh")
+             .list()
+             .layer(GravesLSTM(n_in=2, n_out=8))
+             .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss_function="mcxent")))
+        b = b.backprop_type(backprop_type)
+        b = b.t_bptt_forward_length(tbptt_len).t_bptt_backward_length(tbptt_len)
+        return b.build()
+
+    def test_tbptt_trains_long_sequence(self):
+        rng = np.random.default_rng(0)
+        B, T = 8, 20
+        x = np.zeros((B, T, 2), np.float32)
+        bits = rng.integers(0, 2, (B, T))
+        x[np.arange(B)[:, None], np.arange(T)[None, :], bits] = 1
+        y = x.copy()
+        net = MultiLayerNetwork(self._seq_conf("truncated_bptt", 5)).init()
+        ds = DataSet(x, y)
+        net.fit(ds)
+        s0 = net.score()
+        for _ in range(40):
+            net.fit(ds)
+        assert net.score() < s0 / 2
+
+    def test_tbptt_single_chunk_equals_standard(self):
+        """T <= tbptt length -> identical to standard backprop."""
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 5, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 5))]
+        a = MultiLayerNetwork(self._seq_conf("standard")).init()
+        b = MultiLayerNetwork(self._seq_conf("truncated_bptt", 10)).init()
+        for _ in range(3):
+            a.fit(DataSet(x, y))
+            b.fit(DataSet(x, y))
+        np.testing.assert_allclose(a.params_flat(), b.params_flat(), rtol=1e-6)
+
+
+class TestRnnTimeStep:
+    def test_stream_matches_full_forward(self):
+        rng = np.random.default_rng(2)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(5).activation("tanh").list()
+                .layer(GravesLSTM(n_in=3, n_out=6))
+                .layer(RnnOutputLayer(n_in=6, n_out=2, activation="softmax",
+                                      loss_function="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = rng.standard_normal((2, 7, 3)).astype(np.float32)
+        full = net.output(x)
+        net.rnn_clear_previous_state()
+        step_outs = [net.rnn_time_step(x[:, t]) for t in range(7)]
+        for t in range(7):
+            np.testing.assert_allclose(step_outs[t], full[:, t], rtol=1e-4, atol=1e-6)
+        # burst API
+        net.rnn_clear_previous_state()
+        burst = net.rnn_time_step(x)
+        np.testing.assert_allclose(burst, full, rtol=1e-4, atol=1e-6)
+
+    def test_state_persists_across_calls(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(5).activation("tanh").list()
+                .layer(GravesLSTM(n_in=2, n_out=4))
+                .layer(RnnOutputLayer(n_in=4, n_out=2, activation="softmax",
+                                      loss_function="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(3).standard_normal((1, 2)).astype(np.float32)
+        o1 = net.rnn_time_step(x)
+        o2 = net.rnn_time_step(x)  # same input, different state -> different out
+        assert not np.allclose(o1, o2)
+        net.rnn_clear_previous_state()
+        o3 = net.rnn_time_step(x)
+        np.testing.assert_allclose(o1, o3, rtol=1e-6)
