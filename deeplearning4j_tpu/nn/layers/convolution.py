@@ -78,7 +78,18 @@ class SubsamplingImpl(LayerImpl):
         elif pt in (L.PoolingType.AVG, L.PoolingType.SUM):
             out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
             if pt == L.PoolingType.AVG:
-                out = out / (kh * kw)
+                if ph or pw:
+                    # true per-window cell count so padded border zeros
+                    # don't bias averages low (count-include-pad=False).
+                    # Deliberate deviation from the reference's im2col
+                    # averaging (zero-filled windows / kh*kw), which
+                    # undercounts borders — advisor-directed (ADVICE r1)
+                    ones = jnp.ones((1,) + x.shape[1:3] + (1,), x.dtype)
+                    cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                                window, strides, pads)
+                    out = out / cnt
+                else:
+                    out = out / (kh * kw)
         elif pt == L.PoolingType.PNORM:
             p = float(c.pnorm)
             out = jax.lax.reduce_window(jnp.abs(x) ** p, 0.0, jax.lax.add, window, strides, pads)

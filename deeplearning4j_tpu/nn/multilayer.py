@@ -208,6 +208,11 @@ class MultiLayerNetwork:
         T = ds.features.shape[1]
         L = self.conf.tbptt_fwd_length
         b = ds.features.shape[0]
+        if ds.labels.ndim != 3:
+            raise ValueError(
+                f"TBPTT requires per-timestep labels [batch, T, nOut]; got "
+                f"shape {ds.labels.shape}. For sequence-level (2-D) labels "
+                f"use standard BPTT (backprop_type='standard').")
         rec = self._recurrent_impls()
         if not rec:
             raise ValueError("TBPTT configured but no recurrent layers present")

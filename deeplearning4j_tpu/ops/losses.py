@@ -92,6 +92,7 @@ def compute_loss(
     predictions: jnp.ndarray,
     mask: Optional[jnp.ndarray] = None,
     from_logits: bool = False,
+    reduction: str = "mean",
 ) -> jnp.ndarray:
     """Masked mean-over-examples loss (scalar).
 
@@ -104,6 +105,14 @@ def compute_loss(
     MCXENT/NLL and sigmoid-BCE-with-logits for XENT — numerically stable
     and what XLA fuses best; gradient-check tests verify it matches the
     activate-then-score reference semantics.
+
+    Reduction semantics for [b, T, nOut] sequences: the default
+    ``reduction="mean"`` averages over all b*T timesteps (or the mask
+    count), which keeps the score scale independent of sequence length.
+    The reference (``BaseOutputLayer.computeScore``) instead divides the
+    summed sequence loss by minibatch size b only, so its RNN scores and
+    effective learning rates scale with T; pass ``reduction="batch"`` to
+    reproduce that behavior when matching reference configs exactly.
     """
     f = LossFunction(name)
     if from_logits and f in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
@@ -115,6 +124,14 @@ def compute_loss(
     else:
         per_ex = _per_example(f, labels, predictions)
 
+    if reduction == "batch":
+        # reference semantics: sum everything, divide by minibatch size
+        batch = per_ex.shape[0]
+        if mask is not None:
+            per_ex = per_ex * mask.astype(per_ex.dtype)
+        return jnp.sum(per_ex) / batch
+    if reduction != "mean":
+        raise ValueError(f"unknown reduction {reduction!r} (use 'mean' or 'batch')")
     if mask is not None:
         mask = mask.astype(per_ex.dtype)
         total = jnp.sum(per_ex * mask)

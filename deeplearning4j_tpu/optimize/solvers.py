@@ -36,20 +36,27 @@ class BackTrackLineSearch:
         self.initial_step = initial_step
 
     def optimize(self, loss_fn, x: np.ndarray, direction: np.ndarray,
-                 f0: float, g0: np.ndarray) -> Tuple[float, float]:
-        """Returns (step, f_new)."""
+                 f0: float, g0: np.ndarray) -> Tuple[float, float, np.ndarray]:
+        """Returns (step, f_new, direction_used) — callers MUST step along
+        the returned direction, which differs from the input when the
+        input was not a descent direction and -grad was substituted."""
         slope = float(np.dot(g0, direction))
         if slope >= 0:  # not a descent direction — fall back to -grad
             direction = -g0
             slope = float(np.dot(g0, direction))
         step = self.initial_step
-        f_new = f0
-        for _ in range(self.max_iterations):
+        for i in range(self.max_iterations):
             f_new = float(loss_fn(x + step * direction))
             if np.isfinite(f_new) and f_new <= f0 + self.c1 * step * slope:
-                return step, f_new
-            step *= self.shrink
-        return step, f_new
+                return step, f_new, direction
+            if i < self.max_iterations - 1:
+                step *= self.shrink
+        # Armijo never satisfied: (step, f_new) are the last pair actually
+        # evaluated, so caller state stays consistent; if even that eval
+        # was non-finite, report zero movement at the starting loss.
+        if not np.isfinite(f_new):
+            return 0.0, f0, direction
+        return step, f_new, direction
 
 
 class _FlatOracle:
@@ -85,8 +92,8 @@ def line_gradient_descent(oracle: _FlatOracle, iterations: int) -> Tuple[np.ndar
     for _ in range(iterations):
         f, g = oracle.value_and_grad(jnp.asarray(x))
         f, g = float(f), np.asarray(g)
-        step, f = ls.optimize(oracle.loss, x, -g, f, g)
-        x = x - step * g
+        step, f, d = ls.optimize(oracle.loss, x, -g, f, g)
+        x = x + step * d
     return x, f
 
 
@@ -98,7 +105,7 @@ def conjugate_gradient(oracle: _FlatOracle, iterations: int) -> Tuple[np.ndarray
     f, g = float(f), np.asarray(g)
     d = -g
     for _ in range(iterations):
-        step, f = ls.optimize(oracle.loss, x, d, f, g)
+        step, f, d = ls.optimize(oracle.loss, x, d, f, g)
         x = x + step * d
         f_new, g_new = oracle.value_and_grad(jnp.asarray(x))
         f, g_new = float(f_new), np.asarray(g_new)
@@ -131,7 +138,7 @@ def lbfgs(oracle: _FlatOracle, iterations: int, memory: int = 10) -> Tuple[np.nd
             b = rho * float(np.dot(y, q))
             q += (a - b) * s
         d = -q
-        step, f = ls.optimize(oracle.loss, x, d, f, g)
+        step, f, d = ls.optimize(oracle.loss, x, d, f, g)
         x_new = x + step * d
         f_new, g_new = oracle.value_and_grad(jnp.asarray(x_new))
         f_new, g_new = float(f_new), np.asarray(g_new)

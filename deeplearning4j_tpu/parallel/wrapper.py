@@ -24,6 +24,7 @@ collapse onto the mesh:
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import jax
@@ -129,7 +130,14 @@ class ParallelWrapper:
             n = ds.num_examples()
             per = n // W
             if per == 0:
+                warnings.warn(
+                    f"averaging mode skipped a {n}-example minibatch entirely "
+                    f"(fewer examples than {W} workers)")
                 continue
+            if per * W < n:
+                warnings.warn(
+                    f"averaging mode drops {n - per * W} tail examples of a "
+                    f"{n}-example minibatch (not divisible by {W} workers)")
             x = np.asarray(ds.features[:per * W], m._dtype).reshape((W, per) + ds.features.shape[1:])
             y = np.asarray(ds.labels[:per * W], m._dtype).reshape((W, per) + ds.labels.shape[1:])
             x, y = self.ctx.shard_batch(x, y)
