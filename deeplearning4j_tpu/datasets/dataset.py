@@ -68,3 +68,21 @@ class MultiDataSet:
 
     def num_examples(self) -> int:
         return int(self.features[0].shape[0])
+
+    def __getitem__(self, idx) -> "MultiDataSet":
+        sl = lambda arrs: None if arrs is None else [
+            None if a is None else a[idx] for a in arrs]
+        return MultiDataSet(
+            features=[f[idx] for f in self.features],
+            labels=[l[idx] for l in self.labels],
+            features_masks=sl(self.features_masks),
+            labels_masks=sl(self.labels_masks))
+
+    def batch_by(self, batch_size: int) -> List["MultiDataSet"]:
+        n = self.num_examples()
+        return [self[i:i + batch_size] for i in range(0, n, batch_size)]
+
+    def shuffle(self, seed: Optional[int] = None) -> "MultiDataSet":
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self.num_examples())
+        return self[perm]

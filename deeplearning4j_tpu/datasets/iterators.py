@@ -17,7 +17,7 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 
 
 class DataSetIterator:
@@ -49,10 +49,11 @@ class DataSetIterator:
         return True
 
 
-class ListDataSetIterator(DataSetIterator):
-    """``ListDataSetIterator`` — minibatches from an in-memory DataSet."""
+class _ListBatchCore:
+    """Shared minibatch-slicing engine for in-memory datasets; payload
+    type only needs ``num_examples()`` and ``__getitem__``."""
 
-    def __init__(self, data: DataSet, batch_size: int = 32, shuffle: bool = False, seed: int = 0):
+    def __init__(self, data, batch_size: int = 32, shuffle: bool = False, seed: int = 0):
         self._data = data
         self._batch = batch_size
         self._shuffle = shuffle
@@ -82,6 +83,10 @@ class ListDataSetIterator(DataSetIterator):
 
     def total_examples(self):
         return self._data.num_examples()
+
+
+class ListDataSetIterator(_ListBatchCore, DataSetIterator):
+    """``ListDataSetIterator`` — minibatches from an in-memory DataSet."""
 
 
 class AsyncDataSetIterator(DataSetIterator):
@@ -216,3 +221,38 @@ class SamplingDataSetIterator(DataSetIterator):
 
     def batch(self):
         return self._batch
+
+
+class MultiDataSetIterator:
+    """Iterator over MultiDataSet minibatches (``MultiDataSetIterator``
+    contract — the ComputationGraph feed,
+    ``AsyncMultiDataSetIterator.java`` async role is played by wrapping
+    in ``AsyncDataSetIterator``, which is payload-agnostic)."""
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> MultiDataSet:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    def async_supported(self) -> bool:
+        return True
+
+
+class ListMultiDataSetIterator(_ListBatchCore, MultiDataSetIterator):
+    """Minibatches from an in-memory MultiDataSet."""

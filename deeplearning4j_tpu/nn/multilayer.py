@@ -35,6 +35,7 @@ from deeplearning4j_tpu.nn.updater import (
     init_updater_state,
     normalize_gradient,
 )
+from deeplearning4j_tpu.util.dtypes import cast_floats, cast_like, resolve_compute_dtype
 
 Params = Dict[str, Dict[str, jnp.ndarray]]
 
@@ -55,6 +56,10 @@ class MultiLayerNetwork:
         self.listeners: List[Callable[["MultiLayerNetwork", int, float], None]] = []
         self._score: float = float("nan")
         self._dtype = jnp.float32
+        self._pretrained = False
+        # mixed precision: params/opt/state stay f32, layer compute in
+        # gc.compute_dtype, loss in f32 (util/dtypes.py policy)
+        self._cd = resolve_compute_dtype(self.gc.compute_dtype)
         self._jits: Dict[Any, Callable] = {}
 
     # ------------------------------------------------------------------ init
@@ -77,6 +82,7 @@ class MultiLayerNetwork:
             upd[impl.name] = {n: init_updater_state(ucfg, v) for n, v in p.items()}
         self.opt_state = {"step": jnp.zeros((), jnp.int32), "updater": upd}
         self._jits = {}
+        self._pretrained = False
         return self
 
     def set_listeners(self, *listeners) -> None:
@@ -88,12 +94,24 @@ class MultiLayerNetwork:
         """All-layer forward; returns (activations per layer, new states)."""
         acts = []
         new_states = {}
+        n_last = len(self.impls) - 1
+        if self._cd is not None:
+            x = x.astype(self._cd)
         for i, impl in enumerate(self.impls):
             pre = self.conf.input_preprocessors.get(i)
             if pre is not None:
                 x = pre(x)
+            p = params[impl.name]
+            if self._cd is not None:
+                if i == n_last and impl.has_loss():
+                    # output head always runs f32 (stable softmax/loss)
+                    x = x.astype(jnp.float32)
+                else:
+                    p = cast_floats(p, self._cd)
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            x, ns = impl.forward(params[impl.name], x, states[impl.name], train, lrng, mask=fmask)
+            x, ns = impl.forward(p, x, states[impl.name], train, lrng, mask=fmask)
+            if self._cd is not None:
+                ns = cast_like(ns, states[impl.name])
             new_states[impl.name] = ns
             acts.append(x)
         return acts, new_states
@@ -102,17 +120,26 @@ class MultiLayerNetwork:
         """Data loss (output layer) + L1/L2 penalties — the quantity
         ``computeGradientAndScore`` minimizes (SURVEY.md §3.1)."""
         new_states = {}
+        if self._cd is not None:
+            x = x.astype(self._cd)
         for i, impl in enumerate(self.impls[:-1]):
             pre = self.conf.input_preprocessors.get(i)
             if pre is not None:
                 x = pre(x)
+            p = params[impl.name]
+            if self._cd is not None:
+                p = cast_floats(p, self._cd)
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            x, ns = impl.forward(params[impl.name], x, states[impl.name], train, lrng, mask=fmask)
+            x, ns = impl.forward(p, x, states[impl.name], train, lrng, mask=fmask)
+            if self._cd is not None:
+                ns = cast_like(ns, states[impl.name])
             new_states[impl.name] = ns
         i_out = len(self.impls) - 1
         pre = self.conf.input_preprocessors.get(i_out)
         if pre is not None:
             x = pre(x)
+        if self._cd is not None:
+            x = x.astype(jnp.float32)  # loss always f32
         lrng = jax.random.fold_in(rng, i_out) if rng is not None else None
         score = self.out.score(params[self.out.name], x, y, states[self.out.name], train, lrng, mask=lmask)
         new_states[self.out.name] = states[self.out.name]
@@ -182,6 +209,11 @@ class MultiLayerNetwork:
             self.init()
         if isinstance(data, np.ndarray) or isinstance(data, jnp.ndarray):
             data = DataSet(np.asarray(data), np.asarray(labels))
+        if self.conf.pretrain and not self._pretrained:
+            # layer-wise unsupervised phase before supervised backprop
+            # (fit :1037 → pretrain :163 when conf.pretrain)
+            self.pretrain(data, batch_size=batch_size)
+            self._pretrained = True
         if isinstance(data, DataSet):
             if batch_size is not None:
                 data = ListDataSetIterator(data, batch_size)
@@ -193,6 +225,78 @@ class MultiLayerNetwork:
             it = AsyncDataSetIterator(it)
         for ds in it:
             self._fit_batch(ds)
+
+    # ------------------------------------------------------------- pretrain
+
+    def _make_pretrain_step(self, i: int):
+        """Compiled greedy-pretraining step for layer i: forward the frozen
+        stack below it (inference mode), then one unsupervised update of
+        layer i only — CD-k for RBM (supplied gradients), jax.grad of the
+        reconstruction loss for AutoEncoder. One XLA program either way."""
+        impl = self.impls[i]
+        ucfg = self.gc.updater_config_for(impl.conf)
+        use_cd = hasattr(impl, "cd_gradients")
+
+        def step(params, ustate, it, states, x, rng_key):
+            rng = jax.random.fold_in(rng_key, it)
+            for j in range(i):
+                pre = self.conf.input_preprocessors.get(j)
+                if pre is not None:
+                    x = pre(x)
+                x, _ = self.impls[j].forward(params[self.impls[j].name], x,
+                                             states[self.impls[j].name], False, None)
+            pre = self.conf.input_preprocessors.get(i)
+            if pre is not None:
+                x = pre(x)
+            p_i = params[impl.name]
+            if use_cd:
+                g, loss = impl.cd_gradients(p_i, x, rng)
+            else:
+                loss, g = jax.value_and_grad(
+                    lambda p: impl.pretrain_loss(p, x, rng))(p_i)
+            new_p, new_u = {}, {}
+            for pname, gval in g.items():
+                u, ust = apply_updater(ucfg, gval, ustate[pname], it)
+                new_p[pname] = p_i[pname] - u.astype(p_i[pname].dtype)
+                new_u[pname] = ust
+            return new_p, new_u, it + 1, loss
+
+        return jax.jit(step)
+
+    def pretrain(self, data: Union[DataSet, DataSetIterator],
+                 epochs: int = 1, batch_size: Optional[int] = None) -> Dict[str, float]:
+        """Layer-wise greedy unsupervised pretraining
+        (``MultiLayerNetwork.pretrain(iter)`` :163, reached from fit :1037
+        when ``conf.pretrain``): for each RBM/AutoEncoder layer in order,
+        train it on the frozen activations of the layers below in
+        minibatches, then move on. Returns the final pretrain loss per
+        trained layer."""
+        if self.params is None:
+            self.init()
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator(data, batch_size or 32)
+        losses: Dict[str, float] = {}
+        for i, impl in enumerate(self.impls):
+            if not hasattr(impl, "pretrain_loss"):
+                continue
+            step = self._make_pretrain_step(i)
+            ucfg = self.gc.updater_config_for(impl.conf)
+            ustate = {n: init_updater_state(ucfg, v)
+                      for n, v in self.params[impl.name].items()}
+            it = jnp.zeros((), jnp.int32)
+            rng_key = jax.random.PRNGKey(self.gc.seed + 104729 * (i + 1))
+            loss = float("nan")
+            for _ in range(max(1, epochs)):
+                for ds in data:
+                    new_p, ustate, it, loss = step(
+                        self.params, ustate, it, self.states,
+                        jnp.asarray(ds.features, self._dtype), rng_key)
+                    self.params = {**self.params, impl.name: new_p}
+            losses[impl.name] = float(loss)
+            self._score = float(loss)
+            for cb in self.listeners:
+                cb(self, int(it), self._score)
+        return losses
 
     # --------------------------------------------------------------- tbptt
 

@@ -1,0 +1,57 @@
+"""Mixed-precision policy helpers.
+
+The policy (SURVEY.md §0 north star: bf16 keeps the MXU fed):
+parameters, updater state, and layer states (BN moving stats, LSTM
+TBPTT carries) live in float32; the layer compute — matmuls, convs,
+scans — runs in the configured compute dtype (``bfloat16`` on TPU);
+the output layer's score/loss is always evaluated in float32 on
+float32-cast inputs. Gradients come out in float32 because the
+param→bf16 casts happen inside the traced function (the cast's
+transpose casts back), which is the standard mixed-precision recipe.
+
+The reference has no counterpart (ND4J is float-typed per buffer,
+``Nd4j.create`` defaults); this is a TPU-first extension exposed as
+``NeuralNetConfiguration.builder().compute_dtype("bfloat16")``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def resolve_compute_dtype(name: str) -> Optional[Any]:
+    """Config string → cast target; None means "no casting" (float32
+    params already are the compute dtype, zero-overhead path)."""
+    if name in ("float32", "f32", None, ""):
+        return None
+    if name in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    if name in ("float16", "f16"):
+        return jnp.float16
+    raise ValueError(f"unknown compute_dtype {name!r}")
+
+
+def cast_floats(tree: Any, dtype) -> Any:
+    """Cast every floating leaf to ``dtype`` (ints/bools untouched)."""
+    def cast(v):
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+            return v.astype(dtype)
+        return v
+    return jax.tree.map(cast, tree)
+
+
+def cast_like(new_tree: Any, old_tree: Any) -> Any:
+    """Cast ``new_tree`` leaves back to the dtypes of ``old_tree`` —
+    keeps carried state (lax.scan carries in fit_scan) dtype-stable
+    across steps regardless of the compute dtype."""
+    def cast(n, o):
+        if (hasattr(n, "dtype") and hasattr(o, "dtype")
+                and n.dtype != o.dtype
+                and jnp.issubdtype(n.dtype, jnp.floating)
+                and jnp.issubdtype(o.dtype, jnp.floating)):
+            return n.astype(o.dtype)
+        return n
+    return jax.tree.map(cast, new_tree, old_tree)

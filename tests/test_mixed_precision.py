@@ -1,0 +1,75 @@
+"""Mixed-precision (bf16 compute / f32 params) policy tests.
+
+TPU-first extension (no reference counterpart — ND4J buffers are
+singly-typed): ``compute_dtype("bfloat16")`` casts layer compute to
+bf16 inside the traced step while parameters, updater state, layer
+states, and the loss stay float32 (util/dtypes.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer, GravesLSTM, OutputLayer, RnnOutputLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _mlp_conf(cd):
+    return (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1).updater("sgd").activation("relu")
+            .compute_dtype(cd)
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+
+
+def test_bf16_trains_and_keeps_f32_params(rng):
+    x = rng.standard_normal((24, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 24)]
+    net = MultiLayerNetwork(_mlp_conf("bfloat16")).init()
+    ds = DataSet(x, y)
+    net.fit(ds)
+    s0 = net.score()
+    for _ in range(25):
+        net.fit(ds)
+    assert net.score() < s0
+    for leaf in jax.tree.leaves(net.params):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree.leaves(net.states):
+        assert leaf.dtype == jnp.float32
+
+
+def test_bf16_close_to_f32_single_step(rng):
+    # one SGD step in bf16 stays within bf16 tolerance of the f32 step
+    x = rng.standard_normal((16, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    nets = {cd: MultiLayerNetwork(_mlp_conf(cd)).init() for cd in ("float32", "bfloat16")}
+    for net in nets.values():
+        net.fit(DataSet(x, y))
+    w32 = np.asarray(nets["float32"].params["layer0"]["W"], np.float32)
+    w16 = np.asarray(nets["bfloat16"].params["layer0"]["W"], np.float32)
+    np.testing.assert_allclose(w16, w32, atol=5e-2, rtol=5e-2)
+
+
+def test_bf16_lstm_fit_scan(rng):
+    # scan-carried states must stay dtype-stable under the cast policy
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.05).updater("adam").activation("tanh")
+            .compute_dtype("bfloat16")
+            .list()
+            .layer(GravesLSTM(n_in=4, n_out=8))
+            .layer(RnnOutputLayer(n_in=8, n_out=2, activation="softmax",
+                                  loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.standard_normal((8, 5, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (8, 5))]
+    scores = net.fit_scan(DataSet(x, y), 4, epochs=2)
+    assert np.isfinite(np.asarray(scores)).all()
+    for leaf in jax.tree.leaves(net.params):
+        assert leaf.dtype == jnp.float32
