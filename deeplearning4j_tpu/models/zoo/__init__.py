@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.models.zoo.resnet import resnet50  # noqa: F401

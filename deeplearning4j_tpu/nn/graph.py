@@ -25,6 +25,12 @@ import numpy as np
 
 import deeplearning4j_tpu.nn.layers  # noqa: F401  (registers layer impls)
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ListMultiDataSetIterator,
+    MultiDataSetIterator,
+)
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.graph import GraphVertex, vertex_from_dict
@@ -36,6 +42,7 @@ from deeplearning4j_tpu.nn.updater import (
     init_updater_state,
     normalize_gradient,
 )
+from deeplearning4j_tpu.util.dtypes import cast_floats, cast_like, resolve_compute_dtype
 
 
 @dataclasses.dataclass
@@ -52,6 +59,10 @@ class ComputationGraphConfiguration:
     conf: NeuralNetConfiguration
     vertices: List[VertexDef]
     outputs: List[str]
+    pretrain: bool = False
+    backprop_type: str = "standard"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
 
     class GraphBuilder:
         """``ComputationGraphConfiguration.GraphBuilder`` fluent API."""
@@ -60,6 +71,26 @@ class ComputationGraphConfiguration:
             self._conf = conf or NeuralNetConfiguration()
             self._vertices: List[VertexDef] = []
             self._outputs: List[str] = []
+            self._pretrain = False
+            self._backprop_type = "standard"
+            self._tbptt_fwd = 20
+            self._tbptt_back = 20
+
+        def pretrain(self, flag: bool):
+            self._pretrain = flag
+            return self
+
+        def backprop_type(self, t: str):
+            self._backprop_type = t
+            return self
+
+        def t_bptt_forward_length(self, n: int):
+            self._tbptt_fwd = n
+            return self
+
+        def t_bptt_backward_length(self, n: int):
+            self._tbptt_back = n
+            return self
 
         def add_inputs(self, *names: str) -> "ComputationGraphConfiguration.GraphBuilder":
             for n in names:
@@ -82,7 +113,10 @@ class ComputationGraphConfiguration:
             import copy
             return ComputationGraphConfiguration(
                 conf=self._conf, vertices=copy.deepcopy(self._vertices),
-                outputs=list(self._outputs))
+                outputs=list(self._outputs), pretrain=self._pretrain,
+                backprop_type=self._backprop_type,
+                tbptt_fwd_length=self._tbptt_fwd,
+                tbptt_back_length=self._tbptt_back)
 
     @staticmethod
     def builder(conf: Optional[NeuralNetConfiguration] = None):
@@ -103,6 +137,10 @@ class ComputationGraphConfiguration:
             "conf": self.conf.to_dict(),
             "vertices": [vd(v) for v in self.vertices],
             "outputs": self.outputs,
+            "pretrain": self.pretrain,
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
         }, indent=2)
 
     @staticmethod
@@ -115,7 +153,11 @@ class ComputationGraphConfiguration:
         ) for v in d["vertices"]]
         return ComputationGraphConfiguration(
             conf=NeuralNetConfiguration.from_dict(d["conf"]),
-            vertices=verts, outputs=d["outputs"])
+            vertices=verts, outputs=d["outputs"],
+            pretrain=d.get("pretrain", False),
+            backprop_type=d.get("backprop_type", "standard"),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20))
 
 
 def topological_order(vertices: Sequence[VertexDef]) -> List[str]:
@@ -171,6 +213,10 @@ class ComputationGraph:
         self.listeners: List[Callable] = []
         self._score = float("nan")
         self._dtype = jnp.float32
+        self._pretrained = False
+        # mixed precision: same policy as MultiLayerNetwork
+        # (util/dtypes.py — bf16 vertex compute, f32 params/states/loss)
+        self._cd = resolve_compute_dtype(self.gc.compute_dtype)
         self._jits: Dict[Any, Callable] = {}
 
     # ------------------------------------------------------------------ init
@@ -190,6 +236,7 @@ class ComputationGraph:
             upd[name] = {n: init_updater_state(ucfg, v) for n, v in p.items()}
         self.opt_state = {"step": jnp.zeros((), jnp.int32), "updater": upd}
         self._jits = {}
+        self._pretrained = False
         return self
 
     def set_listeners(self, *listeners):
@@ -205,14 +252,23 @@ class ComputationGraph:
         for vi, name in enumerate(self.order):
             v = self.defs[name]
             if v.kind == "input":
-                acts[name] = inputs[name]
+                x_in = inputs[name]
+                acts[name] = x_in.astype(self._cd) if self._cd is not None else x_in
                 masks[name] = fmasks.get(name)
             elif v.kind == "layer":
                 impl = self.impls[name]
                 x = acts[v.inputs[0]]
                 m = masks[v.inputs[0]]
+                p = params[name]
+                if self._cd is not None:
+                    if impl.has_loss():
+                        x = x.astype(jnp.float32)  # output heads run f32
+                    else:
+                        p = cast_floats(p, self._cd)
                 lrng = jax.random.fold_in(rng, vi) if rng is not None else None
-                out, ns = impl.forward(params[name], x, states[name], train, lrng, mask=m)
+                out, ns = impl.forward(p, x, states[name], train, lrng, mask=m)
+                if self._cd is not None:
+                    ns = cast_like(ns, states[name])
                 acts[name] = out
                 new_states[name] = ns
                 # rnn layers preserve mask; pooling over time consumes it
@@ -234,6 +290,8 @@ class ComputationGraph:
             v = self.defs[name]
             impl = self.impls[name]
             x = acts[v.inputs[0]]
+            if self._cd is not None:
+                x = x.astype(jnp.float32)  # loss always f32
             lrng = jax.random.fold_in(rng, 10_000 + vi) if rng is not None else None
             lmask = lmasks.get(name) if lmasks else None
             s = impl.score(params[name], x, labels[name], states[name], train, lrng, mask=lmask)
@@ -299,23 +357,285 @@ class ComputationGraph:
                     lmasks[n] = jnp.asarray(m, self._dtype)
         return inputs, labels, fmasks, lmasks
 
-    def fit(self, data: Union[DataSet, MultiDataSet], epochs: int = 1) -> None:
-        """``fit(MultiDataSet)`` :677."""
+    def fit(self, data: Union[DataSet, MultiDataSet, DataSetIterator, MultiDataSetIterator],
+            epochs: int = 1, batch_size: Optional[int] = None) -> None:
+        """``fit(MultiDataSet)`` :677 / ``fit(DataSetIterator)`` :621 /
+        ``fit(MultiDataSetIterator)`` :640 — iterators stream minibatches
+        through async prefetch, exactly the MLN doctrine."""
         if self.params is None:
             self.init()
-        mds = self._to_mds(data)
+        if self.conf.pretrain and not self._pretrained:
+            self.pretrain(data, batch_size=batch_size)
+            self._pretrained = True
+        if isinstance(data, (DataSet, MultiDataSet)):
+            if batch_size is not None:
+                mds = self._to_mds(data)
+                data = ListMultiDataSetIterator(mds, batch_size)
+            else:
+                # stage arrays to device ONCE; _tensors' jnp.asarray then
+                # becomes a no-op on every subsequent epoch
+                mds = self._device_mds(self._to_mds(data))
+                for _ in range(epochs):
+                    self._fit_batch(mds)
+                return
+        it = data
+        if it.async_supported():
+            it = AsyncDataSetIterator(it)  # payload-agnostic prefetch
+        for _ in range(epochs):
+            for mds in it:
+                self._fit_batch(self._to_mds(mds))
+
+    def _device_mds(self, mds: MultiDataSet) -> MultiDataSet:
+        dev = lambda a: None if a is None else jnp.asarray(a, self._dtype)
+        devs = lambda arrs: None if arrs is None else [dev(a) for a in arrs]
+        return MultiDataSet(features=[dev(f) for f in mds.features],
+                            labels=[dev(l) for l in mds.labels],
+                            features_masks=devs(mds.features_masks),
+                            labels_masks=devs(mds.labels_masks))
+
+    def _fit_batch(self, mds: MultiDataSet) -> None:
+        feats = mds.features
+        if (self.conf.backprop_type == "truncated_bptt" and feats[0].ndim == 3
+                and feats[0].shape[1] > self.conf.tbptt_fwd_length):
+            self._fit_tbptt(mds)
+            return
+        self._fit_batch_inner(mds)
+
+    def _fit_batch_inner(self, mds: MultiDataSet) -> None:
         if "train" not in self._jits:
             self._jits["train"] = self._make_train_step()
         step = self._jits["train"]
         rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
         inputs, labels, fmasks, lmasks = self._tensors(mds)
+        for _ in range(max(1, self.gc.iterations)):
+            self.params, self.opt_state, self.states, score = step(
+                self.params, self.opt_state, self.states, inputs, labels, fmasks, lmasks, rng_key)
+            self._score = float(score)
+            for cb in self.listeners:
+                cb(self, int(self.opt_state["step"]), self._score)
+
+    # --------------------------------------------------------------- tbptt
+
+    def _recurrent_names(self):
+        from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTMImpl
+        return [n for n, impl in self.impls.items() if isinstance(impl, GravesLSTMImpl)]
+
+    def _fit_tbptt(self, mds: MultiDataSet) -> None:
+        """Truncated BPTT over the DAG (``ComputationGraph`` TBPTT path
+        :887-889): every 3-D features/labels tensor is cut into
+        ``tbptt_fwd_length`` chunks; LSTM carries cross chunk boundaries
+        as data (gradients stop there)."""
+        rec = self._recurrent_names()
+        if not rec:
+            raise ValueError("TBPTT configured but no recurrent layers present")
+        T = mds.features[0].shape[1]
+        Lc = self.conf.tbptt_fwd_length
+        b = mds.features[0].shape[0]
+        if not any(lab.ndim == 3 for lab in mds.labels):
+            # mixed graphs may pair a sequence head (3-D, chunked) with a
+            # static head (2-D, repeated per chunk); but with NO 3-D label
+            # there is nothing to truncate and the config is a mistake
+            raise ValueError(
+                "TBPTT requires at least one per-timestep label [batch, T, "
+                f"nOut]; got shapes {[lab.shape for lab in mds.labels]}")
+        saved = {}
+        for name in rec:
+            saved[name] = self.states[name]
+            n = self.impls[name].conf.n_out
+            self.states[name] = {"h": jnp.zeros((b, n), self._dtype),
+                                 "c": jnp.zeros((b, n), self._dtype)}
+
+        def tslice(arrs, sl):
+            if arrs is None:
+                return None
+            return [None if a is None else (a[:, sl] if a.ndim >= 2 else a)
+                    for a in arrs]
+
+        try:
+            for t0 in range(0, T, Lc):
+                sl = slice(t0, t0 + Lc)
+                chunk = MultiDataSet(
+                    features=[f[:, sl] if f.ndim == 3 else f for f in mds.features],
+                    labels=[l[:, sl] if l.ndim == 3 else l for l in mds.labels],
+                    features_masks=tslice(mds.features_masks, sl),
+                    labels_masks=tslice(mds.labels_masks, sl))
+                self._fit_batch_inner(chunk)
+        finally:
+            for name in rec:
+                self.states[name] = saved[name]
+
+    # ------------------------------------------------- scanned multi-step fit
+
+    def _make_scan_fit(self):
+        """Epoch-as-one-XLA-program over staged minibatches — the DAG
+        analog of MultiLayerNetwork.fit_scan (one host dispatch per
+        epoch; every vertex of every step fused by XLA)."""
+        py_step = self._make_train_step().__wrapped__
+        iters = max(1, self.gc.iterations)
+
+        def epoch(params, opt_state, states, xb, yb, rng_key):
+            def body(carry, batch):
+                p, o, s = carry
+                xs, ys = batch
+                for _ in range(iters):
+                    p, o, s, score = py_step(p, o, s, xs, ys, {}, {}, rng_key)
+                return (p, o, s), score
+
+            (p, o, s), scores = jax.lax.scan(body, (params, opt_state, states), (xb, yb))
+            return p, o, s, scores
+
+        return jax.jit(epoch, donate_argnums=(0, 1, 2))
+
+    def stage_scan(self, data: Union[DataSet, MultiDataSet], batch_size: int):
+        """Stage a dataset on device as scan-ready minibatch stacks — do
+        this ONCE and pass to ``fit_scan(staged=...)`` to avoid paying
+        the host→device transfer per call (the tunnel makes that transfer
+        the dominant cost for image-scale data)."""
+        mds = self._to_mds(data)
+        has_mask = any(m is not None for m in (mds.features_masks or [])) or \
+            any(m is not None for m in (mds.labels_masks or []))
+        if has_mask:
+            raise ValueError("fit_scan does not support masked data; use fit()")
+        n = (mds.num_examples() // batch_size) * batch_size
+        if n == 0:
+            raise ValueError("batch_size larger than dataset")
+        if n != mds.num_examples():
+            import logging
+            logging.getLogger("deeplearning4j_tpu").warning(
+                "fit_scan: dropping %d tail examples (dataset %d %% batch %d)",
+                mds.num_examples() - n, mds.num_examples(), batch_size)
+        stage = lambda a: jnp.asarray(a[:n], self._dtype).reshape(
+            (-1, batch_size) + a.shape[1:])
+        xb = {name: stage(f) for name, f in zip(self.input_names, mds.features)}
+        by_output = dict(zip(self.output_names, mds.labels))
+        yb = {name: stage(by_output[name]) for name in self.loss_outputs}
+        return xb, yb
+
+    def fit_scan(self, data: Optional[Union[DataSet, MultiDataSet]], batch_size: int,
+                 epochs: int = 1, staged=None) -> np.ndarray:
+        """Device-resident multi-step training; returns per-step scores
+        (one host fetch at the end)."""
+        if self.params is None:
+            self.init()
+        xb, yb = staged if staged is not None else self.stage_scan(data, batch_size)
+        if "scan_fit" not in self._jits:
+            self._jits["scan_fit"] = self._make_scan_fit()
+        fit = self._jits["scan_fit"]
+        rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
+        all_scores = []
         for _ in range(epochs):
-            for _ in range(max(1, self.gc.iterations)):
-                self.params, self.opt_state, self.states, score = step(
-                    self.params, self.opt_state, self.states, inputs, labels, fmasks, lmasks, rng_key)
-                self._score = float(score)
-                for cb in self.listeners:
-                    cb(self, int(self.opt_state["step"]), self._score)
+            self.params, self.opt_state, self.states, scores = fit(
+                self.params, self.opt_state, self.states, xb, yb, rng_key)
+            all_scores.append(scores)
+        out = np.asarray(jnp.concatenate(all_scores))
+        self._score = float(out[-1])
+        return out
+
+    # ------------------------------------------------------------- pretrain
+
+    def pretrain(self, data, epochs: int = 1,
+                 batch_size: Optional[int] = None) -> Dict[str, float]:
+        """Layer-wise greedy pretraining over the DAG: each RBM/AE layer
+        vertex trains on the frozen activations of its input subgraph
+        (``ComputationGraph.pretrain`` path)."""
+        if self.params is None:
+            self.init()
+        if isinstance(data, (DataSet, MultiDataSet)):
+            data = ListMultiDataSetIterator(self._to_mds(data), batch_size or 32)
+        losses: Dict[str, float] = {}
+        for vi, name in enumerate(self.order):
+            v = self.defs[name]
+            if v.kind != "layer" or not hasattr(self.impls[name], "pretrain_loss"):
+                continue
+            impl = self.impls[name]
+            ucfg = self.gc.updater_config_for(impl.conf)
+            use_cd = hasattr(impl, "cd_gradients")
+
+            def make_step(name=name, impl=impl, ucfg=ucfg, use_cd=use_cd):
+                def step(params, ustate, it, states, inputs, rng_key):
+                    rng = jax.random.fold_in(rng_key, it)
+                    acts, _, _ = self._forward_all(params, states, inputs, False, None, {})
+                    x = acts[self.defs[name].inputs[0]]
+                    if self._cd is not None:
+                        x = x.astype(jnp.float32)
+                    p_i = params[name]
+                    if use_cd:
+                        g, loss = impl.cd_gradients(p_i, x, rng)
+                    else:
+                        loss, g = jax.value_and_grad(
+                            lambda p: impl.pretrain_loss(p, x, rng))(p_i)
+                    new_p, new_u = {}, {}
+                    for pname, gval in g.items():
+                        u, ust = apply_updater(ucfg, gval, ustate[pname], it)
+                        new_p[pname] = p_i[pname] - u.astype(p_i[pname].dtype)
+                        new_u[pname] = ust
+                    return new_p, new_u, it + 1, loss
+                return jax.jit(step)
+
+            step = make_step()
+            ustate = {n: init_updater_state(ucfg, vv)
+                      for n, vv in self.params[name].items()}
+            it = jnp.zeros((), jnp.int32)
+            rng_key = jax.random.PRNGKey(self.gc.seed + 104729 * (vi + 1))
+            loss = float("nan")
+            for _ in range(max(1, epochs)):
+                for mds in data:
+                    mds = self._to_mds(mds)
+                    inputs = {n: jnp.asarray(f, self._dtype)
+                              for n, f in zip(self.input_names, mds.features)}
+                    new_p, ustate, it, loss = step(
+                        self.params, ustate, it, self.states, inputs, rng_key)
+                    self.params = {**self.params, name: new_p}
+            losses[name] = float(loss)
+        return losses
+
+    # ------------------------------------------------------- streaming rnn
+
+    def rnn_time_step(self, *features: np.ndarray) -> List[np.ndarray]:
+        """Stateful streaming inference over the DAG
+        (``ComputationGraph.rnnTimeStep`` :1063 semantics): feed one
+        timestep [b, f] per input (or [b, t, f] bursts), LSTM vertices
+        keep their carry across calls."""
+        xs = [np.asarray(f) for f in features]
+        # per-input burst detection: 3-D inputs are [b, t, f] bursts and
+        # get time-sliced; 2-D inputs are static and fed whole each step
+        bursts = [x.ndim == 3 for x in xs]
+        burst = any(bursts)
+        steps = max((x.shape[1] for x, b3 in zip(xs, bursts) if b3), default=1)
+        if not hasattr(self, "_rnn_state") or self._rnn_state is None:
+            self._rnn_state = {}
+        outs: List[List[np.ndarray]] = []
+        for t in range(steps):
+            inputs = {n: jnp.asarray(x[:, t] if b3 else x, self._dtype)
+                      for (n, x), b3 in zip(zip(self.input_names, xs), bursts)}
+            acts: Dict[str, jnp.ndarray] = {}
+            for name in self.order:
+                v = self.defs[name]
+                if v.kind == "input":
+                    acts[name] = inputs[name]
+                elif v.kind == "layer":
+                    impl = self.impls[name]
+                    x = acts[v.inputs[0]]
+                    if hasattr(impl, "rnn_time_step"):
+                        st = self._rnn_state.get(name, {})
+                        out, st = impl.rnn_time_step(self.params[name], x, st)
+                        self._rnn_state[name] = st
+                        acts[name] = out
+                    else:
+                        out, _ = impl.forward(self.params[name], x,
+                                              self.states[name], False, None)
+                        acts[name] = out
+                else:
+                    ins = [acts[i] for i in v.inputs]
+                    acts[name] = v.vertex.forward(ins, [None] * len(ins))
+            outs.append([np.asarray(acts[n]) for n in self.output_names])
+        if burst:
+            return [np.stack([o[k] for o in outs], axis=1)
+                    for k in range(len(self.output_names))]
+        return outs[0]
+
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_state = {}
 
     # ------------------------------------------------------------- inference
 

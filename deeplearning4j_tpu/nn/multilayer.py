@@ -420,11 +420,10 @@ class MultiLayerNetwork:
 
         return jax.jit(epoch, donate_argnums=(0, 1, 2))
 
-    def fit_scan(self, ds: DataSet, batch_size: int, epochs: int = 1) -> np.ndarray:
-        """Device-resident multi-step training; returns per-step scores
-        (fetched once at the end — no per-step host sync)."""
-        if self.params is None:
-            self.init()
+    def stage_scan(self, ds: DataSet, batch_size: int):
+        """Stage a dataset on device as scan-ready minibatch stacks — do
+        this ONCE and pass to ``fit_scan(staged=...)`` so repeated calls
+        don't re-pay the host→device transfer."""
         if ds.features_mask is not None or ds.labels_mask is not None:
             raise ValueError("fit_scan does not support masked DataSets; use fit()")
         n = (ds.num_examples() // batch_size) * batch_size
@@ -439,6 +438,15 @@ class MultiLayerNetwork:
             (-1, batch_size) + ds.features.shape[1:])
         yb = jnp.asarray(ds.labels[:n], self._dtype).reshape(
             (-1, batch_size) + ds.labels.shape[1:])
+        return xb, yb
+
+    def fit_scan(self, ds: Optional[DataSet], batch_size: int, epochs: int = 1,
+                 staged=None) -> np.ndarray:
+        """Device-resident multi-step training; returns per-step scores
+        (fetched once at the end — no per-step host sync)."""
+        if self.params is None:
+            self.init()
+        xb, yb = staged if staged is not None else self.stage_scan(ds, batch_size)
         key = ("scan_fit",)
         if key not in self._jits:
             self._jits[key] = self._make_scan_fit()

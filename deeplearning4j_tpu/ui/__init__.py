@@ -1,0 +1,7 @@
+from deeplearning4j_tpu.ui.report import render_html, save_report  # noqa: F401
+from deeplearning4j_tpu.ui.stats import StatsListener, StatsReport  # noqa: F401
+from deeplearning4j_tpu.ui.storage import (  # noqa: F401
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    StatsStorage,
+)

@@ -1,0 +1,120 @@
+"""HTML training dashboard export.
+
+Parity: the ``deeplearning4j-ui`` Dropwizard dashboard
+(``ui/UiServer.java:25-32``, weights/score views) and the Spark stats
+HTML export (``stats/StatsUtils.java``). A zero-egress TPU pod can't
+assume a live web server, so the dashboard is a self-contained static
+HTML file (inline SVG charts, no external assets) rendered from a
+StatsStorage session — open it in any browser, attach it to CI.
+"""
+
+from __future__ import annotations
+
+import html
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+_W, _H, _PAD = 640, 220, 36
+_COLORS = ("#3366cc", "#dc3912", "#ff9900", "#109618", "#990099",
+           "#0099c6", "#dd4477", "#66aa00", "#b82e2e", "#316395")
+
+
+def _finite(points: Sequence[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    return [(x, y) for x, y in points if math.isfinite(x) and math.isfinite(y)]
+
+
+def _svg_line_chart(title: str, series: Dict[str, List[Tuple[float, float]]],
+                    log_y: bool = False) -> str:
+    """Multi-series line chart as a standalone <svg>."""
+    all_pts = _finite([p for pts in series.values() for p in pts])
+    if not all_pts:
+        return f"<h3>{html.escape(title)}</h3><p>(no data)</p>"
+    xs = [p[0] for p in all_pts]
+    ys = [p[1] for p in all_pts]
+    if log_y:
+        ys = [y for y in ys if y > 0]
+        if not ys:
+            log_y = False
+            ys = [p[1] for p in all_pts]
+    x0, x1 = min(xs), max(xs)
+    y0, y1 = min(ys), max(ys)
+    if log_y:
+        y0, y1 = math.log10(y0), math.log10(y1)
+    if x1 == x0:
+        x1 = x0 + 1
+    if y1 == y0:
+        y1 = y0 + 1
+
+    def sx(x):
+        return _PAD + (x - x0) / (x1 - x0) * (_W - 2 * _PAD)
+
+    def sy(y):
+        if log_y:
+            y = math.log10(max(y, 10 ** y0))
+        return _H - _PAD - (y - y0) / (y1 - y0) * (_H - 2 * _PAD)
+
+    parts = [f'<svg width="{_W}" height="{_H}" xmlns="http://www.w3.org/2000/svg" '
+             f'style="background:#fff;border:1px solid #ddd">']
+    # axes + labels
+    parts.append(f'<line x1="{_PAD}" y1="{_H-_PAD}" x2="{_W-_PAD}" y2="{_H-_PAD}" stroke="#999"/>')
+    parts.append(f'<line x1="{_PAD}" y1="{_PAD}" x2="{_PAD}" y2="{_H-_PAD}" stroke="#999"/>')
+    fmt = (lambda v: f"1e{v:.1f}") if log_y else (lambda v: f"{v:.3g}")
+    parts.append(f'<text x="{_PAD}" y="{_H-_PAD+14}" font-size="10">{x0:.0f}</text>')
+    parts.append(f'<text x="{_W-_PAD-20}" y="{_H-_PAD+14}" font-size="10">{x1:.0f}</text>')
+    parts.append(f'<text x="2" y="{_H-_PAD}" font-size="10">{fmt(y0)}</text>')
+    parts.append(f'<text x="2" y="{_PAD+8}" font-size="10">{fmt(y1)}</text>')
+    for i, (name, pts) in enumerate(sorted(series.items())):
+        pts = _finite(pts)
+        if not pts:
+            continue
+        color = _COLORS[i % len(_COLORS)]
+        d = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in pts)
+        parts.append(f'<polyline points="{d}" fill="none" stroke="{color}" stroke-width="1.5"/>')
+        ly = _PAD + 12 * (i + 1)
+        parts.append(f'<rect x="{_W-_PAD-130}" y="{ly-8}" width="8" height="8" fill="{color}"/>')
+        parts.append(f'<text x="{_W-_PAD-118}" y="{ly}" font-size="10">{html.escape(name[:24])}</text>')
+    parts.append("</svg>")
+    return f"<h3>{html.escape(title)}</h3>" + "".join(parts)
+
+
+def render_html(storage: StatsStorage, session_id: str,
+                worker_id: Optional[str] = None) -> str:
+    """Render one session's training telemetry to a standalone HTML page."""
+    reports = storage.get_reports(session_id, worker_id)
+    score = {"score": [(r.iteration, r.score) for r in reports]}
+    timing = {"ms/iteration": [(r.iteration, r.duration_ms) for r in reports]}
+    pnorms: Dict[str, List[Tuple[float, float]]] = {}
+    unorms: Dict[str, List[Tuple[float, float]]] = {}
+    mem: Dict[str, List[Tuple[float, float]]] = {}
+    for r in reports:
+        for k, v in r.param_norms.items():
+            pnorms.setdefault(k, []).append((r.iteration, v))
+        for k, v in r.update_norms.items():
+            unorms.setdefault(k, []).append((r.iteration, v))
+        for k, v in r.memory.items():
+            mem.setdefault(k, []).append((r.iteration, v / 2**20))
+    sections = [
+        _svg_line_chart("Score vs iteration", score),
+        _svg_line_chart("Parameter L2 norms (log)", pnorms, log_y=True),
+        _svg_line_chart("Update magnitudes |Δ‖p‖| (log)", unorms, log_y=True),
+        _svg_line_chart("Iteration time (ms)", timing),
+    ]
+    if mem:
+        sections.append(_svg_line_chart("Device memory (MiB)", mem))
+    head = (f"<h1>deeplearning4j_tpu training report</h1>"
+            f"<p>session <b>{html.escape(session_id)}</b>, "
+            f"{len(reports)} reports, workers: "
+            f"{', '.join(storage.list_workers(session_id)) or '-'}</p>")
+    return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>training report</title></head>"
+            f"<body style='font-family:sans-serif'>{head}"
+            + "".join(sections) + "</body></html>")
+
+
+def save_report(storage: StatsStorage, session_id: str, path: str,
+                worker_id: Optional[str] = None) -> str:
+    with open(path, "w") as f:
+        f.write(render_html(storage, session_id, worker_id))
+    return path

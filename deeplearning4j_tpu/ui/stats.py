@@ -1,0 +1,133 @@
+"""Training telemetry: StatsListener + structured reports.
+
+Parity: ``deeplearning4j-ui-model/.../stats/StatsListener.java:46-187``
+(iterationDone :117 — score, per-layer parameter/gradient/update
+histograms & norms, memory, timing, hardware info) and
+``stats/api/StatsReport.java``. The reference encodes reports with
+generated SBE codecs and posts them over HTTP; here a report is a plain
+dataclass → dict (JSON-ready) routed to a ``StatsStorage`` —
+the wire format problem SBE solved doesn't exist in-process, and the
+storage SPI (storage.py) is the extension seam a transport would plug
+into.
+
+TPU note: param/update statistics force a device→host transfer, so the
+listener computes them every ``frequency`` iterations only, in ONE jitted
+reduction per call (not one per layer) to keep host round-trips flat.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+
+@dataclasses.dataclass
+class StatsReport:
+    """One iteration's telemetry (``StatsReport.java`` role)."""
+
+    session_id: str
+    worker_id: str
+    iteration: int
+    timestamp: float
+    score: float
+    duration_ms: float = float("nan")
+    # per-layer-parameter statistics, keyed "layer/param"
+    param_norms: Dict[str, float] = dataclasses.field(default_factory=dict)
+    update_norms: Dict[str, float] = dataclasses.field(default_factory=dict)
+    param_histograms: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    memory: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "StatsReport":
+        return StatsReport(**d)
+
+
+def _flat_names(params) -> List[str]:
+    names = []
+    for lname in sorted(params):
+        for pname in sorted(params[lname]):
+            names.append(f"{lname}/{pname}")
+    return names
+
+
+@jax.jit
+def _norms(params):
+    """All per-parameter L2 norms in one device program."""
+    return {ln: {pn: jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32))))
+                 for pn, v in ps.items()}
+            for ln, ps in params.items()}
+
+
+class StatsListener(IterationListener):
+    """Collects StatsReports into a storage
+    (``StatsListener.java:46`` — iterationDone :117).
+
+    ``histograms=True`` additionally ships 20-bin parameter histograms
+    (HistogramIterationListener role) — a full device→host pull of the
+    parameters, so keep the frequency low when using it.
+    """
+
+    def __init__(self, storage, frequency: int = 1, session_id: str = "default",
+                 worker_id: str = "worker0", histograms: bool = False,
+                 histogram_bins: int = 20):
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self.session_id = session_id
+        self.worker_id = worker_id
+        self.histograms = histograms
+        self.histogram_bins = histogram_bins
+        self._last_time: Optional[float] = None
+        self._last_norms: Optional[Dict[str, float]] = None
+
+    def _device_memory(self) -> Dict[str, float]:
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            return {k: float(v) for k, v in stats.items()
+                    if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")}
+        except Exception:
+            return {}
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        duration = float("nan")
+        if self._last_time is not None:
+            duration = (now - self._last_time) * 1000.0
+        self._last_time = now
+        if iteration % self.frequency != 0:
+            return
+        report = StatsReport(
+            session_id=self.session_id, worker_id=self.worker_id,
+            iteration=iteration, timestamp=time.time(), score=float(score),
+            duration_ms=duration, memory=self._device_memory())
+        if model.params is not None:
+            norm_tree = jax.device_get(_norms(model.params))
+            norms = {f"{ln}/{pn}": float(v)
+                     for ln, ps in norm_tree.items() for pn, v in ps.items()}
+            report.param_norms = norms
+            if self._last_norms is not None:
+                # |Δ‖p‖| as the cheap update-magnitude proxy; exact update
+                # norms would need a param snapshot (2x HBM) per report
+                report.update_norms = {
+                    k: abs(norms[k] - self._last_norms[k])
+                    for k in norms if k in self._last_norms}
+            self._last_norms = norms
+            if self.histograms:
+                host = jax.device_get(model.params)
+                for ln in sorted(host):
+                    for pn, v in sorted(host[ln].items()):
+                        counts, edges = np.histogram(
+                            np.asarray(v, np.float32).ravel(), bins=self.histogram_bins)
+                        report.param_histograms[f"{ln}/{pn}"] = {
+                            "counts": counts.tolist(),
+                            "min": float(edges[0]), "max": float(edges[-1])}
+        self.storage.put_report(report)

@@ -1,0 +1,118 @@
+"""StatsStorage SPI + in-memory and file-backed implementations.
+
+Parity: ``deeplearning4j-ui-model/.../storage/StatsStorage.java``
+(sessions/workers keyed report store + change listeners) and
+``mapdb/MapDBStatsStorage.java:21`` (persistent impl). The file backend
+here is append-only JSONL per session — crash-safe, greppable, and
+streamable, which is what MapDB bought the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.ui.stats import StatsReport
+
+
+class StatsStorage:
+    """Storage SPI (``StatsStorage.java``)."""
+
+    def put_report(self, report: StatsReport) -> None:
+        raise NotImplementedError
+
+    def list_sessions(self) -> List[str]:
+        raise NotImplementedError
+
+    def list_workers(self, session_id: str) -> List[str]:
+        raise NotImplementedError
+
+    def get_reports(self, session_id: str,
+                    worker_id: Optional[str] = None) -> List[StatsReport]:
+        raise NotImplementedError
+
+    def latest_report(self, session_id: str) -> Optional[StatsReport]:
+        reports = self.get_reports(session_id)
+        return reports[-1] if reports else None
+
+    # change-stream (StatsStorageListener role)
+
+    def add_listener(self, cb: Callable[[StatsReport], None]) -> None:
+        if not hasattr(self, "_listeners"):
+            self._listeners: List[Callable] = []
+        self._listeners.append(cb)
+
+    def _notify(self, report: StatsReport) -> None:
+        for cb in getattr(self, "_listeners", []):
+            cb(report)
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """``InMemoryStatsStorage`` — dict-backed, test/dev use."""
+
+    def __init__(self):
+        self._data: Dict[Tuple[str, str], List[StatsReport]] = {}
+        self._lock = threading.Lock()
+
+    def put_report(self, report: StatsReport) -> None:
+        with self._lock:
+            self._data.setdefault((report.session_id, report.worker_id), []).append(report)
+        self._notify(report)
+
+    def list_sessions(self) -> List[str]:
+        return sorted({s for s, _ in self._data})
+
+    def list_workers(self, session_id: str) -> List[str]:
+        return sorted({w for s, w in self._data if s == session_id})
+
+    def get_reports(self, session_id, worker_id=None) -> List[StatsReport]:
+        out = []
+        for (s, w), reports in self._data.items():
+            if s == session_id and (worker_id is None or w == worker_id):
+                out.extend(reports)
+        return sorted(out, key=lambda r: (r.iteration, r.timestamp))
+
+
+class FileStatsStorage(StatsStorage):
+    """``MapDBStatsStorage`` role: persistent storage as append-only
+    JSONL, one file per session under ``root_dir``."""
+
+    def __init__(self, root_dir: str):
+        self.root = root_dir
+        os.makedirs(root_dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, session_id: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in session_id)
+        return os.path.join(self.root, f"{safe}.jsonl")
+
+    def put_report(self, report: StatsReport) -> None:
+        line = json.dumps(report.to_dict())
+        with self._lock:
+            with open(self._path(report.session_id), "a") as f:
+                f.write(line + "\n")
+        self._notify(report)
+
+    def list_sessions(self) -> List[str]:
+        return sorted(os.path.splitext(f)[0] for f in os.listdir(self.root)
+                      if f.endswith(".jsonl"))
+
+    def list_workers(self, session_id: str) -> List[str]:
+        return sorted({r.worker_id for r in self.get_reports(session_id)})
+
+    def get_reports(self, session_id, worker_id=None) -> List[StatsReport]:
+        path = self._path(session_id)
+        if not os.path.exists(path):
+            return []
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                r = StatsReport.from_dict(json.loads(line))
+                if worker_id is None or r.worker_id == worker_id:
+                    out.append(r)
+        return sorted(out, key=lambda r: (r.iteration, r.timestamp))

@@ -1,0 +1,70 @@
+"""Observability subsystem tests: StatsListener → StatsStorage → HTML.
+
+Parity: ``StatsListener.java:46-187``, ``StatsStorage.java`` +
+``MapDBStatsStorage.java:21``, ``UiServer.java`` dashboard role
+(static HTML export here).
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage, InMemoryStatsStorage, StatsListener, render_html, save_report)
+
+
+def _train(storage, rng, histograms=False, n_iters=6):
+    conf = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.1)
+            .updater("sgd").activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(StatsListener(storage, session_id="s1",
+                                    histograms=histograms))
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    for _ in range(n_iters):
+        net.fit(DataSet(x, y))
+    return net
+
+
+def test_stats_collected_in_memory(rng):
+    storage = InMemoryStatsStorage()
+    _train(storage, rng)
+    assert storage.list_sessions() == ["s1"]
+    reports = storage.get_reports("s1")
+    assert len(reports) == 6
+    r = reports[-1]
+    assert np.isfinite(r.score)
+    assert set(r.param_norms) == {"layer0/W", "layer0/b", "layer1/W", "layer1/b"}
+    assert all(v >= 0 for v in r.param_norms.values())
+    # update magnitudes appear from the second report on
+    assert reports[1].update_norms and not reports[0].update_norms
+    assert np.isfinite(reports[-1].duration_ms)
+
+
+def test_file_storage_roundtrip_and_report(rng, tmp_path):
+    storage = FileStatsStorage(str(tmp_path / "stats"))
+    _train(storage, rng, histograms=True, n_iters=4)
+    # fresh handle reads back what the listener wrote
+    storage2 = FileStatsStorage(str(tmp_path / "stats"))
+    reports = storage2.get_reports("s1")
+    assert len(reports) == 4
+    assert reports[-1].param_histograms["layer0/W"]["counts"]
+    html_text = render_html(storage2, "s1")
+    assert "<svg" in html_text and "Score vs iteration" in html_text
+    out = save_report(storage2, "s1", str(tmp_path / "report.html"))
+    assert open(out).read().startswith("<!DOCTYPE html>")
+
+
+def test_change_listener(rng):
+    storage = InMemoryStatsStorage()
+    seen = []
+    storage.add_listener(lambda r: seen.append(r.iteration))
+    _train(storage, rng, n_iters=3)
+    assert len(seen) == 3
