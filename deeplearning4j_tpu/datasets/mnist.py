@@ -77,6 +77,11 @@ def load_mnist(train: bool = True, num_examples: Optional[int] = None,
         images = _read_idx(img_path)
         labels = _read_idx(lbl_path)
     else:
+        import logging
+        logging.getLogger("deeplearning4j_tpu").warning(
+            "MNIST IDX files not found in %s — using SYNTHETIC class-"
+            "conditional blobs. Throughput numbers are valid; accuracy "
+            "claims on this data are NOT.", _MNIST_DIRS)
         n = num_examples or (60000 if train else 10000)
         images, labels = _synthetic_mnist(n, seed, train)
     if num_examples is not None:

@@ -1,0 +1,158 @@
+"""Feature normalization: standardize, min-max, image scaling.
+
+Parity: ND4J's dataset preprocessors the reference trains through —
+``NormalizerStandardize``, ``NormalizerMinMaxScaler``,
+``ImagePreProcessingScaler``, ``VGG16ImagePreProcessor`` role. Each has
+fit(DataSet|iterator) → transform/revert, plus save/restore of the
+statistics (the checkpointing contract the reference gives its
+normalizers).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+class Normalizer:
+    def fit(self, data: Union[DataSet, DataSetIterator]) -> "Normalizer":
+        raise NotImplementedError
+
+    def transform(self, ds: DataSet) -> DataSet:
+        raise NotImplementedError
+
+    def revert(self, ds: DataSet) -> DataSet:
+        raise NotImplementedError
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self._state(), f)
+
+    @classmethod
+    def load(cls, path: str) -> "Normalizer":
+        with open(path) as f:
+            state = json.load(f)
+        obj = cls.__new__(cls)
+        obj._set_state(state)
+        return obj
+
+    # iteration helper: single pass accumulating (n, sum, sumsq, min, max)
+    @staticmethod
+    def _moments(data):
+        if isinstance(data, DataSet):
+            batches = [data]
+        else:
+            batches = data
+        n = 0
+        s = ss = None
+        mn = mx = None
+        for ds in batches:
+            x = np.asarray(ds.features, np.float64)
+            x2 = x.reshape(-1, x.shape[-1])
+            n += x2.shape[0]
+            s = x2.sum(0) if s is None else s + x2.sum(0)
+            ss = (x2 ** 2).sum(0) if ss is None else ss + (x2 ** 2).sum(0)
+            bmn, bmx = x2.min(0), x2.max(0)
+            mn = bmn if mn is None else np.minimum(mn, bmn)
+            mx = bmx if mx is None else np.maximum(mx, bmx)
+        return n, s, ss, mn, mx
+
+
+class NormalizerStandardize(Normalizer):
+    """Zero-mean unit-variance per feature (``NormalizerStandardize``)."""
+
+    def __init__(self):
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+
+    def fit(self, data):
+        n, s, ss, _, _ = self._moments(data)
+        self.mean = (s / n).astype(np.float32)
+        var = ss / n - (s / n) ** 2
+        self.std = np.sqrt(np.maximum(var, 1e-12)).astype(np.float32)
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        x = (np.asarray(ds.features, np.float32) - self.mean) / self.std
+        return DataSet(x, ds.labels, ds.features_mask, ds.labels_mask)
+
+    def revert(self, ds: DataSet) -> DataSet:
+        x = np.asarray(ds.features, np.float32) * self.std + self.mean
+        return DataSet(x, ds.labels, ds.features_mask, ds.labels_mask)
+
+    def _state(self):
+        return {"kind": "standardize", "mean": self.mean.tolist(),
+                "std": self.std.tolist()}
+
+    def _set_state(self, st):
+        self.mean = np.asarray(st["mean"], np.float32)
+        self.std = np.asarray(st["std"], np.float32)
+
+
+class NormalizerMinMaxScaler(Normalizer):
+    """Scale each feature to [lo, hi] (``NormalizerMinMaxScaler``)."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0):
+        self.lo, self.hi = lo, hi
+        self.min: Optional[np.ndarray] = None
+        self.max: Optional[np.ndarray] = None
+
+    def fit(self, data):
+        _, _, _, mn, mx = self._moments(data)
+        self.min = mn.astype(np.float32)
+        self.max = mx.astype(np.float32)
+        return self
+
+    def _scale(self):
+        rng = np.maximum(self.max - self.min, 1e-12)
+        return rng
+
+    def transform(self, ds: DataSet) -> DataSet:
+        x = (np.asarray(ds.features, np.float32) - self.min) / self._scale()
+        x = x * (self.hi - self.lo) + self.lo
+        return DataSet(x, ds.labels, ds.features_mask, ds.labels_mask)
+
+    def revert(self, ds: DataSet) -> DataSet:
+        x = (np.asarray(ds.features, np.float32) - self.lo) / (self.hi - self.lo)
+        x = x * self._scale() + self.min
+        return DataSet(x, ds.labels, ds.features_mask, ds.labels_mask)
+
+    def _state(self):
+        return {"kind": "minmax", "lo": self.lo, "hi": self.hi,
+                "min": self.min.tolist(), "max": self.max.tolist()}
+
+    def _set_state(self, st):
+        self.lo, self.hi = st["lo"], st["hi"]
+        self.min = np.asarray(st["min"], np.float32)
+        self.max = np.asarray(st["max"], np.float32)
+
+
+class ImagePreProcessingScaler(Normalizer):
+    """Pixel range [0,255] → [lo,hi] without fitting
+    (``ImagePreProcessingScaler``)."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0):
+        self.lo, self.hi = lo, hi
+
+    def fit(self, data):
+        return self  # stateless
+
+    def transform(self, ds: DataSet) -> DataSet:
+        x = np.asarray(ds.features, np.float32) / 255.0
+        x = x * (self.hi - self.lo) + self.lo
+        return DataSet(x, ds.labels, ds.features_mask, ds.labels_mask)
+
+    def revert(self, ds: DataSet) -> DataSet:
+        x = (np.asarray(ds.features, np.float32) - self.lo) / (self.hi - self.lo) * 255.0
+        return DataSet(x, ds.labels, ds.features_mask, ds.labels_mask)
+
+    def _state(self):
+        return {"kind": "image", "lo": self.lo, "hi": self.hi}
+
+    def _set_state(self, st):
+        self.lo, self.hi = st["lo"], st["hi"]

@@ -1,0 +1,12 @@
+from deeplearning4j_tpu.datavec.iterator import (  # noqa: F401
+    RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.datavec.records import (  # noqa: F401
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    ImageRecordReader,
+    LineRecordReader,
+    RecordReader,
+)

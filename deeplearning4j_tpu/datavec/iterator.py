@@ -1,0 +1,206 @@
+"""RecordReader → DataSet/MultiDataSet iterator bridges.
+
+Parity: ``datasets/datavec/RecordReaderDataSetIterator.java:54``
+(single reader → DataSet, classification label-index one-hot or
+regression passthrough), ``SequenceRecordReaderDataSetIterator.java``
+(aligned feature/label sequence readers with padding + masks), and
+``RecordReaderMultiDataSetIterator.java`` (named readers composed into
+multi-input/multi-output MultiDataSets for ComputationGraph).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator, MultiDataSetIterator
+from deeplearning4j_tpu.datavec.records import ImageRecordReader, RecordReader
+
+
+def _one_hot(idx: int, n: int) -> np.ndarray:
+    v = np.zeros((n,), np.float32)
+    v[int(idx)] = 1.0
+    return v
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """Single record reader → DataSet minibatches.
+
+    ``label_index`` marks the label column (classification with
+    ``num_classes``, or regression when ``regression=True``);
+    ``label_index=None`` yields unlabeled features (labels == features,
+    the reference's unsupervised convention for pretrain feeds).
+    For ``ImageRecordReader`` records ([array, label]) the array is the
+    feature block.
+    """
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: Optional[int] = -1,
+                 num_classes: Optional[int] = None,
+                 regression: bool = False):
+        self.reader = reader
+        self._batch = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        self.reader.reset()
+
+    def reset(self):
+        self.reader.reset()
+
+    def has_next(self):
+        return self.reader.has_next()
+
+    def batch(self):
+        return self._batch
+
+    def _split(self, rec) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        if isinstance(self.reader, ImageRecordReader) or (
+                len(rec) == 2 and isinstance(rec[0], np.ndarray) and rec[0].ndim >= 2):
+            arr, label = rec
+            x = np.asarray(arr, np.float32)
+            if self.label_index is None:
+                return x, None
+            if self.regression:
+                return x, np.asarray([label], np.float32)
+            return x, _one_hot(label, self.num_classes or len(self.reader.labels))
+        vals = list(rec)
+        if self.label_index is None:
+            return np.asarray(vals, np.float32), None
+        li = self.label_index if self.label_index >= 0 else len(vals) + self.label_index
+        label = vals.pop(li)
+        x = np.asarray(vals, np.float32)
+        if self.regression:
+            return x, np.asarray([float(label)], np.float32)
+        if self.num_classes is None:
+            raise ValueError("classification needs num_classes")
+        return x, _one_hot(float(label) if not isinstance(label, str) else
+                           self._label_to_index(label), self.num_classes)
+
+    def _label_to_index(self, label: str) -> int:
+        if not hasattr(self, "_label_map"):
+            self._label_map: Dict[str, int] = {}
+        if label not in self._label_map:
+            self._label_map[label] = len(self._label_map)
+        return self._label_map[label]
+
+    def next(self) -> DataSet:
+        xs, ys = [], []
+        while self.reader.has_next() and len(xs) < self._batch:
+            x, y = self._split(self.reader.next_record())
+            xs.append(x)
+            ys.append(y)
+        feats = np.stack(xs)
+        labels = feats if ys[0] is None else np.stack(ys)
+        return DataSet(feats, labels)
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """Aligned feature + label sequence readers → padded, masked
+    sequence DataSets (``SequenceRecordReaderDataSetIterator.java``,
+    ALIGN_END padding semantics: shorter sequences are zero-padded at
+    the end and masked out)."""
+
+    def __init__(self, features_reader: RecordReader,
+                 labels_reader: Optional[RecordReader], batch_size: int,
+                 num_classes: Optional[int] = None, regression: bool = False):
+        self.fr = features_reader
+        self.lr = labels_reader
+        self._batch = batch_size
+        self.num_classes = num_classes
+        self.regression = regression
+
+    def reset(self):
+        self.fr.reset()
+        if self.lr is not None:
+            self.lr.reset()
+
+    def has_next(self):
+        return self.fr.has_next()
+
+    def batch(self):
+        return self._batch
+
+    def next(self) -> DataSet:
+        fseqs, lseqs = [], []
+        while self.fr.has_next() and len(fseqs) < self._batch:
+            f = np.asarray(self.fr.next_record(), np.float32)
+            fseqs.append(f)
+            if self.lr is not None:
+                l = np.asarray(self.lr.next_record(), np.float32)
+                if not self.regression:
+                    if self.num_classes is None:
+                        raise ValueError("classification needs num_classes")
+                    l = np.eye(self.num_classes, dtype=np.float32)[
+                        l.astype(int).ravel()]
+                lseqs.append(l)
+        T = max(s.shape[0] for s in fseqs)
+        b = len(fseqs)
+        x = np.zeros((b, T, fseqs[0].shape[-1]), np.float32)
+        mask = np.zeros((b, T), np.float32)
+        for i, s in enumerate(fseqs):
+            x[i, :s.shape[0]] = s
+            mask[i, :s.shape[0]] = 1.0
+        if self.lr is None:
+            return DataSet(x, x, features_mask=mask, labels_mask=mask)
+        y = np.zeros((b, T, lseqs[0].shape[-1]), np.float32)
+        lmask = np.zeros((b, T), np.float32)
+        for i, s in enumerate(lseqs):
+            y[i, :s.shape[0]] = s
+            lmask[i, :s.shape[0]] = 1.0
+        return DataSet(x, y, features_mask=mask, labels_mask=lmask)
+
+
+class RecordReaderMultiDataSetIterator(MultiDataSetIterator):
+    """Named readers → MultiDataSet (``RecordReaderMultiDataSetIterator``
+    builder semantics): each input/output selects a reader and either a
+    column range ("all features") or a one-hot label column."""
+
+    def __init__(self, batch_size: int):
+        self._batch = batch_size
+        self._readers: Dict[str, RecordReader] = {}
+        self._inputs: List[Tuple[str, Optional[int], Optional[int]]] = []
+        self._outputs: List[Tuple[str, int, int]] = []
+
+    def add_reader(self, name: str, reader: RecordReader):
+        self._readers[name] = reader
+        return self
+
+    def add_input(self, reader_name: str, col_from: Optional[int] = None,
+                  col_to: Optional[int] = None):
+        self._inputs.append((reader_name, col_from, col_to))
+        return self
+
+    def add_output_one_hot(self, reader_name: str, column: int, num_classes: int):
+        self._outputs.append((reader_name, column, num_classes))
+        return self
+
+    def reset(self):
+        for r in self._readers.values():
+            r.reset()
+
+    def has_next(self):
+        return all(r.has_next() for r in self._readers.values())
+
+    def batch(self):
+        return self._batch
+
+    def next(self) -> MultiDataSet:
+        rows: Dict[str, List[List[float]]] = {n: [] for n in self._readers}
+        count = 0
+        while self.has_next() and count < self._batch:
+            for n, r in self._readers.items():
+                rows[n].append(list(r.next_record()))
+            count += 1
+        feats = []
+        for name, c0, c1 in self._inputs:
+            arr = np.asarray([[float(v) for v in row] for row in rows[name]],
+                             np.float32)
+            feats.append(arr[:, c0:c1] if c0 is not None or c1 is not None else arr)
+        labels = []
+        for name, col, ncls in self._outputs:
+            idx = np.asarray([float(row[col]) for row in rows[name]]).astype(int)
+            labels.append(np.eye(ncls, dtype=np.float32)[idx])
+        return MultiDataSet(features=feats, labels=labels)

@@ -395,8 +395,9 @@ class ComputationGraph:
 
     def _fit_batch(self, mds: MultiDataSet) -> None:
         feats = mds.features
-        if (self.conf.backprop_type == "truncated_bptt" and feats[0].ndim == 3
-                and feats[0].shape[1] > self.conf.tbptt_fwd_length):
+        if (self.conf.backprop_type == "truncated_bptt"
+                and any(f.ndim == 3 and f.shape[1] > self.conf.tbptt_fwd_length
+                        for f in feats)):
             self._fit_tbptt(mds)
             return
         self._fit_batch_inner(mds)
@@ -428,7 +429,8 @@ class ComputationGraph:
         rec = self._recurrent_names()
         if not rec:
             raise ValueError("TBPTT configured but no recurrent layers present")
-        T = mds.features[0].shape[1]
+        seq_feats = [f for f in mds.features if f.ndim == 3]
+        T = max(f.shape[1] for f in seq_feats)
         Lc = self.conf.tbptt_fwd_length
         b = mds.features[0].shape[0]
         if not any(lab.ndim == 3 for lab in mds.labels):
@@ -601,7 +603,11 @@ class ComputationGraph:
         # get time-sliced; 2-D inputs are static and fed whole each step
         bursts = [x.ndim == 3 for x in xs]
         burst = any(bursts)
-        steps = max((x.shape[1] for x, b3 in zip(xs, bursts) if b3), default=1)
+        lengths = {x.shape[1] for x, b3 in zip(xs, bursts) if b3}
+        if len(lengths) > 1:
+            raise ValueError(
+                f"rnn_time_step burst inputs disagree on length: {sorted(lengths)}")
+        steps = lengths.pop() if lengths else 1
         if not hasattr(self, "_rnn_state") or self._rnn_state is None:
             self._rnn_state = {}
         outs: List[List[np.ndarray]] = []

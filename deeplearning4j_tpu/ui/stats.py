@@ -45,10 +45,16 @@ class StatsReport:
     memory: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        # NaN is not valid strict JSON — ship null so jq/JS can parse it
+        if not np.isfinite(d["duration_ms"]):
+            d["duration_ms"] = None
+        return d
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "StatsReport":
+        if d.get("duration_ms") is None:
+            d = {**d, "duration_ms": float("nan")}
         return StatsReport(**d)
 
 

@@ -62,14 +62,18 @@ class InMemoryStatsStorage(StatsStorage):
         self._notify(report)
 
     def list_sessions(self) -> List[str]:
-        return sorted({s for s, _ in self._data})
+        with self._lock:
+            return sorted({s for s, _ in self._data})
 
     def list_workers(self, session_id: str) -> List[str]:
-        return sorted({w for s, w in self._data if s == session_id})
+        with self._lock:
+            return sorted({w for s, w in self._data if s == session_id})
 
     def get_reports(self, session_id, worker_id=None) -> List[StatsReport]:
         out = []
-        for (s, w), reports in self._data.items():
+        with self._lock:
+            items = list(self._data.items())
+        for (s, w), reports in items:
             if s == session_id and (worker_id is None or w == worker_id):
                 out.extend(reports)
         return sorted(out, key=lambda r: (r.iteration, r.timestamp))

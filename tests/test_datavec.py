@@ -1,0 +1,156 @@
+"""Data plane tests: record readers, DataSet bridges, normalizers,
+CIFAR/LFW loaders.
+
+Parity: ``RecordReaderDataSetIterator.java:54``,
+``SequenceRecordReaderDataSetIterator.java``,
+``RecordReaderMultiDataSetIterator.java``, ``CifarDataSetIterator.java:17``,
+ND4J normalizers.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator, load_cifar10
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.datasets.lfw import load_lfw
+from deeplearning4j_tpu.datasets.normalizers import (
+    ImagePreProcessingScaler, NormalizerMinMaxScaler, NormalizerStandardize)
+from deeplearning4j_tpu.datavec import (
+    CSVRecordReader, CSVSequenceRecordReader, ImageRecordReader,
+    RecordReaderDataSetIterator, RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator)
+
+
+CSV = ["1.0,2.0,0", "3.0,4.0,1", "5.0,6.0,2", "7.0,8.0,0", "9.0,10.0,1"]
+
+
+def test_csv_reader_to_dataset():
+    it = RecordReaderDataSetIterator(CSVRecordReader(CSV), batch_size=2,
+                                     label_index=-1, num_classes=3)
+    ds = it.next()
+    np.testing.assert_allclose(ds.features, [[1, 2], [3, 4]])
+    np.testing.assert_allclose(ds.labels, [[1, 0, 0], [0, 1, 0]])
+    batches = list(it)
+    assert [b.num_examples() for b in batches] == [2, 2, 1]
+
+
+def test_csv_reader_regression_and_header():
+    lines = ["a,b,target"] + CSV
+    it = RecordReaderDataSetIterator(
+        CSVRecordReader(lines, skip_lines=1), batch_size=5,
+        label_index=-1, regression=True)
+    ds = it.next()
+    assert ds.labels.shape == (5, 1)
+    np.testing.assert_allclose(ds.labels.ravel(), [0, 1, 2, 0, 1])
+
+
+def test_sequence_reader_padding_and_masks(tmp_path):
+    # two sequence files of different lengths -> padded + masked batch
+    f1 = tmp_path / "s1.csv"
+    f1.write_text("1,2\n3,4\n5,6\n")
+    f2 = tmp_path / "s2.csv"
+    f2.write_text("7,8\n9,10\n")
+    l1 = tmp_path / "l1.csv"
+    l1.write_text("0\n1\n0\n")
+    l2 = tmp_path / "l2.csv"
+    l2.write_text("1\n1\n")
+    it = SequenceRecordReaderDataSetIterator(
+        CSVSequenceRecordReader([str(f1), str(f2)]),
+        CSVSequenceRecordReader([str(l1), str(l2)]),
+        batch_size=2, num_classes=2)
+    ds = it.next()
+    assert ds.features.shape == (2, 3, 2)
+    np.testing.assert_allclose(ds.features_mask, [[1, 1, 1], [1, 1, 0]])
+    np.testing.assert_allclose(ds.labels[0, 1], [0, 1])
+    np.testing.assert_allclose(ds.features[1, 2], [0, 0])  # padded
+
+
+def test_image_record_reader(tmp_path):
+    from PIL import Image
+    for label in ("cat", "dog"):
+        d = tmp_path / label
+        d.mkdir()
+        for i in range(2):
+            Image.new("RGB", (10, 8), color=(i * 50, 100, 150)).save(
+                d / f"{i}.png")
+    reader = ImageRecordReader(height=4, width=5, channels=3,
+                               root_dir=str(tmp_path))
+    assert reader.labels == ["cat", "dog"]
+    it = RecordReaderDataSetIterator(reader, batch_size=4, num_classes=2)
+    ds = it.next()
+    assert ds.features.shape == (4, 4, 5, 3)
+    assert ds.labels.sum() == 4
+
+
+def test_multi_dataset_iterator():
+    it = (RecordReaderMultiDataSetIterator(batch_size=2)
+          .add_reader("r", CSVRecordReader(CSV))
+          .add_input("r", 0, 2)
+          .add_output_one_hot("r", 2, 3))
+    mds = it.next()
+    assert mds.features[0].shape == (2, 2)
+    assert mds.labels[0].shape == (2, 3)
+
+
+def test_normalizer_standardize_roundtrip(rng, tmp_path):
+    x = rng.normal(5.0, 3.0, (64, 4)).astype(np.float32)
+    ds = DataSet(x, np.zeros((64, 1), np.float32))
+    norm = NormalizerStandardize().fit(ListDataSetIterator(ds, 16))
+    t = norm.transform(ds)
+    assert abs(t.features.mean()) < 1e-4
+    assert abs(t.features.std() - 1.0) < 1e-2
+    back = norm.revert(t)
+    np.testing.assert_allclose(back.features, x, atol=1e-4)
+    # persistence
+    p = str(tmp_path / "norm.json")
+    norm.save(p)
+    norm2 = NormalizerStandardize.load(p)
+    np.testing.assert_allclose(norm2.transform(ds).features, t.features)
+
+
+def test_normalizer_minmax_and_image_scaler(rng):
+    x = rng.uniform(-3, 7, (32, 5)).astype(np.float32)
+    ds = DataSet(x, np.zeros((32, 1), np.float32))
+    mm = NormalizerMinMaxScaler().fit(ds)
+    t = mm.transform(ds)
+    assert t.features.min() >= -1e-6 and t.features.max() <= 1 + 1e-6
+    np.testing.assert_allclose(mm.revert(t).features, x, atol=1e-4)
+    img = DataSet(np.full((2, 3, 3, 1), 255.0, np.float32),
+                  np.zeros((2, 1), np.float32))
+    np.testing.assert_allclose(
+        ImagePreProcessingScaler().transform(img).features, 1.0)
+
+
+def test_cifar_and_lfw_loaders():
+    ds = load_cifar10(train=True, num_examples=32)
+    assert ds.features.shape == (32, 32, 32, 3)
+    assert ds.labels.shape == (32, 10)
+    assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+    it = CifarDataSetIterator(batch=8, num_examples=16)
+    assert sum(1 for _ in it) == 2
+    lfw = load_lfw(num_examples=8, image_size=(16, 16))
+    assert lfw.features.shape == (8, 16, 16, 3)
+
+
+def test_train_from_record_reader_end_to_end(rng):
+    """VERDICT r1 #4 'done' criterion: a network trains from a record
+    reader through the async-prefetch fit path."""
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    lines = [f"{rng.normal()},{rng.normal()},{i % 3}" for i in range(48)]
+    conf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+            .updater("adam").activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=2, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    for _ in range(3):
+        it = RecordReaderDataSetIterator(CSVRecordReader(lines), batch_size=16,
+                                         label_index=-1, num_classes=3)
+        net.fit(it)
+    assert np.isfinite(net.score())
