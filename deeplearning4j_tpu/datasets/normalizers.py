@@ -41,7 +41,8 @@ class Normalizer:
         obj._set_state(state)
         return obj
 
-    # iteration helper: single pass accumulating (n, sum, sumsq, min, max)
+    # iteration helper: single pass accumulating (n, sum, sumsq, min, max);
+    # masked sequence timesteps (features_mask == 0 padding) are excluded
     @staticmethod
     def _moments(data):
         if isinstance(data, DataSet):
@@ -54,6 +55,11 @@ class Normalizer:
         for ds in batches:
             x = np.asarray(ds.features, np.float64)
             x2 = x.reshape(-1, x.shape[-1])
+            if ds.features_mask is not None and x.ndim == 3:
+                keep = np.asarray(ds.features_mask, bool).reshape(-1)
+                x2 = x2[keep]
+            if x2.shape[0] == 0:
+                continue
             n += x2.shape[0]
             s = x2.sum(0) if s is None else s + x2.sum(0)
             ss = (x2 ** 2).sum(0) if ss is None else ss + (x2 ** 2).sum(0)

@@ -45,6 +45,20 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.label_index = label_index
         self.num_classes = num_classes
         self.regression = regression
+        self._label_map: Dict[str, int] = {}
+        if (not regression and label_index is not None
+                and not isinstance(reader, ImageRecordReader)):
+            # canonical (sorted) string-label map, like the reference's
+            # label list: first-encounter order would make the class
+            # indices depend on record order and differ across splits
+            strings = set()
+            self.reader.reset()
+            for rec in self.reader:
+                vals = list(rec)
+                li = label_index if label_index >= 0 else len(vals) + label_index
+                if isinstance(vals[li], str):
+                    strings.add(vals[li])
+            self._label_map = {s: i for i, s in enumerate(sorted(strings))}
         self.reader.reset()
 
     def reset(self):
@@ -80,10 +94,9 @@ class RecordReaderDataSetIterator(DataSetIterator):
                            self._label_to_index(label), self.num_classes)
 
     def _label_to_index(self, label: str) -> int:
-        if not hasattr(self, "_label_map"):
-            self._label_map: Dict[str, int] = {}
         if label not in self._label_map:
-            self._label_map[label] = len(self._label_map)
+            raise ValueError(f"unseen string label {label!r}; known: "
+                             f"{sorted(self._label_map)}")
         return self._label_map[label]
 
     def next(self) -> DataSet:
@@ -99,18 +112,25 @@ class RecordReaderDataSetIterator(DataSetIterator):
 
 class SequenceRecordReaderDataSetIterator(DataSetIterator):
     """Aligned feature + label sequence readers → padded, masked
-    sequence DataSets (``SequenceRecordReaderDataSetIterator.java``,
-    ALIGN_END padding semantics: shorter sequences are zero-padded at
-    the end and masked out)."""
+    sequence DataSets (``SequenceRecordReaderDataSetIterator.java``).
+
+    ``align="start"`` (default) left-aligns sequences, zero-padding and
+    masking the tail (DL4J ALIGN_START); ``align="end"`` right-aligns so
+    every sequence's last real timestep sits at index T-1 (DL4J
+    ALIGN_END — the sequence-to-last-step convention)."""
 
     def __init__(self, features_reader: RecordReader,
                  labels_reader: Optional[RecordReader], batch_size: int,
-                 num_classes: Optional[int] = None, regression: bool = False):
+                 num_classes: Optional[int] = None, regression: bool = False,
+                 align: str = "start"):
+        if align not in ("start", "end"):
+            raise ValueError(f"align must be 'start' or 'end', got {align!r}")
         self.fr = features_reader
         self.lr = labels_reader
         self._batch = batch_size
         self.num_classes = num_classes
         self.regression = regression
+        self.align = align
 
     def reset(self):
         self.fr.reset()
@@ -138,18 +158,23 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
                 lseqs.append(l)
         T = max(s.shape[0] for s in fseqs)
         b = len(fseqs)
-        x = np.zeros((b, T, fseqs[0].shape[-1]), np.float32)
-        mask = np.zeros((b, T), np.float32)
-        for i, s in enumerate(fseqs):
-            x[i, :s.shape[0]] = s
-            mask[i, :s.shape[0]] = 1.0
+
+        def pack(seqs, width):
+            arr = np.zeros((b, T, width), np.float32)
+            mask = np.zeros((b, T), np.float32)
+            for i, s in enumerate(seqs):
+                if self.align == "end":
+                    arr[i, T - s.shape[0]:] = s
+                    mask[i, T - s.shape[0]:] = 1.0
+                else:
+                    arr[i, :s.shape[0]] = s
+                    mask[i, :s.shape[0]] = 1.0
+            return arr, mask
+
+        x, mask = pack(fseqs, fseqs[0].shape[-1])
         if self.lr is None:
             return DataSet(x, x, features_mask=mask, labels_mask=mask)
-        y = np.zeros((b, T, lseqs[0].shape[-1]), np.float32)
-        lmask = np.zeros((b, T), np.float32)
-        for i, s in enumerate(lseqs):
-            y[i, :s.shape[0]] = s
-            lmask[i, :s.shape[0]] = 1.0
+        y, lmask = pack(lseqs, lseqs[0].shape[-1])
         return DataSet(x, y, features_mask=mask, labels_mask=lmask)
 
 

@@ -290,7 +290,8 @@ def _auto_wire(mlc: MultiLayerConfiguration) -> None:
 def _family(layer: L.Layer) -> str:
     if isinstance(layer, (L.ConvolutionLayer, L.SubsamplingLayer, L.LocalResponseNormalization)):
         return "cnn"
-    if isinstance(layer, (L.GravesLSTM, L.GravesBidirectionalLSTM, L.RnnOutputLayer)):
+    if isinstance(layer, (L.GravesLSTM, L.GravesBidirectionalLSTM, L.RnnOutputLayer,
+                          L.AttentionLayer)):
         return "rnn"
     if isinstance(layer, (L.BatchNormalization, L.ActivationLayer, L.LossLayer,
                           L.DropoutLayer, L.GlobalPoolingLayer)):
@@ -352,6 +353,11 @@ def _wire_layer(mlc: MultiLayerConfiguration, i: int, layer: L.Layer, t: InputTy
         return t
     if isinstance(layer, (L.GravesLSTM, L.GravesBidirectionalLSTM)):
         set_nin(t.size)
+        return InputType.recurrent(layer.n_out, t.timesteps)
+    if isinstance(layer, L.AttentionLayer):
+        set_nin(t.size)
+        if getattr(layer, "n_out", None) is None:
+            object.__setattr__(layer, "n_out", layer.n_in)
         return InputType.recurrent(layer.n_out, t.timesteps)
     if isinstance(layer, L.RnnOutputLayer):
         set_nin(t.size)

@@ -189,6 +189,24 @@ class GravesBidirectionalLSTM(GravesLSTM):
 
 @register_layer
 @dataclasses.dataclass(frozen=True)
+class AttentionLayer(FeedForwardLayer):
+    """Multi-head self-attention over [b, t, f] sequences.
+
+    No reference counterpart (the reference predates attention —
+    SURVEY.md §5 long-context note); this is the SURVEY §7.7 extension
+    made user-reachable. Backed by ``ops/attention.py``; when a
+    sequence-parallel mesh is active (``parallel.mesh.sequence_mesh``),
+    the impl automatically switches to the ring-attention kernel
+    (``parallel/ring_attention.py``) and shards time over the mesh's
+    ``seq`` axis."""
+
+    num_heads: int = 4
+    causal: bool = False
+    residual: bool = True  # x + attn(x) — standard transformer block wiring
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
 class EmbeddingLayer(FeedForwardLayer):
     """``nn/conf/layers/EmbeddingLayer.java`` — index lookup as one-hot
     matmul (MXU-friendly gather; input is int indices [batch] or

@@ -402,10 +402,18 @@ class ComputationGraph:
             return
         self._fit_batch_inner(mds)
 
+    def _seq_token(self):
+        """Seq-parallel context marker for jit cache keys (see
+        MultiLayerNetwork._seq_token)."""
+        from deeplearning4j_tpu.parallel.mesh import current_sequence_mesh
+        s = current_sequence_mesh()
+        return None if s is None else (id(s[0]), s[1])
+
     def _fit_batch_inner(self, mds: MultiDataSet) -> None:
-        if "train" not in self._jits:
-            self._jits["train"] = self._make_train_step()
-        step = self._jits["train"]
+        key = ("train", self._seq_token())
+        if key not in self._jits:
+            self._jits[key] = self._make_train_step()
+        step = self._jits[key]
         rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
         inputs, labels, fmasks, lmasks = self._tensors(mds)
         for _ in range(max(1, self.gc.iterations)):
@@ -520,9 +528,10 @@ class ComputationGraph:
         if self.params is None:
             self.init()
         xb, yb = staged if staged is not None else self.stage_scan(data, batch_size)
-        if "scan_fit" not in self._jits:
-            self._jits["scan_fit"] = self._make_scan_fit()
-        fit = self._jits["scan_fit"]
+        key = ("scan_fit", self._seq_token())
+        if key not in self._jits:
+            self._jits[key] = self._make_scan_fit()
+        fit = self._jits[key]
         rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
         all_scores = []
         for _ in range(epochs):
@@ -650,7 +659,7 @@ class ComputationGraph:
         """``ComputationGraph.outputs`` — activations of all graph outputs."""
         inputs = {n: jnp.asarray(f, self._dtype) for n, f in zip(self.input_names, features)}
         fmasks = {k: jnp.asarray(v, self._dtype) for k, v in (features_masks or {}).items()}
-        key = ("outputs", tuple(sorted(fmasks)))
+        key = ("outputs", tuple(sorted(fmasks)), self._seq_token())
         if key not in self._jits:
             self._jits[key] = jax.jit(
                 lambda p, s, i, fm: self._forward_all(p, s, i, False, None, fm)[0])

@@ -11,6 +11,7 @@ XLA emits TPU kernels for conv/pool/norm directly.
 
 from deeplearning4j_tpu.nn.layers.base import LayerImpl, build_layer  # noqa: F401
 from deeplearning4j_tpu.nn.layers import (  # noqa: F401  (registers impls)
+    attention,
     convolution,
     feedforward,
     normalization,

@@ -1,0 +1,71 @@
+"""Multi-head self-attention layer impl.
+
+No reference counterpart (SURVEY.md §5: the reference's only
+long-context tool is truncated BPTT); this makes the round-1 orphan
+``ops/attention.py`` capability user-reachable as a layer (VERDICT r1
+next-round #8) and is the on-ramp to sequence parallelism: when a
+``parallel.mesh.sequence_mesh`` context is active the forward switches
+to the ring-attention kernel (``parallel/ring_attention.py``), sharding
+time over the mesh's ``seq`` axis with K/V blocks rotating over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers.base import LayerImpl, register_impl
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.attention import scaled_dot_product_attention
+from deeplearning4j_tpu.parallel.mesh import current_sequence_mesh
+from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+
+
+@register_impl(L.AttentionLayer)
+class AttentionImpl(LayerImpl):
+    def init_params(self, key) -> Dict[str, jnp.ndarray]:
+        c = self.conf
+        if c.n_out % c.num_heads != 0:
+            raise ValueError(f"n_out {c.n_out} not divisible by num_heads {c.num_heads}")
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        mk = lambda k, shape: init_weights(k, shape, self.weight_init,
+                                           shape[0], shape[1],
+                                           c.dist_mean, c.dist_std)
+        return {
+            "Wq": mk(kq, (c.n_in, c.n_out)),
+            "Wk": mk(kk, (c.n_in, c.n_out)),
+            "Wv": mk(kv, (c.n_in, c.n_out)),
+            "Wo": mk(ko, (c.n_out, c.n_out)),
+            "bo": jnp.zeros((c.n_out,), jnp.float32),
+        }
+
+    def forward(self, params, x, state, train, rng=None, mask=None):
+        c = self.conf
+        x = self.maybe_dropout_input(x, train, rng)
+        b, t, _ = x.shape
+        h = c.num_heads
+        d = c.n_out // h
+        split = lambda z: z.reshape(b, t, h, d)
+        q = split(x @ params["Wq"].astype(x.dtype))
+        k = split(x @ params["Wk"].astype(x.dtype))
+        v = split(x @ params["Wv"].astype(x.dtype))
+        seq = current_sequence_mesh()
+        if seq is not None and mask is None:
+            mesh, axis = seq
+            o = ring_attention(q, k, v, mesh, axis=axis, causal=c.causal)
+        else:
+            # mask (variable-length) stays on the full-attention path —
+            # ring blocks assume dense time
+            o = scaled_dot_product_attention(q, k, v, causal=c.causal, mask=mask)
+        out = o.reshape(b, t, c.n_out) @ params["Wo"].astype(x.dtype) \
+            + params["bo"].astype(x.dtype)
+        if c.residual:
+            if c.n_in != c.n_out:
+                raise ValueError("residual attention needs n_in == n_out")
+            out = out + x
+        if mask is not None:
+            out = out * mask[:, :, None].astype(out.dtype)
+        return out, state

@@ -181,8 +181,16 @@ class MultiLayerNetwork:
 
         return jax.jit(step, donate_argnums=(0, 1))
 
+    def _seq_token(self):
+        """Sequence-parallel context marker for jit cache keys — a trace
+        made inside ``sequence_mesh`` bakes the ring-attention path in,
+        so cached executables must be keyed on the active context."""
+        from deeplearning4j_tpu.parallel.mesh import current_sequence_mesh
+        s = current_sequence_mesh()
+        return None if s is None else (id(s[0]), s[1])
+
     def _get_jit(self, kind: str, **flags):
-        key = (kind, tuple(sorted(flags.items())))
+        key = (kind, tuple(sorted(flags.items())), self._seq_token())
         if key not in self._jits:
             if kind == "train":
                 self._jits[key] = self._make_train_step(flags["fm"], flags["lm"])
@@ -447,7 +455,7 @@ class MultiLayerNetwork:
         if self.params is None:
             self.init()
         xb, yb = staged if staged is not None else self.stage_scan(ds, batch_size)
-        key = ("scan_fit",)
+        key = ("scan_fit", self._seq_token())
         if key not in self._jits:
             self._jits[key] = self._make_scan_fit()
         fit = self._jits[key]

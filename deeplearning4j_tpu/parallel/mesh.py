@@ -69,3 +69,37 @@ class MeshContext:
 
     def data_axis_size(self) -> int:
         return self.mesh.shape.get("data", 1)
+
+
+# ---------------------------------------------------------- seq-parallel ctx
+
+_SEQ_MESH: list = []  # stack of (mesh, axis)
+
+
+class sequence_mesh:
+    """Context manager activating sequence parallelism: while active,
+    AttentionLayer impls route through the ring-attention kernel with
+    time sharded over ``axis`` of ``mesh``::
+
+        with sequence_mesh(mesh):          # mesh has a "seq" axis
+            net.fit(...)                   # attention now rings over ICI
+    """
+
+    def __init__(self, mesh: Mesh, axis: str = "seq"):
+        if axis not in mesh.shape:
+            raise ValueError(f"mesh {dict(mesh.shape)} has no '{axis}' axis")
+        self.mesh = mesh
+        self.axis = axis
+
+    def __enter__(self):
+        _SEQ_MESH.append((self.mesh, self.axis))
+        return self
+
+    def __exit__(self, *exc):
+        _SEQ_MESH.pop()
+        return False
+
+
+def current_sequence_mesh():
+    """(mesh, axis) if sequence parallelism is active, else None."""
+    return _SEQ_MESH[-1] if _SEQ_MESH else None

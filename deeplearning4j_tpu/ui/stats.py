@@ -45,16 +45,28 @@ class StatsReport:
     memory: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
-        d = dataclasses.asdict(self)
-        # NaN is not valid strict JSON — ship null so jq/JS can parse it
-        if not np.isfinite(d["duration_ms"]):
-            d["duration_ms"] = None
-        return d
+        # non-finite floats are not valid strict JSON — ship null so
+        # jq/JS can parse report lines even from diverged runs
+        def clean(v):
+            if isinstance(v, float) and not np.isfinite(v):
+                return None
+            if isinstance(v, dict):
+                return {k: clean(x) for k, x in v.items()}
+            if isinstance(v, list):
+                return [clean(x) for x in v]
+            return v
+        return clean(dataclasses.asdict(self))
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "StatsReport":
-        if d.get("duration_ms") is None:
-            d = {**d, "duration_ms": float("nan")}
+        d = dict(d)
+        for k in ("duration_ms", "score"):
+            if d.get(k) is None:
+                d[k] = float("nan")
+        for k in ("param_norms", "update_norms", "memory"):
+            if d.get(k):
+                d[k] = {kk: (float("nan") if v is None else v)
+                        for kk, v in d[k].items()}
         return StatsReport(**d)
 
 

@@ -154,3 +154,29 @@ def test_train_from_record_reader_end_to_end(rng):
                                          label_index=-1, num_classes=3)
         net.fit(it)
     assert np.isfinite(net.score())
+
+
+def test_string_labels_deterministic_order():
+    """Label indices must come from the sorted label set, not encounter
+    order, so independently built train/test iterators agree."""
+    a = ["1,dog", "2,cat", "3,dog"]
+    b = ["4,cat", "5,dog"]
+    ita = RecordReaderDataSetIterator(CSVRecordReader(a), 8, num_classes=2)
+    itb = RecordReaderDataSetIterator(CSVRecordReader(b), 8, num_classes=2)
+    da, db = ita.next(), itb.next()
+    # cat=0, dog=1 in both regardless of encounter order
+    np.testing.assert_allclose(da.labels, [[0, 1], [1, 0], [0, 1]])
+    np.testing.assert_allclose(db.labels, [[1, 0], [0, 1]])
+
+
+def test_sequence_align_end(tmp_path):
+    f1 = tmp_path / "a.csv"
+    f1.write_text("1,1\n2,2\n3,3\n")
+    f2 = tmp_path / "b.csv"
+    f2.write_text("9,9\n")
+    it = SequenceRecordReaderDataSetIterator(
+        CSVSequenceRecordReader([str(f1), str(f2)]), None, 2, align="end")
+    ds = it.next()
+    np.testing.assert_allclose(ds.features_mask, [[1, 1, 1], [0, 0, 1]])
+    np.testing.assert_allclose(ds.features[1, 2], [9, 9])  # last step aligned
+    np.testing.assert_allclose(ds.features[1, 0], [0, 0])
