@@ -39,14 +39,18 @@ class RecordReaderDataSetIterator(DataSetIterator):
     def __init__(self, reader: RecordReader, batch_size: int,
                  label_index: Optional[int] = -1,
                  num_classes: Optional[int] = None,
-                 regression: bool = False):
+                 regression: bool = False,
+                 labels: Optional[Sequence[str]] = None):
         self.reader = reader
         self._batch = batch_size
         self.label_index = label_index
         self.num_classes = num_classes
         self.regression = regression
         self._label_map: Dict[str, int] = {}
-        if (not regression and label_index is not None
+        if labels is not None:
+            # explicit canonical label list (cross-split contract)
+            self._label_map = {s: i for i, s in enumerate(labels)}
+        elif (not regression and label_index is not None
                 and not isinstance(reader, ImageRecordReader)):
             # canonical (sorted) string-label map, like the reference's
             # label list: first-encounter order would make the class
@@ -59,6 +63,13 @@ class RecordReaderDataSetIterator(DataSetIterator):
                 if isinstance(vals[li], str):
                     strings.add(vals[li])
             self._label_map = {s: i for i, s in enumerate(sorted(strings))}
+            if (strings and num_classes is not None
+                    and len(self._label_map) != num_classes):
+                raise ValueError(
+                    f"this split contains {len(self._label_map)} distinct "
+                    f"string labels ({sorted(strings)}) but num_classes="
+                    f"{num_classes}; indices would disagree across splits — "
+                    f"pass labels=<canonical list> to pin the mapping")
         self.reader.reset()
 
     def reset(self):

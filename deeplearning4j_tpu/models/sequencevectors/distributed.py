@@ -1,0 +1,166 @@
+"""Mesh-sharded embedding training steps (distributed word2vec/GloVe).
+
+The reference scales embedding training two ways: Hogwild threads on one
+box (``SequenceVectors.java:1008``) and Spark map-side updates across a
+cluster (``dl4j-spark-nlp/.../Word2VecPerformer.java``,
+``TextPipeline.java``). Both are asynchronous-racy by design. The
+TPU-native replacement is synchronous SPMD over the mesh:
+
+- ``data`` axis: the pair stream is sharded per device; each device
+  scatter-adds its own delta into a zero buffer and the deltas are
+  summed with ``psum`` — addition commutes, so the result is EXACTLY
+  the single-device batched update (the equivalence the Hogwild design
+  gave up).
+- ``model`` axis (optional): syn0/syn1 are sharded along the embedding
+  dimension; dot products psum over the axis, updates stay local to
+  each dim shard — vectors larger than one chip's HBM scale across ICI.
+
+Padding: batches are padded to a multiple of the data-axis size with
+weight-0 entries, which contribute exactly zero gradient and are
+excluded from the loss denominator, preserving equivalence for every
+batch size.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _maybe_psum(x, axis: Optional[str]):
+    return x if axis is None else jax.lax.psum(x, axis)
+
+
+def make_sharded_sgns_step(mesh: Mesh, data_axis: str = "data",
+                           model_axis: Optional[str] = None):
+    """Sharded skip-gram negative sampling step. Signature:
+    (syn0, syn1neg, centers, contexts, negatives, weights, lr) →
+    (syn0', syn1neg', loss). ``weights`` ∈ {0,1} masks padded pairs."""
+    if model_axis is not None and model_axis not in mesh.shape:
+        model_axis = None
+    table_spec = P(None, model_axis)
+
+    def local(syn0, syn1neg, centers, contexts, negatives, w, lr):
+        v = syn0[centers]
+        u_pos = syn1neg[contexts]
+        u_neg = syn1neg[negatives]
+        s_pos = _maybe_psum(jnp.sum(v * u_pos, axis=-1), model_axis)
+        s_neg = _maybe_psum(jnp.einsum("bd,bkd->bk", v, u_neg), model_axis)
+        neg_ok = (negatives != contexts[:, None]).astype(s_neg.dtype) * w[:, None]
+        g_pos = (1.0 - jax.nn.sigmoid(s_pos)) * w
+        g_neg = -jax.nn.sigmoid(s_neg) * neg_ok
+        dv = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+        du_pos = g_pos[:, None] * v
+        du_neg = g_neg[..., None] * v[:, None, :]
+        d0 = jnp.zeros_like(syn0).at[centers].add(lr * dv)
+        d1 = jnp.zeros_like(syn1neg).at[contexts].add(lr * du_pos)
+        d1 = d1.at[negatives].add(lr * du_neg)
+        d0 = jax.lax.psum(d0, data_axis)
+        d1 = jax.lax.psum(d1, data_axis)
+        loss_sum = -(jnp.sum(jnp.log(jax.nn.sigmoid(s_pos) + 1e-10) * w)
+                     + jnp.sum(jnp.log(jax.nn.sigmoid(-s_neg) + 1e-10) * neg_ok))
+        loss_sum = jax.lax.psum(loss_sum, data_axis)
+        count = jax.lax.psum(jnp.sum(w), data_axis)
+        return syn0 + d0, syn1neg + d1, loss_sum / jnp.maximum(count, 1.0)
+
+    shard = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(table_spec, table_spec, P(data_axis), P(data_axis),
+                  P(data_axis, None), P(data_axis), P()),
+        out_specs=(table_spec, table_spec, P()))
+    return jax.jit(shard, donate_argnums=(0, 1))
+
+
+def make_sharded_hs_step(mesh: Mesh, data_axis: str = "data",
+                         model_axis: Optional[str] = None):
+    """Sharded hierarchical-softmax step. Signature:
+    (syn0, syn1, centers, codes, points, code_mask, weights, lr)."""
+    if model_axis is not None and model_axis not in mesh.shape:
+        model_axis = None
+    table_spec = P(None, model_axis)
+
+    def local(syn0, syn1, centers, codes, points, code_mask, w, lr):
+        v = syn0[centers]
+        u = syn1[points]
+        s = _maybe_psum(jnp.einsum("bd,bld->bl", v, u), model_axis)
+        cm = code_mask * w[:, None]
+        g = (1.0 - codes - jax.nn.sigmoid(s)) * cm
+        dv = jnp.einsum("bl,bld->bd", g, u)
+        du = g[..., None] * v[:, None, :]
+        d0 = jnp.zeros_like(syn0).at[centers].add(lr * dv)
+        d1 = jnp.zeros_like(syn1).at[points].add(lr * du)
+        d0 = jax.lax.psum(d0, data_axis)
+        d1 = jax.lax.psum(d1, data_axis)
+        p = jax.nn.sigmoid(jnp.where(codes > 0, -s, s))
+        loss_sum = jax.lax.psum(-jnp.sum(jnp.log(p + 1e-10) * cm), data_axis)
+        count = jax.lax.psum(jnp.sum(cm), data_axis)
+        return syn0 + d0, syn1 + d1, loss_sum / jnp.maximum(count, 1.0)
+
+    shard = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(table_spec, table_spec, P(data_axis), P(data_axis, None),
+                  P(data_axis, None), P(data_axis, None), P(data_axis), P()),
+        out_specs=(table_spec, table_spec, P()))
+    return jax.jit(shard, donate_argnums=(0, 1))
+
+
+def make_sharded_cbow_step(mesh: Mesh, data_axis: str = "data",
+                           model_axis: Optional[str] = None):
+    """Sharded CBOW + negative-sampling step. Signature:
+    (syn0, syn1neg, ctx, ctx_mask, centers, negatives, weights, lr)."""
+    if model_axis is not None and model_axis not in mesh.shape:
+        model_axis = None
+    table_spec = P(None, model_axis)
+
+    def local(syn0, syn1neg, ctx, ctx_mask, centers, negatives, w, lr):
+        u_ctx = syn0[ctx]                               # [B, C, d]
+        m = ctx_mask[..., None]
+        cnt = jnp.maximum(jnp.sum(ctx_mask, axis=-1, keepdims=True), 1.0)
+        h = jnp.sum(u_ctx * m, axis=1) / cnt[..., 0][:, None]  # mean context
+        u_pos = syn1neg[centers]
+        u_neg = syn1neg[negatives]
+        s_pos = _maybe_psum(jnp.sum(h * u_pos, axis=-1), model_axis)
+        s_neg = _maybe_psum(jnp.einsum("bd,bkd->bk", h, u_neg), model_axis)
+        neg_ok = (negatives != centers[:, None]).astype(s_neg.dtype) * w[:, None]
+        g_pos = (1.0 - jax.nn.sigmoid(s_pos)) * w
+        g_neg = -jax.nn.sigmoid(s_neg) * neg_ok
+        dh = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+        du_pos = g_pos[:, None] * h
+        du_neg = g_neg[..., None] * h[:, None, :]
+        dctx = (dh[:, None, :] * m) / cnt[..., None]
+        d0 = jnp.zeros_like(syn0).at[ctx].add(lr * dctx)
+        d1 = jnp.zeros_like(syn1neg).at[centers].add(lr * du_pos)
+        d1 = d1.at[negatives].add(lr * du_neg)
+        d0 = jax.lax.psum(d0, data_axis)
+        d1 = jax.lax.psum(d1, data_axis)
+        loss_sum = -(jnp.sum(jnp.log(jax.nn.sigmoid(s_pos) + 1e-10) * w)
+                     + jnp.sum(jnp.log(jax.nn.sigmoid(-s_neg) + 1e-10) * neg_ok))
+        loss_sum = jax.lax.psum(loss_sum, data_axis)
+        count = jax.lax.psum(jnp.sum(w), data_axis)
+        return syn0 + d0, syn1neg + d1, loss_sum / jnp.maximum(count, 1.0)
+
+    shard = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(table_spec, table_spec, P(data_axis, None), P(data_axis, None),
+                  P(data_axis), P(data_axis, None), P(data_axis), P()),
+        out_specs=(table_spec, table_spec, P()))
+    return jax.jit(shard, donate_argnums=(0, 1))
+
+
+def pad_to_multiple(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+def place_tables(mesh: Mesh, syn0: np.ndarray, syn1: np.ndarray,
+                 model_axis: Optional[str] = None):
+    """Place syn0/syn1 with the embedding dim sharded over model_axis
+    (replicated when absent)."""
+    if model_axis is not None and model_axis not in mesh.shape:
+        model_axis = None
+    sh = NamedSharding(mesh, P(None, model_axis))
+    return jax.device_put(jnp.asarray(syn0), sh), jax.device_put(jnp.asarray(syn1), sh)

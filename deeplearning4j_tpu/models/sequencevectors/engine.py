@@ -170,7 +170,8 @@ class SequenceVectors:
                  learning_rate: float = 0.025, min_learning_rate: float = 1e-4,
                  negative: int = 5, use_hierarchic_softmax: bool = False,
                  subsampling: float = 0.0, batch_size: int = 4096,
-                 elements_learning_algorithm: str = "skipgram", seed: int = 123):
+                 elements_learning_algorithm: str = "skipgram", seed: int = 123,
+                 mesh=None, data_axis: str = "data", model_axis: str = "model"):
         self.vector_length = vector_length
         self.window = window
         self.min_word_frequency = min_word_frequency
@@ -183,6 +184,16 @@ class SequenceVectors:
         self.batch_size = batch_size
         self.algo = elements_learning_algorithm
         self.seed = seed
+        # mesh-sharded training (the Spark-NLP distributed word2vec role):
+        # pair stream over data_axis, embedding dim over model_axis
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+        if mesh is not None and data_axis not in mesh.shape:
+            raise ValueError(
+                f"mesh {dict(mesh.shape)} has no '{data_axis}' axis; the pair "
+                f"stream needs one — for pure embedding-dim sharding use "
+                f"{{'{data_axis}': 1, '{model_axis}': N}}")
         self.vocab: Optional[VocabCache] = None
         self.lookup_table: Optional[InMemoryLookupTable] = None
         self.huffman: Optional[Huffman] = None
@@ -224,8 +235,33 @@ class SequenceVectors:
             self.build_vocab(token_lists)
         lt = self.lookup_table
         rng = np.random.default_rng(self.seed)
-        syn0 = jnp.asarray(lt.syn0)
-        syn1 = jnp.asarray(lt.syn1) if self.use_hs else jnp.asarray(lt.syn1neg)
+        sharded = self.mesh is not None
+        if sharded:
+            from deeplearning4j_tpu.models.sequencevectors.distributed import (
+                make_sharded_cbow_step, make_sharded_hs_step,
+                make_sharded_sgns_step, place_tables)
+            dsize = self.mesh.shape[self.data_axis]
+            syn0, syn1 = place_tables(
+                self.mesh, lt.syn0, lt.syn1 if self.use_hs else lt.syn1neg,
+                self.model_axis)
+            kw = dict(data_axis=self.data_axis, model_axis=self.model_axis)
+            if self.algo == "cbow":
+                sh_step = make_sharded_cbow_step(self.mesh, **kw)
+            elif self.use_hs:
+                sh_step = make_sharded_hs_step(self.mesh, **kw)
+            else:
+                sh_step = make_sharded_sgns_step(self.mesh, **kw)
+
+            def pad(arr, target):
+                arr = np.asarray(arr)
+                n = len(arr)
+                if n == target:
+                    return arr
+                padding = np.zeros((target - n,) + arr.shape[1:], arr.dtype)
+                return np.concatenate([arr, padding])
+        else:
+            syn0 = jnp.asarray(lt.syn0)
+            syn1 = jnp.asarray(lt.syn1) if self.use_hs else jnp.asarray(lt.syn1neg)
         neg_table = lt.negative_table() if not self.use_hs else None
         if self.use_hs:
             codes = jnp.asarray(self.huffman.codes)
@@ -258,21 +294,47 @@ class SequenceVectors:
                 cb = centers[s:s + B]
                 if len(cb) == 0:
                     continue
+                if sharded:
+                    from deeplearning4j_tpu.models.sequencevectors.distributed import pad_to_multiple
+                    tgt = pad_to_multiple(len(cb), dsize)
+                    w = np.zeros(tgt, np.float32)
+                    w[:len(cb)] = 1.0
+                    w = jnp.asarray(w)
                 if self.algo == "cbow":
                     negs = rng.choice(neg_table, (len(cb), self.negative))
-                    syn0, syn1, loss = _cbow_sgns_step(
-                        syn0, syn1, jnp.asarray(ctx[s:s + B]), jnp.asarray(cmask_b[s:s + B]),
-                        jnp.asarray(cb), jnp.asarray(negs, jnp.int32), lr)
+                    if sharded:
+                        syn0, syn1, loss = sh_step(
+                            syn0, syn1,
+                            jnp.asarray(pad(ctx[s:s + B], tgt)),
+                            jnp.asarray(pad(cmask_b[s:s + B], tgt)),
+                            jnp.asarray(pad(cb, tgt)),
+                            jnp.asarray(pad(negs, tgt), jnp.int32), w, lr)
+                    else:
+                        syn0, syn1, loss = _cbow_sgns_step(
+                            syn0, syn1, jnp.asarray(ctx[s:s + B]), jnp.asarray(cmask_b[s:s + B]),
+                            jnp.asarray(cb), jnp.asarray(negs, jnp.int32), lr)
                 elif self.use_hs:
                     xb = contexts[s:s + B]
-                    syn0, syn1, loss = _hs_step(
-                        syn0, syn1, jnp.asarray(cb), codes[jnp.asarray(xb)],
-                        points[jnp.asarray(xb)], cmask[jnp.asarray(xb)], lr)
+                    if sharded:
+                        xj = jnp.asarray(pad(xb, tgt))
+                        syn0, syn1, loss = sh_step(
+                            syn0, syn1, jnp.asarray(pad(cb, tgt)), codes[xj],
+                            points[xj], cmask[xj], w, lr)
+                    else:
+                        syn0, syn1, loss = _hs_step(
+                            syn0, syn1, jnp.asarray(cb), codes[jnp.asarray(xb)],
+                            points[jnp.asarray(xb)], cmask[jnp.asarray(xb)], lr)
                 else:
                     negs = rng.choice(neg_table, (len(cb), self.negative))
-                    syn0, syn1, loss = _sgns_step(
-                        syn0, syn1, jnp.asarray(cb), jnp.asarray(contexts[s:s + B]),
-                        jnp.asarray(negs, jnp.int32), lr)
+                    if sharded:
+                        syn0, syn1, loss = sh_step(
+                            syn0, syn1, jnp.asarray(pad(cb, tgt)),
+                            jnp.asarray(pad(contexts[s:s + B], tgt)),
+                            jnp.asarray(pad(negs, tgt), jnp.int32), w, lr)
+                    else:
+                        syn0, syn1, loss = _sgns_step(
+                            syn0, syn1, jnp.asarray(cb), jnp.asarray(contexts[s:s + B]),
+                            jnp.asarray(negs, jnp.int32), lr)
                 step_i += 1
                 if step_i % 10 == 0:
                     self._loss_history.append(float(loss))

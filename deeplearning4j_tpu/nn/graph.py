@@ -403,11 +403,10 @@ class ComputationGraph:
         self._fit_batch_inner(mds)
 
     def _seq_token(self):
-        """Seq-parallel context marker for jit cache keys (see
-        MultiLayerNetwork._seq_token)."""
-        from deeplearning4j_tpu.parallel.mesh import current_sequence_mesh
-        s = current_sequence_mesh()
-        return None if s is None else (id(s[0]), s[1])
+        """Sequence-parallel context marker for jit cache keys
+        (parallel/mesh.py sequence_mesh_token)."""
+        from deeplearning4j_tpu.parallel.mesh import sequence_mesh_token
+        return sequence_mesh_token()
 
     def _fit_batch_inner(self, mds: MultiDataSet) -> None:
         key = ("train", self._seq_token())

@@ -44,6 +44,11 @@ class AttentionImpl(LayerImpl):
 
     def forward(self, params, x, state, train, rng=None, mask=None):
         c = self.conf
+        if x.ndim != 3:
+            raise ValueError(
+                f"AttentionLayer needs [batch, time, features] input, got "
+                f"shape {x.shape}. Stepwise rnn_time_step inference is not "
+                f"supported for attention (no KV cache) — feed full windows.")
         x = self.maybe_dropout_input(x, train, rng)
         b, t, _ = x.shape
         h = c.num_heads

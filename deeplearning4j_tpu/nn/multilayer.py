@@ -182,12 +182,10 @@ class MultiLayerNetwork:
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _seq_token(self):
-        """Sequence-parallel context marker for jit cache keys — a trace
-        made inside ``sequence_mesh`` bakes the ring-attention path in,
-        so cached executables must be keyed on the active context."""
-        from deeplearning4j_tpu.parallel.mesh import current_sequence_mesh
-        s = current_sequence_mesh()
-        return None if s is None else (id(s[0]), s[1])
+        """Sequence-parallel context marker for jit cache keys
+        (parallel/mesh.py sequence_mesh_token)."""
+        from deeplearning4j_tpu.parallel.mesh import sequence_mesh_token
+        return sequence_mesh_token()
 
     def _get_jit(self, kind: str, **flags):
         key = (kind, tuple(sorted(flags.items())), self._seq_token())

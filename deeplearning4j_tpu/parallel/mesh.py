@@ -103,3 +103,17 @@ class sequence_mesh:
 def current_sequence_mesh():
     """(mesh, axis) if sequence parallelism is active, else None."""
     return _SEQ_MESH[-1] if _SEQ_MESH else None
+
+
+def sequence_mesh_token():
+    """Hashable marker of the active sequence-parallel context, for jit
+    cache keys: a trace made inside ``sequence_mesh`` bakes the ring
+    path in, so cached executables must be keyed on the mesh identity —
+    by topology + device ids (NOT ``id(mesh)``, which can be reused
+    after garbage collection and would serve a stale executable)."""
+    s = current_sequence_mesh()
+    if s is None:
+        return None
+    mesh, axis = s
+    return (tuple(mesh.shape.items()),
+            tuple(d.id for d in mesh.devices.flat), axis)

@@ -1,0 +1,138 @@
+"""Multi-host / multi-slice distribution.
+
+The reference's cluster plane is Spark: driver broadcast of params
+(``NetBroadcastTuple``), ``mapPartitions`` worker fit, ``RDD.aggregate``
+tree-reduce of parameter sums back to the driver
+(``ParameterAveragingTrainingMaster.java:336``, ``ExecuteWorkerFlatMap.java:37``).
+The TPU-native plane replaces every piece of that with SPMD:
+
+- cluster membership   → ``jax.distributed.initialize`` (coordinator
+  rendezvous; this module wraps it and picks gloo collectives on CPU
+  hosts so the same code runs in tests without TPUs)
+- broadcast of params  → replicated sharding over the global mesh
+- per-worker batches   → ``make_array_from_process_local_data`` (each
+  host contributes its local shard of the global batch; nothing ever
+  funnels through a driver)
+- aggregate+average    → the reduction INSIDE the compiled step: with
+  batch sharded over ``data`` and params replicated, GSPMD partitions
+  the loss mean and emits the gradient all-reduce over ICI within a
+  slice and DCN across slices — the ``RDD.aggregate`` tree with zero
+  host hops
+- driver checkpointing → process-0 save (every process holds the full
+  replicated params, so rank 0 writes and others barrier)
+
+Mesh doctrine (scaling-book recipe): DCN-connected slices form OUTER
+mesh axes (data parallelism — one all-reduce per step tolerates DCN
+latency), ICI-connected devices form INNER axes (model/seq parallelism
+— per-layer collectives need ICI bandwidth).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the cluster (``jax.distributed.initialize`` wrapper).
+
+    On TPU pods the three arguments come from the environment and may be
+    omitted. On CPU hosts (tests, the `local[N]` analog) pass them
+    explicitly; gloo collectives are selected automatically.
+    """
+    # NOTE: must run before ANY backend-initializing jax call (including
+    # jax.process_count()), so no "already initialized" probe here
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # TPU builds may not expose the option; collectives ride ICI
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)
+
+
+def is_coordinator() -> bool:
+    """True on the process that plays the reference's driver role."""
+    return jax.process_index() == 0
+
+
+def make_multihost_mesh(dcn_axes: Optional[Dict[str, int]] = None,
+                        ici_axes: Optional[Dict[str, int]] = None) -> Mesh:
+    """Global mesh with DCN axes OUTER (across hosts/slices) and ICI
+    axes INNER (within a slice). Defaults: pure data parallelism with
+    ``data`` split across processes × local devices.
+
+    Device order in ``jax.devices()`` groups each process's local
+    devices contiguously, so reshaping [dcn..., ici...] puts process
+    boundaries on the outer (DCN) axes — collectives over inner axes
+    stay on-host/on-slice.
+    """
+    devices = jax.devices()
+    n_proc = jax.process_count()
+    if dcn_axes is None:
+        dcn_axes = {"data": n_proc}
+    if ici_axes is None:
+        # data absorbs whatever the explicit axes leave over (pure-DP
+        # default: data = n_proc * local_devices)
+        ici_axes = {}
+    names = list(dcn_axes.keys()) + list(ici_axes.keys())
+    sizes = list(dcn_axes.values()) + list(ici_axes.values())
+    if "data" in dcn_axes and int(np.prod(sizes)) != len(devices):
+        others = int(np.prod([v for k, v in dcn_axes.items() if k != "data"])) \
+            * int(np.prod(list(ici_axes.values()) or [1]))
+        if len(devices) % others == 0:
+            dcn_axes = {**dcn_axes, "data": len(devices) // others}
+            sizes = list(dcn_axes.values()) + list(ici_axes.values())
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(f"axes {names}={sizes} need {int(np.prod(sizes))} "
+                         f"devices, have {len(devices)}")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def global_batch(mesh: Mesh, local_arrays: Sequence[np.ndarray],
+                 axis: str = "data"):
+    """Assemble a global batch from each process's LOCAL shard — the
+    replacement for the reference's repartition/data-locality plane:
+    data never leaves the host that loaded it."""
+    out = []
+    for a in local_arrays:
+        if a is None:
+            out.append(None)
+            continue
+        spec = P(axis, *([None] * (np.ndim(a) - 1)))
+        out.append(jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), np.asarray(a)))
+    return out
+
+
+def replicate(mesh: Mesh, tree):
+    """Replicate a pytree of host arrays over the global mesh (the
+    ``NetBroadcastTuple`` broadcast, done by sharding)."""
+    sh = NamedSharding(mesh, P())
+    return jax.tree.map(
+        lambda v: jax.make_array_from_process_local_data(sh, np.asarray(v)),
+        tree)
+
+
+def save_checkpoint_process0(model, path: str) -> Optional[str]:
+    """Process-0 checkpoint write (driver-side save in the reference);
+    replicated params are fully addressable on every host, so rank 0
+    serializes and everyone else synchronizes."""
+    from jax.experimental import multihost_utils
+    if is_coordinator():
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        write_model(model, path)
+        result = path
+    else:
+        result = None
+    multihost_utils.sync_global_devices("checkpoint_write")
+    return result

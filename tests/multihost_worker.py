@@ -1,0 +1,82 @@
+"""Worker script for the multi-host equivalence test (the cluster
+analog of the reference's ``TestCompareParameterAveragingSparkVsSingleMachine``):
+run as N processes × M CPU devices, train DP over the global mesh, have
+process 0 dump the final params.
+
+Usage: python multihost_worker.py <pid> <nproc> <port> <out.npz>
+(single-process reference mode: nproc=1, no distributed init)
+
+Env (set by the spawner, BEFORE interpreter start): JAX_PLATFORMS=cpu,
+GRAFT_LOCAL_DEVICES=<M>, PALLAS_AXON_POOL_IPS removed.
+"""
+
+import os
+import sys
+
+pid, nproc, port, out = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+
+import jax  # noqa: E402
+
+jax.config.update("jax_num_cpu_devices", int(os.environ.get("GRAFT_LOCAL_DEVICES", "2")))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.parallel import multihost  # noqa: E402
+
+if nproc > 1:
+    multihost.initialize(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=nproc, process_id=pid)
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration  # noqa: E402
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: E402
+
+GLOBAL_BATCH = 32
+STEPS = 5
+
+conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.1)
+        .updater("sgd").activation("tanh")
+        .list()
+        .layer(DenseLayer(n_in=6, n_out=10))
+        .layer(OutputLayer(n_in=10, n_out=3, activation="softmax",
+                           loss_function="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+
+rng = np.random.default_rng(0)  # same data in every process
+X = rng.standard_normal((GLOBAL_BATCH, 6)).astype(np.float32)
+Y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, GLOBAL_BATCH)]
+
+mesh = multihost.make_multihost_mesh()  # pure DP over all devices
+assert dict(mesh.shape)["data"] == len(jax.devices()), dict(mesh.shape)
+
+# each process contributes only ITS slice of the global batch
+per = GLOBAL_BATCH // nproc
+lo = pid * per
+x_local, y_local = X[lo:lo + per], Y[lo:lo + per]
+xg, yg = multihost.global_batch(mesh, [x_local, y_local])
+
+# broadcast (replicate) params + optimizer state over the global mesh
+net.params = multihost.replicate(mesh, jax.device_get(net.params))
+net.opt_state = multihost.replicate(mesh, jax.device_get(net.opt_state))
+net.states = multihost.replicate(mesh, jax.device_get(net.states))
+
+step = net._get_jit("train", fm=False, lm=False)
+import jax.numpy as jnp  # noqa: E402
+
+zero = jnp.zeros(())
+key = jax.random.PRNGKey(1)
+for _ in range(STEPS):
+    net.params, net.opt_state, net.states, score = step(
+        net.params, net.opt_state, net.states, xg, yg, zero, zero, key)
+
+if pid == 0:
+    flat = {}
+    for ln, ps in jax.device_get(net.params).items():
+        for pn, v in ps.items():
+            flat[f"{ln}/{pn}"] = np.asarray(v)
+    np.savez(out, score=float(score), **flat)
+    print("saved", out, "score", float(score), flush=True)
+if nproc > 1:
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("done")
