@@ -1,0 +1,136 @@
+"""VP-tree nearest-neighbor index + brute-force TPU alternative.
+
+Parity: ``deeplearning4j-core/.../clustering/vptree/VPTree.java`` — a
+vantage-point tree for metric-space k-NN serving (the nearest-neighbors
+backend of word2vec ``wordsNearest`` and the UI's t-SNE hover).
+
+TPU-first note: a VP-tree is a pointer-chasing host structure — the
+right tool when queries arrive one at a time on the host. For batched
+queries the TPU answer is ``knn_brute``: ONE [q, n] distance matmul on
+the MXU + top-k, which saturates the chip and beats tree traversal for
+any batch big enough to matter (the same argument SURVEY §2.3 makes for
+exact t-SNE over Barnes-Hut). Both are provided; they agree exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _dist(metric: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """a: [d] or [m, d]; b: [n, d] → [n] or [m, n]."""
+    if metric == "euclidean":
+        diff = np.atleast_2d(a)[:, None, :] - b[None, :, :]
+        out = np.sqrt(np.maximum((diff * diff).sum(-1), 0.0))
+    elif metric == "cosine":
+        an = np.atleast_2d(a)
+        an = an / np.maximum(np.linalg.norm(an, axis=-1, keepdims=True), 1e-12)
+        bn = b / np.maximum(np.linalg.norm(b, axis=-1, keepdims=True), 1e-12)
+        out = 1.0 - an @ bn.T
+    else:
+        raise ValueError(f"unknown metric {metric}")
+    return out[0] if a.ndim == 1 else out
+
+
+@dataclasses.dataclass
+class _Node:
+    index: int                      # vantage point row
+    radius: float
+    inside: Optional["_Node"]
+    outside: Optional["_Node"]
+    leaf_indices: Optional[np.ndarray] = None
+
+
+class VPTree:
+    """Vantage-point tree (``VPTree.java``) over row vectors."""
+
+    def __init__(self, points: np.ndarray, metric: str = "euclidean",
+                 leaf_size: int = 16, seed: int = 0):
+        self.points = np.asarray(points, np.float64)
+        self.metric = metric
+        self.leaf_size = max(1, leaf_size)
+        rng = np.random.default_rng(seed)
+        self.root = self._build(np.arange(len(self.points)), rng)
+
+    def _build(self, idx: np.ndarray, rng) -> Optional[_Node]:
+        if len(idx) == 0:
+            return None
+        if len(idx) <= self.leaf_size:
+            return _Node(int(idx[0]), 0.0, None, None, leaf_indices=idx)
+        vp_pos = rng.integers(0, len(idx))
+        vp = int(idx[vp_pos])
+        rest = np.delete(idx, vp_pos)
+        d = _dist(self.metric, self.points[vp], self.points[rest])
+        radius = float(np.median(d))
+        inside = rest[d <= radius]
+        outside = rest[d > radius]
+        return _Node(vp, radius, self._build(inside, rng), self._build(outside, rng))
+
+    def search(self, query: np.ndarray, k: int = 1) -> Tuple[List[int], List[float]]:
+        """k nearest neighbors of one query vector: (indices, distances)."""
+        query = np.asarray(query, np.float64)
+        heap: List[Tuple[float, int]] = []  # max-heap by negated distance
+
+        def consider(indices):
+            d = _dist(self.metric, query, self.points[indices])
+            for i, di in zip(np.atleast_1d(indices), np.atleast_1d(d)):
+                if len(heap) < k:
+                    heap.append((float(di), int(i)))
+                    heap.sort(reverse=True)
+                elif di < heap[0][0]:
+                    heap[0] = (float(di), int(i))
+                    heap.sort(reverse=True)
+
+        def tau():
+            return heap[0][0] if len(heap) == k else np.inf
+
+        def visit(node: Optional[_Node]):
+            if node is None:
+                return
+            if node.leaf_indices is not None:
+                consider(node.leaf_indices)
+                return
+            dv = float(_dist(self.metric, query, self.points[node.index:node.index + 1])[0])
+            consider(np.asarray([node.index]))
+            # standard VP pruning: only descend a side if it can contain
+            # a point closer than the current kth distance
+            if dv <= node.radius:
+                visit(node.inside)
+                if dv + tau() > node.radius:
+                    visit(node.outside)
+            else:
+                visit(node.outside)
+                if dv - tau() <= node.radius:
+                    visit(node.inside)
+
+        visit(self.root)
+        heap.sort()
+        return [i for _, i in heap], [d for d, _ in heap]
+
+
+def knn_brute(points: np.ndarray, queries: np.ndarray, k: int,
+              metric: str = "euclidean") -> Tuple[np.ndarray, np.ndarray]:
+    """Batched exact k-NN on device: one distance matmul + top-k.
+    Returns (indices [q, k], distances [q, k]) — matches VPTree.search
+    exactly (same metric, full scan). This is the serving path for TPU
+    deployments; the VP-tree is the host-side single-query path."""
+    import jax
+    import jax.numpy as jnp
+
+    p = jnp.asarray(points, jnp.float32)
+    q = jnp.asarray(np.atleast_2d(queries), jnp.float32)
+    if metric == "euclidean":
+        # |q-p|^2 = |q|^2 - 2 q·p + |p|^2 ; the q·p term is the matmul
+        d2 = (jnp.sum(q * q, 1)[:, None] - 2.0 * q @ p.T + jnp.sum(p * p, 1)[None, :])
+        d = jnp.sqrt(jnp.maximum(d2, 0.0))
+    elif metric == "cosine":
+        qn = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+        pn = p / jnp.maximum(jnp.linalg.norm(p, axis=1, keepdims=True), 1e-12)
+        d = 1.0 - qn @ pn.T
+    else:
+        raise ValueError(f"unknown metric {metric}")
+    neg_d, idx = jax.lax.top_k(-d, k)
+    return np.asarray(idx), np.asarray(-neg_d)

@@ -55,6 +55,10 @@ def write_word_vectors_binary(wv: WordVectors, path: str) -> None:
 
 
 def read_word_vectors_binary(path: str) -> WordVectors:
+    """Reads both binary conventions: word2vec.c terminates each vector
+    with '\\n' (and so does our writer), gensim writes none — leading
+    whitespace before a word is skipped instead of assuming a trailing
+    byte, so files from either tool load identically."""
     with open(path, "rb") as f:
         header = f.readline().split()
         v, d = int(header[0]), int(header[1])
@@ -64,11 +68,19 @@ def read_word_vectors_binary(path: str) -> WordVectors:
             word = bytearray()
             while True:
                 c = f.read(1)
+                if not c:
+                    raise EOFError(f"truncated binary word2vec file at word {i}")
                 if c == b" ":
                     break
+                if c in (b"\n", b"\r") and not word:
+                    continue  # leading newline from the previous record
                 word.extend(c)
-            vectors[i] = np.frombuffer(f.read(4 * d), "<f4")
-            f.read(1)  # trailing newline
+            buf = f.read(4 * d)
+            if len(buf) != 4 * d:
+                raise EOFError(f"truncated binary word2vec file: word {i} "
+                               f"({word.decode('utf-8', 'replace')!r}) has "
+                               f"{len(buf)} of {4 * d} vector bytes")
+            vectors[i] = np.frombuffer(buf, "<f4")
             vocab.add_token(word.decode("utf-8"), max(1, v - i))
         vocab.finish()
     return WordVectors(vocab, vectors)

@@ -191,12 +191,15 @@ class MultiLayerConfiguration:
         )
 
     def to_yaml(self) -> str:
-        """YAML output for parity with ``toYaml`` :286 (JSON is valid YAML)."""
-        return self.to_json()
+        """Real YAML output (``toYaml`` :286 — the reference serializes
+        through Jackson's YAML factory; here PyYAML over the same dict)."""
+        from deeplearning4j_tpu.util.yaml_io import json_to_yaml
+        return json_to_yaml(self.to_json())
 
     @staticmethod
     def from_yaml(s: str) -> "MultiLayerConfiguration":
-        return MultiLayerConfiguration.from_json(s)
+        from deeplearning4j_tpu.util.yaml_io import yaml_to_json
+        return MultiLayerConfiguration.from_json(yaml_to_json(s))
 
 
 class ListBuilder:

@@ -143,6 +143,15 @@ class ComputationGraphConfiguration:
             "tbptt_back_length": self.tbptt_back_length,
         }, indent=2)
 
+    def to_yaml(self) -> str:
+        from deeplearning4j_tpu.util.yaml_io import json_to_yaml
+        return json_to_yaml(self.to_json())
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        from deeplearning4j_tpu.util.yaml_io import yaml_to_json
+        return ComputationGraphConfiguration.from_json(yaml_to_json(s))
+
     @staticmethod
     def from_json(s: str) -> "ComputationGraphConfiguration":
         d = json.loads(s)

@@ -1,0 +1,144 @@
+"""Document iterators + labels providers.
+
+Parity: ``text/documentiterator/`` — DocumentIterator (stream of whole
+documents), LabelAwareDocumentIterator / LabelAwareIterator (documents
+with labels for ParagraphVectors), LabelsSource (label generator), and
+FileDocumentIterator (one document per file; parent dir = label).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+
+class DocumentIterator:
+    """``DocumentIterator`` contract: stream documents as raw text."""
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next_document(self) -> str:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[str]:
+        self.reset()
+        while self.has_next():
+            yield self.next_document()
+
+
+class LabelAwareDocumentIterator(DocumentIterator):
+    """``LabelAwareDocumentIterator`` — adds current_label()."""
+
+    def current_label(self) -> str:
+        raise NotImplementedError
+
+
+class CollectionDocumentIterator(DocumentIterator):
+    def __init__(self, docs: Sequence[str]):
+        self._docs = list(docs)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._docs)
+
+    def next_document(self):
+        d = self._docs[self._pos]
+        self._pos += 1
+        return d
+
+    def reset(self):
+        self._pos = 0
+
+
+class LabelledCollectionIterator(LabelAwareDocumentIterator):
+    """In-memory (document, label) pairs."""
+
+    def __init__(self, docs: Sequence[str], labels: Sequence[str]):
+        if len(docs) != len(labels):
+            raise ValueError("docs and labels must align")
+        self._items: List[Tuple[str, str]] = list(zip(docs, labels))
+        self._pos = 0
+        self._label: Optional[str] = None
+
+    def has_next(self):
+        return self._pos < len(self._items)
+
+    def next_document(self):
+        doc, self._label = self._items[self._pos]
+        self._pos += 1
+        return doc
+
+    def current_label(self):
+        if self._label is None:
+            raise RuntimeError("call next_document first")
+        return self._label
+
+    def reset(self):
+        self._pos = 0
+        self._label = None
+
+
+class FileDocumentIterator(LabelAwareDocumentIterator):
+    """``FileDocumentIterator`` / FileLabelAwareIterator — one document
+    per file under ``root``; each file's parent directory name is its
+    label (the labelled-corpus directory convention)."""
+
+    def __init__(self, root: str, extensions: Sequence[str] = (".txt",)):
+        self._paths: List[str] = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                if fn.lower().endswith(tuple(extensions)):
+                    self._paths.append(os.path.join(dirpath, fn))
+        self._pos = 0
+        self._label: Optional[str] = None
+
+    def has_next(self):
+        return self._pos < len(self._paths)
+
+    def next_document(self):
+        path = self._paths[self._pos]
+        self._pos += 1
+        self._label = os.path.basename(os.path.dirname(path))
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+    def current_label(self):
+        if self._label is None:
+            raise RuntimeError("call next_document first")
+        return self._label
+
+    def reset(self):
+        self._pos = 0
+        self._label = None
+
+
+class LabelsSource:
+    """``LabelsSource`` — generated or user-supplied document labels
+    (ParagraphVectors' DOC_xxx ids)."""
+
+    def __init__(self, labels: Optional[Sequence[str]] = None,
+                 template: str = "DOC_%d"):
+        self._fixed = list(labels) if labels is not None else None
+        self._template = template
+        self._counter = 0
+        self.labels_used: List[str] = []
+
+    def next_label(self) -> str:
+        if self._fixed is not None:
+            lab = self._fixed[self._counter]
+        else:
+            lab = self._template % self._counter
+        self._counter += 1
+        self.labels_used.append(lab)
+        return lab
+
+    def get_labels(self) -> List[str]:
+        return list(self.labels_used)
+
+    def reset(self):
+        self._counter = 0
+        self.labels_used = []

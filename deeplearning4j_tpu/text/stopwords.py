@@ -1,0 +1,59 @@
+"""Stop-word list + removing preprocessor.
+
+Parity: ``deeplearning4j-nlp/.../text/stopwords/StopWords.java`` (the
+reference ships a bundled english stopword resource consumed by the
+vectorizers and tokenizer pipelines).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Set
+
+from deeplearning4j_tpu.text.tokenization import TokenPreProcess
+
+# the classic english list the reference bundles (stopwords resource)
+ENGLISH_STOP_WORDS: Set[str] = {
+    "a", "about", "above", "after", "again", "against", "all", "am", "an",
+    "and", "any", "are", "aren't", "as", "at", "be", "because", "been",
+    "before", "being", "below", "between", "both", "but", "by", "can't",
+    "cannot", "could", "couldn't", "did", "didn't", "do", "does", "doesn't",
+    "doing", "don't", "down", "during", "each", "few", "for", "from",
+    "further", "had", "hadn't", "has", "hasn't", "have", "haven't", "having",
+    "he", "he'd", "he'll", "he's", "her", "here", "here's", "hers", "herself",
+    "him", "himself", "his", "how", "how's", "i", "i'd", "i'll", "i'm",
+    "i've", "if", "in", "into", "is", "isn't", "it", "it's", "its", "itself",
+    "let's", "me", "more", "most", "mustn't", "my", "myself", "no", "nor",
+    "not", "of", "off", "on", "once", "only", "or", "other", "ought", "our",
+    "ours", "ourselves", "out", "over", "own", "same", "shan't", "she",
+    "she'd", "she'll", "she's", "should", "shouldn't", "so", "some", "such",
+    "than", "that", "that's", "the", "their", "theirs", "them", "themselves",
+    "then", "there", "there's", "these", "they", "they'd", "they'll",
+    "they're", "they've", "this", "those", "through", "to", "too", "under",
+    "until", "up", "very", "was", "wasn't", "we", "we'd", "we'll", "we're",
+    "we've", "were", "weren't", "what", "what's", "when", "when's", "where",
+    "where's", "which", "while", "who", "who's", "whom", "why", "why's",
+    "with", "won't", "would", "wouldn't", "you", "you'd", "you'll", "you're",
+    "you've", "your", "yours", "yourself", "yourselves",
+}
+
+
+def get_stop_words() -> List[str]:
+    """``StopWords.getStopWords()``."""
+    return sorted(ENGLISH_STOP_WORDS)
+
+
+def remove_stop_words(tokens: Iterable[str],
+                      stop_words: Iterable[str] = frozenset()) -> List[str]:
+    sw = set(stop_words) or ENGLISH_STOP_WORDS
+    return [t for t in tokens if t.lower() not in sw]
+
+
+class StopWordsPreprocessor(TokenPreProcess):
+    """Token preprocessor mapping stop words to '' (callers drop empty
+    tokens) — composes with the tokenizer-factory SPI."""
+
+    def __init__(self, stop_words: Iterable[str] = frozenset()):
+        self.stop_words = set(stop_words) or ENGLISH_STOP_WORDS
+
+    def pre_process(self, token: str) -> str:
+        return "" if token.lower() in self.stop_words else token

@@ -126,3 +126,89 @@ STOP_WORDS = frozenset("""a an and are as at be but by for if in into is it no n
 that the their then there these they this to was will with i you he she we his her its our your
 from has have had do does did so than too very can could should would may might must am been being
 """.split())
+
+
+# -------------------------------------------------------- factory registry
+
+_FACTORY_REGISTRY = {}
+
+
+def register_tokenizer_factory(name: str, factory_cls) -> None:
+    """Pluggable tokenizer seam (the role of the reference's separate
+    ``deeplearning4j-nlp-japanese`` / ``-korean`` modules, which vendor
+    Kuromoji and open-korean-text behind the same TokenizerFactory
+    interface): third-party morphological analyzers register here and
+    become selectable by name."""
+    _FACTORY_REGISTRY[name] = factory_cls
+
+
+def tokenizer_factory(name: str, **kwargs) -> TokenizerFactory:
+    if name not in _FACTORY_REGISTRY:
+        raise KeyError(f"unknown tokenizer factory {name!r}; "
+                       f"registered: {sorted(_FACTORY_REGISTRY)}")
+    return _FACTORY_REGISTRY[name](**kwargs)
+
+
+class CJKTokenizerFactory(TokenizerFactory):
+    """Dictionary-free CJK segmentation: runs of Han/Hiragana/Katakana/
+    Hangul are emitted as character n-grams (default unigram+bigram, the
+    standard IR fallback), other scripts split on whitespace.
+
+    The reference vendors Kuromoji's Viterbi lattice (6.9k LoC + a
+    binary dictionary, ``com/atilika/kuromoji/viterbi/``) for true
+    morphological analysis; that class of analyzer plugs in via
+    ``register_tokenizer_factory`` without touching callers.
+    """
+
+    def __init__(self, preprocessor: Optional[TokenPreProcess] = None,
+                 emit_bigrams: bool = True):
+        self.preprocessor = preprocessor
+        self.emit_bigrams = emit_bigrams
+
+    @staticmethod
+    def _is_cjk(ch: str) -> bool:
+        o = ord(ch)
+        return (0x4E00 <= o <= 0x9FFF      # CJK unified
+                or 0x3400 <= o <= 0x4DBF   # ext A
+                or 0x3040 <= o <= 0x30FF   # hiragana + katakana
+                or o == 0x3005             # 々 iteration mark
+                or 0x31F0 <= o <= 0x31FF   # katakana phonetic ext
+                or 0xFF66 <= o <= 0xFF9F   # halfwidth katakana
+                or 0xAC00 <= o <= 0xD7AF   # hangul syllables
+                or 0x1100 <= o <= 0x11FF   # hangul jamo
+                or 0xF900 <= o <= 0xFAFF)  # compat ideographs
+
+    def create(self, text: str) -> Tokenizer:
+        tokens: List[str] = []
+        run: List[str] = []
+
+        def flush_run():
+            if not run:
+                return
+            tokens.extend(run)
+            if self.emit_bigrams and len(run) > 1:
+                tokens.extend(a + b for a, b in zip(run, run[1:]))
+            run.clear()
+
+        for part in text.split():
+            buf = ""
+            for ch in part:
+                if self._is_cjk(ch):
+                    if buf:
+                        tokens.append(buf)
+                        buf = ""
+                    run.append(ch)
+                else:
+                    flush_run()
+                    buf += ch
+            flush_run()
+            if buf:
+                tokens.append(buf)
+        return Tokenizer(tokens, self.preprocessor)
+
+    def set_token_pre_processor(self, pre: TokenPreProcess):
+        self.preprocessor = pre
+
+
+register_tokenizer_factory("default", DefaultTokenizerFactory)
+register_tokenizer_factory("cjk", CJKTokenizerFactory)

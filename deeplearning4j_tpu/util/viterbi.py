@@ -1,0 +1,78 @@
+"""Viterbi decoder + moving-window utility.
+
+Parity: ``deeplearning4j-nn/.../util/Viterbi.java`` (most-likely label
+sequence under a transition model) and ``util/MovingWindowMatrix.java``
+(sliding sub-windows of a matrix). The DP recurrence is a ``lax.scan``
+over time — an XLA while-loop on device, batched over sequences — where
+the reference ran a per-step Java loop.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def viterbi_decode(log_emissions: np.ndarray,
+                   log_transitions: np.ndarray,
+                   log_initial: np.ndarray = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Most-likely state path.
+
+    log_emissions: [t, k] (or [b, t, k] batched) per-step state scores;
+    log_transitions: [k, k] (from → to); log_initial: [k].
+    Returns (path [t] / [b, t] int32, score scalar / [b]).
+    """
+    e = jnp.asarray(log_emissions, jnp.float32)
+    batched = e.ndim == 3
+    if not batched:
+        e = e[None]
+    A = jnp.asarray(log_transitions, jnp.float32)
+    k = A.shape[0]
+    pi = jnp.zeros((k,), jnp.float32) if log_initial is None \
+        else jnp.asarray(log_initial, jnp.float32)
+
+    def decode_one(em):  # em: [t, k]
+        def step(alpha, obs):
+            # alpha: [k] best score ending in each state
+            cand = alpha[:, None] + A          # [from, to]
+            best = jnp.max(cand, axis=0) + obs
+            back = jnp.argmax(cand, axis=0)
+            return best, back
+
+        alpha0 = pi + em[0]
+        alpha, backs = jax.lax.scan(step, alpha0, em[1:])
+        last = jnp.argmax(alpha)
+        score = alpha[last]
+
+        def backtrack(state, back):
+            prev = back[state]
+            return prev, state
+
+        _, path_rev = jax.lax.scan(backtrack, last, backs, reverse=True)
+        path = jnp.concatenate([path_rev, last[None]])
+        return path.astype(jnp.int32), score
+
+    paths, scores = jax.jit(jax.vmap(decode_one))(e)
+    if not batched:
+        return np.asarray(paths[0]), float(scores[0])
+    return np.asarray(paths), np.asarray(scores)
+
+
+def moving_window_matrix(arr: np.ndarray, window_rows: int, window_cols: int,
+                         rotate: int = 0) -> np.ndarray:
+    """``MovingWindowMatrix`` — all dense [window_rows, window_cols]
+    sub-windows of a 2-D array (stride 1), optionally each rotated 90°
+    ``rotate`` times. Returns [n_windows, wr, wc]."""
+    a = np.asarray(arr)
+    r, c = a.shape
+    wr, wc = window_rows, window_cols
+    if wr > r or wc > c:
+        raise ValueError(f"window {wr}x{wc} larger than matrix {r}x{c}")
+    wins = np.lib.stride_tricks.sliding_window_view(a, (wr, wc))
+    out = wins.reshape(-1, wr, wc).copy()
+    if rotate:
+        out = np.rot90(out, k=rotate, axes=(1, 2)).copy()
+    return out

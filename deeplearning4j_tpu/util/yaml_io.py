@@ -1,0 +1,21 @@
+"""JSON↔YAML bridging for configuration serialization.
+
+One place for the convention both MultiLayerConfiguration and
+ComputationGraphConfiguration use: serialize through the class's
+canonical JSON form, re-render as block-style YAML (the reference's
+Jackson YAML factory role, ``NeuralNetConfiguration.java:286``).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def json_to_yaml(json_str: str) -> str:
+    import yaml
+    return yaml.safe_dump(json.loads(json_str), sort_keys=False)
+
+
+def yaml_to_json(yaml_str: str) -> str:
+    import yaml
+    return json.dumps(yaml.safe_load(yaml_str))

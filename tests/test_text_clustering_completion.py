@@ -1,0 +1,93 @@
+"""VP-tree, document iterators, stopwords, CJK tokenizer tests.
+
+Parity: ``clustering/vptree/VPTree.java``, ``text/documentiterator/``,
+``text/stopwords``, and the pluggable tokenizer seam standing in for
+``deeplearning4j-nlp-japanese`` / ``-korean``.
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.vptree import VPTree, knn_brute
+from deeplearning4j_tpu.text.documentiterator import (
+    FileDocumentIterator, LabelledCollectionIterator, LabelsSource)
+from deeplearning4j_tpu.text.stopwords import (
+    get_stop_words, remove_stop_words)
+from deeplearning4j_tpu.text.tokenization import tokenizer_factory
+
+
+def test_vptree_matches_brute_force(rng):
+    pts = rng.standard_normal((200, 8))
+    queries = rng.standard_normal((10, 8))
+    tree = VPTree(pts, metric="euclidean")
+    bidx, bdist = knn_brute(pts, queries, k=5)
+    for qi, q in enumerate(queries):
+        tidx, tdist = tree.search(q, k=5)
+        np.testing.assert_allclose(sorted(tdist), sorted(bdist[qi]), rtol=1e-5)
+        assert set(tidx) == set(bidx[qi].tolist())
+
+
+def test_vptree_cosine(rng):
+    pts = rng.standard_normal((64, 6))
+    tree = VPTree(pts, metric="cosine")
+    idx, dist = tree.search(pts[7], k=1)
+    assert idx[0] == 7
+    assert dist[0] < 1e-9
+
+
+def test_document_iterators(tmp_path):
+    (tmp_path / "pos").mkdir()
+    (tmp_path / "neg").mkdir()
+    (tmp_path / "pos" / "a.txt").write_text("good great")
+    (tmp_path / "neg" / "b.txt").write_text("bad awful")
+    it = FileDocumentIterator(str(tmp_path))
+    docs = []
+    while it.has_next():
+        d = it.next_document()
+        docs.append((d, it.current_label()))
+    assert ("good great", "pos") in docs and ("bad awful", "neg") in docs
+
+    lit = LabelledCollectionIterator(["x y", "z"], ["A", "B"])
+    assert lit.next_document() == "x y" and lit.current_label() == "A"
+
+    src = LabelsSource()
+    assert src.next_label() == "DOC_0" and src.next_label() == "DOC_1"
+    assert src.get_labels() == ["DOC_0", "DOC_1"]
+
+
+def test_stopwords():
+    assert "the" in get_stop_words()
+    assert remove_stop_words("the quick fox".split()) == ["quick", "fox"]
+
+
+def test_cjk_tokenizer_registry():
+    toks = tokenizer_factory("cjk").create("東京 hello").get_tokens()
+    assert "東" in toks and "京" in toks and "東京" in toks and "hello" in toks
+    default = tokenizer_factory("default").create("a b").get_tokens()
+    assert default == ["a", "b"]
+
+
+def test_viterbi_decode_matches_brute_force(rng):
+    from itertools import product
+    from deeplearning4j_tpu.util.viterbi import viterbi_decode
+    t, k = 5, 3
+    em = rng.standard_normal((t, k))
+    A = rng.standard_normal((k, k))
+    path, score = viterbi_decode(em, A)
+    # brute force over all 3^5 paths
+    best, best_p = -np.inf, None
+    for p in product(range(k), repeat=t):
+        s = em[0, p[0]] + sum(A[p[i - 1], p[i]] + em[i, p[i]] for i in range(1, t))
+        if s > best:
+            best, best_p = s, p
+    assert tuple(path) == best_p
+    assert abs(score - best) < 1e-4
+
+
+def test_moving_window_matrix(rng):
+    from deeplearning4j_tpu.util.viterbi import moving_window_matrix
+    a = np.arange(12).reshape(3, 4)
+    w = moving_window_matrix(a, 2, 2)
+    assert w.shape == (6, 2, 2)
+    np.testing.assert_array_equal(w[0], [[0, 1], [4, 5]])
+    r = moving_window_matrix(a, 2, 2, rotate=1)
+    assert r.shape == (6, 2, 2)
