@@ -29,7 +29,13 @@ _MNIST_DIRS = [
 
 
 def _read_idx(path: str) -> np.ndarray:
-    """Parse an IDX file (optionally gzipped) — MnistManager.java format."""
+    """Parse an IDX file (optionally gzipped) — MnistManager.java format.
+    Uncompressed files go through the native C++ reader when available
+    (deeplearning4j_tpu/native)."""
+    from deeplearning4j_tpu.native import idx_read
+    native = idx_read(path)
+    if native is not None:
+        return native
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         data = f.read()
@@ -37,10 +43,16 @@ def _read_idx(path: str) -> np.ndarray:
     if zeros != 0:
         raise ValueError(f"bad IDX magic in {path}")
     dims = struct.unpack(f">{ndim}I", data[4:4 + 4 * ndim])
-    dtypes = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
-              0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}
-    arr = np.frombuffer(data, dtypes[dtype_code], offset=4 + 4 * ndim)
-    return arr.reshape(dims)
+    # IDX payloads are BIG-endian (MnistManager.java readInt doctrine);
+    # decode as >-types then normalize to native order
+    dtypes = {0x08: np.dtype(np.uint8), 0x09: np.dtype(np.int8),
+              0x0B: np.dtype(">i2"), 0x0C: np.dtype(">i4"),
+              0x0D: np.dtype(">f4"), 0x0E: np.dtype(">f8")}
+    dt = dtypes[dtype_code]
+    arr = np.frombuffer(data, dt, offset=4 + 4 * ndim).reshape(dims)
+    if dt.byteorder == ">":
+        arr = arr.astype(dt.newbyteorder("="))
+    return arr
 
 
 def _find_idx(name: str) -> Optional[str]:

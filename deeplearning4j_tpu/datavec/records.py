@@ -124,9 +124,20 @@ class CSVSequenceRecordReader(RecordReader):
         return self._pos < len(self._paths)
 
     def next_record(self) -> np.ndarray:
-        """Returns the [t, f] float array for one sequence."""
-        reader = CSVRecordReader(self._paths[self._pos], self._skip, self._delim)
+        """Returns the [t, f] float array for one sequence. Numeric CSVs
+        are parsed by the native multithreaded reader when available
+        (comma-delimited only; other delimiters take the python path)."""
+        path = self._paths[self._pos]
         self._pos += 1
+        if self._delim == ",":
+            from deeplearning4j_tpu.native import csv_read_floats
+            try:
+                # strict: a mis-pointed or string-labelled file must fail
+                # loudly, not train on silently-zeroed features
+                return csv_read_floats(path, skip_rows=self._skip, strict=True)
+            except IOError:
+                pass
+        reader = CSVRecordReader(path, self._skip, self._delim)
         rows = [r for r in reader]
         return np.asarray(rows, np.float32)
 

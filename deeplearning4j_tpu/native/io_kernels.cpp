@@ -1,0 +1,198 @@
+// Native IO kernels for the host-side data plane.
+//
+// Role: the reference's data plane bottoms out in native code twice —
+// libnd4j's C++ buffer ops behind every INDArray, and DataVec's IO
+// stack feeding RecordReaderDataSetIterator (SURVEY.md §1 layer 1/4).
+// On TPU the array side is XLA; THIS file is the native side of the
+// feed path: parsing host data fast enough that the async prefetch
+// queue (AsyncDataSetIterator role) never starves the chip.
+//
+// Exposed as a plain C ABI consumed via ctypes (the environment has no
+// pybind11). Numeric parsing uses std::from_chars — locale-independent
+// (strtof misreads '1.5' under comma-decimal locales) and allocation
+// free. Line semantics MATCH the python fallback exactly: skip_rows
+// counts PHYSICAL lines, whitespace-only lines are not rows.
+//
+// Build: g++ -O3 -shared -fPIC -pthread -std=c++17 -o libdl4jtpu_io.so io_kernels.cpp
+
+#include <atomic>
+#include <charconv>
+#include <cstdio>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+bool is_blank(const char* p, const char* end) {
+    for (; p < end; p++)
+        if (*p != ' ' && *p != '\t' && *p != '\r') return false;
+    return true;
+}
+
+// Parse one cell: skip quotes/spaces, from_chars, return success.
+bool parse_cell(const char* q, const char* cell_end, float* out) {
+    while (q < cell_end && (*q == '"' || *q == ' ' || *q == '\t')) q++;
+    const char* e = cell_end;
+    while (e > q && (*(e - 1) == '"' || *(e - 1) == ' ' || *(e - 1) == '\t'
+                     || *(e - 1) == '\r')) e--;
+    if (q >= e) { *out = 0.0f; return false; }
+    auto res = std::from_chars(q, e, *out);
+    if (res.ec != std::errc()) { *out = 0.0f; return false; }
+    return true;
+}
+
+struct FileBuf {
+    std::vector<char> data;
+    bool ok = false;
+};
+
+FileBuf read_file(const char* path) {
+    FileBuf fb;
+    FILE* f = fopen(path, "rb");
+    if (!f) return fb;
+    fseek(f, 0, SEEK_END);
+    long n = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    fb.data.resize(n + 1);
+    if (n > 0 && fread(fb.data.data(), 1, n, f) != (size_t)n) { fclose(f); return fb; }
+    fclose(f);
+    fb.data[n] = '\0';
+    fb.data.resize(n);
+    fb.ok = true;
+    return fb;
+}
+
+// Collect [start, end) of every data line (after skipping skip_rows
+// PHYSICAL lines and dropping blank lines) — shared by shape + parse.
+void data_lines(const std::vector<char>& buf, long skip_rows,
+                std::vector<const char*>& starts,
+                std::vector<const char*>& ends) {
+    const char* p = buf.data();
+    const char* end = p + buf.size();
+    long physical = 0;
+    while (p < end) {
+        const char* line_end = (const char*)memchr(p, '\n', end - p);
+        if (!line_end) line_end = end;
+        if (physical >= skip_rows && !is_blank(p, line_end)) {
+            starts.push_back(p);
+            ends.push_back(line_end);
+        }
+        physical++;
+        p = line_end + 1;
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// ----------------------------------------------------------------- csv
+
+int dl4j_csv_shape(const char* path, long skip_rows, long* rows, long* cols) {
+    FileBuf fb = read_file(path);
+    if (!fb.ok) return -1;
+    std::vector<const char*> starts, ends;
+    data_lines(fb.data, skip_rows, starts, ends);
+    *rows = (long)starts.size();
+    *cols = 0;
+    if (!starts.empty()) {
+        long c = 1;
+        for (const char* q = starts[0]; q < ends[0]; q++)
+            if (*q == ',') c++;
+        *cols = c;
+    }
+    return 0;
+}
+
+// Parse into a pre-allocated [rows, cols] float32 buffer. Returns the
+// number of non-numeric cells (>= 0, parsed as 0.0), or negative on IO
+// error — the caller decides whether bad cells are fatal.
+long dl4j_csv_parse(const char* path, long skip_rows, float* out,
+                    long rows, long cols, int threads) {
+    FileBuf fb = read_file(path);
+    if (!fb.ok) return -1;
+    std::vector<const char*> starts, ends;
+    data_lines(fb.data, skip_rows, starts, ends);
+    if ((long)starts.size() < rows) return -3;
+
+    std::atomic<long> bad{0};
+    auto parse_range = [&](long lo, long hi) {
+        long local_bad = 0;
+        for (long i = lo; i < hi; i++) {
+            const char* q = starts[i];
+            const char* line_end = ends[i];
+            float* row_out = out + i * cols;
+            long col = 0;
+            while (col < cols) {
+                const char* cell_end = (const char*)memchr(q, ',', line_end - q);
+                if (!cell_end) cell_end = line_end;
+                if (q >= line_end && col > 0) {
+                    row_out[col++] = 0.0f;  // short row: zero-fill
+                    local_bad++;
+                    continue;
+                }
+                if (!parse_cell(q, cell_end, &row_out[col])) local_bad++;
+                col++;
+                q = cell_end < line_end ? cell_end + 1 : line_end;
+            }
+        }
+        bad.fetch_add(local_bad, std::memory_order_relaxed);
+    };
+
+    int nt = threads > 0 ? threads : (int)std::thread::hardware_concurrency();
+    if (nt < 1) nt = 1;
+    if (nt > 16) nt = 16;
+    // small files are not worth thread spawns
+    long min_rows_per_thread = 4096;
+    long useful = rows / min_rows_per_thread + 1;
+    if ((long)nt > useful) nt = (int)useful;
+    if (nt <= 1) {
+        parse_range(0, rows);
+    } else {
+        long per = (rows + nt - 1) / nt;
+        std::vector<std::thread> pool;
+        for (int t = 0; t < nt; t++) {
+            long lo = t * per;
+            long hi = lo + per < rows ? lo + per : rows;
+            if (lo >= hi) break;
+            pool.emplace_back(parse_range, lo, hi);
+        }
+        for (auto& th : pool) th.join();
+    }
+    return bad.load();
+}
+
+// ----------------------------------------------------------------- idx
+
+int dl4j_idx_header(const char* path, int* dtype, int* ndim, long* dims) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char h[4];
+    if (fread(h, 1, 4, f) != 4 || h[0] != 0 || h[1] != 0) { fclose(f); return -2; }
+    *dtype = h[2];
+    *ndim = h[3];
+    if (*ndim > 8) { fclose(f); return -3; }
+    for (int i = 0; i < *ndim; i++) {
+        unsigned char d[4];
+        if (fread(d, 1, 4, f) != 4) { fclose(f); return -4; }
+        dims[i] = ((long)d[0] << 24) | ((long)d[1] << 16) | ((long)d[2] << 8) | d[3];
+    }
+    fclose(f);
+    return 0;
+}
+
+int dl4j_idx_read(const char* path, unsigned char* out, long nbytes) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char h[4];
+    if (fread(h, 1, 4, f) != 4) { fclose(f); return -2; }
+    long skip = 4 + 4 * h[3];
+    fseek(f, skip, SEEK_SET);
+    long got = (long)fread(out, 1, nbytes, f);
+    fclose(f);
+    return got == nbytes ? 0 : -5;
+}
+
+}  // extern "C"

@@ -1,0 +1,104 @@
+"""Native IO kernel tests (C++ via ctypes, Python fallback parity).
+
+The native side of the data plane (deeplearning4j_tpu/native): where
+the reference's feed path bottoms out in libnd4j/DataVec native code,
+ours compiles a small C++ library on first use and falls back to NumPy
+transparently.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.native import (
+    _csv_read_floats_py, csv_read_floats, get_lib, idx_read)
+
+
+def test_csv_native_matches_python(tmp_path, rng):
+    data = rng.standard_normal((500, 7)).astype(np.float32)
+    path = str(tmp_path / "data.csv")
+    np.savetxt(path, data, delimiter=",", fmt="%.6f")
+    a = csv_read_floats(path)
+    b = _csv_read_floats_py(path, 0)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+    np.testing.assert_allclose(a, data, atol=1e-5)
+
+
+def test_csv_skip_rows_and_non_numeric(tmp_path):
+    path = str(tmp_path / "h.csv")
+    with open(path, "w") as f:
+        f.write("colA,colB\n1.5,2.5\nx,4.0\n")
+    a = csv_read_floats(path, skip_rows=1)
+    np.testing.assert_allclose(a, [[1.5, 2.5], [0.0, 4.0]])
+
+
+def test_idx_native_roundtrip(tmp_path, rng):
+    if get_lib() is None:
+        pytest.skip("no native toolchain")
+    arr = rng.integers(0, 255, (40, 5, 6)).astype(np.uint8)
+    path = str(tmp_path / "t.idx")
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 3))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.tobytes())
+    got = idx_read(path)
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_idx_float_dtype(tmp_path, rng):
+    if get_lib() is None:
+        pytest.skip("no native toolchain")
+    arr = rng.standard_normal((8, 3)).astype(">f4")
+    path = str(tmp_path / "f.idx")
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x0D, 2))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.tobytes())
+    got = idx_read(path)
+    np.testing.assert_allclose(got, arr.astype(np.float32), rtol=1e-6)
+
+
+def test_sequence_reader_uses_native_path(tmp_path):
+    from deeplearning4j_tpu.datavec import CSVSequenceRecordReader
+    p = tmp_path / "seq.csv"
+    p.write_text("1,2\n3,4\n")
+    r = CSVSequenceRecordReader([str(p)])
+    np.testing.assert_allclose(r.next_record(), [[1, 2], [3, 4]])
+
+
+def test_csv_quoted_cells_and_blank_lines(tmp_path):
+    path = str(tmp_path / "q.csv")
+    with open(path, "w") as f:
+        f.write('\n"1.5","2.5"\n   \n3.0,4.0\n')
+    a = csv_read_floats(path)
+    b = _csv_read_floats_py(path, 0)
+    np.testing.assert_allclose(a, [[1.5, 2.5], [3.0, 4.0]])
+    np.testing.assert_allclose(a, b)
+
+
+def test_csv_strict_raises_on_string_column(tmp_path):
+    path = str(tmp_path / "s.csv")
+    with open(path, "w") as f:
+        f.write("1.0,cat\n2.0,dog\n")
+    with pytest.raises(ValueError):
+        csv_read_floats(path, strict=True)
+    with pytest.raises(ValueError):
+        _csv_read_floats_py(path, 0, strict=True)
+
+
+def test_python_idx_fallback_big_endian(tmp_path, rng):
+    # the pure-python IDX parser must byte-swap like the native one
+    from deeplearning4j_tpu.datasets.mnist import _read_idx
+    arr = rng.standard_normal((4, 3)).astype(">f4")
+    path = str(tmp_path / "be.idx.gz")  # .gz path skips the native reader
+    import gzip, struct as st
+    with gzip.open(path, "wb") as f:
+        f.write(st.pack(">HBB", 0, 0x0D, 2))
+        for d in arr.shape:
+            f.write(st.pack(">I", d))
+        f.write(arr.tobytes())
+    got = _read_idx(path)
+    np.testing.assert_allclose(got, arr.astype(np.float32), rtol=1e-6)
