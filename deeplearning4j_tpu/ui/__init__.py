@@ -1,4 +1,15 @@
+from deeplearning4j_tpu.ui.components import (  # noqa: F401
+    ChartHistogram,
+    ChartHorizontalBar,
+    ChartLine,
+    ChartScatter,
+    Component,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+)
 from deeplearning4j_tpu.ui.report import render_html, save_report  # noqa: F401
+from deeplearning4j_tpu.ui.server import RemoteStatsStorageRouter, UiServer  # noqa: F401
 from deeplearning4j_tpu.ui.stats import StatsListener, StatsReport  # noqa: F401
 from deeplearning4j_tpu.ui.storage import (  # noqa: F401
     FileStatsStorage,

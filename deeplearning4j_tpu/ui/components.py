@@ -1,0 +1,285 @@
+"""Declarative UI component DSL: charts/tables/text as JSON.
+
+Parity: ``deeplearning4j-ui-components/.../ui/components/{chart,table,
+text,decorator}`` (~2.1k LoC) — a tree of declarative components, each
+JSON-serializable with a polymorphic ``componentType`` tag, used to
+build custom dashboards. The reference renders them with bundled JS
+(dygraphs etc.); here every component renders to self-contained
+HTML/SVG (same zero-asset doctrine as ``report.py``), and the JSON
+round-trip is the stable interchange format.
+
+Usage::
+
+    page = ComponentDiv(
+        ComponentText("LeNet run 7", style=StyleText(size=18, bold=True)),
+        ChartLine("score", x=[its], y=[scores], series_names=["score"]),
+        ComponentTable(header=["layer", "‖p‖"], content=rows),
+    )
+    open("dash.html", "w").write(page.render_html())
+    ComponentDiv.from_dict(json.loads(json.dumps(page.to_dict())))
+"""
+
+from __future__ import annotations
+
+import html as _html
+from typing import Any, Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.ui.report import _svg_line_chart
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def _register(cls):
+    _REGISTRY[cls.component_type] = cls
+    return cls
+
+
+class Component:
+    """Base component (``ui/api/Component.java`` role): a JSON-taggable
+    node; subclasses implement ``_body_dict``/``_from_body``/
+    ``render_html``."""
+
+    component_type = "Component"
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"componentType": self.component_type}
+        d.update(self._body_dict())
+        return d
+
+    def _body_dict(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Component":
+        ctype = d.get("componentType")
+        cls = _REGISTRY.get(ctype)
+        if cls is None:
+            raise ValueError(f"unknown componentType {ctype!r}; "
+                             f"known: {sorted(_REGISTRY)}")
+        body = {k: v for k, v in d.items() if k != "componentType"}
+        return cls._from_body(body)
+
+    @classmethod
+    def _from_body(cls, body: Dict[str, Any]) -> "Component":
+        return cls(**body)
+
+    def render_html(self) -> str:
+        raise NotImplementedError
+
+
+@_register
+class ComponentText(Component):
+    """``components/text/ComponentText.java``."""
+
+    component_type = "ComponentText"
+
+    def __init__(self, text: str, size: int = 12, bold: bool = False,
+                 color: str = "#000"):
+        self.text, self.size, self.bold, self.color = text, size, bold, color
+
+    def _body_dict(self):
+        return {"text": self.text, "size": self.size, "bold": self.bold,
+                "color": self.color}
+
+    def render_html(self) -> str:
+        weight = "bold" if self.bold else "normal"
+        return (f"<div style='font-size:{int(self.size)}px;"
+                f"font-weight:{weight};color:{_html.escape(self.color)}'>"
+                f"{_html.escape(self.text)}</div>")
+
+
+@_register
+class ComponentTable(Component):
+    """``components/table/ComponentTable.java``."""
+
+    component_type = "ComponentTable"
+
+    def __init__(self, header: Sequence[str], content: Sequence[Sequence[Any]],
+                 title: str = ""):
+        self.header = list(header)
+        self.content = [list(row) for row in content]
+        self.title = title
+
+    def _body_dict(self):
+        return {"header": self.header, "content": self.content,
+                "title": self.title}
+
+    def render_html(self) -> str:
+        head = "".join(f"<th>{_html.escape(str(h))}</th>" for h in self.header)
+        rows = "".join(
+            "<tr>" + "".join(f"<td>{_html.escape(str(c))}</td>" for c in row) + "</tr>"
+            for row in self.content)
+        title = f"<h3>{_html.escape(self.title)}</h3>" if self.title else ""
+        return (f"{title}<table border='1' cellpadding='4' "
+                f"style='border-collapse:collapse'><tr>{head}</tr>{rows}</table>")
+
+
+class _Chart(Component):
+    """Shared chart fields (``components/chart/Chart.java``)."""
+
+    def __init__(self, title: str = ""):
+        self.title = title
+
+
+@_register
+class ChartLine(_Chart):
+    """``chart/ChartLine.java``: one or more (x, y) line series."""
+
+    component_type = "ChartLine"
+
+    def __init__(self, title: str = "", x: Sequence[Sequence[float]] = (),
+                 y: Sequence[Sequence[float]] = (),
+                 series_names: Optional[Sequence[str]] = None,
+                 log_y: bool = False):
+        super().__init__(title)
+        self.x = [list(map(float, s)) for s in x]
+        self.y = [list(map(float, s)) for s in y]
+        if len(self.x) != len(self.y):
+            raise ValueError(f"{len(self.x)} x-series vs {len(self.y)} y-series")
+        self.series_names = list(series_names) if series_names else [
+            f"series{i}" for i in range(len(self.x))]
+        self.log_y = log_y
+
+    def _body_dict(self):
+        return {"title": self.title, "x": self.x, "y": self.y,
+                "series_names": self.series_names, "log_y": self.log_y}
+
+    def render_html(self) -> str:
+        series = {name: list(zip(xs, ys)) for name, xs, ys
+                  in zip(self.series_names, self.x, self.y)}
+        return _svg_line_chart(self.title, series, log_y=self.log_y)
+
+
+@_register
+class ChartScatter(ChartLine):
+    """``chart/ChartScatter.java`` — same payload, point marks."""
+
+    component_type = "ChartScatter"
+
+    def render_html(self) -> str:
+        # render as a line chart with zero-length segments: reuse the SVG
+        # scaffolding but emit circles by chopping each series to points
+        series = {name: list(zip(xs, ys)) for name, xs, ys
+                  in zip(self.series_names, self.x, self.y)}
+        svg = _svg_line_chart(self.title, series, log_y=self.log_y)
+        return svg.replace('fill="none" stroke-width="1.5"',
+                           'fill="none" stroke-width="0"')
+
+
+@_register
+class ChartHistogram(_Chart):
+    """``chart/ChartHistogram.java``: bins as [low, high, count]."""
+
+    component_type = "ChartHistogram"
+
+    def __init__(self, title: str = "", lower: Sequence[float] = (),
+                 upper: Sequence[float] = (), counts: Sequence[float] = ()):
+        super().__init__(title)
+        self.lower = list(map(float, lower))
+        self.upper = list(map(float, upper))
+        self.counts = list(map(float, counts))
+        if not (len(self.lower) == len(self.upper) == len(self.counts)):
+            raise ValueError("lower/upper/counts lengths differ")
+
+    def _body_dict(self):
+        return {"title": self.title, "lower": self.lower,
+                "upper": self.upper, "counts": self.counts}
+
+    def render_html(self) -> str:
+        if not self.counts:
+            return f"<h3>{_html.escape(self.title)}</h3><p>(no data)</p>"
+        w, h, pad = 640, 220, 36
+        x0, x1 = min(self.lower), max(self.upper)
+        cmax = max(self.counts) or 1.0
+        span = (x1 - x0) or 1.0
+        bars = []
+        for lo, hi, c in zip(self.lower, self.upper, self.counts):
+            bx = pad + (lo - x0) / span * (w - 2 * pad)
+            bw = max(1.0, (hi - lo) / span * (w - 2 * pad) - 1)
+            bh = c / cmax * (h - 2 * pad)
+            bars.append(f'<rect x="{bx:.1f}" y="{h - pad - bh:.1f}" '
+                        f'width="{bw:.1f}" height="{bh:.1f}" fill="#3366cc"/>')
+        return (f"<h3>{_html.escape(self.title)}</h3>"
+                f'<svg width="{w}" height="{h}" xmlns="http://www.w3.org/2000/svg" '
+                f'style="background:#fff;border:1px solid #ddd">'
+                f'<line x1="{pad}" y1="{h-pad}" x2="{w-pad}" y2="{h-pad}" stroke="#999"/>'
+                f'<text x="{pad}" y="{h-pad+14}" font-size="10">{x0:.3g}</text>'
+                f'<text x="{w-pad-30}" y="{h-pad+14}" font-size="10">{x1:.3g}</text>'
+                f'<text x="2" y="{pad+8}" font-size="10">{cmax:.3g}</text>'
+                + "".join(bars) + "</svg>")
+
+
+@_register
+class ChartHorizontalBar(_Chart):
+    """``chart/ChartHorizontalBar.java``: labeled horizontal bars."""
+
+    component_type = "ChartHorizontalBar"
+
+    def __init__(self, title: str = "", labels: Sequence[str] = (),
+                 values: Sequence[float] = ()):
+        super().__init__(title)
+        self.labels = list(labels)
+        self.values = list(map(float, values))
+        if len(self.labels) != len(self.values):
+            raise ValueError("labels/values lengths differ")
+
+    def _body_dict(self):
+        return {"title": self.title, "labels": self.labels,
+                "values": self.values}
+
+    def render_html(self) -> str:
+        if not self.values:
+            return f"<h3>{_html.escape(self.title)}</h3><p>(no data)</p>"
+        w, row_h, pad = 640, 18, 140
+        vmax = max(abs(v) for v in self.values) or 1.0
+        h = len(self.values) * row_h + 10
+        rows = []
+        for i, (lab, v) in enumerate(zip(self.labels, self.values)):
+            y = 5 + i * row_h
+            bw = abs(v) / vmax * (w - pad - 10)
+            rows.append(
+                f'<text x="2" y="{y + 12}" font-size="10">'
+                f'{_html.escape(str(lab)[:20])}</text>'
+                f'<rect x="{pad}" y="{y}" width="{bw:.1f}" height="{row_h - 4}" '
+                f'fill="#3366cc"/>'
+                f'<text x="{pad + bw + 3:.1f}" y="{y + 12}" font-size="10">{v:.4g}</text>')
+        return (f"<h3>{_html.escape(self.title)}</h3>"
+                f'<svg width="{w}" height="{h}" xmlns="http://www.w3.org/2000/svg" '
+                f'style="background:#fff;border:1px solid #ddd">'
+                + "".join(rows) + "</svg>")
+
+
+@_register
+class ComponentDiv(Component):
+    """``components/component/ComponentDiv.java``: child container."""
+
+    component_type = "ComponentDiv"
+
+    def __init__(self, *children: Component, style: str = ""):
+        # from_dict path passes a prebuilt list of dicts via `children=`
+        if len(children) == 1 and isinstance(children[0], (list, tuple)):
+            children = tuple(children[0])
+        self.children: List[Component] = [
+            c if isinstance(c, Component) else Component.from_dict(c)
+            for c in children]
+        self.style = style
+
+    def _body_dict(self):
+        return {"children": [c.to_dict() for c in self.children],
+                "style": self.style}
+
+    @classmethod
+    def _from_body(cls, body):
+        return cls(body.get("children", []), style=body.get("style", ""))
+
+    def render_html(self) -> str:
+        inner = "".join(c.render_html() for c in self.children)
+        style = f" style='{_html.escape(self.style)}'" if self.style else ""
+        return f"<div{style}>{inner}</div>"
+
+    def render_page(self, title: str = "deeplearning4j_tpu dashboard") -> str:
+        """Standalone HTML page wrapper."""
+        return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+                f"<title>{_html.escape(title)}</title></head>"
+                f"<body style='font-family:sans-serif'>{self.render_html()}"
+                "</body></html>")

@@ -1,0 +1,197 @@
+"""Live training dashboard server + remote stats transport.
+
+Parity: ``deeplearning4j-ui/.../ui/UiServer.java:25-32`` (embedded
+Dropwizard/Jetty app serving dashboards, port auto-config) and the
+remote listener transport (``weights/HistogramIterationListener.java:33``
+posts telemetry to the server via a Jersey HTTP client;
+``deeplearning4j-ui-remote-iterationlisteners``).
+
+TPU-first re-design: the server is a stdlib ``ThreadingHTTPServer``
+daemon around a :class:`~deeplearning4j_tpu.ui.storage.StatsStorage` —
+no web framework, no servlet container, zero dependencies, so it runs on
+a zero-egress pod host. Dashboards are the same self-contained SVG pages
+``report.py`` renders offline; the JSON API exposes the storage SPI 1:1
+so external tooling (curl/jq, notebooks) can stream telemetry. A
+:class:`RemoteStatsStorageRouter` is the client half: a ``StatsStorage``
+whose ``put_report`` POSTs to a server, so a ``StatsListener`` on worker
+hosts ships reports to one dashboard process exactly like the
+reference's remote listeners.
+
+Routes:
+  GET  /                                  session index (HTML)
+  GET  /train/<session>[?worker=w]        dashboard (HTML, report.py)
+  GET  /api/sessions                      ["s1", ...]
+  GET  /api/sessions/<s>/workers          ["w0", ...]
+  GET  /api/sessions/<s>/reports[?worker] [report dicts...]
+  POST /api/reports                       accept one report dict
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, unquote, urlparse
+
+from deeplearning4j_tpu.ui.report import render_html
+from deeplearning4j_tpu.ui.stats import StatsReport
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4j-tpu-ui/1.0"
+
+    # the owning UiServer injects `storage` onto the server object
+    @property
+    def storage(self) -> StatsStorage:
+        return self.server._storage  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if self.server._verbose:  # type: ignore[attr-defined]
+            super().log_message(fmt, *args)
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj, code: int = 200) -> None:
+        self._send(code, json.dumps(obj).encode(), "application/json")
+
+    def _html(self, text: str, code: int = 200) -> None:
+        self._send(code, text.encode(), "text/html; charset=utf-8")
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        url = urlparse(self.path)
+        parts = [unquote(p) for p in url.path.split("/") if p]
+        query = parse_qs(url.query)
+        worker = query.get("worker", [None])[0]
+        try:
+            if not parts:
+                return self._html(self._index())
+            if parts[0] == "train" and len(parts) == 2:
+                return self._html(render_html(self.storage, parts[1], worker))
+            if parts[0] == "api":
+                if parts[1:] == ["sessions"]:
+                    return self._json(self.storage.list_sessions())
+                if len(parts) == 4 and parts[1] == "sessions" and parts[3] == "workers":
+                    return self._json(self.storage.list_workers(parts[2]))
+                if len(parts) == 4 and parts[1] == "sessions" and parts[3] == "reports":
+                    reports = self.storage.get_reports(parts[2], worker)
+                    return self._json([r.to_dict() for r in reports])
+            return self._json({"error": "not found"}, 404)
+        except Exception as e:  # surface handler bugs to the client, not the log
+            return self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+
+    def do_POST(self):  # noqa: N802
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        if parts != ["api", "reports"]:
+            return self._json({"error": "not found"}, 404)
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            report = StatsReport.from_dict(json.loads(self.rfile.read(length)))
+            self.storage.put_report(report)
+            return self._json({"ok": True})
+        except Exception as e:
+            return self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+    def _index(self) -> str:
+        rows = []
+        for s in self.storage.list_sessions():
+            workers = ", ".join(self.storage.list_workers(s)) or "-"
+            n = len(self.storage.get_reports(s))
+            link = f"/train/{html.escape(s)}"
+            rows.append(f"<tr><td><a href='{link}'>{html.escape(s)}</a></td>"
+                        f"<td>{n}</td><td>{html.escape(workers)}</td></tr>")
+        body = ("<table border='1' cellpadding='4'>"
+                "<tr><th>session</th><th>reports</th><th>workers</th></tr>"
+                + "".join(rows) + "</table>") if rows else "<p>(no sessions yet)</p>"
+        return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+                "<title>deeplearning4j_tpu UI</title></head>"
+                "<body style='font-family:sans-serif'>"
+                "<h1>deeplearning4j_tpu training UI</h1>" + body + "</body></html>")
+
+
+class UiServer:
+    """Embedded dashboard server (``UiServer.java:25``).
+
+    ``port=0`` picks a free port (the reference's port auto-config).
+    The server runs on a daemon thread; ``attach`` more storages is not
+    needed — pass the storage the training listeners write to.
+    """
+
+    def __init__(self, storage: StatsStorage, port: int = 0,
+                 host: str = "127.0.0.1", verbose: bool = False):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd._storage = storage  # type: ignore[attr-defined]
+        self._httpd._verbose = verbose  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "UiServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="dl4j-tpu-ui", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class RemoteStatsStorageRouter(StatsStorage):
+    """Client-side storage that ships reports to a :class:`UiServer`
+    over HTTP — the remote-listener transport
+    (``HistogramIterationListener.java:35-52`` Jersey POST role). Give
+    this to a ``StatsListener`` on a worker host and reports land in the
+    dashboard process's storage.
+
+    Reads (list/get) also proxy through the JSON API, so the router is a
+    full ``StatsStorage`` — a worker can read back global state too.
+    """
+
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.base = url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str):
+        with urllib.request.urlopen(self.base + path, timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def put_report(self, report: StatsReport) -> None:
+        data = json.dumps(report.to_dict()).encode()
+        req = urllib.request.Request(
+            self.base + "/api/reports", data=data, method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            resp = json.loads(r.read())
+        if not resp.get("ok"):
+            raise RuntimeError(f"report rejected: {resp}")
+        self._notify(report)
+
+    def list_sessions(self):
+        return self._get("/api/sessions")
+
+    def list_workers(self, session_id: str):
+        return self._get(f"/api/sessions/{session_id}/workers")
+
+    def get_reports(self, session_id: str, worker_id: Optional[str] = None):
+        suffix = f"?worker={worker_id}" if worker_id else ""
+        dicts = self._get(f"/api/sessions/{session_id}/reports{suffix}")
+        return [StatsReport.from_dict(d) for d in dicts]

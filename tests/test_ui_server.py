@@ -1,0 +1,116 @@
+"""UiServer + remote stats transport + component DSL tests.
+
+Parity: ``UiServer.java:25-32`` (live dashboard server),
+``HistogramIterationListener.java:35-52`` (HTTP report transport),
+``deeplearning4j-ui-components`` (declarative chart/table/text DSL).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui import (
+    ChartHistogram, ChartHorizontalBar, ChartLine, ChartScatter, Component,
+    ComponentDiv, ComponentTable, ComponentText, InMemoryStatsStorage,
+    RemoteStatsStorageRouter, UiServer)
+from deeplearning4j_tpu.ui.stats import StatsReport
+
+
+def _report(i, session="s1", worker="w0", score=1.0):
+    return StatsReport(session_id=session, worker_id=worker, iteration=i,
+                       timestamp=1000.0 + i, score=score,
+                       param_norms={"layer0/W": 1.5})
+
+
+@pytest.fixture()
+def server():
+    storage = InMemoryStatsStorage()
+    srv = UiServer(storage, port=0).start()
+    yield srv, storage
+    srv.stop()
+
+
+def test_server_api_roundtrip(server):
+    srv, storage = server
+    for i in range(3):
+        storage.put_report(_report(i))
+    storage.put_report(_report(0, worker="w1"))
+
+    def get(path):
+        with urllib.request.urlopen(srv.url + path, timeout=5) as r:
+            return json.loads(r.read())
+
+    assert get("/api/sessions") == ["s1"]
+    assert get("/api/sessions/s1/workers") == ["w0", "w1"]
+    reports = get("/api/sessions/s1/reports")
+    assert len(reports) == 4
+    assert get("/api/sessions/s1/reports?worker=w1")[0]["worker_id"] == "w1"
+    with urllib.request.urlopen(srv.url + "/train/s1", timeout=5) as r:
+        page = r.read().decode()
+    assert "<svg" in page and "Score vs iteration" in page
+    with urllib.request.urlopen(srv.url + "/", timeout=5) as r:
+        index = r.read().decode()
+    assert "s1" in index
+
+
+def test_remote_router_ships_reports(server):
+    srv, storage = server
+    router = RemoteStatsStorageRouter(srv.url)
+    for i in range(4):
+        router.put_report(_report(i, session="remote"))
+    # landed in the server-side storage
+    assert len(storage.get_reports("remote")) == 4
+    # reads proxy through the API
+    assert router.list_sessions() == ["remote"]
+    got = router.get_reports("remote")
+    assert [r.iteration for r in got] == [0, 1, 2, 3]
+    assert got[0].param_norms == {"layer0/W": 1.5}
+
+
+def test_server_404_and_bad_post(server):
+    srv, _ = server
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(srv.url + "/api/nope", timeout=5)
+    assert e.value.code == 404
+    req = urllib.request.Request(srv.url + "/api/reports", data=b"not json",
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 400
+
+
+def test_component_dsl_roundtrip_and_render():
+    rng = np.random.default_rng(0)
+    counts, edges = np.histogram(rng.standard_normal(500), bins=10)
+    page = ComponentDiv(
+        ComponentText("LeNet run", size=18, bold=True),
+        ChartLine("score", x=[[0, 1, 2]], y=[[3.0, 2.0, 1.5]],
+                  series_names=["score"]),
+        ChartScatter("pts", x=[[0, 1]], y=[[1.0, 2.0]]),
+        ChartHistogram("W dist", lower=edges[:-1].tolist(),
+                       upper=edges[1:].tolist(), counts=counts.tolist()),
+        ChartHorizontalBar("norms", labels=["layer0/W", "layer0/b"],
+                           values=[1.5, 0.1]),
+        ComponentTable(header=["layer", "norm"],
+                       content=[["layer0/W", 1.5]], title="params"),
+        style="margin:8px",
+    )
+    blob = json.dumps(page.to_dict())
+    back = Component.from_dict(json.loads(blob))
+    assert isinstance(back, ComponentDiv) and len(back.children) == 6
+    assert json.dumps(back.to_dict()) == blob  # stable round-trip
+    html_page = back.render_page()
+    assert html_page.startswith("<!DOCTYPE html>")
+    for frag in ("LeNet run", "score", "W dist", "layer0/W", "<svg", "<table"):
+        assert frag in html_page
+
+
+def test_component_dsl_validation():
+    with pytest.raises(ValueError):
+        ChartLine("x", x=[[1]], y=[])
+    with pytest.raises(ValueError):
+        ChartHistogram("h", lower=[0], upper=[1, 2], counts=[1])
+    with pytest.raises(ValueError):
+        Component.from_dict({"componentType": "NoSuch"})
