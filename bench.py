@@ -166,10 +166,16 @@ def main():
     subs = {}
     for name, fn in [("gemm_bf16", bench_gemm), ("lenet_mnist", bench_lenet),
                      ("lstm_char", bench_lstm), ("resnet50", bench_resnet50)]:
-        try:
-            r = fn()
-        except Exception as e:  # a broken sub-bench must not hide the rest
-            r = {"error": f"{type(e).__name__}: {e}"}
+        r = None
+        attempts = 3  # tunneled remote-compile can drop transiently
+        for attempt in range(attempts):
+            try:
+                r = fn()
+                break
+            except Exception as e:  # a broken sub-bench must not hide the rest
+                r = {"error": f"{type(e).__name__}: {e}"}
+                if attempt < attempts - 1:
+                    time.sleep(5)
         if r is not None:
             subs[name] = r
 

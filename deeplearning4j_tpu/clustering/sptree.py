@@ -1,0 +1,222 @@
+"""SP-tree (generalized quad/oct tree) + Barnes-Hut forces.
+
+Parity: ``deeplearning4j-core/.../clustering/sptree/SpTree.java`` and
+``clustering/quadtree/QuadTree.java`` (SURVEY.md §2.3) — the
+space-partitioning tree Barnes-Hut t-SNE uses to approximate the
+repulsive force sum in O(n log n): each cell stores its center of mass
+and cumulative size; a traversal substitutes a whole far-away cell by
+its center of mass when the cell is "small enough seen from the point"
+(cell radius / distance < theta).
+
+Role in the TPU build: ``plot/tsne.py`` keeps the exact O(n²)
+formulation as the DEVICE path (pairwise matmuls are MXU-dense; a
+pointer tree cannot run on the TPU at all) — see the equivalence
+benchmark in ``tests/test_sptree.py``, which shows the exact device
+path dominating at t-SNE scales. The SP-tree is the HOST-side analog
+for (a) parity with the reference data structure, (b) n large enough
+that O(n²) memory (an [n,n] device buffer) stops fitting, and (c)
+nearest-cell queries on CPU-only processes (e.g. data workers).
+
+``QuadTree`` is the fixed-2-D specialization the reference ships
+separately; here it is literally the same structure with d=2.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class SpTree:
+    """Static SP-tree over an [n, d] point set.
+
+    Vectorized construction: points are bucketed per level by child
+    index (interleaved radix in d bits), no Python recursion per point.
+    Nodes are stored in flat arrays (struct-of-arrays — the JVM
+    reference chases one heap object per cell, SpTree.java:~node class;
+    flat arrays keep traversal cache-friendly and numpy-sliceable).
+    """
+
+    def __init__(self, data: np.ndarray, leaf_size: int = 1,
+                 max_depth: int = 32):
+        data = np.asarray(data, np.float64)
+        if data.ndim != 2:
+            raise ValueError(f"expected [n, d] points, got {data.shape}")
+        self.data = data
+        n, d = data.shape
+        self.n, self.d = n, d
+        self.leaf_size = max(1, leaf_size)
+        self.max_depth = max_depth
+
+        # node arrays (grown geometrically)
+        cap = max(16, 4 * n)
+        self._center = np.zeros((cap, d))      # cell geometric center
+        self._half = np.zeros(cap)             # cell half-width (max over dims)
+        self._com = np.zeros((cap, d))         # center of mass
+        self._count = np.zeros(cap, np.int64)  # points in cell
+        self._children = -np.ones((cap, 2 ** d), np.int64)
+        self._leaf_start = np.zeros(cap, np.int64)   # into self._order
+        self._leaf_len = np.zeros(cap, np.int64)
+        self._n_nodes = 0
+        self._order = np.arange(n)
+
+        if n:
+            self._build()
+
+    # -- construction --------------------------------------------------
+
+    def _alloc(self) -> int:
+        if self._n_nodes == len(self._half):
+            grow = len(self._half)
+            self._center = np.vstack([self._center, np.zeros((grow, self.d))])
+            self._half = np.concatenate([self._half, np.zeros(grow)])
+            self._com = np.vstack([self._com, np.zeros((grow, self.d))])
+            self._count = np.concatenate([self._count, np.zeros(grow, np.int64)])
+            self._children = np.vstack(
+                [self._children, -np.ones((grow, 2 ** self.d), np.int64)])
+            self._leaf_start = np.concatenate(
+                [self._leaf_start, np.zeros(grow, np.int64)])
+            self._leaf_len = np.concatenate(
+                [self._leaf_len, np.zeros(grow, np.int64)])
+        self._n_nodes += 1
+        return self._n_nodes - 1
+
+    def _build(self) -> None:
+        lo, hi = self.data.min(0), self.data.max(0)
+        center = (lo + hi) / 2.0
+        half = float(np.max(hi - lo) / 2.0) + 1e-10
+        root = self._alloc()
+        self._center[root] = center
+        self._half[root] = half
+        # (node, start, end, depth) work stack over the point-order array
+        stack = [(root, 0, self.n, 0)]
+        while stack:
+            node, s, e, depth = stack.pop()
+            idx = self._order[s:e]
+            pts = self.data[idx]
+            self._count[node] = e - s
+            self._com[node] = pts.mean(0)
+            dup = bool(np.all(pts == pts[0]))  # duplicate guard (SpTree.java)
+            if (e - s) <= self.leaf_size or depth >= self.max_depth or dup:
+                self._leaf_start[node], self._leaf_len[node] = s, e - s
+                continue
+            center, half = self._center[node], self._half[node] / 2.0
+            # child index = interleaved bits of (point >= center) per dim
+            bits = (pts >= center[None, :]).astype(np.int64)
+            child_of = bits @ (1 << np.arange(self.d, dtype=np.int64))
+            sort = np.argsort(child_of, kind="stable")
+            self._order[s:e] = idx[sort]
+            child_of = child_of[sort]
+            bounds = np.searchsorted(child_of, np.arange(2 ** self.d + 1))
+            for ci in range(2 ** self.d):
+                cs, ce = s + bounds[ci], s + bounds[ci + 1]
+                if cs == ce:
+                    continue
+                child = self._alloc()
+                offset = np.array([(half if (ci >> k) & 1 else -half)
+                                   for k in range(self.d)])
+                self._center[child] = center + offset
+                self._half[child] = half
+                self._children[node, ci] = child
+                stack.append((child, cs, ce, depth + 1))
+
+    # -- queries -------------------------------------------------------
+
+    @property
+    def n_nodes(self) -> int:
+        return self._n_nodes
+
+    def depth(self) -> int:
+        best = 0
+        stack = [(0, 1)] if self._n_nodes else []
+        while stack:
+            node, depth = stack.pop()
+            best = max(best, depth)
+            for c in self._children[node]:
+                if c >= 0:
+                    stack.append((c, depth + 1))
+        return best
+
+    def compute_non_edge_forces(self, point: np.ndarray, theta: float
+                                ) -> Tuple[np.ndarray, float]:
+        """Barnes-Hut repulsive term for one query point under the
+        t-SNE Student-t kernel (``SpTree.java`` computeNonEdgeForces):
+        returns (force_vector, sum_q) where
+        force = Σ q_ij² * count * (point - com) and sum_q = Σ q_ij*count
+        with q_ij = 1/(1+|point-com|²); exact whenever a cell is opened
+        down to leaves, approximated by COM when half/dist < theta.
+        Self-interaction (distance 0) is skipped, matching the
+        reference's skip of the query point's own cell entry.
+        """
+        point = np.asarray(point, np.float64)
+        force = np.zeros(self.d)
+        sum_q = 0.0
+        if not self._n_nodes:
+            return force, sum_q
+        stack = [0]
+        while stack:
+            node = stack.pop()
+            diff = point - self._com[node]
+            d2 = float(diff @ diff)
+            count = int(self._count[node])
+            is_leaf = self._leaf_len[node] > 0
+            if is_leaf or self._half[node] * 2.0 < theta * np.sqrt(max(d2, 1e-300)):
+                if is_leaf and (self._leaf_len[node] > 1 or d2 == 0.0):
+                    # open the leaf exactly (skipping the query point)
+                    s, ln = self._leaf_start[node], self._leaf_len[node]
+                    pts = self.data[self._order[s:s + ln]]
+                    dv = point[None, :] - pts
+                    dd = np.einsum("ij,ij->i", dv, dv)
+                    keep = dd > 0.0
+                    q = 1.0 / (1.0 + dd[keep])
+                    sum_q += float(q.sum())
+                    force += (q * q) @ dv[keep]
+                elif d2 > 0.0:
+                    q = 1.0 / (1.0 + d2)
+                    sum_q += q * count
+                    force += (q * q * count) * diff
+                continue
+            for c in self._children[node]:
+                if c >= 0:
+                    stack.append(c)
+        return force, sum_q
+
+
+class QuadTree(SpTree):
+    """2-D specialization (``clustering/quadtree/QuadTree.java``)."""
+
+    def __init__(self, data: np.ndarray, leaf_size: int = 1,
+                 max_depth: int = 32):
+        data = np.asarray(data)
+        if data.ndim != 2 or data.shape[1] != 2:
+            raise ValueError(f"QuadTree is 2-D; got {data.shape}")
+        super().__init__(data, leaf_size=leaf_size, max_depth=max_depth)
+
+
+def barnes_hut_tsne_gradient(y: np.ndarray, p_rows: np.ndarray,
+                             p_cols: np.ndarray, p_vals: np.ndarray,
+                             theta: float = 0.5) -> np.ndarray:
+    """Full Barnes-Hut t-SNE gradient on the host
+    (``plot/BarnesHutTsne.java:63`` gradient role): attractive term from
+    the sparse P (CSR triplets), repulsive term via :class:`SpTree`.
+
+    grad_i = 4 * (Σ_j p_ij q_ij (y_i - y_j)  -  (Σ_j q_ij² (y_i-y_j)) / sum_Q)
+    """
+    y = np.asarray(y, np.float64)
+    n, d = y.shape
+    tree = SpTree(y)
+    rep = np.zeros((n, d))
+    sum_q = 0.0
+    for i in range(n):
+        f, sq = tree.compute_non_edge_forces(y[i], theta)
+        rep[i] = f
+        sum_q += sq
+    attr = np.zeros((n, d))
+    for i in range(n):
+        js = p_cols[p_rows[i]:p_rows[i + 1]]
+        ps = p_vals[p_rows[i]:p_rows[i + 1]]
+        dv = y[i][None, :] - y[js]
+        q = 1.0 / (1.0 + np.einsum("ij,ij->i", dv, dv))
+        attr[i] = (ps * q) @ dv
+    return 4.0 * (attr - rep / max(sum_q, 1e-300))

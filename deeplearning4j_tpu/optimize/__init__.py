@@ -4,3 +4,4 @@ from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     PerformanceListener,
     CollectScoresIterationListener,
 )
+from deeplearning4j_tpu.optimize.training_stats import TrainingStats  # noqa: F401

@@ -24,6 +24,7 @@ collapse onto the mesh:
 
 from __future__ import annotations
 
+import contextlib
 import warnings
 from typing import Optional
 
@@ -33,15 +34,32 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator, DataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.optimize.training_stats import TrainingStats
 from deeplearning4j_tpu.parallel.mesh import MeshContext, make_mesh
+
+
+def _timed_batches(it: DataSetIterator, stats: Optional[TrainingStats]):
+    """Drain an iterator, attributing blocking time to ``data_wait``."""
+    if stats is None:
+        yield from it
+        return
+    it.reset()  # keep the for-loop protocol's __iter__ -> reset() semantics
+    while True:
+        with stats.time("data_wait"):
+            if not it.has_next():
+                return
+            ds = it.next()
+        yield ds
 
 
 class ParallelWrapper:
     def __init__(self, model, mesh=None, workers: Optional[int] = None,
                  averaging_frequency: int = 1, mode: str = "allreduce",
-                 prefetch_buffer: int = 4):
+                 prefetch_buffer: int = 4, collect_stats: bool = False):
         """``workers`` defaults to the mesh ``data`` axis size (the
-        reference defaulted to device count)."""
+        reference defaulted to device count). ``collect_stats=True``
+        records per-phase timings into ``self.stats``
+        (``setCollectTrainingStats`` / CommonSparkTrainingStats role)."""
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.ctx = MeshContext(self.mesh)
@@ -54,9 +72,18 @@ class ParallelWrapper:
             raise ValueError(mode)
         self.mode = mode
         self.prefetch_buffer = prefetch_buffer
+        self.stats: Optional[TrainingStats] = TrainingStats() if collect_stats else None
         self._vstep = None
         self._avg = None
         self._counter = 0
+
+    @contextlib.contextmanager
+    def _phase(self, name: str):
+        if self.stats is None:
+            yield
+        else:
+            with self.stats.time(name):
+                yield
 
     # ------------------------------------------------------------- allreduce
 
@@ -67,19 +94,21 @@ class ParallelWrapper:
         m.opt_state = jax.device_put(m.opt_state, repl)
         m.states = jax.device_put(m.states, repl)
         rng_key = jax.random.PRNGKey(m.gc.seed + 7919)
-        for ds in it:
+        for ds in _timed_batches(it, self.stats):
             fm = ds.features_mask is not None
             lm = ds.labels_mask is not None
             step = m._get_jit("train", fm=fm, lm=lm)
-            x, y, fmask, lmask = self.ctx.shard_batch(
-                np.asarray(ds.features, m._dtype), np.asarray(ds.labels, m._dtype),
-                None if not fm else np.asarray(ds.features_mask, m._dtype),
-                None if not lm else np.asarray(ds.labels_mask, m._dtype))
+            with self._phase("stage"):
+                x, y, fmask, lmask = self.ctx.shard_batch(
+                    np.asarray(ds.features, m._dtype), np.asarray(ds.labels, m._dtype),
+                    None if not fm else np.asarray(ds.features_mask, m._dtype),
+                    None if not lm else np.asarray(ds.labels_mask, m._dtype))
             zero = jnp.zeros((), m._dtype)
-            m.params, m.opt_state, m.states, score = step(
-                m.params, m.opt_state, m.states, x, y,
-                fmask if fm else zero, lmask if lm else zero, rng_key)
-            m._score = float(score)
+            with self._phase("step"):
+                m.params, m.opt_state, m.states, score = step(
+                    m.params, m.opt_state, m.states, x, y,
+                    fmask if fm else zero, lmask if lm else zero, rng_key)
+                m._score = float(score)  # score fetch = device sync
             for cb in m.listeners:
                 cb(m, int(m.opt_state["step"]), m._score)
 
@@ -123,7 +152,7 @@ class ParallelWrapper:
         wopt = spread(m.opt_state)
         wstates = spread(m.states)
         rng_key = jax.random.PRNGKey(m.gc.seed + 7919)
-        for ds in it:
+        for ds in _timed_batches(it, self.stats):
             if ds.features_mask is not None or ds.labels_mask is not None:
                 raise ValueError("averaging mode does not support masked DataSets; "
                                  "use mode='allreduce'")
@@ -138,14 +167,17 @@ class ParallelWrapper:
                 warnings.warn(
                     f"averaging mode drops {n - per * W} tail examples of a "
                     f"{n}-example minibatch (not divisible by {W} workers)")
-            x = np.asarray(ds.features[:per * W], m._dtype).reshape((W, per) + ds.features.shape[1:])
-            y = np.asarray(ds.labels[:per * W], m._dtype).reshape((W, per) + ds.labels.shape[1:])
-            x, y = self.ctx.shard_batch(x, y)
-            wparams, wopt, wstates, scores = self._vstep(wparams, wopt, wstates, x, y, rng_key)
-            self._counter += 1
-            m._score = float(jnp.mean(scores))
+            with self._phase("stage"):
+                x = np.asarray(ds.features[:per * W], m._dtype).reshape((W, per) + ds.features.shape[1:])
+                y = np.asarray(ds.labels[:per * W], m._dtype).reshape((W, per) + ds.labels.shape[1:])
+                x, y = self.ctx.shard_batch(x, y)
+            with self._phase("step"):
+                wparams, wopt, wstates, scores = self._vstep(wparams, wopt, wstates, x, y, rng_key)
+                self._counter += 1
+                m._score = float(jnp.mean(scores))  # score fetch = device sync
             if self._counter % self.averaging_frequency == 0:
-                wparams, wopt = self._avg(wparams, wopt)
+                with self._phase("average"):
+                    wparams, wopt = self._avg(wparams, wopt)
             for cb in m.listeners:
                 cb(m, self._counter, m._score)
         # final average + collapse back onto the wrapped model (:121);

@@ -1,0 +1,32 @@
+"""Streaming ingest/serve plane.
+
+Parity: ``dl4j-streaming`` (SURVEY.md §2.6) — Kafka NDArray/DataSet
+publish+consume (``streaming/kafka/NDArrayKafkaClient.java``), Camel
+routes (``routes/DL4jServeRouteBuilder.java``), and Spark-streaming
+train/inference pipelines (``pipeline/spark/SparkStreamingPipeline.java``).
+
+TPU-first re-design: the broker is an SPI (``MessageBroker``) with an
+in-process queue impl and a dependency-free TCP impl (the Kafka role on
+a zero-egress pod; a real Kafka client would plug into the same SPI).
+Wire format is npz — self-describing, dtype/shape-safe, zero-copy into
+numpy. Pipelines feed the SAME compiled fit/output paths as batch
+training: a stream is just a DataSetIterator whose ``has_next`` blocks.
+"""
+
+from deeplearning4j_tpu.streaming.broker import (  # noqa: F401
+    InMemoryBroker,
+    MessageBroker,
+    TcpBroker,
+    TcpBrokerServer,
+)
+from deeplearning4j_tpu.streaming.pipeline import (  # noqa: F401
+    StreamingDataSetIterator,
+    StreamingInference,
+    StreamingTrainer,
+)
+from deeplearning4j_tpu.streaming.serde import (  # noqa: F401
+    dataset_from_bytes,
+    dataset_to_bytes,
+    ndarray_from_bytes,
+    ndarray_to_bytes,
+)

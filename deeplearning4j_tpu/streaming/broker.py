@@ -1,0 +1,173 @@
+"""Message broker SPI + in-memory and TCP implementations.
+
+Parity: the Kafka producer/consumer pair in
+``dl4j-streaming/.../kafka/NDArrayKafkaClient.java`` (+
+``NDArrayPublisher``/``NDArrayConsumer``). The SPI keeps the pipeline
+layer transport-agnostic; ``InMemoryBroker`` is the test/dev transport,
+``TcpBroker(Server)`` is a dependency-free network transport with
+length-prefixed frames and per-topic FIFO queues (at-most-once, one
+consumer group — the subset of Kafka semantics the reference pipelines
+actually use).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional
+
+_MAX_FRAME = 1 << 30
+
+
+class MessageBroker:
+    """Transport SPI: byte payloads on named topics."""
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def consume(self, topic: str, timeout: Optional[float] = None) -> Optional[bytes]:
+        """Pop the next payload, blocking up to ``timeout`` seconds.
+        Returns None on timeout."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryBroker(MessageBroker):
+    """Per-topic FIFO queues in-process."""
+
+    def __init__(self):
+        self._topics: Dict[str, "queue.Queue[bytes]"] = {}
+        self._lock = threading.Lock()
+
+    def _q(self, topic: str) -> "queue.Queue[bytes]":
+        with self._lock:
+            if topic not in self._topics:
+                self._topics[topic] = queue.Queue()
+            return self._topics[topic]
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        self._q(topic).put(bytes(payload))
+
+    def consume(self, topic: str, timeout: Optional[float] = None) -> Optional[bytes]:
+        try:
+            return self._q(topic).get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+# --- TCP transport ----------------------------------------------------------
+# Frame: 1-byte op ('P' publish / 'C' consume) + u16 topic len + topic utf-8
+#        + u32 payload len + payload.
+# Reply: u32 len + payload ('' = timeout/none for consume; 'ok' for publish).
+
+def _send_frame(sock: socket.socket, op: bytes, topic: str, payload: bytes) -> None:
+    t = topic.encode()
+    sock.sendall(op + struct.pack(">HI", len(t), len(payload)) + t + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class _BrokerHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        broker: InMemoryBroker = self.server._broker  # type: ignore[attr-defined]
+        timeout = self.server._poll_timeout  # type: ignore[attr-defined]
+        while True:
+            try:
+                op = _recv_exact(self.request, 1)
+            except ConnectionError:
+                return
+            tlen, plen = struct.unpack(">HI", _recv_exact(self.request, 6))
+            if plen > _MAX_FRAME:
+                return
+            topic = _recv_exact(self.request, tlen).decode()
+            payload = _recv_exact(self.request, plen)
+            if op == b"P":
+                broker.publish(topic, payload)
+                reply = b"ok"
+            elif op == b"C":
+                reply = broker.consume(topic, timeout=timeout) or b""
+            else:
+                return
+            self.request.sendall(struct.pack(">I", len(reply)) + reply)
+
+
+class TcpBrokerServer:
+    """Broker daemon: topics live server-side in an ``InMemoryBroker``;
+    any number of TCP clients publish/consume. ``port=0`` auto-picks."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 poll_timeout: float = 0.25):
+        self._srv = socketserver.ThreadingTCPServer((host, port), _BrokerHandler)
+        self._srv.daemon_threads = True
+        self._srv._broker = InMemoryBroker()  # type: ignore[attr-defined]
+        self._srv._poll_timeout = poll_timeout  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self):
+        return self._srv.server_address[:2]
+
+    def start(self) -> "TcpBrokerServer":
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        name="dl4j-tpu-broker", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class TcpBroker(MessageBroker):
+    """Client half: a ``MessageBroker`` over one TCP connection to a
+    :class:`TcpBrokerServer`. Consume long-polls: the server replies
+    empty after its poll timeout and the client retries until the
+    caller's ``timeout`` budget runs out."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 5.0):
+        self._sock = socket.create_connection((host, port), timeout=connect_timeout)
+        self._sock.settimeout(None)  # long-poll replies block
+        self._lock = threading.Lock()
+
+    def _roundtrip(self, op: bytes, topic: str, payload: bytes) -> bytes:
+        with self._lock:
+            _send_frame(self._sock, op, topic, payload)
+            (rlen,) = struct.unpack(">I", _recv_exact(self._sock, 4))
+            return _recv_exact(self._sock, rlen)
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        reply = self._roundtrip(b"P", topic, payload)
+        if reply != b"ok":
+            raise RuntimeError(f"publish rejected: {reply!r}")
+
+    def consume(self, topic: str, timeout: Optional[float] = None) -> Optional[bytes]:
+        import time
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            reply = self._roundtrip(b"C", topic, b"")
+            if reply:
+                return reply
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,199 @@
+"""Streaming train / inference pipelines over a MessageBroker.
+
+Parity: ``dl4j-streaming/.../pipeline/spark/SparkStreamingPipeline.java``
+(consume DataSets from Kafka, fit per micro-batch) and
+``routes/DL4jServeRouteBuilder.java`` (serve route: features in →
+predictions out). A stream here is just a ``DataSetIterator`` whose
+``has_next`` blocks on the broker, so it feeds the SAME compiled
+fit/output hot paths as batch training — micro-batching is the
+device-efficiency knob (bigger batches = better MXU utilisation), not a
+separate execution engine.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+from deeplearning4j_tpu.streaming.broker import MessageBroker
+from deeplearning4j_tpu.streaming.serde import (
+    dataset_from_bytes, dataset_to_bytes, ndarray_from_bytes, ndarray_to_bytes)
+
+_STOP = b"__dl4j_tpu_stream_stop__"
+
+
+def publish_dataset(broker: MessageBroker, topic: str, ds: DataSet) -> None:
+    broker.publish(topic, dataset_to_bytes(ds))
+
+
+def publish_stop(broker: MessageBroker, topic: str) -> None:
+    """Poison pill: downstream iterators/trainers drain and exit."""
+    broker.publish(topic, _STOP)
+
+
+class StreamingDataSetIterator(DataSetIterator):
+    """Broker topic → blocking DataSetIterator.
+
+    Accumulates incoming DataSets until ``batch_size`` examples are
+    buffered (micro-batching), then emits one concatenated DataSet.
+    ``has_next`` returns False after a stop pill or an idle period of
+    ``idle_timeout`` seconds (None = wait forever).
+    """
+
+    def __init__(self, broker: MessageBroker, topic: str, batch_size: int = 32,
+                 idle_timeout: Optional[float] = None):
+        self.broker = broker
+        self.topic = topic
+        self.batch_size = batch_size
+        self.idle_timeout = idle_timeout
+        self._buffer: List[DataSet] = []
+        self._buffered = 0
+        self._pending: Optional[DataSet] = None
+        self._stopped = False
+
+    def _pull(self) -> bool:
+        """Fetch one message into the buffer; False on stop/timeout."""
+        payload = self.broker.consume(self.topic, timeout=self.idle_timeout)
+        if payload is None or payload == _STOP:
+            self._stopped = True
+            return False
+        ds = dataset_from_bytes(payload)
+        self._buffer.append(ds)
+        self._buffered += ds.num_examples()
+        return True
+
+    def _emit(self) -> Optional[DataSet]:
+        if not self._buffer:
+            return None
+        parts = self._buffer
+        self._buffer, self._buffered = [], 0
+        if len(parts) == 1:
+            return parts[0]
+        cat = (lambda arrs: None if arrs[0] is None
+               else np.concatenate(arrs, axis=0))
+        return DataSet(
+            features=cat([p.features for p in parts]),
+            labels=cat([p.labels for p in parts]),
+            features_mask=cat([p.features_mask for p in parts]),
+            labels_mask=cat([p.labels_mask for p in parts]))
+
+    def has_next(self) -> bool:
+        if self._pending is not None:
+            return True
+        while not self._stopped and self._buffered < self.batch_size:
+            if not self._pull():
+                break
+        self._pending = self._emit()
+        return self._pending is not None
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        out, self._pending = self._pending, None
+        return out
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def reset(self) -> None:  # streams don't rewind (Kafka offset semantics)
+        pass
+
+    def async_supported(self) -> bool:
+        return True
+
+
+class StreamingTrainer:
+    """Consume DataSets from a topic and fit the model per micro-batch
+    (``SparkStreamingPipeline`` train role). Runs inline (``run``) or on
+    a daemon thread (``start``/``join``)."""
+
+    def __init__(self, net, broker: MessageBroker, topic: str,
+                 batch_size: int = 32, idle_timeout: Optional[float] = None):
+        self.net = net
+        self.iterator = StreamingDataSetIterator(
+            broker, topic, batch_size=batch_size, idle_timeout=idle_timeout)
+        self.batches_fit = 0
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def run(self, max_batches: Optional[int] = None) -> int:
+        while self.iterator.has_next():
+            self.net.fit(self.iterator.next())
+            self.batches_fit += 1
+            if max_batches is not None and self.batches_fit >= max_batches:
+                break
+        return self.batches_fit
+
+    def start(self, max_batches: Optional[int] = None) -> "StreamingTrainer":
+        def _target():
+            try:
+                self.run(max_batches)
+            except BaseException as e:  # surfaced in join()
+                self._error = e
+        self._thread = threading.Thread(target=_target, daemon=True,
+                                        name="dl4j-tpu-stream-train")
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> int:
+        if self._thread:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("streaming trainer still running")
+        if self._error is not None:
+            raise self._error
+        return self.batches_fit
+
+
+class StreamingInference:
+    """Serve route (``DL4jServeRouteBuilder``): consume feature arrays
+    from ``in_topic``, publish ``net.output`` predictions to
+    ``out_topic`` until a stop pill (or idle timeout) arrives."""
+
+    def __init__(self, net, broker: MessageBroker, in_topic: str,
+                 out_topic: str, idle_timeout: Optional[float] = None):
+        self.net = net
+        self.broker = broker
+        self.in_topic = in_topic
+        self.out_topic = out_topic
+        self.idle_timeout = idle_timeout
+        self.served = 0
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def run(self, max_requests: Optional[int] = None) -> int:
+        while True:
+            payload = self.broker.consume(self.in_topic, timeout=self.idle_timeout)
+            if payload is None or payload == _STOP:
+                break
+            x = ndarray_from_bytes(payload)
+            pred = np.asarray(self.net.output(x))
+            self.broker.publish(self.out_topic, ndarray_to_bytes(pred))
+            self.served += 1
+            if max_requests is not None and self.served >= max_requests:
+                break
+        return self.served
+
+    def start(self, max_requests: Optional[int] = None) -> "StreamingInference":
+        def _target():
+            try:
+                self.run(max_requests)
+            except BaseException as e:
+                self._error = e
+        self._thread = threading.Thread(target=_target, daemon=True,
+                                        name="dl4j-tpu-stream-serve")
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> int:
+        if self._thread:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("streaming inference still running")
+        if self._error is not None:
+            raise self._error
+        return self.served

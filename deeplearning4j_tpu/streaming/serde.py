@@ -1,0 +1,46 @@
+"""NDArray / DataSet wire serialization.
+
+Parity: ``dl4j-streaming/.../serde/`` + ``NDArrayKafkaClient.java``
+(base64-JSON NDArray payloads). Here the payload is npz bytes:
+self-describing (dtype+shape embedded), portable, and loads straight
+into numpy without a codec layer.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+def ndarray_to_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def ndarray_from_bytes(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+def dataset_to_bytes(ds: DataSet) -> bytes:
+    arrays = {"features": ds.features, "labels": ds.labels}
+    if ds.features_mask is not None:
+        arrays["features_mask"] = ds.features_mask
+    if ds.labels_mask is not None:
+        arrays["labels_mask"] = ds.labels_mask
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def dataset_from_bytes(data: bytes) -> DataSet:
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        def opt(name) -> Optional[np.ndarray]:
+            return z[name] if name in z.files else None
+        return DataSet(features=z["features"], labels=z["labels"],
+                       features_mask=opt("features_mask"),
+                       labels_mask=opt("labels_mask"))

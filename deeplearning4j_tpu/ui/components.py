@@ -157,13 +157,10 @@ class ChartScatter(ChartLine):
     component_type = "ChartScatter"
 
     def render_html(self) -> str:
-        # render as a line chart with zero-length segments: reuse the SVG
-        # scaffolding but emit circles by chopping each series to points
         series = {name: list(zip(xs, ys)) for name, xs, ys
                   in zip(self.series_names, self.x, self.y)}
-        svg = _svg_line_chart(self.title, series, log_y=self.log_y)
-        return svg.replace('fill="none" stroke-width="1.5"',
-                           'fill="none" stroke-width="0"')
+        return _svg_line_chart(self.title, series, log_y=self.log_y,
+                               point_marks=True)
 
 
 @_register

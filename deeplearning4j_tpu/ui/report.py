@@ -26,8 +26,9 @@ def _finite(points: Sequence[Tuple[float, float]]) -> List[Tuple[float, float]]:
 
 
 def _svg_line_chart(title: str, series: Dict[str, List[Tuple[float, float]]],
-                    log_y: bool = False) -> str:
-    """Multi-series line chart as a standalone <svg>."""
+                    log_y: bool = False, point_marks: bool = False) -> str:
+    """Multi-series chart as a standalone <svg>; ``point_marks=True``
+    draws circles per point instead of connecting lines (scatter)."""
     all_pts = _finite([p for pts in series.values() for p in pts])
     if not all_pts:
         return f"<h3>{html.escape(title)}</h3><p>(no data)</p>"
@@ -70,8 +71,12 @@ def _svg_line_chart(title: str, series: Dict[str, List[Tuple[float, float]]],
         if not pts:
             continue
         color = _COLORS[i % len(_COLORS)]
-        d = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in pts)
-        parts.append(f'<polyline points="{d}" fill="none" stroke="{color}" stroke-width="1.5"/>')
+        if point_marks:
+            parts.extend(f'<circle cx="{sx(x):.1f}" cy="{sy(y):.1f}" r="2.5" '
+                         f'fill="{color}"/>' for x, y in pts)
+        else:
+            d = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in pts)
+            parts.append(f'<polyline points="{d}" fill="none" stroke="{color}" stroke-width="1.5"/>')
         ly = _PAD + 12 * (i + 1)
         parts.append(f'<rect x="{_W-_PAD-130}" y="{ly-8}" width="8" height="8" fill="{color}"/>')
         parts.append(f'<text x="{_W-_PAD-118}" y="{ly}" font-size="10">{html.escape(name[:24])}</text>')
@@ -106,7 +111,7 @@ def render_html(storage: StatsStorage, session_id: str,
     head = (f"<h1>deeplearning4j_tpu training report</h1>"
             f"<p>session <b>{html.escape(session_id)}</b>, "
             f"{len(reports)} reports, workers: "
-            f"{', '.join(storage.list_workers(session_id)) or '-'}</p>")
+            f"{html.escape(', '.join(storage.list_workers(session_id)) or '-')}</p>")
     return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
             "<title>training report</title></head>"
             f"<body style='font-family:sans-serif'>{head}"

@@ -34,7 +34,7 @@ import threading
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import parse_qs, unquote, urlparse
+from urllib.parse import parse_qs, quote, unquote, urlencode, urlparse
 
 from deeplearning4j_tpu.ui.report import render_html
 from deeplearning4j_tpu.ui.stats import StatsReport
@@ -106,7 +106,7 @@ class _Handler(BaseHTTPRequestHandler):
         for s in self.storage.list_sessions():
             workers = ", ".join(self.storage.list_workers(s)) or "-"
             n = len(self.storage.get_reports(s))
-            link = f"/train/{html.escape(s)}"
+            link = f"/train/{html.escape(quote(s, safe=''))}"
             rows.append(f"<tr><td><a href='{link}'>{html.escape(s)}</a></td>"
                         f"<td>{n}</td><td>{html.escape(workers)}</td></tr>")
         body = ("<table border='1' cellpadding='4'>"
@@ -189,9 +189,10 @@ class RemoteStatsStorageRouter(StatsStorage):
         return self._get("/api/sessions")
 
     def list_workers(self, session_id: str):
-        return self._get(f"/api/sessions/{session_id}/workers")
+        return self._get(f"/api/sessions/{quote(session_id, safe='')}/workers")
 
     def get_reports(self, session_id: str, worker_id: Optional[str] = None):
-        suffix = f"?worker={worker_id}" if worker_id else ""
-        dicts = self._get(f"/api/sessions/{session_id}/reports{suffix}")
+        suffix = "?" + urlencode({"worker": worker_id}) if worker_id else ""
+        dicts = self._get(
+            f"/api/sessions/{quote(session_id, safe='')}/reports{suffix}")
         return [StatsReport.from_dict(d) for d in dicts]

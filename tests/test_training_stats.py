@@ -1,0 +1,105 @@
+"""Phase-timer instrumentation tests.
+
+Parity: ``spark/stats/CommonSparkTrainingStats.java`` /
+``StatsUtils.java`` — per-phase timings, export, cross-worker merge;
+wired into ParallelWrapper via ``collect_stats=True``
+(``setCollectTrainingStats`` role).
+"""
+
+import json
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.training_stats import TrainingStats
+from deeplearning4j_tpu.parallel import ParallelWrapper
+
+
+def test_basic_aggregation_and_export(tmp_path):
+    stats = TrainingStats()
+    for ms in (1.0, 3.0, 2.0):
+        stats.add("step", ms)
+    with stats.time("data_wait"):
+        time.sleep(0.01)
+    s = stats.summary()
+    assert s["step"]["count"] == 3
+    assert s["step"]["total_ms"] == 6.0
+    assert s["step"]["min_ms"] == 1.0 and s["step"]["max_ms"] == 3.0
+    assert s["data_wait"]["mean_ms"] >= 9.0
+    assert len(stats.timeline()) == 4
+    path = stats.export_json(str(tmp_path / "stats.json"))
+    loaded = json.load(open(path))
+    assert loaded["summary"]["step"]["count"] == 3
+    assert loaded["timeline"][0]["phase"] == "step"
+
+
+def test_merge_namespacing():
+    master, worker = TrainingStats(), TrainingStats()
+    worker.add("step", 5.0)
+    worker.add("step", 7.0)
+    master.add("average", 1.0)
+    master.merge(worker, prefix="worker1/")
+    s = master.summary()
+    assert s["worker1/step"]["count"] == 2
+    assert s["average"]["count"] == 1
+    # merging same-named phases accumulates
+    master.merge(worker, prefix="worker1/")
+    assert master.summary()["worker1/step"]["count"] == 4
+
+
+def _net_and_data(rng):
+    conf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+            .updater("sgd").activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+    return net, DataSet(x, y)
+
+
+def test_parallel_wrapper_collects_phases(rng):
+    net, ds = _net_and_data(rng)
+    pw = ParallelWrapper(net, collect_stats=True)
+    pw.fit(ds)
+    s = pw.stats.summary()
+    assert {"data_wait", "stage", "step"} <= set(s)
+    assert s["step"]["count"] == 1
+    assert all(v["total_ms"] >= 0 for v in s.values())
+
+
+def test_parallel_wrapper_averaging_collects_average_phase(rng):
+    net, ds = _net_and_data(rng)
+    pw = ParallelWrapper(net, mode="averaging",
+                         averaging_frequency=1, collect_stats=True)
+    pw.fit(ds)
+    s = pw.stats.summary()
+    assert {"data_wait", "stage", "step", "average"} <= set(s)
+
+
+def test_refit_same_iterator_with_stats(rng):
+    """collect_stats must keep the for-loop reset semantics: fitting the
+    same iterator twice trains both epochs (regression: _timed_batches
+    skipped __iter__ -> reset())."""
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    net, ds = _net_and_data(rng)
+    it = ListDataSetIterator(ds, 16)  # 32 examples -> 2 batches
+    pw = ParallelWrapper(net, collect_stats=True)
+    pw.fit(it)
+    pw.fit(it)
+    assert pw.stats.summary()["step"]["count"] == 4
+
+
+def test_stats_off_by_default(rng):
+    net, ds = _net_and_data(rng)
+    pw = ParallelWrapper(net)
+    pw.fit(ds)
+    assert pw.stats is None

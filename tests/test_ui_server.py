@@ -105,6 +105,9 @@ def test_component_dsl_roundtrip_and_render():
     assert html_page.startswith("<!DOCTYPE html>")
     for frag in ("LeNet run", "score", "W dist", "layer0/W", "<svg", "<table"):
         assert frag in html_page
+    # scatter draws point marks, line draws polylines
+    assert "<circle" in back.children[2].render_html()
+    assert "<polyline" in back.children[1].render_html()
 
 
 def test_component_dsl_validation():
