@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.layers.base import LayerImpl, register_impl
 from deeplearning4j_tpu.nn.weights import init_weights
-from deeplearning4j_tpu.ops.attention import scaled_dot_product_attention
+from deeplearning4j_tpu.ops.flash_attention import flash_attention
 from deeplearning4j_tpu.parallel.mesh import current_sequence_mesh
 from deeplearning4j_tpu.parallel.ring_attention import ring_attention
 
@@ -62,9 +62,9 @@ class AttentionImpl(LayerImpl):
             mesh, axis = seq
             o = ring_attention(q, k, v, mesh, axis=axis, causal=c.causal)
         else:
-            # mask (variable-length) stays on the full-attention path —
-            # ring blocks assume dense time
-            o = scaled_dot_product_attention(q, k, v, causal=c.causal, mask=mask)
+            # flash Pallas kernel when it applies; key-validity masks
+            # (variable-length) fall back to the full XLA path inside
+            o = flash_attention(q, k, v, causal=c.causal, mask=mask)
         out = o.reshape(b, t, c.n_out) @ params["Wo"].astype(x.dtype) \
             + params["bo"].astype(x.dtype)
         if c.residual:

@@ -9,3 +9,4 @@ the surrounding matmuls/convs instead of round-tripping HBM.
 
 from deeplearning4j_tpu.ops.activations import Activation, activate  # noqa: F401
 from deeplearning4j_tpu.ops.losses import LossFunction, compute_loss  # noqa: F401
+from deeplearning4j_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,102 @@
+"""Flash-attention Pallas kernel vs the XLA oracle.
+
+The exact formulation in ``ops/attention.py`` is the correctness
+oracle (same doctrine as ring attention); the kernel must match it in
+forward AND gradients, causal and not, square and cross-length.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.attention import scaled_dot_product_attention
+from deeplearning4j_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(rng, b=2, tq=128, tk=128, h=2, d=64):
+    mk = lambda t: jnp.asarray(
+        rng.standard_normal((b, t, h, d)), jnp.float32)
+    return mk(tq), mk(tk), mk(tk)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_oracle(rng, causal):
+    q, k, v = _qkv(rng)
+    got = flash_attention(q, k, v, causal=causal)
+    want = scaled_dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_cross_length_causal(rng):
+    """tq != tk exercises the diagonal offset (tril k=tk-tq)."""
+    q, k, v = _qkv(rng, tq=64, tk=256)
+    got = flash_attention(q, k, v, causal=True)
+    want = scaled_dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_multi_kblock_accumulation(rng):
+    """Long keys force several online-softmax steps per q block."""
+    q, k, v = _qkv(rng, tq=32, tk=512, d=32)
+    got = flash_attention(q, k, v, block_q=32, block_k=128)
+    want = scaled_dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_oracle(rng, causal):
+    q, k, v = _qkv(rng, b=1, tq=64, tk=64, h=1, d=32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(scaled_dot_product_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_bf16_inputs(rng):
+    q, k, v = _qkv(rng)
+    got = flash_attention(q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                          v.astype(jnp.bfloat16))
+    assert got.dtype == jnp.bfloat16
+    want = scaled_dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=3e-2, atol=3e-2)
+
+
+def test_mask_falls_back(rng):
+    """Key-validity masks take the XLA path — results must still match."""
+    q, k, v = _qkv(rng, b=2, tq=16, tk=16)
+    mask = np.ones((2, 16), np.float32)
+    mask[:, 10:] = 0.0
+    got = flash_attention(q, k, v, mask=jnp.asarray(mask))
+    want = scaled_dot_product_attention(q, k, v, mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_odd_lengths_fall_back(rng):
+    q, k, v = _qkv(rng, tq=17, tk=23, d=16)
+    got = flash_attention(q, k, v)
+    want = scaled_dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_jit_and_under_vmap(rng):
+    q, k, v = _qkv(rng, b=1, tq=32, tk=32, d=32)
+    jitted = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(
+        np.asarray(jitted(q, k, v)),
+        np.asarray(scaled_dot_product_attention(q, k, v, causal=True)),
+        rtol=2e-5, atol=2e-5)
