@@ -63,7 +63,9 @@ class InMemoryBroker(MessageBroker):
 # --- TCP transport ----------------------------------------------------------
 # Frame: 1-byte op ('P' publish / 'C' consume) + u16 topic len + topic utf-8
 #        + u32 payload len + payload.
-# Reply: u32 len + payload ('' = timeout/none for consume; 'ok' for publish).
+# Reply: 1-byte status (1 = payload follows / 0 = none-or-ack) + u32 len +
+#        payload. The status byte keeps zero-length payloads distinguishable
+#        from a consume poll timeout.
 
 def _send_frame(sock: socket.socket, op: bytes, topic: str, payload: bytes) -> None:
     t = topic.encode()
@@ -97,12 +99,14 @@ class _BrokerHandler(socketserver.BaseRequestHandler):
             payload = _recv_exact(self.request, plen)
             if op == b"P":
                 broker.publish(topic, payload)
-                reply = b"ok"
+                status, reply = b"\x00", b""
             elif op == b"C":
-                reply = broker.consume(topic, timeout=timeout) or b""
+                msg = broker.consume(topic, timeout=timeout)
+                status = b"\x00" if msg is None else b"\x01"
+                reply = msg or b""
             else:
                 return
-            self.request.sendall(struct.pack(">I", len(reply)) + reply)
+            self.request.sendall(status + struct.pack(">I", len(reply)) + reply)
 
 
 class TcpBrokerServer:
@@ -145,23 +149,22 @@ class TcpBroker(MessageBroker):
         self._sock.settimeout(None)  # long-poll replies block
         self._lock = threading.Lock()
 
-    def _roundtrip(self, op: bytes, topic: str, payload: bytes) -> bytes:
+    def _roundtrip(self, op: bytes, topic: str, payload: bytes):
         with self._lock:
             _send_frame(self._sock, op, topic, payload)
+            status = _recv_exact(self._sock, 1)
             (rlen,) = struct.unpack(">I", _recv_exact(self._sock, 4))
-            return _recv_exact(self._sock, rlen)
+            return status == b"\x01", _recv_exact(self._sock, rlen)
 
     def publish(self, topic: str, payload: bytes) -> None:
-        reply = self._roundtrip(b"P", topic, payload)
-        if reply != b"ok":
-            raise RuntimeError(f"publish rejected: {reply!r}")
+        self._roundtrip(b"P", topic, payload)
 
     def consume(self, topic: str, timeout: Optional[float] = None) -> Optional[bytes]:
         import time
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            reply = self._roundtrip(b"C", topic, b"")
-            if reply:
+            found, reply = self._roundtrip(b"C", topic, b"")
+            if found:
                 return reply
             if deadline is not None and time.monotonic() >= deadline:
                 return None

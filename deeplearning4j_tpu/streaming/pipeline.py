@@ -73,13 +73,23 @@ class StreamingDataSetIterator(DataSetIterator):
         self._buffer, self._buffered = [], 0
         if len(parts) == 1:
             return parts[0]
-        cat = (lambda arrs: None if arrs[0] is None
-               else np.concatenate(arrs, axis=0))
+
+        def cat_masks(masks, shapes):
+            # mixed presence: a missing mask means "all valid" — fill
+            # with ones so no part's padding info is dropped
+            if all(m is None for m in masks):
+                return None
+            return np.concatenate(
+                [np.ones(shape, np.float32) if m is None else m
+                 for m, shape in zip(masks, shapes)], axis=0)
+
         return DataSet(
-            features=cat([p.features for p in parts]),
-            labels=cat([p.labels for p in parts]),
-            features_mask=cat([p.features_mask for p in parts]),
-            labels_mask=cat([p.labels_mask for p in parts]))
+            features=np.concatenate([p.features for p in parts], axis=0),
+            labels=np.concatenate([p.labels for p in parts], axis=0),
+            features_mask=cat_masks([p.features_mask for p in parts],
+                                    [p.features.shape[:2] for p in parts]),
+            labels_mask=cat_masks([p.labels_mask for p in parts],
+                                  [p.labels.shape[:2] for p in parts]))
 
     def has_next(self) -> bool:
         if self._pending is not None:

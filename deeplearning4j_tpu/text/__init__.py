@@ -12,3 +12,9 @@ from deeplearning4j_tpu.text.sentenceiterator import (  # noqa: F401
     FileSentenceIterator,
     BasicLineIterator,
 )
+from deeplearning4j_tpu.text.annotation import (  # noqa: F401
+    AnnotatedTokenizerFactory,
+    AnnotationPipeline,
+    Annotator,
+    default_pipeline,
+)

@@ -1,0 +1,268 @@
+"""Annotation pipeline: sentence split → tokenize → POS → lemma.
+
+Parity: ``deeplearning4j-nlp-uima`` (SURVEY.md §2.5) — the reference
+runs a UIMA ``AnalysisEngine`` pipeline (``text/annotator/
+{SentenceAnnotator,TokenizerAnnotator,PoStagger,StemmerAnnotator}``)
+whose net effect on the framework is: sentence boundaries, tokens with
+part-of-speech tags, and lemmatized token streams feeding
+``UimaTokenizerFactory``. This module provides that seam without the
+UIMA runtime: ``Annotator`` is the SPI (an ``AnalysisEngine`` role),
+``AnnotationPipeline`` the aggregate engine, and the bundled annotators
+are dependency-free rule/lexicon implementations. Heavier taggers
+(a real treebank parser, SentiWordNet) plug in as ``Annotator``
+subclasses — the pipeline contract, not the linguistics, is the parity
+surface.
+
+``AnnotatedTokenizerFactory`` adapts a pipeline into the tokenizer SPI
+(``UimaTokenizerFactory`` role) so Word2Vec/BOW/paragraph-vectors can
+consume lemmatized, POS-filtered token streams.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Iterable, List, Optional, Sequence
+
+from deeplearning4j_tpu.text.tokenization import (
+    Tokenizer, TokenizerFactory, register_tokenizer_factory)
+
+
+@dataclasses.dataclass
+class TokenAnnotation:
+    """One token's annotations (UIMA ``Token`` feature-structure role)."""
+
+    text: str
+    start: int                 # char offset into the document
+    end: int
+    sentence: int              # sentence index
+    pos: Optional[str] = None  # coarse tag: NOUN/VERB/ADJ/ADV/PRON/DET/ADP/NUM/PUNCT/X
+    lemma: Optional[str] = None
+
+
+@dataclasses.dataclass
+class AnnotatedDocument:
+    """The CAS role: raw text + accumulated annotations."""
+
+    text: str
+    sentences: List[str] = dataclasses.field(default_factory=list)
+    # (start, end) char spans per sentence
+    sentence_spans: List[tuple] = dataclasses.field(default_factory=list)
+    tokens: List[TokenAnnotation] = dataclasses.field(default_factory=list)
+
+
+class Annotator:
+    """AnalysisEngine SPI: mutate/extend the document's annotations."""
+
+    def process(self, doc: AnnotatedDocument) -> AnnotatedDocument:
+        raise NotImplementedError
+
+
+class AnnotationPipeline(Annotator):
+    """Aggregate engine (``AnalysisEngineFactory.createEngine`` chain)."""
+
+    def __init__(self, annotators: Sequence[Annotator]):
+        self.annotators = list(annotators)
+
+    def process(self, doc: AnnotatedDocument) -> AnnotatedDocument:
+        for a in self.annotators:
+            doc = a.process(doc)
+        return doc
+
+    def annotate(self, text: str) -> AnnotatedDocument:
+        return self.process(AnnotatedDocument(text=text))
+
+
+_SENT_END = re.compile(r"(?<=[.!?])[\"')\]]*\s+(?=[A-Z0-9\"'(\[])")
+_ABBREV = {"mr", "mrs", "ms", "dr", "prof", "st", "vs", "etc", "e.g", "i.e",
+           "jr", "sr", "inc", "ltd", "co", "fig", "al"}
+
+
+class SentenceAnnotator(Annotator):
+    """Rule-based sentence splitter (``SentenceAnnotator`` role):
+    terminal punctuation followed by whitespace and an upper-case/digit
+    opener, with an abbreviation guard."""
+
+    def process(self, doc: AnnotatedDocument) -> AnnotatedDocument:
+        text = doc.text
+        starts = [0]
+        for m in _SENT_END.finditer(text):
+            prev = text[:m.start()].rstrip(".!?\"')]")
+            last_word = prev.rsplit(None, 1)[-1].lower() if prev.split() else ""
+            if last_word in _ABBREV:
+                continue
+            starts.append(m.end())
+        spans = []
+        for i, s in enumerate(starts):
+            e = starts[i + 1] if i + 1 < len(starts) else len(text)
+            if text[s:e].strip():
+                spans.append((s, e))
+        doc.sentence_spans = spans
+        doc.sentences = [text[s:e].strip() for s, e in spans]
+        return doc
+
+
+_TOKEN = re.compile(r"[A-Za-z]+(?:'[A-Za-z]+)?|\d+(?:[.,]\d+)*|[^\w\s]")
+
+
+class TokenizerAnnotator(Annotator):
+    """Offset-preserving tokenizer (``TokenizerAnnotator`` role)."""
+
+    def process(self, doc: AnnotatedDocument) -> AnnotatedDocument:
+        if not doc.sentence_spans:
+            doc = SentenceAnnotator().process(doc)
+        doc.tokens = []
+        for si, (s, e) in enumerate(doc.sentence_spans):
+            for m in _TOKEN.finditer(doc.text[s:e]):
+                doc.tokens.append(TokenAnnotation(
+                    text=m.group(), start=s + m.start(), end=s + m.end(),
+                    sentence=si))
+        return doc
+
+
+# compact closed-class lexicon + suffix rules; coarse universal-ish tags
+_POS_LEXICON = {
+    "DET": {"the", "a", "an", "this", "that", "these", "those", "each",
+            "every", "some", "any", "no", "all", "both"},
+    "PRON": {"i", "you", "he", "she", "it", "we", "they", "me", "him", "her",
+             "us", "them", "my", "your", "his", "its", "our", "their", "who",
+             "whom", "which", "what", "mine", "yours", "hers", "ours",
+             "theirs", "myself", "itself", "themselves"},
+    "ADP": {"in", "on", "at", "by", "for", "with", "about", "against",
+            "between", "into", "through", "during", "before", "after",
+            "above", "below", "to", "from", "up", "down", "of", "off",
+            "over", "under"},
+    "CONJ": {"and", "or", "but", "nor", "so", "yet", "because", "although",
+             "while", "if", "unless", "since", "when", "whereas"},
+    "VERB": {"is", "am", "are", "was", "were", "be", "been", "being", "have",
+             "has", "had", "do", "does", "did", "will", "would", "can",
+             "could", "shall", "should", "may", "might", "must", "go", "goes",
+             "went", "gone", "say", "says", "said", "get", "gets", "got",
+             "make", "makes", "made", "see", "sees", "saw", "seen", "know",
+             "knows", "knew", "known", "take", "takes", "took", "taken"},
+    "ADV": {"not", "very", "too", "also", "just", "only", "then", "there",
+            "here", "now", "never", "always", "often", "again", "still",
+            "well", "more", "most", "less", "least"},
+}
+_POS_BY_WORD = {w: tag for tag, words in _POS_LEXICON.items() for w in words}
+
+
+class PosAnnotator(Annotator):
+    """Coarse POS tagging (``PoStagger`` role): closed-class lexicon
+    first, then suffix heuristics, default NOUN."""
+
+    def process(self, doc: AnnotatedDocument) -> AnnotatedDocument:
+        for t in doc.tokens:
+            w = t.text
+            lw = w.lower()
+            if not w[0].isalnum():
+                t.pos = "PUNCT"
+            elif w[0].isdigit():
+                t.pos = "NUM"
+            elif lw in _POS_BY_WORD:
+                t.pos = _POS_BY_WORD[lw]
+            elif lw.endswith("ly"):
+                t.pos = "ADV"
+            elif lw.endswith(("ing", "ed", "ize", "ise", "ify", "ate")) and len(lw) > 4:
+                t.pos = "VERB"
+            elif lw.endswith(("ous", "ful", "ive", "able", "ible", "al",
+                              "ic", "less", "ish", "est", "er")) and len(lw) > 4:
+                t.pos = "ADJ"
+            else:
+                t.pos = "NOUN"
+        return doc
+
+
+_IRREGULAR_LEMMAS = {
+    "was": "be", "were": "be", "is": "be", "are": "be", "am": "be",
+    "been": "be", "being": "be", "has": "have", "had": "have",
+    "does": "do", "did": "do", "done": "do", "went": "go", "gone": "go",
+    "said": "say", "got": "get", "made": "make", "saw": "see", "seen": "see",
+    "knew": "know", "known": "know", "took": "take", "taken": "take",
+    "ran": "run", "sat": "sit", "came": "come", "gave": "give",
+    "found": "find", "told": "tell", "left": "leave", "felt": "feel",
+    "kept": "keep", "began": "begin", "brought": "bring", "bought": "buy",
+    "thought": "think", "wrote": "write", "written": "write",
+    "stood": "stand", "heard": "hear", "held": "hold", "met": "meet",
+    "paid": "pay", "sent": "send", "sold": "sell", "spoke": "speak",
+    "spoken": "speak", "spent": "spend", "taught": "teach", "wore": "wear",
+    "worn": "wear", "won": "win", "lost": "lose", "built": "build",
+    "caught": "catch", "chose": "choose", "chosen": "choose",
+    "drew": "draw", "drawn": "draw", "drove": "drive", "driven": "drive",
+    "ate": "eat", "eaten": "eat", "fell": "fall", "fallen": "fall",
+    "flew": "fly", "flown": "fly", "grew": "grow", "grown": "grow",
+    "lay": "lie", "led": "lead", "meant": "mean", "rose": "rise",
+    "risen": "rise", "threw": "throw", "thrown": "throw",
+    "understood": "understand",
+    "children": "child", "men": "man", "women": "woman", "feet": "foot",
+    "teeth": "tooth", "mice": "mouse", "people": "person", "better": "good",
+    "best": "good", "worse": "bad", "worst": "bad",
+}
+_VOWELS = set("aeiou")
+
+
+class LemmaAnnotator(Annotator):
+    """Rule-based English lemmatizer (``StemmerAnnotator`` role, but
+    producing dictionary forms rather than Snowball stems)."""
+
+    @staticmethod
+    def _lemma(w: str, pos: Optional[str]) -> str:
+        lw = w.lower()
+        if lw in _IRREGULAR_LEMMAS:
+            return _IRREGULAR_LEMMAS[lw]
+        if pos in ("PUNCT", "NUM", "PRON", "DET", "ADP", "CONJ"):
+            return lw
+        for suf, rep in (("sses", "ss"), ("ies", "y"), ("ches", "ch"),
+                         ("shes", "sh"), ("xes", "x"), ("zes", "z")):
+            if lw.endswith(suf):
+                return lw[: -len(suf)] + rep
+        if lw.endswith("s") and not lw.endswith(("ss", "us", "is")) and len(lw) > 3:
+            return lw[:-1]
+        if lw.endswith("ing") and len(lw) > 5:
+            stem = lw[:-3]
+            if len(stem) > 2 and stem[-1] == stem[-2]:      # running -> run
+                return stem[:-1]
+            if stem[-1] not in _VOWELS and len(stem) > 2:    # making -> make
+                return stem + "e" if stem[-1] in "kvzcg" else stem
+            return stem
+        if lw.endswith("ed") and len(lw) > 4:
+            stem = lw[:-2]
+            if len(stem) > 2 and stem[-1] == stem[-2]:       # stopped -> stop
+                return stem[:-1]
+            if stem.endswith("i"):                           # tried -> try
+                return stem[:-1] + "y"
+            return stem
+        return lw
+
+    def process(self, doc: AnnotatedDocument) -> AnnotatedDocument:
+        for t in doc.tokens:
+            t.lemma = self._lemma(t.text, t.pos)
+        return doc
+
+
+def default_pipeline() -> AnnotationPipeline:
+    return AnnotationPipeline([SentenceAnnotator(), TokenizerAnnotator(),
+                               PosAnnotator(), LemmaAnnotator()])
+
+
+class AnnotatedTokenizerFactory(TokenizerFactory):
+    """``UimaTokenizerFactory`` role: tokenizer SPI whose tokens are
+    pipeline lemmas, optionally filtered by POS (e.g. drop PUNCT) —
+    plugs into Word2Vec/BOW exactly like any other factory."""
+
+    def __init__(self, pipeline: Optional[AnnotationPipeline] = None,
+                 use_lemmas: bool = True,
+                 drop_pos: Iterable[str] = ("PUNCT",)):
+        self.pipeline = pipeline or default_pipeline()
+        self.use_lemmas = use_lemmas
+        self.drop_pos = frozenset(drop_pos)
+        self._pre = None
+
+    def create(self, text: str) -> Tokenizer:
+        doc = self.pipeline.annotate(text)
+        toks = [(t.lemma if self.use_lemmas and t.lemma else t.text)
+                for t in doc.tokens if t.pos not in self.drop_pos]
+        return Tokenizer(toks, self._pre)
+
+
+register_tokenizer_factory("annotated", AnnotatedTokenizerFactory)

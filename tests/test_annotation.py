@@ -1,0 +1,80 @@
+"""Annotation pipeline tests (UIMA-analog).
+
+Parity: ``deeplearning4j-nlp-uima`` annotators — sentence split,
+offset-preserving tokens, POS, lemmas, and the ``UimaTokenizerFactory``
+adapter into the tokenizer SPI.
+"""
+
+from deeplearning4j_tpu.text.annotation import (
+    AnnotatedTokenizerFactory, AnnotationPipeline, LemmaAnnotator,
+    PosAnnotator, SentenceAnnotator, TokenizerAnnotator, default_pipeline)
+from deeplearning4j_tpu.text.tokenization import (
+    LowCasePreprocessor, tokenizer_factory)
+
+
+def test_sentence_split():
+    doc = SentenceAnnotator().process(
+        __import__("deeplearning4j_tpu.text.annotation",
+                   fromlist=["AnnotatedDocument"]).AnnotatedDocument(
+            text="Dr. Smith went home. It was late! Was it? Yes."))
+    assert doc.sentences == ["Dr. Smith went home.", "It was late!",
+                             "Was it?", "Yes."]
+
+
+def test_tokens_have_offsets_and_sentences():
+    doc = default_pipeline().annotate("The cats sat. Dogs ran fast.")
+    texts = [t.text for t in doc.tokens]
+    assert texts == ["The", "cats", "sat", ".", "Dogs", "ran", "fast", "."]
+    for t in doc.tokens:
+        assert doc.text[t.start:t.end] == t.text
+    assert [t.sentence for t in doc.tokens] == [0, 0, 0, 0, 1, 1, 1, 1]
+
+
+def test_pos_tags():
+    doc = default_pipeline().annotate("The quick dogs quickly ran in 42 parks.")
+    by_word = {t.text: t.pos for t in doc.tokens}
+    assert by_word["The"] == "DET"
+    assert by_word["quickly"] == "ADV"
+    assert by_word["in"] == "ADP"
+    assert by_word["42"] == "NUM"
+    assert by_word["."] == "PUNCT"
+    assert by_word["dogs"] == "NOUN"
+
+
+def test_lemmas():
+    doc = default_pipeline().annotate(
+        "The children were running and stopped; she tried the boxes.")
+    by_word = {t.text.lower(): t.lemma for t in doc.tokens}
+    assert by_word["children"] == "child"
+    assert by_word["were"] == "be"
+    assert by_word["running"] == "run"
+    assert by_word["stopped"] == "stop"
+    assert by_word["tried"] == "try"
+    assert by_word["boxes"] == "box"
+
+
+def test_tokenizer_factory_adapter():
+    fac = AnnotatedTokenizerFactory()
+    fac.set_token_pre_processor(LowCasePreprocessor())
+    toks = fac.create("The children were running. Fast!").get_tokens()
+    assert toks == ["the", "child", "be", "run", "fast"]  # PUNCT dropped
+
+
+def test_registered_in_factory_registry():
+    fac = tokenizer_factory("annotated")
+    assert isinstance(fac, AnnotatedTokenizerFactory)
+    assert fac.create("Cats sat.").get_tokens() == ["cat", "sit"]
+
+
+def test_custom_annotator_plugs_in():
+    class UpperAnnotator:
+        def process(self, doc):
+            for t in doc.tokens:
+                t.lemma = (t.lemma or t.text).upper()
+            return doc
+
+    pipe = AnnotationPipeline([SentenceAnnotator(), TokenizerAnnotator(),
+                               PosAnnotator(), LemmaAnnotator(),
+                               UpperAnnotator()])
+    doc = pipe.annotate("cats ran")
+    assert [t.lemma for t in doc.tokens] == ["CAT", "RUN"]

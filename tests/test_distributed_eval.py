@@ -1,0 +1,95 @@
+"""Distributed evaluation equivalence tests.
+
+Parity: ``SparkDl4jMultiLayer.evaluate`` / evaluation reduce
+(SURVEY.md §2.6) — mesh-sharded confusion counts must equal the
+host-side ``Evaluation`` over the same data.
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer, GravesLSTM, OutputLayer, RnnOutputLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.evaluation import evaluate_sharded
+
+
+def _ff_net():
+    conf = (NeuralNetConfiguration.builder().seed(11).learning_rate(0.1)
+            .updater("sgd").activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=12))
+            .layer(OutputLayer(n_in=12, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _host_eval(net, ds):
+    ev = Evaluation()
+    ev.eval(ds.labels, net.output(ds.features),
+            mask=ds.labels_mask)
+    return ev
+
+
+def test_matches_host_eval(rng):
+    net = _ff_net()
+    x = rng.standard_normal((64, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    ds = DataSet(x, y)
+    host = _host_eval(net, ds)
+    dist = evaluate_sharded(net, ds)
+    np.testing.assert_array_equal(dist.confusion.counts, host.confusion.counts)
+    assert dist.accuracy() == host.accuracy()
+
+
+def test_ragged_batches_and_iterator(rng):
+    """61 examples over 8 devices: every batch has a padded tail."""
+    net = _ff_net()
+    x = rng.standard_normal((61, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 61)]
+    ds = DataSet(x, y)
+    host = _host_eval(net, ds)
+    dist = evaluate_sharded(net, ListDataSetIterator(ds, 16))
+    np.testing.assert_array_equal(dist.confusion.counts, host.confusion.counts)
+
+
+def test_num_classes_wider_than_labels(rng):
+    """num_classes > label width embeds counts (classes absent from the
+    split); narrower raises (regression: used to crash on broadcast)."""
+    import pytest
+
+    net = _ff_net()
+    x = rng.standard_normal((16, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    ds = DataSet(x, y)
+    dist = evaluate_sharded(net, ds, num_classes=5)
+    assert dist.confusion.counts.shape == (5, 5)
+    assert dist.confusion.counts[:3, :3].sum() == 16
+    assert dist.confusion.counts[3:, :].sum() == 0
+    with pytest.raises(ValueError):
+        evaluate_sharded(net, ds, num_classes=2)
+
+
+def test_time_series_with_mask(rng):
+    conf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+            .updater("sgd").activation("tanh")
+            .list()
+            .layer(GravesLSTM(n_in=4, n_out=8))
+            .layer(RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    b, t = 24, 7
+    x = rng.standard_normal((b, t, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (b, t))]
+    lmask = (rng.random((b, t)) > 0.3).astype(np.float32)
+    lmask[:, 0] = 1.0
+    ds = DataSet(x, y, labels_mask=lmask)
+    host = _host_eval(net, ds)
+    dist = evaluate_sharded(net, ds)
+    np.testing.assert_array_equal(dist.confusion.counts, host.confusion.counts)
+    assert dist.confusion.counts.sum() == int(lmask.sum())

@@ -93,6 +93,44 @@ def test_streaming_iterator_microbatches(rng):
     assert [b.num_examples() for b in batches] == [16, 16]
 
 
+def test_tcp_broker_empty_payload_survives():
+    """Zero-length payloads are messages, not timeouts (regression:
+    the reply framing conflated them)."""
+    server = TcpBrokerServer(port=0).start()
+    try:
+        host, port = server.address
+        c = TcpBroker(host, port)
+        c.publish("t", b"")
+        assert c.consume("t", timeout=5) == b""
+        assert c.consume("t", timeout=0.3) is None
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_microbatch_mixed_mask_presence(rng):
+    """Mixed masked/unmasked parts synthesize all-ones masks instead of
+    crashing or dropping padding info (regression)."""
+    broker = InMemoryBroker()
+    b, t = 4, 6
+    mk = lambda: DataSet(rng.standard_normal((b, t, 3)).astype(np.float32),
+                         rng.standard_normal((b, t, 2)).astype(np.float32))
+    masked = mk()
+    masked.features_mask = np.zeros((b, t), np.float32)
+    masked.features_mask[:, :3] = 1.0
+    masked.labels_mask = masked.features_mask.copy()
+    for oi, order in enumerate([[masked, mk()], [mk(), masked]]):  # both orders
+        topic = f"m{oi}"
+        for part in order:
+            publish_dataset(broker, topic, part)
+        publish_stop(broker, topic)
+        it = StreamingDataSetIterator(broker, topic, batch_size=2 * b)
+        out = it.next()
+        assert out.num_examples() == 2 * b
+        assert out.features_mask is not None and out.labels_mask is not None
+        assert out.features_mask.sum() == 3 * b + t * b  # masked part + ones
+
+
 def test_streaming_trainer_fits(rng):
     broker = InMemoryBroker()
     net = _net()
