@@ -60,7 +60,11 @@ class AttentionImpl(LayerImpl):
         seq = current_sequence_mesh()
         if seq is not None and mask is None:
             mesh, axis = seq
-            o = ring_attention(q, k, v, mesh, axis=axis, causal=c.causal)
+            # DP×SP composition: batch rides the mesh's data axis when
+            # one exists; rings rotate within each data group
+            batch_axis = "data" if "data" in mesh.shape else None
+            o = ring_attention(q, k, v, mesh, axis=axis, causal=c.causal,
+                               batch_axis=batch_axis)
         else:
             # flash Pallas kernel when it applies; key-validity masks
             # (variable-length) fall back to the full XLA path inside

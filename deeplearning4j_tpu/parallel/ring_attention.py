@@ -48,10 +48,14 @@ def _block_attend(q, k, v, scores_mask, m_prev, l_prev, acc_prev):
 def ring_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     mesh: Mesh, axis: str = "seq", causal: bool = False,
+    batch_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """Sequence-parallel exact attention. q/k/v: [b, t, h, d] with t
     divisible by the ``axis`` size; returns [b, t, h, d] sharded the
-    same way."""
+    same way. ``batch_axis`` composes DP×SP: the batch dim shards over
+    that mesh axis while rings rotate within each data-parallel group
+    (the ring's ppermute is over ``axis`` only, so K/V never cross the
+    batch axis)."""
     n = mesh.shape[axis]
     t = q.shape[1]
     blk = t // n
@@ -66,8 +70,10 @@ def ring_attention(
         a0 = jnp.zeros_like(qb)
         # carries become device-varying after step 1; mark them so from the
         # start or the fori_loop carry types mismatch under shard_map
-        m0 = jax.lax.pcast(m0, (axis,), to="varying")
-        l0 = jax.lax.pcast(l0, (axis,), to="varying")
+        # (over every axis the inputs vary on, incl. the DP batch axis)
+        vary = (axis,) if batch_axis is None else (batch_axis, axis)
+        m0 = jax.lax.pcast(m0, vary, to="varying")
+        l0 = jax.lax.pcast(l0, vary, to="varying")
         qpos = my * blk + jnp.arange(blk)
 
         def body(i, carry):
@@ -89,6 +95,6 @@ def ring_attention(
         l_t = l.transpose(0, 2, 1)[..., None]  # [b, tq, h, 1]
         return acc / jnp.maximum(l_t, jnp.asarray(1e-30, l_t.dtype))
 
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, None, None)
     return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)(q, k, v)

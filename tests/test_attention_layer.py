@@ -63,6 +63,22 @@ def test_attention_mask_zeroes_padded_steps(rng):
     assert np.isfinite(net.score())
 
 
+def test_ring_attention_dp_sp_composition_matches_full(rng):
+    """DP×SP: batch sharded over 'data', time ringed over 'seq' in ONE
+    mesh — output must equal single-device full attention."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        import pytest
+        pytest.skip("needs 8 CPU devices")
+    net = MultiLayerNetwork(_conf(causal=True)).init()
+    x = rng.standard_normal((4, 8, 8)).astype(np.float32)
+    full = net.output(x)
+    mesh = make_mesh({"data": 2, "seq": 4}, devices=devs[:8])
+    with sequence_mesh(mesh):
+        composed = net.output(x)
+    np.testing.assert_allclose(composed, full, rtol=2e-4, atol=2e-5)
+
+
 def test_ring_attention_auto_select_matches_full(rng):
     """Same params, same input: output under a seq mesh (ring kernel)
     must match the single-device full-attention output."""
