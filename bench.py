@@ -176,6 +176,13 @@ def bench_flash_attention():
             "vs_baseline": round((flops / PEAK_BF16) / 0.30, 4)}
 
 
+def bench_gpt():
+    """GPT-style causal LM (zoo transformer, flash-attention blocks),
+    synthetic token stream."""
+    from deeplearning4j_tpu.models.zoo.transformer import gpt_benchmark
+    return gpt_benchmark(PEAK_BF16)
+
+
 def bench_resnet50():
     """ResNet-50 (config #3, ComputationGraph.java:677) — requires the
     ComputationGraph fit_scan path; returns None until it exists."""
@@ -190,7 +197,8 @@ def main():
     subs = {}
     for name, fn in [("gemm_bf16", bench_gemm), ("lenet_mnist", bench_lenet),
                      ("lstm_char", bench_lstm), ("resnet50", bench_resnet50),
-                     ("flash_attention", bench_flash_attention)]:
+                     ("flash_attention", bench_flash_attention),
+                     ("gpt", bench_gpt)]:
         r = None
         attempts = 3  # tunneled remote-compile can drop transiently
         last_err = None

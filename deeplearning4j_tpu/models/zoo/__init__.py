@@ -1,1 +1,2 @@
-from deeplearning4j_tpu.models.zoo.resnet import resnet50  # noqa: F401
+from deeplearning4j_tpu.models.zoo.resnet import resnet, resnet50  # noqa: F401
+from deeplearning4j_tpu.models.zoo.transformer import gpt  # noqa: F401

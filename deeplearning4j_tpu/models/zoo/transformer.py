@@ -1,0 +1,89 @@
+"""GPT-style causal language model for the zoo.
+
+No reference counterpart (the reference's sequence flagship is the
+GravesLSTM char-RNN, ``LSTMHelpers.java:54``); this is the modern
+long-context flagship built from the SURVEY §7.7 extension layers:
+token+position embedding → N pre-LN transformer blocks (flash Pallas
+attention single-chip, ring attention under a seq mesh) → tied-free
+softmax LM head. One config serves single-chip, DP, and DP×SP runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    RnnOutputLayer,
+    SequenceEmbeddingLayer,
+    TransformerBlock,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def gpt(vocab_size: int = 50257, d_model: int = 512, n_layers: int = 8,
+        num_heads: int = 8, max_len: int = 1024, ffn_mult: int = 4,
+        dropout: float = 0.0, learning_rate: float = 3e-4,
+        compute_dtype: str = "bfloat16", seed: int = 0) -> MultiLayerNetwork:
+    """Decoder-only LM over int token ids [b, t]; labels one-hot
+    [b, t, vocab] (next-token targets)."""
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed).learning_rate(learning_rate).updater("adam")
+         .activation("identity").weight_init("xavier")
+         .compute_dtype(compute_dtype)
+         .list()
+         .layer(SequenceEmbeddingLayer(n_in=vocab_size, n_out=d_model,
+                                       max_len=max_len)))
+    for _ in range(n_layers):
+        b = b.layer(TransformerBlock(n_in=d_model, n_out=d_model,
+                                     num_heads=num_heads, ffn_mult=ffn_mult,
+                                     causal=True, dropout=dropout))
+    conf = (b.layer(RnnOutputLayer(n_in=d_model, n_out=vocab_size,
+                                   activation="softmax",
+                                   loss_function="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf)
+
+
+def gpt_train_flops_per_token(vocab_size: int, d_model: int, n_layers: int,
+                              seq_len: int, ffn_mult: int = 4) -> float:
+    """Per-token train FLOPs ≈ 6 * (params-ish MACs) + attention term."""
+    per_layer = 3 * d_model * d_model + d_model * d_model \
+        + 2 * ffn_mult * d_model * d_model          # qkv + proj + mlp
+    attn = 2 * seq_len * d_model / 2                # causal qk^T + pv
+    head = d_model * vocab_size
+    macs = n_layers * (per_layer + attn) + head + d_model  # + embed gather
+    return 6.0 * macs
+
+
+def gpt_benchmark(peak_flops: float, vocab_size: int = 8192,
+                  d_model: int = 512, n_layers: int = 8, seq_len: int = 1024,
+                  batch: int = 16, steps: int = 4) -> dict:
+    """Train-step throughput on synthetic token streams."""
+    import time
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    net = gpt(vocab_size=vocab_size, d_model=d_model, n_layers=n_layers,
+              max_len=seq_len).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab_size, (batch * steps, seq_len))
+    x = ids.astype(np.float32)
+    y = np.eye(vocab_size, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    data = DataSet(x, y)
+
+    staged = net.stage_scan(data, batch)
+    net.fit_scan(None, batch, epochs=1, staged=staged)  # compile + warmup
+    epochs = 3
+    t0 = time.perf_counter()
+    scores = net.fit_scan(None, batch, epochs=epochs, staged=staged)
+    dt = time.perf_counter() - t0
+
+    tokens = epochs * steps * batch * seq_len
+    tps = tokens / dt
+    mfu = tps * gpt_train_flops_per_token(
+        vocab_size, d_model, n_layers, seq_len) / peak_flops
+    assert np.isfinite(np.asarray(scores)).all()
+    return {"metric": "gpt_train_tokens_per_sec_per_chip",
+            "value": round(tps, 1), "unit": "tokens/sec/chip",
+            "mfu": round(mfu, 4), "vs_baseline": round(mfu / 0.30, 4)}

@@ -215,6 +215,30 @@ class EmbeddingLayer(FeedForwardLayer):
 
 @register_layer
 @dataclasses.dataclass(frozen=True)
+class SequenceEmbeddingLayer(FeedForwardLayer):
+    """Token + learned positional embedding: int indices [b, t] →
+    [b, t, n_out]. No reference counterpart (the reference embeds only
+    [b] ids, ``EmbeddingLayer.java``); this is the transformer on-ramp
+    (SURVEY §7.7 extension)."""
+
+    max_len: int = 2048
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class TransformerBlock(FeedForwardLayer):
+    """Pre-LN transformer decoder/encoder block: LN → multi-head
+    attention (flash Pallas kernel / ring under a seq mesh) → residual →
+    LN → GELU MLP → residual. No reference counterpart (SURVEY §7.7
+    extension); n_in == n_out == d_model."""
+
+    num_heads: int = 8
+    ffn_mult: int = 4
+    causal: bool = True
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
 class AutoEncoder(FeedForwardLayer):
     """``nn/conf/layers/AutoEncoder.java`` — denoising autoencoder for
     layerwise pretraining."""

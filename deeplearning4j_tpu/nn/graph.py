@@ -226,6 +226,27 @@ class ComputationGraph:
         # mixed precision: same policy as MultiLayerNetwork
         # (util/dtypes.py — bf16 vertex compute, f32 params/states/loss)
         self._cd = resolve_compute_dtype(self.gc.compute_dtype)
+        # input vertices feeding an index-input layer (embedding) keep
+        # their raw dtype — bf16 would corrupt the ids (LayerImpl.cast_input).
+        # Walk transitively through non-layer op vertices (merge/stack/...)
+        # since those pass ids along unchanged; layers terminate the walk.
+        self._input_casts = {}
+        for name in self.input_names:
+            ok = True
+            frontier, seen = [name], set()
+            while frontier and ok:
+                src = frontier.pop()
+                if src in seen:
+                    continue
+                seen.add(src)
+                for v in conf.vertices:
+                    if src not in getattr(v, "inputs", ()):
+                        continue
+                    if v.kind == "layer":
+                        ok = ok and self.impls[v.name].cast_input
+                    elif v.kind != "input":
+                        frontier.append(v.name)
+            self._input_casts[name] = ok
         self._jits: Dict[Any, Callable] = {}
 
     # ------------------------------------------------------------------ init
@@ -262,7 +283,9 @@ class ComputationGraph:
             v = self.defs[name]
             if v.kind == "input":
                 x_in = inputs[name]
-                acts[name] = x_in.astype(self._cd) if self._cd is not None else x_in
+                if self._cd is not None and self._input_casts.get(name, True):
+                    x_in = x_in.astype(self._cd)
+                acts[name] = x_in
                 masks[name] = fmasks.get(name)
             elif v.kind == "layer":
                 impl = self.impls[name]

@@ -16,4 +16,5 @@ from deeplearning4j_tpu.nn.layers import (  # noqa: F401  (registers impls)
     feedforward,
     normalization,
     recurrent,
+    transformer,
 )

@@ -24,6 +24,21 @@ from deeplearning4j_tpu.parallel.mesh import current_sequence_mesh
 from deeplearning4j_tpu.parallel.ring_attention import ring_attention
 
 
+def dispatch_attention(q, k, v, causal: bool, mask=None):
+    """Shared parallelism dispatch for every attention-bearing layer:
+    ring attention under an active sequence mesh (DP×SP when the mesh
+    also has a 'data' axis), otherwise the flash Pallas kernel
+    (key-validity masks fall back to the XLA path inside it; ring
+    blocks assume dense time, so masked inputs also stay off the ring)."""
+    seq = current_sequence_mesh()
+    if seq is not None and mask is None:
+        mesh, axis = seq
+        batch_axis = "data" if "data" in mesh.shape else None
+        return ring_attention(q, k, v, mesh, axis=axis, causal=causal,
+                              batch_axis=batch_axis)
+    return flash_attention(q, k, v, causal=causal, mask=mask)
+
+
 @register_impl(L.AttentionLayer)
 class AttentionImpl(LayerImpl):
     def init_params(self, key) -> Dict[str, jnp.ndarray]:
@@ -57,18 +72,7 @@ class AttentionImpl(LayerImpl):
         q = split(x @ params["Wq"].astype(x.dtype))
         k = split(x @ params["Wk"].astype(x.dtype))
         v = split(x @ params["Wv"].astype(x.dtype))
-        seq = current_sequence_mesh()
-        if seq is not None and mask is None:
-            mesh, axis = seq
-            # DP×SP composition: batch rides the mesh's data axis when
-            # one exists; rings rotate within each data group
-            batch_axis = "data" if "data" in mesh.shape else None
-            o = ring_attention(q, k, v, mesh, axis=axis, causal=c.causal,
-                               batch_axis=batch_axis)
-        else:
-            # flash Pallas kernel when it applies; key-validity masks
-            # (variable-length) fall back to the full XLA path inside
-            o = flash_attention(q, k, v, causal=c.causal, mask=mask)
+        o = dispatch_attention(q, k, v, causal=c.causal, mask=mask)
         out = o.reshape(b, t, c.n_out) @ params["Wo"].astype(x.dtype) \
             + params["bo"].astype(x.dtype)
         if c.residual:

@@ -57,6 +57,12 @@ class LayerImpl:
 
     conf_cls: Type[L.Layer] = L.Layer
 
+    # False for layers whose input is integer indices (embeddings): the
+    # mixed-precision input cast must NOT touch them — bf16 has an
+    # 8-bit mantissa, so ids >= 256 round (bf16(511) == 512), producing
+    # wrong or out-of-range gathers/scatter-grads
+    cast_input = True
+
     def __init__(self, global_conf: NeuralNetConfiguration, conf: L.Layer, name: str):
         self.gc = global_conf
         self.conf = conf

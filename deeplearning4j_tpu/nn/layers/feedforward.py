@@ -111,6 +111,8 @@ class EmbeddingImpl(LayerImpl):
     lookup. Input: int indices [b] or [b, 1]; output [b, n_out].
     jnp.take lowers to a TPU gather; bias added as in the reference."""
 
+    cast_input = False  # ids must stay exact (see LayerImpl.cast_input)
+
     def init_params(self, key):
         c = self.conf
         W = init_weights(key, (c.n_in, c.n_out), self.weight_init, c.n_in, c.n_out,

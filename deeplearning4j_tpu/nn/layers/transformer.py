@@ -1,0 +1,116 @@
+"""Transformer block + sequence embedding layer impls.
+
+No reference counterpart (SURVEY §7.7 extension — the reference's only
+sequence model is the Graves LSTM); these are the layers the modern
+long-context stack is built from, wired so ONE config runs single-chip
+(flash Pallas kernel, ``ops/flash_attention.py``) or sequence-parallel
+(ring attention over the mesh ``seq`` axis, DP×SP composed) with no
+model change — the same auto-select doctrine as ``AttentionImpl``.
+
+Pre-LN wiring (x + Attn(LN(x)), x + MLP(LN(x))): the standard stable
+variant; LayerNorm runs in f32 even under a bf16 compute policy
+(variance of bf16 activations underflows), matching the output-head-f32
+rule in ``multilayer.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers.attention import dispatch_attention
+from deeplearning4j_tpu.nn.layers.base import (
+    LayerImpl, apply_dropout, register_impl)
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+def _layer_norm(x, gamma, beta, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+    return out.astype(x.dtype)
+
+
+@register_impl(L.SequenceEmbeddingLayer)
+class SequenceEmbeddingImpl(LayerImpl):
+    """int ids [b, t] → [b, t, d]: token gather + learned positions."""
+
+    cast_input = False  # ids must stay exact (see LayerImpl.cast_input)
+
+    def init_params(self, key) -> Dict[str, jnp.ndarray]:
+        c = self.conf
+        kw, kp = jax.random.split(key)
+        W = init_weights(kw, (c.n_in, c.n_out), self.weight_init,
+                         c.n_in, c.n_out, c.dist_mean, c.dist_std)
+        P = 0.01 * jax.random.normal(kp, (c.max_len, c.n_out), jnp.float32)
+        return {"W": W, "P": P}
+
+    def forward(self, params, x, state, train, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3:  # one-hot input tolerated
+            idx = jnp.argmax(idx, axis=-1)
+        t = idx.shape[1]
+        if t > self.conf.max_len:
+            raise ValueError(f"sequence length {t} > max_len {self.conf.max_len}")
+        z = jnp.take(params["W"], idx, axis=0) + params["P"][:t][None]
+        return z, state
+
+
+@register_impl(L.TransformerBlock)
+class TransformerBlockImpl(LayerImpl):
+    def init_params(self, key) -> Dict[str, jnp.ndarray]:
+        c = self.conf
+        if c.n_out != c.n_in:
+            raise ValueError("TransformerBlock needs n_in == n_out (d_model)")
+        if c.n_out % c.num_heads != 0:
+            raise ValueError(f"d_model {c.n_out} not divisible by "
+                             f"num_heads {c.num_heads}")
+        d, f = c.n_out, c.ffn_mult * c.n_out
+        ks = jax.random.split(key, 4)
+        mk = lambda k, shape: init_weights(k, shape, self.weight_init,
+                                           shape[0], shape[1],
+                                           c.dist_mean, c.dist_std)
+        return {
+            "Wqkv": mk(ks[0], (d, 3 * d)),
+            "Wo": mk(ks[1], (d, d)),
+            "W1": mk(ks[2], (d, f)), "b1": jnp.zeros((f,), jnp.float32),
+            "W2": mk(ks[3], (f, d)), "b2": jnp.zeros((d,), jnp.float32),
+            "ln1_g": jnp.ones((d,), jnp.float32),
+            "ln1_b": jnp.zeros((d,), jnp.float32),
+            "ln2_g": jnp.ones((d,), jnp.float32),
+            "ln2_b": jnp.zeros((d,), jnp.float32),
+        }
+
+    def forward(self, params, x, state, train, rng=None, mask=None):
+        c = self.conf
+        if x.ndim != 3:
+            raise ValueError(f"TransformerBlock needs [b, t, d], got {x.shape}")
+        b, t, d = x.shape
+        h_count, hd = c.num_heads, c.n_out // c.num_heads
+        h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
+        qkv = h @ params["Wqkv"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = lambda z: z.reshape(b, t, h_count, hd)
+        q, k, v = shape(q), shape(k), shape(v)
+        o = dispatch_attention(q, k, v, causal=c.causal, mask=mask)
+        attn = o.reshape(b, t, d) @ params["Wo"].astype(x.dtype)
+        if train and self.dropout_rate > 0.0 and rng is not None:
+            attn = apply_dropout(attn, self.dropout_rate,
+                                 jax.random.fold_in(rng, 1))
+        x = x + attn
+
+        h2 = _layer_norm(x, params["ln2_g"], params["ln2_b"])
+        mlp = jax.nn.gelu(h2 @ params["W1"].astype(x.dtype)
+                          + params["b1"].astype(x.dtype))
+        mlp = mlp @ params["W2"].astype(x.dtype) + params["b2"].astype(x.dtype)
+        if train and self.dropout_rate > 0.0 and rng is not None:
+            mlp = apply_dropout(mlp, self.dropout_rate,
+                                jax.random.fold_in(rng, 2))
+        out = x + mlp
+        if mask is not None:
+            out = out * mask[:, :, None].astype(out.dtype)
+        return out, state

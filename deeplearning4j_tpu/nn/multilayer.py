@@ -95,7 +95,7 @@ class MultiLayerNetwork:
         acts = []
         new_states = {}
         n_last = len(self.impls) - 1
-        if self._cd is not None:
+        if self._cd is not None and self.impls[0].cast_input:
             x = x.astype(self._cd)
         for i, impl in enumerate(self.impls):
             pre = self.conf.input_preprocessors.get(i)
@@ -120,7 +120,7 @@ class MultiLayerNetwork:
         """Data loss (output layer) + L1/L2 penalties — the quantity
         ``computeGradientAndScore`` minimizes (SURVEY.md §3.1)."""
         new_states = {}
-        if self._cd is not None:
+        if self._cd is not None and self.impls[0].cast_input:
             x = x.astype(self._cd)
         for i, impl in enumerate(self.impls[:-1]):
             pre = self.conf.input_preprocessors.get(i)

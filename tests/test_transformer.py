@@ -1,0 +1,134 @@
+"""Transformer block + GPT zoo tests.
+
+SURVEY §7.7 extension layers: gradcheck, causality, training, and the
+single-config single-chip vs DP×SP sequence-parallel equivalence.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models.zoo.transformer import gpt
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    RnnOutputLayer, SequenceEmbeddingLayer, TransformerBlock)
+from deeplearning4j_tpu.nn.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.mesh import make_mesh, sequence_mesh
+
+
+def _tiny_gpt(vocab=11, d=16, layers=2, max_len=16, dropout=0.0):
+    return gpt(vocab_size=vocab, d_model=d, n_layers=layers, num_heads=2,
+               max_len=max_len, dropout=dropout, compute_dtype="float32",
+               learning_rate=0.01).init()
+
+
+def _data(rng, vocab=11, b=4, t=8):
+    ids = rng.integers(0, vocab, (b, t))
+    x = ids.astype(np.float32)
+    y = np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    return DataSet(x, y)
+
+
+def test_gpt_trains(rng):
+    net = _tiny_gpt()
+    ds = _data(rng)
+    s0 = net.score(ds)
+    for _ in range(30):
+        net.fit(ds)
+    s1 = net.score(ds)
+    assert np.isfinite(s1) and s1 < s0 * 0.7, (s0, s1)
+
+
+def test_transformer_block_gradcheck(rng):
+    """FD-vs-analytic on a block stack over continuous inputs (the
+    framework's correctness oracle, GradientCheckUtil doctrine)."""
+    conf = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.1)
+            .updater("sgd").activation("identity").weight_init("xavier")
+            .list()
+            .layer(TransformerBlock(n_in=8, n_out=8, num_heads=2, causal=True))
+            .layer(RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.standard_normal((2, 4, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, 4))]
+    assert check_gradients(net, DataSet(x, y))
+
+
+def test_causality(rng):
+    """Changing a future token must not change earlier logits."""
+    net = _tiny_gpt()
+    ids = rng.integers(0, 11, (1, 8))
+    out1 = net.output(ids.astype(np.float32))
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 1) % 11
+    out2 = net.output(ids2.astype(np.float32))
+    np.testing.assert_allclose(out1[0, :-1], out2[0, :-1], rtol=1e-5, atol=1e-6)
+    assert np.abs(out1[0, -1] - out2[0, -1]).max() > 1e-6
+
+
+def test_seq_mesh_equivalence(rng):
+    """Same params: single-chip flash output == DP×SP ring output."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 CPU devices")
+    net = _tiny_gpt(d=16, layers=2, max_len=16)
+    x = rng.integers(0, 11, (4, 8)).astype(np.float32)
+    full = net.output(x)
+    mesh = make_mesh({"data": 2, "seq": 4}, devices=devs[:8])
+    with sequence_mesh(mesh):
+        ringed = net.output(x)
+    np.testing.assert_allclose(ringed, full, rtol=2e-4, atol=1e-5)
+
+
+def test_bf16_policy_keeps_ids_exact(rng):
+    """Regression: the mixed-precision input cast must not touch token
+    ids — bf16(257) rounds to 256, silently swapping embeddings (and
+    bf16(511) == 512 goes out of range). ids >= 256 must select their
+    own rows under a bf16 compute policy."""
+    net = gpt(vocab_size=512, d_model=16, n_layers=1, num_heads=2,
+              max_len=8, compute_dtype="bfloat16", seed=3).init()
+    a = net.output(np.full((1, 4), 257.0, np.float32))
+    b = net.output(np.full((1, 4), 256.0, np.float32))
+    c = net.output(np.full((1, 4), 511.0, np.float32))
+    assert np.abs(a - b).max() > 1e-6, "id 257 collapsed onto 256"
+    assert np.abs(c - b).max() > 1e-6, "id 511 corrupted"
+    # and bf16 training through the scanned path stays finite
+    ids = rng.integers(0, 512, (8, 8))
+    ds = DataSet(ids.astype(np.float32),
+                 np.eye(512, dtype=np.float32)[np.roll(ids, -1, 1)])
+    scores = net.fit_scan(None, 4, epochs=1, staged=net.stage_scan(ds, 4))
+    assert np.isfinite(scores).all()
+
+
+def test_embedding_rejects_overlong(rng):
+    net = _tiny_gpt(max_len=8)
+    with pytest.raises(ValueError, match="max_len"):
+        net.output(rng.integers(0, 11, (1, 9)).astype(np.float32))
+
+
+def test_block_validation():
+    with pytest.raises(ValueError, match="divisible"):
+        conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.1)
+                .updater("sgd").activation("identity")
+                .list()
+                .layer(TransformerBlock(n_in=10, n_out=10, num_heads=3))
+                .layer(RnnOutputLayer(n_in=10, n_out=2, activation="softmax",
+                                      loss_function="mcxent"))
+                .build())
+        MultiLayerNetwork(conf).init()
+
+
+def test_serialization_roundtrip(rng, tmp_path):
+    from deeplearning4j_tpu.util.model_serializer import (
+        restore_model, write_model)
+    net = _tiny_gpt()
+    ds = _data(rng)
+    net.fit(ds)
+    path = str(tmp_path / "gpt.zip")
+    write_model(net, path)
+    net2 = restore_model(path)
+    np.testing.assert_allclose(net.output(ds.features),
+                               net2.output(ds.features), rtol=1e-6)
