@@ -239,6 +239,23 @@ class TransformerBlock(FeedForwardLayer):
 
 @register_layer
 @dataclasses.dataclass(frozen=True)
+class MoELayer(FeedForwardLayer):
+    """Mixture-of-experts FFN with Switch-style top-1 routing
+    (capacity-bounded dense dispatch; see ``ops/moe.py``). No reference
+    counterpart (SURVEY §2.6 note 5 — expert parallelism postdates it);
+    shard the expert weight dim over a mesh ``expert`` axis for EP.
+    Contributes the load-balancing aux loss to the objective via the
+    layer-state seam (``__aux_loss__``)."""
+
+    num_experts: int = 8
+    ffn_mult: int = 4
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    residual: bool = False
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
 class AutoEncoder(FeedForwardLayer):
     """``nn/conf/layers/AutoEncoder.java`` — denoising autoencoder for
     layerwise pretraining."""

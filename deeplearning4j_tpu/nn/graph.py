@@ -330,6 +330,11 @@ class ComputationGraph:
             score = s if score is None else score + s
         for name, impl in self.impls.items():
             score = score + impl.regularization_penalty(params[name]).astype(score.dtype)
+        # activation-dependent auxiliary losses (e.g. MoE load balancing)
+        # ride the state seam — same contract as MultiLayerNetwork
+        for ns in new_states.values():
+            if isinstance(ns, dict) and "__aux_loss__" in ns:
+                score = score + ns["__aux_loss__"].astype(score.dtype)
         return score, new_states
 
     def _make_train_step(self):

@@ -1,0 +1,79 @@
+"""Mixture-of-experts layer impl (expert parallelism).
+
+No reference counterpart (SURVEY §2.6 lists expert parallelism as
+absent from the reference); the routing math lives in ``ops/moe.py``.
+Expert parallelism is a sharding, not a code path: put
+``PartitionSpec("expert", ...)`` on the leading dim of W1/b1/W2/b2
+(``parallel.tensor_parallel.moe_ep_specs``) and XLA lowers the
+dispatch/combine einsums to the canonical all-to-all over the mesh —
+the forward below never mentions devices.
+
+The Switch load-balancing aux loss is activation-dependent, so it
+can't flow through ``regularization_penalty(params)``; instead it
+rides the layer-state seam: ``forward`` writes the weighted aux into
+``state["__aux_loss__"]`` and the containers add every such entry to
+the training objective (differentiably — state is produced inside the
+traced step).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers.base import LayerImpl, register_impl
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.moe import moe_ffn
+
+AUX_LOSS_KEY = "__aux_loss__"
+
+
+@register_impl(L.MoELayer)
+class MoEImpl(LayerImpl):
+    def init_params(self, key) -> Dict[str, jnp.ndarray]:
+        c = self.conf
+        d, f, e = c.n_in, c.ffn_mult * c.n_in, c.num_experts
+        if c.n_out != c.n_in:
+            raise ValueError("MoELayer needs n_in == n_out (FFN block)")
+        ks = jax.random.split(key, 3)
+        mk = lambda k, shape, fi, fo: init_weights(
+            k, shape, self.weight_init, fi, fo, c.dist_mean, c.dist_std)
+        return {
+            "Wg": mk(ks[0], (d, e), d, e),
+            "W1": mk(ks[1], (e, d, f), d, f),
+            "b1": jnp.zeros((e, f), jnp.float32),
+            "W2": mk(ks[2], (e, f, d), f, d),
+            "b2": jnp.zeros((e, d), jnp.float32),
+        }
+
+    def init_state(self):
+        return {AUX_LOSS_KEY: jnp.zeros((), jnp.float32)}
+
+    def forward(self, params, x, state, train, rng=None, mask=None):
+        c = self.conf
+        x = self.maybe_dropout_input(x, train, rng)
+        shape = x.shape
+        if x.ndim == 3:
+            x2 = x.reshape(-1, shape[-1])
+        elif x.ndim == 2:
+            x2 = x
+        else:
+            raise ValueError(f"MoELayer needs [b, d] or [b, t, d], got {shape}")
+        valid = None
+        if mask is not None and x.ndim == 3:
+            # masked timesteps must not occupy capacity or skew the aux
+            valid = mask.reshape(-1)
+        y2, aux = moe_ffn(x2, params["Wg"], params["W1"], params["b1"],
+                          params["W2"], params["b2"],
+                          capacity_factor=c.capacity_factor, valid=valid)
+        y = y2.reshape(shape[:-1] + (c.n_out,))
+        if c.residual:
+            y = y + x
+        if mask is not None and y.ndim == 3:
+            y = y * mask[:, :, None].astype(y.dtype)
+        new_state = {AUX_LOSS_KEY: (c.aux_loss_weight
+                                    * aux.astype(jnp.float32))}
+        return y, new_state

@@ -145,6 +145,11 @@ class MultiLayerNetwork:
         new_states[self.out.name] = states[self.out.name]
         for impl in self.impls:
             score = score + impl.regularization_penalty(params[impl.name]).astype(score.dtype)
+        # activation-dependent auxiliary losses (e.g. MoE load balancing)
+        # ride the state seam — differentiable, produced inside this trace
+        for ns in new_states.values():
+            if isinstance(ns, dict) and "__aux_loss__" in ns:
+                score = score + ns["__aux_loss__"].astype(score.dtype)
         return score, new_states
 
     def _make_train_step(self, has_fmask: bool, has_lmask: bool):

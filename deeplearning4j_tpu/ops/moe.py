@@ -1,0 +1,74 @@
+"""Mixture-of-experts FFN with top-1 (Switch-style) routing.
+
+No reference counterpart (SURVEY §2.6 note 5: the reference predates
+expert parallelism); build-plan extension. TPU-first formulation: hard
+routing is expressed as dense dispatch/combine one-hot tensors and
+einsums — gathers/scatters become MXU matmuls, shapes stay static
+(capacity-bounded), and when the expert dimension of the weights is
+sharded over a mesh ``expert`` axis XLA lowers the dispatched einsum to
+the canonical all-to-all. Overflowed tokens (expert over capacity) pass
+through the residual path with zero expert output, as in Switch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def top1_dispatch(gate_logits: jnp.ndarray, capacity: int,
+                  valid: jnp.ndarray = None):
+    """gate_logits [n, E] → (dispatch [n, E, C] one-hot, combine
+    [n, E, C] gate-weighted, aux_loss scalar).
+
+    ``valid`` [n] (optional): masked-out tokens are routed nowhere —
+    they consume no capacity slots and are excluded from the aux loss
+    (padded timesteps must not starve real tokens of capacity).
+
+    aux_loss is the Switch load-balancing loss E·Σ_e f_e·p_e (fraction
+    routed × mean router prob) — add it to the training objective to
+    keep experts utilized.
+    """
+    n, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                      # [n]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)    # [n, E]
+    if valid is not None:
+        v = valid.astype(jnp.float32)
+        onehot = onehot * v[:, None]
+        n_valid = jnp.maximum(jnp.sum(v), 1.0)
+    else:
+        v = None
+        n_valid = float(n)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0          # [n, E]
+    keep = (pos >= 0) & (pos < capacity)
+    pos_clamped = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    dispatch = (jax.nn.one_hot(pos_clamped, capacity, dtype=jnp.float32)
+                * keep[..., None].astype(jnp.float32))       # [n, E, C]
+    gate = jnp.sum(probs * onehot, axis=-1)                  # [n]
+    combine = dispatch * gate[:, None, None]
+    frac_routed = jnp.sum(onehot, axis=0) / n_valid
+    mean_prob = (jnp.sum(probs * (v[:, None] if v is not None else 1.0),
+                         axis=0) / n_valid)
+    aux_loss = e * jnp.sum(frac_routed * mean_prob)
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(x: jnp.ndarray, Wg, W1, b1, W2, b2,
+            capacity_factor: float = 1.25, valid: jnp.ndarray = None):
+    """x [n, d] → ([n, d], aux_loss). Expert weights: W1 [E, d, f],
+    b1 [E, f], W2 [E, f, d], b2 [E, d]; router Wg [d, E]. ``valid``
+    [n]: tokens to route (masked tokens get zero output and no slot)."""
+    n, d = x.shape
+    e = W1.shape[0]
+    capacity = max(1, int(capacity_factor * n / e))
+    gate_logits = x.astype(jnp.float32) @ Wg.astype(jnp.float32)
+    dispatch, combine, aux = top1_dispatch(gate_logits, capacity, valid=valid)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+    xe = jnp.einsum("nec,nd->ecd", dispatch, x)              # [E, C, d]
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, W1) + b1[:, None, :])
+    ye = jnp.einsum("ecf,efd->ecd", h, W2) + b2[:, None, :]  # [E, C, d]
+    y = jnp.einsum("ecd,nec->nd", ye, combine)
+    return y, aux

@@ -23,3 +23,4 @@ attention for long context (``ring_attention.py``), multi-host DCN via
 from deeplearning4j_tpu.parallel.mesh import MeshContext, make_mesh  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
 from deeplearning4j_tpu.parallel.evaluation import evaluate_sharded  # noqa: F401
+from deeplearning4j_tpu.parallel.pipeline import pipeline_apply  # noqa: F401

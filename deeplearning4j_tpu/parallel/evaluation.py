@@ -49,6 +49,146 @@ def _counts_program(model):
     return jax.jit(counts)
 
 
+def _batches(data: Union[DataSet, DataSetIterator],
+             batch_size: Optional[int]):
+    if isinstance(data, DataSet):
+        data = ListDataSetIterator(data, batch_size or data.num_examples())
+    return data
+
+
+def _flatten_with_valid(ds: DataSet):
+    """(x, y, valid) with time folded later device-side; valid is the
+    per-row (or per-timestep) label weight."""
+    x = np.asarray(ds.features, np.float32)
+    y = np.asarray(ds.labels, np.float32)
+    if y.ndim == 3 and ds.labels_mask is not None:
+        valid = np.asarray(ds.labels_mask, np.float32)
+    elif y.ndim == 3:
+        valid = np.ones(y.shape[:2], np.float32)
+    else:
+        valid = np.ones((y.shape[0],), np.float32)
+    return x, y, valid
+
+
+def _pad_for_mesh(dsize: int, x, y, valid):
+    pad = (-x.shape[0]) % dsize
+    if pad:
+        zeros = lambda a: np.zeros((pad,) + a.shape[1:], a.dtype)
+        x = np.concatenate([x, zeros(x)])
+        y = np.concatenate([y, zeros(y)])
+        valid = np.concatenate([valid, zeros(valid)])
+    return x, y, valid
+
+
+def evaluate_regression_sharded(model, data: Union[DataSet, DataSetIterator],
+                                mesh=None, batch_size: Optional[int] = None):
+    """Mesh-sharded ``RegressionEvaluation``: one jitted program reduces
+    the eight per-column sufficient statistics (count, Σ|err|, Σerr²,
+    Σy, Σy², Σŷ, Σŷ², Σyŷ) over the data axis — only [8, C] floats
+    reach the host per batch.
+
+    Precision: cross-batch accumulation is host-side np.float64; the
+    WITHIN-batch device reduction runs at f64 only when jax_enable_x64
+    is on (else f32, JAX silently downcasts). For large batches of
+    large-magnitude targets under x64-off, keep ``batch_size`` modest
+    so the f32 partial sums stay accurate — the host evaluator is
+    always full f64."""
+    from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+
+    mesh = mesh if mesh is not None else make_mesh()
+    ctx = MeshContext(mesh)
+
+    def stats(params, states, x, labels, valid):
+        acts, _ = model._forward(params, states, x, False, None, None)
+        preds = acts[-1].astype(jnp.float64)
+        labels = labels.astype(jnp.float64)
+        c = labels.shape[-1]
+        if preds.ndim == 3:
+            preds = preds.reshape(-1, c)
+            labels = labels.reshape(-1, c)
+            valid = valid.reshape(-1)
+        # binary validity (host evaluator keeps rows with mask > 0);
+        # v² == v, so masking y/ŷ once masks every product below
+        v = (valid > 0).astype(jnp.float64)[:, None]
+        err = (preds - labels) * v
+        labels = labels * v
+        preds = preds * v
+        return jnp.stack([
+            jnp.broadcast_to(jnp.sum(v), (c,)),
+            jnp.sum(jnp.abs(err), axis=0),
+            jnp.sum(err * err, axis=0),
+            jnp.sum(labels, axis=0),
+            jnp.sum(labels * labels, axis=0),
+            jnp.sum(preds, axis=0),
+            jnp.sum(preds * preds, axis=0),
+            jnp.sum(labels * preds, axis=0),
+        ])
+
+    program = jax.jit(stats)
+    repl = ctx.replicated()
+    params = jax.device_put(model.params, repl)
+    states = jax.device_put(model.states, repl)
+    total = None
+    for ds in _batches(data, batch_size):
+        x, y, valid = _flatten_with_valid(ds)
+        x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid)
+        xs, ys, vs = ctx.shard_batch(x, y, valid)
+        out = np.asarray(program(params, states, xs, ys, vs), np.float64)
+        total = out if total is None else total + out
+    ev = RegressionEvaluation()
+    if total is not None:
+        ev._ensure(total.shape[1])
+        (ev.count, ev.sum_abs_err, ev.sum_sq_err, ev.sum_label,
+         ev.sum_label_sq, ev.sum_pred, ev.sum_pred_sq,
+         ev.sum_label_pred) = total
+    return ev
+
+
+def evaluate_roc_sharded(model, data: Union[DataSet, DataSetIterator],
+                         mesh=None, batch_size: Optional[int] = None,
+                         threshold_steps: int = 100):
+    """Mesh-sharded binary ``ROC``: per-threshold TP/FP counts computed
+    as one [T+1, n] masked comparison reduced device-side (the host ROC
+    loops thresholds in Python). Equals host-side ROC exactly."""
+    from deeplearning4j_tpu.eval.roc import ROC
+
+    mesh = mesh if mesh is not None else make_mesh()
+    ctx = MeshContext(mesh)
+    thresholds = jnp.linspace(0.0, 1.0, threshold_steps + 1)
+
+    def counts(params, states, x, labels, valid):
+        acts, _ = model._forward(params, states, x, False, None, None)
+        preds = acts[-1]
+        if labels.ndim >= 2 and labels.shape[-1] == 2:
+            labels = labels[..., 1]
+            preds = preds[..., 1]
+        labels = labels.reshape(-1)
+        preds = preds.reshape(-1).astype(jnp.float32)
+        v = valid.reshape(-1) > 0
+        pos = (labels > 0.5) & v
+        neg = (labels <= 0.5) & v
+        predicted = preds[None, :] >= thresholds[:, None]  # [T+1, n]
+        tp = jnp.sum(predicted & pos[None, :], axis=1)
+        fp = jnp.sum(predicted & neg[None, :], axis=1)
+        return tp, fp, jnp.sum(pos), jnp.sum(neg)
+
+    program = jax.jit(counts)
+    repl = ctx.replicated()
+    params = jax.device_put(model.params, repl)
+    states = jax.device_put(model.states, repl)
+    roc = ROC(threshold_steps)
+    for ds in _batches(data, batch_size):
+        x, y, valid = _flatten_with_valid(ds)
+        x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid)
+        xs, ys, vs = ctx.shard_batch(x, y, valid)
+        tp, fp, pos, neg = program(params, states, xs, ys, vs)
+        roc.tp += np.asarray(tp, np.int64)
+        roc.fp += np.asarray(fp, np.int64)
+        roc.pos += int(pos)
+        roc.neg += int(neg)
+    return roc
+
+
 def evaluate_sharded(model, data: Union[DataSet, DataSetIterator],
                      mesh=None, batch_size: Optional[int] = None,
                      num_classes: Optional[int] = None) -> Evaluation:
@@ -61,31 +201,15 @@ def evaluate_sharded(model, data: Union[DataSet, DataSetIterator],
     """
     mesh = mesh if mesh is not None else make_mesh()
     ctx = MeshContext(mesh)
-    dsize = ctx.data_axis_size()
-    if isinstance(data, DataSet):
-        data = ListDataSetIterator(data, batch_size or data.num_examples())
     program = _counts_program(model)
     repl = ctx.replicated()
     params = jax.device_put(model.params, repl)
     states = jax.device_put(model.states, repl)
 
     total: Optional[np.ndarray] = None
-    for ds in data:
-        x = np.asarray(ds.features, np.float32)
-        y = np.asarray(ds.labels, np.float32)
-        n = x.shape[0]
-        if y.ndim == 3 and ds.labels_mask is not None:
-            valid = np.asarray(ds.labels_mask, np.float32)
-        elif y.ndim == 3:
-            valid = np.ones(y.shape[:2], np.float32)
-        else:
-            valid = np.ones((n,), np.float32)
-        pad = (-n) % dsize
-        if pad:  # ragged tail: pad rows, zero validity
-            zeros = lambda a: np.zeros((pad,) + a.shape[1:], a.dtype)
-            x = np.concatenate([x, zeros(x)])
-            y = np.concatenate([y, zeros(y)])
-            valid = np.concatenate([valid, zeros(valid)])
+    for ds in _batches(data, batch_size):
+        x, y, valid = _flatten_with_valid(ds)
+        x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid)
         xs, ys, vs = ctx.shard_batch(x, y, valid)
         counts = np.asarray(program(params, states, xs, ys, vs))
         total = counts if total is None else total + counts

@@ -43,6 +43,16 @@ def conv_tp_specs(layer_names, axis: str = "model") -> Dict[str, Dict[str, P]]:
     return {n: {"W": P(None, None, None, axis), "b": P(axis)} for n in layer_names}
 
 
+def moe_ep_specs(layer_names, axis: str = "expert") -> Dict[str, Dict[str, P]]:
+    """Expert-parallel PartitionSpecs for ``MoELayer``s: shard the
+    leading expert dim of the expert weights over ``axis``; the router
+    stays replicated. XLA lowers the dispatch/combine einsums to the
+    canonical MoE all-to-all over the mesh."""
+    return {name: {"Wg": P(), "W1": P(axis, None, None), "b1": P(axis, None),
+                   "W2": P(axis, None, None), "b2": P(axis, None)}
+            for name in layer_names}
+
+
 def lstm_tp_specs(layer_names, axis: str = "model") -> Dict[str, Dict[str, P]]:
     """Gate-dimension sharding for LSTM packed weights.
 

@@ -24,6 +24,8 @@ Routes:
   GET  /api/sessions/<s>/workers          ["w0", ...]
   GET  /api/sessions/<s>/reports[?worker] [report dicts...]
   POST /api/reports                       accept one report dict
+  GET  /words[?word=w&n=k]                nearest-words view (HTML)
+  GET  /api/words/nearest?word=w[&n=k]    {"word": w, "nearest": [...]}
 """
 
 from __future__ import annotations
@@ -84,6 +86,10 @@ class _Handler(BaseHTTPRequestHandler):
                 if len(parts) == 4 and parts[1] == "sessions" and parts[3] == "reports":
                     reports = self.storage.get_reports(parts[2], worker)
                     return self._json([r.to_dict() for r in reports])
+                if parts[1:] == ["words", "nearest"]:
+                    return self._words_nearest(query)
+            if parts == ["words"]:
+                return self._html(self._words_page(query))
             return self._json({"error": "not found"}, 404)
         except Exception as e:  # surface handler bugs to the client, not the log
             return self._json({"error": f"{type(e).__name__}: {e}"}, 500)
@@ -100,6 +106,58 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"ok": True})
         except Exception as e:
             return self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+    def _words_nearest(self, query):
+        """Nearest-neighbor serving for attached word vectors — the
+        ``deeplearning4j-scaleout/deeplearning4j-nlp`` Dropwizard
+        nearest-neighbors resource role."""
+        wv = self.server._word_vectors  # type: ignore[attr-defined]
+        if wv is None:
+            return self._json({"error": "no word vectors attached"}, 404)
+        word = query.get("word", [None])[0]
+        if not word:
+            return self._json({"error": "missing ?word="}, 400)
+        try:
+            n = int(query.get("n", ["10"])[0])
+        except ValueError:
+            return self._json({"error": "?n= must be an integer"}, 400)
+        try:
+            pairs = wv.words_nearest(word, n=n)
+        except KeyError:
+            return self._json({"error": f"unknown word {word!r}"}, 404)
+        pairs = [list(p) if isinstance(p, (list, tuple)) else [p, None]
+                 for p in pairs]
+        return self._json({"word": word, "nearest": pairs})
+
+    def _words_page(self, query) -> str:
+        word = query.get("word", [""])[0]
+        rows = ""
+        wv = self.server._word_vectors  # type: ignore[attr-defined]
+        if wv is not None and word:
+            try:
+                n = int(query.get("n", ["10"])[0])
+            except ValueError:
+                n = 10
+            try:
+                pairs = wv.words_nearest(word, n=n)
+                rows = "".join(
+                    f"<tr><td>{html.escape(str(w))}</td>"
+                    f"<td>{'' if s is None else f'{float(s):.4f}'}</td></tr>"
+                    for w, s in (p if isinstance(p, (list, tuple)) else (p, None)
+                                 for p in pairs))
+                rows = ("<table border='1' cellpadding='4'>"
+                        "<tr><th>word</th><th>similarity</th></tr>"
+                        + rows + "</table>")
+            except KeyError:
+                rows = f"<p>unknown word: {html.escape(word)}</p>"
+        elif wv is None:
+            rows = "<p>(no word vectors attached)</p>"
+        return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+                "<title>nearest words</title></head>"
+                "<body style='font-family:sans-serif'><h1>Nearest words</h1>"
+                "<form method='get'><input name='word' "
+                f"value='{html.escape(word)}'/>"
+                "<button>search</button></form>" + rows + "</body></html>")
 
     def _index(self) -> str:
         rows = []
@@ -127,10 +185,15 @@ class UiServer:
     """
 
     def __init__(self, storage: StatsStorage, port: int = 0,
-                 host: str = "127.0.0.1", verbose: bool = False):
+                 host: str = "127.0.0.1", verbose: bool = False,
+                 word_vectors=None):
+        """``word_vectors``: any object with ``words_nearest(word, n)``
+        (Word2Vec/WordVectors) — enables the /words nearest-neighbor
+        view (legacy dl4j-scaleout/deeplearning4j-nlp render role)."""
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd._storage = storage  # type: ignore[attr-defined]
         self._httpd._verbose = verbose  # type: ignore[attr-defined]
+        self._httpd._word_vectors = word_vectors  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property

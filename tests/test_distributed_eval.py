@@ -6,6 +6,7 @@ host-side ``Evaluation`` over the same data.
 """
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
@@ -72,6 +73,54 @@ def test_num_classes_wider_than_labels(rng):
     assert dist.confusion.counts[3:, :].sum() == 0
     with pytest.raises(ValueError):
         evaluate_sharded(net, ds, num_classes=2)
+
+
+def test_regression_sharded_matches_host(rng):
+    from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+    from deeplearning4j_tpu.parallel.evaluation import evaluate_regression_sharded
+
+    conf = (NeuralNetConfiguration.builder().seed(2).learning_rate(0.1)
+            .updater("sgd").activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=5, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="identity",
+                               loss_function="mse"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.standard_normal((37, 5)).astype(np.float32)  # ragged over 8 devs
+    y = rng.standard_normal((37, 2)).astype(np.float32)
+    host = RegressionEvaluation()
+    host.eval(y, net.output(x))
+    dist = evaluate_regression_sharded(net, DataSet(x, y), batch_size=16)
+    for c in range(2):
+        assert dist.mean_squared_error(c) == pytest.approx(
+            host.mean_squared_error(c), rel=1e-6)
+        assert dist.r_squared(c) == pytest.approx(host.r_squared(c), rel=1e-5)
+        assert dist.pearson_correlation(c) == pytest.approx(
+            host.pearson_correlation(c), rel=1e-5)
+
+
+def test_roc_sharded_matches_host(rng):
+    from deeplearning4j_tpu.eval.roc import ROC
+    from deeplearning4j_tpu.parallel.evaluation import evaluate_roc_sharded
+
+    conf = (NeuralNetConfiguration.builder().seed(4).learning_rate(0.1)
+            .updater("sgd").activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.standard_normal((45, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 45)]
+    host = ROC(50)
+    host.eval(y, net.output(x))
+    dist = evaluate_roc_sharded(net, DataSet(x, y), threshold_steps=50)
+    np.testing.assert_array_equal(dist.tp, host.tp)
+    np.testing.assert_array_equal(dist.fp, host.fp)
+    assert (dist.pos, dist.neg) == (host.pos, host.neg)
+    assert dist.calculate_auc() == pytest.approx(host.calculate_auc())
 
 
 def test_time_series_with_mask(rng):

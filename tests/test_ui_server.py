@@ -81,6 +81,38 @@ def test_server_404_and_bad_post(server):
     assert e.value.code == 400
 
 
+def test_words_nearest_endpoint():
+    """Nearest-neighbor serving (legacy dl4j-scaleout nlp render role)."""
+    class FakeWV:
+        def words_nearest(self, word, n=10):
+            if word != "king":
+                raise KeyError(word)
+            return ["queen", "prince"][:n]
+
+    storage = InMemoryStatsStorage()
+    srv = UiServer(storage, port=0, word_vectors=FakeWV()).start()
+    try:
+        got = json.loads(urllib.request.urlopen(
+            srv.url + "/api/words/nearest?word=king&n=2", timeout=5).read())
+        assert got["nearest"] == [["queen", None], ["prince", None]]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url + "/api/words/nearest?word=zzz",
+                                   timeout=5)
+        assert e.value.code == 404
+        page = urllib.request.urlopen(srv.url + "/words?word=king",
+                                      timeout=5).read().decode()
+        assert "queen" in page
+    finally:
+        srv.stop()
+
+
+def test_words_endpoint_absent_without_vectors(server):
+    srv, _ = server
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(srv.url + "/api/words/nearest?word=x", timeout=5)
+    assert e.value.code == 404
+
+
 def test_component_dsl_roundtrip_and_render():
     rng = np.random.default_rng(0)
     counts, edges = np.histogram(rng.standard_normal(500), bins=10)
