@@ -24,9 +24,13 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 def gpt(vocab_size: int = 50257, d_model: int = 512, n_layers: int = 8,
         num_heads: int = 8, max_len: int = 1024, ffn_mult: int = 4,
         dropout: float = 0.0, learning_rate: float = 3e-4,
-        compute_dtype: str = "bfloat16", seed: int = 0) -> MultiLayerNetwork:
+        compute_dtype: str = "bfloat16", num_experts: int = 0,
+        capacity_factor: float = 1.25, aux_loss_weight: float = 0.01,
+        seed: int = 0) -> MultiLayerNetwork:
     """Decoder-only LM over int token ids [b, t]; labels one-hot
-    [b, t, vocab] (next-token targets)."""
+    [b, t, vocab] (next-token targets). ``num_experts > 0`` swaps the
+    dense MLPs for Mixtral-style top-1 routed experts
+    (capacity_factor/aux_loss_weight tune the routing)."""
     b = (NeuralNetConfiguration.builder()
          .seed(seed).learning_rate(learning_rate).updater("adam")
          .activation("identity").weight_init("xavier")
@@ -37,7 +41,10 @@ def gpt(vocab_size: int = 50257, d_model: int = 512, n_layers: int = 8,
     for _ in range(n_layers):
         b = b.layer(TransformerBlock(n_in=d_model, n_out=d_model,
                                      num_heads=num_heads, ffn_mult=ffn_mult,
-                                     causal=True, dropout=dropout))
+                                     causal=True, dropout=dropout,
+                                     num_experts=num_experts,
+                                     capacity_factor=capacity_factor,
+                                     aux_loss_weight=aux_loss_weight))
     conf = (b.layer(RnnOutputLayer(n_in=d_model, n_out=vocab_size,
                                    activation="softmax",
                                    loss_function="mcxent"))

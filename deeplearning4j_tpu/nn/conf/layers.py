@@ -235,6 +235,11 @@ class TransformerBlock(FeedForwardLayer):
     num_heads: int = 8
     ffn_mult: int = 4
     causal: bool = True
+    # Mixtral-style MoE FFN: > 0 replaces the dense MLP with a top-1
+    # routed expert mix (ops/moe.py); shard experts via moe_ep_specs
+    num_experts: int = 0
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
 
 
 @register_layer

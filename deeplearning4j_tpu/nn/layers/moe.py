@@ -31,23 +31,42 @@ from deeplearning4j_tpu.ops.moe import moe_ffn
 AUX_LOSS_KEY = "__aux_loss__"
 
 
+def init_moe_params(key, d: int, f: int, e: int, weight_init: str,
+                    dist_mean: float, dist_std: float) -> Dict[str, jnp.ndarray]:
+    """Router + expert FFN weights (shared by MoEImpl and the MoE
+    variant of TransformerBlock)."""
+    ks = jax.random.split(key, 3)
+    mk = lambda k, shape, fi, fo: init_weights(
+        k, shape, weight_init, fi, fo, dist_mean, dist_std)
+    return {
+        "Wg": mk(ks[0], (d, e), d, e),
+        "W1": mk(ks[1], (e, d, f), d, f),
+        "b1": jnp.zeros((e, f), jnp.float32),
+        "W2": mk(ks[2], (e, f, d), f, d),
+        "b2": jnp.zeros((e, d), jnp.float32),
+    }
+
+
+def run_moe_ffn(params, x2: jnp.ndarray, capacity_factor: float,
+                aux_loss_weight: float, mask=None):
+    """Flattened-token MoE forward + weighted aux packaged for the
+    layer-state seam: returns (y2, {AUX_LOSS_KEY: weighted_aux})."""
+    valid = mask.reshape(-1) if mask is not None else None
+    y2, aux = moe_ffn(x2, params["Wg"], params["W1"], params["b1"],
+                      params["W2"], params["b2"],
+                      capacity_factor=capacity_factor, valid=valid)
+    return y2, {AUX_LOSS_KEY: aux_loss_weight * aux.astype(jnp.float32)}
+
+
 @register_impl(L.MoELayer)
 class MoEImpl(LayerImpl):
     def init_params(self, key) -> Dict[str, jnp.ndarray]:
         c = self.conf
-        d, f, e = c.n_in, c.ffn_mult * c.n_in, c.num_experts
         if c.n_out != c.n_in:
             raise ValueError("MoELayer needs n_in == n_out (FFN block)")
-        ks = jax.random.split(key, 3)
-        mk = lambda k, shape, fi, fo: init_weights(
-            k, shape, self.weight_init, fi, fo, c.dist_mean, c.dist_std)
-        return {
-            "Wg": mk(ks[0], (d, e), d, e),
-            "W1": mk(ks[1], (e, d, f), d, f),
-            "b1": jnp.zeros((e, f), jnp.float32),
-            "W2": mk(ks[2], (e, f, d), f, d),
-            "b2": jnp.zeros((e, d), jnp.float32),
-        }
+        return init_moe_params(key, c.n_in, c.ffn_mult * c.n_in,
+                               c.num_experts, self.weight_init,
+                               c.dist_mean, c.dist_std)
 
     def init_state(self):
         return {AUX_LOSS_KEY: jnp.zeros((), jnp.float32)}
@@ -62,18 +81,13 @@ class MoEImpl(LayerImpl):
             x2 = x
         else:
             raise ValueError(f"MoELayer needs [b, d] or [b, t, d], got {shape}")
-        valid = None
-        if mask is not None and x.ndim == 3:
-            # masked timesteps must not occupy capacity or skew the aux
-            valid = mask.reshape(-1)
-        y2, aux = moe_ffn(x2, params["Wg"], params["W1"], params["b1"],
-                          params["W2"], params["b2"],
-                          capacity_factor=c.capacity_factor, valid=valid)
+        # masked timesteps must not occupy capacity or skew the aux
+        routing_mask = mask if (mask is not None and x.ndim == 3) else None
+        y2, new_state = run_moe_ffn(params, x2, c.capacity_factor,
+                                    c.aux_loss_weight, mask=routing_mask)
         y = y2.reshape(shape[:-1] + (c.n_out,))
         if c.residual:
             y = y + x
         if mask is not None and y.ndim == 3:
             y = y * mask[:, :, None].astype(y.dtype)
-        new_state = {AUX_LOSS_KEY: (c.aux_loss_weight
-                                    * aux.astype(jnp.float32))}
         return y, new_state

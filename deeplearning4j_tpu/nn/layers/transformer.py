@@ -24,6 +24,8 @@ from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.layers.attention import dispatch_attention
 from deeplearning4j_tpu.nn.layers.base import (
     LayerImpl, apply_dropout, register_impl)
+from deeplearning4j_tpu.nn.layers.moe import (
+    AUX_LOSS_KEY, init_moe_params, run_moe_ffn)
 from deeplearning4j_tpu.nn.weights import init_weights
 
 
@@ -70,20 +72,35 @@ class TransformerBlockImpl(LayerImpl):
             raise ValueError(f"d_model {c.n_out} not divisible by "
                              f"num_heads {c.num_heads}")
         d, f = c.n_out, c.ffn_mult * c.n_out
+        # split(key, 4) as in the dense-only original: a fixed seed must
+        # keep producing bit-identical dense-block inits
         ks = jax.random.split(key, 4)
         mk = lambda k, shape: init_weights(k, shape, self.weight_init,
                                            shape[0], shape[1],
                                            c.dist_mean, c.dist_std)
-        return {
+        params = {
             "Wqkv": mk(ks[0], (d, 3 * d)),
             "Wo": mk(ks[1], (d, d)),
-            "W1": mk(ks[2], (d, f)), "b1": jnp.zeros((f,), jnp.float32),
-            "W2": mk(ks[3], (f, d)), "b2": jnp.zeros((d,), jnp.float32),
             "ln1_g": jnp.ones((d,), jnp.float32),
             "ln1_b": jnp.zeros((d,), jnp.float32),
             "ln2_g": jnp.ones((d,), jnp.float32),
             "ln2_b": jnp.zeros((d,), jnp.float32),
         }
+        if c.num_experts > 0:  # Mixtral-style routed MLP (shared init)
+            params.update(init_moe_params(
+                ks[2], d, f, c.num_experts, self.weight_init,
+                c.dist_mean, c.dist_std))
+        else:
+            params.update({
+                "W1": mk(ks[2], (d, f)), "b1": jnp.zeros((f,), jnp.float32),
+                "W2": mk(ks[3], (f, d)), "b2": jnp.zeros((d,), jnp.float32),
+            })
+        return params
+
+    def init_state(self):
+        if self.conf.num_experts > 0:
+            return {AUX_LOSS_KEY: jnp.zeros((), jnp.float32)}
+        return {}
 
     def forward(self, params, x, state, train, rng=None, mask=None):
         c = self.conf
@@ -104,13 +121,20 @@ class TransformerBlockImpl(LayerImpl):
         x = x + attn
 
         h2 = _layer_norm(x, params["ln2_g"], params["ln2_b"])
-        mlp = jax.nn.gelu(h2 @ params["W1"].astype(x.dtype)
-                          + params["b1"].astype(x.dtype))
-        mlp = mlp @ params["W2"].astype(x.dtype) + params["b2"].astype(x.dtype)
+        new_state = state
+        if c.num_experts > 0:  # routed expert MLP (Mixtral wiring)
+            mlp2, new_state = run_moe_ffn(
+                params, h2.reshape(-1, d), c.capacity_factor,
+                c.aux_loss_weight, mask=mask)
+            mlp = mlp2.reshape(b, t, d)
+        else:
+            mlp = jax.nn.gelu(h2 @ params["W1"].astype(x.dtype)
+                              + params["b1"].astype(x.dtype))
+            mlp = mlp @ params["W2"].astype(x.dtype) + params["b2"].astype(x.dtype)
         if train and self.dropout_rate > 0.0 and rng is not None:
             mlp = apply_dropout(mlp, self.dropout_rate,
                                 jax.random.fold_in(rng, 2))
         out = x + mlp
         if mask is not None:
             out = out * mask[:, :, None].astype(out.dtype)
-        return out, state
+        return out, new_state

@@ -65,22 +65,36 @@ def lstm_tp_specs(layer_names, axis: str = "model") -> Dict[str, Dict[str, P]]:
             for n in layer_names}
 
 
-def apply_shardings(model, mesh: Mesh,
-                    specs: Dict[str, Dict[str, P]]) -> None:
-    """Place the model's params (and matching updater state) according to
-    ``specs``; unlisted params are replicated. Subsequent ``fit`` calls
-    compile SPMD with these placements."""
+def _placer(mesh: Mesh, specs: Dict[str, Dict[str, P]]):
     repl = NamedSharding(mesh, P())
 
     def place(layer, pname, v):
         spec = specs.get(layer, {}).get(pname)
         return jax.device_put(v, NamedSharding(mesh, spec) if spec is not None else repl)
 
-    model.params = {ln: {pn: place(ln, pn, v) for pn, v in ld.items()}
-                    for ln, ld in model.params.items()}
+    return place
+
+
+def place_updater_state(model, mesh: Mesh,
+                        specs: Dict[str, Dict[str, P]]) -> None:
+    """Shard the updater-state mirror of each parameter per ``specs``
+    (unlisted -> replicated). Used by apply_shardings and ZeRO-1."""
+    place = _placer(mesh, specs)
     upd = model.opt_state["updater"]
     model.opt_state["updater"] = {
         ln: {pn: jax.tree.map(lambda s: place(ln, pn, s), st) for pn, st in ld.items()}
         for ln, ld in upd.items()}
-    model.states = jax.device_put(model.states, repl)
-    model.opt_state["step"] = jax.device_put(model.opt_state["step"], repl)
+    model.opt_state["step"] = jax.device_put(
+        model.opt_state["step"], NamedSharding(mesh, P()))
+
+
+def apply_shardings(model, mesh: Mesh,
+                    specs: Dict[str, Dict[str, P]]) -> None:
+    """Place the model's params (and matching updater state) according to
+    ``specs``; unlisted params are replicated. Subsequent ``fit`` calls
+    compile SPMD with these placements."""
+    place = _placer(mesh, specs)
+    model.params = {ln: {pn: place(ln, pn, v) for pn, v in ld.items()}
+                    for ln, ld in model.params.items()}
+    place_updater_state(model, mesh, specs)
+    model.states = jax.device_put(model.states, NamedSharding(mesh, P()))

@@ -1,0 +1,63 @@
+"""ZeRO / FSDP-style sharding of parameters and optimizer state.
+
+No reference counterpart (SURVEY §2.6 note 5: ZeRO-style sharding
+postdates the reference); mesh-axis extension alongside TP/SP/EP/PP.
+
+In the XLA SPMD world ZeRO is not an algorithm but a placement: shard
+each parameter (and its updater-state mirror) along its largest
+divisible dim over the ``data`` axis and the partitioner derives the
+FSDP schedule — all-gather params for the forward/backward,
+reduce-scatter gradients, update each shard locally. ZeRO-1 (optimizer
+state only) keeps params replicated and shards just the updater state;
+memory drops by (axis_size-1)/axis_size of the optimizer state with no
+change to the forward.
+
+Numerics are placement-invariant (equivalence-tested vs replicated
+training).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.tensor_parallel import (
+    apply_shardings, place_updater_state)
+
+
+def fsdp_specs(model, mesh: Mesh, axis: str = "data") -> Dict[str, Dict[str, P]]:
+    """Per-parameter PartitionSpecs sharding the largest dim divisible
+    by the ``axis`` size; indivisible params stay replicated."""
+    size = mesh.shape[axis]
+    specs: Dict[str, Dict[str, P]] = {}
+    for layer, params in model.params.items():
+        for pname, v in params.items():
+            dims = sorted(range(v.ndim), key=lambda i: -v.shape[i])
+            for i in dims:
+                if v.shape[i] >= size and v.shape[i] % size == 0:
+                    spec = [None] * v.ndim
+                    spec[i] = axis
+                    specs.setdefault(layer, {})[pname] = P(*spec)
+                    break
+    return specs
+
+
+def apply_fsdp(model, mesh: Mesh, axis: str = "data") -> Dict[str, Dict[str, P]]:
+    """ZeRO-3/FSDP: shard params + optimizer state over ``axis``.
+    Returns the specs used."""
+    specs = fsdp_specs(model, mesh, axis)
+    apply_shardings(model, mesh, specs)
+    return specs
+
+
+def apply_zero1(model, mesh: Mesh, axis: str = "data") -> Dict[str, Dict[str, P]]:
+    """ZeRO-1: params replicated, optimizer state sharded over ``axis``.
+    Returns the specs used for the updater state."""
+    specs = fsdp_specs(model, mesh, axis)
+    repl = NamedSharding(mesh, P())
+    model.params = jax.device_put(model.params, repl)
+    model.states = jax.device_put(model.states, repl)
+    place_updater_state(model, mesh, specs)
+    return specs

@@ -1,0 +1,6 @@
+"""Runnable end-to-end examples (the dl4j-examples role).
+
+Each example is a `main(smoke=False)` driving the public API only;
+`--smoke` shrinks shapes/epochs for CI. Run as
+``python -m examples.<name>`` from the repo root.
+"""

@@ -1,0 +1,47 @@
+"""Word2Vec skip-gram embeddings + nearest-words dashboard.
+
+The reference's Word2VecRawTextExample role: sentence iterator →
+tokenizer → vocab → SGNS training → wordsNearest, plus the live
+UiServer nearest-words view.
+"""
+
+import argparse
+
+from deeplearning4j_tpu.models.word2vec.word2vec import Word2Vec
+from deeplearning4j_tpu.text.sentenceiterator import CollectionSentenceIterator
+from deeplearning4j_tpu.text.tokenization import (
+    CommonPreprocessor, DefaultTokenizerFactory)
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, UiServer
+
+_SENTENCES = [
+    "the king rules the kingdom with the queen",
+    "the queen rules beside the king",
+    "a dog chases the cat around the garden",
+    "the cat sleeps while the dog barks",
+    "kings and queens live in castles",
+    "dogs and cats are animals",
+] * 50
+
+
+def main(smoke: bool = False, serve: bool = False):
+    fac = DefaultTokenizerFactory(CommonPreprocessor())
+    w2v = Word2Vec(min_word_frequency=2, layer_size=16 if smoke else 64,
+                   window_size=3, epochs=1 if smoke else 5, seed=7,
+                   tokenizer_factory=fac)
+    w2v.fit(CollectionSentenceIterator(_SENTENCES))
+    print("nearest(king):", w2v.words_nearest("king", 4))
+    if serve:
+        srv = UiServer(InMemoryStatsStorage(), port=0,
+                       word_vectors=w2v).start()
+        print(f"nearest-words view: {srv.url}/words?word=king")
+        input("enter to stop...")
+        srv.stop()
+    return w2v
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--serve", action="store_true")
+    args = ap.parse_args()
+    main(smoke=args.smoke, serve=args.serve)

@@ -1,0 +1,37 @@
+"""Examples smoke tests: every shipped example must run end-to-end.
+
+The dl4j-examples role — these are the first thing a migrating user
+runs, so they are CI-gated in smoke mode (tiny shapes, CPU mesh).
+"""
+
+import numpy as np
+
+
+def test_lenet_example():
+    from examples.train_lenet_mnist import main
+    acc = main(smoke=True, report_path="/tmp/test_lenet_report.html")
+    assert 0.0 <= acc <= 1.0
+    assert open("/tmp/test_lenet_report.html").read().startswith("<!DOCTYPE")
+
+
+def test_char_rnn_example():
+    from examples.train_char_rnn import main
+    assert np.isfinite(main(smoke=True))
+
+
+def test_word2vec_example():
+    from examples.train_word2vec import main
+    w2v = main(smoke=True)
+    assert len(w2v.words_nearest("king", 3)) == 3
+
+
+def test_gpt_example_variants():
+    from examples.train_gpt import main
+    assert np.isfinite(main(smoke=True))
+    assert np.isfinite(main(smoke=True, num_experts=2))
+    assert np.isfinite(main(smoke=True, seq_parallel=True))
+
+
+def test_resnet_example():
+    from examples.train_resnet50 import main
+    assert np.isfinite(main(smoke=True))

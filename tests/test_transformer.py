@@ -83,6 +83,32 @@ def test_seq_mesh_equivalence(rng):
     np.testing.assert_allclose(ringed, full, rtol=2e-4, atol=1e-5)
 
 
+def test_moe_transformer_trains_and_gradchecks(rng):
+    """Mixtral wiring: TransformerBlock with routed expert MLPs."""
+    net = gpt(vocab_size=11, d_model=16, n_layers=2, num_heads=2,
+              max_len=16, compute_dtype="float32", learning_rate=0.01,
+              num_experts=4).init()
+    ds = _data(rng)
+    s0 = net.score(ds)
+    for _ in range(30):
+        net.fit(ds)
+    assert np.isfinite(net.score(ds)) and net.score(ds) < s0
+    # gradcheck a single MoE block over continuous input
+    conf = (NeuralNetConfiguration.builder().seed(9).learning_rate(0.1)
+            .updater("sgd").activation("identity").weight_init("xavier")
+            .list()
+            .layer(TransformerBlock(n_in=8, n_out=8, num_heads=2,
+                                    causal=True, num_experts=2,
+                                    capacity_factor=8.0))
+            .layer(RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .build())
+    blk = MultiLayerNetwork(conf).init()
+    x = (rng.standard_normal((2, 4, 8)) * 2.0).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, 4))]
+    assert check_gradients(blk, DataSet(x, y))
+
+
 def test_bf16_policy_keeps_ids_exact(rng):
     """Regression: the mixed-precision input cast must not touch token
     ids — bf16(257) rounds to 256, silently swapping embeddings (and

@@ -1,0 +1,100 @@
+"""ZeRO-1 / FSDP sharding equivalence tests.
+
+No reference counterpart (SURVEY §2.6 note 5); the oracle is replicated
+training — placement must not change the math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.mesh import MeshContext, make_mesh
+from deeplearning4j_tpu.parallel.zero import apply_fsdp, apply_zero1, fsdp_specs
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(17).learning_rate(0.05)
+            .updater("adam").activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16))
+            .layer(DenseLayer(n_in=16, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _steps(net, ctx, x, y, n=5):
+    step = net._get_jit("train", fm=False, lm=False)
+    xs, ys = ctx.shard_batch(x, y)
+    zero = jnp.zeros((), jnp.float32)
+    key = jax.random.PRNGKey(3)
+    for _ in range(n):
+        net.params, net.opt_state, net.states, score = step(
+            net.params, net.opt_state, net.states, xs, ys, zero, zero, key)
+    return float(score), jax.device_get(net.params)
+
+
+def _data(rng):
+    x = rng.standard_normal((32, 8)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+    return x, y
+
+
+@pytest.mark.parametrize("apply_fn", [apply_fsdp, apply_zero1])
+def test_sharded_training_matches_replicated(rng, apply_fn):
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh({"data": 8}, devices=devs[:8])
+    ctx = MeshContext(mesh)
+    x, y = _data(rng)
+
+    ref = _net()
+    score_ref, params_ref = _steps(ref, ctx, x, y)
+
+    net = _net()
+    apply_fn(net, mesh)
+    score_sh, params_sh = _steps(net, ctx, x, y)
+
+    assert score_sh == pytest.approx(score_ref, rel=1e-5)
+    for ln in params_ref:
+        for pn in params_ref[ln]:
+            np.testing.assert_allclose(params_sh[ln][pn], params_ref[ln][pn],
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_fsdp_specs_pick_divisible_dims(rng):
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh({"data": 8}, devices=devs[:8])
+    net = _net()
+    specs = fsdp_specs(net, mesh)
+    # 16-dim axes are divisible by 8; the [8,16] W shards its dim-1 (16)
+    assert specs["layer0"]["W"] == jax.sharding.PartitionSpec(None, "data")
+    assert specs["layer1"]["W"] in (jax.sharding.PartitionSpec("data", None),
+                                    jax.sharding.PartitionSpec(None, "data"))
+    # 4-dim bias of the output layer is indivisible -> absent (replicated)
+    assert "b" not in specs.get("layer2", {})
+
+
+def test_zero1_shards_only_optimizer_state(rng):
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh({"data": 8}, devices=devs[:8])
+    net = _net()
+    apply_zero1(net, mesh)
+    # params replicated
+    p_shard = net.params["layer0"]["W"].sharding
+    assert p_shard.is_fully_replicated
+    # adam moments sharded
+    m = net.opt_state["updater"]["layer0"]["W"]
+    leaf = jax.tree.leaves(m)[0]
+    assert not leaf.sharding.is_fully_replicated
