@@ -1,0 +1,50 @@
+"""Curves dataset: synthetic rasterized bezier curves.
+
+Parity: ``deeplearning4j-core/.../datasets/fetchers/CurvesDataFetcher.java``
+(SURVEY §2.3 row "Dataset fetchers") — the classic deep-autoencoder/DBN
+benchmark of 28x28 images of smooth curves. The reference downloads a
+frozen binary; a zero-egress TPU pod can't, so this fetcher GENERATES
+the same family deterministically: quadratic beziers from seeded random
+control points, rasterized by dense parameter sampling. Same shape
+contract ([n, 784] floats in [0, 1]), same role (unsupervised
+pretraining data for AE/RBM stacks); labels are the 6 control-point
+coordinates (a regression target, useful for supervised sanity checks).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+SIDE = 28
+
+
+def _raster_bezier(p0, p1, p2, side: int = SIDE) -> np.ndarray:
+    """Quadratic bezier through 3 control points in [0,1]² → [side, side]
+    grayscale with linear falloff around the stroke."""
+    t = np.linspace(0.0, 1.0, 4 * side)[:, None]
+    pts = ((1 - t) ** 2) * p0 + 2 * (1 - t) * t * p1 + (t ** 2) * p2  # [T, 2]
+    img = np.zeros((side, side), np.float32)
+    xy = np.clip((pts * (side - 1)).round().astype(int), 0, side - 1)
+    img[xy[:, 1], xy[:, 0]] = 1.0
+    # 1-pixel soft halo so gradients aren't bang-bang
+    halo = np.zeros_like(img)
+    halo[1:, :] += img[:-1, :] * 0.4
+    halo[:-1, :] += img[1:, :] * 0.4
+    halo[:, 1:] += img[:, :-1] * 0.4
+    halo[:, :-1] += img[:, 1:] * 0.4
+    return np.clip(img + halo, 0.0, 1.0)
+
+
+def load_curves(num_examples: int = 10000, seed: int = 123,
+                flat: bool = True) -> DataSet:
+    """[n, 784] (or [n, 28, 28, 1]) curve images; labels = the six
+    control-point coordinates in [0, 1]."""
+    rng = np.random.default_rng(seed)
+    ctrl = rng.random((num_examples, 3, 2)).astype(np.float32)
+    imgs = np.stack([_raster_bezier(c[0], c[1], c[2]) for c in ctrl])
+    features = imgs.reshape(num_examples, -1) if flat \
+        else imgs[..., None]
+    return DataSet(features.astype(np.float32),
+                   ctrl.reshape(num_examples, 6).astype(np.float32))

@@ -52,14 +52,30 @@ def _timed_batches(it: DataSetIterator, stats: Optional[TrainingStats]):
         yield ds
 
 
+class TrainingHook:
+    """Pre/post-step intercept seam (``spark/api/TrainingHook`` /
+    ``ParameterServerTrainingHook`` role): subclass and register via
+    ``ParallelWrapper(hooks=[...])`` to observe or stage work around
+    each distributed step — e.g. push params to an external parameter
+    server, record custom metrics, trigger snapshots."""
+
+    def pre_update(self, model, iteration: int) -> None:
+        pass
+
+    def post_update(self, model, iteration: int) -> None:
+        pass
+
+
 class ParallelWrapper:
     def __init__(self, model, mesh=None, workers: Optional[int] = None,
                  averaging_frequency: int = 1, mode: str = "allreduce",
-                 prefetch_buffer: int = 4, collect_stats: bool = False):
+                 prefetch_buffer: int = 4, collect_stats: bool = False,
+                 hooks: Optional[list] = None):
         """``workers`` defaults to the mesh ``data`` axis size (the
         reference defaulted to device count). ``collect_stats=True``
         records per-phase timings into ``self.stats``
-        (``setCollectTrainingStats`` / CommonSparkTrainingStats role)."""
+        (``setCollectTrainingStats`` / CommonSparkTrainingStats role).
+        ``hooks``: TrainingHook instances called around every step."""
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.ctx = MeshContext(self.mesh)
@@ -72,6 +88,7 @@ class ParallelWrapper:
             raise ValueError(mode)
         self.mode = mode
         self.prefetch_buffer = prefetch_buffer
+        self.hooks = list(hooks or [])
         self.stats: Optional[TrainingStats] = TrainingStats() if collect_stats else None
         self._vstep = None
         self._avg = None
@@ -104,11 +121,16 @@ class ParallelWrapper:
                     None if not fm else np.asarray(ds.features_mask, m._dtype),
                     None if not lm else np.asarray(ds.labels_mask, m._dtype))
             zero = jnp.zeros((), m._dtype)
+            it_num = int(m.opt_state["step"])
+            for h in self.hooks:
+                h.pre_update(m, it_num)
             with self._phase("step"):
                 m.params, m.opt_state, m.states, score = step(
                     m.params, m.opt_state, m.states, x, y,
                     fmask if fm else zero, lmask if lm else zero, rng_key)
                 m._score = float(score)  # score fetch = device sync
+            for h in self.hooks:
+                h.post_update(m, int(m.opt_state["step"]))
             for cb in m.listeners:
                 cb(m, int(m.opt_state["step"]), m._score)
 
@@ -171,10 +193,20 @@ class ParallelWrapper:
                 x = np.asarray(ds.features[:per * W], m._dtype).reshape((W, per) + ds.features.shape[1:])
                 y = np.asarray(ds.labels[:per * W], m._dtype).reshape((W, per) + ds.labels.shape[1:])
                 x, y = self.ctx.shard_batch(x, y)
+            for h in self.hooks:
+                h.pre_update(m, self._counter)
             with self._phase("step"):
                 wparams, wopt, wstates, scores = self._vstep(wparams, wopt, wstates, x, y, rng_key)
                 self._counter += 1
                 m._score = float(jnp.mean(scores))  # score fetch = device sync
+            if self.hooks:
+                # hooks must observe the CURRENT worker-mean params, not
+                # the stale pre-fit copy the wrapped model holds until
+                # the end-of-fit collapse (allreduce mode is always
+                # fresh; keep both modes' hook contract identical)
+                m.params = jax.tree.map(lambda v: jnp.mean(v, axis=0), wparams)
+                for h in self.hooks:
+                    h.post_update(m, self._counter)
             if self._counter % self.averaging_frequency == 0:
                 with self._phase("average"):
                     wparams, wopt = self._avg(wparams, wopt)

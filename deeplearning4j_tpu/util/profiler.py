@@ -1,0 +1,67 @@
+"""XLA/TPU profiler hooks.
+
+Parity: SURVEY §5 tracing — the reference has PerformanceListener +
+Spark phase timers + StatsListener telemetry (all rebuilt:
+``optimize/listeners.py``, ``optimize/training_stats.py``,
+``ui/stats.py``); the named TPU equivalent "XLA/TPU profiler traces"
+is this module: thin, dependency-tolerant wrappers over
+``jax.profiler`` producing TensorBoard-loadable traces of the real
+device timeline (compilation, fusion, HBM traffic — the layers Python
+timers can't see).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture a device trace for the enclosed block::
+
+        with profiler.trace("/tmp/jax-trace"):
+            net.fit_scan(ds, 512, epochs=1)
+        # then: tensorboard --logdir /tmp/jax-trace
+
+    No-ops (with a warning) when the backend can't trace.
+    """
+    import jax
+
+    try:
+        jax.profiler.start_trace(log_dir,
+                                 create_perfetto_link=create_perfetto_link)
+        started = True
+    except Exception as e:  # tunneled/experimental backends may refuse
+        import logging
+        logging.getLogger(__name__).warning("profiler trace unavailable: %s", e)
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+def start_server(port: int = 9999) -> Optional[object]:
+    """Start the on-demand profiling server (connect with TensorBoard's
+    capture-profile button). Returns the server or None if unsupported."""
+    import jax
+
+    try:
+        return jax.profiler.start_server(port)
+    except Exception as e:
+        import logging
+        logging.getLogger(__name__).warning("profiler server unavailable: %s", e)
+        return None
+
+
+def annotate(name: str):
+    """TraceAnnotation context manager: names a host-side region in the
+    captured timeline (StepTraceAnnotation role for custom phases)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -29,7 +29,7 @@ def main(smoke: bool = False, data_dir: str = None, batch: int = 32,
     if data_dir:
         from deeplearning4j_tpu.datavec.records import ImageRecordReader
         from deeplearning4j_tpu.datavec.iterator import RecordReaderDataSetIterator
-        reader = ImageRecordReader(data_dir, height=size, width=size)
+        reader = ImageRecordReader(height=size, width=size, root_dir=data_dir)
         it = RecordReaderDataSetIterator(reader, batch_size=batch)
         for _ in range(epochs):
             net.fit(it)
