@@ -199,17 +199,25 @@ class ParallelWrapper:
                 wparams, wopt, wstates, scores = self._vstep(wparams, wopt, wstates, x, y, rng_key)
                 self._counter += 1
                 m._score = float(jnp.mean(scores))  # score fetch = device sync
-            if self.hooks:
-                # hooks must observe the CURRENT worker-mean params, not
-                # the stale pre-fit copy the wrapped model holds until
-                # the end-of-fit collapse (allreduce mode is always
-                # fresh; keep both modes' hook contract identical)
-                m.params = jax.tree.map(lambda v: jnp.mean(v, axis=0), wparams)
-                for h in self.hooks:
-                    h.post_update(m, self._counter)
-            if self._counter % self.averaging_frequency == 0:
+            did_avg = self._counter % self.averaging_frequency == 0
+            if did_avg:
                 with self._phase("average"):
                     wparams, wopt = self._avg(wparams, wopt)
+            if self.hooks or m.listeners:
+                # observers (hooks AND listeners) must see the CURRENT
+                # worker-mean model — params, opt_state, and states —
+                # not the stale pre-fit copy the wrapped model holds
+                # until the end-of-fit collapse; allreduce mode is
+                # always fresh, keep the contracts identical. Reuse the
+                # just-averaged tree when this was an averaging step.
+                take0 = lambda t: jax.tree.map(lambda v: v[0], t)
+                avg0 = lambda t: jax.tree.map(lambda v: jnp.mean(v, axis=0), t)
+                m.params = take0(wparams) if did_avg else avg0(wparams)
+                m.opt_state = take0(wopt) if did_avg else \
+                    {"step": wopt["step"][0], "updater": avg0(wopt["updater"])}
+                m.states = avg0(wstates)
+            for h in self.hooks:
+                h.post_update(m, self._counter)
             for cb in m.listeners:
                 cb(m, self._counter, m._score)
         # final average + collapse back onto the wrapped model (:121);

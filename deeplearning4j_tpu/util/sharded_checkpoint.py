@@ -1,0 +1,108 @@
+"""Sharded (tensorstore-backed) distributed checkpointing via orbax.
+
+Parity: SURVEY §5 checkpoint/resume — the reference's three-part zip
+(``ModelSerializer.java:78-120``: config JSON + flat params + updater
+state) is rebuilt host-side in ``model_serializer.py``; this module is
+the named TPU equivalent: "the same three-part logical checkpoint in a
+tensorstore-style sharded format". Each device writes its own parameter
+shards (no host gather of the full model — mandatory once params are
+FSDP/TP-sharded past host memory), and restore re-places arrays under
+ANY topology: the checkpoint is placement-free, shardings come from the
+live model at restore time.
+
+Layout: ``<dir>/state`` (orbax PyTree of params/opt_state/states) +
+``<dir>/configuration.json`` (same payload the zip format uses, so the
+model can be rebuilt from the checkpoint alone).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(model, directory: str) -> str:
+    """Write config + params + updater state + layer states, sharded."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    if isinstance(model, MultiLayerNetwork):
+        model_type = "MultiLayerNetwork"
+    elif isinstance(model, ComputationGraph):
+        model_type = "ComputationGraph"
+    else:
+        raise TypeError(type(model))
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    state = {"params": model.params, "opt_state": model.opt_state,
+             "states": model.states}
+    _checkpointer().save(os.path.join(directory, "state"), state, force=True)
+    payload = {"model_type": model_type,
+               "conf": json.loads(model.conf.to_json())}
+    with open(os.path.join(directory, "configuration.json"), "w") as f:
+        json.dump(payload, f, indent=2)
+    return directory
+
+
+def restore_checkpoint(directory: str, model=None, shardings=None):
+    """Restore a checkpoint.
+
+    ``model=None`` rebuilds the network from the stored config (restore
+    on a fresh process). ``shardings``: optional pytree-prefix of
+    ``jax.sharding.Sharding`` to place params under (e.g. from
+    ``fsdp_specs``); default keeps the restoring model's current
+    placements when it has any, else single-device default placement —
+    the checkpoint itself is topology-free.
+    """
+    directory = os.path.abspath(directory)
+    if model is None:
+        with open(os.path.join(directory, "configuration.json")) as f:
+            payload = json.load(f)
+        from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf_json = json.dumps(payload["conf"])
+        if payload["model_type"] == "MultiLayerNetwork":
+            model = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
+        else:
+            model = ComputationGraph(ComputationGraphConfiguration.from_json(conf_json))
+        model.init()
+
+    # read arrays as host numpy: restore is then valid on ANY topology
+    # (orbax's default re-applies the SAVED shardings, which fails when
+    # the saving devices aren't all present)
+    import numpy as _np
+    import orbax.checkpoint as ocp
+
+    template = {"params": model.params, "opt_state": model.opt_state,
+                "states": model.states}
+    restore_args = jax.tree.map(
+        lambda _: ocp.RestoreArgs(restore_type=_np.ndarray), template)
+    restored = _checkpointer().restore(os.path.join(directory, "state"),
+                                       restore_args=restore_args)
+
+    def _placed(new, old):
+        return jax.tree.map(
+            lambda n, o: jax.device_put(
+                n, o.sharding if hasattr(o, "sharding") else None), new, old)
+
+    if shardings is not None:
+        model.params = jax.tree.map(
+            lambda n, s: jax.device_put(n, s), restored["params"], shardings)
+    else:
+        model.params = _placed(restored["params"], model.params)
+    model.opt_state = _placed(restored["opt_state"], model.opt_state)
+    model.states = _placed(restored["states"], model.states)
+    model._jits = {}
+    return model

@@ -84,6 +84,22 @@ def test_training_hooks_see_fresh_params_in_averaging_mode(rng):
     assert seen and np.abs(seen[-1] - before).max() > 1e-7
 
 
+def test_listeners_see_fresh_params_in_averaging_mode(rng, tmp_path):
+    """Listeners too — without any hook registered (regression: the
+    refresh was gated on hooks)."""
+    net, ds = _net_and_data(rng)
+    path = str(tmp_path / "avg_pg.tsv")
+    net.set_listeners(ParamAndGradientIterationListener(path=path))
+    pw = ParallelWrapper(net, mode="averaging")
+    for _ in range(3):
+        pw.fit(ds)
+    lines = open(path).read().strip().split("\n")
+    header = lines[0].split("\t")
+    col = header.index("layer0/W:upd")
+    upds = [float(line.split("\t")[col]) for line in lines[2:]]
+    assert any(u > 1e-9 for u in upds), f"stale params: updates {upds}"
+
+
 def test_profiler_trace_tolerates_backend(tmp_path, rng):
     """trace() must run the body exactly once whether or not the
     backend supports tracing."""
