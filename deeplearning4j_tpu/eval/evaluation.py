@@ -62,18 +62,27 @@ class Evaluation:
              meta: Optional[Sequence[Any]] = None):
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
-        if labels.ndim == 3:  # [b,t,c] time series -> flatten with mask
-            b, t = labels.shape[:2]
-            if mask is not None:
-                keep = np.asarray(mask).reshape(-1) > 0
-            else:
-                keep = np.ones(b * t, bool)
-            labels = labels.reshape(-1, labels.shape[-1])[keep]
+        # sparse integer-id labels (ops/losses.py convention): one dim
+        # fewer than predictions; negative ids = ignore-index
+        sparse = labels.ndim == predictions.ndim - 1
+        if predictions.ndim == 3:  # [b,t,c] time series -> flatten w/ mask
+            b, t = predictions.shape[:2]
+            keep = (np.asarray(mask).reshape(-1) > 0) if mask is not None \
+                else np.ones(b * t, bool)
             predictions = predictions.reshape(-1, predictions.shape[-1])[keep]
+            labels = (labels.reshape(-1)[keep] if sparse
+                      else labels.reshape(-1, labels.shape[-1])[keep])
             if meta is not None:  # per-example meta -> per-kept-timestep
                 meta = np.repeat(np.asarray(meta, dtype=object), t)[keep]
-        self._ensure(labels.shape[-1])
-        actual = np.argmax(labels, axis=-1)
+        self._ensure(predictions.shape[-1])
+        if sparse:
+            actual = labels.astype(np.int64)
+            valid = actual >= 0
+            actual, predictions = actual[valid], predictions[valid]
+            if meta is not None:
+                meta = np.asarray(meta, dtype=object)[valid]
+        else:
+            actual = np.argmax(labels, axis=-1)
         pred = np.argmax(predictions, axis=-1)
         self.confusion.add_batch(actual, pred)
         if meta is not None:

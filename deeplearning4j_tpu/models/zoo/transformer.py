@@ -27,10 +27,12 @@ def gpt(vocab_size: int = 50257, d_model: int = 512, n_layers: int = 8,
         compute_dtype: str = "bfloat16", num_experts: int = 0,
         capacity_factor: float = 1.25, aux_loss_weight: float = 0.01,
         seed: int = 0) -> MultiLayerNetwork:
-    """Decoder-only LM over int token ids [b, t]; labels one-hot
-    [b, t, vocab] (next-token targets). ``num_experts > 0`` swaps the
-    dense MLPs for Mixtral-style top-1 routed experts
-    (capacity_factor/aux_loss_weight tune the routing)."""
+    """Decoder-only LM over int token ids [b, t]; labels are SPARSE
+    next-token ids [b, t] (ops/losses.py gathers target log-probs — no
+    [b, t, vocab] one-hot; negative ids are ignored). One-hot labels
+    also work. ``num_experts > 0`` swaps the dense MLPs for
+    Mixtral-style top-1 routed experts (capacity_factor/aux_loss_weight
+    tune the routing)."""
     b = (NeuralNetConfiguration.builder()
          .seed(seed).learning_rate(learning_rate).updater("adam")
          .activation("identity").weight_init("xavier")
@@ -76,7 +78,8 @@ def gpt_benchmark(peak_flops: float, vocab_size: int = 8192,
     rng = np.random.default_rng(0)
     ids = rng.integers(0, vocab_size, (batch * steps, seq_len))
     x = ids.astype(np.float32)
-    y = np.eye(vocab_size, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    # sparse int labels: no [n, t, vocab] one-hot staging (ops/losses.py)
+    y = np.roll(ids, -1, axis=1).astype(np.float32)
     data = DataSet(x, y)
 
     staged = net.stage_scan(data, batch)

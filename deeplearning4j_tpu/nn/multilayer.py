@@ -323,11 +323,13 @@ class MultiLayerNetwork:
         T = ds.features.shape[1]
         L = self.conf.tbptt_fwd_length
         b = ds.features.shape[0]
-        if ds.labels.ndim != 3:
+        per_timestep = ds.labels.ndim == 3 or (
+            ds.labels.ndim == 2 and ds.labels.shape == (b, T))  # sparse ids
+        if not per_timestep:
             raise ValueError(
-                f"TBPTT requires per-timestep labels [batch, T, nOut]; got "
-                f"shape {ds.labels.shape}. For sequence-level (2-D) labels "
-                f"use standard BPTT (backprop_type='standard').")
+                f"TBPTT requires per-timestep labels [batch, T, nOut] (or "
+                f"sparse int ids [batch, T]); got shape {ds.labels.shape}. "
+                f"For sequence-level labels use backprop_type='standard'.")
         rec = self._recurrent_impls()
         if not rec:
             raise ValueError("TBPTT configured but no recurrent layers present")

@@ -115,7 +115,34 @@ def compute_loss(
     reproduce that behavior when matching reference configs exactly.
     """
     f = LossFunction(name)
-    if from_logits and f in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
+    sparse = labels.ndim == predictions.ndim - 1
+    if sparse and f not in (LossFunction.MCXENT,
+                            LossFunction.NEGATIVELOGLIKELIHOOD):
+        raise ValueError(
+            f"sparse integer labels (shape {labels.shape} vs predictions "
+            f"{predictions.shape}) are only supported for mcxent/nll")
+    if sparse:
+        # integer class-id labels: gather the target log-prob instead of
+        # materializing one-hots — for a [b, t] LM batch over vocab V
+        # this removes the [b, t, V] label tensor entirely (HBM traffic
+        # and host->device staging shrink by a factor of V).
+        # Contract: ids must be in [0, V); NEGATIVE ids are the
+        # ignore-index convention — zero loss, excluded from the mean.
+        # (ids >= V clamp silently under jit, unlike the one-hot path —
+        # data validation belongs host-side.)
+        ids = labels.astype(jnp.int32)
+        ignore = ids < 0
+        ids = jnp.clip(ids, 0, None)[..., None]
+        if from_logits:
+            logp = jax.nn.log_softmax(predictions, axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(predictions, _EPS, 1.0))
+        per_ex = -jnp.take_along_axis(logp, ids, axis=-1)[..., 0]
+        if mask is None:
+            mask = (~ignore).astype(per_ex.dtype)
+        else:
+            mask = mask.astype(per_ex.dtype) * (~ignore).astype(per_ex.dtype)
+    elif from_logits and f in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
         logp = jax.nn.log_softmax(predictions, axis=-1)
         per_ex = -jnp.sum(labels * logp, axis=-1)
     elif from_logits and f is LossFunction.XENT:

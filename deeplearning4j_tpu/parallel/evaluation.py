@@ -34,12 +34,19 @@ def _counts_program(model):
     def counts(params, states, x, labels, valid):
         acts, _ = model._forward(params, states, x, False, None, None)
         preds = acts[-1]
-        c = labels.shape[-1]
+        c = preds.shape[-1]
+        sparse = labels.ndim == preds.ndim - 1  # int-id labels
         if preds.ndim == 3:  # time series: fold time into batch
             preds = preds.reshape(-1, c)
-            labels = labels.reshape(-1, c)
+            labels = labels.reshape(-1) if sparse else labels.reshape(-1, c)
             valid = valid.reshape(-1)
-        actual = jax.nn.one_hot(jnp.argmax(labels, -1), c, dtype=jnp.float32)
+        if sparse:
+            ids = labels.astype(jnp.int32)
+            valid = valid * (ids >= 0)  # ignore-index convention
+            actual_ids = jnp.clip(ids, 0, None)
+        else:
+            actual_ids = jnp.argmax(labels, -1)
+        actual = jax.nn.one_hot(actual_ids, c, dtype=jnp.float32)
         pred = jax.nn.one_hot(jnp.argmax(preds, -1), c, dtype=jnp.float32)
         actual = actual * valid[:, None]
         # [C, C] = actualᵀ @ pred — counts[i, j] = #(actual i, predicted j)
@@ -58,12 +65,15 @@ def _batches(data: Union[DataSet, DataSetIterator],
 
 def _flatten_with_valid(ds: DataSet):
     """(x, y, valid) with time folded later device-side; valid is the
-    per-row (or per-timestep) label weight."""
+    per-row (or per-timestep) label weight. Sparse per-timestep int
+    labels ([b, t] with [b, t, ...] features) count as time series."""
     x = np.asarray(ds.features, np.float32)
     y = np.asarray(ds.labels, np.float32)
-    if y.ndim == 3 and ds.labels_mask is not None:
+    time_series = y.ndim == 3 or (
+        y.ndim == 2 and x.ndim >= 3 and y.shape == x.shape[:2])
+    if time_series and ds.labels_mask is not None:
         valid = np.asarray(ds.labels_mask, np.float32)
-    elif y.ndim == 3:
+    elif time_series:
         valid = np.ones(y.shape[:2], np.float32)
     else:
         valid = np.ones((y.shape[0],), np.float32)

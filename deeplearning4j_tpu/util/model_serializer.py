@@ -61,8 +61,9 @@ def _npz_tree(data: bytes) -> Dict[str, Any]:
         return _unflatten({k: z[k] for k in z.files})
 
 
-def write_model(model, path: str, save_updater: bool = True) -> None:
-    """``ModelSerializer.writeModel`` equivalent."""
+def config_payload(model) -> dict:
+    """{"model_type", "conf"} JSON payload shared by the zip format and
+    the sharded orbax format (``sharded_checkpoint.py``)."""
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -72,8 +73,24 @@ def write_model(model, path: str, save_updater: bool = True) -> None:
         model_type = "ComputationGraph"
     else:
         raise TypeError(type(model))
-    conf = json.loads(model.conf.to_json())
-    payload = {"model_type": model_type, "conf": conf}
+    return {"model_type": model_type, "conf": json.loads(model.conf.to_json())}
+
+
+def model_from_payload(payload: dict):
+    """Rebuild an UNinitialized model from a ``config_payload`` dict."""
+    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf_json = json.dumps(payload["conf"])
+    if payload["model_type"] == "MultiLayerNetwork":
+        return MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
+    return ComputationGraph(ComputationGraphConfiguration.from_json(conf_json))
+
+
+def write_model(model, path: str, save_updater: bool = True) -> None:
+    """``ModelSerializer.writeModel`` equivalent."""
+    payload = config_payload(model)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr("configuration.json", json.dumps(payload, indent=2))
         z.writestr("coefficients.npz", _npz_bytes(model.params))
@@ -96,21 +113,12 @@ def restore_model(path: str, load_updater: bool = True):
 
 
 def _restore(path: str, expect: Union[str, None], load_updater: bool):
-    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
-    from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
-    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-
     with zipfile.ZipFile(path) as z:
         payload = json.loads(z.read("configuration.json"))
         model_type = payload["model_type"]
         if expect and model_type != expect:
             raise ValueError(f"checkpoint is a {model_type}, expected {expect}")
-        conf_json = json.dumps(payload["conf"])
-        if model_type == "MultiLayerNetwork":
-            model = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
-        else:
-            model = ComputationGraph(ComputationGraphConfiguration.from_json(conf_json))
-        model.init()
+        model = model_from_payload(payload).init()
         # merge stored arrays into the freshly-initialized structure: layers
         # without params (pooling, activation, ...) serialize as nothing, so
         # a plain tree_map over both trees would see mismatched keys

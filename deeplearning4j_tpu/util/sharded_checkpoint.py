@@ -32,24 +32,15 @@ def _checkpointer():
 
 def save_checkpoint(model, directory: str) -> str:
     """Write config + params + updater state + layer states, sharded."""
-    from deeplearning4j_tpu.nn.graph import ComputationGraph
-    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.util.model_serializer import config_payload
 
-    if isinstance(model, MultiLayerNetwork):
-        model_type = "MultiLayerNetwork"
-    elif isinstance(model, ComputationGraph):
-        model_type = "ComputationGraph"
-    else:
-        raise TypeError(type(model))
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
     state = {"params": model.params, "opt_state": model.opt_state,
              "states": model.states}
     _checkpointer().save(os.path.join(directory, "state"), state, force=True)
-    payload = {"model_type": model_type,
-               "conf": json.loads(model.conf.to_json())}
     with open(os.path.join(directory, "configuration.json"), "w") as f:
-        json.dump(payload, f, indent=2)
+        json.dump(config_payload(model), f, indent=2)
     return directory
 
 
@@ -67,42 +58,36 @@ def restore_checkpoint(directory: str, model=None, shardings=None):
     if model is None:
         with open(os.path.join(directory, "configuration.json")) as f:
             payload = json.load(f)
-        from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
-        from deeplearning4j_tpu.nn.graph import (
-            ComputationGraph, ComputationGraphConfiguration)
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.util.model_serializer import model_from_payload
 
-        conf_json = json.dumps(payload["conf"])
-        if payload["model_type"] == "MultiLayerNetwork":
-            model = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
-        else:
-            model = ComputationGraph(ComputationGraphConfiguration.from_json(conf_json))
-        model.init()
+        model = model_from_payload(payload).init()
 
-    # read arrays as host numpy: restore is then valid on ANY topology
-    # (orbax's default re-applies the SAVED shardings, which fails when
-    # the saving devices aren't all present)
+    # restore each leaf DIRECTLY under its target placement
+    # (ArrayRestoreArgs): each process/device reads only its own shards
+    # — no full-array host materialization, so models sharded past host
+    # memory restore, and the SAVING topology is irrelevant. Leaves
+    # without a target sharding (fresh CPU model) come back as numpy.
     import numpy as _np
     import orbax.checkpoint as ocp
 
     template = {"params": model.params, "opt_state": model.opt_state,
                 "states": model.states}
-    restore_args = jax.tree.map(
-        lambda _: ocp.RestoreArgs(restore_type=_np.ndarray), template)
+    if shardings is not None:
+        template = dict(template)
+        template["params"] = shardings
+
+    def _arg(leaf):
+        if hasattr(leaf, "sharding"):  # live jax.Array target
+            return ocp.ArrayRestoreArgs(sharding=leaf.sharding)
+        if isinstance(leaf, jax.sharding.Sharding):  # explicit spec
+            return ocp.ArrayRestoreArgs(sharding=leaf)
+        return ocp.RestoreArgs(restore_type=_np.ndarray)
+
+    restore_args = jax.tree.map(_arg, template)
     restored = _checkpointer().restore(os.path.join(directory, "state"),
                                        restore_args=restore_args)
-
-    def _placed(new, old):
-        return jax.tree.map(
-            lambda n, o: jax.device_put(
-                n, o.sharding if hasattr(o, "sharding") else None), new, old)
-
-    if shardings is not None:
-        model.params = jax.tree.map(
-            lambda n, s: jax.device_put(n, s), restored["params"], shardings)
-    else:
-        model.params = _placed(restored["params"], model.params)
-    model.opt_state = _placed(restored["opt_state"], model.opt_state)
-    model.states = _placed(restored["states"], model.states)
+    model.params = restored["params"]
+    model.opt_state = restored["opt_state"]
+    model.states = restored["states"]
     model._jits = {}
     return model

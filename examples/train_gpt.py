@@ -23,7 +23,8 @@ def main(smoke: bool = False, num_experts: int = 0, seq_parallel: bool = False):
     seq, d, layers, epochs = (32, 32, 2, 1) if smoke else (256, 256, 4, 8)
     n = (len(data_ids) - 1) // seq * seq
     x = data_ids[:n].reshape(-1, seq).astype(np.float32)
-    y = np.eye(vocab, dtype=np.float32)[data_ids[1:n + 1].reshape(-1, seq)]
+    # sparse int labels — no [n, seq, vocab] one-hot materialization
+    y = data_ids[1:n + 1].reshape(-1, seq).astype(np.float32)
     ds = DataSet(x, y)
 
     net = gpt(vocab_size=vocab, d_model=d, n_layers=layers,

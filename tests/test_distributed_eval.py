@@ -142,3 +142,30 @@ def test_time_series_with_mask(rng):
     dist = evaluate_sharded(net, ds)
     np.testing.assert_array_equal(dist.confusion.counts, host.confusion.counts)
     assert dist.confusion.counts.sum() == int(lmask.sum())
+
+
+def test_sparse_labels_match_onehot_eval(rng):
+    """Sparse int-id labels give the same confusion counts as one-hot —
+    host Evaluation and mesh-sharded eval, incl. ignore-index."""
+    net = _ff_net()
+    x = rng.standard_normal((24, 6)).astype(np.float32)
+    ids = rng.integers(0, 3, 24)
+    onehot = np.eye(3, dtype=np.float32)[ids]
+    sparse = ids.astype(np.float32)
+    preds = net.output(x)
+
+    host_a = Evaluation(); host_a.eval(onehot, preds)
+    host_b = Evaluation(); host_b.eval(sparse, preds)
+    np.testing.assert_array_equal(host_a.confusion.counts,
+                                  host_b.confusion.counts)
+
+    dist = evaluate_sharded(net, DataSet(x, sparse))
+    np.testing.assert_array_equal(dist.confusion.counts,
+                                  host_a.confusion.counts)
+    # ignore-index rows drop out of the counts
+    sparse_ig = sparse.copy(); sparse_ig[:5] = -1.0
+    host_c = Evaluation(); host_c.eval(sparse_ig, preds)
+    assert host_c.confusion.counts.sum() == 19
+    dist_ig = evaluate_sharded(net, DataSet(x, sparse_ig))
+    np.testing.assert_array_equal(dist_ig.confusion.counts,
+                                  host_c.confusion.counts)

@@ -117,3 +117,52 @@ class TestWeightInit:
         w1 = init_weights(k, (4, 4), "xavier", 4, 4)
         w2 = init_weights(k, (4, 4), "xavier", 4, 4)
         np.testing.assert_array_equal(w1, w2)
+
+
+def test_sparse_mcxent_matches_onehot(rng):
+    """Integer-id labels == one-hot labels for mcxent/nll, logits and
+    probability paths, 2-D and 3-D, masked and not."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.losses import compute_loss
+
+    b, t, c = 4, 5, 7
+    logits = jnp.asarray(rng.standard_normal((b, t, c)), jnp.float32)
+    ids = rng.integers(0, c, (b, t))
+    onehot = jnp.asarray(np.eye(c, dtype=np.float32)[ids])
+    sparse = jnp.asarray(ids, jnp.float32)
+    mask = jnp.asarray((rng.random((b, t)) > 0.4), jnp.float32)
+    for from_logits in (True, False):
+        preds = logits if from_logits else jax.nn.softmax(logits, axis=-1)
+        for m in (None, mask):
+            a = compute_loss("mcxent", onehot, preds, mask=m,
+                             from_logits=from_logits)
+            s = compute_loss("mcxent", sparse, preds, mask=m,
+                             from_logits=from_logits)
+            np.testing.assert_allclose(np.asarray(s), np.asarray(a),
+                                       rtol=1e-6, atol=1e-7)
+    # 2-D case
+    a2 = compute_loss("negativeloglikelihood", onehot[:, 0], logits[:, 0],
+                      from_logits=True)
+    s2 = compute_loss("negativeloglikelihood", sparse[:, 0], logits[:, 0],
+                      from_logits=True)
+    np.testing.assert_allclose(np.asarray(s2), np.asarray(a2), rtol=1e-6)
+    # sparse labels reject non-xent losses loudly
+    import pytest
+    with pytest.raises(ValueError, match="sparse"):
+        compute_loss("mse", sparse, logits)
+
+
+def test_sparse_mcxent_ignore_index(rng):
+    """Negative ids contribute zero loss and are excluded from the mean
+    (the ignore-index convention)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.losses import compute_loss
+
+    logits = jnp.asarray(rng.standard_normal((6, 5)), jnp.float32)
+    ids = rng.integers(0, 5, 6)
+    sparse = jnp.asarray(ids, jnp.float32)
+    ignored = sparse.at[2].set(-1.0).at[4].set(-1.0)
+    keep = jnp.asarray([1, 1, 0, 1, 0, 1], jnp.float32)
+    want = compute_loss("mcxent", sparse, logits, mask=keep, from_logits=True)
+    got = compute_loss("mcxent", ignored, logits, from_logits=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
