@@ -54,6 +54,92 @@ def gpt(vocab_size: int = 50257, d_model: int = 512, n_layers: int = 8,
     return MultiLayerNetwork(conf)
 
 
+def generate(net: MultiLayerNetwork, prompt_ids: np.ndarray,
+             max_new_tokens: int, temperature: float = 0.0,
+             seed: int = 0) -> np.ndarray:
+    """Autoregressive decoding with per-block KV caches — the
+    transformer analog of the stateful ``rnnTimeStep`` path
+    (``MultiLayerNetwork.java:1233`` role): ONE jitted single-token
+    program (fixed shapes, no per-step recompiles), O(t) attention per
+    token instead of the O(t²) full-window forward.
+
+    ``prompt_ids``: [b, t0] int tokens; returns [b, t0 + max_new_tokens].
+    ``temperature`` 0 = greedy, else softmax sampling.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.util.dtypes import cast_floats
+
+    emb = net.impls[0]
+    blocks = net.impls[1:-1]
+    head = net.impls[-1]
+    prompt_ids = np.asarray(prompt_ids, np.int64)
+    b, t0 = prompt_ids.shape
+    total = t0 + max_new_tokens
+    max_len = emb.conf.max_len
+    if total > max_len:
+        raise ValueError(f"prompt {t0} + {max_new_tokens} new tokens "
+                         f"exceeds max_len {max_len}")
+    cd = net._cd
+    cache_dtype = cd if cd is not None else jnp.float32
+    # caches sized to the actual generation length, not max_len: each
+    # step's attention then runs over `total` slots (true O(t)/token)
+    caches = [blk.init_cache(b, total, cache_dtype) for blk in blocks]
+
+    def step(params, caches, tok, pos):
+        p_emb = params[emb.name]
+        if cd is not None:
+            p_emb = cast_floats(p_emb, cd)
+        x = jnp.take(p_emb["W"], tok, axis=0) \
+            + jax.lax.dynamic_index_in_dim(p_emb["P"], pos, 0, keepdims=False)
+        new_caches = []
+        for blk, cache in zip(blocks, caches):
+            p = params[blk.name]
+            if cd is not None:
+                p = cast_floats(p, cd)
+            x, cache = blk.decode_step(p, x, cache, pos)
+            new_caches.append(cache)
+        logits = head.preout(params[head.name], x.astype(jnp.float32))
+        return logits, new_caches
+
+    # the WHOLE decode loop runs device-side as one lax.scan — one
+    # dispatch for the entire generation (a host loop pays a tunnel
+    # round-trip + cache copy per token; measured ~250ms/step vs
+    # milliseconds here), sampling included
+    def decode(params, caches, out0, key):
+        def body(carry, pos):
+            caches, out = carry
+            tok = jax.lax.dynamic_index_in_dim(out, pos, 1, keepdims=False)
+            logits, caches = step(params, caches, tok, pos)
+            if temperature <= 0.0:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(key, pos),
+                    logits / temperature, axis=-1).astype(jnp.int32)
+            # keep prompt tokens during prefill; write samples after
+            cur = jax.lax.dynamic_index_in_dim(out, pos + 1, 1, keepdims=False)
+            nxt = jnp.where(pos + 1 < t0, cur, nxt)
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, nxt[:, None], pos + 1, axis=1)
+            return (caches, out), None
+
+        (caches, out), _ = jax.lax.scan(
+            body, (caches, out0), jnp.arange(total - 1))
+        return out
+
+    out0 = jnp.zeros((b, total), jnp.int32)
+    out0 = out0.at[:, :t0].set(prompt_ids.astype(np.int32))
+    # cache the compiled decode on the model: repeat generate() calls
+    # with the same shapes/temperature reuse the executable
+    key = ("gpt_generate", b, t0, total, float(temperature))
+    if key not in net._jits:
+        net._jits[key] = jax.jit(decode)
+    out = net._jits[key](net.params, caches, out0, jax.random.PRNGKey(seed))
+    return np.asarray(out, np.int64)
+
+
 def gpt_train_flops_per_token(vocab_size: int, d_model: int, n_layers: int,
                               seq_len: int, ffn_mult: int = 4) -> float:
     """Per-token train FLOPs ≈ 6 * (params-ish MACs) + attention term."""

@@ -121,16 +121,10 @@ class TransformerBlockImpl(LayerImpl):
         x = x + attn
 
         h2 = _layer_norm(x, params["ln2_g"], params["ln2_b"])
-        new_state = state
-        if c.num_experts > 0:  # routed expert MLP (Mixtral wiring)
-            mlp2, new_state = run_moe_ffn(
-                params, h2.reshape(-1, d), c.capacity_factor,
-                c.aux_loss_weight, mask=mask)
-            mlp = mlp2.reshape(b, t, d)
-        else:
-            mlp = jax.nn.gelu(h2 @ params["W1"].astype(x.dtype)
-                              + params["b1"].astype(x.dtype))
-            mlp = mlp @ params["W2"].astype(x.dtype) + params["b2"].astype(x.dtype)
+        mlp, new_state = self._ffn(params, h2.reshape(-1, d), state,
+                                   mask=mask,
+                                   capacity_factor=c.capacity_factor)
+        mlp = mlp.reshape(b, t, d)
         if train and self.dropout_rate > 0.0 and rng is not None:
             mlp = apply_dropout(mlp, self.dropout_rate,
                                 jax.random.fold_in(rng, 2))
@@ -138,3 +132,63 @@ class TransformerBlockImpl(LayerImpl):
         if mask is not None:
             out = out * mask[:, :, None].astype(out.dtype)
         return out, new_state
+
+    def _ffn(self, params, h2, state, mask=None, capacity_factor=None):
+        """Post-LN2 feed-forward over flattened tokens [n, d]: dense
+        GELU MLP or routed experts — the ONE implementation both
+        ``forward`` and ``decode_step`` use."""
+        c = self.conf
+        if c.num_experts > 0:
+            return run_moe_ffn(params, h2, capacity_factor,
+                               c.aux_loss_weight, mask=mask)
+        mlp = jax.nn.gelu(h2 @ params["W1"].astype(h2.dtype)
+                          + params["b1"].astype(h2.dtype))
+        mlp = mlp @ params["W2"].astype(h2.dtype) \
+            + params["b2"].astype(h2.dtype)
+        return mlp, state
+
+    # ------------------------------------------- incremental decoding
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        """KV cache for autoregressive decoding (the transformer analog
+        of ``BaseRecurrentLayer`` stateMap / ``rnnTimeStep``)."""
+        c = self.conf
+        h, hd = c.num_heads, c.n_out // c.num_heads
+        shape = (batch, max_len, h, hd)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def decode_step(self, params, x_t, cache, pos):
+        """One-token forward [b, d] with cached keys/values; ``pos`` is
+        the (traced) current position. Returns (y_t [b, d], new cache).
+        Dense blocks match ``forward`` exactly at every prefix position
+        (tested); MoE blocks route NO-DROP at decode time (capacity =
+        batch) — the training-time capacity heuristic over b*t tokens
+        has no stepwise equivalent, and dropping tokens at inference is
+        never what serving wants."""
+        c = self.conf
+        b, d = x_t.shape
+        h_count, hd = c.num_heads, c.n_out // c.num_heads
+        h = _layer_norm(x_t, params["ln1_g"], params["ln1_b"])
+        qkv = h @ params["Wqkv"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = lambda z: z.reshape(b, h_count, hd)
+        q, k, v = shape(q), shape(k), shape(v)
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            cache["k"], k[:, None].astype(cache["k"].dtype), pos, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            cache["v"], v[:, None].astype(cache["v"].dtype), pos, axis=1)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, q.dtype))
+        s = jnp.einsum("bhd,bkhd->bhk", q, ck.astype(q.dtype)) * scale
+        # causal: only positions <= pos are live
+        live = jnp.arange(ck.shape[1]) <= pos
+        s = jnp.where(live[None, None, :], s,
+                      jnp.asarray(jnp.finfo(s.dtype).min, s.dtype))
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhk,bkhd->bhd", w, cv.astype(q.dtype))
+        x_t = x_t + o.reshape(b, d) @ params["Wo"].astype(x_t.dtype)
+
+        h2 = _layer_norm(x_t, params["ln2_g"], params["ln2_b"])
+        # no-drop capacity: capacity = ceil(cf*b/E) >= b when cf = E
+        mlp, _ = self._ffn(params, h2, {},
+                           capacity_factor=float(max(1, c.num_experts)))
+        return x_t + mlp, {"k": ck, "v": cv}

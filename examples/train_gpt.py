@@ -45,17 +45,11 @@ def main(smoke: bool = False, num_experts: int = 0, seq_parallel: bool = False):
     print(f"final score {scores[-1]:.4f} "
           f"(experts={num_experts}, sp={seq_parallel})")
 
-    # greedy continuation in a FIXED-length window (right-padded zeros;
-    # causal attention keeps pads from leaking into the read position),
-    # so the jitted forward compiles exactly once
-    out = list(np.frombuffer(b"the quick", np.uint8).astype(int))
-    buf = np.zeros((1, seq), np.float32)
-    for _ in range(30 if not smoke else 8):
-        window = out[-seq:]
-        buf[0, :len(window)] = window
-        logits = net.output(buf)
-        out.append(int(np.argmax(logits[0, len(window) - 1])))
-    print("sample:", bytes(out).decode(errors="replace"))
+    # KV-cached greedy decoding: one jitted single-token program
+    from deeplearning4j_tpu.models.zoo.transformer import generate
+    prompt = np.frombuffer(b"the quick", np.uint8)[None].astype(np.int64)
+    out = generate(net, prompt, max_new_tokens=30 if not smoke else 8)
+    print("sample:", bytes(out[0].tolist()).decode(errors="replace"))
     return float(scores[-1])
 
 
