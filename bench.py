@@ -176,6 +176,74 @@ def bench_flash_attention():
             "vs_baseline": round((flops / PEAK_BF16) / 0.30, 4)}
 
 
+def bench_mlp_iris():
+    """MLP-Iris (BASELINE config #2, 'DenseLayer only, ND4J gemm
+    path'): the 4-feature/3-class shape at modern batch, fit_scan."""
+    import time
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iris import load_iris_dataset
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    iris = load_iris_dataset()
+    reps = 256  # 150 rows -> 38.4k examples so the chip sees real batches
+    x = np.tile(iris.features, (reps, 1)).astype(np.float32)
+    y = np.tile(iris.labels, (reps, 1)).astype(np.float32)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.1).updater("adam").activation("relu")
+            .compute_dtype("bfloat16")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=64))
+            .layer(DenseLayer(n_in=64, n_out=64))
+            .layer(OutputLayer(n_in=64, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    batch = 4096
+    staged = net.stage_scan(DataSet(x, y), batch)
+    net.fit_scan(None, batch, epochs=1, staged=staged)
+    epochs = 20
+    t0 = time.perf_counter()
+    scores = net.fit_scan(None, batch, epochs=epochs, staged=staged)
+    dt = time.perf_counter() - t0
+    n = epochs * (x.shape[0] // batch) * batch
+    assert np.isfinite(np.asarray(scores)).all()
+    return {"metric": "mlp_iris_train_examples_per_sec_per_chip",
+            "value": round(n / dt, 1), "unit": "examples/sec/chip",
+            "vs_baseline": 1.0}  # reference publishes no number (BASELINE.md)
+
+
+def bench_word2vec():
+    """Word2Vec skip-gram (BASELINE config #5): batched scatter-add SGNS
+    engine throughput over a synthetic zipf corpus, tokens/sec."""
+    import time
+
+    from deeplearning4j_tpu.models.word2vec.word2vec import Word2Vec
+
+    rng = np.random.default_rng(0)
+    vocab, n_sent, sent_len, bs = 2000, 8000, 20, 32768
+    # zipf-ish frequencies like natural text
+    probs = 1.0 / np.arange(1, vocab + 1)
+    probs /= probs.sum()
+    sents = [[f"w{t}" for t in rng.choice(vocab, sent_len, p=probs)]
+             for _ in range(n_sent)]
+    mk = lambda epochs: Word2Vec(layer_size=128, window_size=5,
+                                 min_word_frequency=1, epochs=epochs,
+                                 negative_sample=5, seed=1, batch_size=bs)
+    mk(1).fit(sents)  # compile + warmup (same convention as the NN benches)
+    epochs = 2
+    w2v = mk(epochs)
+    t0 = time.perf_counter()
+    w2v.fit(sents)
+    dt = time.perf_counter() - t0
+    tokens = epochs * n_sent * sent_len
+    return {"metric": "word2vec_sgns_tokens_per_sec_per_chip",
+            "value": round(tokens / dt, 1), "unit": "tokens/sec/chip",
+            "vs_baseline": 1.0}  # reference publishes no number (BASELINE.md)
+
+
 def bench_gpt():
     """GPT-style causal LM (zoo transformer, flash-attention blocks),
     synthetic token stream."""
@@ -196,9 +264,10 @@ def bench_resnet50():
 def main():
     subs = {}
     for name, fn in [("gemm_bf16", bench_gemm), ("lenet_mnist", bench_lenet),
-                     ("lstm_char", bench_lstm), ("resnet50", bench_resnet50),
+                     ("mlp_iris", bench_mlp_iris), ("lstm_char", bench_lstm),
+                     ("resnet50", bench_resnet50),
                      ("flash_attention", bench_flash_attention),
-                     ("gpt", bench_gpt)]:
+                     ("gpt", bench_gpt), ("word2vec", bench_word2vec)]:
         r = None
         attempts = 3  # tunneled remote-compile can drop transiently
         last_err = None

@@ -21,6 +21,7 @@ import numpy as np
 
 from deeplearning4j_tpu.models.sequencevectors.engine import (
     SequenceVectors,
+    _pad_np,
     _sgns_step,
 )
 from deeplearning4j_tpu.text.sentenceiterator import LabelAwareIterator
@@ -103,10 +104,14 @@ class ParagraphVectors(SequenceVectors):
             for s in range(0, len(order), B):
                 sel = order[s:s + B]
                 negs = rng.choice(neg_table, (len(sel), self.negative))
+                # pad the tail to one static batch shape; weights mask pads
+                w = np.zeros(B, np.float32)
+                w[:len(sel)] = 1.0
                 doc_vecs, syn1neg, _ = _sgns_step(
-                    doc_vecs, syn1neg, jnp.asarray(doc_ids[sel]),
-                    jnp.asarray(word_ids[sel]), jnp.asarray(negs, jnp.int32),
-                    jnp.float32(self.learning_rate))
+                    doc_vecs, syn1neg, jnp.asarray(_pad_np(doc_ids[sel], B)),
+                    jnp.asarray(_pad_np(word_ids[sel], B)),
+                    jnp.asarray(_pad_np(negs, B), jnp.int32),
+                    jnp.float32(self.learning_rate), jnp.asarray(w))
         self.doc_vectors = np.asarray(doc_vecs)
         self.lookup_table.syn1neg = np.asarray(syn1neg)
 

@@ -40,9 +40,11 @@ from deeplearning4j_tpu.models.word2vec.vocab import Huffman, VocabCache
 # --------------------------------------------------------------- device steps
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
-def _sgns_step(syn0, syn1neg, centers, contexts, negatives, lr):
+def _sgns_step(syn0, syn1neg, centers, contexts, negatives, lr, weights):
     """Skip-gram negative-sampling batch update (SkipGram.iterateSample
-    :204 neg-sampling branch, batched). Returns (syn0', syn1neg', loss)."""
+    :204 neg-sampling branch, batched). Returns (syn0', syn1neg', loss).
+    ``weights`` [B]: per-pair weight (0 = padding — one static batch
+    shape means ONE compile regardless of the final ragged tail)."""
     v = syn0[centers]                       # [B, d]
     u_pos = syn1neg[contexts]               # [B, d]
     u_neg = syn1neg[negatives]              # [B, K, d]
@@ -52,27 +54,31 @@ def _sgns_step(syn0, syn1neg, centers, contexts, negatives, lr):
     # semantics: a sampled negative equal to the target is discarded)
     neg_ok = (negatives != contexts[:, None]).astype(s_neg.dtype)
     # maximize log σ(s_pos) + Σ log σ(-s_neg)
-    g_pos = 1.0 - jax.nn.sigmoid(s_pos)     # [B]
-    g_neg = -jax.nn.sigmoid(s_neg) * neg_ok  # [B, K]
+    g_pos = (1.0 - jax.nn.sigmoid(s_pos)) * weights
+    g_neg = -jax.nn.sigmoid(s_neg) * neg_ok * weights[:, None]
     dv = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
     du_pos = g_pos[:, None] * v
     du_neg = g_neg[..., None] * v[:, None, :]
     syn0 = syn0.at[centers].add(lr * dv)
     syn1neg = syn1neg.at[contexts].add(lr * du_pos)
     syn1neg = syn1neg.at[negatives].add(lr * du_neg)
-    loss = -jnp.mean(jnp.log(jax.nn.sigmoid(s_pos) + 1e-10)
-                     + jnp.sum(jnp.log(jax.nn.sigmoid(-s_neg) + 1e-10) * neg_ok, axis=-1))
+    n_real = jnp.maximum(jnp.sum(weights), 1.0)
+    loss = -jnp.sum((jnp.log(jax.nn.sigmoid(s_pos) + 1e-10)
+                     + jnp.sum(jnp.log(jax.nn.sigmoid(-s_neg) + 1e-10) * neg_ok,
+                               axis=-1)) * weights) / n_real
     return syn0, syn1neg, loss
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
-def _hs_step(syn0, syn1, centers, codes, points, code_mask, lr):
+def _hs_step(syn0, syn1, centers, codes, points, code_mask, lr, weights):
     """Hierarchical-softmax batch update (SkipGram.iterateSample :204 HS
-    branch, batched over padded Huffman paths)."""
+    branch, batched over padded Huffman paths). ``weights`` as in
+    ``_sgns_step``."""
     v = syn0[centers]                       # [B, d]
     u = syn1[points]                        # [B, L, d]
     s = jnp.einsum("bd,bld->bl", v, u)      # [B, L]
     # label = 1 - code; g = (label - σ(s)) masked
+    code_mask = code_mask * weights[:, None]
     g = (1.0 - codes - jax.nn.sigmoid(s)) * code_mask
     dv = jnp.einsum("bl,bld->bd", g, u)
     du = g[..., None] * v[:, None, :]
@@ -84,6 +90,15 @@ def _hs_step(syn0, syn1, centers, codes, points, code_mask, lr):
 
 
 # ------------------------------------------------------------------- sampling
+
+def _pad_np(arr, target: int) -> np.ndarray:
+    """Zero-pad the leading dim to ``target`` (paired with a 0 weight)."""
+    arr = np.asarray(arr)
+    if len(arr) == target:
+        return arr
+    padding = np.zeros((target - len(arr),) + arr.shape[1:], arr.dtype)
+    return np.concatenate([arr, padding])
+
 
 def skipgram_pairs(sentences_idx: List[np.ndarray], window: int,
                    rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
@@ -132,9 +147,10 @@ def cbow_pairs(sentences_idx, window, rng, pad_idx):
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
-def _cbow_sgns_step(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr):
+def _cbow_sgns_step(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr,
+                    weights):
     """CBOW with negative sampling: mean of context vectors predicts the
-    center (CBOW.java batched)."""
+    center (CBOW.java batched). ``weights`` as in ``_sgns_step``."""
     vc = syn0[ctx] * ctx_mask[..., None]            # [B, W, d]
     denom = jnp.maximum(jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0)
     h = jnp.sum(vc, axis=1) / denom                 # [B, d]
@@ -143,15 +159,17 @@ def _cbow_sgns_step(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr):
     s_pos = jnp.sum(h * u_pos, axis=-1)
     s_neg = jnp.einsum("bd,bkd->bk", h, u_neg)
     neg_ok = (negatives != centers[:, None]).astype(s_neg.dtype)
-    g_pos = 1.0 - jax.nn.sigmoid(s_pos)
-    g_neg = -jax.nn.sigmoid(s_neg) * neg_ok
+    g_pos = (1.0 - jax.nn.sigmoid(s_pos)) * weights
+    g_neg = -jax.nn.sigmoid(s_neg) * neg_ok * weights[:, None]
     dh = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
     dctx = (dh / denom)[:, None, :] * ctx_mask[..., None]
     syn0 = syn0.at[ctx].add(lr * dctx)
     syn1neg = syn1neg.at[centers].add(lr * (g_pos[:, None] * h))
     syn1neg = syn1neg.at[negatives].add(lr * (g_neg[..., None] * h[:, None, :]))
-    loss = -jnp.mean(jnp.log(jax.nn.sigmoid(s_pos) + 1e-10)
-                     + jnp.sum(jnp.log(jax.nn.sigmoid(-s_neg) + 1e-10) * neg_ok, axis=-1))
+    n_real = jnp.maximum(jnp.sum(weights), 1.0)
+    loss = -jnp.sum((jnp.log(jax.nn.sigmoid(s_pos) + 1e-10)
+                     + jnp.sum(jnp.log(jax.nn.sigmoid(-s_neg) + 1e-10) * neg_ok,
+                               axis=-1)) * weights) / n_real
     return syn0, syn1neg, loss
 
 
@@ -251,14 +269,7 @@ class SequenceVectors:
                 sh_step = make_sharded_hs_step(self.mesh, **kw)
             else:
                 sh_step = make_sharded_sgns_step(self.mesh, **kw)
-
-            def pad(arr, target):
-                arr = np.asarray(arr)
-                n = len(arr)
-                if n == target:
-                    return arr
-                padding = np.zeros((target - n,) + arr.shape[1:], arr.dtype)
-                return np.concatenate([arr, padding])
+            pad = _pad_np
         else:
             syn0 = jnp.asarray(lt.syn0)
             syn1 = jnp.asarray(lt.syn1) if self.use_hs else jnp.asarray(lt.syn1neg)
@@ -294,12 +305,18 @@ class SequenceVectors:
                 cb = centers[s:s + B]
                 if len(cb) == 0:
                     continue
+                # pad EVERY batch to one static shape (tail included) and
+                # weight the padding to 0: one compile per stream instead
+                # of one per distinct tail size (padding also keeps the
+                # sharded batch divisible over the data axis)
                 if sharded:
                     from deeplearning4j_tpu.models.sequencevectors.distributed import pad_to_multiple
-                    tgt = pad_to_multiple(len(cb), dsize)
-                    w = np.zeros(tgt, np.float32)
-                    w[:len(cb)] = 1.0
-                    w = jnp.asarray(w)
+                    tgt = pad_to_multiple(B, dsize)
+                else:
+                    tgt = B
+                w = np.zeros(tgt, np.float32)
+                w[:len(cb)] = 1.0
+                w = jnp.asarray(w)
                 if self.algo == "cbow":
                     negs = rng.choice(neg_table, (len(cb), self.negative))
                     if sharded:
@@ -311,8 +328,10 @@ class SequenceVectors:
                             jnp.asarray(pad(negs, tgt), jnp.int32), w, lr)
                     else:
                         syn0, syn1, loss = _cbow_sgns_step(
-                            syn0, syn1, jnp.asarray(ctx[s:s + B]), jnp.asarray(cmask_b[s:s + B]),
-                            jnp.asarray(cb), jnp.asarray(negs, jnp.int32), lr)
+                            syn0, syn1, jnp.asarray(_pad_np(ctx[s:s + B], tgt)),
+                            jnp.asarray(_pad_np(cmask_b[s:s + B], tgt)),
+                            jnp.asarray(_pad_np(cb, tgt)),
+                            jnp.asarray(_pad_np(negs, tgt), jnp.int32), lr, w)
                 elif self.use_hs:
                     xb = contexts[s:s + B]
                     if sharded:
@@ -321,9 +340,10 @@ class SequenceVectors:
                             syn0, syn1, jnp.asarray(pad(cb, tgt)), codes[xj],
                             points[xj], cmask[xj], w, lr)
                     else:
+                        xj = jnp.asarray(_pad_np(xb, tgt))
                         syn0, syn1, loss = _hs_step(
-                            syn0, syn1, jnp.asarray(cb), codes[jnp.asarray(xb)],
-                            points[jnp.asarray(xb)], cmask[jnp.asarray(xb)], lr)
+                            syn0, syn1, jnp.asarray(_pad_np(cb, tgt)),
+                            codes[xj], points[xj], cmask[xj], lr, w)
                 else:
                     negs = rng.choice(neg_table, (len(cb), self.negative))
                     if sharded:
@@ -333,8 +353,9 @@ class SequenceVectors:
                             jnp.asarray(pad(negs, tgt), jnp.int32), w, lr)
                     else:
                         syn0, syn1, loss = _sgns_step(
-                            syn0, syn1, jnp.asarray(cb), jnp.asarray(contexts[s:s + B]),
-                            jnp.asarray(negs, jnp.int32), lr)
+                            syn0, syn1, jnp.asarray(_pad_np(cb, tgt)),
+                            jnp.asarray(_pad_np(contexts[s:s + B], tgt)),
+                            jnp.asarray(_pad_np(negs, tgt), jnp.int32), lr, w)
                 step_i += 1
                 if step_i % 10 == 0:
                     self._loss_history.append(float(loss))
