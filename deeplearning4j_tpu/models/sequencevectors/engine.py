@@ -102,23 +102,38 @@ def _pad_np(arr, target: int) -> np.ndarray:
 
 def skipgram_pairs(sentences_idx: List[np.ndarray], window: int,
                    rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
-    """Vectorized (center, context) pair generation with the reference's
-    reduced-window sampling (random b in [1, window] per center)."""
-    cs, xs = [], []
-    for s in sentences_idx:
-        n = len(s)
-        if n < 2:
-            continue
-        b = rng.integers(1, window + 1, n)
-        for i in range(n):
-            lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
-            for j in range(lo, hi):
-                if j != i:
-                    cs.append(s[i])
-                    xs.append(s[j])
-    if not cs:
+    """(center, context) pair generation with the reference's
+    reduced-window sampling (random b in [1, window] per center).
+
+    Fully numpy-vectorized over the concatenated corpus: sentences are
+    flattened with position indices, and for each offset d in
+    [-window, window] a boolean mask selects centers whose sampled
+    window covers d AND whose context stays inside the same sentence —
+    no Python loop per token (the engine's host half runs on one core;
+    the reference amortized this across Hogwild threads)."""
+    sents = [np.asarray(s) for s in sentences_idx if len(s) >= 2]
+    if not sents:
         return np.zeros(0, np.int32), np.zeros(0, np.int32)
-    return np.asarray(cs, np.int32), np.asarray(xs, np.int32)
+    flat = np.concatenate(sents).astype(np.int32)
+    lens = np.array([len(s) for s in sents])
+    pos = np.concatenate([np.arange(n) for n in lens])        # within-sentence
+    slen = np.repeat(lens, lens)                              # sentence length
+    b = rng.integers(1, window + 1, len(flat))
+    idx_parts, xs_parts = [], []
+    dmax = min(window, int(lens.max()) - 1)  # longer offsets can't pair
+    for d in range(-dmax, dmax + 1):
+        if d == 0:
+            continue
+        ok = (np.abs(d) <= b) & (pos + d >= 0) & (pos + d < slen)
+        idx = np.nonzero(ok)[0]
+        idx_parts.append(idx)
+        xs_parts.append(flat[idx + d])
+    center_idx = np.concatenate(idx_parts)
+    xs = np.concatenate(xs_parts)
+    # center-major order, contexts by ascending offset — the same
+    # (center, context) sequence the per-token loop produced
+    order = np.argsort(center_idx, kind="stable")
+    return flat[center_idx[order]], xs[order]  # already int32
 
 
 def cbow_pairs(sentences_idx, window, rng, pad_idx):
