@@ -91,3 +91,63 @@ class KMeansClustering:
         x = jnp.asarray(data, jnp.float32)
         d = self._distances(x, jnp.asarray(self.centers))
         return float(jnp.sum(jnp.min(d, axis=1)))
+
+
+class Cluster:
+    """One cluster of a ClusterSet (``clustering/cluster/Cluster.java``
+    role): center + member point indices with distances-to-center."""
+
+    def __init__(self, cluster_id: int, center: np.ndarray):
+        self.id = cluster_id
+        self.center = center
+        self.point_indices: list = []
+        self.distances: list = []
+
+    def add_point(self, index: int, distance: float) -> None:
+        self.point_indices.append(int(index))
+        self.distances.append(float(distance))
+
+    def average_distance(self) -> float:
+        return float(np.mean(self.distances)) if self.distances else 0.0
+
+    def max_distance(self) -> float:
+        return float(np.max(self.distances)) if self.distances else 0.0
+
+    def __len__(self) -> int:
+        return len(self.point_indices)
+
+
+class ClusterSet:
+    """``clustering/cluster/ClusterSet.java`` role: the queryable result
+    of a clustering run — per-cluster membership with distances and
+    nearest-cluster lookup for new points."""
+
+    def __init__(self, model: "KMeansClustering", data: np.ndarray):
+        self.model = model
+        # one distance matmul serves both assignment and the stats
+        d = np.asarray(model._distances(jnp.asarray(data, jnp.float32),
+                                        jnp.asarray(model.centers)))
+        if model.distance == "euclidean":
+            # _distances returns squared euclidean (cancellation can dip
+            # epsilon-negative); report TRUE distances like the other
+            # metrics so Cluster stats are metric-consistent
+            d = np.sqrt(np.maximum(d, 0.0))
+        labels = d.argmin(axis=1)
+        self.clusters = [Cluster(i, model.centers[i])
+                         for i in range(model.k)]
+        for idx, lab in enumerate(labels):
+            self.clusters[int(lab)].add_point(idx, d[idx, lab])
+
+    def cluster_of(self, point: np.ndarray) -> Cluster:
+        lab = int(self.model.predict(np.asarray(point, np.float32)[None])[0])
+        return self.clusters[lab]
+
+    def total_average_distance(self) -> float:
+        ds = [dist for c in self.clusters for dist in c.distances]
+        return float(np.mean(ds)) if ds else 0.0
+
+    def __iter__(self):
+        return iter(self.clusters)
+
+    def __len__(self) -> int:
+        return len(self.clusters)

@@ -144,3 +144,25 @@ class TestTSNE:
         ca, cb = emb[:30].mean(0), emb[30:].mean(0)
         spread = max(emb[:30].std(), emb[30:].std())
         assert np.linalg.norm(ca - cb) > 2 * spread
+
+
+def test_cluster_set_api(rng):
+    """ClusterSet framework (ClusterSet.java role): membership with
+    distances, nearest-cluster lookup, summary stats."""
+    from deeplearning4j_tpu.clustering.kmeans import ClusterSet, KMeansClustering
+
+    blobs = np.concatenate([
+        rng.standard_normal((30, 2)) * 0.2 + c
+        for c in ([0, 0], [5, 5], [0, 5])]).astype(np.float32)
+    km = KMeansClustering(k=3, seed=5).fit(blobs)
+    cs = ClusterSet(km, blobs)
+    assert len(cs) == 3
+    assert sum(len(c) for c in cs) == 90
+    # each original blob lands in one cluster
+    lab = km.predict(blobs)
+    for start in (0, 30, 60):
+        assert len(set(lab[start:start + 30])) == 1
+    near = cs.cluster_of(np.array([5.1, 4.9], np.float32))
+    assert np.linalg.norm(near.center - [5, 5]) < 1.0
+    assert cs.total_average_distance() > 0
+    assert near.max_distance() >= near.average_distance()
