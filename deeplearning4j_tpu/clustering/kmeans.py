@@ -133,10 +133,13 @@ class ClusterSet:
             # metrics so Cluster stats are metric-consistent
             d = np.sqrt(np.maximum(d, 0.0))
         labels = d.argmin(axis=1)
-        self.clusters = [Cluster(i, model.centers[i])
-                         for i in range(model.k)]
-        for idx, lab in enumerate(labels):
-            self.clusters[int(lab)].add_point(idx, d[idx, lab])
+        self.clusters = []
+        for i in range(model.k):
+            c = Cluster(i, model.centers[i])
+            members = np.flatnonzero(labels == i)
+            c.point_indices = members.tolist()
+            c.distances = d[members, i].tolist()
+            self.clusters.append(c)
 
     def cluster_of(self, point: np.ndarray) -> Cluster:
         lab = int(self.model.predict(np.asarray(point, np.float32)[None])[0])

@@ -56,6 +56,7 @@ def gpt(vocab_size: int = 50257, d_model: int = 512, n_layers: int = 8,
 
 def generate(net: MultiLayerNetwork, prompt_ids: np.ndarray,
              max_new_tokens: int, temperature: float = 0.0,
+             top_k: int = 0, top_p: float = 0.0,
              seed: int = 0) -> np.ndarray:
     """Autoregressive decoding with per-block KV caches — the
     transformer analog of the stateful ``rnnTimeStep`` path
@@ -64,7 +65,10 @@ def generate(net: MultiLayerNetwork, prompt_ids: np.ndarray,
     token instead of the O(t²) full-window forward.
 
     ``prompt_ids``: [b, t0] int tokens; returns [b, t0 + max_new_tokens].
-    ``temperature`` 0 = greedy, else softmax sampling.
+    ``temperature`` 0 = greedy, else softmax sampling, optionally
+    restricted to the ``top_k`` highest logits and/or the smallest
+    nucleus with cumulative probability ≥ ``top_p`` (both filters run
+    device-side inside the scan).
     """
     import jax
     import jax.numpy as jnp
@@ -115,9 +119,21 @@ def generate(net: MultiLayerNetwork, prompt_ids: np.ndarray,
             if temperature <= 0.0:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             else:
+                lg = logits / temperature
+                neg = jnp.asarray(jnp.finfo(lg.dtype).min, lg.dtype)
+                if top_k and top_k < lg.shape[-1]:
+                    kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+                    lg = jnp.where(lg < kth, neg, lg)
+                if top_p and top_p < 1.0:
+                    srt = jnp.sort(lg, axis=-1)[:, ::-1]
+                    probs = jax.nn.softmax(srt, axis=-1)
+                    # smallest prefix with cumulative prob >= top_p
+                    keep = jnp.cumsum(probs, axis=-1) - probs < top_p
+                    cutoff = jnp.min(jnp.where(keep, srt, jnp.inf),
+                                     axis=-1, keepdims=True)
+                    lg = jnp.where(lg < cutoff, neg, lg)
                 nxt = jax.random.categorical(
-                    jax.random.fold_in(key, pos),
-                    logits / temperature, axis=-1).astype(jnp.int32)
+                    jax.random.fold_in(key, pos), lg, axis=-1).astype(jnp.int32)
             # keep prompt tokens during prefill; write samples after
             cur = jax.lax.dynamic_index_in_dim(out, pos + 1, 1, keepdims=False)
             nxt = jnp.where(pos + 1 < t0, cur, nxt)
@@ -133,7 +149,8 @@ def generate(net: MultiLayerNetwork, prompt_ids: np.ndarray,
     out0 = out0.at[:, :t0].set(prompt_ids.astype(np.int32))
     # cache the compiled decode on the model: repeat generate() calls
     # with the same shapes/temperature reuse the executable
-    key = ("gpt_generate", b, t0, total, float(temperature))
+    key = ("gpt_generate", b, t0, total, float(temperature),
+           int(top_k), float(top_p))
     if key not in net._jits:
         net._jits[key] = jax.jit(decode)
     out = net._jits[key](net.params, caches, out0, jax.random.PRNGKey(seed))

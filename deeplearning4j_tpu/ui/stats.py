@@ -108,12 +108,24 @@ class StatsListener(IterationListener):
         self._last_norms: Optional[Dict[str, float]] = None
 
     def _device_memory(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
         try:
             stats = jax.local_devices()[0].memory_stats() or {}
-            return {k: float(v) for k, v in stats.items()
-                    if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")}
+            out = {k: float(v) for k, v in stats.items()
+                   if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")}
         except Exception:
-            return {}
+            pass
+        try:
+            # host RSS — the role the reference's JVM-memory/GC MXBean
+            # telemetry plays (StatsListener.java:165-190)
+            import os
+            page = os.sysconf("SC_PAGE_SIZE")
+            with open("/proc/self/statm") as f:
+                out["host_rss_bytes"] = float(
+                    int(f.read().split()[1]) * page)
+        except Exception:
+            pass
+        return out
 
     def iteration_done(self, model, iteration, score):
         now = time.perf_counter()

@@ -163,7 +163,13 @@ def test_generate_moe_and_sampling(rng):
     g1 = generate(net, prompt, max_new_tokens=4)
     g2 = generate(net, prompt, max_new_tokens=4)
     np.testing.assert_array_equal(g1, g2)
-    assert ("gpt_generate", 4, 2, 6, 0.0) in net._jits
+    assert ("gpt_generate", 4, 2, 6, 0.0, 0, 0.0) in net._jits
+    # top-k=1 sampling degenerates to greedy regardless of temperature
+    g3 = generate(net, prompt, max_new_tokens=4, temperature=5.0, top_k=1)
+    np.testing.assert_array_equal(g3, g1)
+    # nucleus filter produces valid tokens
+    g4 = generate(net, prompt, max_new_tokens=4, temperature=1.0, top_p=0.8)
+    assert (g4 >= 0).all() and (g4 < 11).all()
     with pytest.raises(ValueError, match="max_len"):
         generate(net, prompt, max_new_tokens=100)
 
