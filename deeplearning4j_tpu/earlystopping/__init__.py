@@ -8,5 +8,6 @@ from deeplearning4j_tpu.earlystopping.earlystopping import (  # noqa: F401
     ScoreImprovementEpochTerminationCondition,
     InMemoryModelSaver,
     LocalFileModelSaver,
+    ShardedCheckpointSaver,
     DataSetLossCalculator,
 )

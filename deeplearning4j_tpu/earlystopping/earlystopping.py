@@ -241,3 +241,31 @@ class EarlyStoppingTrainer:
             total_epochs=epoch + 1, best_model_epoch=best_epoch,
             best_model_score=best_score,
             best_model=cfg.model_saver.get_best_model() or self.model)
+
+
+class ShardedCheckpointSaver(LocalFileModelSaver):
+    """Early-stopping saver backed by the orbax/tensorstore sharded
+    checkpoint format (``util/sharded_checkpoint.py``): each device
+    writes its own shards, so best-model snapshots of FSDP/TP-sharded
+    models never gather to host. Same SPI and directory conventions as
+    :class:`LocalFileModelSaver`, with checkpoint DIRECTORIES instead
+    of zips."""
+
+    def save_best_model(self, model, score) -> None:
+        from deeplearning4j_tpu.util.sharded_checkpoint import save_checkpoint
+        save_checkpoint(model, self._path("bestModel"))
+
+    def save_latest_model(self, model, score) -> None:
+        from deeplearning4j_tpu.util.sharded_checkpoint import save_checkpoint
+        save_checkpoint(model, self._path("latestModel"))
+
+    def _load(self, name: str):
+        from deeplearning4j_tpu.util.sharded_checkpoint import restore_checkpoint
+        path = self._path(name)
+        return restore_checkpoint(path) if os.path.isdir(path) else None
+
+    def get_best_model(self):
+        return self._load("bestModel")
+
+    def get_latest_model(self):
+        return self._load("latestModel")

@@ -55,7 +55,7 @@ def gpt(vocab_size: int = 50257, d_model: int = 512, n_layers: int = 8,
 
 
 def generate(net: MultiLayerNetwork, prompt_ids: np.ndarray,
-             max_new_tokens: int, temperature: float = 0.0,
+             max_new_tokens: int, temperature: float = 0.0, *,
              top_k: int = 0, top_p: float = 0.0,
              seed: int = 0) -> np.ndarray:
     """Autoregressive decoding with per-block KV caches — the

@@ -90,3 +90,27 @@ def test_restore_into_sharded_model(rng, tmp_path):
     assert not restored.params["layer0"]["W"].sharding.is_fully_replicated
     np.testing.assert_allclose(np.asarray(restored.output(ds.features)),
                                out_before, rtol=1e-5)
+
+
+def test_early_stopping_with_sharded_saver(rng, tmp_path):
+    """Early stopping snapshots best/latest models in the sharded
+    format; get_best_model restores a working model from disk."""
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.earlystopping import (
+        DataSetLossCalculator, EarlyStoppingConfiguration,
+        EarlyStoppingTrainer, MaxEpochsTerminationCondition,
+        ShardedCheckpointSaver)
+
+    net, ds = _net_and_data(rng)
+    saver = ShardedCheckpointSaver(str(tmp_path / "es"))
+    conf = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+        score_calculator=DataSetLossCalculator(ListDataSetIterator(ds, 32)),
+        model_saver=saver, save_last_model=True)
+    result = EarlyStoppingTrainer(conf, net, ListDataSetIterator(ds, 16)).fit()
+    assert result.total_epochs == 3
+    best = saver.get_best_model()
+    assert best is not None
+    np.testing.assert_allclose(best.score(ds), result.best_model_score,
+                               rtol=1e-5)
+    assert saver.get_latest_model() is not None
