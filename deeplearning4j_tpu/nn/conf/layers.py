@@ -47,6 +47,10 @@ class Layer:
     activation: Optional[str] = None
     weight_init: Optional[str] = None
     bias_init: Optional[float] = None
+    # Layers feeding BatchNormalization don't need a bias: BN's beta
+    # absorbs it, and on TPU the bias *gradient* is a full HBM reduce
+    # over the layer's output — measurably expensive in conv nets.
+    has_bias: bool = True
     dist_mean: float = 0.0
     dist_std: float = 1.0
     dropout: Optional[float] = None  # keep DL4J semantics: probability of RETAINING is 1-dropout? see layers/base.py

@@ -512,14 +512,17 @@ class ComputationGraph:
 
     # ------------------------------------------------- scanned multi-step fit
 
-    def _make_scan_fit(self):
-        """Epoch-as-one-XLA-program over staged minibatches — the DAG
-        analog of MultiLayerNetwork.fit_scan (one host dispatch per
-        epoch; every vertex of every step fused by XLA)."""
+    def _make_scan_fit(self, epochs: int = 1):
+        """Epochs-as-one-XLA-program over staged minibatches — the DAG
+        analog of MultiLayerNetwork.fit_scan (ONE host dispatch for the
+        whole run; every vertex of every step fused by XLA). The epoch
+        count is baked into the program: each tunnel dispatch costs
+        ~50-100ms, so per-epoch dispatch measurably caps short-epoch
+        training throughput."""
         py_step = self._make_train_step().__wrapped__
         iters = max(1, self.gc.iterations)
 
-        def epoch(params, opt_state, states, xb, yb, rng_key):
+        def run(params, opt_state, states, xb, yb, rng_key):
             def body(carry, batch):
                 p, o, s = carry
                 xs, ys = batch
@@ -527,10 +530,15 @@ class ComputationGraph:
                     p, o, s, score = py_step(p, o, s, xs, ys, {}, {}, rng_key)
                 return (p, o, s), score
 
-            (p, o, s), scores = jax.lax.scan(body, (params, opt_state, states), (xb, yb))
-            return p, o, s, scores
+            def epoch(carry, _):
+                carry, scores = jax.lax.scan(body, carry, (xb, yb))
+                return carry, scores
 
-        return jax.jit(epoch, donate_argnums=(0, 1, 2))
+            (p, o, s), scores = jax.lax.scan(
+                epoch, (params, opt_state, states), None, length=epochs)
+            return p, o, s, scores.reshape((-1,))
+
+        return jax.jit(run, donate_argnums=(0, 1, 2))
 
     def stage_scan(self, data: Union[DataSet, MultiDataSet], batch_size: int):
         """Stage a dataset on device as scan-ready minibatch stacks — do
@@ -564,17 +572,14 @@ class ComputationGraph:
         if self.params is None:
             self.init()
         xb, yb = staged if staged is not None else self.stage_scan(data, batch_size)
-        key = ("scan_fit", self._seq_token())
+        key = ("scan_fit", epochs, self._seq_token())
         if key not in self._jits:
-            self._jits[key] = self._make_scan_fit()
+            self._jits[key] = self._make_scan_fit(epochs)
         fit = self._jits[key]
         rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
-        all_scores = []
-        for _ in range(epochs):
-            self.params, self.opt_state, self.states, scores = fit(
-                self.params, self.opt_state, self.states, xb, yb, rng_key)
-            all_scores.append(scores)
-        out = np.asarray(jnp.concatenate(all_scores))
+        self.params, self.opt_state, self.states, scores = fit(
+            self.params, self.opt_state, self.states, xb, yb, rng_key)
+        out = np.asarray(scores)
         self._score = float(out[-1])
         return out
 

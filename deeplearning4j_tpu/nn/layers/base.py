@@ -63,10 +63,18 @@ class LayerImpl:
     # wrong or out-of-range gathers/scatter-grads
     cast_input = True
 
+    # Impls that honor ``has_bias=False`` set this True (conv/dense); all
+    # others reject the flag loudly instead of silently training a bias.
+    supports_no_bias = False
+
     def __init__(self, global_conf: NeuralNetConfiguration, conf: L.Layer, name: str):
         self.gc = global_conf
         self.conf = conf
         self.name = name
+        if not getattr(conf, "has_bias", True) and not self.supports_no_bias:
+            raise ValueError(
+                f"{type(conf).__name__} ({name}): has_bias=False is not "
+                f"supported by {type(self).__name__}")
 
     # -- config resolution helpers --
     @property

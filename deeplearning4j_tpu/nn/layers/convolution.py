@@ -36,6 +36,8 @@ def _padding(conf) -> object:
 
 @register_impl(L.ConvolutionLayer)
 class ConvolutionImpl(LayerImpl):
+    supports_no_bias = True
+
     def init_params(self, key: jax.Array) -> Dict[str, jnp.ndarray]:
         c = self.conf
         kh, kw = c.kernel_size
@@ -44,6 +46,8 @@ class ConvolutionImpl(LayerImpl):
         fan_out = c.n_out * kh * kw
         W = init_weights(key, (kh, kw, c.n_in, c.n_out), self.weight_init,
                          fan_in, fan_out, c.dist_mean, c.dist_std)
+        if not c.has_bias:
+            return {"W": W}
         b = jnp.full((c.n_out,), self.bias_init, jnp.float32)
         return {"W": W, "b": b}
 
@@ -54,7 +58,9 @@ class ConvolutionImpl(LayerImpl):
             window_strides=self.conf.stride,
             padding=_padding(self.conf),
             dimension_numbers=_DIMS,
-        ) + params["b"].astype(x.dtype)
+        )
+        if "b" in params:
+            z = z + params["b"].astype(x.dtype)
         return activate(self.activation, z), state
 
 

@@ -34,16 +34,21 @@ def _fused_logits_pair(activation: str, loss_function: str) -> bool:
 class BaseDenseImpl(LayerImpl):
     """z = x·W + b ; a = act(z) (``BaseLayer.preOutput`` :354)."""
 
+    supports_no_bias = True
+
     def init_params(self, key: jax.Array) -> Dict[str, jnp.ndarray]:
         c = self.conf
         kW, _ = jax.random.split(key)
         W = init_weights(kW, (c.n_in, c.n_out), self.weight_init, c.n_in, c.n_out,
                          c.dist_mean, c.dist_std)
+        if not c.has_bias:
+            return {"W": W}
         b = jnp.full((c.n_out,), self.bias_init, jnp.float32)
         return {"W": W, "b": b}
 
     def preout(self, params, x):
-        return x @ params["W"] + params["b"]
+        z = x @ params["W"]
+        return z + params["b"] if "b" in params else z
 
     def forward(self, params, x, state, train, rng=None, mask=None):
         x = self.maybe_dropout_input(x, train, rng)

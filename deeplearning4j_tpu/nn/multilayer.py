@@ -440,21 +440,23 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------- scanned multi-step fit
 
-    def _make_scan_fit(self):
-        """Epoch-as-one-XLA-program: ``lax.scan`` over staged minibatches.
+    def _make_scan_fit(self, epochs: int = 1):
+        """Epochs-as-one-XLA-program: ``lax.scan`` over staged minibatches
+        inside ``lax.scan`` over epochs.
 
         The reference necessarily paid a JVM→native dispatch per layer per
         iteration; the per-step jit path here still pays one host dispatch
         per iteration. This path removes even that: the host dispatches
-        once per EPOCH and the chip runs every step back-to-back (the
-        design reason TBPTT-style host loops are absent from the hot
-        path). No mask support — use fit() for masked data.
+        ONCE for the whole run and the chip runs every step back-to-back
+        (each tunnel dispatch costs ~50-100ms, so even per-epoch dispatch
+        measurably caps short-epoch throughput). No mask support — use
+        fit() for masked data.
         """
         py_step = self._make_train_step(False, False).__wrapped__
 
         iters = max(1, self.gc.iterations)
 
-        def epoch(params, opt_state, states, xb, yb, rng_key):
+        def run(params, opt_state, states, xb, yb, rng_key):
             def body(carry, batch):
                 p, o, s = carry
                 x, y = batch
@@ -462,10 +464,15 @@ class MultiLayerNetwork:
                     p, o, s, score = py_step(p, o, s, x, y, 0.0, 0.0, rng_key)
                 return (p, o, s), score
 
-            (p, o, s), scores = jax.lax.scan(body, (params, opt_state, states), (xb, yb))
-            return p, o, s, scores
+            def epoch(carry, _):
+                carry, scores = jax.lax.scan(body, carry, (xb, yb))
+                return carry, scores
 
-        return jax.jit(epoch, donate_argnums=(0, 1, 2))
+            (p, o, s), scores = jax.lax.scan(
+                epoch, (params, opt_state, states), None, length=epochs)
+            return p, o, s, scores.reshape((-1,))
+
+        return jax.jit(run, donate_argnums=(0, 1, 2))
 
     def stage_scan(self, ds: DataSet, batch_size: int):
         """Stage a dataset on device as scan-ready minibatch stacks — do
@@ -494,17 +501,14 @@ class MultiLayerNetwork:
         if self.params is None:
             self.init()
         xb, yb = staged if staged is not None else self.stage_scan(ds, batch_size)
-        key = ("scan_fit", self._seq_token())
+        key = ("scan_fit", epochs, self._seq_token())
         if key not in self._jits:
-            self._jits[key] = self._make_scan_fit()
+            self._jits[key] = self._make_scan_fit(epochs)
         fit = self._jits[key]
         rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
-        all_scores = []
-        for _ in range(epochs):
-            self.params, self.opt_state, self.states, scores = fit(
-                self.params, self.opt_state, self.states, xb, yb, rng_key)
-            all_scores.append(scores)
-        out = np.asarray(jnp.concatenate(all_scores))
+        self.params, self.opt_state, self.states, scores = fit(
+            self.params, self.opt_state, self.states, xb, yb, rng_key)
+        out = np.asarray(scores)
         self._score = float(out[-1])
         return out
 
