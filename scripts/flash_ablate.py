@@ -1,0 +1,202 @@
+"""Ablate flash-attention forward kernel costs on the real chip.
+
+Variants (non-causal, 16k, b1 h8 d128): full online-softmax kernel vs
+kernels with pieces removed — isolates VPU pass costs (max chain, exp,
+astype) from MXU/DMA floor. Timing: best of 3 repeats x 8 iters.
+"""
+import functools, time
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def timeit(fn, iters=8, repeats=3):
+    float(fn())
+    best = 1e9
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn()
+        float(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def make_kernel(mode):
+    def kern(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, bq, bk):
+        kj = pl.program_id(2)
+        nk = pl.num_programs(2)
+
+        @pl.when(kj == 0)
+        def _init():
+            m_ref[:] = jnp.full_like(m_ref, -1e30)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if mode == "matmul_only":
+            acc_ref[:] += jax.lax.dot_general(
+                s.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        elif mode == "exp_only":  # no max/l chain
+            p = jnp.exp(s)
+            acc_ref[:] += jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        elif mode == "exp_sum":  # + denominator, still no max
+            p = jnp.exp(s)
+            l_ref[:, :1] += jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[:] += jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        elif mode == "full":
+            m_prev = m_ref[:, :1]
+            l_prev = l_ref[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_new = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        elif mode == "full_lane0":  # partial-lane m/l stores
+            m_prev = m_ref[:, :1]
+            l_prev = l_ref[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_new = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[:, :1] = m_new
+            l_ref[:, :1] = l_new
+
+        @pl.when(kj == nk - 1)
+        def _final():
+            o_ref[0] = acc_ref[:].astype(o_ref.dtype)
+
+    return kern
+
+
+def run(mode, bq, bk, t=16384, bh=8, d=128):
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (bh, t, d),
+                                 jnp.bfloat16) * 0.1 for i in range(3))
+    kern = functools.partial(make_kernel(mode), bq=bq, bk=bk)
+    vmem = dict(memory_space=pltpu.VMEM)
+    f = pl.pallas_call(
+        kern,
+        grid=(bh, t // bq, t // bk),
+        in_specs=[pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **vmem),
+                  pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), **vmem),
+                  pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), **vmem)],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **vmem),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), jnp.bfloat16),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )
+    fj = jax.jit(lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32)))
+    dt = timeit(lambda: fj(q, k, v))
+    fl = 4 * bh * t * t * d / dt
+    steps = bh * (t // bq) * (t // bk)
+    print(f"{mode:12s} bq={bq:4d} bk={bk:4d}: {dt*1e3:6.2f}ms "
+          f"{fl/1e12:5.1f} TF/s  {dt/steps*1e6:5.2f}us/step")
+
+
+if __name__ == "__main__" and __import__("sys").argv[-1] != "causal":
+    for mode in ("matmul_only", "exp_only", "exp_sum", "full", "full_lane0"):
+        run(mode, 512, 1024)
+    for bq, bk in ((512, 2048), (1024, 1024), (256, 1024)):
+        run("full", bq, bk)
+
+
+def make_causal_kernel(mode):
+    def kern(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, d_ref, *, bq, bk):
+        qi = pl.program_id(1)
+        kj = pl.program_id(2)
+        nk = pl.num_programs(2)
+
+        @pl.when((qi == 0) & (kj == 0))
+        def _initD():
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            d_ref[:] = rows - cols
+
+        @pl.when(kj == 0)
+        def _init():
+            m_ref[:, :1] = jnp.full((bq, 1), -1e30, jnp.float32)
+            l_ref[:, :1] = jnp.zeros((bq, 1), jnp.float32)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        live = kj * bk <= qi * bq + bq - 1
+
+        def _step():
+            s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if mode == "iota":
+                rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                ok = (qi * bq + rows) >= (kj * bk + cols)
+            else:
+                ok = d_ref[:] >= kj * bk - qi * bq
+            s = jnp.where(ok, s, -1e30)
+            m_prev = m_ref[:, :1]
+            l_prev = l_ref[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_new = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[:, :1] = m_new
+            l_ref[:, :1] = l_new
+
+        pl.when(live)(_step)
+
+        @pl.when(kj == nk - 1)
+        def _final():
+            o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+    return kern
+
+
+def run_causal(mode, bq, bk, t=16384, bh=8, d=128):
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (bh, t, d),
+                                 jnp.bfloat16) * 0.1 for i in range(3))
+    kern = functools.partial(make_causal_kernel(mode), bq=bq, bk=bk)
+    vmem = dict(memory_space=pltpu.VMEM)
+    f = pl.pallas_call(
+        kern,
+        grid=(bh, t // bq, t // bk),
+        in_specs=[pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **vmem),
+                  pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), **vmem),
+                  pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0), **vmem)],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0), **vmem),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), jnp.bfloat16),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, bk), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )
+    fj = jax.jit(lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32)))
+    dt = timeit(lambda: fj(q, k, v))
+    fl = 4 * bh * t * t * d / 2 / dt
+    print(f"causal/{mode:8s} bq={bq:4d} bk={bk:4d}: {dt*1e3:6.2f}ms {fl/1e12:5.1f} TF/s")
+
+
+if __name__ == "__main__" and __import__("sys").argv[-1] == "causal":
+    for mode in ("iota", "dscratch"):
+        for bq, bk in ((512, 1024), (512, 512), (1024, 512), (2048, 512), (1024, 1024)):
+            run_causal(mode, bq, bk)
