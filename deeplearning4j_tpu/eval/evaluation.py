@@ -77,6 +77,15 @@ class Evaluation:
         self._ensure(predictions.shape[-1])
         if sparse:
             actual = labels.astype(np.int64)
+            width = predictions.shape[-1]
+            if actual.size and actual.max() >= width:
+                bad = int(actual.max())
+                raise ValueError(
+                    f"sparse label id {bad} is out of range for predictions "
+                    f"with {width} classes (valid ids: 0..{width - 1}; "
+                    f"negative ids mean ignore-index). The training loss "
+                    f"clamps out-of-range ids, but evaluation refuses them "
+                    f"so a vocabulary/label mismatch is caught loudly.")
             valid = actual >= 0
             actual, predictions = actual[valid], predictions[valid]
             if meta is not None:

@@ -36,6 +36,7 @@ from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.graph import GraphVertex, vertex_from_dict
 from deeplearning4j_tpu.nn.conf.layers import layer_from_dict
 from deeplearning4j_tpu.nn.layers.base import build_layer
+from deeplearning4j_tpu.nn.observed import SyncedStateAttr
 from deeplearning4j_tpu.nn.updater import (
     GradientNormalization,
     apply_updater,
@@ -198,6 +199,12 @@ def topological_order(vertices: Sequence[VertexDef]) -> List[str]:
 
 
 class ComputationGraph:
+    # observer-visible state: reads run any pending lazy sync installed
+    # by ParallelWrapper's averaging mode (nn/observed.py)
+    params = SyncedStateAttr("params")
+    states = SyncedStateAttr("states")
+    opt_state = SyncedStateAttr("opt_state")
+
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         self.gc = conf.conf

@@ -35,12 +35,19 @@ from deeplearning4j_tpu.nn.updater import (
     init_updater_state,
     normalize_gradient,
 )
+from deeplearning4j_tpu.nn.observed import SyncedStateAttr
 from deeplearning4j_tpu.util.dtypes import cast_floats, cast_like, resolve_compute_dtype
 
 Params = Dict[str, Dict[str, jnp.ndarray]]
 
 
 class MultiLayerNetwork:
+    # observer-visible state: reads run any pending lazy sync installed
+    # by ParallelWrapper's averaging mode (nn/observed.py)
+    params = SyncedStateAttr("params")
+    states = SyncedStateAttr("states")
+    opt_state = SyncedStateAttr("opt_state")
+
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.gc = conf.conf
@@ -323,13 +330,24 @@ class MultiLayerNetwork:
         T = ds.features.shape[1]
         L = self.conf.tbptt_fwd_length
         b = ds.features.shape[0]
-        per_timestep = ds.labels.ndim == 3 or (
-            ds.labels.ndim == 2 and ds.labels.shape == (b, T))  # sparse ids
+        labels_arr = np.asarray(ds.labels)
+        # the sparse-id path demands integer dtype so a dense sequence-level
+        # label matrix [b, nOut] with nOut == T can never be silently
+        # reinterpreted as per-timestep class ids
+        sparse_ids = (labels_arr.ndim == 2 and labels_arr.shape == (b, T)
+                      and np.issubdtype(labels_arr.dtype, np.integer))
+        per_timestep = labels_arr.ndim == 3 or sparse_ids
         if not per_timestep:
+            hint = ""
+            if labels_arr.ndim == 2 and labels_arr.shape == (b, T):
+                hint = (f" Labels have the [batch, T] shape but float dtype "
+                        f"{labels_arr.dtype}; cast to an integer dtype to use "
+                        f"the sparse-id path.")
             raise ValueError(
                 f"TBPTT requires per-timestep labels [batch, T, nOut] (or "
-                f"sparse int ids [batch, T]); got shape {ds.labels.shape}. "
-                f"For sequence-level labels use backprop_type='standard'.")
+                f"sparse INT ids [batch, T]); got shape {ds.labels.shape}. "
+                f"For sequence-level labels use backprop_type='standard'."
+                + hint)
         rec = self._recurrent_impls()
         if not rec:
             raise ValueError("TBPTT configured but no recurrent layers present")

@@ -1,0 +1,43 @@
+"""Lazy observer synchronization for model state attributes.
+
+``ParallelWrapper`` in averaging mode keeps the real training state on a
+leading worker axis; observers (hooks/listeners — the reference's
+``IterationListener`` chain, ``optimize/api/IterationListener.java``)
+must nevertheless see the CURRENT worker-mean model when they read
+``model.params`` / ``opt_state`` / ``states``. Materializing that mean
+every step purely in case someone looks is measurable overhead when
+``averaging_frequency > 1`` on large models, so the wrapper instead
+installs a pending-sync thunk and these descriptors run it on first
+read — observers that only consume the score never pay for the mean.
+"""
+
+from __future__ import annotations
+
+
+class SyncedStateAttr:
+    """Data descriptor backing ``params``/``opt_state``/``states``.
+
+    Reads run (and clear) the instance's pending ``_observer_sync``
+    thunk first, so an externally-installed refresh happens exactly
+    once, and only if somebody actually looks. Writes go straight to
+    the backing slot (the thunk itself writes through here while
+    already cleared, so there is no recursion).
+    """
+
+    def __init__(self, name: str):
+        self._slot = "_synced_" + name
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        sync = obj.__dict__.get("_observer_sync")
+        if sync is not None:
+            obj._observer_sync = None
+            sync()
+        return obj.__dict__.get(self._slot)
+
+    def __set__(self, obj, value):
+        obj.__dict__[self._slot] = value
+
+    def __delete__(self, obj):
+        obj.__dict__.pop(self._slot, None)

@@ -349,9 +349,12 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
     # backward blocks: score blocks live in VMEM 4x over (pT/dPT/dsT
-    # temporaries), so cap at 512x512
-    bq = _pick_block(q.shape[1], min(block_q, 512))
-    bk = _pick_block(k.shape[1], min(block_k, 512))
+    # temporaries), so cap at 512x512. A caller-chosen forward block
+    # > 512 whose length has no <=512 divisor in the candidate list
+    # would make _pick_block return 0 — fall back to the forward block
+    # (it ran, so it divides the length) rather than divide by zero.
+    bq = _pick_block(q.shape[1], min(block_q, 512)) or block_q
+    bk = _pick_block(k.shape[1], min(block_k, 512)) or block_k
     return _flash_bwd_impl(q, k, v, o, lse, g, causal, bq, bk, interpret)
 
 

@@ -63,14 +63,39 @@ def _batches(data: Union[DataSet, DataSetIterator],
     return data
 
 
-def _flatten_with_valid(ds: DataSet):
+def _preds_shape(model, ds: DataSet):
+    """(rank, class width) of the model's prediction array for this
+    data — found by abstract tracing (jax.eval_shape: no compile, no
+    device work)."""
+    x1 = jnp.zeros((1,) + np.asarray(ds.features).shape[1:], jnp.float32)
+    out = jax.eval_shape(
+        lambda p, s, xx: model._forward(p, s, xx, False, None, None)[0][-1],
+        model.params, model.states, x1)
+    return len(out.shape), out.shape[-1]
+
+
+def _check_sparse_ids(y: np.ndarray, preds_rank: int, width: int):
+    """Same loud contract as host ``Evaluation.eval`` (ADVICE r2): an
+    id >= the prediction width must raise, not silently fall out of the
+    device one-hot (which emits an all-zero row for out-of-range ids)."""
+    if y.ndim == preds_rank - 1 and y.size and y.max() >= width:
+        raise ValueError(
+            f"sparse label id {int(y.max())} is out of range for "
+            f"predictions with {width} classes (valid ids: "
+            f"0..{width - 1}; negative ids mean ignore-index)")
+
+
+def _flatten_with_valid(ds: DataSet, preds_rank: int = 2):
     """(x, y, valid) with time folded later device-side; valid is the
-    per-row (or per-timestep) label weight. Sparse per-timestep int
-    labels ([b, t] with [b, t, ...] features) count as time series."""
+    per-row (or per-timestep) label weight. 2-D labels count as sparse
+    per-timestep ids ONLY when the model actually emits [b, t, c]
+    predictions (``preds_rank == 3``) — a dense classifier whose class
+    count happens to equal x.shape[1] (e.g. [b, 28, 28, 1] images with
+    28 one-hot classes) must stay a per-row evaluation (ADVICE r2)."""
     x = np.asarray(ds.features, np.float32)
     y = np.asarray(ds.labels, np.float32)
     time_series = y.ndim == 3 or (
-        y.ndim == 2 and x.ndim >= 3 and y.shape == x.shape[:2])
+        y.ndim == 2 and preds_rank == 3 and y.shape == x.shape[:2])
     if time_series and ds.labels_mask is not None:
         valid = np.asarray(ds.labels_mask, np.float32)
     elif time_series:
@@ -139,8 +164,11 @@ def evaluate_regression_sharded(model, data: Union[DataSet, DataSetIterator],
     params = jax.device_put(model.params, repl)
     states = jax.device_put(model.states, repl)
     total = None
+    rank = None
     for ds in _batches(data, batch_size):
-        x, y, valid = _flatten_with_valid(ds)
+        if rank is None:
+            rank, _ = _preds_shape(model, ds)
+        x, y, valid = _flatten_with_valid(ds, rank)
         x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid)
         xs, ys, vs = ctx.shard_batch(x, y, valid)
         out = np.asarray(program(params, states, xs, ys, vs), np.float64)
@@ -187,8 +215,11 @@ def evaluate_roc_sharded(model, data: Union[DataSet, DataSetIterator],
     params = jax.device_put(model.params, repl)
     states = jax.device_put(model.states, repl)
     roc = ROC(threshold_steps)
+    rank = None
     for ds in _batches(data, batch_size):
-        x, y, valid = _flatten_with_valid(ds)
+        if rank is None:
+            rank, _ = _preds_shape(model, ds)
+        x, y, valid = _flatten_with_valid(ds, rank)
         x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid)
         xs, ys, vs = ctx.shard_batch(x, y, valid)
         tp, fp, pos, neg = program(params, states, xs, ys, vs)
@@ -217,8 +248,12 @@ def evaluate_sharded(model, data: Union[DataSet, DataSetIterator],
     states = jax.device_put(model.states, repl)
 
     total: Optional[np.ndarray] = None
+    rank = width = None
     for ds in _batches(data, batch_size):
-        x, y, valid = _flatten_with_valid(ds)
+        if rank is None:
+            rank, width = _preds_shape(model, ds)
+        x, y, valid = _flatten_with_valid(ds, rank)
+        _check_sparse_ids(y, rank, width)
         x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid)
         xs, ys, vs = ctx.shard_batch(x, y, valid)
         counts = np.asarray(program(params, states, xs, ys, vs))

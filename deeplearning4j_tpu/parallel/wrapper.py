@@ -195,6 +195,9 @@ class ParallelWrapper:
                 x, y = self.ctx.shard_batch(x, y)
             for h in self.hooks:
                 h.pre_update(m, self._counter)
+            # an unconsumed pending sync still references the buffers the
+            # step below donates — drop it (nobody looked this round)
+            m._observer_sync = None
             with self._phase("step"):
                 wparams, wopt, wstates, scores = self._vstep(wparams, wopt, wstates, x, y, rng_key)
                 self._counter += 1
@@ -208,21 +211,33 @@ class ParallelWrapper:
                 # worker-mean model — params, opt_state, and states —
                 # not the stale pre-fit copy the wrapped model holds
                 # until the end-of-fit collapse; allreduce mode is
-                # always fresh, keep the contracts identical. Reuse the
-                # just-averaged tree when this was an averaging step.
+                # always fresh, keep the contracts identical. The mean
+                # is NOT materialized up front: a pending-sync thunk is
+                # installed and the model's SyncedStateAttr descriptors
+                # run it on first read, so score-only observers never
+                # pay for a full-tree mean. The thunk must run before
+                # the next _vstep donates these buffers; it is cleared
+                # below at the top of each iteration either way.
                 take0 = lambda t: jax.tree.map(lambda v: v[0], t)
                 avg0 = lambda t: jax.tree.map(lambda v: jnp.mean(v, axis=0), t)
-                m.params = take0(wparams) if did_avg else avg0(wparams)
-                m.opt_state = take0(wopt) if did_avg else \
-                    {"step": wopt["step"][0], "updater": avg0(wopt["updater"])}
-                m.states = avg0(wstates)
+
+                def _sync(wp=wparams, wo=wopt, ws=wstates, avg=did_avg):
+                    m.params = take0(wp) if avg else avg0(wp)
+                    m.opt_state = take0(wo) if avg else \
+                        {"step": wo["step"][0], "updater": avg0(wo["updater"])}
+                    m.states = avg0(ws)
+
+                m._observer_sync = _sync
             for h in self.hooks:
                 h.post_update(m, self._counter)
             for cb in m.listeners:
                 cb(m, self._counter, m._score)
         # final average + collapse back onto the wrapped model (:121);
         # layer states (BN moving stats) are averaged too, matching the
-        # reference's average-everything semantics
+        # reference's average-everything semantics. Clear any pending
+        # observer sync FIRST so a later read can't clobber the final
+        # state with a stale per-step mean.
+        m._observer_sync = None
         wparams, wopt = self._avg(wparams, wopt)
         take0 = lambda t: jax.tree.map(lambda v: v[0], t)
         avg0 = lambda t: jax.tree.map(lambda v: jnp.mean(v, axis=0), t)

@@ -144,6 +144,33 @@ def test_time_series_with_mask(rng):
     assert dist.confusion.counts.sum() == int(lmask.sum())
 
 
+def test_dense_classifier_with_class_count_matching_time_dim(rng):
+    """ADVICE r2 regression: [b, 3, 2, 1] image features with 3 one-hot
+    classes — y.shape == x.shape[:2] by coincidence, but the model emits
+    [b, 3] (rank-2) predictions, so this must stay a per-ROW evaluation,
+    not become a bogus [b, 3] 'time series' with a broadcast crash."""
+    from deeplearning4j_tpu.nn.conf.preprocessors import (
+        CnnToFeedForwardPreProcessor)
+
+    conf = (NeuralNetConfiguration.builder().seed(9).learning_rate(0.1)
+            .updater("sgd").activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .input_preprocessor(0, CnnToFeedForwardPreProcessor())
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.standard_normal((16, 3, 2, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    assert y.shape == x.shape[:2]  # the coincidence under test
+    host = Evaluation()
+    host.eval(y, net.output(x))
+    dist = evaluate_sharded(net, DataSet(x, y))
+    np.testing.assert_array_equal(dist.confusion.counts,
+                                  host.confusion.counts)
+
+
 def test_sparse_labels_match_onehot_eval(rng):
     """Sparse int-id labels give the same confusion counts as one-hot —
     host Evaluation and mesh-sharded eval, incl. ignore-index."""
@@ -169,3 +196,13 @@ def test_sparse_labels_match_onehot_eval(rng):
     dist_ig = evaluate_sharded(net, DataSet(x, sparse_ig))
     np.testing.assert_array_equal(dist_ig.confusion.counts,
                                   host_c.confusion.counts)
+
+
+def test_sparse_label_out_of_range_raises_in_sharded_eval(rng):
+    """Same loud contract as host Evaluation.eval: an id >= the class
+    width must not silently vanish from the device one-hot counts."""
+    net = _ff_net()
+    x = rng.standard_normal((8, 6)).astype(np.float32)
+    bad = np.array([0, 1, 2, 7, 0, 1, 2, 0], np.float32)  # 7 >= 3 classes
+    with pytest.raises(ValueError, match="sparse label id 7"):
+        evaluate_sharded(net, DataSet(x, bad))

@@ -82,6 +82,23 @@ class TestEvaluation:
         assert e.get_meta(0, 1) == ["exA"]
         assert e.get_meta(1, 1) == ["exB"]
 
+    def test_sparse_labels_match_one_hot(self):
+        dense, sparse = Evaluation(3), Evaluation(3)
+        ids = np.array([0, 1, 2, 1])
+        preds = np.eye(3)[[0, 2, 2, 1]]
+        dense.eval(np.eye(3)[ids], preds)
+        sparse.eval(ids, preds)
+        np.testing.assert_array_equal(dense.confusion.counts,
+                                      sparse.confusion.counts)
+
+    def test_sparse_label_out_of_range_raises_clearly(self):
+        """ADVICE r2: an id >= prediction width must fail loudly with the
+        offending value, not deep inside np.add.at."""
+        e = Evaluation(3)
+        preds = np.eye(3)[[0, 1]]
+        with pytest.raises(ValueError, match="sparse label id 7"):
+            e.eval(np.array([0, 7]), preds)
+
 
 class TestROC:
     def test_separable_auc_is_one(self):

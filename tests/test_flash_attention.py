@@ -47,6 +47,28 @@ def test_multi_kblock_accumulation(rng):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_backward_with_oversized_caller_blocks(rng):
+    """A caller block > 512 that divides t while NO candidate <= 512
+    does (t=1028 = 4·257: none of 512..8 divide it) must not
+    ZeroDivisionError in the backward — it falls back to the forward
+    block size."""
+    t = 1028
+    q, k, v = _qkv(rng, b=1, tq=t, tk=t, h=1, d=32)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=t, block_k=t) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(scaled_dot_product_attention(q, k, v) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_gradients_match_oracle(rng, causal):
     q, k, v = _qkv(rng, b=1, tq=64, tk=64, h=1, d=32)

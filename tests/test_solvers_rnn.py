@@ -71,6 +71,27 @@ class TestTBPTT:
             net.fit(ds)
         assert net.score() < s0 / 2
 
+    def test_tbptt_float_sequence_level_labels_rejected(self):
+        """ADVICE r2: a dense [b, nOut] label matrix whose nOut equals T
+        must NOT be silently reinterpreted as sparse per-timestep ids —
+        the sparse path demands integer dtype."""
+        rng = np.random.default_rng(5)
+        B, T = 4, 6
+        x = rng.standard_normal((B, T, 2)).astype(np.float32)
+        y_float = rng.random((B, T)).astype(np.float32)  # shape collides
+        net = MultiLayerNetwork(self._seq_conf("truncated_bptt", 3)).init()
+        with pytest.raises(ValueError, match="integer dtype"):
+            net.fit(DataSet(x, y_float))
+
+    def test_tbptt_sparse_int_labels_train(self):
+        rng = np.random.default_rng(6)
+        B, T = 4, 6
+        x = rng.standard_normal((B, T, 2)).astype(np.float32)
+        y_ids = rng.integers(0, 2, (B, T))
+        net = MultiLayerNetwork(self._seq_conf("truncated_bptt", 3)).init()
+        net.fit(DataSet(x, y_ids))  # must not raise
+        assert np.isfinite(net.score())
+
     def test_tbptt_single_chunk_equals_standard(self):
         """T <= tbptt length -> identical to standard backprop."""
         rng = np.random.default_rng(1)
