@@ -247,8 +247,9 @@ def bench_mlp_iris():
 
 
 def bench_word2vec():
-    """Word2Vec skip-gram (BASELINE config #5): batched scatter-add SGNS
-    engine throughput over a synthetic zipf corpus, tokens/sec."""
+    """Word2Vec skip-gram (BASELINE config #5): the all-epochs-on-device
+    SGNS scan engine (device pairgen + table negatives + capped MXU
+    accumulation) over a synthetic zipf corpus, tokens/sec."""
     import time
 
     from deeplearning4j_tpu.models.word2vec.word2vec import Word2Vec
@@ -270,6 +271,9 @@ def bench_word2vec():
     w2v.fit(sents)
     dt = time.perf_counter() - t0
     tokens = epochs * n_sent * sent_len
+    hist = w2v._loss_history
+    assert hist and np.isfinite(hist).all() and hist[-1] < hist[0], \
+        f"word2vec loss not converging: {hist[:2]}..{hist[-2:]}"
     return {"metric": "word2vec_sgns_tokens_per_sec_per_chip",
             "value": round(tokens / dt, 1), "unit": "tokens/sec/chip",
             "vs_baseline": 1.0}  # reference publishes no number (BASELINE.md)

@@ -32,6 +32,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+from deeplearning4j_tpu.models.sequencevectors.engine import _row_denom
+
+
 def _maybe_psum(x, axis: Optional[str]):
     return x if axis is None else jax.lax.psum(x, axis)
 
@@ -57,9 +60,21 @@ def make_sharded_sgns_step(mesh: Mesh, data_axis: str = "data",
         dv = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
         du_pos = g_pos[:, None] * v
         du_neg = g_neg[..., None] * v[:, None, :]
-        d0 = jnp.zeros_like(syn0).at[centers].add(lr * dv)
-        d1 = jnp.zeros_like(syn1neg).at[contexts].add(lr * du_pos)
-        d1 = d1.at[negatives].add(lr * du_neg)
+        # capped accumulation with GLOBAL per-row counts (engine._row_denom
+        # psums them over the data axis), so the update equals the
+        # single-device batched step exactly; each table's counts are
+        # sized by its OWN row count (they differ for ParagraphVectors)
+        idx_all = jnp.concatenate([contexts[:, None], negatives], axis=1)
+        w_all = jnp.broadcast_to(w[:, None], idx_all.shape)
+        den_c = _row_denom(syn0.shape[0], centers, w, syn0.dtype,
+                           psum_axis=data_axis)
+        den_u = _row_denom(syn1neg.shape[0], idx_all, w_all, syn0.dtype,
+                           psum_axis=data_axis)
+        d0 = jnp.zeros_like(syn0).at[centers].add(
+            lr * dv / den_c[centers][:, None])
+        d1 = jnp.zeros_like(syn1neg).at[contexts].add(
+            lr * du_pos / den_u[contexts][:, None])
+        d1 = d1.at[negatives].add(lr * du_neg / den_u[negatives][..., None])
         d0 = jax.lax.psum(d0, data_axis)
         d1 = jax.lax.psum(d1, data_axis)
         loss_sum = -(jnp.sum(jnp.log(jax.nn.sigmoid(s_pos) + 1e-10) * w)
@@ -92,8 +107,15 @@ def make_sharded_hs_step(mesh: Mesh, data_axis: str = "data",
         g = (1.0 - codes - jax.nn.sigmoid(s)) * cm
         dv = jnp.einsum("bl,bld->bd", g, u)
         du = g[..., None] * v[:, None, :]
-        d0 = jnp.zeros_like(syn0).at[centers].add(lr * dv)
-        d1 = jnp.zeros_like(syn1).at[points].add(lr * du)
+        # capped accumulation with global counts (matches engine._hs_step)
+        den_c = _row_denom(syn0.shape[0], centers, w, syn0.dtype,
+                           psum_axis=data_axis)
+        den_p = _row_denom(syn1.shape[0], points, cm, syn1.dtype,
+                           psum_axis=data_axis)
+        d0 = jnp.zeros_like(syn0).at[centers].add(
+            lr * dv / den_c[centers][:, None])
+        d1 = jnp.zeros_like(syn1).at[points].add(
+            lr * du / den_p[points][..., None])
         d0 = jax.lax.psum(d0, data_axis)
         d1 = jax.lax.psum(d1, data_axis)
         p = jax.nn.sigmoid(jnp.where(codes > 0, -s, s))
@@ -133,9 +155,20 @@ def make_sharded_cbow_step(mesh: Mesh, data_axis: str = "data",
         du_pos = g_pos[:, None] * h
         du_neg = g_neg[..., None] * h[:, None, :]
         dctx = (dh[:, None, :] * m) / cnt[..., None]
-        d0 = jnp.zeros_like(syn0).at[ctx].add(lr * dctx)
-        d1 = jnp.zeros_like(syn1neg).at[centers].add(lr * du_pos)
-        d1 = d1.at[negatives].add(lr * du_neg)
+        # capped accumulation with global counts (matches engine
+        # _cbow_sgns_step)
+        wc = ctx_mask * w[:, None]
+        den_ctx = _row_denom(syn0.shape[0], ctx, wc, syn0.dtype,
+                             psum_axis=data_axis)
+        idx_all = jnp.concatenate([centers[:, None], negatives], axis=1)
+        w_all = jnp.broadcast_to(w[:, None], idx_all.shape)
+        den_u = _row_denom(syn1neg.shape[0], idx_all, w_all, syn1neg.dtype,
+                           psum_axis=data_axis)
+        d0 = jnp.zeros_like(syn0).at[ctx].add(
+            lr * dctx / den_ctx[ctx][..., None])
+        d1 = jnp.zeros_like(syn1neg).at[centers].add(
+            lr * du_pos / den_u[centers][:, None])
+        d1 = d1.at[negatives].add(lr * du_neg / den_u[negatives][..., None])
         d0 = jax.lax.psum(d0, data_axis)
         d1 = jax.lax.psum(d1, data_axis)
         loss_sum = -(jnp.sum(jnp.log(jax.nn.sigmoid(s_pos) + 1e-10) * w)

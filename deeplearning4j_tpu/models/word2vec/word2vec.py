@@ -24,14 +24,15 @@ class Word2Vec(SequenceVectors):
                  sampling: float = 0.0, batch_size: int = 4096,
                  elements_learning_algorithm: str = "skipgram",
                  tokenizer_factory: Optional[TokenizerFactory] = None,
-                 seed: int = 123):
+                 seed: int = 123, device_pairgen: bool = True):
         super().__init__(
             vector_length=layer_size, window=window_size,
             min_word_frequency=min_word_frequency, epochs=epochs,
             learning_rate=learning_rate, min_learning_rate=min_learning_rate,
             negative=negative_sample, use_hierarchic_softmax=use_hierarchic_softmax,
             subsampling=sampling, batch_size=batch_size,
-            elements_learning_algorithm=elements_learning_algorithm, seed=seed)
+            elements_learning_algorithm=elements_learning_algorithm, seed=seed,
+            device_pairgen=device_pairgen)
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
 
     def _tokenize(self, corpus) -> List[List[str]]:

@@ -74,13 +74,19 @@ def _preds_shape(model, ds: DataSet):
     return len(out.shape), out.shape[-1]
 
 
-def _check_sparse_ids(y: np.ndarray, preds_rank: int, width: int):
+def _check_sparse_ids(y: np.ndarray, preds_rank: int, width: int,
+                      valid: np.ndarray):
     """Same loud contract as host ``Evaluation.eval`` (ADVICE r2): an
     id >= the prediction width must raise, not silently fall out of the
-    device one-hot (which emits an all-zero row for out-of-range ids)."""
-    if y.ndim == preds_rank - 1 and y.size and y.max() >= width:
+    device one-hot (which emits an all-zero row for out-of-range ids).
+    Only UNMASKED entries are checked — masked-out padding may carry
+    any sentinel value and is already excluded from the counts."""
+    if y.ndim != preds_rank - 1 or not y.size:
+        return
+    live = y[valid > 0]
+    if live.size and live.max() >= width:
         raise ValueError(
-            f"sparse label id {int(y.max())} is out of range for "
+            f"sparse label id {int(live.max())} is out of range for "
             f"predictions with {width} classes (valid ids: "
             f"0..{width - 1}; negative ids mean ignore-index)")
 
@@ -253,7 +259,7 @@ def evaluate_sharded(model, data: Union[DataSet, DataSetIterator],
         if rank is None:
             rank, width = _preds_shape(model, ds)
         x, y, valid = _flatten_with_valid(ds, rank)
-        _check_sparse_ids(y, rank, width)
+        _check_sparse_ids(y, rank, width, valid)
         x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid)
         xs, ys, vs = ctx.shard_batch(x, y, valid)
         counts = np.asarray(program(params, states, xs, ys, vs))

@@ -22,8 +22,12 @@ CORPUS = [
 
 def _fit(mesh=None, model_axis="model", **kw):
     from deeplearning4j_tpu.models.sequencevectors.engine import SequenceVectors
+    # device_pairgen=False: both sides must run the identical host
+    # per-batch pair stream for exact equivalence (the scan path draws
+    # its pairs/negatives from a different on-device RNG stream)
     sv = SequenceVectors(vector_length=16, window=2, epochs=2, batch_size=64,
-                         seed=99, mesh=mesh, model_axis=model_axis, **kw)
+                         seed=99, mesh=mesh, model_axis=model_axis,
+                         device_pairgen=False, **kw)
     sv.fit(CORPUS)
     return sv
 

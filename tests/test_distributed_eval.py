@@ -206,3 +206,28 @@ def test_sparse_label_out_of_range_raises_in_sharded_eval(rng):
     bad = np.array([0, 1, 2, 7, 0, 1, 2, 0], np.float32)  # 7 >= 3 classes
     with pytest.raises(ValueError, match="sparse label id 7"):
         evaluate_sharded(net, DataSet(x, bad))
+
+
+def test_masked_sentinel_ids_do_not_raise(rng):
+    """Out-of-range ids at MASKED timesteps are padding sentinels, not
+    errors — only unmasked entries are validated."""
+    conf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+            .updater("sgd").activation("tanh")
+            .list()
+            .layer(GravesLSTM(n_in=4, n_out=8))
+            .layer(RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    b, t = 8, 5
+    x = rng.standard_normal((b, t, 4)).astype(np.float32)
+    ids = rng.integers(0, 3, (b, t)).astype(np.float32)
+    mask = np.ones((b, t), np.float32)
+    mask[:, -2:] = 0.0
+    ids[:, -2:] = 99.0  # sentinel well past the class width, masked out
+    dist = evaluate_sharded(net, DataSet(x, ids, labels_mask=mask))
+    assert dist.confusion.counts.sum() == int(mask.sum())
+    # but an UNMASKED out-of-range id still raises
+    ids2 = ids.copy(); ids2[0, 0] = 99.0
+    with pytest.raises(ValueError, match="sparse label id 99"):
+        evaluate_sharded(net, DataSet(x, ids2, labels_mask=mask))

@@ -140,6 +140,64 @@ class TestWord2Vec:
         nearest = w2v.words_nearest("apple", 3)
         assert set(nearest) <= {"banana", "cherry", "mango"}, nearest
 
+    @pytest.mark.parametrize("device_pairgen", [True, False])
+    def test_zipf_large_batch_stays_bounded(self, device_pairgen):
+        """Divergence regression: with a zipf head word occurring
+        hundreds of times per batch, unbounded scatter-sum accumulation
+        blew the tables up to inf (both engine paths, any batch >~1k on
+        natural-text frequencies). Capped accumulation (engine._sgns_math)
+        must keep the loss finite and decreasing."""
+        rng = np.random.default_rng(0)
+        vocab = 200
+        probs = 1.0 / np.arange(1, vocab + 1)
+        probs /= probs.sum()
+        sents = [[f"w{t}" for t in rng.choice(vocab, 20, p=probs)]
+                 for _ in range(600)]
+        w2v = Word2Vec(layer_size=32, window_size=5, epochs=3, batch_size=8192,
+                       negative_sample=5, seed=1,
+                       device_pairgen=device_pairgen)
+        w2v.fit(sents)
+        hist = w2v._loss_history
+        assert np.isfinite(hist).all(), hist[-3:]
+        assert hist[-1] < hist[0] - 0.3, (hist[0], hist[-1])
+        assert np.abs(w2v.lookup_table.syn0).max() < 50.0
+
+    def test_sgns_math_mismatched_table_sizes(self):
+        """ParagraphVectors trains doc vectors (syn0, n_docs rows)
+        against the word output table (syn1neg, V rows >> n_docs); the
+        cap denominators must be sized per-table or word ids beyond
+        n_docs get dropped/clamped."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.models.sequencevectors.engine import _sgns_math
+
+        rng = np.random.default_rng(3)
+        n_docs, V, d, B, K = 4, 40, 8, 16, 3
+        syn0 = jnp.asarray(rng.standard_normal((n_docs, d)), jnp.float32)
+        syn1 = jnp.asarray(rng.standard_normal((V, d)), jnp.float32)
+        centers = jnp.asarray(rng.integers(0, n_docs, B), jnp.int32)
+        contexts = jnp.asarray(rng.integers(n_docs, V, B), jnp.int32)
+        negatives = jnp.asarray(rng.integers(n_docs, V, (B, K)), jnp.int32)
+        w = jnp.ones(B, jnp.float32)
+        for dense in (False, True):
+            s0, s1, _ = _sgns_math(syn0, syn1, centers, contexts, negatives,
+                                   jnp.float32(0.1), w, dense)
+            # every context row >= n_docs must actually receive an update
+            touched = np.unique(np.asarray(contexts))
+            diff = np.abs(np.asarray(s1) - np.asarray(syn1)).sum(axis=1)
+            assert (diff[touched] > 0).all(), (dense, touched, diff[touched])
+
+    def test_scan_and_host_paths_agree_on_structure(self):
+        """The device-pairgen scan path and the host per-batch path use
+        different RNG streams so vectors differ, but both must learn
+        the same topical structure."""
+        for dp in (True, False):
+            w2v = Word2Vec(layer_size=24, window_size=3, epochs=12,
+                           batch_size=128, seed=7, device_pairgen=dp)
+            w2v.fit(_toy_corpus())
+            in_topic = w2v.similarity("apple", "banana")
+            cross = w2v.similarity("apple", "car")
+            assert in_topic > cross + 0.1, (dp, in_topic, cross)
+
 
 class TestSerializer:
     def _small_wv(self):
