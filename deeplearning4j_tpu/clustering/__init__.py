@@ -1,4 +1,14 @@
 from deeplearning4j_tpu.clustering.kmeans import Cluster, ClusterSet, KMeansClustering  # noqa: F401
+from deeplearning4j_tpu.clustering.algorithm import (  # noqa: F401
+    BaseClusteringAlgorithm,
+    ClusteringOptimizationType,
+    ClusteringStrategy,
+    ConvergenceCondition,
+    FixedClusterCountStrategy,
+    FixedIterationCountCondition,
+    OptimisationStrategy,
+    VarianceVariationCondition,
+)
 from deeplearning4j_tpu.clustering.kdtree import KDTree  # noqa: F401
 from deeplearning4j_tpu.clustering.vptree import VPTree  # noqa: F401
 from deeplearning4j_tpu.clustering.sptree import (  # noqa: F401

@@ -1,0 +1,505 @@
+"""Iterative clustering algorithm framework.
+
+Parity: ``clustering/algorithm/`` (SURVEY.md §2.3, VERDICT r2 missing
+item #1) — ``BaseClusteringAlgorithm.java`` driving a
+``ClusteringStrategy`` (``strategy/ClusteringStrategy.java``,
+``FixedClusterCountStrategy.java``, ``OptimisationStrategy.java``) to a
+termination ``ClusteringAlgorithmCondition``
+(``condition/ConvergenceCondition.java``,
+``FixedIterationCountCondition.java``, ``VarianceVariationCondition.java``),
+with per-iteration stats in an ``IterationHistory``
+(``iteration/IterationHistory.java``) and cluster-splitting
+optimizations (``optimisation/ClusteringOptimization.java``,
+``ClusterUtils.applyOptimization`` :215).
+
+TPU-first split: the O(n·k·d) work per iteration — point-to-center
+distances, assignment, center means, the distance/variance statistics —
+is ONE device program over the full point matrix (the reference loops
+``List<Point>`` on the JVM heap across an ExecutorService); the O(k)
+strategy control flow (dropping empty clusters, splitting spread-out
+ones — which changes k, i.e. array shapes) stays host-side where
+dynamic shapes belong.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.kmeans import ClusterSet, KMeansClustering
+
+
+# ------------------------------------------------------------- iteration info
+
+class ClusterSetInfo:
+    """Per-iteration statistics (``cluster/info/ClusterSetInfo.java``
+    role), computed vectorized from the [n, k] distance matrix."""
+
+    def __init__(self, points_count: int, cluster_point_counts: np.ndarray,
+                 average_point_distance: np.ndarray,
+                 max_point_distance: np.ndarray,
+                 distance_variance: float, point_location_change: int):
+        self.points_count = points_count
+        self.cluster_point_counts = cluster_point_counts    # [k]
+        self.average_point_distance = average_point_distance  # [k]
+        self.max_point_distance = max_point_distance          # [k]
+        #: variance of every point's distance to its cluster center
+        #: (``getPointDistanceFromClusterVariance`` role)
+        self.point_distance_from_cluster_variance = distance_variance
+        #: how many points changed cluster since the previous iteration
+        #: (``getPointLocationChange`` role)
+        self.point_location_change = point_location_change
+
+    @property
+    def cluster_count(self) -> int:
+        return len(self.cluster_point_counts)
+
+
+class IterationInfo:
+    """``iteration/IterationInfo.java``: one iteration's index + stats +
+    whether a strategy (split/drop) mutated the cluster set."""
+
+    def __init__(self, index: int, cluster_set_info: ClusterSetInfo):
+        self.index = index
+        self.cluster_set_info = cluster_set_info
+        self.strategy_applied = False
+
+
+class IterationHistory:
+    """``iteration/IterationHistory.java``: iteration index → info."""
+
+    def __init__(self):
+        self.iterations: Dict[int, IterationInfo] = {}
+
+    def add(self, info: IterationInfo) -> None:
+        self.iterations[info.index] = info
+
+    def get_iteration_count(self) -> int:
+        return len(self.iterations)
+
+    def get_iteration_info(self, index: int) -> Optional[IterationInfo]:
+        return self.iterations.get(index)
+
+    def get_most_recent_iteration_info(self) -> Optional[IterationInfo]:
+        if not self.iterations:
+            return None
+        return self.iterations[max(self.iterations)]
+
+    def get_most_recent_cluster_set_info(self) -> Optional[ClusterSetInfo]:
+        info = self.get_most_recent_iteration_info()
+        return info.cluster_set_info if info else None
+
+
+# ----------------------------------------------------------------- conditions
+
+class ClusteringAlgorithmCondition:
+    """``condition/ClusteringAlgorithmCondition.java`` SPI."""
+
+    def is_satisfied(self, history: IterationHistory) -> bool:
+        raise NotImplementedError
+
+
+class FixedIterationCountCondition(ClusteringAlgorithmCondition):
+    """``condition/FixedIterationCountCondition.java``."""
+
+    def __init__(self, count: int):
+        self.count = count
+
+    @staticmethod
+    def iteration_count_greater_than(count: int) -> "FixedIterationCountCondition":
+        return FixedIterationCountCondition(count)
+
+    def is_satisfied(self, history: IterationHistory) -> bool:
+        return history.get_iteration_count() >= self.count
+
+
+class ConvergenceCondition(ClusteringAlgorithmCondition):
+    """``condition/ConvergenceCondition.java``: the fraction of points
+    that changed cluster last iteration drops below ``rate``."""
+
+    def __init__(self, rate: float):
+        self.rate = rate
+
+    @staticmethod
+    def distribution_variation_rate_less_than(rate: float) -> "ConvergenceCondition":
+        return ConvergenceCondition(rate)
+
+    def is_satisfied(self, history: IterationHistory) -> bool:
+        if history.get_iteration_count() <= 1:
+            return False
+        info = history.get_most_recent_cluster_set_info()
+        variation = info.point_location_change / max(info.points_count, 1)
+        return variation < self.rate
+
+
+class VarianceVariationCondition(ClusteringAlgorithmCondition):
+    """``condition/VarianceVariationCondition.java``: the relative
+    change of the point-distance variance stays below ``variation`` for
+    each of the last ``period`` iterations."""
+
+    def __init__(self, variation: float, period: int):
+        self.variation = variation
+        self.period = period
+
+    @staticmethod
+    def variance_variation_less_than(variation: float,
+                                     period: int) -> "VarianceVariationCondition":
+        return VarianceVariationCondition(variation, period)
+
+    def is_satisfied(self, history: IterationHistory) -> bool:
+        n = history.get_iteration_count()
+        if n <= self.period:
+            return False
+        # iterations are recorded at indices 1..n (reference loop
+        # ``getIterationInfo(j - i)`` with j = iterationCount)
+        for i in range(self.period):
+            cur = history.get_iteration_info(n - i)
+            prev = history.get_iteration_info(n - i - 1)
+            if cur is None or prev is None:
+                return False
+            pv = prev.cluster_set_info.point_distance_from_cluster_variance
+            cv = cur.cluster_set_info.point_distance_from_cluster_variance
+            if pv == 0:
+                return False
+            if abs((cv - pv) / pv) >= self.variation:
+                return False
+        return True
+
+
+# --------------------------------------------------------------- optimization
+
+class ClusteringOptimizationType(enum.Enum):
+    """``optimisation/ClusteringOptimizationType.java`` (5 members; as
+    in the reference, ``applyOptimization`` acts on the two
+    point-to-center types — ``ClusterUtils.java:215-235`` silently
+    no-ops the rest)."""
+
+    MINIMIZE_AVERAGE_POINT_TO_CENTER_DISTANCE = "avg_center"
+    MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE = "max_center"
+    MINIMIZE_AVERAGE_POINT_TO_POINT_DISTANCE = "avg_point"
+    MINIMIZE_MAXIMUM_POINT_TO_POINT_DISTANCE = "max_point"
+    MINIMIZE_PER_CLUSTER_POINT_COUNT = "point_count"
+
+
+class ClusteringOptimization:
+    """``optimisation/ClusteringOptimization.java``: (type, value)."""
+
+    def __init__(self, type: ClusteringOptimizationType, value: float):
+        self.type = type
+        self.value = value
+
+
+# ----------------------------------------------------------------- strategies
+
+class ClusteringStrategyType(enum.Enum):
+    FIXED_CLUSTER_COUNT = "fixed"
+    OPTIMIZATION = "optimization"
+
+
+class ClusteringStrategy:
+    """``strategy/BaseClusteringStrategy.java``: declarative spec the
+    algorithm runs — cluster count, distance, termination condition and
+    (for ``OptimisationStrategy``) a split optimization + its
+    application condition."""
+
+    def __init__(self, type: ClusteringStrategyType, initial_cluster_count: int,
+                 distance_function: str = "euclidean",
+                 allow_empty_clusters: bool = False):
+        self.type = type
+        self.initial_cluster_count = initial_cluster_count
+        self.distance_function = distance_function
+        self.allow_empty_clusters = allow_empty_clusters
+        self.termination_condition: Optional[ClusteringAlgorithmCondition] = None
+
+    # builder verbs (``endWhen…`` in the reference)
+    def end_when_iteration_count_equals(self, n: int) -> "ClusteringStrategy":
+        self.termination_condition = \
+            FixedIterationCountCondition.iteration_count_greater_than(n)
+        return self
+
+    def end_when_distribution_variation_rate_less_than(self, rate: float) -> "ClusteringStrategy":
+        self.termination_condition = \
+            ConvergenceCondition.distribution_variation_rate_less_than(rate)
+        return self
+
+    def is_strategy_of_type(self, t: ClusteringStrategyType) -> bool:
+        return self.type is t
+
+    def is_optimization_defined(self) -> bool:
+        return False
+
+    def is_optimization_applicable_now(self, history: IterationHistory) -> bool:
+        return False
+
+
+class FixedClusterCountStrategy(ClusteringStrategy):
+    """``strategy/FixedClusterCountStrategy.java``: keep exactly k
+    clusters; empty ones are dropped and the most spread-out clusters
+    split to restore the count."""
+
+    DEFAULT_ITERATION_COUNT = 100
+
+    def __init__(self, cluster_count: int, distance_function: str,
+                 allow_empty_clusters: bool = False):
+        super().__init__(ClusteringStrategyType.FIXED_CLUSTER_COUNT,
+                         cluster_count, distance_function, allow_empty_clusters)
+
+    @staticmethod
+    def setup(cluster_count: int,
+              distance_function: str = "euclidean") -> "FixedClusterCountStrategy":
+        return FixedClusterCountStrategy(cluster_count, distance_function)
+
+
+class OptimisationStrategy(ClusteringStrategy):
+    """``strategy/OptimisationStrategy.java``: additionally split
+    clusters violating a distance bound, when an application condition
+    holds."""
+
+    DEFAULT_ITERATION_COUNT = 100
+
+    def __init__(self, initial_cluster_count: int, distance_function: str):
+        super().__init__(ClusteringStrategyType.OPTIMIZATION,
+                         initial_cluster_count, distance_function,
+                         allow_empty_clusters=False)
+        self.clustering_optimization: Optional[ClusteringOptimization] = None
+        self.optimization_application_condition: \
+            Optional[ClusteringAlgorithmCondition] = None
+
+    @staticmethod
+    def setup(initial_cluster_count: int,
+              distance_function: str = "euclidean") -> "OptimisationStrategy":
+        return OptimisationStrategy(initial_cluster_count, distance_function)
+
+    def optimize(self, type: ClusteringOptimizationType,
+                 value: float) -> "OptimisationStrategy":
+        self.clustering_optimization = ClusteringOptimization(type, value)
+        return self
+
+    def optimize_when_iteration_count_multiple_of(self, n: int) -> "OptimisationStrategy":
+        self.optimization_application_condition = \
+            FixedIterationCountCondition.iteration_count_greater_than(n)
+        return self
+
+    def optimize_when_point_distribution_variation_rate_less_than(
+            self, rate: float) -> "OptimisationStrategy":
+        self.optimization_application_condition = \
+            ConvergenceCondition.distribution_variation_rate_less_than(rate)
+        return self
+
+    def get_clustering_optimization_value(self) -> float:
+        return self.clustering_optimization.value
+
+    def is_clustering_optimization_type(self, t: ClusteringOptimizationType) -> bool:
+        return (self.clustering_optimization is not None
+                and self.clustering_optimization.type is t)
+
+    def is_optimization_defined(self) -> bool:
+        return self.clustering_optimization is not None
+
+    def is_optimization_applicable_now(self, history: IterationHistory) -> bool:
+        return (self.optimization_application_condition is not None
+                and self.optimization_application_condition.is_satisfied(history))
+
+
+# ------------------------------------------------------------------ algorithm
+
+def _distances(x: jnp.ndarray, c: jnp.ndarray, distance: str) -> jnp.ndarray:
+    """[n, k] TRUE distances (euclidean un-squared, unlike the k-means
+    inner loop, because strategy thresholds are metric values)."""
+    km = KMeansClustering(k=max(1, c.shape[0]), distance=distance)
+    d = km._distances(x, c)
+    if distance == "euclidean":
+        d = jnp.sqrt(jnp.maximum(d, 0.0))
+    return d
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _iteration_stats(d: jnp.ndarray, labels: jnp.ndarray,
+                     prev_labels: jnp.ndarray, x: jnp.ndarray, k: int):
+    """One device program: per-cluster counts/means/max distances, the
+    distance variance, the location-change count, and the new centers."""
+    n = d.shape[0]
+    one_hot = jax.nn.one_hot(labels, k, dtype=x.dtype)             # [n, k]
+    counts = jnp.sum(one_hot, axis=0)                              # [k]
+    mine = jnp.take_along_axis(d, labels[:, None], axis=1)[:, 0]   # [n]
+    sums = one_hot.T @ mine[:, None]                               # [k, 1]
+    avg = jnp.where(counts > 0, sums[:, 0] / jnp.maximum(counts, 1.0), 0.0)
+    mx = jnp.max(jnp.where(one_hot > 0, d, 0.0), axis=0)           # [k]
+    var = jnp.var(mine)
+    moved = jnp.sum((labels != prev_labels).astype(jnp.int32))
+    centers = one_hot.T @ x / jnp.maximum(counts[:, None], 1.0)
+    return counts, avg, mx, var, moved, centers
+
+
+class BaseClusteringAlgorithm:
+    """``BaseClusteringAlgorithm.java``: distance-weighted seeding →
+    iterate (classify → refresh centers → record stats → apply
+    strategy) until the termination condition holds with no strategy
+    mutation in the final iteration (``iterations()`` :96-105)."""
+
+    def __init__(self, strategy: ClusteringStrategy, seed: int = 123):
+        if strategy.termination_condition is None:
+            default = (FixedClusterCountStrategy.DEFAULT_ITERATION_COUNT
+                       if isinstance(strategy, (FixedClusterCountStrategy,
+                                                OptimisationStrategy))
+                       else 100)
+            strategy.end_when_iteration_count_equals(default)
+        self.strategy = strategy
+        self.seed = seed
+        self.history = IterationHistory()
+        self.centers: Optional[np.ndarray] = None
+
+    @staticmethod
+    def setup(strategy: ClusteringStrategy, seed: int = 123) -> "BaseClusteringAlgorithm":
+        return BaseClusteringAlgorithm(strategy, seed)
+
+    # ---- public entry (``applyTo`` :76) ----
+
+    def apply_to(self, points: np.ndarray) -> ClusterSet:
+        x = jnp.asarray(points, jnp.float32)
+        n = x.shape[0]
+        k = self.strategy.initial_cluster_count
+        if n < k:
+            raise ValueError(f"{n} points < cluster count {k}")
+        self.history = IterationHistory()
+        self._init_clusters(x)
+        self._iterations(x)
+        km = KMeansClustering(k=len(self.centers),
+                              distance=self.strategy.distance_function,
+                              seed=self.seed)
+        km.centers = self.centers
+        km.iterations_run = self.history.get_iteration_count()
+        return ClusterSet(km, np.asarray(points, np.float32))
+
+    # ---- seeding (``initClusters`` :107: distance-weighted pick) ----
+
+    def _init_clusters(self, x: jnp.ndarray) -> None:
+        rng = np.random.default_rng(self.seed)
+        xn = np.asarray(x, np.float64)
+        n = len(xn)
+        chosen = [int(rng.integers(n))]
+        while len(chosen) < self.strategy.initial_cluster_count:
+            c = jnp.asarray(xn[chosen], jnp.float32)
+            d = np.asarray(_distances(x, c, self.strategy.distance_function))
+            dmin = d.min(axis=1) ** 2
+            dmin[chosen] = 0.0
+            r = rng.random() * dmin.max()
+            idx = int(np.argmax(dmin >= r))
+            if idx in chosen:  # degenerate duplicates: fall back to farthest
+                idx = int(np.argmax(dmin))
+            chosen.append(idx)
+        self.centers = xn[chosen].astype(np.float32)
+
+    # ---- iteration loop (``iterations`` :96) ----
+
+    def _iterations(self, x: jnp.ndarray) -> None:
+        cond = self.strategy.termination_condition
+        prev_labels = np.full(x.shape[0], -1)
+        it = 0
+        while (not cond.is_satisfied(self.history)
+               or self.history.get_most_recent_iteration_info().strategy_applied):
+            it += 1
+            prev_labels = self._classify_and_refresh(x, it, prev_labels)
+            self._apply_strategy(x, it)
+            if it > 10_000:  # safety net; the reference loops forever here
+                break
+
+    def _classify_and_refresh(self, x: jnp.ndarray, it: int,
+                              prev_labels: np.ndarray) -> np.ndarray:
+        k = len(self.centers)
+        d = _distances(x, jnp.asarray(self.centers),
+                       self.strategy.distance_function)
+        labels = jnp.argmin(d, axis=1)
+        counts, avg, mx, var, moved, centers = _iteration_stats(
+            d, labels, jnp.asarray(prev_labels), x, k)
+        counts = np.asarray(counts)
+        # empty clusters keep their center (the strategy phase decides
+        # whether to drop them)
+        new_centers = np.array(centers)  # copy: device arrays are read-only
+        keep = counts > 0
+        new_centers[~keep] = self.centers[~keep]
+        self.centers = new_centers
+        info = ClusterSetInfo(
+            points_count=x.shape[0], cluster_point_counts=counts,
+            average_point_distance=np.asarray(avg),
+            max_point_distance=np.asarray(mx),
+            distance_variance=float(var), point_location_change=int(moved))
+        self.history.add(IterationInfo(it, info))
+        return np.asarray(labels)
+
+    # ---- strategy application (``applyClusteringStrategy`` :141) ----
+
+    def _apply_strategy(self, x: jnp.ndarray, it: int) -> None:
+        info = self.history.get_most_recent_cluster_set_info()
+        iteration = self.history.get_most_recent_iteration_info()
+        strategy = self.strategy
+        if not strategy.allow_empty_clusters:
+            empty = info.cluster_point_counts == 0
+            if empty.any():
+                self.centers = self.centers[~empty]
+                iteration.strategy_applied = True
+                if (strategy.is_strategy_of_type(
+                        ClusteringStrategyType.FIXED_CLUSTER_COUNT)
+                        and len(self.centers) < strategy.initial_cluster_count):
+                    self._split_most_spread_out(
+                        x, strategy.initial_cluster_count - len(self.centers))
+        if (strategy.is_optimization_defined() and it != 0
+                and strategy.is_optimization_applicable_now(self.history)):
+            if self._optimize(x):
+                iteration.strategy_applied = True
+
+    def _split_most_spread_out(self, x: jnp.ndarray, count: int) -> None:
+        """``ClusterUtils.splitMostSpreadOutClusters`` role: the widest
+        clusters donate their farthest member as a new center."""
+        for _ in range(count):
+            d = np.asarray(_distances(x, jnp.asarray(self.centers),
+                                      self.strategy.distance_function))
+            labels = d.argmin(axis=1)
+            mine = d[np.arange(len(labels)), labels]
+            spread = np.asarray([mine[labels == c].max() if (labels == c).any()
+                                 else 0.0 for c in range(len(self.centers))])
+            widest = int(spread.argmax())
+            members = np.flatnonzero(labels == widest)
+            far = members[mine[members].argmax()]
+            self.centers = np.concatenate(
+                [self.centers, np.asarray(x[far], np.float32)[None]])
+
+    def _optimize(self, x: jnp.ndarray) -> bool:
+        """``ClusterUtils.applyOptimization`` :215: split every cluster
+        whose average/maximum point-to-center distance exceeds the
+        optimization value."""
+        strategy: OptimisationStrategy = self.strategy  # type: ignore
+        info = self.history.get_most_recent_cluster_set_info()
+        if strategy.is_clustering_optimization_type(
+                ClusteringOptimizationType.MINIMIZE_AVERAGE_POINT_TO_CENTER_DISTANCE):
+            violating = info.average_point_distance > \
+                strategy.get_clustering_optimization_value()
+        elif strategy.is_clustering_optimization_type(
+                ClusteringOptimizationType.MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE):
+            violating = info.max_point_distance > \
+                strategy.get_clustering_optimization_value()
+        else:  # the remaining types are no-ops in the reference too
+            return False
+        violating = violating & (info.cluster_point_counts > 0)
+        if not violating.any():
+            return False
+        d = np.asarray(_distances(x, jnp.asarray(self.centers),
+                                  self.strategy.distance_function))
+        labels = d.argmin(axis=1)
+        mine = d[np.arange(len(labels)), labels]
+        new_centers = []
+        for c in np.flatnonzero(violating):
+            members = np.flatnonzero(labels == c)
+            if len(members) < 2:
+                continue
+            far = members[mine[members].argmax()]
+            new_centers.append(np.asarray(x[far], np.float32))
+        if not new_centers:
+            return False
+        self.centers = np.concatenate([self.centers, np.asarray(new_centers)])
+        return True

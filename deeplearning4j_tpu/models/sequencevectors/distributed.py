@@ -63,12 +63,15 @@ def make_sharded_sgns_step(mesh: Mesh, data_axis: str = "data",
         # capped accumulation with GLOBAL per-row counts (engine._row_denom
         # psums them over the data axis), so the update equals the
         # single-device batched step exactly; each table's counts are
-        # sized by its OWN row count (they differ for ParagraphVectors)
+        # sized by its OWN row count (they differ for ParagraphVectors).
+        # KEEP IN LOCKSTEP with engine._sgns_math's scatter branch — the
+        # sharded-vs-single equivalence test (test_distributed_embeddings,
+        # 8-device mesh) is the tripwire.
         idx_all = jnp.concatenate([contexts[:, None], negatives], axis=1)
         w_all = jnp.broadcast_to(w[:, None], idx_all.shape)
         den_c = _row_denom(syn0.shape[0], centers, w, syn0.dtype,
                            psum_axis=data_axis)
-        den_u = _row_denom(syn1neg.shape[0], idx_all, w_all, syn0.dtype,
+        den_u = _row_denom(syn1neg.shape[0], idx_all, w_all, syn1neg.dtype,
                            psum_axis=data_axis)
         d0 = jnp.zeros_like(syn0).at[centers].add(
             lr * dv / den_c[centers][:, None])

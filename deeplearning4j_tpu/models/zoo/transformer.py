@@ -157,6 +157,83 @@ def generate(net: MultiLayerNetwork, prompt_ids: np.ndarray,
     return np.asarray(out, np.int64)
 
 
+def gpt_stack_blocks(net: MultiLayerNetwork):
+    """Stage-stack the (identical) TransformerBlock params of a ``gpt``
+    net: every leaf gains a leading [n_layers] stage dim, the layout
+    ``parallel.pipeline.pipeline_apply`` shards over the ``pp`` axis."""
+    import jax
+    import jax.numpy as jnp
+
+    blocks = net.impls[1:-1]
+    trees = [net.params[b.name] for b in blocks]
+    return jax.tree.map(lambda *vs: jnp.stack(vs), *trees)
+
+
+def gpt_unstack_blocks(net: MultiLayerNetwork, stacked) -> None:
+    """Write stage-stacked block params back onto the net (inverse of
+    ``gpt_stack_blocks``) so the pipelined trainer and the sequential
+    container share one parameter store."""
+    import jax
+
+    for i, b in enumerate(net.impls[1:-1]):
+        net.params = {**net.params,
+                      b.name: jax.tree.map(lambda v, i=i: v[i], stacked)}
+
+
+def gpt_pipeline_loss_fn(net: MultiLayerNetwork, mesh, axis: str = "pp",
+                         microbatches: int = None):
+    """Pipelined LM loss for a ``gpt`` net: embedding and LM head run
+    replicated; the TransformerBlock stack runs as a GPipe microbatch
+    pipeline over the mesh ``axis`` (``parallel/pipeline.py`` — each
+    device holds one stage, activations rotate via ppermute).
+
+    Returns ``loss(p_emb, p_blocks, p_head, ids, labels)`` with
+    ``p_blocks`` stage-stacked ([n_layers] leading dim, from
+    ``gpt_stack_blocks``). Differentiable end-to-end — ``jax.grad``
+    yields the reverse-schedule backward pipeline, equal to the
+    sequential container's gradients (tested)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel.pipeline import pipeline_apply
+
+    emb, head = net.impls[0], net.impls[-1]
+    blk = net.impls[1]
+
+    def loss(p_emb, p_blocks, p_head, ids, labels):
+        from deeplearning4j_tpu.nn.layers.attention import xla_attention
+
+        z, _ = emb.forward(p_emb, ids, {}, False)
+        fn = lambda p, h: blk.forward(p, h, {}, False)[0]
+        with xla_attention():  # pallas can't run under the pp shard_map
+            z = pipeline_apply(p_blocks, fn, z, mesh, axis=axis,
+                               microbatches=microbatches)
+        return head.score(p_head, z.astype(jnp.float32), labels, {}, False)
+
+    return loss
+
+
+def gpt_pipelined_train_step(net: MultiLayerNetwork, mesh, axis: str = "pp",
+                             learning_rate: float = 1e-3,
+                             microbatches: int = None):
+    """Jitted SGD train step over (emb, stage-stacked blocks, head)
+    params with the block stack pipelined over ``axis``. Returns
+    ``step(p_emb, p_blocks, p_head, ids, labels) -> (params..., loss)``."""
+    import jax
+
+    loss_fn = gpt_pipeline_loss_fn(net, mesh, axis, microbatches)
+
+    @jax.jit
+    def step(p_emb, p_blocks, p_head, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            p_emb, p_blocks, p_head, ids, labels)
+        upd = lambda t, g: jax.tree.map(
+            lambda v, gv: v - learning_rate * gv, t, g)
+        return (upd(p_emb, grads[0]), upd(p_blocks, grads[1]),
+                upd(p_head, grads[2]), loss)
+
+    return step
+
+
 def gpt_train_flops_per_token(vocab_size: int, d_model: int, n_layers: int,
                               seq_len: int, ffn_mult: int = 4) -> float:
     """Per-token train FLOPs ≈ 6 * (params-ish MACs) + attention term."""

@@ -11,6 +11,7 @@ time over the mesh's ``seq`` axis with K/V blocks rotating over ICI.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Dict
 
 import jax
@@ -19,9 +20,27 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.layers.base import LayerImpl, register_impl
 from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.attention import scaled_dot_product_attention
 from deeplearning4j_tpu.ops.flash_attention import flash_attention
 from deeplearning4j_tpu.parallel.mesh import current_sequence_mesh
 from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+
+_FORCE_XLA: list = []
+
+
+@contextlib.contextmanager
+def xla_attention():
+    """Force the plain XLA attention formulation while tracing under
+    this context. Needed where a Pallas call can't apply — notably
+    inside the pipeline-parallel ``shard_map`` (pallas_call outputs
+    carry no varying-mesh-axes info, and pp stages hold short
+    per-microbatch activations where flash's memory advantage is moot
+    anyway)."""
+    _FORCE_XLA.append(True)
+    try:
+        yield
+    finally:
+        _FORCE_XLA.pop()
 
 
 def dispatch_attention(q, k, v, causal: bool, mask=None):
@@ -29,7 +48,10 @@ def dispatch_attention(q, k, v, causal: bool, mask=None):
     ring attention under an active sequence mesh (DP×SP when the mesh
     also has a 'data' axis), otherwise the flash Pallas kernel
     (key-validity masks fall back to the XLA path inside it; ring
-    blocks assume dense time, so masked inputs also stay off the ring)."""
+    blocks assume dense time, so masked inputs also stay off the ring).
+    An active ``xla_attention()`` context overrides both."""
+    if _FORCE_XLA:
+        return scaled_dot_product_attention(q, k, v, causal=causal, mask=mask)
     seq = current_sequence_mesh()
     if seq is not None and mask is None:
         mesh, axis = seq

@@ -1,0 +1,169 @@
+"""Clustering algorithm framework tests.
+
+Parity: ``clustering/algorithm/`` (VERDICT r2 missing #1) — strategy
+setup/termination/optimization semantics mirror
+``BaseClusteringAlgorithm.java`` / ``FixedClusterCountStrategy.java`` /
+``OptimisationStrategy.java`` and the three conditions.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    BaseClusteringAlgorithm,
+    ClusteringOptimizationType,
+    ConvergenceCondition,
+    FixedClusterCountStrategy,
+    FixedIterationCountCondition,
+    OptimisationStrategy,
+    VarianceVariationCondition,
+)
+from deeplearning4j_tpu.clustering.algorithm import (
+    ClusterSetInfo,
+    IterationHistory,
+    IterationInfo,
+)
+
+
+def _blobs(rng, k=3, per=50, d=4, spread=0.15):
+    centers = rng.standard_normal((k, d)) * 4.0
+    pts = np.concatenate([c + spread * rng.standard_normal((per, d))
+                          for c in centers])
+    return pts.astype(np.float32), centers
+
+
+def _info(var=1.0, moved=0, counts=(5, 5), n=10, avg=None, mx=None):
+    counts = np.asarray(counts)
+    k = len(counts)
+    return ClusterSetInfo(
+        points_count=n, cluster_point_counts=counts,
+        average_point_distance=np.asarray(avg if avg is not None else [0.5] * k),
+        max_point_distance=np.asarray(mx if mx is not None else [1.0] * k),
+        distance_variance=var, point_location_change=moved)
+
+
+def _history(infos):
+    h = IterationHistory()
+    for i, info in enumerate(infos, start=1):
+        h.add(IterationInfo(i, info))
+    return h
+
+
+class TestConditions:
+    def test_fixed_iteration_count(self):
+        cond = FixedIterationCountCondition.iteration_count_greater_than(3)
+        assert not cond.is_satisfied(_history([_info()] * 2))
+        assert cond.is_satisfied(_history([_info()] * 3))
+
+    def test_convergence_needs_two_iterations(self):
+        cond = ConvergenceCondition.distribution_variation_rate_less_than(0.1)
+        assert not cond.is_satisfied(_history([_info(moved=0)]))
+
+    def test_convergence_rate(self):
+        cond = ConvergenceCondition.distribution_variation_rate_less_than(0.1)
+        # 3/10 points moved -> 0.3 >= 0.1: not converged
+        assert not cond.is_satisfied(_history([_info(), _info(moved=3)]))
+        # 0/10 moved -> 0.0 < 0.1: converged
+        assert cond.is_satisfied(_history([_info(), _info(moved=0)]))
+
+    def test_variance_variation_over_period(self):
+        cond = VarianceVariationCondition.variance_variation_less_than(0.05, 2)
+        # needs more than `period` iterations
+        assert not cond.is_satisfied(_history([_info(var=1.0), _info(var=1.0)]))
+        # stable variance across the window: satisfied
+        assert cond.is_satisfied(
+            _history([_info(var=1.0), _info(var=1.01), _info(var=1.012)]))
+        # a >=5% jump inside the window: not satisfied
+        assert not cond.is_satisfied(
+            _history([_info(var=1.0), _info(var=1.5), _info(var=1.51)]))
+
+
+class TestFixedClusterCount:
+    def test_recovers_blobs(self, rng):
+        pts, _ = _blobs(rng, k=3)
+        strategy = (FixedClusterCountStrategy.setup(3, "euclidean")
+                    .end_when_distribution_variation_rate_less_than(0.01))
+        cs = BaseClusteringAlgorithm.setup(strategy, seed=7).apply_to(pts)
+        assert len(cs) == 3
+        sizes = sorted(len(c) for c in cs)
+        assert sizes == [50, 50, 50]
+
+    def test_iteration_count_termination(self, rng):
+        pts, _ = _blobs(rng, k=2, per=30)
+        strategy = (FixedClusterCountStrategy.setup(2, "euclidean")
+                    .end_when_iteration_count_equals(4))
+        algo = BaseClusteringAlgorithm.setup(strategy, seed=3)
+        algo.apply_to(pts)
+        assert algo.history.get_iteration_count() >= 4
+
+    def test_history_records_stats(self, rng):
+        pts, _ = _blobs(rng, k=2, per=20)
+        strategy = (FixedClusterCountStrategy.setup(2, "euclidean")
+                    .end_when_iteration_count_equals(3))
+        algo = BaseClusteringAlgorithm.setup(strategy, seed=1)
+        algo.apply_to(pts)
+        info = algo.history.get_most_recent_cluster_set_info()
+        assert info.points_count == 40
+        assert info.cluster_point_counts.sum() == 40
+        assert np.isfinite(info.point_distance_from_cluster_variance)
+        # converged: nobody moves on the last iteration
+        assert info.point_location_change == 0
+
+    def test_default_termination_installed(self, rng):
+        pts, _ = _blobs(rng, k=2, per=10)
+        algo = BaseClusteringAlgorithm.setup(
+            FixedClusterCountStrategy.setup(2), seed=5)
+        cs = algo.apply_to(pts)  # must terminate without explicit cond
+        assert len(cs) == 2
+
+
+class TestOptimisationStrategy:
+    def test_splits_to_meet_average_distance_bound(self, rng):
+        """Starting with fewer clusters than natural blobs, the
+        optimization splits wide clusters until the bound holds."""
+        pts, _ = _blobs(rng, k=4, per=40, spread=0.05)
+        strategy = (OptimisationStrategy.setup(2, "euclidean")
+                    .optimize(ClusteringOptimizationType.
+                              MINIMIZE_AVERAGE_POINT_TO_CENTER_DISTANCE, 1.0)
+                    .optimize_when_iteration_count_multiple_of(1)
+                    .end_when_distribution_variation_rate_less_than(0.01))
+        algo = BaseClusteringAlgorithm.setup(strategy, seed=11)
+        cs = algo.apply_to(pts)
+        assert len(cs) >= 4  # split up from the initial 2
+        info = algo.history.get_most_recent_cluster_set_info()
+        live = info.cluster_point_counts > 0
+        assert (info.average_point_distance[live] <= 1.0).all()
+
+    def test_no_split_when_bound_already_met(self, rng):
+        pts, _ = _blobs(rng, k=2, per=30, spread=0.05)
+        strategy = (OptimisationStrategy.setup(2, "euclidean")
+                    .optimize(ClusteringOptimizationType.
+                              MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE, 50.0)
+                    .optimize_when_iteration_count_multiple_of(1)
+                    .end_when_distribution_variation_rate_less_than(0.01))
+        cs = BaseClusteringAlgorithm.setup(strategy, seed=2).apply_to(pts)
+        assert len(cs) == 2
+
+    def test_unimplemented_types_are_noops(self, rng):
+        """Reference parity: ClusterUtils.applyOptimization only acts on
+        the two point-to-center types (ClusterUtils.java:215-235)."""
+        pts, _ = _blobs(rng, k=2, per=20)
+        strategy = (OptimisationStrategy.setup(2, "euclidean")
+                    .optimize(ClusteringOptimizationType.
+                              MINIMIZE_PER_CLUSTER_POINT_COUNT, 1.0)
+                    .optimize_when_iteration_count_multiple_of(1)
+                    .end_when_iteration_count_equals(3))
+        cs = BaseClusteringAlgorithm.setup(strategy, seed=2).apply_to(pts)
+        assert len(cs) == 2
+
+
+def test_cluster_set_result_api(rng):
+    """The framework returns the same queryable ClusterSet the direct
+    KMeansClustering path builds."""
+    pts, _ = _blobs(rng, k=2, per=25)
+    strategy = (FixedClusterCountStrategy.setup(2, "euclidean")
+                .end_when_distribution_variation_rate_less_than(0.01))
+    cs = BaseClusteringAlgorithm.setup(strategy, seed=9).apply_to(pts)
+    c = cs.cluster_of(pts[0])
+    assert 0 in c.point_indices
+    assert cs.total_average_distance() >= 0.0

@@ -114,3 +114,76 @@ def test_batch_divisibility_validated(rng):
     with pytest.raises(ValueError, match="not divisible"):
         pipeline_apply(params, _stage_fn,
                        jnp.zeros((7, 4), jnp.float32), mesh, microbatches=2)
+
+
+class TestPipelinedTransformer:
+    """VERDICT r2 #5: the pipeline must drive REAL TransformerBlock
+    stages, not a toy lambda — loss and gradients must equal the
+    sequential MultiLayerNetwork container."""
+
+    def _net(self):
+        from deeplearning4j_tpu.models.zoo.transformer import gpt
+        return gpt(vocab_size=64, d_model=16, n_layers=4, num_heads=2,
+                   max_len=16, compute_dtype="float32", seed=5).init()
+
+    def test_pipelined_gpt_loss_and_grads_equal_sequential(self, rng):
+        devs = _need(4)
+        from deeplearning4j_tpu.models.zoo.transformer import (
+            gpt_pipeline_loss_fn, gpt_stack_blocks)
+
+        net = self._net()
+        mesh = make_mesh({"pp": 4}, devices=devs[:4])
+        ids = rng.integers(0, 64, (8, 8)).astype(np.float32)
+        labels = np.roll(ids, -1, axis=1).astype(np.float32)
+
+        emb, head = net.impls[0], net.impls[-1]
+        blocks = net.impls[1:-1]
+        p_emb = net.params[emb.name]
+        p_head = net.params[head.name]
+        p_blocks = gpt_stack_blocks(net)
+
+        loss_pp = gpt_pipeline_loss_fn(net, mesh)
+
+        def loss_seq(p_emb, p_blocks, p_head, ids, labels):
+            z, _ = emb.forward(p_emb, jnp.asarray(ids), {}, False)
+            for i, b in enumerate(blocks):
+                z, _ = b.forward(jax.tree.map(lambda v, i=i: v[i], p_blocks),
+                                 z, {}, False)
+            return head.score(p_head, z.astype(jnp.float32),
+                              jnp.asarray(labels), {}, False)
+
+        args = (p_emb, p_blocks, p_head, jnp.asarray(ids), jnp.asarray(labels))
+        l_pp, g_pp = jax.value_and_grad(loss_pp, argnums=(0, 1, 2))(*args)
+        l_sq, g_sq = jax.value_and_grad(loss_seq, argnums=(0, 1, 2))(*args)
+        assert float(l_pp) == pytest.approx(float(l_sq), rel=1e-5)
+        flat_pp = jax.tree.leaves(g_pp)
+        flat_sq = jax.tree.leaves(g_sq)
+        for a, b in zip(flat_pp, flat_sq):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=1e-6)
+
+    def test_pipelined_gpt_trains(self, rng):
+        devs = _need(4)
+        from deeplearning4j_tpu.models.zoo.transformer import (
+            gpt_pipelined_train_step, gpt_stack_blocks, gpt_unstack_blocks)
+
+        net = self._net()
+        mesh = make_mesh({"pp": 4}, devices=devs[:4])
+        ids = rng.integers(0, 64, (8, 8)).astype(np.float32)
+        labels = np.roll(ids, -1, axis=1).astype(np.float32)
+        p_emb = net.params[net.impls[0].name]
+        p_head = net.params[net.impls[-1].name]
+        p_blocks = gpt_stack_blocks(net)
+        step = gpt_pipelined_train_step(net, mesh, learning_rate=0.05)
+        losses = []
+        for _ in range(8):
+            p_emb, p_blocks, p_head, loss = step(
+                p_emb, p_blocks, p_head, jnp.asarray(ids), jnp.asarray(labels))
+            losses.append(float(loss))
+        assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+        # round-trip the trained stages back onto the container
+        gpt_unstack_blocks(net, p_blocks)
+        net.params = {**net.params, net.impls[0].name: p_emb,
+                      net.impls[-1].name: p_head}
+        out = net.output(ids)
+        assert np.isfinite(out).all()
