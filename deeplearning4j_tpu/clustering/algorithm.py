@@ -472,30 +472,29 @@ class BaseClusteringAlgorithm:
     def _optimize(self, x: jnp.ndarray) -> bool:
         """``ClusterUtils.applyOptimization`` :215: split every cluster
         whose average/maximum point-to-center distance exceeds the
-        optimization value."""
+        optimization value. All statistics are recomputed against the
+        CURRENT centers (an empty-cluster drop earlier in this same
+        strategy pass renumbers clusters, so the history's per-cluster
+        arrays may be stale-indexed)."""
         strategy: OptimisationStrategy = self.strategy  # type: ignore
-        info = self.history.get_most_recent_cluster_set_info()
-        if strategy.is_clustering_optimization_type(
-                ClusteringOptimizationType.MINIMIZE_AVERAGE_POINT_TO_CENTER_DISTANCE):
-            violating = info.average_point_distance > \
-                strategy.get_clustering_optimization_value()
-        elif strategy.is_clustering_optimization_type(
-                ClusteringOptimizationType.MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE):
-            violating = info.max_point_distance > \
-                strategy.get_clustering_optimization_value()
-        else:  # the remaining types are no-ops in the reference too
-            return False
-        violating = violating & (info.cluster_point_counts > 0)
-        if not violating.any():
+        is_avg = strategy.is_clustering_optimization_type(
+            ClusteringOptimizationType.MINIMIZE_AVERAGE_POINT_TO_CENTER_DISTANCE)
+        is_max = strategy.is_clustering_optimization_type(
+            ClusteringOptimizationType.MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE)
+        if not (is_avg or is_max):  # remaining types: reference no-ops
             return False
         d = np.asarray(_distances(x, jnp.asarray(self.centers),
                                   self.strategy.distance_function))
         labels = d.argmin(axis=1)
         mine = d[np.arange(len(labels)), labels]
+        bound = strategy.get_clustering_optimization_value()
         new_centers = []
-        for c in np.flatnonzero(violating):
+        for c in range(len(self.centers)):
             members = np.flatnonzero(labels == c)
             if len(members) < 2:
+                continue
+            stat = mine[members].mean() if is_avg else mine[members].max()
+            if stat <= bound:
                 continue
             far = members[mine[members].argmax()]
             new_centers.append(np.asarray(x[far], np.float32))

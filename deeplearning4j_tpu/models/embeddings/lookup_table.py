@@ -37,14 +37,22 @@ class InMemoryLookupTable:
         self.syn1 = np.zeros((max(v - 1, 1), d), np.float32)
         self.syn1neg = np.zeros((v, d), np.float32)
 
-    def negative_table(self) -> np.ndarray:
-        """Unigram^0.75 sampling table (:66-74)."""
+    def negative_table(self, size: Optional[int] = None) -> np.ndarray:
+        """Unigram^0.75 sampling table (:66-74). ``size`` overrides the
+        configured table size (the device scan path asks for a smaller
+        one); ``max(1, ...)`` guarantees every vocab word at least one
+        slot, so the actual length is ``>= max(size, vocab words)``."""
+        if size is not None:
+            return self._build_table(size)
         if self._neg_table is None:
-            freqs = self.vocab.word_frequencies().astype(np.float64) ** 0.75
-            probs = freqs / freqs.sum()
-            counts = np.maximum(1, np.round(probs * self.negative_table_size)).astype(np.int64)
-            self._neg_table = np.repeat(np.arange(len(counts), dtype=np.int32), counts)
+            self._neg_table = self._build_table(self.negative_table_size)
         return self._neg_table
+
+    def _build_table(self, size: int) -> np.ndarray:
+        freqs = self.vocab.word_frequencies().astype(np.float64) ** 0.75
+        probs = freqs / freqs.sum()
+        counts = np.maximum(1, np.round(probs * size)).astype(np.int64)
+        return np.repeat(np.arange(len(counts), dtype=np.int32), counts)
 
 
 class WordVectors:

@@ -594,16 +594,13 @@ class SequenceVectors:
             flat, pos, slen = z(flat), z(pos), z(slen)
         total_steps = n_batches * self.epochs
 
-        # rebuild the unigram^0.75 table AT the device size (<=128k
-        # entries, a ~0.5MB one-time upload) rather than striding the
-        # big host table: max(1, ...) keeps every vocab word at least
-        # one slot, where a stride would deterministically drop most
-        # tail words from negative sampling entirely
-        freqs = self.vocab.word_frequencies().astype(np.float64) ** 0.75
-        probs = freqs / freqs.sum()
-        counts = np.maximum(1, np.round(probs * 131072)).astype(np.int64)
-        neg_table = jnp.asarray(
-            np.repeat(np.arange(len(counts), dtype=np.int32), counts))
+        # build the unigram^0.75 table at the device size rather than
+        # striding the big host table (a stride deterministically drops
+        # most tail words from negative sampling). The min-one-slot
+        # guarantee means the actual length is max(128k, vocab words) —
+        # ~0.5MB uploaded once for typical vocabs, linear in vocab size
+        # beyond 131072 words.
+        neg_table = jnp.asarray(lt.negative_table(size=131072))
         key = jax.random.PRNGKey(int(rng.integers(2**31)))
         flat_d, pos_d, slen_d = (jnp.asarray(flat), jnp.asarray(pos),
                                  jnp.asarray(slen))

@@ -191,13 +191,29 @@ def gpt_pipeline_loss_fn(net: MultiLayerNetwork, mesh, axis: str = "pp",
     ``p_blocks`` stage-stacked ([n_layers] leading dim, from
     ``gpt_stack_blocks``). Differentiable end-to-end — ``jax.grad``
     yields the reverse-schedule backward pipeline, equal to the
-    sequential container's gradients (tested)."""
+    sequential container's gradients (tested).
+
+    Scope: DENSE blocks only. MoE blocks carry a router aux loss in
+    layer state that the stage pipeline does not thread (it would
+    silently train a different objective than the container), so they
+    are rejected; dropout likewise runs 0 here (the gpt default)."""
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.parallel.pipeline import pipeline_apply
 
     emb, head = net.impls[0], net.impls[-1]
     blk = net.impls[1]
+    if getattr(blk.conf, "num_experts", 0) > 0:
+        raise NotImplementedError(
+            "pipelined GPT supports dense TransformerBlocks only: MoE "
+            "blocks carry a router aux loss in layer state that the "
+            "stage pipeline does not thread — train MoE via the "
+            "expert-parallel path (parallel.tensor_parallel.moe_ep_specs) "
+            "instead")
+    if getattr(blk, "dropout_rate", 0.0):
+        raise NotImplementedError(
+            "pipelined GPT runs blocks without dropout; build the net "
+            "with dropout=0")
 
     def loss(p_emb, p_blocks, p_head, ids, labels):
         from deeplearning4j_tpu.nn.layers.attention import xla_attention

@@ -187,3 +187,16 @@ class TestPipelinedTransformer:
                       net.impls[-1].name: p_head}
         out = net.output(ids)
         assert np.isfinite(out).all()
+
+    def test_moe_blocks_rejected(self, rng):
+        """MoE blocks carry router aux loss in state the pipeline does
+        not thread — they must be rejected loudly, not silently train a
+        different objective than the container."""
+        devs = _need(2)
+        from deeplearning4j_tpu.models.zoo.transformer import (
+            gpt, gpt_pipeline_loss_fn)
+        net = gpt(vocab_size=32, d_model=16, n_layers=2, num_heads=2,
+                  max_len=8, num_experts=2, compute_dtype="float32").init()
+        mesh = make_mesh({"pp": 2}, devices=devs[:2])
+        with pytest.raises(NotImplementedError, match="dense"):
+            gpt_pipeline_loss_fn(net, mesh)
