@@ -119,10 +119,17 @@ class AsyncDataSetIterator(DataSetIterator):
                     except queue.Full:
                         continue
         finally:
-            try:
-                q.put_nowait(self._SENTINEL)
-            except queue.Full:
-                pass
+            # the sentinel MUST reach the consumer or has_next() blocks
+            # forever: a put_nowait here silently dropped it whenever
+            # the queue was still full at exhaustion (source with
+            # >= queue_size+1 batches and a slow consumer) — block with
+            # the same stop-aware retry as the data puts
+            while not stop.is_set():
+                try:
+                    q.put(self._SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     def _start(self):
         if self._needs_reset:

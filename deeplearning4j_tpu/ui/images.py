@@ -1,0 +1,60 @@
+"""Minimal PNG encoding + activation-grid rasterization (stdlib only).
+
+Support code for the ``ConvolutionalIterationListener`` role
+(``deeplearning4j-ui/.../weights/ConvolutionalIterationListener.java:39``)
+— the reference rasterizes per-layer activation maps into images for
+the UI; this is the zero-dependency equivalent (PNG = zlib-deflated
+filter-0 scanlines + CRC'd chunks).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+
+def encode_png_gray(img: np.ndarray) -> bytes:
+    """8-bit grayscale PNG from a [h, w] uint8 (or castable) array."""
+    img = np.ascontiguousarray(np.asarray(img, np.uint8))
+    if img.ndim != 2:
+        raise ValueError(f"need [h, w] grayscale, got shape {img.shape}")
+    h, w = img.shape
+
+    def chunk(tag: bytes, payload: bytes) -> bytes:
+        return (struct.pack(">I", len(payload)) + tag + payload
+                + struct.pack(">I", zlib.crc32(tag + payload) & 0xFFFFFFFF))
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, 0, 0, 0, 0)  # gray, no interlace
+    raw = b"".join(b"\x00" + img[r].tobytes() for r in range(h))
+    return (b"\x89PNG\r\n\x1a\n"
+            + chunk(b"IHDR", ihdr)
+            + chunk(b"IDAT", zlib.compress(raw, 6))
+            + chunk(b"IEND", b""))
+
+
+def activation_grid(acts: np.ndarray, pad: int = 1,
+                    max_channels: int = 64) -> np.ndarray:
+    """[h, w, c] (or [b, h, w, c]: first example) activation maps tiled
+    into one [H, W] uint8 grid, each channel min-max normalized —
+    the reference's per-layer activation montage."""
+    a = np.asarray(acts, np.float32)
+    if a.ndim == 4:
+        a = a[0]
+    if a.ndim != 3:
+        raise ValueError(f"need [h, w, c] activations, got shape {a.shape}")
+    h, w, c = a.shape
+    c = min(c, max_channels)
+    cols = int(np.ceil(np.sqrt(c)))
+    rows = int(np.ceil(c / cols))
+    grid = np.zeros((rows * (h + pad) + pad, cols * (w + pad) + pad), np.uint8)
+    for i in range(c):
+        ch = a[:, :, i]
+        lo, hi = float(ch.min()), float(ch.max())
+        norm = (ch - lo) / (hi - lo) if hi > lo else np.zeros_like(ch)
+        r, col = divmod(i, cols)
+        y = pad + r * (h + pad)
+        x = pad + col * (w + pad)
+        grid[y:y + h, x:x + w] = (norm * 255).astype(np.uint8)
+    return grid

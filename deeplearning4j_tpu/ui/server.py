@@ -90,6 +90,12 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._words_nearest(query)
             if parts == ["words"]:
                 return self._html(self._words_page(query))
+            if parts == ["flow"]:
+                return self._flow_page()
+            if parts == ["api", "flow"]:
+                return self._flow_json()
+            if parts == ["activations"]:
+                return self._activations_page()
             return self._json({"error": "not found"}, 404)
         except Exception as e:  # surface handler bugs to the client, not the log
             return self._json({"error": f"{type(e).__name__}: {e}"}, 500)
@@ -106,6 +112,48 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"ok": True})
         except Exception as e:
             return self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+    def _flow_info(self):
+        """Model-graph info: from an attached FlowIterationListener's
+        latest snapshot, else built live from an attached model
+        (``ui/flow/FlowIterationListener.java`` view role)."""
+        from deeplearning4j_tpu.ui.activations import model_flow_info
+
+        fl = self.server._flow_listener  # type: ignore[attr-defined]
+        if fl is not None and fl.latest is not None:
+            return fl.latest
+        model = self.server._flow_model  # type: ignore[attr-defined]
+        if model is not None:
+            return model_flow_info(model, getattr(model, "_score", None))
+        return None
+
+    def _flow_json(self):
+        info = self._flow_info()
+        if info is None:
+            return self._json({"error": "no model attached"}, 404)
+        return self._json(info)
+
+    def _flow_page(self):
+        from deeplearning4j_tpu.ui.activations import render_flow_svg
+
+        info = self._flow_info()
+        if info is None:
+            return self._html("<p>(no model attached; pass model= or "
+                              "flow_listener= to UiServer)</p>")
+        return self._html(
+            "<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>model flow</title></head>"
+            "<body style='font-family:sans-serif'><h1>Model graph</h1>"
+            + render_flow_svg(info) + "</body></html>")
+
+    def _activations_page(self):
+        from deeplearning4j_tpu.ui.activations import render_activations_html
+
+        conv = self.server._conv_listener  # type: ignore[attr-defined]
+        if conv is None:
+            return self._html("<p>(no ConvolutionalIterationListener "
+                              "attached)</p>")
+        return self._html(render_activations_html(conv))
 
     def _words_nearest(self, query):
         """Nearest-neighbor serving for attached word vectors — the
@@ -186,14 +234,23 @@ class UiServer:
 
     def __init__(self, storage: StatsStorage, port: int = 0,
                  host: str = "127.0.0.1", verbose: bool = False,
-                 word_vectors=None):
+                 word_vectors=None, model=None, conv_listener=None,
+                 flow_listener=None):
         """``word_vectors``: any object with ``words_nearest(word, n)``
         (Word2Vec/WordVectors) — enables the /words nearest-neighbor
-        view (legacy dl4j-scaleout/deeplearning4j-nlp render role)."""
+        view (legacy dl4j-scaleout/deeplearning4j-nlp render role).
+        ``model``: a MultiLayerNetwork/ComputationGraph for the /flow
+        model-graph view (live snapshot); ``flow_listener`` /
+        ``conv_listener``: FlowIterationListener /
+        ConvolutionalIterationListener instances backing /flow and
+        /activations with training-time snapshots."""
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd._storage = storage  # type: ignore[attr-defined]
         self._httpd._verbose = verbose  # type: ignore[attr-defined]
         self._httpd._word_vectors = word_vectors  # type: ignore[attr-defined]
+        self._httpd._flow_model = model  # type: ignore[attr-defined]
+        self._httpd._conv_listener = conv_listener  # type: ignore[attr-defined]
+        self._httpd._flow_listener = flow_listener  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
