@@ -22,7 +22,6 @@ import base64
 import os
 from typing import Dict, List, Optional
 
-import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.optimize.listeners import IterationListener
@@ -52,12 +51,9 @@ class ConvolutionalIterationListener(IterationListener):
     def iteration_done(self, model, iteration: int, score: float):
         if iteration % self.frequency:
             return
-        x = jnp.asarray(self.probe, model._dtype)
-        acts, _ = model._forward(model.params, model.states, x, False,
-                                 None, None)
+        acts = model.feed_forward(self.probe)
         latest = {}  # built locally, assigned once: the UiServer thread
-        for impl, act in zip(model.impls, acts):  # iterates self.latest
-            a = np.asarray(act)
+        for impl, a in zip(model.impls, acts):  # iterates self.latest
             if a.ndim != 4:  # only spatial feature maps render
                 continue
             png = encode_png_gray(activation_grid(a, max_channels=self.max_channels))

@@ -1,0 +1,192 @@
+"""Berkeley-NLP utility collections.
+
+Parity: ``deeplearning4j-nn/.../berkeley/`` (13 files — Counter,
+CounterMap, PriorityQueue, Pair/Triple and friends vendored from the
+Berkeley NLP toolkit; SURVEY.md §2.1 "util + berkeley" row). Under
+Python most of that file count IS the standard library, so these are
+deliberately thin classes that keep the reference's API surface
+(``getCount``/``incrementCount``/``argMax``/``normalize``,
+priority-queue ``next``/``peek``) over ``dict``/``heapq`` machinery —
+the residual value is API familiarity for ported callers, not data
+structures.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Dict, Generic, Iterator, List, Optional, Tuple, TypeVar
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class Counter(Generic[K]):
+    """Float-valued counter (``berkeley/Counter.java``)."""
+
+    def __init__(self):
+        self._c: Dict[K, float] = {}
+
+    def get_count(self, key: K) -> float:
+        return self._c.get(key, 0.0)
+
+    def set_count(self, key: K, count: float) -> None:
+        self._c[key] = float(count)
+
+    def increment_count(self, key: K, amount: float = 1.0) -> None:
+        self._c[key] = self._c.get(key, 0.0) + amount
+
+    def increment_all(self, keys, amount: float = 1.0) -> None:
+        for k in keys:
+            self.increment_count(k, amount)
+
+    def total_count(self) -> float:
+        return sum(self._c.values())
+
+    def normalize(self) -> None:
+        total = self.total_count()
+        if total:
+            for k in self._c:
+                self._c[k] /= total
+
+    def arg_max(self) -> Optional[K]:
+        return max(self._c, key=self._c.get) if self._c else None
+
+    def max_count(self) -> float:
+        return max(self._c.values()) if self._c else 0.0
+
+    def key_set(self):
+        return self._c.keys()
+
+    def items(self):
+        return self._c.items()
+
+    def sorted_keys(self) -> List[K]:
+        """Keys by descending count (``Counter.getSortedKeys``)."""
+        return sorted(self._c, key=self._c.get, reverse=True)
+
+    def __len__(self) -> int:
+        return len(self._c)
+
+    def __contains__(self, key: K) -> bool:
+        return key in self._c
+
+
+class CounterMap(Generic[K, V]):
+    """Two-level counter (``berkeley/CounterMap.java``)."""
+
+    def __init__(self):
+        self._m: Dict[K, Counter[V]] = {}
+
+    def get_counter(self, key: K) -> Counter[V]:
+        if key not in self._m:
+            self._m[key] = Counter()
+        return self._m[key]
+
+    def get_count(self, key: K, value: V) -> float:
+        c = self._m.get(key)
+        return c.get_count(value) if c else 0.0
+
+    def increment_count(self, key: K, value: V, amount: float = 1.0) -> None:
+        self.get_counter(key).increment_count(value, amount)
+
+    def set_count(self, key: K, value: V, count: float) -> None:
+        self.get_counter(key).set_count(value, count)
+
+    def total_count(self) -> float:
+        return sum(c.total_count() for c in self._m.values())
+
+    def normalize(self) -> None:
+        """Row-normalize every inner counter (conditional distribution)."""
+        for c in self._m.values():
+            c.normalize()
+
+    def key_set(self):
+        return self._m.keys()
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+
+class PriorityQueue(Generic[K]):
+    """Max-priority queue with ``next``/``peek``/``has_next``
+    (``berkeley/PriorityQueue.java`` — descending priority order)."""
+
+    def __init__(self):
+        self._heap: List[Tuple[float, int, K]] = []
+        self._tie = itertools.count()
+
+    def add(self, item: K, priority: float) -> None:
+        heapq.heappush(self._heap, (-priority, next(self._tie), item))
+
+    def has_next(self) -> bool:
+        return bool(self._heap)
+
+    def peek(self) -> K:
+        return self._heap[0][2]
+
+    def get_priority(self) -> float:
+        return -self._heap[0][0]
+
+    def next(self) -> K:
+        return heapq.heappop(self._heap)[2]
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __iter__(self) -> Iterator[K]:
+        while self.has_next():
+            yield self.next()
+
+
+class Pair(Generic[K, V]):
+    """``berkeley/Pair.java`` (a named tuple with the reference's
+    accessor names, for ported call sites)."""
+
+    __slots__ = ("first", "second")
+
+    def __init__(self, first: K, second: V):
+        self.first = first
+        self.second = second
+
+    def get_first(self) -> K:
+        return self.first
+
+    def get_second(self) -> V:
+        return self.second
+
+    def __iter__(self):
+        return iter((self.first, self.second))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Pair) and self.first == other.first
+                and self.second == other.second)
+
+    def __hash__(self) -> int:
+        return hash((self.first, self.second))
+
+    def __repr__(self) -> str:
+        return f"Pair({self.first!r}, {self.second!r})"
+
+
+class Triple(Generic[K, V]):
+    """``berkeley/Triple.java``."""
+
+    __slots__ = ("first", "second", "third")
+
+    def __init__(self, first, second, third):
+        self.first = first
+        self.second = second
+        self.third = third
+
+    def __iter__(self):
+        return iter((self.first, self.second, self.third))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Triple) and tuple(self) == tuple(other))
+
+    def __hash__(self) -> int:
+        return hash(tuple(self))
+
+    def __repr__(self) -> str:
+        return f"Triple({self.first!r}, {self.second!r}, {self.third!r})"

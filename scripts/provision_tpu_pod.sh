@@ -1,63 +1,18 @@
 #!/usr/bin/env bash
-# TPU pod provisioning — the deeplearning4j-aws analog (SURVEY.md §2.6:
-# ec2/Ec2BoxCreator.java cluster create, provision/ClusterSetup.java
-# rsync+ssh fan-out, s3 data iterators). Where the reference spun up
-# EC2 boxes and rsynced jars, a TPU deployment creates ONE queued
-# multi-host TPU VM resource and runs the same command on every host;
-# jax.distributed + deeplearning4j_tpu.parallel.multihost discover the
-# mesh from the TPU runtime, so there is no Spark-master analog to
-# provision.
+# TPU pod provisioning — thin CLI over the TESTED command-plan builder
+# deeplearning4j_tpu/parallel/provisioning.py (the deeplearning4j-aws
+# analog: Ec2BoxCreator.java create-request construction +
+# ClusterSetup.java artifact fan-out; see that module's docstring for
+# the TPU re-design notes).
 #
 # Usage:
-#   ./provision_tpu_pod.sh create  <name> <zone> <accel-type> [version]
-#   ./provision_tpu_pod.sh setup   <name> <zone>          # ship the framework
-#   ./provision_tpu_pod.sh run     <name> <zone> -- <cmd> # run on ALL hosts
-#   ./provision_tpu_pod.sh delete  <name> <zone>
+#   ./provision_tpu_pod.sh create <name> <zone> <accel-type> [--spot]
+#   ./provision_tpu_pod.sh setup  <name> <zone>
+#   ./provision_tpu_pod.sh run    <name> <zone> --command '<cmd>'
+#   ./provision_tpu_pod.sh delete <name> <zone>
+#   ./provision_tpu_pod.sh plan   <name> <zone> <accel-type> [--command '<cmd>']
 #
-# Example (v5e-64, 16 hosts x 4 chips):
-#   ./provision_tpu_pod.sh create  dl4j-pod us-west4-a v5litepod-64
-#   ./provision_tpu_pod.sh setup   dl4j-pod us-west4-a
-#   ./provision_tpu_pod.sh run     dl4j-pod us-west4-a -- \
-#       python -m examples.train_resnet50 --data gs://my-bucket/imagenet
-#
-# Data plane: the S3 reader analog is a GCS-backed RecordReader — mount
-# via gcsfuse or stream with gsutil; see datavec/records.py.
-
+# Pass --dry-run to print the gcloud commands without executing.
 set -euo pipefail
-
-cmd=${1:?create|setup|run|delete}
-name=${2:?tpu name}
-zone=${3:?zone}
-
-case "$cmd" in
-  create)
-    accel=${4:?accelerator type, e.g. v5litepod-64}
-    version=${5:-tpu-ubuntu2204-base}
-    # queued resources survive capacity waits; --spot for preemptible
-    gcloud compute tpus queued-resources create "$name" \
-      --node-id "$name" --zone "$zone" \
-      --accelerator-type "$accel" --runtime-version "$version"
-    ;;
-  setup)
-    # ship the framework to every host (ClusterSetup.java rsync role);
-    # jax/libtpu ship preinstalled on TPU runtime images
-    tar czf /tmp/dl4j_tpu.tgz deeplearning4j_tpu tests bench.py pyproject.toml
-    gcloud compute tpus tpu-vm scp /tmp/dl4j_tpu.tgz "$name":~ \
-      --zone "$zone" --worker=all
-    gcloud compute tpus tpu-vm ssh "$name" --zone "$zone" --worker=all \
-      --command "tar xzf dl4j_tpu.tgz && python -c 'import deeplearning4j_tpu'"
-    ;;
-  run)
-    shift 3; [ "${1:-}" = "--" ] && shift
-    # same command on every host: the TPU runtime provides coordinator
-    # discovery; jax.distributed.initialize() no-args inside the program
-    gcloud compute tpus tpu-vm ssh "$name" --zone "$zone" --worker=all \
-      --command "$*"
-    ;;
-  delete)
-    gcloud compute tpus queued-resources delete "$name" --zone "$zone" --force
-    ;;
-  *)
-    echo "unknown command: $cmd" >&2; exit 2
-    ;;
-esac
+cd "$(dirname "$0")/.."
+exec python -m deeplearning4j_tpu.parallel.provisioning "$@"
