@@ -157,12 +157,17 @@ def resnet50_benchmark(peak_flops: float, batch: int = 128,
     mds = MultiDataSet([x], [y])
 
     staged = net.stage_scan(mds, batch)  # one host→device transfer
-    epochs = 3
+    # 6 epochs x 8 steps ≈ 2.5s device per dispatch, so the tunnel
+    # dispatch RTT stays a small fraction; best of 2 timed dispatches
+    # rides out pool contention (BASELINE.md amortization note)
+    epochs = 6
     # warm up the SAME epochs-baked program the timed run uses
     net.fit_scan(None, batch, epochs=epochs, staged=staged)
-    t0 = time.perf_counter()
-    scores = net.fit_scan(None, batch, epochs=epochs, staged=staged)
-    dt = time.perf_counter() - t0
+    dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        scores = net.fit_scan(None, batch, epochs=epochs, staged=staged)
+        dt = min(dt, time.perf_counter() - t0)
 
     n_examples = epochs * steps * batch
     eps = n_examples / dt

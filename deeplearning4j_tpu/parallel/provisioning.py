@@ -40,9 +40,10 @@ class TpuPodSpec:
     def __post_init__(self):
         for field in ("name", "zone", "accelerator_type", "runtime_version"):
             v = getattr(self, field)
-            if not v or any(c.isspace() for c in v):
-                raise ValueError(f"{field} must be a non-empty token, "
-                                 f"got {v!r}")
+            if not v or any(c.isspace() for c in v) or v.startswith("-"):
+                raise ValueError(
+                    f"{field} must be a non-empty token with no leading "
+                    f"'-' (gcloud would parse it as a flag), got {v!r}")
 
 
 class TpuPodProvisioner:
@@ -108,11 +109,15 @@ class TpuPodProvisioner:
     # ---- execution ----
 
     def execute(self, steps: Sequence[List[str]], dry_run: bool = True,
-                runner=subprocess.run) -> List[List[str]]:
+                runner=None) -> List[List[str]]:
         """Run (or with ``dry_run`` just return) the given steps;
-        ``runner`` is injectable for tests."""
+        ``runner`` is injectable for tests. Resolved at CALL time (a
+        def-time ``subprocess.run`` default would defeat monkeypatched
+        spies guarding the billable path)."""
         if dry_run:
             return [list(s) for s in steps]
+        if runner is None:
+            runner = subprocess.run
         for step in steps:
             runner(step, check=True)
         return [list(s) for s in steps]

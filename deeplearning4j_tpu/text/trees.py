@@ -108,10 +108,8 @@ class ShallowTreeParser:
 
         def flush():
             nonlocal run, run_label
-            if run:
-                chunks.append(Tree(run_label, run) if run_label
-                              else run[0] if len(run) == 1
-                              else Tree("X", run))
+            if run:  # run_label is always set when run is non-empty
+                chunks.append(Tree(run_label, run))
                 run, run_label = [], None
 
         def chunk_of(pos: str) -> Optional[str]:

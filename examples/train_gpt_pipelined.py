@@ -1,0 +1,74 @@
+"""Pipeline-parallel GPT training — GPipe stages over the mesh.
+
+The block stack of a ``gpt()`` zoo net is stage-stacked (one
+TransformerBlock per device along the ``pp`` mesh axis) and trained
+through the ppermute microbatch pipeline
+(``parallel/pipeline.py`` + ``models/zoo/transformer.py`` pipelined
+mode); embedding and LM head stay replicated. Gradients equal the
+sequential container's (tests/test_pipeline.py), so the trained stages
+round-trip back onto the plain model for serving.
+"""
+
+import argparse
+
+import numpy as np
+
+from deeplearning4j_tpu.models.zoo.transformer import (
+    gpt,
+    gpt_pipelined_train_step,
+    gpt_stack_blocks,
+    gpt_unstack_blocks,
+)
+
+_TEXT = ("the quick brown fox jumps over the lazy dog. "
+         "she sells sea shells by the sea shore. ") * 200
+
+
+def main(smoke: bool = False, stages: int = 4):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    devs = jax.devices()
+    stages = min(stages, len(devs))
+    mesh = make_mesh({"pp": stages}, devices=devs[:stages])
+
+    data_ids = np.frombuffer(_TEXT.encode(), np.uint8).astype(np.int64)
+    vocab = 256
+    seq, d, steps = (16, 32, 3) if smoke else (128, 128, 30)
+    n = (len(data_ids) - 1) // seq * seq
+    x = data_ids[:n].reshape(-1, seq).astype(np.float32)
+    y = data_ids[1:n + 1].reshape(-1, seq).astype(np.float32)
+    batch = 4 * stages  # divisible into the default microbatch count
+
+    net = gpt(vocab_size=vocab, d_model=d, n_layers=stages, num_heads=4,
+              max_len=seq, compute_dtype="float32").init()
+    p_emb = net.params[net.impls[0].name]
+    p_head = net.params[net.impls[-1].name]
+    p_blocks = gpt_stack_blocks(net)
+    step = gpt_pipelined_train_step(net, mesh, learning_rate=1e-2)
+
+    losses = []
+    ids = jnp.asarray(x[:batch])
+    labels = jnp.asarray(y[:batch])
+    for _ in range(steps):
+        p_emb, p_blocks, p_head, loss = step(p_emb, p_blocks, p_head,
+                                             ids, labels)
+        losses.append(float(loss))
+    print(f"pp={stages}: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+
+    # collapse the trained stages back onto the sequential container
+    gpt_unstack_blocks(net, p_blocks)
+    net.params = {**net.params, net.impls[0].name: p_emb,
+                  net.impls[-1].name: p_head}
+    out = net.output(x[:2])
+    assert np.isfinite(out).all()
+    return losses[-1]
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--stages", type=int, default=4)
+    main(**vars(ap.parse_args()))

@@ -35,3 +35,18 @@ def test_gpt_example_variants():
 def test_resnet_example():
     from examples.train_resnet50 import main
     assert np.isfinite(main(smoke=True))
+
+
+def test_pipelined_gpt_example():
+    import jax
+    import pytest
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices")
+    from examples.train_gpt_pipelined import main
+    assert np.isfinite(main(smoke=True, stages=2))
+
+
+def test_train_from_export_example():
+    from examples.train_from_export import main
+    assert np.isfinite(main(smoke=True))

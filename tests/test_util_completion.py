@@ -213,3 +213,10 @@ def test_cli_run_requires_command():
 
     with pytest.raises(SystemExit):
         main(["run", "pod1", "us-west4-a"])
+
+
+def test_spec_rejects_leading_dash():
+    from deeplearning4j_tpu.parallel.provisioning import TpuPodSpec
+
+    with pytest.raises(ValueError, match="leading"):
+        TpuPodSpec("--force", "z1", "v5litepod-8")
