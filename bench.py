@@ -166,6 +166,28 @@ def bench_lstm():
             "mfu": round(mfu, 4), "vs_baseline": round(mfu / 0.30, 4)}
 
 
+
+def _scan_reps_time(make_step, compile_args, reps, trials=3):
+    """Time a per-step computation by scanning it ``reps`` times inside
+    ONE program and taking the best of ``trials`` dispatches — the
+    amortization recipe for ops whose single call is comparable to the
+    tunnel dispatch RTT (BASELINE.md note). ``make_step(i)`` returns the
+    scalar contribution for scan step i."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def rep(*args):
+        def step(c, i):
+            return c + make_step(i, *args), 0
+        tot, _ = jax.lax.scan(step, jnp.float32(0), jnp.arange(reps))
+        return tot
+
+    float(rep(*compile_args))  # compile
+    return min(_timeit(lambda: rep(*compile_args), warmup=0, iters=1)
+               for _ in range(trials)) / reps
+
+
 def bench_flash_attention():
     """Pallas flash-attention kernel, 16k causal bf16 (the long-context
     hot op; the XLA formulation OOMs past ~16k on the [b,h,t,t] scores).
@@ -181,20 +203,12 @@ def bench_flash_attention():
     key = jax.random.PRNGKey(0)
     q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (b, t, h, d),
                                  jnp.bfloat16) for i in range(3))
-    reps = 16
 
-    @jax.jit
-    def rep(q, k, v):
-        def step(c, i):
-            o = flash_attention(q + i.astype(q.dtype) * 0.001, k, v,
-                                causal=True)
-            return c + jnp.sum(o.astype(jnp.float32)), 0
-        tot, _ = jax.lax.scan(step, jnp.float32(0), jnp.arange(reps))
-        return tot
+    def step(i, q, k, v):  # perturb per step to defeat CSE
+        o = flash_attention(q + i.astype(q.dtype) * 0.001, k, v, causal=True)
+        return jnp.sum(o.astype(jnp.float32))
 
-    float(rep(q, k, v))  # compile
-    dt = min(_timeit(lambda: rep(q, k, v), warmup=0, iters=1)
-             for _ in range(3)) / reps
+    dt = _scan_reps_time(step, (q, k, v), reps=16)
     flops = 4 * b * h * t * t * d / 2 / dt  # causal halves the work
     return {"metric": "flash_attention_16k_causal_tflops",
             "value": round(flops / 1e12, 2), "unit": "TFLOP/s",
@@ -220,19 +234,12 @@ def bench_flash_attention_train():
     loss = lambda q, k, v: jnp.sum(
         flash_attention(q, k, v, causal=True).astype(jnp.float32) * 1e-3)
     grad_fn = jax.grad(loss, argnums=(0, 1, 2))
-    reps = 4
 
-    @jax.jit
-    def rep(q, k, v):
-        def step(c, i):
-            g = grad_fn(q + i.astype(q.dtype) * 0.001, k, v)
-            return c + jnp.sum(g[0].astype(jnp.float32)), 0
-        tot, _ = jax.lax.scan(step, jnp.float32(0), jnp.arange(reps))
-        return tot
+    def step(i, q, k, v):  # perturb per step to defeat CSE
+        g = grad_fn(q + i.astype(q.dtype) * 0.001, k, v)
+        return jnp.sum(g[0].astype(jnp.float32))
 
-    float(rep(q, k, v))  # compile
-    dt = min(_timeit(lambda: rep(q, k, v), warmup=0, iters=1)
-             for _ in range(3)) / reps
+    dt = _scan_reps_time(step, (q, k, v), reps=4)
     flops = (4 + 10) * b * h * t * t * d / 2 / dt
     return {"metric": "flash_attention_train_32k_causal_tflops",
             "value": round(flops / 1e12, 2), "unit": "TFLOP/s",

@@ -98,10 +98,27 @@ class IterationHistory:
 # ----------------------------------------------------------------- conditions
 
 class ClusteringAlgorithmCondition:
-    """``condition/ClusteringAlgorithmCondition.java`` SPI."""
+    """``condition/ClusteringAlgorithmCondition.java`` SPI. Conditions
+    and strategies serialize to plain dicts (the reference marks the
+    whole framework ``Serializable``) so a clustering setup rides the
+    same JSON config plane as network configs."""
 
     def is_satisfied(self, history: IterationHistory) -> bool:
         raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        d = {"type": type(self).__name__}
+        d.update(self.__dict__)
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "ClusteringAlgorithmCondition":
+        kinds = {c.__name__: c for c in (
+            FixedIterationCountCondition, ConvergenceCondition,
+            VarianceVariationCondition)}
+        d = dict(d)
+        cls = kinds[d.pop("type")]
+        return cls(**d)
 
 
 class FixedIterationCountCondition(ClusteringAlgorithmCondition):
@@ -235,6 +252,45 @@ class ClusteringStrategy:
 
     def is_optimization_applicable_now(self, history: IterationHistory) -> bool:
         return False
+
+    def to_dict(self) -> dict:
+        d = {"strategy": type(self).__name__,
+             "initial_cluster_count": self.initial_cluster_count,
+             "distance_function": self.distance_function,
+             "allow_empty_clusters": self.allow_empty_clusters,
+             "termination_condition":
+                 self.termination_condition.to_dict()
+                 if self.termination_condition else None}
+        opt = getattr(self, "clustering_optimization", None)
+        if opt is not None:
+            d["optimization"] = {"type": opt.type.name, "value": opt.value}
+        cond = getattr(self, "optimization_application_condition", None)
+        if cond is not None:
+            d["optimization_condition"] = cond.to_dict()
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "ClusteringStrategy":
+        kinds = {c.__name__: c for c in (FixedClusterCountStrategy,
+                                         OptimisationStrategy)}
+        cls = kinds[d["strategy"]]
+        if cls is FixedClusterCountStrategy:
+            st = cls(d["initial_cluster_count"], d["distance_function"],
+                     d.get("allow_empty_clusters", False))
+        else:
+            st = cls(d["initial_cluster_count"], d["distance_function"])
+        if d.get("termination_condition"):
+            st.termination_condition = ClusteringAlgorithmCondition.from_dict(
+                d["termination_condition"])
+        if d.get("optimization") and isinstance(st, OptimisationStrategy):
+            st.clustering_optimization = ClusteringOptimization(
+                ClusteringOptimizationType[d["optimization"]["type"]],
+                d["optimization"]["value"])
+        if d.get("optimization_condition") and isinstance(st, OptimisationStrategy):
+            st.optimization_application_condition = \
+                ClusteringAlgorithmCondition.from_dict(
+                    d["optimization_condition"])
+        return st
 
 
 class FixedClusterCountStrategy(ClusteringStrategy):

@@ -57,7 +57,10 @@ def main(smoke: bool = False):
     it2.next()
     cursor = it2.state()
     it3 = ExportedDataSetIterator(outdir, shuffle=True, seed=1).restore(cursor)
-    remaining = sum(1 for _ in iter(it3.has_next, False) if it3.next() is not None)
+    remaining = 0
+    while it3.has_next():
+        it3.next()
+        remaining += 1
     print(f"final score {score:.4f}; resume served {remaining} of "
           f"{n_files} batches after the cursor")
     return score

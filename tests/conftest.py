@@ -11,6 +11,11 @@ import os
 # NOTE: this box's sitecustomize pre-imports jax before conftest runs, so
 # plain env-var assignment is too late for JAX_PLATFORMS; use the config
 # API as well (backends initialize lazily, so this still lands in time).
+# The sitecustomize registers a TPU PJRT plugin whenever
+# PALLAS_AXON_POOL_IPS is set and the tunnel hangs CPU-only runs — scrub
+# the trigger so the suite is self-contained regardless of caller env
+# (same doctrine as __graft_entry__._dryrun_in_subprocess).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -167,3 +167,34 @@ def test_cluster_set_result_api(rng):
     c = cs.cluster_of(pts[0])
     assert 0 in c.point_indices
     assert cs.total_average_distance() >= 0.0
+
+
+def test_strategy_json_round_trip(rng):
+    """Strategies/conditions serialize like the reference's
+    Serializable framework — config survives a JSON round trip and the
+    restored strategy clusters identically."""
+    import json
+
+    from deeplearning4j_tpu.clustering.algorithm import ClusteringStrategy
+
+    s = (OptimisationStrategy.setup(2, "euclidean")
+         .optimize(ClusteringOptimizationType.
+                   MINIMIZE_AVERAGE_POINT_TO_CENTER_DISTANCE, 1.0)
+         .optimize_when_iteration_count_multiple_of(1)
+         .end_when_distribution_variation_rate_less_than(0.01))
+    d = json.loads(json.dumps(s.to_dict()))
+    r = ClusteringStrategy.from_dict(d)
+    assert isinstance(r, OptimisationStrategy)
+    assert r.get_clustering_optimization_value() == 1.0
+    assert r.is_optimization_defined()
+
+    pts, _ = _blobs(rng, k=3, per=30)
+    a = BaseClusteringAlgorithm.setup(s, seed=4).apply_to(pts)
+    b = BaseClusteringAlgorithm.setup(r, seed=4).apply_to(pts)
+    assert len(a) == len(b)
+
+    f = (FixedClusterCountStrategy.setup(3)
+         .end_when_iteration_count_equals(5))
+    r2 = ClusteringStrategy.from_dict(json.loads(json.dumps(f.to_dict())))
+    assert isinstance(r2, FixedClusterCountStrategy)
+    assert r2.initial_cluster_count == 3
