@@ -195,4 +195,91 @@ int dl4j_idx_read(const char* path, unsigned char* out, long nbytes) {
     return got == nbytes ? 0 : -5;
 }
 
+
+// ------------------------------------------------------- batch assembly
+//
+// The DataVec/AsyncDataSetIterator hot loop on the host side: assemble
+// a shuffled minibatch (gather rows by index), optionally fused with
+// per-column standardization, and expand integer labels to one-hot —
+// all across a thread pool so the prefetch queue never starves the
+// chip. Row indices are bounds-checked (returns -2 on the first OOB).
+
+}  // extern "C" (templates below need C++ linkage)
+
+// Minimum per-thread work (floats): below this, thread create/join
+// overhead dwarfs the copy — typical 32-row minibatches run inline.
+static const long kMinWorkPerThread = 1L << 16;
+
+static int clamp_threads(int threads, long rows, long work_per_row) {
+    int nt = threads > 0 ? threads
+                         : (int)std::thread::hardware_concurrency();
+    if (nt < 1) nt = 1;
+    if ((long)nt > rows) nt = (int)(rows > 0 ? rows : 1);
+    long total = rows * (work_per_row > 0 ? work_per_row : 1);
+    long by_work = total / kMinWorkPerThread;
+    if (by_work < 1) by_work = 1;
+    if ((long)nt > by_work && threads <= 0) nt = (int)by_work;
+    return nt;
+}
+
+template <typename Fn>
+static void parallel_rows(long rows, long work_per_row, int threads, Fn fn) {
+    int nt = clamp_threads(threads, rows, work_per_row);
+    if (nt <= 1) { fn(0L, rows); return; }
+    long per = (rows + nt - 1) / nt;
+    std::vector<std::thread> pool;
+    for (int t = 0; t < nt; t++) {
+        long lo = t * per;
+        long hi = lo + per < rows ? lo + per : rows;
+        if (lo >= hi) break;
+        pool.emplace_back(fn, lo, hi);
+    }
+    for (auto& th : pool) th.join();
+}
+
+extern "C" {
+
+long dl4j_gather_rows(const float* src, long n_rows, long row_elems,
+                      const long* idx, long n_idx, float* out, int threads) {
+    for (long i = 0; i < n_idx; i++)
+        if (idx[i] < 0 || idx[i] >= n_rows) return -2;
+    parallel_rows(n_idx, row_elems, threads, [&](long lo, long hi) {
+        for (long i = lo; i < hi; i++)
+            std::memcpy(out + i * row_elems, src + idx[i] * row_elems,
+                        sizeof(float) * (size_t)row_elems);
+    });
+    return 0;
+}
+
+long dl4j_gather_normalize(const float* src, long n_rows, long row_elems,
+                           const long* idx, long n_idx, const float* mean,
+                           const float* stdv, float* out, int threads) {
+    for (long i = 0; i < n_idx; i++)
+        if (idx[i] < 0 || idx[i] >= n_rows) return -2;
+    parallel_rows(n_idx, row_elems, threads, [&](long lo, long hi) {
+        for (long i = lo; i < hi; i++) {
+            const float* row = src + idx[i] * row_elems;
+            float* dst = out + i * row_elems;
+            for (long j = 0; j < row_elems; j++) {
+                float sd = stdv[j];
+                dst[j] = (row[j] - mean[j]) / (sd != 0.0f ? sd : 1.0f);
+            }
+        }
+    });
+    return 0;
+}
+
+long dl4j_onehot(const long* labels, long n, long classes, float* out,
+                 int threads) {
+    for (long i = 0; i < n; i++)
+        if (labels[i] < 0 || labels[i] >= classes) return -2;
+    parallel_rows(n, classes, threads, [&](long lo, long hi) {
+        std::memset(out + lo * classes, 0,
+                    sizeof(float) * (size_t)((hi - lo) * classes));
+        for (long i = lo; i < hi; i++)
+            out[i * classes + labels[i]] = 1.0f;
+    });
+    return 0;
+}
+
 }  // extern "C"

@@ -1,0 +1,125 @@
+"""Native batch-assembly tests: C++ path vs NumPy semantics.
+
+Parity: the host data plane's native half (SURVEY.md §1 layer 1/4 —
+libnd4j row ops + DataVec feed threads); doctrine as in
+tests/test_native_io.py — identical results whichever path runs.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.native_batcher import (
+    NativeBatchIterator, gather_rows, one_hot)
+from deeplearning4j_tpu.native import get_lib
+
+
+def test_gather_matches_numpy(rng):
+    src = rng.standard_normal((100, 7)).astype(np.float32)
+    idx = rng.integers(0, 100, 33)
+    np.testing.assert_array_equal(gather_rows(src, idx), src[idx])
+
+
+def test_gather_nd_features(rng):
+    src = rng.standard_normal((40, 4, 5, 2)).astype(np.float32)
+    idx = rng.integers(0, 40, 16)
+    np.testing.assert_array_equal(gather_rows(src, idx), src[idx])
+
+
+def test_gather_normalize_fused(rng):
+    src = rng.standard_normal((60, 9)).astype(np.float32) * 3 + 1
+    idx = rng.integers(0, 60, 25)
+    mean, std = src.mean(0), src.std(0)
+    got = gather_rows(src, idx, mean, std)
+    want = (src[idx] - mean) / std
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_gather_zero_std_guard(rng):
+    src = np.ones((10, 3), np.float32)
+    got = gather_rows(src, np.arange(10), src.mean(0), src.std(0))
+    assert np.isfinite(got).all()
+
+
+def test_gather_oob_raises(rng):
+    src = rng.standard_normal((10, 3)).astype(np.float32)
+    with pytest.raises(IndexError):
+        gather_rows(src, np.array([0, 10]))
+    with pytest.raises(IndexError):
+        gather_rows(src, np.array([-1]))
+
+
+def test_one_hot_matches_numpy(rng):
+    ids = rng.integers(0, 7, 50)
+    np.testing.assert_array_equal(one_hot(ids, 7),
+                                  np.eye(7, dtype=np.float32)[ids])
+    with pytest.raises(IndexError):
+        one_hot(np.array([7]), 7)
+
+
+def test_native_lib_has_batch_kernels():
+    lib = get_lib()
+    if lib is None:
+        pytest.skip("no native toolchain")
+    assert hasattr(lib, "dl4j_gather_rows")
+
+
+class TestNativeBatchIterator:
+    def test_covers_all_examples_shuffled(self, rng):
+        x = rng.standard_normal((83, 5)).astype(np.float32)
+        y = rng.integers(0, 4, 83)
+        it = NativeBatchIterator(x, y, batch_size=16, num_classes=4, seed=3)
+        seen, n = [], 0
+        while it.has_next():
+            b = it.next()
+            n += b.num_examples()
+            seen.append(b)
+        assert n == 83
+        assert seen[-1].num_examples() == 83 % 16
+        # one-hot labels round-trip to the original ids
+        ids = np.concatenate([np.argmax(np.asarray(b.labels), -1)
+                              for b in seen])
+        assert sorted(ids.tolist()) == sorted(y.tolist())
+        order0 = it._order.copy()
+        it.reset()
+        assert not np.array_equal(it._order, order0)  # reshuffled
+
+    def test_normalized_training(self, rng):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        centers = rng.standard_normal((3, 6)) * 5 + 10  # needs normalization
+        ids = rng.integers(0, 3, 200)
+        x = centers[ids] + 0.3 * rng.standard_normal((200, 6))
+        it = NativeBatchIterator(x.astype(np.float32), ids, batch_size=32,
+                                 normalize=True, num_classes=3, seed=1)
+        b = it.next()
+        assert abs(float(np.asarray(b.features).mean())) < 1.0  # standardized
+        it.reset()
+
+        conf = (NeuralNetConfiguration.builder().seed(2).learning_rate(0.1)
+                .updater("adam").activation("tanh").list()
+                .layer(DenseLayer(n_in=6, n_out=16))
+                .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                   loss_function="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(8):
+            net.fit(it)
+            it.reset()
+        acc = float(np.mean(net.predict(
+            gather_rows(x.astype(np.float32), np.arange(200),
+                        it.mean, it.std)) == ids))
+        assert acc > 0.9, acc
+
+    def test_sparse_int_labels_pass_through(self, rng):
+        x = rng.standard_normal((20, 4)).astype(np.float32)
+        y = rng.integers(0, 5, 20)
+        it = NativeBatchIterator(x, y, batch_size=8, num_classes=None)
+        b = it.next()
+        assert b.labels.shape == (8,)  # sparse ids, ops/losses convention
+
+    def test_length_mismatch_raises(self, rng):
+        with pytest.raises(ValueError, match="mismatch"):
+            NativeBatchIterator(np.zeros((4, 2), np.float32),
+                                np.zeros(5, np.int64), 2)
