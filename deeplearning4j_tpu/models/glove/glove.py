@@ -123,19 +123,21 @@ class Glove:
         hb, hbc = jnp.full(V, 1e-8), jnp.full(V, 1e-8)
         lr = jnp.float32(self.learning_rate)
         B = self.batch_size
-        for _ in range(self.epochs):
-            order = rng.permutation(len(ii))
-            ep_loss = 0.0
-            nb = 0
+        epoch_losses = []  # device scalars; ONE fetch after the loop — a
+        for _ in range(self.epochs):  # per-batch float(loss) would stall
+            order = rng.permutation(len(ii))  # the dispatch queue on the
+            batch_losses = []                 # tunneled TPU (engine.py note)
             for s in range(0, len(order), B):
                 sel = order[s:s + B]
                 w, wc, b, bc, hw, hwc, hb, hbc, loss = _glove_step(
                     w, wc, b, bc, hw, hwc, hb, hbc,
                     jnp.asarray(ii[sel]), jnp.asarray(jj[sel]),
                     jnp.asarray(logx[sel]), jnp.asarray(fx[sel]), lr)
-                ep_loss += float(loss)
-                nb += 1
-            self.loss_history.append(ep_loss / max(nb, 1))
+                batch_losses.append(loss)
+            epoch_losses.append(jnp.mean(jnp.stack(batch_losses)))
+        if epoch_losses:  # epochs=0: vocab/co-occurrence build only
+            self.loss_history.extend(
+                np.asarray(jnp.stack(epoch_losses)).tolist())
         # final vectors = w + wc (GloVe convention; the reference sums)
         self.vectors = np.asarray(w) + np.asarray(wc)
 
