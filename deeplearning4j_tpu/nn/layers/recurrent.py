@@ -59,7 +59,7 @@ def _lstm_scan(p, x, h0, c0, gate_act: str, block_act: str, mask=None,
     batched over time, only the small recurrent gemm stays sequential.
 
     Inference (``train=False``) dispatches the recurrence to the fused
-    Pallas kernel (``ops/lstm_kernel.py``, -31% vs this scan on v5e)
+    Pallas kernel (``ops/lstm_kernel.py``, -32% vs this scan on v5e)
     when the configuration allows; training keeps this XLA scan — its
     fused scan-grad measured faster than any split kernel+BPTT (see
     the kernel module docstring).
@@ -71,7 +71,8 @@ def _lstm_scan(p, x, h0, c0, gate_act: str, block_act: str, mask=None,
     from deeplearning4j_tpu.ops.lstm_kernel import (
         fused_lstm_applicable, fused_lstm_scan)
     if not train and fused_lstm_applicable(x.shape[0], n, gate_act,
-                                           block_act, mask):
+                                           block_act, mask,
+                                           itemsize=x.dtype.itemsize):
         xg_k = xg_t[::-1] if reverse else xg_t
         h_seq, (h, c) = fused_lstm_scan(xg_k, p["Wr"], p["wci"], p["wcf"],
                                         p["wco"], h0, c0)

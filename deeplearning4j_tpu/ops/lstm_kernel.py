@@ -5,7 +5,7 @@ BASELINE.json "CudnnLSTMHelper → XLA while-loop" north star, taken one
 step further for the forward pass. Measured on v5e at the char-RNN
 bench shape (b1024/n512/t128, bf16):
 
-- forward: XLA ``lax.scan`` 24.7 ms → this kernel 17.0 ms (-31%) —
+- forward: XLA ``lax.scan`` 25.2 ms → this kernel 17.1 ms (-32%) —
   the recurrent gemm and the gate nonlinearities fuse in VMEM, with
   the [n, 4n] recurrent weight and the (h, c) carries resident in
   scratch across every timestep (grid (batch_blocks, t), t innermost
@@ -51,10 +51,12 @@ def _scratch(shape, dtype=jnp.float32):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _fwd_kernel(xg_ref, wr_ref, wci_ref, wcf_ref, wco_ref, h0_ref, c0_ref,
-                h_ref, i_ref, f_ref, o_ref, blk_ref, c_ref,
-                h_scr, c_scr, *, n: int):
-    """Training/vjp variant: streams gate residuals for the BPTT."""
+def _cell(xg_ref, wr_ref, wci_ref, wcf_ref, wco_ref, h0_ref, c0_ref,
+          h_scr, c_scr, n: int):
+    """ONE Graves step against the VMEM-resident carries — the shared
+    body of both kernel variants (keeping the gate math in one place so
+    the residual and inference paths can never desynchronize).
+    Returns (i, f, o, blk, c_new, h_new) and advances the scratch."""
     t = pl.program_id(1)
 
     @pl.when(t == 0)
@@ -77,9 +79,18 @@ def _fwd_kernel(xg_ref, wr_ref, wci_ref, wcf_ref, wco_ref, h0_ref, c0_ref,
     c_new = f * c_prev + i * blk
     o = jax.nn.sigmoid(g[:, 2 * n:3 * n] + c_new * wco_ref[0])
     h_new = o * jnp.tanh(c_new)
-
     h_scr[:] = h_new.astype(h_scr.dtype)
     c_scr[:] = c_new
+    return i, f, o, blk, c_new, h_new
+
+
+def _fwd_kernel(xg_ref, wr_ref, wci_ref, wcf_ref, wco_ref, h0_ref, c0_ref,
+                h_ref, i_ref, f_ref, o_ref, blk_ref, c_ref,
+                h_scr, c_scr, *, n: int):
+    """Training/vjp variant: streams gate residuals for the BPTT."""
+    i, f, o, blk, c_new, h_new = _cell(
+        xg_ref, wr_ref, wci_ref, wcf_ref, wco_ref, h0_ref, c0_ref,
+        h_scr, c_scr, n)
     h_ref[0] = h_new.astype(h_ref.dtype)
     i_ref[0] = i.astype(i_ref.dtype)
     f_ref[0] = f.astype(f_ref.dtype)
@@ -92,29 +103,13 @@ def _fwd_only_kernel(xg_ref, wr_ref, wci_ref, wcf_ref, wco_ref, h0_ref,
                      c0_ref, h_ref, hl_ref, cl_ref, h_scr, c_scr, *, n: int):
     """Inference variant: h sequence + final carries only — no residual
     streaming (5/6 of the full variant's output bandwidth)."""
-    t = pl.program_id(1)
     nt = pl.num_programs(1)
-
-    @pl.when(t == 0)
-    def _init():
-        h_scr[:] = h0_ref[...].astype(h_scr.dtype)
-        c_scr[:] = c0_ref[...].astype(jnp.float32)
-
-    c_prev = c_scr[:]
-    g = xg_ref[0].astype(jnp.float32) + jax.lax.dot_general(
-        h_scr[:], wr_ref[...],
-        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    i = jax.nn.sigmoid(g[:, :n] + c_prev * wci_ref[0])
-    f = jax.nn.sigmoid(g[:, n:2 * n] + c_prev * wcf_ref[0])
-    blk = jnp.tanh(g[:, 3 * n:])
-    c_new = f * c_prev + i * blk
-    o = jax.nn.sigmoid(g[:, 2 * n:3 * n] + c_new * wco_ref[0])
-    h_new = o * jnp.tanh(c_new)
-    h_scr[:] = h_new.astype(h_scr.dtype)
-    c_scr[:] = c_new
+    _, _, _, _, c_new, h_new = _cell(
+        xg_ref, wr_ref, wci_ref, wcf_ref, wco_ref, h0_ref, c0_ref,
+        h_scr, c_scr, n)
     h_ref[0] = h_new.astype(h_ref.dtype)
 
-    @pl.when(t == nt - 1)
+    @pl.when(pl.program_id(1) == nt - 1)
     def _final():
         hl_ref[...] = h_new.astype(hl_ref.dtype)
         cl_ref[...] = c_new.astype(cl_ref.dtype)
@@ -265,17 +260,25 @@ def _on_tpu() -> bool:  # patchable seam for tests
     return jax.default_backend() == "tpu"
 
 
+#: largest hidden size the kernel accepts per dtype width: the
+#: VMEM-resident [n, 4n] recurrent weight is 4n²·itemsize bytes and
+#: must leave room for the step blocks inside the ~16MB scoped budget
+_MAX_N = {2: 1024, 4: 512}
+
+
 def fused_lstm_applicable(b: int, n: int, gate_act: str, block_act: str,
-                          mask) -> bool:
+                          mask, itemsize: int = 2) -> bool:
     """The kernel covers the default Graves configuration on tileable
     shapes ON TPU; everything else keeps the XLA scan (on CPU/GPU hosts
     the kernel would run under the Pallas interpreter, orders of
     magnitude slower — tests exercise it by calling fused_lstm_scan
-    directly)."""
+    directly). ``itemsize``: activation dtype width in bytes (bounds
+    the VMEM-resident weight)."""
     return (_on_tpu()
             and mask is None and gate_act == "sigmoid"
             and block_act == "tanh"
-            and n % 128 == 0 and _pick_block_b(b) > 0)
+            and n % 128 == 0 and n <= _MAX_N.get(itemsize, 512)
+            and _pick_block_b(b) > 0)
 
 
 def fused_lstm_scan(xg, wr, wci, wcf, wco, h0, c0
@@ -288,6 +291,10 @@ def fused_lstm_scan(xg, wr, wci, wcf, wco, h0, c0
     """
     t, b, g4 = xg.shape
     block_b = _pick_block_b(b)
+    if block_b == 0:
+        raise ValueError(
+            f"batch {b} is not tileable (needs a divisor in 8..256); "
+            f"gate with fused_lstm_applicable or use the XLA scan")
     interpret = jax.default_backend() != "tpu"
     h_seq, h_last, c_last = _fused(xg, wr, wci, wcf, wco, h0, c0,
                                    block_b, interpret)
