@@ -72,7 +72,7 @@ def _lstm_scan(p, x, h0, c0, gate_act: str, block_act: str, mask=None,
         fused_lstm_applicable, fused_lstm_scan)
     if not train and fused_lstm_applicable(x.shape[0], n, gate_act,
                                            block_act, mask,
-                                           itemsize=x.dtype.itemsize):
+                                           itemsize=xg.dtype.itemsize):
         xg_k = xg_t[::-1] if reverse else xg_t
         h_seq, (h, c) = fused_lstm_scan(xg_k, p["Wr"], p["wci"], p["wcf"],
                                         p["wco"], h0, c0)

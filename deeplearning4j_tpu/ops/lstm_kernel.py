@@ -293,7 +293,7 @@ def fused_lstm_scan(xg, wr, wci, wcf, wco, h0, c0
     block_b = _pick_block_b(b)
     if block_b == 0:
         raise ValueError(
-            f"batch {b} is not tileable (needs a divisor in 8..256); "
+            f"batch {b} is not tileable (must be a multiple of 8); "
             f"gate with fused_lstm_applicable or use the XLA scan")
     interpret = jax.default_backend() != "tpu"
     h_seq, h_last, c_last = _fused(xg, wr, wci, wcf, wco, h0, c0,

@@ -28,12 +28,39 @@ from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.parallel.mesh import MeshContext, make_mesh
 
 
+
+def _model_forward(model):
+    """Prediction function (params, states, x) -> preds for BOTH model
+    containers: MultiLayerNetwork directly, ComputationGraph through a
+    single-input/single-output adapter (the ``SparkComputationGraph``
+    evaluate role — the reference evaluates graphs the same way,
+    ``impl/graph/SparkComputationGraph.java``)."""
+    if hasattr(model, "_forward"):
+        return lambda p, s, x: model._forward(p, s, x, False, None, None)[0][-1]
+    if not hasattr(model, "_forward_all"):
+        raise TypeError(f"cannot evaluate {type(model).__name__}")
+    if len(model.input_names) != 1 or len(model.output_names) != 1:
+        raise ValueError(
+            "sharded evaluation supports single-input/single-output "
+            f"graphs; this one has inputs {model.input_names} and "
+            f"outputs {model.output_names} — evaluate per-output with "
+            "the host Evaluation instead")
+    inp, outname = model.input_names[0], model.output_names[0]
+
+    def fwd(p, s, x):
+        acts, _, _ = model._forward_all(p, s, {inp: x}, False, None, {})
+        return acts[outname]
+
+    return fwd
+
+
 def _counts_program(model):
     """jitted (params, states, x, labels, valid) -> [C, C] i32 counts."""
 
+    fwd = _model_forward(model)
+
     def counts(params, states, x, labels, valid):
-        acts, _ = model._forward(params, states, x, False, None, None)
-        preds = acts[-1]
+        preds = fwd(params, states, x)
         c = preds.shape[-1]
         sparse = labels.ndim == preds.ndim - 1  # int-id labels
         if preds.ndim == 3:  # time series: fold time into batch
@@ -68,9 +95,8 @@ def _preds_shape(model, ds: DataSet):
     data — found by abstract tracing (jax.eval_shape: no compile, no
     device work)."""
     x1 = jnp.zeros((1,) + np.asarray(ds.features).shape[1:], jnp.float32)
-    out = jax.eval_shape(
-        lambda p, s, xx: model._forward(p, s, xx, False, None, None)[0][-1],
-        model.params, model.states, x1)
+    out = jax.eval_shape(_model_forward(model),
+                         model.params, model.states, x1)
     return len(out.shape), out.shape[-1]
 
 
@@ -139,9 +165,10 @@ def evaluate_regression_sharded(model, data: Union[DataSet, DataSetIterator],
     mesh = mesh if mesh is not None else make_mesh()
     ctx = MeshContext(mesh)
 
+    fwd = _model_forward(model)
+
     def stats(params, states, x, labels, valid):
-        acts, _ = model._forward(params, states, x, False, None, None)
-        preds = acts[-1].astype(jnp.float64)
+        preds = fwd(params, states, x).astype(jnp.float64)
         labels = labels.astype(jnp.float64)
         c = labels.shape[-1]
         if preds.ndim == 3:
@@ -200,9 +227,10 @@ def evaluate_roc_sharded(model, data: Union[DataSet, DataSetIterator],
     ctx = MeshContext(mesh)
     thresholds = jnp.linspace(0.0, 1.0, threshold_steps + 1)
 
+    fwd = _model_forward(model)
+
     def counts(params, states, x, labels, valid):
-        acts, _ = model._forward(params, states, x, False, None, None)
-        preds = acts[-1]
+        preds = fwd(params, states, x)
         if labels.ndim >= 2 and labels.shape[-1] == 2:
             labels = labels[..., 1]
             preds = preds[..., 1]

@@ -231,3 +231,44 @@ def test_masked_sentinel_ids_do_not_raise(rng):
     ids2 = ids.copy(); ids2[0, 0] = 99.0
     with pytest.raises(ValueError, match="sparse label id 99"):
         evaluate_sharded(net, DataSet(x, ids2, labels_mask=mask))
+
+
+def test_computation_graph_sharded_eval(rng):
+    """The sharded evaluators also accept a ComputationGraph (the
+    SparkComputationGraph.evaluate role) — equal to host eval."""
+    from deeplearning4j_tpu.nn.graph import (
+        ComputationGraph, ComputationGraphConfiguration)
+
+    b = (ComputationGraphConfiguration.GraphBuilder()
+         .add_inputs("in")
+         .add_layer("d1", DenseLayer(n_in=6, n_out=10), "in")
+         .add_layer("out", OutputLayer(n_in=10, n_out=3,
+                                       activation="softmax",
+                                       loss_function="mcxent"), "d1")
+         .set_outputs("out"))
+    net = ComputationGraph(b.build()).init()
+    x = rng.standard_normal((32, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    host = Evaluation()
+    host.eval(y, net.output(x))
+    dist = evaluate_sharded(net, DataSet(x, y))
+    np.testing.assert_array_equal(dist.confusion.counts,
+                                  host.confusion.counts)
+
+
+def test_multi_output_graph_rejected(rng):
+    from deeplearning4j_tpu.nn.graph import (
+        ComputationGraph, ComputationGraphConfiguration)
+
+    b = (ComputationGraphConfiguration.GraphBuilder()
+         .add_inputs("in")
+         .add_layer("o1", OutputLayer(n_in=6, n_out=2, activation="softmax",
+                                      loss_function="mcxent"), "in")
+         .add_layer("o2", OutputLayer(n_in=6, n_out=2, activation="softmax",
+                                      loss_function="mcxent"), "in")
+         .set_outputs("o1", "o2"))
+    net = ComputationGraph(b.build()).init()
+    x = rng.standard_normal((8, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    with pytest.raises(ValueError, match="single-input/single-output"):
+        evaluate_sharded(net, DataSet(x, y))
