@@ -147,6 +147,25 @@ def _sgns_step(syn0, syn1neg, centers, contexts, negatives, lr, weights,
                       weights, dense)
 
 
+def _device_pairs(flat, pos, slen, n_tokens, idx, kb, offs, bp, n2w, N):
+    """On-device skip-gram pair generation for one batch of stream
+    positions — the ONE implementation both scan programs share
+    (reduced-window draw, same-sentence bounds, padding guard)."""
+    centers = flat[idx]
+    p, L = pos[idx], slen[idx]
+    window = n2w // 2
+    b = jax.random.randint(jax.random.fold_in(kb, 0), (bp,), 1, window + 1)
+    cpos = p[:, None] + offs[None, :]                             # [bp, 2w]
+    ok = ((jnp.abs(offs)[None, :] <= b[:, None])
+          & (cpos >= 0) & (cpos < L[:, None])
+          & (idx[:, None] < n_tokens))
+    contexts = flat[jnp.clip(idx[:, None] + offs[None, :], 0, N - 1)]
+    c2 = jnp.broadcast_to(centers[:, None], (bp, n2w)).reshape(-1)
+    x2 = contexts.reshape(-1)
+    w2 = ok.reshape(-1).astype(jnp.float32)
+    return c2, x2, w2
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1),
                    static_argnames=("window", "K", "bp", "n_steps", "dense"))
 def _sgns_scan_program(syn0, syn1neg, flat, pos, slen, neg_table, key,
@@ -191,19 +210,9 @@ def _sgns_scan_program(syn0, syn1neg, flat, pos, slen, neg_table, key,
         syn0, syn1neg = carry
         base = (i % (N // bp)) * bp
         idx = base + jnp.arange(bp, dtype=jnp.int32)              # [bp]
-        centers = flat[idx]
-        p, L = pos[idx], slen[idx]
         kb = jax.random.fold_in(key, step0 + i)
-        b = jax.random.randint(jax.random.fold_in(kb, 0), (bp,), 1,
-                               window + 1)
-        cpos = p[:, None] + offs[None, :]                         # [bp, 2w]
-        ok = ((jnp.abs(offs)[None, :] <= b[:, None])
-              & (cpos >= 0) & (cpos < L[:, None])
-              & (idx[:, None] < n_tokens))
-        contexts = flat[jnp.clip(idx[:, None] + offs[None, :], 0, N - 1)]
-        c2 = jnp.broadcast_to(centers[:, None], (bp, n2w)).reshape(-1)
-        x2 = contexts.reshape(-1)
-        w2 = ok.reshape(-1).astype(jnp.float32)
+        c2, x2, w2 = _device_pairs(flat, pos, slen, n_tokens, idx, kb,
+                                   offs, bp, n2w, N)
         negs = neg_table[jax.random.randint(
             jax.random.fold_in(kb, 1), (bp * n2w, K), 0,
             neg_table.shape[0])]
@@ -218,11 +227,9 @@ def _sgns_scan_program(syn0, syn1neg, flat, pos, slen, neg_table, key,
     return syn0, syn1neg, losses
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _hs_step(syn0, syn1, centers, codes, points, code_mask, lr, weights):
-    """Hierarchical-softmax batch update (SkipGram.iterateSample :204 HS
-    branch, batched over padded Huffman paths). ``weights`` as in
-    ``_sgns_step``."""
+def _hs_math(syn0, syn1, centers, codes, points, code_mask, lr, weights):
+    """Shared hierarchical-softmax batch update (SkipGram.iterateSample
+    :204 HS branch, batched over padded Huffman paths)."""
     v = syn0[centers]                       # [B, d]
     u = syn1[points]                        # [B, L, d]
     s = jnp.einsum("bd,bld->bl", v, u)      # [B, L]
@@ -240,6 +247,48 @@ def _hs_step(syn0, syn1, centers, codes, points, code_mask, lr, weights):
     p = jax.nn.sigmoid(jnp.where(codes > 0, -s, s))
     loss = -jnp.sum(jnp.log(p + 1e-10) * code_mask) / jnp.maximum(jnp.sum(code_mask), 1.0)
     return syn0, syn1, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _hs_step(syn0, syn1, centers, codes, points, code_mask, lr, weights):
+    """One host-fed HS batch (fallback path; the hot path is
+    ``_hs_scan_program``)."""
+    return _hs_math(syn0, syn1, centers, codes, points, code_mask, lr,
+                    weights)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("window", "bp", "n_steps"))
+def _hs_scan_program(syn0, syn1, flat, pos, slen, codes_tab, points_tab,
+                     cmask_tab, key, lr0, min_lr, n_tokens, step0,
+                     total_steps, *, window, bp, n_steps):
+    """ONE EPOCH of hierarchical-softmax skip-gram as ONE compiled
+    program — the HS twin of ``_sgns_scan_program`` (same device
+    pair generation; the Huffman code/point/mask tables are uploaded
+    once and gathered by context id on device)."""
+    offs = jnp.asarray([d for d in range(-window, window + 1) if d != 0],
+                       jnp.int32)
+    n2w = 2 * window
+    N = flat.shape[0]
+    total = total_steps.astype(jnp.float32)
+
+    def body(carry, i):
+        syn0, syn1 = carry
+        base = (i % (N // bp)) * bp
+        idx = base + jnp.arange(bp, dtype=jnp.int32)
+        kb = jax.random.fold_in(key, step0 + i)
+        c2, x2, w2 = _device_pairs(flat, pos, slen, n_tokens, idx, kb,
+                                   offs, bp, n2w, N)
+        g_step = (step0 + i).astype(jnp.float32)
+        lr = jnp.maximum(min_lr, lr0 * (1.0 - g_step / total))
+        syn0, syn1, loss = _hs_math(
+            syn0, syn1, c2, codes_tab[x2], points_tab[x2], cmask_tab[x2],
+            lr, w2)
+        return (syn0, syn1), loss
+
+    (syn0, syn1), losses = jax.lax.scan(
+        body, (syn0, syn1), jnp.arange(n_steps, dtype=jnp.int32))
+    return syn0, syn1, losses
 
 
 # ------------------------------------------------------------------- sampling
@@ -455,8 +504,14 @@ class SequenceVectors:
         else:
             syn0 = jnp.asarray(lt.syn0)
             syn1 = jnp.asarray(lt.syn1) if self.use_hs else jnp.asarray(lt.syn1neg)
-        neg_table = lt.negative_table() if not self.use_hs else None
-        if self.use_hs:
+        # the skip-gram scan hot path builds its own device tables — do
+        # the (potentially megabytes of) host table setup only for the
+        # per-batch fallback paths
+        scan_path = (not sharded and self.algo == "skipgram"
+                     and self.subsampling == 0 and self.device_pairgen)
+        neg_table = (lt.negative_table()
+                     if not self.use_hs and not scan_path else None)
+        if self.use_hs and not scan_path:
             codes = jnp.asarray(self.huffman.codes)
             points = jnp.asarray(self.huffman.points)
             lens = self.huffman.code_lengths
@@ -474,12 +529,12 @@ class SequenceVectors:
                  and self.vocab.num_words() <= _DENSE_UPDATE_MAX_VOCAB)
         device_losses: List[jnp.ndarray] = []
 
-        # hot path: plain SGNS with no subsampling runs ALL epochs as
-        # one device program (zero per-step host traffic; see
-        # _sgns_scan_program). Subsampling re-draws the kept tokens per
-        # epoch host-side, so it stays on the per-batch path.
-        if (not sharded and self.algo == "skipgram" and not self.use_hs
-                and self.subsampling == 0 and self.device_pairgen):
+        # hot path: skip-gram (SGNS or HS) with no subsampling runs ALL
+        # epochs as one device program per epoch (zero per-step host
+        # traffic; see _sgns_scan_program/_hs_scan_program). Subsampling
+        # re-draws the kept tokens per epoch host-side, so it stays on
+        # the per-batch path.
+        if scan_path:
             self._fit_sgns_scan(sentences, syn0, syn1, rng)
             return
 
@@ -569,11 +624,12 @@ class SequenceVectors:
         else:
             lt.syn1neg = np.asarray(syn1)
 
-    def _fit_sgns_scan(self, sentences, syn0, syn1neg,
+    def _fit_sgns_scan(self, sentences, syn0, syn1,
                        rng: np.random.Generator):
         """Stage the token stream once and run every epoch inside
-        ``_sgns_scan_program`` — the only host↔device traffic is the
-        initial upload and one final table/loss fetch."""
+        ``_sgns_scan_program`` / ``_hs_scan_program`` — the only
+        host↔device traffic is the initial upload and one final
+        table/loss fetch."""
         lt = self.lookup_table
         idx_lists = self._to_indices(sentences, rng)
         sents = [s for s in idx_lists if len(s) >= 2]
@@ -594,32 +650,47 @@ class SequenceVectors:
             flat, pos, slen = z(flat), z(pos), z(slen)
         total_steps = n_batches * self.epochs
 
-        # build the unigram^0.75 table at the device size rather than
-        # striding the big host table (a stride deterministically drops
-        # most tail words from negative sampling). The min-one-slot
-        # guarantee means the actual length is max(128k, vocab words) —
-        # ~0.5MB uploaded once for typical vocabs, linear in vocab size
-        # beyond 131072 words.
-        neg_table = jnp.asarray(lt.negative_table(size=131072))
         key = jax.random.PRNGKey(int(rng.integers(2**31)))
         flat_d, pos_d, slen_d = (jnp.asarray(flat), jnp.asarray(pos),
                                  jnp.asarray(slen))
-        dense = self.vocab.num_words() <= _DENSE_UPDATE_MAX_VOCAB
+        common = dict(window=self.window, bp=bp, n_steps=n_batches)
+        scal = lambda e: (jnp.float32(self.learning_rate),
+                          jnp.float32(self.min_learning_rate),
+                          jnp.int32(n_tokens), jnp.int32(e * n_batches),
+                          jnp.int32(total_steps))
         loss_chunks = []
-        for e in range(self.epochs):
-            # one executable per corpus shape; epochs re-dispatch it
-            # with a new step offset — no host↔device traffic between
-            # epochs beyond these scalars
-            syn0, syn1neg, losses = _sgns_scan_program(
-                syn0, syn1neg, flat_d, pos_d, slen_d, neg_table, key,
-                jnp.float32(self.learning_rate),
-                jnp.float32(self.min_learning_rate), jnp.int32(n_tokens),
-                jnp.int32(e * n_batches), jnp.int32(total_steps),
-                window=self.window, K=self.negative, bp=bp,
-                n_steps=n_batches, dense=dense)
-            loss_chunks.append(losses)
-        lt.syn0 = np.asarray(syn0)
-        lt.syn1neg = np.asarray(syn1neg)
+        if self.use_hs:
+            codes_tab = jnp.asarray(self.huffman.codes)
+            points_tab = jnp.asarray(self.huffman.points)
+            lens = self.huffman.code_lengths
+            cmask_tab = jnp.asarray(
+                (np.arange(codes_tab.shape[1])[None, :]
+                 < lens[:, None]).astype(np.float32))
+            for e in range(self.epochs):
+                syn0, syn1, losses = _hs_scan_program(
+                    syn0, syn1, flat_d, pos_d, slen_d, codes_tab,
+                    points_tab, cmask_tab, key, *scal(e), **common)
+                loss_chunks.append(losses)
+            lt.syn0 = np.asarray(syn0)
+            lt.syn1 = np.asarray(syn1)
+        else:
+            # build the unigram^0.75 table at the device size rather
+            # than striding the big host table (a stride would drop most
+            # tail words from negative sampling). min-one-slot means the
+            # actual length is max(128k, vocab words) — ~0.5MB uploaded
+            # once for typical vocabs, linear in vocab beyond 131072.
+            neg_table = jnp.asarray(lt.negative_table(size=131072))
+            dense = self.vocab.num_words() <= _DENSE_UPDATE_MAX_VOCAB
+            for e in range(self.epochs):
+                # one executable per corpus shape; epochs re-dispatch it
+                # with a new step offset — no host-device traffic
+                # between epochs beyond these scalars
+                syn0, syn1, losses = _sgns_scan_program(
+                    syn0, syn1, flat_d, pos_d, slen_d, neg_table, key,
+                    *scal(e), K=self.negative, dense=dense, **common)
+                loss_chunks.append(losses)
+            lt.syn0 = np.asarray(syn0)
+            lt.syn1neg = np.asarray(syn1)
         self._loss_history.extend(
             np.asarray(jnp.concatenate(loss_chunks))[::10].tolist())
 
