@@ -21,11 +21,42 @@ import numpy as np
 
 from deeplearning4j_tpu.models.sequencevectors.engine import (
     SequenceVectors,
+    _DENSE_UPDATE_MAX_VOCAB,
     _pad_np,
+    _sgns_math,
     _sgns_step,
 )
 from deeplearning4j_tpu.text.sentenceiterator import LabelAwareIterator
 from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+
+
+import functools
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("K", "bs", "n_steps", "dense"))
+def _pv_scan_program(doc_vecs, syn1neg, doc_ids, word_ids, neg_table, key,
+                     lr, n_pairs, *, K, bs, n_steps, dense):
+    """ONE EPOCH of the doc-vector phase as ONE compiled program (the
+    scan doctrine of ``engine._sgns_scan_program``): the (doc, word)
+    pair list is epoch-invariant, so it uploads once and only scalars
+    cross the tunnel per epoch; negatives sample on device from the
+    unigram^0.75 table."""
+
+    def body(carry, i):
+        dv, s1 = carry
+        sl = i * bs + jnp.arange(bs, dtype=jnp.int32)
+        c = doc_ids[sl]
+        x = word_ids[sl]
+        w = (sl < n_pairs).astype(jnp.float32)
+        negs = neg_table[jax.random.randint(
+            jax.random.fold_in(key, i), (bs, K), 0, neg_table.shape[0])]
+        dv, s1, loss = _sgns_math(dv, s1, c, x, negs, lr, w, dense)
+        return (dv, s1), loss
+
+    (doc_vecs, syn1neg), losses = jax.lax.scan(
+        body, (doc_vecs, syn1neg), jnp.arange(n_steps, dtype=jnp.int32))
+    return doc_vecs, syn1neg, losses
 
 
 @jax.jit
@@ -83,7 +114,6 @@ class ParagraphVectors(SequenceVectors):
         if self.train_words:
             super().fit(token_lists)
         syn1neg = jnp.asarray(self.lookup_table.syn1neg)
-        neg_table = self.lookup_table.negative_table()
 
         # DBOW: doc vector predicts each word of the doc; DM adds
         # context-window centering (approximated by the same pair set with
@@ -99,19 +129,52 @@ class ParagraphVectors(SequenceVectors):
         doc_ids = np.asarray(doc_ids, np.int32)
         word_ids = np.asarray(word_ids, np.int32)
         B = self.batch_size
-        for _ in range(self.epochs):
-            order = rng.permutation(len(doc_ids))
-            for s in range(0, len(order), B):
-                sel = order[s:s + B]
-                negs = rng.choice(neg_table, (len(sel), self.negative))
-                # pad the tail to one static batch shape; weights mask pads
-                w = np.zeros(B, np.float32)
-                w[:len(sel)] = 1.0
-                doc_vecs, syn1neg, _ = _sgns_step(
-                    doc_vecs, syn1neg, jnp.asarray(_pad_np(doc_ids[sel], B)),
-                    jnp.asarray(_pad_np(word_ids[sel], B)),
-                    jnp.asarray(_pad_np(negs, B), jnp.int32),
-                    jnp.float32(self.learning_rate), jnp.asarray(w))
+        if self.device_pairgen and len(doc_ids):
+            # all-epochs-on-device scan: pairs upload ONCE, negatives
+            # sample on device (engine scan doctrine — the per-batch
+            # loop below pays a tunnel transfer per step). Pairs are
+            # shuffled host-side before upload: the list is built
+            # doc-major, and un-mixed batches would hold one doc_id
+            # thousands of times, which the capped accumulation would
+            # clamp to a single bounded step per batch.
+            n_pairs = len(doc_ids)
+            order = rng.permutation(n_pairs)
+            doc_ids, word_ids = doc_ids[order], word_ids[order]
+            n_batches = -(-n_pairs // B)
+            pad = n_batches * B - n_pairs
+            di = jnp.asarray(np.concatenate([doc_ids,
+                                             np.zeros(pad, np.int32)]))
+            wi = jnp.asarray(np.concatenate([word_ids,
+                                             np.zeros(pad, np.int32)]))
+            neg_dev = jnp.asarray(
+                self.lookup_table.negative_table(size=131072))
+            # BOTH tables must be small for the dense one-hot update:
+            # syn0 here is the doc table (n_labels rows), syn1neg the
+            # word table
+            dense = max(len(self.labels), self.vocab.num_words())                 <= _DENSE_UPDATE_MAX_VOCAB
+            key = jax.random.PRNGKey(int(rng.integers(2**31)))
+            for e in range(self.epochs):
+                doc_vecs, syn1neg, _ = _pv_scan_program(
+                    doc_vecs, syn1neg, di, wi,
+                    neg_dev, jax.random.fold_in(key, e),
+                    jnp.float32(self.learning_rate), jnp.int32(n_pairs),
+                    K=self.negative, bs=B, n_steps=n_batches, dense=dense)
+        else:
+            neg_table = self.lookup_table.negative_table()
+            for _ in range(self.epochs):
+                order = rng.permutation(len(doc_ids))
+                for s in range(0, len(order), B):
+                    sel = order[s:s + B]
+                    negs = rng.choice(neg_table, (len(sel), self.negative))
+                    # pad the tail to one static shape; weights mask pads
+                    w = np.zeros(B, np.float32)
+                    w[:len(sel)] = 1.0
+                    doc_vecs, syn1neg, _ = _sgns_step(
+                        doc_vecs, syn1neg,
+                        jnp.asarray(_pad_np(doc_ids[sel], B)),
+                        jnp.asarray(_pad_np(word_ids[sel], B)),
+                        jnp.asarray(_pad_np(negs, B), jnp.int32),
+                        jnp.float32(self.learning_rate), jnp.asarray(w))
         self.doc_vectors = np.asarray(doc_vecs)
         self.lookup_table.syn1neg = np.asarray(syn1neg)
 

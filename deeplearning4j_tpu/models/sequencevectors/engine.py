@@ -291,6 +291,18 @@ def _hs_scan_program(syn0, syn1, flat, pos, slen, codes_tab, points_tab,
     return syn0, syn1, losses
 
 
+def _huffman_device_tables(huffman):
+    """Device copies of the Huffman code/point tables + the padded-path
+    float mask — the ONE staging used by both the per-batch fallback
+    and the HS scan path."""
+    codes = jnp.asarray(huffman.codes)
+    points = jnp.asarray(huffman.points)
+    lens = huffman.code_lengths
+    cmask = jnp.asarray((np.arange(codes.shape[1])[None, :]
+                         < lens[:, None]).astype(np.float32))
+    return codes, points, cmask
+
+
 # ------------------------------------------------------------------- sampling
 
 def _pad_np(arr, target: int) -> np.ndarray:
@@ -512,11 +524,7 @@ class SequenceVectors:
         neg_table = (lt.negative_table()
                      if not self.use_hs and not scan_path else None)
         if self.use_hs and not scan_path:
-            codes = jnp.asarray(self.huffman.codes)
-            points = jnp.asarray(self.huffman.points)
-            lens = self.huffman.code_lengths
-            mask_np = (np.arange(codes.shape[1])[None, :] < lens[:, None]).astype(np.float32)
-            cmask = jnp.asarray(mask_np)
+            codes, points, cmask = _huffman_device_tables(self.huffman)
 
         # estimated total steps for linear lr decay
         sentences = list(token_lists)
@@ -660,12 +668,8 @@ class SequenceVectors:
                           jnp.int32(total_steps))
         loss_chunks = []
         if self.use_hs:
-            codes_tab = jnp.asarray(self.huffman.codes)
-            points_tab = jnp.asarray(self.huffman.points)
-            lens = self.huffman.code_lengths
-            cmask_tab = jnp.asarray(
-                (np.arange(codes_tab.shape[1])[None, :]
-                 < lens[:, None]).astype(np.float32))
+            codes_tab, points_tab, cmask_tab = _huffman_device_tables(
+                self.huffman)
             for e in range(self.epochs):
                 syn0, syn1, losses = _hs_scan_program(
                     syn0, syn1, flat_d, pos_d, slen_d, codes_tab,
