@@ -80,11 +80,13 @@ class ParagraphVectors(SequenceVectors):
                  min_word_frequency: int = 1, epochs: int = 1,
                  learning_rate: float = 0.025, negative_sample: int = 5,
                  sequence_learning_algorithm: str = "dbow",
-                 train_words: bool = True, batch_size: int = 4096, seed: int = 123):
+                 train_words: bool = True, batch_size: int = 4096,
+                 seed: int = 123, device_pairgen: bool = True):
         super().__init__(vector_length=layer_size, window=window_size,
                          min_word_frequency=min_word_frequency, epochs=epochs,
                          learning_rate=learning_rate, negative=negative_sample,
-                         batch_size=batch_size, seed=seed)
+                         batch_size=batch_size, seed=seed,
+                         device_pairgen=device_pairgen)
         self.sequence_algo = sequence_learning_algorithm
         self.train_words = train_words
         self.tokenizer_factory = DefaultTokenizerFactory()

@@ -1,0 +1,82 @@
+"""Training with the live dashboard: stats, activation images, model graph.
+
+The observability stack end-to-end (``UiServer.java:25`` role): a
+StatsListener streams score/norm/histogram reports into a storage the
+UiServer serves at ``/train/<session>``, a ConvolutionalIterationListener
+renders per-conv-layer activation montages at ``/activations``, and a
+FlowIterationListener publishes the model graph at ``/flow``. Run it
+and open the printed URL.
+"""
+
+import argparse
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer,
+    DenseLayer,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, UiServer
+from deeplearning4j_tpu.ui.activations import (
+    ConvolutionalIterationListener,
+    FlowIterationListener,
+)
+from deeplearning4j_tpu.ui.stats import StatsListener
+
+
+def main(smoke: bool = False, port: int = 0, keep_serving: bool = False):
+    rng = np.random.default_rng(0)
+    side, n, epochs = (10, 64, 2) if smoke else (28, 4096, 12)
+    x = rng.standard_normal((n, side, side, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.01).updater("adam").activation("relu")
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3)))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=32))
+            .layer(OutputLayer(n_out=10, activation="softmax",
+                               loss_function="mcxent"))
+            .set_input_type(InputType.convolutional(side, side, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    storage = InMemoryStatsStorage()
+    conv = ConvolutionalIterationListener(x[:2], frequency=2)
+    flow = FlowIterationListener(frequency=2)
+    net.set_listeners(StatsListener(storage, frequency=1), conv, flow)
+
+    srv = UiServer(storage, port=port, conv_listener=conv,
+                   flow_listener=flow, model=net).start()
+    print(f"dashboard: {srv.url}  (train view: {srv.url}/train/default, "
+          f"activations: {srv.url}/activations, graph: {srv.url}/flow)")
+
+    ds = DataSet(x, y)
+    for _ in range(epochs):
+        net.fit(ds)
+    print(f"final score {net.score():.4f}; "
+          f"{len(storage.get_reports('default'))} reports, "
+          f"{len(conv.latest)} activation images")
+
+    if keep_serving:
+        print("serving until interrupted...")
+        import time
+        while True:
+            time.sleep(60)
+    srv.stop()
+    return net.score()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--keep-serving", action="store_true")
+    main(**vars(ap.parse_args()))

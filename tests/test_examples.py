@@ -50,3 +50,8 @@ def test_pipelined_gpt_example():
 def test_train_from_export_example():
     from examples.train_from_export import main
     assert np.isfinite(main(smoke=True))
+
+
+def test_train_with_ui_example():
+    from examples.train_with_ui import main
+    assert np.isfinite(main(smoke=True))

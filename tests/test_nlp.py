@@ -290,3 +290,18 @@ class TestVectorizers:
         ds = v.vectorize(["x y", "z w"], [0, 1])
         assert ds.features.shape == (2, 4)
         assert ds.labels.shape == (2, 2)
+
+
+def test_paragraph_vectors_host_fallback_path():
+    """device_pairgen=False exposes the per-batch host path (the
+    equivalence-test path) through the public constructor."""
+    from deeplearning4j_tpu.models.paragraphvectors.paragraphvectors import (
+        ParagraphVectors)
+
+    docs = [("apple banana cherry fruit sweet", ["food"]),
+            ("car engine wheel road drive", ["auto"])] * 10
+    pv = ParagraphVectors(layer_size=16, epochs=4, batch_size=32,
+                          seed=3, device_pairgen=False)
+    pv.fit(docs)
+    assert pv.doc_vectors.shape == (2, 16)
+    assert np.isfinite(pv.doc_vectors).all()
