@@ -148,9 +148,12 @@ def _sgns_step(syn0, syn1neg, centers, contexts, negatives, lr, weights,
 
 
 def _device_pairs(flat, pos, slen, n_tokens, idx, kb, offs, bp, n2w, N):
-    """On-device skip-gram pair generation for one batch of stream
-    positions — the ONE implementation both scan programs share
-    (reduced-window draw, same-sentence bounds, padding guard)."""
+    """On-device window generation for one batch of stream positions —
+    the ONE implementation every scan program shares (reduced-window
+    draw, same-sentence bounds, padding guard). Returns the UNflattened
+    (centers [bp], contexts [bp, 2w], ok [bp, 2w] float mask): the
+    skip-gram callers flatten to a pair stream, CBOW consumes the
+    window matrix directly."""
     centers = flat[idx]
     p, L = pos[idx], slen[idx]
     window = n2w // 2
@@ -160,10 +163,14 @@ def _device_pairs(flat, pos, slen, n_tokens, idx, kb, offs, bp, n2w, N):
           & (cpos >= 0) & (cpos < L[:, None])
           & (idx[:, None] < n_tokens))
     contexts = flat[jnp.clip(idx[:, None] + offs[None, :], 0, N - 1)]
+    return centers, contexts, ok.astype(jnp.float32)
+
+
+def _flat_pairs(centers, contexts, ok, bp, n2w):
+    """[bp]-windows → the flattened (center, context, weight) pair
+    stream the skip-gram objectives consume."""
     c2 = jnp.broadcast_to(centers[:, None], (bp, n2w)).reshape(-1)
-    x2 = contexts.reshape(-1)
-    w2 = ok.reshape(-1).astype(jnp.float32)
-    return c2, x2, w2
+    return c2, contexts.reshape(-1), ok.reshape(-1)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1),
@@ -211,8 +218,8 @@ def _sgns_scan_program(syn0, syn1neg, flat, pos, slen, neg_table, key,
         base = (i % (N // bp)) * bp
         idx = base + jnp.arange(bp, dtype=jnp.int32)              # [bp]
         kb = jax.random.fold_in(key, step0 + i)
-        c2, x2, w2 = _device_pairs(flat, pos, slen, n_tokens, idx, kb,
-                                   offs, bp, n2w, N)
+        c2, x2, w2 = _flat_pairs(*_device_pairs(
+            flat, pos, slen, n_tokens, idx, kb, offs, bp, n2w, N), bp, n2w)
         negs = neg_table[jax.random.randint(
             jax.random.fold_in(kb, 1), (bp * n2w, K), 0,
             neg_table.shape[0])]
@@ -277,8 +284,8 @@ def _hs_scan_program(syn0, syn1, flat, pos, slen, codes_tab, points_tab,
         base = (i % (N // bp)) * bp
         idx = base + jnp.arange(bp, dtype=jnp.int32)
         kb = jax.random.fold_in(key, step0 + i)
-        c2, x2, w2 = _device_pairs(flat, pos, slen, n_tokens, idx, kb,
-                                   offs, bp, n2w, N)
+        c2, x2, w2 = _flat_pairs(*_device_pairs(
+            flat, pos, slen, n_tokens, idx, kb, offs, bp, n2w, N), bp, n2w)
         g_step = (step0 + i).astype(jnp.float32)
         lr = jnp.maximum(min_lr, lr0 * (1.0 - g_step / total))
         syn0, syn1, loss = _hs_math(
@@ -375,11 +382,10 @@ def cbow_pairs(sentences_idx, window, rng, pad_idx):
             np.asarray(masks, np.float32))
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _cbow_sgns_step(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr,
-                    weights):
-    """CBOW with negative sampling: mean of context vectors predicts the
-    center (CBOW.java batched). ``weights`` as in ``_sgns_step``."""
+def _cbow_math(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr,
+               weights):
+    """Shared CBOW + negative-sampling update (CBOW.java batched):
+    mean of context vectors predicts the center."""
     vc = syn0[ctx] * ctx_mask[..., None]            # [B, W, d]
     denom = jnp.maximum(jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0)
     h = jnp.sum(vc, axis=1) / denom                 # [B, d]
@@ -408,6 +414,52 @@ def _cbow_sgns_step(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr,
                      + jnp.sum(jnp.log(jax.nn.sigmoid(-s_neg) + 1e-10) * neg_ok,
                                axis=-1)) * weights) / n_real
     return syn0, syn1neg, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_sgns_step(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr,
+                    weights):
+    """One host-fed CBOW batch (fallback path; the hot path is
+    ``_cbow_scan_program``)."""
+    return _cbow_math(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr,
+                      weights)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("window", "K", "bp", "n_steps"))
+def _cbow_scan_program(syn0, syn1neg, flat, pos, slen, neg_table, key,
+                       lr0, min_lr, n_tokens, step0, total_steps, *,
+                       window, K, bp, n_steps):
+    """ONE EPOCH of CBOW + negative sampling as ONE compiled program —
+    the device pair generation yields exactly CBOW's [bp, 2w] context
+    window (same reduced-window/sentence-bounds mask as the skip-gram
+    scans; one center per stream position)."""
+    offs = jnp.asarray([d for d in range(-window, window + 1) if d != 0],
+                       jnp.int32)
+    N = flat.shape[0]
+    total = total_steps.astype(jnp.float32)
+
+    n2w = 2 * window
+
+    def body(carry, i):
+        syn0, syn1neg = carry
+        base = (i % (N // bp)) * bp
+        idx = base + jnp.arange(bp, dtype=jnp.int32)
+        kb = jax.random.fold_in(key, step0 + i)
+        centers, ctx, cmask = _device_pairs(
+            flat, pos, slen, n_tokens, idx, kb, offs, bp, n2w, N)
+        w = (jnp.sum(cmask, axis=1) > 0).astype(jnp.float32)
+        negs = neg_table[jax.random.randint(
+            jax.random.fold_in(kb, 1), (bp, K), 0, neg_table.shape[0])]
+        g_step = (step0 + i).astype(jnp.float32)
+        lr = jnp.maximum(min_lr, lr0 * (1.0 - g_step / total))
+        syn0, syn1neg, loss = _cbow_math(syn0, syn1neg, ctx, cmask,
+                                         centers, negs, lr, w)
+        return (syn0, syn1neg), loss
+
+    (syn0, syn1neg), losses = jax.lax.scan(
+        body, (syn0, syn1neg), jnp.arange(n_steps, dtype=jnp.int32))
+    return syn0, syn1neg, losses
 
 
 # --------------------------------------------------------------------- engine
@@ -516,11 +568,13 @@ class SequenceVectors:
         else:
             syn0 = jnp.asarray(lt.syn0)
             syn1 = jnp.asarray(lt.syn1) if self.use_hs else jnp.asarray(lt.syn1neg)
-        # the skip-gram scan hot path builds its own device tables — do
-        # the (potentially megabytes of) host table setup only for the
-        # per-batch fallback paths
-        scan_path = (not sharded and self.algo == "skipgram"
-                     and self.subsampling == 0 and self.device_pairgen)
+        # the scan hot path (skip-gram SGNS/HS and CBOW-SGNS) builds
+        # its own device tables — do the (potentially megabytes of)
+        # host table setup only for the per-batch fallback paths
+        scan_path = (not sharded and self.subsampling == 0
+                     and self.device_pairgen
+                     and (self.algo == "skipgram"
+                          or (self.algo == "cbow" and not self.use_hs)))
         neg_table = (lt.negative_table()
                      if not self.use_hs and not scan_path else None)
         if self.use_hs and not scan_path:
@@ -537,13 +591,13 @@ class SequenceVectors:
                  and self.vocab.num_words() <= _DENSE_UPDATE_MAX_VOCAB)
         device_losses: List[jnp.ndarray] = []
 
-        # hot path: skip-gram (SGNS or HS) with no subsampling runs ALL
-        # epochs as one device program per epoch (zero per-step host
-        # traffic; see _sgns_scan_program/_hs_scan_program). Subsampling
-        # re-draws the kept tokens per epoch host-side, so it stays on
-        # the per-batch path.
+        # hot path: SGNS/HS skip-gram and CBOW-SGNS with no subsampling
+        # run each epoch as one device program (zero per-step host
+        # traffic; see the *_scan_program trio). Subsampling re-draws
+        # the kept tokens per epoch host-side, so it stays on the
+        # per-batch path.
         if scan_path:
-            self._fit_sgns_scan(sentences, syn0, syn1, rng)
+            self._fit_scan(sentences, syn0, syn1, rng)
             return
 
         for _ in range(self.epochs):
@@ -632,12 +686,12 @@ class SequenceVectors:
         else:
             lt.syn1neg = np.asarray(syn1)
 
-    def _fit_sgns_scan(self, sentences, syn0, syn1,
-                       rng: np.random.Generator):
-        """Stage the token stream once and run every epoch inside
-        ``_sgns_scan_program`` / ``_hs_scan_program`` — the only
-        host↔device traffic is the initial upload and one final
-        table/loss fetch."""
+    def _fit_scan(self, sentences, syn0, syn1,
+                  rng: np.random.Generator):
+        """Stage the token stream once and run every epoch inside one
+        of the scan programs (SGNS / HS / CBOW) — the only host↔device
+        traffic is the initial upload and one final table/loss
+        fetch."""
         lt = self.lookup_table
         idx_lists = self._to_indices(sentences, rng)
         sents = [s for s in idx_lists if len(s) >= 2]
@@ -650,7 +704,11 @@ class SequenceVectors:
         n_tokens = len(flat)
 
         n2w = 2 * self.window
-        bp = max(8, self.batch_size // n2w)       # positions per step
+        # positions per scan step: skip-gram expands each position into
+        # 2w pairs, so bp*2w ~ batch_size pairs; CBOW trains ONE
+        # example per position, so bp = batch_size outright
+        bp = (self.batch_size if self.algo == "cbow"
+              else max(8, self.batch_size // n2w))
         n_batches = -(-n_tokens // bp)
         pad = n_batches * bp - n_tokens
         if pad:
@@ -667,7 +725,21 @@ class SequenceVectors:
                           jnp.int32(n_tokens), jnp.int32(e * n_batches),
                           jnp.int32(total_steps))
         loss_chunks = []
-        if self.use_hs:
+        # device unigram^0.75 table (SGNS objectives), built at device
+        # size rather than striding the big host table (a stride would
+        # drop most tail words); min-one-slot means the actual length
+        # is max(128k, vocab words) — ~0.5MB once for typical vocabs
+        neg_table = (jnp.asarray(lt.negative_table(size=131072))
+                     if not self.use_hs else None)
+        if self.algo == "cbow":
+            for e in range(self.epochs):
+                syn0, syn1, losses = _cbow_scan_program(
+                    syn0, syn1, flat_d, pos_d, slen_d, neg_table, key,
+                    *scal(e), K=self.negative, **common)
+                loss_chunks.append(losses)
+            lt.syn0 = np.asarray(syn0)
+            lt.syn1neg = np.asarray(syn1)
+        elif self.use_hs:
             codes_tab, points_tab, cmask_tab = _huffman_device_tables(
                 self.huffman)
             for e in range(self.epochs):
@@ -678,12 +750,6 @@ class SequenceVectors:
             lt.syn0 = np.asarray(syn0)
             lt.syn1 = np.asarray(syn1)
         else:
-            # build the unigram^0.75 table at the device size rather
-            # than striding the big host table (a stride would drop most
-            # tail words from negative sampling). min-one-slot means the
-            # actual length is max(128k, vocab words) — ~0.5MB uploaded
-            # once for typical vocabs, linear in vocab beyond 131072.
-            neg_table = jnp.asarray(lt.negative_table(size=131072))
             dense = self.vocab.num_words() <= _DENSE_UPDATE_MAX_VOCAB
             for e in range(self.epochs):
                 # one executable per corpus shape; epochs re-dispatch it
