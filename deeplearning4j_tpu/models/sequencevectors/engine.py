@@ -416,6 +416,40 @@ def _cbow_math(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr,
     return syn0, syn1neg, loss
 
 
+def _cbow_hs_math(syn0, syn1, ctx, ctx_mask, codes, points, code_mask,
+                  lr, weights):
+    """CBOW with hierarchical softmax (CBOW.java HS branch, batched):
+    the masked MEAN of the context vectors walks the CENTER word's
+    Huffman path. codes/points/code_mask are the center's [B, L]
+    tables."""
+    m = ctx_mask[..., None]
+    denom = jnp.maximum(jnp.sum(ctx_mask, axis=-1, keepdims=True), 1.0)
+    h = jnp.sum(syn0[ctx] * m, axis=1) / denom          # [B, d]
+    u = syn1[points]                                    # [B, L, d]
+    s = jnp.einsum("bd,bld->bl", h, u)
+    cm = code_mask * weights[:, None]
+    g = (1.0 - codes - jax.nn.sigmoid(s)) * cm
+    dh = jnp.einsum("bl,bld->bd", g, u)
+    du = g[..., None] * h[:, None, :]
+    dctx = (dh / denom)[:, None, :] * m
+    # capped accumulation (see _sgns_math)
+    wc = ctx_mask * weights[:, None]
+    den_ctx = _row_denom(syn0.shape[0], ctx, wc, syn0.dtype)
+    syn0 = syn0.at[ctx].add(lr * dctx / den_ctx[ctx][..., None])
+    den_p = _row_denom(syn1.shape[0], points, cm, syn1.dtype)
+    syn1 = syn1.at[points].add(lr * du / den_p[points][..., None])
+    p = jax.nn.sigmoid(jnp.where(codes > 0, -s, s))
+    loss = -jnp.sum(jnp.log(p + 1e-10) * cm) / jnp.maximum(jnp.sum(cm), 1.0)
+    return syn0, syn1, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, codes, points, code_mask, lr,
+                  weights):
+    return _cbow_hs_math(syn0, syn1, ctx, ctx_mask, codes, points,
+                         code_mask, lr, weights)
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _cbow_sgns_step(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr,
                     weights):
@@ -549,6 +583,12 @@ class SequenceVectors:
         lt = self.lookup_table
         rng = np.random.default_rng(self.seed)
         sharded = self.mesh is not None
+        if sharded and self.algo == "cbow" and self.use_hs:
+            # fail BEFORE any device placement happens below
+            raise NotImplementedError(
+                "mesh-sharded CBOW with hierarchical softmax is not "
+                "implemented; use negative sampling or the single-device "
+                "path")
         if sharded:
             from deeplearning4j_tpu.models.sequencevectors.distributed import (
                 make_sharded_cbow_step, make_sharded_hs_step,
@@ -630,7 +670,13 @@ class SequenceVectors:
                 w = np.zeros(tgt, np.float32)
                 w[:len(cb)] = 1.0
                 w = jnp.asarray(w)
-                if self.algo == "cbow":
+                if self.algo == "cbow" and self.use_hs:
+                    cj = jnp.asarray(_pad_np(cb, tgt))
+                    syn0, syn1, loss = _cbow_hs_step(
+                        syn0, syn1, jnp.asarray(_pad_np(ctx[s:s + B], tgt)),
+                        jnp.asarray(_pad_np(cmask_b[s:s + B], tgt)),
+                        codes[cj], points[cj], cmask[cj], lr, w)
+                elif self.algo == "cbow":
                     negs = rng.choice(neg_table, (len(cb), self.negative))
                     if sharded:
                         syn0, syn1, loss = sh_step(

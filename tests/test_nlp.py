@@ -305,3 +305,16 @@ def test_paragraph_vectors_host_fallback_path():
     pv.fit(docs)
     assert pv.doc_vectors.shape == (2, 16)
     assert np.isfinite(pv.doc_vectors).all()
+
+
+def test_cbow_hierarchical_softmax_trains():
+    """CBOW + HS (both public builder knobs, CBOW.java HS branch):
+    previously crashed; must train topical structure."""
+    w2v = Word2Vec(layer_size=32, window_size=3, epochs=15,
+                   learning_rate=0.05, batch_size=256, seed=7,
+                   elements_learning_algorithm="cbow",
+                   negative_sample=0, use_hierarchic_softmax=True)
+    w2v.fit(_wide_corpus())
+    ins = np.mean([w2v.similarity("a0", x) for x in ["a1", "a2", "a3"]])
+    crs = np.mean([w2v.similarity("a0", x) for x in ["b1", "b2", "b3"]])
+    assert ins > crs + 0.1, (ins, crs)

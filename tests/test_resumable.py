@@ -1,0 +1,123 @@
+"""Preemption-safe training: kill mid-run, resume, finish identically.
+
+Beyond-parity doctrine (SURVEY.md §5): a preempted-and-resumed run must
+produce EXACTLY the parameters of the uninterrupted run — model,
+updater state, and data cursor all round-trip.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.export import (
+    ExportedDataSetIterator, export_dataset)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.resumable import ResumableTrainer
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.05)
+            .updater("adam").activation("tanh").list()
+            .layer(DenseLayer(n_in=6, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _spill(rng, tmp_path, n_chunks=5, chunk=24):
+    def gen():
+        for _ in range(n_chunks):
+            x = rng.standard_normal((chunk, 6)).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, chunk)]
+            yield DataSet(x, y)
+    d = str(tmp_path / "data")
+    export_dataset(gen(), d, batch_size=24)
+    return d
+
+
+def test_preempted_run_equals_uninterrupted(rng, tmp_path):
+    data_dir = _spill(rng, tmp_path)
+    epochs = 3
+
+    # uninterrupted reference run
+    ref = ResumableTrainer(_net(), str(tmp_path / "ref"), checkpoint_every=2)
+    ref.fit(ExportedDataSetIterator(data_dir, shuffle=True, seed=9),
+            epochs=epochs)
+    want = np.asarray(ref.model.params_flat())
+
+    # "preempted" run: die after 7 batches, then a FRESH process
+    # (fresh trainer + iterator) resumes from disk and finishes
+    ck = str(tmp_path / "ck")
+    t1 = ResumableTrainer(_net(), ck, checkpoint_every=2)
+    it1 = ExportedDataSetIterator(data_dir, shuffle=True, seed=9)
+    t1.fit(it1, epochs=epochs, max_steps=7)
+    del t1, it1  # the dead incarnation
+
+    t2 = ResumableTrainer(_net(), ck, checkpoint_every=2)
+    it2 = ExportedDataSetIterator(data_dir, shuffle=True, seed=9)
+    t2.resume_or_start(it2)
+    assert t2.steps_done == 7
+    t2.fit(it2, epochs=epochs)
+    got = np.asarray(t2.model.params_flat())
+
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_resume_restores_updater_schedule(rng, tmp_path):
+    """Adam moments survive the checkpoint: resuming must NOT restart
+    the optimizer cold (bit-equality above implies it, this pins the
+    state explicitly)."""
+    data_dir = _spill(rng, tmp_path)
+    ck = str(tmp_path / "ck")
+    t1 = ResumableTrainer(_net(), ck, checkpoint_every=1)
+    it1 = ExportedDataSetIterator(data_dir)
+    t1.fit(it1, epochs=1, max_steps=3)
+    step_before = int(t1.model.opt_state["step"])
+    assert step_before == 3
+
+    t2 = ResumableTrainer(_net(), ck, checkpoint_every=1)
+    t2.resume_or_start(ExportedDataSetIterator(data_dir))
+    assert int(t2.model.opt_state["step"]) == step_before
+    m = t2.model.opt_state["updater"]["layer0"]["W"]
+    assert any(np.abs(np.asarray(v)).max() > 0 for v in
+               (m.values() if isinstance(m, dict) else [m]))
+
+
+def test_no_checkpoint_starts_fresh(rng, tmp_path):
+    t = ResumableTrainer(_net(), str(tmp_path / "empty"))
+    assert not t.has_checkpoint()
+    model = t.resume_or_start()
+    assert model is t.model and t.steps_done == 0
+
+
+def test_atomic_checkpoint_never_partial(rng, tmp_path, monkeypatch):
+    """A crash mid-save must leave the PREVIOUS checkpoint intact."""
+    import deeplearning4j_tpu.optimize.resumable as R
+
+    data_dir = _spill(rng, tmp_path)
+    ck = str(tmp_path / "ck")
+    t1 = ResumableTrainer(_net(), ck, checkpoint_every=1)
+    t1.fit(ExportedDataSetIterator(data_dir), epochs=1, max_steps=2)
+    unit = f"{ck}/checkpoint"
+    good_model = open(f"{unit}/model.zip", "rb").read()
+    good_cursor = open(f"{unit}/cursor.json").read()
+
+    def exploding_write(model, path):
+        with open(path, "wb") as f:
+            f.write(b"partial")
+        raise RuntimeError("simulated preemption mid-write")
+
+    monkeypatch.setattr(R, "write_model", exploding_write)
+    t1.steps_done += 1
+    with pytest.raises(RuntimeError, match="preemption"):
+        t1._save(ExportedDataSetIterator(data_dir))
+    # the WHOLE unit (model AND cursor, one atomic dir) is untouched
+    assert open(f"{unit}/model.zip", "rb").read() == good_model
+    assert open(f"{unit}/cursor.json").read() == good_cursor
+    assert not [f for f in os.listdir(ck) if f.startswith(".ckpt_tmp_")]
+
