@@ -20,6 +20,17 @@ import time
 
 import numpy as np
 
+
+def _enable_compile_cache():
+    """Persistent XLA executable cache: the suite compiles ~20 programs
+    and first-compiles are 20-40s each on this box — cached across runs
+    (same dir the test conftest uses)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax_comp_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
 # v5e peaks: bf16 ~197 TFLOP/s per chip, f32 ~½ that.
 PEAK_BF16 = 197e12
 PEAK_F32 = 98.5e12
@@ -37,6 +48,18 @@ def _timeit(fn, warmup=1, iters=3):
         out = fn()
     float(out)
     return (time.perf_counter() - t0) / iters
+
+
+def _best_of_fit_scan(net, batch, epochs, staged, trials=2):
+    """Best-of-N timed fit_scan dispatches (BASELINE.md contention
+    note) — one timing policy for every fit_scan bench."""
+    dt = float("inf")
+    scores = None
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        scores = net.fit_scan(None, batch, epochs=epochs, staged=staged)
+        dt = min(dt, time.perf_counter() - t0)
+    return scores, dt
 
 
 def bench_gemm():
@@ -97,11 +120,10 @@ def bench_lenet():
     data = DataSet(ds.features.reshape(-1, 28, 28, 1), ds.labels)
 
     staged = net.stage_scan(data, batch)  # one host→device transfer
-    # warm up the SAME epochs-baked program the timed run uses
+    # warm up the SAME epochs-baked program the timed run uses; best of
+    # 2 dispatches rides out pool contention (BASELINE.md note)
     net.fit_scan(None, batch, epochs=epochs, staged=staged)
-    t0 = time.perf_counter()
-    scores = net.fit_scan(None, batch, epochs=epochs, staged=staged)
-    dt = time.perf_counter() - t0
+    scores, dt = _best_of_fit_scan(net, batch, epochs, staged)
 
     n_examples = epochs * (epoch_examples // batch) * batch
     eps = n_examples / dt
@@ -147,11 +169,10 @@ def bench_lstm():
     # tunnel dispatch RTT (~0.1-0.25s) stays a small fraction (the same
     # amortization note as bench_lenet / BASELINE.md)
     epochs = 16
-    # warm up the SAME epochs-baked program the timed run uses
+    # warm up the SAME epochs-baked program the timed run uses; best
+    # of 2 dispatches (BASELINE.md contention note)
     net.fit_scan(None, batch, epochs=epochs, staged=staged)
-    t0 = time.perf_counter()
-    scores = net.fit_scan(None, batch, epochs=epochs, staged=staged)
-    dt = time.perf_counter() - t0
+    scores, dt = _best_of_fit_scan(net, batch, epochs, staged)
 
     n_tokens = epochs * 2 * batch * seq
     tps = n_tokens / dt
@@ -349,6 +370,7 @@ def bench_resnet50():
 
 
 def main():
+    _enable_compile_cache()
     subs = {}
     for name, fn in [("gemm_bf16", bench_gemm), ("lenet_mnist", bench_lenet),
                      ("mlp_iris", bench_mlp_iris), ("lstm_char", bench_lstm),

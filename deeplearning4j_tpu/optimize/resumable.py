@@ -70,37 +70,56 @@ class ResumableTrainer:
                 f.flush()
                 os.fsync(f.fileno())
             final = os.path.join(self.directory, _UNIT)
-            if os.path.isdir(final):  # os.replace can't clobber a dir
-                old = final + ".old"
+            old = final + ".old"
+            # a stale .old from a crash between the two renames below
+            # would make os.rename(final, old) fail forever — clear it
+            # (it is only ever a SUPERSEDED checkpoint: the crash that
+            # leaves it also left either `final` or `tmp`+`final`)
+            shutil.rmtree(old, ignore_errors=True)
+            if os.path.isdir(final):  # os.rename can't clobber a dir
                 os.rename(final, old)
-                os.rename(tmp, final)
-                shutil.rmtree(old, ignore_errors=True)
-            else:
-                os.rename(tmp, final)
+            os.rename(tmp, final)
+            shutil.rmtree(old, ignore_errors=True)
         finally:
             if os.path.isdir(tmp):
                 shutil.rmtree(tmp, ignore_errors=True)
 
-    def _unit(self, name: str) -> str:
-        return os.path.join(self.directory, _UNIT, name)
+    def _unit_dir(self) -> Optional[str]:
+        """The newest COMPLETE checkpoint unit: ``checkpoint``, else
+        ``checkpoint.old`` (present only when a preemption landed
+        between the two install renames — its contents are the last
+        complete unit, so recovery still loses at most the final
+        interval, never the whole run)."""
+        for cand in (os.path.join(self.directory, _UNIT),
+                     os.path.join(self.directory, _UNIT + ".old")):
+            if (os.path.exists(os.path.join(cand, _MODEL))
+                    and os.path.exists(os.path.join(cand, _CURSOR))):
+                return cand
+        return None
 
     def has_checkpoint(self) -> bool:
-        return (os.path.exists(self._unit(_MODEL))
-                and os.path.exists(self._unit(_CURSOR)))
+        return self._unit_dir() is not None
 
     def resume_or_start(self, iterator: Optional[DataSetIterator] = None):
         """Restore model + cursor when a checkpoint exists; returns the
         (possibly restored) model. ``iterator`` (with ``restore()``) is
         rewound to the saved position."""
-        if not self.has_checkpoint():
+        unit = self._unit_dir()
+        if unit is None:
             return self.model
-        self.model = restore_model(self._unit(_MODEL))
-        with open(self._unit(_CURSOR)) as f:
+        self.model = restore_model(os.path.join(unit, _MODEL))
+        with open(os.path.join(unit, _CURSOR)) as f:
             cursor = json.load(f)
         self.steps_done = int(cursor.get("steps_done", 0))
         self.epochs_done = int(cursor.get("epochs_done", 0))
-        if iterator is not None and "iterator" in cursor \
-                and hasattr(iterator, "restore"):
+        if iterator is not None and "iterator" in cursor:
+            if not hasattr(iterator, "restore"):
+                raise ValueError(
+                    "checkpoint carries a data cursor but this iterator "
+                    f"({type(iterator).__name__}) has no restore(); "
+                    "resuming without rewinding would silently re-train "
+                    "already-consumed batches — pass the same resumable "
+                    "iterator type used during training")
             iterator.restore(cursor["iterator"])
         return self.model
 

@@ -121,3 +121,39 @@ def test_atomic_checkpoint_never_partial(rng, tmp_path, monkeypatch):
     assert open(f"{unit}/cursor.json").read() == good_cursor
     assert not [f for f in os.listdir(ck) if f.startswith(".ckpt_tmp_")]
 
+
+
+def test_old_unit_is_valid_recovery_point(rng, tmp_path):
+    """A crash between the two install renames leaves only
+    checkpoint.old — resume must use it, not restart from scratch."""
+    data_dir = _spill(rng, tmp_path)
+    ck = str(tmp_path / "ck")
+    t1 = ResumableTrainer(_net(), ck, checkpoint_every=1)
+    t1.fit(ExportedDataSetIterator(data_dir), epochs=1, max_steps=3)
+    # simulate the crash window: the new unit vanished mid-install
+    os.rename(f"{ck}/checkpoint", f"{ck}/checkpoint.old")
+
+    t2 = ResumableTrainer(_net(), ck, checkpoint_every=1)
+    assert t2.has_checkpoint()
+    t2.resume_or_start(ExportedDataSetIterator(data_dir))
+    assert t2.steps_done == 3
+    # and the next save clears the stale .old instead of erroring
+    t2.fit(ExportedDataSetIterator(data_dir), epochs=1, max_steps=1)
+    assert os.path.isdir(f"{ck}/checkpoint")
+    assert not os.path.isdir(f"{ck}/checkpoint.old")
+
+
+def test_non_resumable_iterator_rejected_on_resume(rng, tmp_path):
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    data_dir = _spill(rng, tmp_path)
+    ck = str(tmp_path / "ck")
+    t1 = ResumableTrainer(_net(), ck, checkpoint_every=1)
+    t1.fit(ExportedDataSetIterator(data_dir), epochs=1, max_steps=2)
+
+    x = np.zeros((8, 6), np.float32)
+    y = np.eye(3, dtype=np.float32)[np.zeros(8, np.int64)]
+    plain = ListDataSetIterator(DataSet(x, y), 4)
+    t2 = ResumableTrainer(_net(), ck)
+    with pytest.raises(ValueError, match="restore"):
+        t2.resume_or_start(plain)
