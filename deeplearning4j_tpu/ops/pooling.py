@@ -1,0 +1,83 @@
+"""Max pooling with an equality-mask backward (TPU-fast).
+
+XLA differentiates ``reduce_window(max)`` through SelectAndScatter,
+which is disproportionately slow on TPU: at ResNet-50's stem pool
+([128, 112, 112, 64], 3x3/s2) the backward measured ~11 ms — ~22% of
+the entire 49 ms train step. This custom VJP replaces it with kh*kw
+dense fused passes: for each window offset, gradient flows to input
+cells EQUAL to their window's max (strided slice → compare → dilate →
+shifted add), all bandwidth-bound elementwise work XLA fuses well.
+
+Tie semantics (documented deviation): SelectAndScatter routes each
+window's gradient to the FIRST maximal cell; the equality mask routes
+it to EVERY maximal cell. For continuous activations ties have measure
+zero, and the finite-difference gradient checks (which perturb ties
+away) pass identically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def maxpool2d(x: jnp.ndarray, window: Tuple[int, int],
+              strides: Tuple[int, int], pads: Tuple[int, int]) -> jnp.ndarray:
+    """NHWC max pooling, symmetric spatial padding (pads = (ph, pw))."""
+    kh, kw = window
+    sh, sw = strides
+    ph, pw = pads
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, kh, kw, 1),
+                             (1, sh, sw, 1),
+                             ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+
+
+def _fwd(x, window, strides, pads):
+    y = maxpool2d(x, window, strides, pads)
+    return y, (x, y)
+
+
+def _bwd(window, strides, pads, res, g):
+    x, y = res
+    kh, kw = window
+    sh, sw = strides
+    ph, pw = pads
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    xp = lax.pad(x, neg, ((0, 0, 0), (ph, ph, 0), (pw, pw, 0), (0, 0, 0)))
+    b, H, W, c = xp.shape
+    oy, ox = y.shape[1], y.shape[2]
+    g32 = g.astype(jnp.float32)
+    dxp = jnp.zeros((b, H, W, c), jnp.float32)
+    for ki in range(kh):
+        for kj in range(kw):
+            # windows whose (ki, kj) cell stays in bounds
+            n_h = min(oy, (H - ki - 1) // sh + 1)
+            n_w = min(ox, (W - kj - 1) // sw + 1)
+            if n_h <= 0 or n_w <= 0:
+                continue
+            xs = lax.slice(xp, (0, ki, kj, 0),
+                           (b, ki + (n_h - 1) * sh + 1,
+                            kj + (n_w - 1) * sw + 1, c),
+                           (1, sh, sw, 1))
+            contrib = jnp.where(xs == y[:, :n_h, :n_w].astype(x.dtype),
+                                g32[:, :n_h, :n_w], 0.0)
+            # interior-dilate back to stride spacing, then shift into
+            # place with edge padding — one fused pad+add per offset
+            dil_h = (n_h - 1) * sh + 1
+            dil_w = (n_w - 1) * sw + 1
+            dxp = dxp + lax.pad(
+                contrib, jnp.float32(0),
+                ((0, 0, 0),
+                 (ki, H - ki - dil_h, sh - 1),
+                 (kj, W - kj - dil_w, sw - 1),
+                 (0, 0, 0)))
+    dx = dxp[:, ph:ph + x.shape[1], pw:pw + x.shape[2], :]
+    return (dx.astype(x.dtype),)
+
+
+maxpool2d.defvjp(_fwd, _bwd)
