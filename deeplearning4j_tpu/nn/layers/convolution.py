@@ -26,6 +26,14 @@ from deeplearning4j_tpu.ops.activations import activate
 
 _DIMS = ("NHWC", "HWIO", "NHWC")
 
+# Maxpool backward selector, read ONCE at import (traced branches are
+# baked into jitted executables, so flipping the env var mid-process
+# would be silently ignored anyway): set DL4J_TPU_MAXPOOL_VJP=mask
+# before the first import to opt into the equality-mask VJP.
+import os as _os
+
+_MAXPOOL_VJP = _os.environ.get("DL4J_TPU_MAXPOOL_VJP", "xla")
+
 
 def _padding(conf) -> object:
     if getattr(conf, "convolution_mode", "truncate") == "same":
@@ -79,15 +87,22 @@ class SubsamplingImpl(LayerImpl):
         pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
         pt = c.pooling_type
         if pt == L.PoolingType.MAX:
-            if jnp.issubdtype(x.dtype, jnp.floating):
-                # custom equality-mask backward: XLA's SelectAndScatter
-                # grad measured ~5x slower on TPU (ops/pooling.py)
+            if (jnp.issubdtype(x.dtype, jnp.floating)
+                    and _MAXPOOL_VJP == "mask"):
+                # opt-in equality-mask backward (ops/pooling.py). It wins
+                # the isolated stem-pool microbenchmark ~5x but LOSES
+                # in-model: ResNet-50 full-step A/B on v5e measured
+                # 49 ms/step (XLA SelectAndScatter grad) vs 69 ms/step
+                # (mask VJP) — the kh*kw f32 dense passes break XLA's
+                # fusion around the pool and add HBM traffic the
+                # microbenchmark never saw. Default = XLA backward.
                 from deeplearning4j_tpu.ops.pooling import maxpool2d
                 out = maxpool2d(x, (kh, kw), (sh, sw), (ph, pw))
             else:
+                init = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                        else jnp.iinfo(x.dtype).min)
                 out = jax.lax.reduce_window(
-                    x, jnp.iinfo(x.dtype).min, jax.lax.max, window, strides,
-                    pads)
+                    x, init, jax.lax.max, window, strides, pads)
         elif pt in (L.PoolingType.AVG, L.PoolingType.SUM):
             out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
             if pt == L.PoolingType.AVG:

@@ -9,10 +9,20 @@ cells EQUAL to their window's max (strided slice → compare → dilate →
 shifted add), all bandwidth-bound elementwise work XLA fuses well.
 
 Tie semantics (documented deviation): SelectAndScatter routes each
-window's gradient to the FIRST maximal cell; the equality mask routes
-it to EVERY maximal cell. For continuous activations ties have measure
-zero, and the finite-difference gradient checks (which perturb ties
-away) pass identically.
+window's gradient to the FIRST maximal cell; the equality mask splits
+it EVENLY across every maximal cell (each window's contribution is
+normalized by its tie count, so total gradient mass per window is
+preserved — ADVICE r3: in bf16 and on post-ReLU zero plateaus exact
+ties are common, so the unnormalized mask amplified gradient mass up
+to kh*kw per window).
+
+Status: OPT-IN (``DL4J_TPU_MAXPOOL_VJP=mask``). It wins the isolated
+stem-pool microbenchmark ~5x but loses in-model — the ResNet-50
+full-step A/B on v5e measured 49 ms/step (XLA SelectAndScatter grad)
+vs 69 ms/step (this VJP); LeNet 1.64M ex/s vs 707k. The kh*kw f32
+dense passes break fusion around the pool and add HBM traffic the
+microbenchmark never saw. Kept for shapes where it may still win and
+as the documented record of the experiment.
 """
 
 from __future__ import annotations
@@ -51,31 +61,38 @@ def _bwd(window, strides, pads, res, g):
     xp = lax.pad(x, neg, ((0, 0, 0), (ph, ph, 0), (pw, pw, 0), (0, 0, 0)))
     b, H, W, c = xp.shape
     oy, ox = y.shape[1], y.shape[2]
-    g32 = g.astype(jnp.float32)
-    dxp = jnp.zeros((b, H, W, c), jnp.float32)
+    ym = y.astype(x.dtype)
+
+    # one equality mask per window offset: cell (ki,kj) of every window
+    # aligned to the window's output position (every window is fully
+    # in-bounds of the -inf-padded input, since oy = (H-kh)//sh + 1)
+    masks = {}
+    cnt = jnp.zeros(y.shape, jnp.float32)
     for ki in range(kh):
         for kj in range(kw):
-            # windows whose (ki, kj) cell stays in bounds
-            n_h = min(oy, (H - ki - 1) // sh + 1)
-            n_w = min(ox, (W - kj - 1) // sw + 1)
-            if n_h <= 0 or n_w <= 0:
-                continue
             xs = lax.slice(xp, (0, ki, kj, 0),
-                           (b, ki + (n_h - 1) * sh + 1,
-                            kj + (n_w - 1) * sw + 1, c),
+                           (b, ki + (oy - 1) * sh + 1,
+                            kj + (ox - 1) * sw + 1, c),
                            (1, sh, sw, 1))
-            contrib = jnp.where(xs == y[:, :n_h, :n_w].astype(x.dtype),
-                                g32[:, :n_h, :n_w], 0.0)
-            # interior-dilate back to stride spacing, then shift into
-            # place with edge padding — one fused pad+add per offset
-            dil_h = (n_h - 1) * sh + 1
-            dil_w = (n_w - 1) * sw + 1
-            dxp = dxp + lax.pad(
-                contrib, jnp.float32(0),
-                ((0, 0, 0),
-                 (ki, H - ki - dil_h, sh - 1),
-                 (kj, W - kj - dil_w, sw - 1),
-                 (0, 0, 0)))
+            eq = (xs == ym).astype(jnp.float32)
+            masks[ki, kj] = eq
+            # per-window tie count, so gradient mass is split evenly
+            # across maximal cells instead of duplicated
+            cnt = cnt + eq
+    g32 = g.astype(jnp.float32) / cnt
+
+    dxp = jnp.zeros((b, H, W, c), jnp.float32)
+    dil_h = (oy - 1) * sh + 1
+    dil_w = (ox - 1) * sw + 1
+    for (ki, kj), eq in masks.items():
+        # interior-dilate back to stride spacing, then shift into
+        # place with edge padding — one fused pad+add per offset
+        dxp = dxp + lax.pad(
+            eq * g32, jnp.float32(0),
+            ((0, 0, 0),
+             (ki, H - ki - dil_h, sh - 1),
+             (kj, W - kj - dil_w, sw - 1),
+             (0, 0, 0)))
     dx = dxp[:, ph:ph + x.shape[1], pw:pw + x.shape[2], :]
     return (dx.astype(x.dtype),)
 

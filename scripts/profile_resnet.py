@@ -4,7 +4,11 @@ Usage: python scripts/profile_resnet.py [--trace] [--batch N] [--steps N]
 Prints examples/sec + MFU for the configured variant.
 """
 import argparse
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

@@ -166,3 +166,40 @@ def test_sparse_mcxent_ignore_index(rng):
     want = compute_loss("mcxent", sparse, logits, mask=keep, from_logits=True)
     got = compute_loss("mcxent", ignored, logits, from_logits=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+class TestMaxpoolMaskVJP:
+    """The opt-in equality-mask maxpool backward (ops/pooling.py)."""
+
+    def test_matches_xla_backward_on_distinct_values(self, rng):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from deeplearning4j_tpu.ops.pooling import maxpool2d
+
+        x = jnp.asarray(rng.permutation(8 * 9 * 9 * 3).reshape(8, 9, 9, 3),
+                        jnp.float32)
+
+        def ref(x):
+            return jnp.sum(lax.reduce_window(
+                x * x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                ((0, 0), (1, 1), (1, 1), (0, 0))))
+
+        def got(x):
+            return jnp.sum(maxpool2d(x * x, (3, 3), (2, 2), (1, 1)))
+
+        np.testing.assert_allclose(np.asarray(jax.grad(got)(x)),
+                                   np.asarray(jax.grad(ref)(x)), rtol=1e-6)
+
+    def test_tie_mass_preserved(self, rng):
+        """With exact ties, each window's gradient splits evenly across
+        maximal cells — total mass per window preserved (ADVICE r3)."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.ops.pooling import maxpool2d
+
+        x = jnp.ones((1, 4, 4, 1), jnp.float32)  # every cell ties
+        g = jax.grad(lambda x: jnp.sum(maxpool2d(x, (2, 2), (2, 2), (0, 0))))(x)
+        # 4 windows, each distributing 1.0 over 4 tied cells
+        np.testing.assert_allclose(np.asarray(g), 0.25)
+        assert float(jnp.sum(g)) == 4.0
