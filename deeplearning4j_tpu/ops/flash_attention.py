@@ -34,6 +34,15 @@ Design (online-softmax blocking fitted to the MXU/VMEM):
   on the [b, h, t, t] score buffer.
 - all matmuls run on the MXU in f32 accumulation
   (``preferred_element_type``) from native-bf16 operands.
+- the forward is VPU-bound at ~32% MFU (16k causal, v5e) — a measured
+  plateau, not a tuning gap: per k-step the online-softmax chain
+  (~10M VPU elementwise ops) hides the 2 MXU matmuls. Rejected
+  variants (r4, all measured on-chip): triangular live-block grid,
+  scalar-prefetch index tables, precomputed D-matrix masks (f32 slow,
+  i8 unsupported), masked/unmasked branch split, dead-block index
+  clamping, exp2-space softmax, 2048-wide blocks (VMEM). See
+  BASELINE.md "Flash-attention forward roofline". The backward's
+  higher MFU is structural (7 matmuls per 2 exp chains).
 
 CPU processes (the test mesh) run the same kernels under the Pallas
 interpreter, so fwd+bwd are exercised everywhere; the TPU path
