@@ -1,0 +1,40 @@
+"""The numpy SGNS host baseline (the bench's external word2vec anchor)."""
+import numpy as np
+
+from deeplearning4j_tpu.models.sequencevectors.host_baseline import (
+    sgns_host_benchmark, sgns_pairs)
+
+
+def test_sgns_pairs_window_semantics():
+    flat = np.arange(40, dtype=np.int32) % 7
+    sent_id = np.repeat(np.arange(4, dtype=np.int32), 10)
+    c, x = sgns_pairs(flat, sent_id, window=3, rng=np.random.default_rng(0))
+    assert c.shape == x.shape and c.size > 0
+    # every pair must co-occur within the max window inside one sentence
+    for cc, xx in list(zip(c, x))[:200]:
+        found = any(
+            i != j and abs(i - j) <= 3 and sent_id[i] == sent_id[j]
+            for i in np.flatnonzero(flat == cc)
+            for j in np.flatnonzero(flat == xx))
+        assert found, (cc, xx)
+
+
+def test_host_sgns_reports_throughput():
+    # deterministic bigram structure: 2k always followed by 2k+1
+    v = 10
+    sents = [[2 * k, 2 * k + 1] * 10 for k in range(v // 2)] * 20
+    r = sgns_host_benchmark(sents, v, dim=16, window=2, K=3, lr=0.1,
+                            seed=3, batch=512, max_seconds=5.0)
+    assert r["tokens_per_sec"] > 0 and np.isfinite(r["tokens_per_sec"])
+    assert r["pairs"] > 1000
+
+
+def test_host_benchmark_tiny_corpus_nonzero():
+    """A corpus with fewer pairs than one batch still reports a real
+    (nonzero) throughput — bench divides by this number."""
+    sents = [[0, 1, 2, 3]] * 4
+    r = sgns_host_benchmark(sents, 4, dim=8, window=2, K=2,
+                            batch=4096, max_seconds=1.0)
+    assert r["tokens_per_sec"] > 0 and np.isfinite(r["tokens_per_sec"])
+    for k in ("tokens", "pairs", "seconds", "pairs_per_token"):
+        assert np.isfinite(r[k])
