@@ -37,18 +37,18 @@ def _bind(lib) -> bool:
         return True
     try:
         fp = ctypes.POINTER(ctypes.c_float)
-        lp = ctypes.POINTER(ctypes.c_long)
-        lib.dl4j_gather_rows.argtypes = [fp, ctypes.c_long, ctypes.c_long,
-                                         lp, ctypes.c_long, fp, ctypes.c_int]
-        lib.dl4j_gather_rows.restype = ctypes.c_long
-        lib.dl4j_gather_normalize.argtypes = [fp, ctypes.c_long,
-                                              ctypes.c_long, lp,
-                                              ctypes.c_long, fp, fp, fp,
+        lp = ctypes.POINTER(ctypes.c_int64)
+        lib.dl4j_gather_rows.argtypes = [fp, ctypes.c_int64, ctypes.c_int64,
+                                         lp, ctypes.c_int64, fp, ctypes.c_int]
+        lib.dl4j_gather_rows.restype = ctypes.c_int64
+        lib.dl4j_gather_normalize.argtypes = [fp, ctypes.c_int64,
+                                              ctypes.c_int64, lp,
+                                              ctypes.c_int64, fp, fp, fp,
                                               ctypes.c_int]
-        lib.dl4j_gather_normalize.restype = ctypes.c_long
-        lib.dl4j_onehot.argtypes = [lp, ctypes.c_long, ctypes.c_long, fp,
+        lib.dl4j_gather_normalize.restype = ctypes.c_int64
+        lib.dl4j_onehot.argtypes = [lp, ctypes.c_int64, ctypes.c_int64, fp,
                                     ctypes.c_int]
-        lib.dl4j_onehot.restype = ctypes.c_long
+        lib.dl4j_onehot.restype = ctypes.c_int64
         lib._batcher_bound = True
         return True
     except AttributeError:  # stale .so without the batch kernels
@@ -76,7 +76,7 @@ def gather_rows(src: np.ndarray, idx: np.ndarray,
     if lib is not None and _bind(lib):
         out = np.empty((len(idx), flat.shape[1]), np.float32)
         fp = ctypes.POINTER(ctypes.c_float)
-        lp = ctypes.POINTER(ctypes.c_long)
+        lp = ctypes.POINTER(ctypes.c_int64)
         if mean is None:
             rc = lib.dl4j_gather_rows(
                 flat.ctypes.data_as(fp), flat.shape[0], flat.shape[1],
@@ -122,7 +122,7 @@ def one_hot(labels: np.ndarray, num_classes: int,
     if lib is not None and _bind(lib):
         out = np.empty((len(labels), num_classes), np.float32)
         rc = lib.dl4j_onehot(
-            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             len(labels), num_classes,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), threads)
         if rc == -2:

@@ -63,19 +63,19 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except OSError as e:
             logger.warning("native io load failed (%s); python fallback", e)
             return None
-        lib.dl4j_csv_shape.argtypes = [ctypes.c_char_p, ctypes.c_long,
-                                       ctypes.POINTER(ctypes.c_long),
-                                       ctypes.POINTER(ctypes.c_long)]
-        lib.dl4j_csv_parse.argtypes = [ctypes.c_char_p, ctypes.c_long,
+        lib.dl4j_csv_shape.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                       ctypes.POINTER(ctypes.c_int64),
+                                       ctypes.POINTER(ctypes.c_int64)]
+        lib.dl4j_csv_parse.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                        ctypes.POINTER(ctypes.c_float),
-                                       ctypes.c_long, ctypes.c_long, ctypes.c_int]
-        lib.dl4j_csv_parse.restype = ctypes.c_long
+                                       ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+        lib.dl4j_csv_parse.restype = ctypes.c_int64
         lib.dl4j_idx_header.argtypes = [ctypes.c_char_p,
                                         ctypes.POINTER(ctypes.c_int),
                                         ctypes.POINTER(ctypes.c_int),
-                                        ctypes.POINTER(ctypes.c_long)]
+                                        ctypes.POINTER(ctypes.c_int64)]
         lib.dl4j_idx_read.argtypes = [ctypes.c_char_p,
-                                      ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+                                      ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -93,8 +93,8 @@ def csv_read_floats(path: str, skip_rows: int = 0, threads: int = 0,
     lib = get_lib()
     if lib is None:
         return _csv_read_floats_py(path, skip_rows, strict)
-    rows = ctypes.c_long()
-    cols = ctypes.c_long()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
     rc = lib.dl4j_csv_shape(path.encode(), skip_rows,
                             ctypes.byref(rows), ctypes.byref(cols))
     if rc != 0:
@@ -143,7 +143,7 @@ def idx_read(path: str) -> Optional[np.ndarray]:
         return None
     dtype = ctypes.c_int()
     ndim = ctypes.c_int()
-    dims = (ctypes.c_long * 8)()
+    dims = (ctypes.c_int64 * 8)()
     rc = lib.dl4j_idx_header(path.encode(), ctypes.byref(dtype),
                              ctypes.byref(ndim), dims)
     if rc != 0:

@@ -53,8 +53,13 @@ FileBuf read_file(const char* path) {
     FILE* f = fopen(path, "rb");
     if (!f) return fb;
     fseek(f, 0, SEEK_END);
-    long n = ftell(f);
+#if defined(_WIN32)
+    int64_t n = _ftelli64(f);  // long ftell is 32-bit on LLP64
+#else
+    int64_t n = ftello(f);
+#endif
     fseek(f, 0, SEEK_SET);
+    if (n < 0) { fclose(f); return fb; }  // ftell failure: empty buf
     fb.data.resize(n + 1);
     if (n > 0 && fread(fb.data.data(), 1, n, f) != (size_t)n) { fclose(f); return fb; }
     fclose(f);
@@ -66,12 +71,12 @@ FileBuf read_file(const char* path) {
 
 // Collect [start, end) of every data line (after skipping skip_rows
 // PHYSICAL lines and dropping blank lines) — shared by shape + parse.
-void data_lines(const std::vector<char>& buf, long skip_rows,
+void data_lines(const std::vector<char>& buf, int64_t skip_rows,
                 std::vector<const char*>& starts,
                 std::vector<const char*>& ends) {
     const char* p = buf.data();
     const char* end = p + buf.size();
-    long physical = 0;
+    int64_t physical = 0;
     while (p < end) {
         const char* line_end = (const char*)memchr(p, '\n', end - p);
         if (!line_end) line_end = end;
@@ -90,15 +95,15 @@ extern "C" {
 
 // ----------------------------------------------------------------- csv
 
-int dl4j_csv_shape(const char* path, long skip_rows, long* rows, long* cols) {
+int dl4j_csv_shape(const char* path, int64_t skip_rows, int64_t* rows, int64_t* cols) {
     FileBuf fb = read_file(path);
     if (!fb.ok) return -1;
     std::vector<const char*> starts, ends;
     data_lines(fb.data, skip_rows, starts, ends);
-    *rows = (long)starts.size();
+    *rows = (int64_t)starts.size();
     *cols = 0;
     if (!starts.empty()) {
-        long c = 1;
+        int64_t c = 1;
         for (const char* q = starts[0]; q < ends[0]; q++)
             if (*q == ',') c++;
         *cols = c;
@@ -109,22 +114,22 @@ int dl4j_csv_shape(const char* path, long skip_rows, long* rows, long* cols) {
 // Parse into a pre-allocated [rows, cols] float32 buffer. Returns the
 // number of non-numeric cells (>= 0, parsed as 0.0), or negative on IO
 // error — the caller decides whether bad cells are fatal.
-long dl4j_csv_parse(const char* path, long skip_rows, float* out,
-                    long rows, long cols, int threads) {
+int64_t dl4j_csv_parse(const char* path, int64_t skip_rows, float* out,
+                    int64_t rows, int64_t cols, int threads) {
     FileBuf fb = read_file(path);
     if (!fb.ok) return -1;
     std::vector<const char*> starts, ends;
     data_lines(fb.data, skip_rows, starts, ends);
-    if ((long)starts.size() < rows) return -3;
+    if ((int64_t)starts.size() < rows) return -3;
 
-    std::atomic<long> bad{0};
-    auto parse_range = [&](long lo, long hi) {
-        long local_bad = 0;
-        for (long i = lo; i < hi; i++) {
+    std::atomic<int64_t> bad{0};
+    auto parse_range = [&](int64_t lo, int64_t hi) {
+        int64_t local_bad = 0;
+        for (int64_t i = lo; i < hi; i++) {
             const char* q = starts[i];
             const char* line_end = ends[i];
             float* row_out = out + i * cols;
-            long col = 0;
+            int64_t col = 0;
             while (col < cols) {
                 const char* cell_end = (const char*)memchr(q, ',', line_end - q);
                 if (!cell_end) cell_end = line_end;
@@ -145,17 +150,17 @@ long dl4j_csv_parse(const char* path, long skip_rows, float* out,
     if (nt < 1) nt = 1;
     if (nt > 16) nt = 16;
     // small files are not worth thread spawns
-    long min_rows_per_thread = 4096;
-    long useful = rows / min_rows_per_thread + 1;
-    if ((long)nt > useful) nt = (int)useful;
+    int64_t min_rows_per_thread = 4096;
+    int64_t useful = rows / min_rows_per_thread + 1;
+    if ((int64_t)nt > useful) nt = (int)useful;
     if (nt <= 1) {
         parse_range(0, rows);
     } else {
-        long per = (rows + nt - 1) / nt;
+        int64_t per = (rows + nt - 1) / nt;
         std::vector<std::thread> pool;
         for (int t = 0; t < nt; t++) {
-            long lo = t * per;
-            long hi = lo + per < rows ? lo + per : rows;
+            int64_t lo = t * per;
+            int64_t hi = lo + per < rows ? lo + per : rows;
             if (lo >= hi) break;
             pool.emplace_back(parse_range, lo, hi);
         }
@@ -166,7 +171,7 @@ long dl4j_csv_parse(const char* path, long skip_rows, float* out,
 
 // ----------------------------------------------------------------- idx
 
-int dl4j_idx_header(const char* path, int* dtype, int* ndim, long* dims) {
+int dl4j_idx_header(const char* path, int* dtype, int* ndim, int64_t* dims) {
     FILE* f = fopen(path, "rb");
     if (!f) return -1;
     unsigned char h[4];
@@ -177,20 +182,20 @@ int dl4j_idx_header(const char* path, int* dtype, int* ndim, long* dims) {
     for (int i = 0; i < *ndim; i++) {
         unsigned char d[4];
         if (fread(d, 1, 4, f) != 4) { fclose(f); return -4; }
-        dims[i] = ((long)d[0] << 24) | ((long)d[1] << 16) | ((long)d[2] << 8) | d[3];
+        dims[i] = ((int64_t)d[0] << 24) | ((int64_t)d[1] << 16) | ((int64_t)d[2] << 8) | d[3];
     }
     fclose(f);
     return 0;
 }
 
-int dl4j_idx_read(const char* path, unsigned char* out, long nbytes) {
+int dl4j_idx_read(const char* path, unsigned char* out, int64_t nbytes) {
     FILE* f = fopen(path, "rb");
     if (!f) return -1;
     unsigned char h[4];
     if (fread(h, 1, 4, f) != 4) { fclose(f); return -2; }
-    long skip = 4 + 4 * h[3];
+    int64_t skip = 4 + 4 * h[3];
     fseek(f, skip, SEEK_SET);
-    long got = (long)fread(out, 1, nbytes, f);
+    int64_t got = (int64_t)fread(out, 1, nbytes, f);
     fclose(f);
     return got == nbytes ? 0 : -5;
 }
@@ -208,29 +213,29 @@ int dl4j_idx_read(const char* path, unsigned char* out, long nbytes) {
 
 // Minimum per-thread work (floats): below this, thread create/join
 // overhead dwarfs the copy — typical 32-row minibatches run inline.
-static const long kMinWorkPerThread = 1L << 16;
+static const int64_t kMinWorkPerThread = 1L << 16;
 
-static int clamp_threads(int threads, long rows, long work_per_row) {
+static int clamp_threads(int threads, int64_t rows, int64_t work_per_row) {
     int nt = threads > 0 ? threads
                          : (int)std::thread::hardware_concurrency();
     if (nt < 1) nt = 1;
-    if ((long)nt > rows) nt = (int)(rows > 0 ? rows : 1);
-    long total = rows * (work_per_row > 0 ? work_per_row : 1);
-    long by_work = total / kMinWorkPerThread;
+    if ((int64_t)nt > rows) nt = (int)(rows > 0 ? rows : 1);
+    int64_t total = rows * (work_per_row > 0 ? work_per_row : 1);
+    int64_t by_work = total / kMinWorkPerThread;
     if (by_work < 1) by_work = 1;
-    if ((long)nt > by_work && threads <= 0) nt = (int)by_work;
+    if ((int64_t)nt > by_work && threads <= 0) nt = (int)by_work;
     return nt;
 }
 
 template <typename Fn>
-static void parallel_rows(long rows, long work_per_row, int threads, Fn fn) {
+static void parallel_rows(int64_t rows, int64_t work_per_row, int threads, Fn fn) {
     int nt = clamp_threads(threads, rows, work_per_row);
     if (nt <= 1) { fn(0L, rows); return; }
-    long per = (rows + nt - 1) / nt;
+    int64_t per = (rows + nt - 1) / nt;
     std::vector<std::thread> pool;
     for (int t = 0; t < nt; t++) {
-        long lo = t * per;
-        long hi = lo + per < rows ? lo + per : rows;
+        int64_t lo = t * per;
+        int64_t hi = lo + per < rows ? lo + per : rows;
         if (lo >= hi) break;
         pool.emplace_back(fn, lo, hi);
     }
@@ -239,28 +244,28 @@ static void parallel_rows(long rows, long work_per_row, int threads, Fn fn) {
 
 extern "C" {
 
-long dl4j_gather_rows(const float* src, long n_rows, long row_elems,
-                      const long* idx, long n_idx, float* out, int threads) {
-    for (long i = 0; i < n_idx; i++)
+int64_t dl4j_gather_rows(const float* src, int64_t n_rows, int64_t row_elems,
+                      const int64_t* idx, int64_t n_idx, float* out, int threads) {
+    for (int64_t i = 0; i < n_idx; i++)
         if (idx[i] < 0 || idx[i] >= n_rows) return -2;
-    parallel_rows(n_idx, row_elems, threads, [&](long lo, long hi) {
-        for (long i = lo; i < hi; i++)
+    parallel_rows(n_idx, row_elems, threads, [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; i++)
             std::memcpy(out + i * row_elems, src + idx[i] * row_elems,
                         sizeof(float) * (size_t)row_elems);
     });
     return 0;
 }
 
-long dl4j_gather_normalize(const float* src, long n_rows, long row_elems,
-                           const long* idx, long n_idx, const float* mean,
+int64_t dl4j_gather_normalize(const float* src, int64_t n_rows, int64_t row_elems,
+                           const int64_t* idx, int64_t n_idx, const float* mean,
                            const float* stdv, float* out, int threads) {
-    for (long i = 0; i < n_idx; i++)
+    for (int64_t i = 0; i < n_idx; i++)
         if (idx[i] < 0 || idx[i] >= n_rows) return -2;
-    parallel_rows(n_idx, row_elems, threads, [&](long lo, long hi) {
-        for (long i = lo; i < hi; i++) {
+    parallel_rows(n_idx, row_elems, threads, [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; i++) {
             const float* row = src + idx[i] * row_elems;
             float* dst = out + i * row_elems;
-            for (long j = 0; j < row_elems; j++) {
+            for (int64_t j = 0; j < row_elems; j++) {
                 float sd = stdv[j];
                 dst[j] = (row[j] - mean[j]) / (sd != 0.0f ? sd : 1.0f);
             }
@@ -269,14 +274,14 @@ long dl4j_gather_normalize(const float* src, long n_rows, long row_elems,
     return 0;
 }
 
-long dl4j_onehot(const long* labels, long n, long classes, float* out,
+int64_t dl4j_onehot(const int64_t* labels, int64_t n, int64_t classes, float* out,
                  int threads) {
-    for (long i = 0; i < n; i++)
+    for (int64_t i = 0; i < n; i++)
         if (labels[i] < 0 || labels[i] >= classes) return -2;
-    parallel_rows(n, classes, threads, [&](long lo, long hi) {
+    parallel_rows(n, classes, threads, [&](int64_t lo, int64_t hi) {
         std::memset(out + lo * classes, 0,
                     sizeof(float) * (size_t)((hi - lo) * classes));
-        for (long i = lo; i < hi; i++)
+        for (int64_t i = lo; i < hi; i++)
             out[i * classes + labels[i]] = 1.0f;
     });
     return 0;

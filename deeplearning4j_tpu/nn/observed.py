@@ -13,6 +13,24 @@ read — observers that only consume the score never pay for the mean.
 
 from __future__ import annotations
 
+import threading
+
+# One lock for all pending-sync handoffs (ADVICE r3): reads can come
+# from non-training threads (a UiServer polling model.params while
+# ParallelWrapper.fit runs), and the get-and-clear below must not let
+# two readers both run the thunk, nor let the training thread donate
+# the buffers a reader's thunk is still consuming. Contention is nil —
+# the lock is held only for the thunk run / a pointer clear.
+_SYNC_LOCK = threading.Lock()
+
+
+def clear_pending_sync(obj) -> None:
+    """Drop ``obj``'s pending observer sync. Blocks while a reader
+    thread is mid-thunk, so the caller may safely donate the buffers
+    the thunk references once this returns."""
+    with _SYNC_LOCK:
+        obj.__dict__["_observer_sync"] = None
+
 
 class SyncedStateAttr:
     """Data descriptor backing ``params``/``opt_state``/``states``.
@@ -30,10 +48,12 @@ class SyncedStateAttr:
     def __get__(self, obj, objtype=None):
         if obj is None:
             return self
-        sync = obj.__dict__.get("_observer_sync")
-        if sync is not None:
-            obj._observer_sync = None
-            sync()
+        if obj.__dict__.get("_observer_sync") is not None:  # cheap probe
+            with _SYNC_LOCK:  # atomic get-and-clear + run (ADVICE r3)
+                sync = obj.__dict__.get("_observer_sync")
+                if sync is not None:
+                    obj.__dict__["_observer_sync"] = None
+                    sync()
         return obj.__dict__.get(self._slot)
 
     def __set__(self, obj, value):

@@ -34,6 +34,7 @@ from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 from deeplearning4j_tpu.util.model_serializer import restore_model, write_model
 
 _UNIT = "checkpoint"
+_TMP_PREFIX = ".ckpt_tmp_"
 _MODEL = "model.zip"
 _CURSOR = "cursor.json"
 
@@ -47,6 +48,12 @@ class ResumableTrainer:
         self.directory = directory
         self.checkpoint_every = max(1, checkpoint_every)
         os.makedirs(directory, exist_ok=True)
+        # sweep temp dirs abandoned by dead incarnations (a preemption
+        # mid-write leaves .ckpt_tmp_*; they are never a complete unit)
+        for name in os.listdir(directory):
+            if name.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(directory, name),
+                              ignore_errors=True)
         self.steps_done = 0
         self.epochs_done = 0
 
@@ -58,7 +65,7 @@ class ResumableTrainer:
         # (two independently-renamed files would let a preemption
         # between them pair a new model with an old cursor, silently
         # replaying batches on resume)
-        tmp = tempfile.mkdtemp(dir=self.directory, prefix=".ckpt_tmp_")
+        tmp = tempfile.mkdtemp(dir=self.directory, prefix=_TMP_PREFIX)
         try:
             write_model(self.model, os.path.join(tmp, _MODEL))
             cursor = {"steps_done": self.steps_done,
@@ -71,15 +78,16 @@ class ResumableTrainer:
                 os.fsync(f.fileno())
             final = os.path.join(self.directory, _UNIT)
             old = final + ".old"
-            # a stale .old from a crash between the two renames below
-            # would make os.rename(final, old) fail forever — clear it
-            # (it is only ever a SUPERSEDED checkpoint: the crash that
-            # leaves it also left either `final` or `tmp`+`final`)
-            shutil.rmtree(old, ignore_errors=True)
+            # Invariant (ADVICE r3): at EVERY instant at least one
+            # complete unit is visible. Only touch `old` while `final`
+            # exists: after a crash that left .old-only (preemption
+            # between the two installs below), clearing old before
+            # installing tmp would open a window with NO unit at all.
             if os.path.isdir(final):  # os.rename can't clobber a dir
+                shutil.rmtree(old, ignore_errors=True)  # final covers us
                 os.rename(final, old)
             os.rename(tmp, final)
-            shutil.rmtree(old, ignore_errors=True)
+            shutil.rmtree(old, ignore_errors=True)  # final covers us
         finally:
             if os.path.isdir(tmp):
                 shutil.rmtree(tmp, ignore_errors=True)

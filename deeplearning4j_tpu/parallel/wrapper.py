@@ -34,6 +34,7 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator, DataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.nn.observed import clear_pending_sync
 from deeplearning4j_tpu.optimize.training_stats import TrainingStats
 from deeplearning4j_tpu.parallel.mesh import MeshContext, make_mesh
 
@@ -196,8 +197,9 @@ class ParallelWrapper:
             for h in self.hooks:
                 h.pre_update(m, self._counter)
             # an unconsumed pending sync still references the buffers the
-            # step below donates — drop it (nobody looked this round)
-            m._observer_sync = None
+            # step below donates — drop it (nobody looked this round);
+            # blocks while an observer thread is mid-thunk (ADVICE r3)
+            clear_pending_sync(m)
             with self._phase("step"):
                 wparams, wopt, wstates, scores = self._vstep(wparams, wopt, wstates, x, y, rng_key)
                 self._counter += 1
@@ -237,7 +239,7 @@ class ParallelWrapper:
         # reference's average-everything semantics. Clear any pending
         # observer sync FIRST so a later read can't clobber the final
         # state with a stale per-step mean.
-        m._observer_sync = None
+        clear_pending_sync(m)
         wparams, wopt = self._avg(wparams, wopt)
         take0 = lambda t: jax.tree.map(lambda v: v[0], t)
         avg0 = lambda t: jax.tree.map(lambda v: jnp.mean(v, axis=0), t)

@@ -157,3 +157,54 @@ def test_non_resumable_iterator_rejected_on_resume(rng, tmp_path):
     t2 = ResumableTrainer(_net(), ck)
     with pytest.raises(ValueError, match="restore"):
         t2.resume_or_start(plain)
+
+
+def test_old_only_save_keeps_a_unit_visible_at_every_instant(
+        rng, tmp_path, monkeypatch):
+    """ADVICE r3 (medium): starting from a .old-only recovery state,
+    _save must never pass through an instant with NO complete unit on
+    disk — every rename/rmtree step is checked."""
+    import shutil as _shutil
+
+    import deeplearning4j_tpu.optimize.resumable as R
+
+    data_dir = _spill(rng, tmp_path)
+    ck = str(tmp_path / "ck")
+    t1 = ResumableTrainer(_net(), ck, checkpoint_every=1)
+    t1.fit(ExportedDataSetIterator(data_dir), epochs=1, max_steps=2)
+    os.rename(f"{ck}/checkpoint", f"{ck}/checkpoint.old")  # crash window
+
+    def a_unit_visible():
+        return any(
+            os.path.exists(os.path.join(ck, u, "model.zip"))
+            and os.path.exists(os.path.join(ck, u, "cursor.json"))
+            for u in ("checkpoint", "checkpoint.old"))
+
+    assert a_unit_visible()
+    real_rename, real_rmtree = os.rename, _shutil.rmtree
+
+    def checked_rename(src, dst):
+        real_rename(src, dst)
+        assert a_unit_visible(), f"no unit after rename {src} -> {dst}"
+
+    def checked_rmtree(path, **kw):
+        real_rmtree(path, **kw)
+        assert a_unit_visible(), f"no unit after rmtree {path}"
+
+    monkeypatch.setattr(R.os, "rename", checked_rename)
+    monkeypatch.setattr(R.shutil, "rmtree", checked_rmtree)
+    t2 = ResumableTrainer(_net(), ck, checkpoint_every=1)
+    t2.resume_or_start(ExportedDataSetIterator(data_dir))
+    t2.fit(ExportedDataSetIterator(data_dir), epochs=1, max_steps=1)
+    assert os.path.isdir(f"{ck}/checkpoint")
+    assert not os.path.isdir(f"{ck}/checkpoint.old")
+
+
+def test_stale_tmp_dirs_swept_on_init(rng, tmp_path):
+    data_dir = _spill(rng, tmp_path)
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    (ck / ".ckpt_tmp_dead").mkdir()
+    (ck / ".ckpt_tmp_dead" / "model.zip").write_bytes(b"partial")
+    ResumableTrainer(_net(), str(ck), checkpoint_every=1)
+    assert not (ck / ".ckpt_tmp_dead").exists()
