@@ -38,11 +38,22 @@ class ROC:
         pos = labels > 0.5
         self.pos += int(pos.sum())
         self.neg += int((~pos).sum())
-        # predicted positive at threshold t: score >= t
-        for i, t in enumerate(self.thresholds):
-            predicted = predictions >= t
-            self.tp[i] += int((predicted & pos).sum())
-            self.fp[i] += int((predicted & ~pos).sum())
+        # predicted positive at threshold t: score >= t. One vectorized
+        # pass instead of a per-threshold host loop (r3 VERDICT weak #5):
+        # searchsorted(thresholds, p, 'right') counts thresholds <= p —
+        # exactly how many grid points this sample is predicted-positive
+        # at, with the SAME comparison semantics as the old loop — and a
+        # histogram tail-sum turns counts into per-threshold totals.
+        def _accumulate(counts: np.ndarray, into: np.ndarray) -> None:
+            hist = np.bincount(counts, minlength=self.steps + 2)
+            tail = hist[::-1].cumsum()[::-1]  # tail[k] = #samples cnt>=k
+            into += tail[1:]  # contributes at index i iff cnt >= i+1
+        cnt = np.searchsorted(self.thresholds, predictions, side="right")
+        # NaN sorts after every threshold; the old `p >= t` loop counted
+        # a NaN score predicted-positive at NO threshold — keep that
+        cnt = np.where(np.isnan(predictions), 0, cnt)
+        _accumulate(cnt[pos], self.tp)
+        _accumulate(cnt[~pos], self.fp)
 
     def get_roc_curve(self) -> List[Tuple[float, float, float]]:
         """[(threshold, fpr, tpr)]"""
