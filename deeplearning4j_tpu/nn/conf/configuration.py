@@ -63,6 +63,11 @@ class NeuralNetConfiguration:
     l1: float = 0.0
     l2: float = 0.0
     dropout: float = 0.0
+    # DropConnect (NeuralNetConfiguration.Builder.useDropConnect): when
+    # true, the layer dropout prob masks the WEIGHTS in preOutput
+    # (BaseLayer.java:350, ConvolutionLayer.java:189 via
+    # util/Dropout.applyDropConnect) instead of the input activations
+    use_drop_connect: bool = False
     gradient_normalization: str = GradientNormalization.NONE.value
     gradient_normalization_threshold: float = 1.0
     mini_batch: bool = True

@@ -127,11 +127,41 @@ class LayerImpl:
 
     def maybe_dropout_input(self, x: jnp.ndarray, train: bool, rng: Optional[jax.Array]) -> jnp.ndarray:
         """The reference applies dropout to a layer's *input* activations
-        (``BaseLayer.preOutput`` → ``Dropout.applyDropout``)."""
+        (``BaseLayer.preOutput`` → ``Dropout.applyDropout``) — UNLESS
+        DropConnect is on, which redirects the same probability to the
+        weights instead (``BaseLayer.java:449`` has ``!useDropConnect``
+        in the input-dropout condition)."""
         rate = self.dropout_rate
-        if train and rate > 0.0 and rng is not None:
+        if (train and rate > 0.0 and rng is not None
+                and not (self.applies_drop_connect
+                         and getattr(self.gc, "use_drop_connect", False))):
             return apply_dropout(x, rate, rng)
         return x
+
+    # True only for impls whose forward actually calls maybe_drop_connect
+    # (dense family, conv, output — the layers where the reference's
+    # BaseLayer.preOutput/ConvolutionLayer apply it). Layers WITHOUT the
+    # weight-mask path keep their input dropout even under
+    # use_drop_connect, so the flag can never silently strip a layer's
+    # only stochastic regularization (review r4).
+    applies_drop_connect = False
+
+    def maybe_drop_connect(self, params: Dict[str, jnp.ndarray], train: bool,
+                           rng: Optional[jax.Array]) -> Dict[str, jnp.ndarray]:
+        """DropConnect (``BaseLayer.preOutput:350``,
+        ``ConvolutionLayer.java:189`` → ``util/Dropout.java:13``
+        ``applyDropConnect``): with ``use_drop_connect``, the layer's
+        dropout probability masks the WEIGHT matrix (W only — biases are
+        untouched, matching the reference's WEIGHT_KEY-only call).
+        Inverted scaling (survivors / keep) like this framework's input
+        dropout, so inference needs no rescale."""
+        rate = self.dropout_rate
+        if not (train and rate > 0.0 and rng is not None and "W" in params
+                and getattr(self.gc, "use_drop_connect", False)):
+            return params
+        # distinct stream from any input-dropout use of the same rng
+        key = jax.random.fold_in(rng, 0x0D20)
+        return {**params, "W": apply_dropout(params["W"], rate, key)}
 
     def regularization_penalty(self, params: Dict[str, jnp.ndarray]) -> jnp.ndarray:
         """L1/L2 score term (``BaseLayer.calcL2/calcL1``; weights only, not

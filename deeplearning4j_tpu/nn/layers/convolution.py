@@ -45,6 +45,7 @@ def _padding(conf) -> object:
 @register_impl(L.ConvolutionLayer)
 class ConvolutionImpl(LayerImpl):
     supports_no_bias = True
+    applies_drop_connect = True
 
     def init_params(self, key: jax.Array) -> Dict[str, jnp.ndarray]:
         c = self.conf
@@ -61,6 +62,7 @@ class ConvolutionImpl(LayerImpl):
 
     def forward(self, params, x, state, train, rng=None, mask=None):
         x = self.maybe_dropout_input(x, train, rng)
+        params = self.maybe_drop_connect(params, train, rng)
         z = jax.lax.conv_general_dilated(
             x, params["W"].astype(x.dtype),
             window_strides=self.conf.stride,

@@ -35,6 +35,7 @@ class BaseDenseImpl(LayerImpl):
     """z = x·W + b ; a = act(z) (``BaseLayer.preOutput`` :354)."""
 
     supports_no_bias = True
+    applies_drop_connect = True
 
     def init_params(self, key: jax.Array) -> Dict[str, jnp.ndarray]:
         c = self.conf
@@ -52,6 +53,7 @@ class BaseDenseImpl(LayerImpl):
 
     def forward(self, params, x, state, train, rng=None, mask=None):
         x = self.maybe_dropout_input(x, train, rng)
+        params = self.maybe_drop_connect(params, train, rng)
         return activate(self.activation, self.preout(params, x)), state
 
 
@@ -76,6 +78,7 @@ class OutputImpl(BaseDenseImpl):
     def score(self, params, x, labels, state, train, rng=None, mask=None):
         """Mean-over-examples data loss for this output layer."""
         x = self.maybe_dropout_input(x, train, rng)
+        params = self.maybe_drop_connect(params, train, rng)
         z = self.preout(params, x)
         if _fused_logits_pair(self.activation, self.loss_function):
             return compute_loss(self.loss_function, labels, z, mask=mask, from_logits=True)

@@ -1,0 +1,129 @@
+"""DropConnect (NeuralNetConfiguration.useDropConnect;
+BaseLayer.java:350 + ConvolutionLayer.java:189 -> util/Dropout.java:13)."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _net(use_dc, dropout=0.5, seed=9):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(0.1).updater("sgd").activation("tanh")
+            .dropout(dropout).use_drop_connect(use_dc)
+            .list()
+            .layer(DenseLayer(n_in=5, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(rng, n=32):
+    x = rng.standard_normal((n, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+def test_inference_deterministic_and_mask_free(rng):
+    """Eval-mode output ignores DropConnect entirely (inverted scaling:
+    no inference-time rescale, matching this framework's dropout)."""
+    ds = _data(rng)
+    a, b = _net(True), _net(False)
+    b.set_params_flat(a.params_flat())  # identical weights
+    oa = a.output(ds.features)
+    ob = b.output(ds.features)
+    np.testing.assert_allclose(np.asarray(oa), np.asarray(ob), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a.output(ds.features)),
+                               np.asarray(oa), atol=1e-6)  # deterministic
+
+
+def test_training_is_stochastic_in_weights(rng):
+    """Two different-seed fits from identical inits diverge (the weight
+    mask is resampled per step), and training still learns."""
+    ds = _data(rng, 64)
+    a, b = _net(True, seed=1), _net(True, seed=2)
+    b.set_params_flat(a.params_flat())
+    s0 = float(a.score(ds))
+    for _ in range(10):
+        a.fit(ds)
+        b.fit(ds)
+    assert not np.allclose(np.asarray(a.params_flat()),
+                           np.asarray(b.params_flat())), \
+        "different rng streams produced identical weight-mask training"
+    for _ in range(40):
+        a.fit(ds)
+    assert float(a.score(ds)) < s0
+
+
+def test_dropconnect_masks_weights_not_inputs(rng):
+    """Reference semantics: useDropConnect redirects the dropout prob to
+    the WEIGHTS; input activations are NOT also dropped
+    (BaseLayer.java:449 has !useDropConnect in the input branch).
+    Verified by exact hand-computation of the masked-weight forward."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.layers.base import apply_dropout
+
+    ds = _data(rng)
+    net = _net(True, dropout=0.5)
+    impl = net.impls[0]
+    p = net.params[impl.name]
+    x = jnp.asarray(ds.features)
+    key = jax.random.PRNGKey(0)
+    out, _ = impl.forward(p, x, {}, True, rng=key)
+    Wm = apply_dropout(p["W"], 0.5, jax.random.fold_in(key, 0x0D20))
+    want = jnp.tanh(x @ Wm + p["b"])  # x UNdropped
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_config_roundtrip(rng):
+    net = _net(True)
+    js = net.conf.to_json()
+    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2.conf.use_drop_connect is True
+
+
+def test_non_dropconnect_layers_keep_input_dropout(rng):
+    """Layers without a weight-mask path (e.g. GravesLSTM) must keep
+    their input dropout when use_drop_connect is on — the global flag
+    may not silently strip a layer's only stochastic regularization."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(4).learning_rate(0.1).updater("sgd").activation("tanh")
+            .dropout(0.5).use_drop_connect(True)
+            .list()
+            .layer(GravesLSTM(n_in=3, n_out=6))
+            .layer(RnnOutputLayer(n_in=6, n_out=2, activation="softmax",
+                                  loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    impl = net.impls[0]
+    assert impl.applies_drop_connect is False
+    x = jnp.asarray(rng.standard_normal((2, 5, 3)), jnp.float32)
+    o1, _ = impl.forward(net.params[impl.name], x, {}, True,
+                         rng=jax.random.PRNGKey(0))
+    o2, _ = impl.forward(net.params[impl.name], x, {}, True,
+                         rng=jax.random.PRNGKey(1))
+    assert not np.allclose(np.asarray(o1), np.asarray(o2)), \
+        "input dropout was suppressed for a non-dropconnect layer"
+
+
+def test_roc_nan_scores_never_predicted_positive():
+    from deeplearning4j_tpu.eval.roc import ROC
+
+    y = np.array([1, 0, 1, 0])
+    p = np.array([0.9, np.nan, np.nan, 0.2])
+    r = ROC(10)
+    r.eval(y, p)
+    # old per-threshold `p >= t` semantics: NaN contributes nowhere
+    assert r.tp[0] == 1 and r.fp[0] == 1  # only the finite scores
+    assert r.tp[-1] == 0 and r.fp[-1] == 0
