@@ -142,7 +142,20 @@ def register_tokenizer_factory(name: str, factory_cls) -> None:
     _FACTORY_REGISTRY[name] = factory_cls
 
 
+#: name → module providing it, consulted on registry miss: factories in
+#: other modules stay reachable by name without a side-effect import,
+#: and new plugins extend this table instead of editing the lookup
+_LAZY_FACTORY_MODULES = {
+    "japanese": "deeplearning4j_tpu.text.lattice",
+    "korean": "deeplearning4j_tpu.text.lattice",
+}
+
+
 def tokenizer_factory(name: str, **kwargs) -> TokenizerFactory:
+    if name not in _FACTORY_REGISTRY and name in _LAZY_FACTORY_MODULES:
+        import importlib
+
+        importlib.import_module(_LAZY_FACTORY_MODULES[name])
     if name not in _FACTORY_REGISTRY:
         raise KeyError(f"unknown tokenizer factory {name!r}; "
                        f"registered: {sorted(_FACTORY_REGISTRY)}")

@@ -105,7 +105,7 @@ class TestLatticeTokenizer:
         assert f.create("私は東京大学の学生です").get_tokens() == \
             ["私", "は", "東京大学", "の", "学生", "です"]
         assert f.create("今日は日本語を勉強します").get_tokens() == \
-            ["今日", "は", "日本語", "を", "勉強", "し", "ます"]
+            ["今日", "は", "日本語", "を", "勉強", "します"]
 
     def test_beats_ngram_fallback(self):
         """The n-gram fallback sprays overlapping bigrams; the lattice
