@@ -144,8 +144,11 @@ class LatticeDictionary:
                 line = line.rstrip("\n")
                 if not line.strip() or line.lstrip().startswith("#"):
                     continue
-                left, right, cost = line.split("\t")[:3]
-                self.connections[(left, right)] = float(cost)
+                parts = line.split("\t")
+                if len(parts) < 2:  # malformed line: skip, like load_tsv
+                    continue
+                cost = float(parts[2]) if len(parts) > 2 and parts[2] else 0.0
+                self.connections[(parts[0], parts[1])] = cost
         return self
 
     def connection(self, left_pos: str, right_pos: str) -> float:

@@ -104,8 +104,10 @@ def test_halfwidth_katakana_and_iteration_mark():
 def test_lazy_registry_no_side_effect_import():
     import subprocess
     import sys
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     code = (
-        "import sys; sys.path.insert(0, '/root/repo')\n"
+        f"import sys; sys.path.insert(0, {repo!r})\n"
         "from deeplearning4j_tpu.text.tokenization import tokenizer_factory\n"
         "f = tokenizer_factory('korean')\n"
         "print(type(f).__name__)\n")

@@ -1,0 +1,132 @@
+"""Treebank parser depth (VERDICT r3 missing #3): head finding, tree
+transforms, vectorization — treeparser/HeadWordFinder.java,
+CollapseUnaries.java, BinarizeTreeTransformer.java, TreeVectorizer.java."""
+import numpy as np
+
+from deeplearning4j_tpu.text.treeparser import (
+    BinarizeTreeTransformer,
+    CollapseUnaries,
+    HeadWordFinder,
+    TreeVectorizer,
+)
+from deeplearning4j_tpu.text.trees import Tree
+
+
+def _pt(pos, tok):
+    return Tree(pos, [Tree(tok, token=tok)])
+
+
+def _np():
+    # (NP (DT the) (JJ quick) (NN fox))
+    return Tree("NP", [_pt("DT", "the"), _pt("JJ", "quick"), _pt("NN", "fox")])
+
+
+def _s():
+    vp = Tree("VP", [_pt("VBZ", "jumps"),
+                     Tree("PP", [_pt("IN", "over"), _np()])])
+    return Tree("S", [_np(), vp])
+
+
+class TestHeadWordFinder:
+    def test_np_head_is_noun(self):
+        h = HeadWordFinder()
+        assert h.head_token(_np()) == "fox"
+
+    def test_s_head_percolates_through_vp(self):
+        # S -> VP (head1), VP -> VBZ (head1) => "jumps"
+        assert HeadWordFinder().head_token(_s()) == "jumps"
+
+    def test_pp_head_is_preposition(self):
+        pp = Tree("PP", [_pt("IN", "over"), _np()])
+        assert HeadWordFinder().head_token(pp) == "over"
+
+    def test_same_label_fallback(self):
+        # no head1/head2 rule for (FOO (BAR x) (FOO y)): same-label wins
+        t = Tree("FOO", [_pt("BAR", "x"), Tree("FOO", [_pt("BAR", "y")])])
+        assert HeadWordFinder().head_token(t) == "y"
+
+    def test_top_unwraps(self):
+        top = Tree("TOP", [_s()])
+        assert HeadWordFinder().head_token(top) == "jumps"
+
+
+class TestTransformers:
+    def test_collapse_unaries(self):
+        # (X (Y (Z (NN dog)))) -> preterminal chain collapses
+        t = Tree("X", [Tree("Y", [Tree("Z", [_pt("NN", "dog")])])])
+        out = CollapseUnaries().transform(t)
+        assert out.label == "X"
+        assert out.yield_tokens() == ["dog"]
+        # only branching/preterminal/leaf nodes remain
+        for st in out.subtrees():
+            assert st.is_leaf() or st.is_preterminal() or len(st.children) > 1
+
+    def test_binarize_left(self):
+        out = BinarizeTreeTransformer("left").transform(_np())
+        assert out.yield_tokens() == ["the", "quick", "fox"]
+        for st in out.subtrees():
+            assert len(st.children) <= 2
+        assert out.label == "NP"  # root label preserved
+
+    def test_binarize_right(self):
+        wide = Tree("NP", [_pt("DT", "a"), _pt("JJ", "b"), _pt("JJ", "c"),
+                           _pt("NN", "d")])
+        out = BinarizeTreeTransformer("right").transform(wide)
+        assert out.yield_tokens() == ["a", "b", "c", "d"]
+        for st in out.subtrees():
+            assert len(st.children) <= 2
+
+    def test_binarize_markov_suffix_bounded(self):
+        wide = Tree("NP", [_pt("JJ", c) for c in "abcde"])
+        out = BinarizeTreeTransformer("left", horizontal_markov=2).transform(wide)
+        for st in out.subtrees():
+            if "-(" in st.label:
+                assert st.label.count("-") <= 3  # <=2 child labels in suffix
+
+    def test_head_survives_binarize_collapse(self):
+        t = CollapseUnaries().transform(
+            BinarizeTreeTransformer().transform(_s()))
+        assert HeadWordFinder().head_token(t) == "jumps"
+
+
+class TestTreeVectorizer:
+    class _Lookup:
+        def vector(self, word):
+            if word == "unknownword":
+                return None
+            return np.full(4, float(len(word)), np.float32)
+
+    def test_get_trees_binarized(self):
+        tv = TreeVectorizer()
+        trees = tv.get_trees("The quick brown fox jumps over the lazy dog.")
+        assert trees
+        for t in trees:
+            for st in t.subtrees():
+                assert len(st.children) <= 2
+
+    def test_vectorize_attaches_leaf_vectors(self):
+        tv = TreeVectorizer()
+        vecs = tv.vectorize("The dog runs", self._Lookup())
+        assert vecs and vecs[0]
+        for tok, v in vecs[0].items():
+            assert v.shape == (4,) and v[0] == len(tok)
+
+
+def test_binarize_labels_balanced_sexpr():
+    """Introduced labels close their parenthesis, so the serialized
+    tree is a parseable s-expression (balanced parens)."""
+    wide = Tree("NP", [_pt("JJ", c) for c in "abcd"])
+    for factor in ("left", "right"):
+        out = BinarizeTreeTransformer(factor).transform(wide)
+        s = out.to_sexpr()
+        assert s.count("(") == s.count(")"), s
+
+
+def test_include_pp_head():
+    # (X (XX (NN y)) (PP ...)): level 5 skips PP by default, so the
+    # earlier non-terminal wins; with include_pp_head the later PP also
+    # qualifies at level 5 and replaces it (reference cascade order)
+    pp = Tree("PP", [_pt("IN", "over")])
+    t = Tree("X", [Tree("XX", [_pt("NN", "y")]), pp])
+    assert HeadWordFinder().head_token(t) == "y"
+    assert HeadWordFinder(include_pp_head=True).head_token(t) == "over"
