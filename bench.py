@@ -188,7 +188,7 @@ def bench_lstm():
 
 
 
-def _scan_reps_time(make_step, compile_args, reps, trials=3):
+def _scan_reps_time(make_step, compile_args, reps, trials=5):
     """Time a per-step computation by scanning it ``reps`` times inside
     ONE program and taking the best of ``trials`` dispatches — the
     amortization recipe for ops whose single call is comparable to the
