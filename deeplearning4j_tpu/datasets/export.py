@@ -143,9 +143,10 @@ class ExportedDataSetIterator(DataSetIterator):
         path = os.path.join(self.directory, self.files[self._order[self._i]])
         self._i += 1
         with np.load(path) as z:
-            return DataSet(z["features"], z["labels"],
-                           z["features_mask"] if "features_mask" in z else None,
-                           z["labels_mask"] if "labels_mask" in z else None)
+            return self._apply_pp(DataSet(
+                z["features"], z["labels"],
+                z["features_mask"] if "features_mask" in z else None,
+                z["labels_mask"] if "labels_mask" in z else None))
 
     def batch(self) -> int:
         bs = self.manifest.get("batch_size")

@@ -20,9 +20,55 @@ import numpy as np
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 
 
-class DataSetIterator:
+class DataSetPreProcessor:
+    """``DataSetPreProcessor`` contract: mutate-or-replace a minibatch
+    before the caller sees it (normalizers implement this too)."""
+
+    def pre_process(self, ds: DataSet):
+        raise NotImplementedError
+
+
+class CombinedPreProcessor(DataSetPreProcessor):
+    """``CombinedPreProcessor`` — applies the given pre-processors in
+    order; each may mutate in place (returning None) or return a
+    replacement DataSet."""
+
+    def __init__(self, *pre_processors):
+        self._pps = list(pre_processors)
+
+    def pre_process(self, ds: DataSet):
+        for pp in self._pps:
+            out = pp.pre_process(ds)
+            if out is not None:
+                ds = out
+        return ds
+
+
+class _PreProcessorSeam:
+    """``setPreProcessor`` contract shared by the DataSet and
+    MultiDataSet iterator bases: ``pp.pre_process(ds)`` runs on every
+    batch the iterator emits (mutate in place or return a
+    replacement)."""
+
+    _pre_processor = None
+
+    def set_pre_processor(self, pp) -> None:
+        self._pre_processor = pp
+
+    def pre_processor(self):
+        return self._pre_processor
+
+    def _apply_pp(self, ds):
+        pp = self._pre_processor
+        if pp is None:
+            return ds
+        out = pp.pre_process(ds)
+        return ds if out is None else out
+
+
+class DataSetIterator(_PreProcessorSeam):
     """Iterator over minibatch DataSets (``DataSetIterator`` contract:
-    hasNext/next/reset/batch/totalExamples)."""
+    hasNext/next/reset/batch/totalExamples/setPreProcessor)."""
 
     def __iter__(self) -> Iterator[DataSet]:
         self.reset()
@@ -76,7 +122,7 @@ class _ListBatchCore:
     def next(self):
         idx = self._order[self._pos:self._pos + self._batch]
         self._pos += self._batch
-        return self._data[idx]
+        return self._apply_pp(self._data[idx])
 
     def batch(self):
         return self._batch
@@ -171,6 +217,14 @@ class AsyncDataSetIterator(DataSetIterator):
         self._peeked = None
         return item
 
+    def set_pre_processor(self, pp) -> None:
+        # delegate: preprocessing then runs on the WORKER thread where
+        # the batch is produced, overlapping device compute
+        self._wrapped.set_pre_processor(pp)
+
+    def pre_processor(self):
+        return self._wrapped.pre_processor()
+
     def batch(self):
         return self._wrapped.batch()
 
@@ -201,6 +255,12 @@ class MultipleEpochsIterator(DataSetIterator):
             raise StopIteration
         return self._wrapped.next()
 
+    def set_pre_processor(self, pp) -> None:
+        self._wrapped.set_pre_processor(pp)  # runs where batches emit
+
+    def pre_processor(self):
+        return self._wrapped.pre_processor()
+
     def batch(self):
         return self._wrapped.batch()
 
@@ -224,13 +284,51 @@ class SamplingDataSetIterator(DataSetIterator):
     def next(self):
         self._count += 1
         idx = self._rng.integers(0, self._data.num_examples(), self._batch)
-        return self._data[idx]
+        return self._apply_pp(self._data[idx])
 
     def batch(self):
         return self._batch
 
 
-class MultiDataSetIterator:
+class ExistingDataSetIterator(DataSetIterator):
+    """``ExistingDataSetIterator`` — DataSetIterator over an existing
+    sequence of DataSets, or a zero-arg factory returning a fresh
+    iterable per epoch (pass a factory for generator sources: a bare
+    generator cannot be reset and is rejected)."""
+
+    def __init__(self, datasets):
+        self._source = datasets
+        self._it = None
+        self._peek = None
+        self.reset()
+
+    def reset(self):
+        src = self._source() if callable(self._source) else self._source
+        it = iter(src)
+        if it is src and not callable(self._source):
+            raise TypeError(
+                "ExistingDataSetIterator got a one-shot iterator/generator; "
+                "reset() could not replay it — pass a list or a zero-arg "
+                "factory (lambda: make_batches()) instead")
+        self._it = it
+        self._peek = None
+
+    def has_next(self):
+        if self._peek is None:
+            self._peek = next(self._it, None)
+        return self._peek is not None
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        ds, self._peek = self._peek, None
+        return self._apply_pp(ds)
+
+    def batch(self):
+        return -1  # unknown/ragged (reference returns the current size)
+
+
+class MultiDataSetIterator(_PreProcessorSeam):
     """Iterator over MultiDataSet minibatches (``MultiDataSetIterator``
     contract — the ComputationGraph feed,
     ``AsyncMultiDataSetIterator.java`` async role is played by wrapping

@@ -23,6 +23,12 @@ class Normalizer:
     def fit(self, data: Union[DataSet, DataSetIterator]) -> "Normalizer":
         raise NotImplementedError
 
+    def pre_process(self, ds: DataSet) -> DataSet:
+        """``DataSetPreProcessor`` contract: a fitted normalizer plugs
+        straight into ``DataSetIterator.set_pre_processor`` (the
+        reference's ``NormalizerStandardize implements DataSetPreProcessor``)."""
+        return self.transform(ds)
+
     def transform(self, ds: DataSet) -> DataSet:
         raise NotImplementedError
 

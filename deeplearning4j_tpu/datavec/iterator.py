@@ -118,7 +118,7 @@ class RecordReaderDataSetIterator(DataSetIterator):
             ys.append(y)
         feats = np.stack(xs)
         labels = feats if ys[0] is None else np.stack(ys)
-        return DataSet(feats, labels)
+        return self._apply_pp(DataSet(feats, labels))
 
 
 class SequenceRecordReaderDataSetIterator(DataSetIterator):
@@ -184,9 +184,9 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
 
         x, mask = pack(fseqs, fseqs[0].shape[-1])
         if self.lr is None:
-            return DataSet(x, x, features_mask=mask, labels_mask=mask)
+            return self._apply_pp(DataSet(x, x, features_mask=mask, labels_mask=mask))
         y, lmask = pack(lseqs, lseqs[0].shape[-1])
-        return DataSet(x, y, features_mask=mask, labels_mask=lmask)
+        return self._apply_pp(DataSet(x, y, features_mask=mask, labels_mask=lmask))
 
 
 class RecordReaderMultiDataSetIterator(MultiDataSetIterator):
@@ -239,4 +239,4 @@ class RecordReaderMultiDataSetIterator(MultiDataSetIterator):
         for name, col, ncls in self._outputs:
             idx = np.asarray([float(row[col]) for row in rows[name]]).astype(int)
             labels.append(np.eye(ncls, dtype=np.float32)[idx])
-        return MultiDataSet(features=feats, labels=labels)
+        return self._apply_pp(MultiDataSet(features=feats, labels=labels))

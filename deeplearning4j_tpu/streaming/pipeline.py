@@ -104,7 +104,7 @@ class StreamingDataSetIterator(DataSetIterator):
         if not self.has_next():
             raise StopIteration
         out, self._pending = self._pending, None
-        return out
+        return self._apply_pp(out)
 
     def batch(self) -> int:
         return self.batch_size

@@ -1,0 +1,126 @@
+"""DataSetPreProcessor seam (setPreProcessor contract): normalizers and
+combined preprocessors attach to every iterator family."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    AsyncDataSetIterator,
+    CombinedPreProcessor,
+    DataSetPreProcessor,
+    ExistingDataSetIterator,
+    ListDataSetIterator,
+    SamplingDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+
+
+def _ds(rng, n=20, f=4):
+    x = (rng.standard_normal((n, f)) * 5 + 3).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    return DataSet(x, y)
+
+
+class _Shift(DataSetPreProcessor):
+    def __init__(self, k):
+        self.k = k
+
+    def pre_process(self, ds):
+        return DataSet(np.asarray(ds.features) + self.k, ds.labels,
+                       ds.features_mask, ds.labels_mask)
+
+
+def test_normalizer_as_pre_processor(rng):
+    ds = _ds(rng, 64)
+    norm = NormalizerStandardize().fit(ds)
+    it = ListDataSetIterator(ds, 16)
+    it.set_pre_processor(norm)
+    batches = list(it)
+    x = np.concatenate([np.asarray(b.features) for b in batches])
+    assert abs(x.mean()) < 0.1 and abs(x.std() - 1.0) < 0.15
+    assert it.pre_processor() is norm
+
+
+def test_combined_pre_processor_order(rng):
+    ds = _ds(rng, 8)
+    it = ListDataSetIterator(ds, 4)
+    it.set_pre_processor(CombinedPreProcessor(_Shift(1.0), _Shift(10.0)))
+    out = next(iter(it))
+    np.testing.assert_allclose(np.asarray(out.features),
+                               np.asarray(ds.features[:4]) + 11.0, rtol=1e-6)
+
+
+def test_async_delegates_to_wrapped(rng):
+    ds = _ds(rng, 32)
+    inner = ListDataSetIterator(ds, 8)
+    it = AsyncDataSetIterator(inner, queue_size=2)
+    it.set_pre_processor(_Shift(5.0))
+    assert inner.pre_processor() is it.pre_processor()
+    xs = np.concatenate([np.asarray(b.features) for b in it])
+    np.testing.assert_allclose(np.sort(xs, 0),
+                               np.sort(np.asarray(ds.features) + 5.0, 0),
+                               rtol=1e-6)
+
+
+def test_sampling_and_existing_iterators_apply_pp(rng):
+    ds = _ds(rng, 16)
+    s = SamplingDataSetIterator(ds, 4, total_batches=2, seed=0)
+    s.set_pre_processor(_Shift(2.0))
+    b = s.next()
+    assert float(np.asarray(b.features).mean()) > float(
+        np.asarray(ds.features).mean()) + 1.5
+
+    e = ExistingDataSetIterator([_ds(rng, 4), _ds(rng, 4)])
+    e.set_pre_processor(_Shift(3.0))
+    got = list(e)
+    assert len(got) == 2
+    e.reset()
+    assert e.has_next()
+
+
+def test_exported_iterator_applies_pp(rng, tmp_path):
+    from deeplearning4j_tpu.datasets.export import (
+        ExportedDataSetIterator, export_dataset)
+    d = str(tmp_path / "spill")
+    export_dataset(_ds(rng, 16), d, batch_size=8)
+    it = ExportedDataSetIterator(d)
+    it.set_pre_processor(_Shift(4.0))
+    x = np.concatenate([np.asarray(b.features) for b in it])
+    assert x.shape[0] == 16 and float(x.mean()) > 3.0
+
+
+def test_multiple_epochs_delegates(rng):
+    from deeplearning4j_tpu.datasets.iterators import MultipleEpochsIterator
+    ds = _ds(rng, 8)
+    inner = ListDataSetIterator(ds, 4)
+    it = MultipleEpochsIterator(2, inner)
+    it.set_pre_processor(_Shift(7.0))
+    assert inner.pre_processor() is it.pre_processor()
+    batches = list(it)
+    assert len(batches) == 4  # 2 epochs x 2 batches
+    for b in batches:
+        assert float(np.asarray(b.features).mean()) > 5.0
+
+
+def test_existing_iterator_rejects_bare_generator_and_takes_factory(rng):
+    import pytest
+    with pytest.raises(TypeError, match="factory"):
+        ExistingDataSetIterator(iter([_ds(rng, 4)]))
+    e = ExistingDataSetIterator(lambda: (x for x in [_ds(rng, 4), _ds(rng, 4)]))
+    assert len(list(e)) == 2
+    e.reset()
+    assert len(list(e)) == 2  # factory replays
+
+
+def test_streaming_iterator_applies_pp(rng):
+    from deeplearning4j_tpu.streaming.broker import InMemoryBroker
+    from deeplearning4j_tpu.streaming.pipeline import (
+        StreamingDataSetIterator, publish_dataset)
+    broker = InMemoryBroker()
+    ds = _ds(rng, 8)
+    publish_dataset(broker, "t", ds)
+    it = StreamingDataSetIterator(broker, "t", batch_size=8, idle_timeout=0.2)
+    it.set_pre_processor(_Shift(9.0))
+    b = it.next()
+    np.testing.assert_allclose(np.sort(np.asarray(b.features), 0),
+                               np.sort(np.asarray(ds.features) + 9.0, 0),
+                               rtol=1e-5)
