@@ -64,7 +64,10 @@ def _best_of_fit_scan(net, batch, epochs, staged, trials=2):
 
 def bench_gemm():
     """Pure-gemm ceiling: chained bf16 matmuls (keeps the MXU busy,
-    avoids an HBM-bound single-op measurement)."""
+    avoids an HBM-bound single-op measurement). The chain runs many
+    times inside ONE program via the shared scan harness — a per-
+    dispatch fetch paid the tunnel RTT (~0.1-0.25s) against ~45ms of
+    device work and under-read the MXU by ~30% (r3: 59-65% 'MFU')."""
     import jax
     import jax.numpy as jnp
 
@@ -73,15 +76,13 @@ def bench_gemm():
     a = jax.random.normal(key, (n, n), jnp.bfloat16)
     b = jax.random.normal(jax.random.fold_in(key, 1), (n, n), jnp.bfloat16)
 
-    @jax.jit
-    def chained(a, b):
-        x = a
+    def step(i, a, b):
+        x = a + i.astype(a.dtype) * 0.001  # defeat CSE across scan steps
         for _ in range(chain):
             x = x @ b
-        # scalar checksum keeps the chain live and makes the fetch tiny
         return jnp.sum(x.astype(jnp.float32))
 
-    dt = _timeit(lambda: chained(a, b), warmup=1, iters=5)
+    dt = _scan_reps_time(step, (a, b), reps=16)
     flops = chain * 2 * n**3 / dt
     return {"metric": "gemm_bf16_tflops", "value": round(flops / 1e12, 2),
             "unit": "TFLOP/s", "mfu": round(flops / PEAK_BF16, 4),
@@ -165,10 +166,11 @@ def bench_lstm():
     data = DataSet(x, y)
 
     staged = net.stage_scan(data, batch)  # one host→device transfer
-    # 16 epochs x 2 steps: ~1.7s of device time per dispatch, so the
-    # tunnel dispatch RTT (~0.1-0.25s) stays a small fraction (the same
-    # amortization note as bench_lenet / BASELINE.md)
-    epochs = 16
+    # 48 epochs x 2 steps: ~4.3s of device time per dispatch, so the
+    # tunnel dispatch RTT (~0.1-0.25s) is <6% even at the slow end (the
+    # same amortization note as bench_lenet / BASELINE.md; at 16 epochs
+    # the RTT still shaved ~2pp of MFU)
+    epochs = 48
     # warm up the SAME epochs-baked program the timed run uses; best
     # of 2 dispatches (BASELINE.md contention note)
     net.fit_scan(None, batch, epochs=epochs, staged=staged)

@@ -137,16 +137,16 @@ class ExportedDataSetIterator(DataSetIterator):
     def has_next(self) -> bool:
         return self._i < len(self._order)
 
-    def next(self) -> DataSet:
+    def _next_impl(self) -> DataSet:
         if not self.has_next():
             raise StopIteration
         path = os.path.join(self.directory, self.files[self._order[self._i]])
         self._i += 1
         with np.load(path) as z:
-            return self._apply_pp(DataSet(
+            return DataSet(
                 z["features"], z["labels"],
                 z["features_mask"] if "features_mask" in z else None,
-                z["labels_mask"] if "labels_mask" in z else None))
+                z["labels_mask"] if "labels_mask" in z else None)
 
     def batch(self) -> int:
         bs = self.manifest.get("batch_size")

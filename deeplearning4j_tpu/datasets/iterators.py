@@ -83,6 +83,14 @@ class DataSetIterator(_PreProcessorSeam):
         raise NotImplementedError
 
     def next(self) -> DataSet:
+        """Template method: every emitted batch passes through the
+        attached pre-processor — subclasses implement ``_next_impl``
+        and CANNOT accidentally skip the seam. Wrapper iterators that
+        delegate ``set_pre_processor`` keep their own ``_pre_processor``
+        None, so nothing double-applies."""
+        return self._apply_pp(self._next_impl())
+
+    def _next_impl(self) -> DataSet:
         raise NotImplementedError
 
     def reset(self) -> None:
@@ -119,10 +127,10 @@ class _ListBatchCore:
     def has_next(self):
         return self._pos < self._data.num_examples()
 
-    def next(self):
+    def _next_impl(self):
         idx = self._order[self._pos:self._pos + self._batch]
         self._pos += self._batch
-        return self._apply_pp(self._data[idx])
+        return self._data[idx]
 
     def batch(self):
         return self._batch
@@ -210,7 +218,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._peeked = item
         return True
 
-    def next(self):
+    def _next_impl(self):
         if not self.has_next():
             raise StopIteration
         item = self._peeked
@@ -250,7 +258,7 @@ class MultipleEpochsIterator(DataSetIterator):
             return self._wrapped.has_next()
         return False
 
-    def next(self):
+    def _next_impl(self):
         if not self.has_next():
             raise StopIteration
         return self._wrapped.next()
@@ -281,10 +289,10 @@ class SamplingDataSetIterator(DataSetIterator):
     def has_next(self):
         return self._count < self._total
 
-    def next(self):
+    def _next_impl(self):
         self._count += 1
         idx = self._rng.integers(0, self._data.num_examples(), self._batch)
-        return self._apply_pp(self._data[idx])
+        return self._data[idx]
 
     def batch(self):
         return self._batch
@@ -318,11 +326,19 @@ class ExistingDataSetIterator(DataSetIterator):
             self._peek = next(self._it, None)
         return self._peek is not None
 
-    def next(self):
+    def _next_impl(self):
         if not self.has_next():
             raise StopIteration
         ds, self._peek = self._peek, None
-        return self._apply_pp(ds)
+        if self._pre_processor is not None:
+            # the stored DataSets are handed out AGAIN on replay (every
+            # other family rebuilds batches): copy so a mutate-in-place
+            # pre-processor can't compound across epochs or corrupt the
+            # caller's arrays through slice views
+            cp = lambda a: None if a is None else np.array(a)
+            ds = DataSet(cp(ds.features), cp(ds.labels),
+                         cp(ds.features_mask), cp(ds.labels_mask))
+        return ds
 
     def batch(self):
         return -1  # unknown/ragged (reference returns the current size)
@@ -347,6 +363,9 @@ class MultiDataSetIterator(_PreProcessorSeam):
         raise NotImplementedError
 
     def next(self) -> MultiDataSet:
+        return self._apply_pp(self._next_impl())
+
+    def _next_impl(self) -> MultiDataSet:
         raise NotImplementedError
 
     def reset(self) -> None:

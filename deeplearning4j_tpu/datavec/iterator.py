@@ -110,7 +110,7 @@ class RecordReaderDataSetIterator(DataSetIterator):
                              f"{sorted(self._label_map)}")
         return self._label_map[label]
 
-    def next(self) -> DataSet:
+    def _next_impl(self) -> DataSet:
         xs, ys = [], []
         while self.reader.has_next() and len(xs) < self._batch:
             x, y = self._split(self.reader.next_record())
@@ -118,7 +118,7 @@ class RecordReaderDataSetIterator(DataSetIterator):
             ys.append(y)
         feats = np.stack(xs)
         labels = feats if ys[0] is None else np.stack(ys)
-        return self._apply_pp(DataSet(feats, labels))
+        return DataSet(feats, labels)
 
 
 class SequenceRecordReaderDataSetIterator(DataSetIterator):
@@ -154,7 +154,7 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
     def batch(self):
         return self._batch
 
-    def next(self) -> DataSet:
+    def _next_impl(self) -> DataSet:
         fseqs, lseqs = [], []
         while self.fr.has_next() and len(fseqs) < self._batch:
             f = np.asarray(self.fr.next_record(), np.float32)
@@ -184,9 +184,9 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
 
         x, mask = pack(fseqs, fseqs[0].shape[-1])
         if self.lr is None:
-            return self._apply_pp(DataSet(x, x, features_mask=mask, labels_mask=mask))
+            return DataSet(x, x, features_mask=mask, labels_mask=mask)
         y, lmask = pack(lseqs, lseqs[0].shape[-1])
-        return self._apply_pp(DataSet(x, y, features_mask=mask, labels_mask=lmask))
+        return DataSet(x, y, features_mask=mask, labels_mask=lmask)
 
 
 class RecordReaderMultiDataSetIterator(MultiDataSetIterator):
@@ -223,7 +223,7 @@ class RecordReaderMultiDataSetIterator(MultiDataSetIterator):
     def batch(self):
         return self._batch
 
-    def next(self) -> MultiDataSet:
+    def _next_impl(self) -> MultiDataSet:
         rows: Dict[str, List[List[float]]] = {n: [] for n in self._readers}
         count = 0
         while self.has_next() and count < self._batch:
@@ -239,4 +239,4 @@ class RecordReaderMultiDataSetIterator(MultiDataSetIterator):
         for name, col, ncls in self._outputs:
             idx = np.asarray([float(row[col]) for row in rows[name]]).astype(int)
             labels.append(np.eye(ncls, dtype=np.float32)[idx])
-        return self._apply_pp(MultiDataSet(features=feats, labels=labels))
+        return MultiDataSet(features=feats, labels=labels)

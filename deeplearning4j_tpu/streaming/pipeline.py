@@ -100,11 +100,11 @@ class StreamingDataSetIterator(DataSetIterator):
         self._pending = self._emit()
         return self._pending is not None
 
-    def next(self) -> DataSet:
+    def _next_impl(self) -> DataSet:
         if not self.has_next():
             raise StopIteration
         out, self._pending = self._pending, None
-        return self._apply_pp(out)
+        return out
 
     def batch(self) -> int:
         return self.batch_size

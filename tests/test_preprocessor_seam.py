@@ -124,3 +124,27 @@ def test_streaming_iterator_applies_pp(rng):
     np.testing.assert_allclose(np.sort(np.asarray(b.features), 0),
                                np.sort(np.asarray(ds.features) + 9.0, 0),
                                rtol=1e-5)
+
+
+def test_existing_iterator_inplace_pp_does_not_compound(rng):
+    """A mutate-in-place pre-processor must not compound across epoch
+    replays nor corrupt the caller's stored DataSets (review r4)."""
+    from deeplearning4j_tpu.datasets.iterators import MultipleEpochsIterator
+
+    base = _ds(rng, 8)
+    stored = [DataSet(base.features[:4], base.labels[:4]),
+              DataSet(base.features[4:], base.labels[4:])]
+    orig0 = np.array(stored[0].features)
+
+    class InPlace(DataSetPreProcessor):
+        def pre_process(self, ds):
+            ds.features += 1.0  # mutates, returns None
+
+    e = ExistingDataSetIterator(stored)
+    e.set_pre_processor(InPlace())
+    it = MultipleEpochsIterator(2, e)
+    means = [float(np.asarray(b.features).mean()) for b in it]
+    # both epochs see exactly +1, not +1 then +2
+    assert abs(means[0] - means[2]) < 1e-5, means
+    # and the caller's stored arrays are untouched
+    np.testing.assert_allclose(np.asarray(stored[0].features), orig0)
