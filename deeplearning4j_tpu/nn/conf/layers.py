@@ -36,6 +36,9 @@ def layer_from_dict(d: Dict[str, Any]) -> "Layer":
     for f in dataclasses.fields(cls):
         if f.name in kwargs and isinstance(kwargs[f.name], list):
             kwargs[f.name] = tuple(kwargs[f.name])
+    if isinstance(kwargs.get("dist"), dict):
+        from deeplearning4j_tpu.nn.weights import Distribution
+        kwargs["dist"] = Distribution.from_dict(kwargs["dist"])
     return cls(**kwargs)
 
 
@@ -53,6 +56,9 @@ class Layer:
     has_bias: bool = True
     dist_mean: float = 0.0
     dist_std: float = 1.0
+    # explicit WeightInit.DISTRIBUTION source (nn/conf/distribution/):
+    # a weights.Distribution; overrides dist_mean/dist_std when set
+    dist: Optional[object] = None
     dropout: Optional[float] = None  # keep DL4J semantics: probability of RETAINING is 1-dropout? see layers/base.py
     l1: Optional[float] = None
     l2: Optional[float] = None
@@ -68,6 +74,8 @@ class Layer:
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)
             if v is not None and v != f.default:
+                if dataclasses.is_dataclass(v) and not isinstance(v, type):
+                    v = dataclasses.asdict(v)  # e.g. weights.Distribution
                 d[f.name] = list(v) if isinstance(v, tuple) else v
         return d
 

@@ -70,7 +70,8 @@ class AttentionImpl(LayerImpl):
         kq, kk, kv, ko = jax.random.split(key, 4)
         mk = lambda k, shape: init_weights(k, shape, self.weight_init,
                                            shape[0], shape[1],
-                                           c.dist_mean, c.dist_std)
+                                           c.dist_mean, c.dist_std,
+                                           dist=c.dist)
         return {
             "Wq": mk(kq, (c.n_in, c.n_out)),
             "Wk": mk(kk, (c.n_in, c.n_out)),

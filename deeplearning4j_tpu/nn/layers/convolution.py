@@ -54,7 +54,8 @@ class ConvolutionImpl(LayerImpl):
         fan_in = c.n_in * kh * kw
         fan_out = c.n_out * kh * kw
         W = init_weights(key, (kh, kw, c.n_in, c.n_out), self.weight_init,
-                         fan_in, fan_out, c.dist_mean, c.dist_std)
+                         fan_in, fan_out, c.dist_mean, c.dist_std,
+                         dist=c.dist)
         if not c.has_bias:
             return {"W": W}
         b = jnp.full((c.n_out,), self.bias_init, jnp.float32)

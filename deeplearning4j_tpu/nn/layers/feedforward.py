@@ -41,7 +41,7 @@ class BaseDenseImpl(LayerImpl):
         c = self.conf
         kW, _ = jax.random.split(key)
         W = init_weights(kW, (c.n_in, c.n_out), self.weight_init, c.n_in, c.n_out,
-                         c.dist_mean, c.dist_std)
+                         c.dist_mean, c.dist_std, dist=c.dist)
         if not c.has_bias:
             return {"W": W}
         b = jnp.full((c.n_out,), self.bias_init, jnp.float32)
@@ -124,7 +124,7 @@ class EmbeddingImpl(LayerImpl):
     def init_params(self, key):
         c = self.conf
         W = init_weights(key, (c.n_in, c.n_out), self.weight_init, c.n_in, c.n_out,
-                         c.dist_mean, c.dist_std)
+                         c.dist_mean, c.dist_std, dist=c.dist)
         b = jnp.full((c.n_out,), self.bias_init, jnp.float32)
         return {"W": W, "b": b}
 

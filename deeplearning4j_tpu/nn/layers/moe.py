@@ -32,12 +32,13 @@ AUX_LOSS_KEY = "__aux_loss__"
 
 
 def init_moe_params(key, d: int, f: int, e: int, weight_init: str,
-                    dist_mean: float, dist_std: float) -> Dict[str, jnp.ndarray]:
+                    dist_mean: float, dist_std: float,
+                    dist=None) -> Dict[str, jnp.ndarray]:
     """Router + expert FFN weights (shared by MoEImpl and the MoE
     variant of TransformerBlock)."""
     ks = jax.random.split(key, 3)
     mk = lambda k, shape, fi, fo: init_weights(
-        k, shape, weight_init, fi, fo, dist_mean, dist_std)
+        k, shape, weight_init, fi, fo, dist_mean, dist_std, dist=dist)
     return {
         "Wg": mk(ks[0], (d, e), d, e),
         "W1": mk(ks[1], (e, d, f), d, f),
@@ -66,7 +67,7 @@ class MoEImpl(LayerImpl):
             raise ValueError("MoELayer needs n_in == n_out (FFN block)")
         return init_moe_params(key, c.n_in, c.ffn_mult * c.n_in,
                                c.num_experts, self.weight_init,
-                               c.dist_mean, c.dist_std)
+                               c.dist_mean, c.dist_std, dist=c.dist)
 
     def init_state(self):
         return {AUX_LOSS_KEY: jnp.zeros((), jnp.float32)}

@@ -34,10 +34,13 @@ from deeplearning4j_tpu.nn.weights import init_weights
 from deeplearning4j_tpu.ops.activations import activate
 
 
-def _lstm_params(key, n_in, n_out, weight_init, dist_mean, dist_std, forget_bias):
+def _lstm_params(key, n_in, n_out, weight_init, dist_mean, dist_std, forget_bias,
+                 dist=None):
     kx, kr = jax.random.split(key)
-    Wx = init_weights(kx, (n_in, 4 * n_out), weight_init, n_in, n_out, dist_mean, dist_std)
-    Wr = init_weights(kr, (n_out, 4 * n_out), weight_init, n_out, n_out, dist_mean, dist_std)
+    Wx = init_weights(kx, (n_in, 4 * n_out), weight_init, n_in, n_out,
+                      dist_mean, dist_std, dist=dist)
+    Wr = init_weights(kr, (n_out, 4 * n_out), weight_init, n_out, n_out,
+                      dist_mean, dist_std, dist=dist)
     b = jnp.zeros((4 * n_out,), jnp.float32)
     # forget-gate section [n_out:2n_out] init (GravesLSTM.forgetGateBiasInit)
     b = b.at[n_out:2 * n_out].set(forget_bias)
@@ -116,7 +119,8 @@ class GravesLSTMImpl(LayerImpl):
     def init_params(self, key) -> Dict[str, jnp.ndarray]:
         c = self.conf
         return _lstm_params(key, c.n_in, c.n_out, self.weight_init,
-                            c.dist_mean, c.dist_std, c.forget_gate_bias_init)
+                            c.dist_mean, c.dist_std, c.forget_gate_bias_init,
+                            dist=c.dist)
 
     def init_state(self):
         # streaming (rnnTimeStep) carry; zeros mean "no history"
@@ -160,9 +164,11 @@ class GravesBidirectionalLSTMImpl(LayerImpl):
         c = self.conf
         kf, kb = jax.random.split(key)
         pf = _lstm_params(kf, c.n_in, c.n_out, self.weight_init,
-                          c.dist_mean, c.dist_std, c.forget_gate_bias_init)
+                          c.dist_mean, c.dist_std, c.forget_gate_bias_init,
+                          dist=c.dist)
         pb = _lstm_params(kb, c.n_in, c.n_out, self.weight_init,
-                          c.dist_mean, c.dist_std, c.forget_gate_bias_init)
+                          c.dist_mean, c.dist_std, c.forget_gate_bias_init,
+                          dist=c.dist)
         return {**{f"f_{k}": v for k, v in pf.items()},
                 **{f"b_{k}": v for k, v in pb.items()}}
 

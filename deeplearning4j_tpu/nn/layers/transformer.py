@@ -47,7 +47,8 @@ class SequenceEmbeddingImpl(LayerImpl):
         c = self.conf
         kw, kp = jax.random.split(key)
         W = init_weights(kw, (c.n_in, c.n_out), self.weight_init,
-                         c.n_in, c.n_out, c.dist_mean, c.dist_std)
+                         c.n_in, c.n_out, c.dist_mean, c.dist_std,
+                         dist=c.dist)
         P = 0.01 * jax.random.normal(kp, (c.max_len, c.n_out), jnp.float32)
         return {"W": W, "P": P}
 
@@ -77,7 +78,8 @@ class TransformerBlockImpl(LayerImpl):
         ks = jax.random.split(key, 4)
         mk = lambda k, shape: init_weights(k, shape, self.weight_init,
                                            shape[0], shape[1],
-                                           c.dist_mean, c.dist_std)
+                                           c.dist_mean, c.dist_std,
+                                           dist=c.dist)
         params = {
             "Wqkv": mk(ks[0], (d, 3 * d)),
             "Wo": mk(ks[1], (d, d)),
@@ -89,7 +91,7 @@ class TransformerBlockImpl(LayerImpl):
         if c.num_experts > 0:  # Mixtral-style routed MLP (shared init)
             params.update(init_moe_params(
                 ks[2], d, f, c.num_experts, self.weight_init,
-                c.dist_mean, c.dist_std))
+                c.dist_mean, c.dist_std, dist=c.dist))
         else:
             params.update({
                 "W1": mk(ks[2], (d, f)), "b1": jnp.zeros((f,), jnp.float32),

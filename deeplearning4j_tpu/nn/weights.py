@@ -8,12 +8,58 @@ keys are what makes multi-host replicated init deterministic on TPU.
 
 from __future__ import annotations
 
+import dataclasses
 import enum
-from typing import Sequence, Union
+from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Distribution:
+    """Weight-init distribution (``nn/conf/distribution/`` —
+    ``NormalDistribution``/``UniformDistribution``/``BinomialDistribution``,
+    selected with ``WeightInit.DISTRIBUTION`` via the layer's ``dist``
+    field). Serializes as a plain dict inside the layer config."""
+
+    kind: str = "normal"  # normal | uniform | binomial
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+    n: int = 1
+    p: float = 0.5
+
+    @staticmethod
+    def normal(mean: float = 0.0, std: float = 1.0) -> "Distribution":
+        return Distribution(kind="normal", mean=mean, std=std)
+
+    @staticmethod
+    def uniform(lower: float, upper: float) -> "Distribution":
+        return Distribution(kind="uniform", lower=lower, upper=upper)
+
+    @staticmethod
+    def binomial(n: int, p: float) -> "Distribution":
+        return Distribution(kind="binomial", n=n, p=p)
+
+    @staticmethod
+    def from_dict(d) -> "Distribution":
+        names = {f.name for f in dataclasses.fields(Distribution)}
+        return Distribution(**{k: v for k, v in d.items() if k in names})
+
+    def sample(self, key: jax.Array, shape, dtype=jnp.float32) -> jnp.ndarray:
+        if self.kind == "normal":
+            return self.mean + self.std * jax.random.normal(key, shape, dtype)
+        if self.kind == "uniform":
+            return jax.random.uniform(key, shape, dtype, self.lower, self.upper)
+        if self.kind == "binomial":
+            # number of successes in n Bernoulli(p) trials (the ND4J
+            # BinomialDistribution init semantics)
+            return jax.random.binomial(
+                key, self.n, self.p, shape=tuple(shape)).astype(dtype)
+        raise ValueError(f"unknown distribution kind {self.kind!r}")
 
 
 class WeightInit(str, enum.Enum):
@@ -41,6 +87,7 @@ def init_weights(
     dist_mean: float = 0.0,
     dist_std: float = 1.0,
     dtype=jnp.float32,
+    dist: Optional[Distribution] = None,
 ) -> jnp.ndarray:
     """Initialize a weight tensor of ``shape``.
 
@@ -78,6 +125,8 @@ def init_weights(
         a = 4.0 * np.sqrt(6.0 / (fan_in + fan_out))
         return jax.random.uniform(key, shape, dtype, -a, a)
     if s is WeightInit.DISTRIBUTION:
+        if dist is not None:
+            return dist.sample(key, shape, dtype)
         return dist_mean + dist_std * jax.random.normal(key, shape, dtype)
     if s is WeightInit.NORMAL:
         std = 1.0 / np.sqrt(fan_in)
