@@ -344,6 +344,98 @@ class ExistingDataSetIterator(DataSetIterator):
         return -1  # unknown/ragged (reference returns the current size)
 
 
+class ReconstructionDataSetIterator(DataSetIterator):
+    """``ReconstructionDataSetIterator`` — wraps an iterator and emits
+    (features, features) pairs (autoencoder/RBM reconstruction feed)."""
+
+    def __init__(self, wrapped: DataSetIterator):
+        self._wrapped = wrapped
+
+    def reset(self):
+        self._wrapped.reset()
+
+    def has_next(self):
+        return self._wrapped.has_next()
+
+    def _next_impl(self):
+        ds = self._wrapped.next()
+        return DataSet(ds.features, ds.features,
+                       ds.features_mask, ds.features_mask)
+
+    def batch(self):
+        return self._wrapped.batch()
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """``IteratorDataSetIterator`` — batches a plain iterator of
+    SINGLE-example DataSets into minibatches of ``batch_size`` (ragged
+    final batch kept)."""
+
+    def __init__(self, examples, batch_size: int):
+        self._source = examples
+        self._batch = batch_size
+        self._it = None
+        self._buf: List[DataSet] = []
+        self.reset()
+
+    def reset(self):
+        src = self._source() if callable(self._source) else self._source
+        it = iter(src)
+        if it is src and not callable(self._source):
+            raise TypeError(
+                "IteratorDataSetIterator got a one-shot iterator; pass a "
+                "sequence or a zero-arg factory so reset() can replay")
+        self._it = it
+        self._buf = []
+
+    _END = object()  # a None ELEMENT in the source must raise, not truncate
+
+    def _fill(self):
+        while len(self._buf) < self._batch:
+            nxt = next(self._it, self._END)
+            if nxt is self._END:
+                break
+            if nxt is None:
+                raise ValueError(
+                    "IteratorDataSetIterator source yielded None (bad "
+                    "record?) — filter such entries out before batching")
+            self._buf.append(nxt)
+
+    def has_next(self):
+        self._fill()
+        return bool(self._buf)
+
+    @staticmethod
+    def _cat_masks(masks, shapes):
+        """Mixed mask presence merges like streaming/pipeline.cat_masks:
+        a missing mask means all-valid — fill with ones."""
+        if all(m is None for m in masks):
+            return None
+        return np.concatenate(
+            [np.ones(shape, np.float32) if m is None else np.asarray(m)
+             for m, shape in zip(masks, shapes)], axis=0)
+
+    def _next_impl(self):
+        self._fill()
+        if not self._buf:
+            raise StopIteration
+        chunk, self._buf = self._buf, []
+        feats = np.concatenate([np.atleast_2d(d.features) for d in chunk], axis=0)
+        labels = (None if all(d.labels is None for d in chunk)
+                  else np.concatenate([np.atleast_2d(d.labels) for d in chunk], axis=0))
+        fmask = self._cat_masks(
+            [d.features_mask for d in chunk],
+            [np.asarray(d.features).shape[:-1] for d in chunk])
+        lmask = self._cat_masks(
+            [d.labels_mask for d in chunk],
+            [np.asarray(d.labels).shape[:-1] if d.labels is not None else (1,)
+             for d in chunk])
+        return DataSet(feats, labels, fmask, lmask)
+
+    def batch(self):
+        return self._batch
+
+
 class MultiDataSetIterator(_PreProcessorSeam):
     """Iterator over MultiDataSet minibatches (``MultiDataSetIterator``
     contract — the ComputationGraph feed,

@@ -148,3 +148,56 @@ def test_existing_iterator_inplace_pp_does_not_compound(rng):
     assert abs(means[0] - means[2]) < 1e-5, means
     # and the caller's stored arrays are untouched
     np.testing.assert_allclose(np.asarray(stored[0].features), orig0)
+
+
+def test_reconstruction_iterator(rng):
+    from deeplearning4j_tpu.datasets.iterators import (
+        ReconstructionDataSetIterator)
+    ds = _ds(rng, 12)
+    it = ReconstructionDataSetIterator(ListDataSetIterator(ds, 4))
+    b = next(iter(it))
+    np.testing.assert_array_equal(np.asarray(b.labels),
+                                  np.asarray(b.features))
+    assert sum(1 for _ in it) >= 2  # restarted by __iter__
+
+
+def test_iterator_dataset_iterator_batches_singles(rng):
+    from deeplearning4j_tpu.datasets.iterators import IteratorDataSetIterator
+    singles = [DataSet(rng.standard_normal((1, 3)).astype(np.float32),
+                       np.eye(2, dtype=np.float32)[[i % 2]])
+               for i in range(7)]
+    it = IteratorDataSetIterator(singles, 3)
+    sizes = [np.asarray(b.features).shape[0] for b in it]
+    assert sizes == [3, 3, 1]
+    it.reset()
+    it.set_pre_processor(_Shift(2.0))
+    b = it.next()
+    assert float(np.asarray(b.features).mean()) > 1.0
+
+
+def test_iterator_dataset_iterator_edge_cases(rng):
+    """Review r4: None elements raise (no silent truncation); mixed mask
+    presence merges with all-valid fill; unlabeled streams keep None."""
+    import pytest
+    from deeplearning4j_tpu.datasets.iterators import IteratorDataSetIterator
+
+    bad = [DataSet(np.ones((1, 2), np.float32), None), None]
+    it = IteratorDataSetIterator(bad, 4)
+    with pytest.raises(ValueError, match="None"):
+        it.has_next()
+
+    # unlabeled stream: labels stay None, not object-dtype garbage
+    singles = [DataSet(np.full((1, 2), i, np.float32), None) for i in range(3)]
+    b = IteratorDataSetIterator(singles, 4).next()
+    assert b.labels is None and np.asarray(b.features).shape == (3, 2)
+
+    # mixed mask presence: missing masks fill with ones
+    m = np.zeros((1, 4), np.float32)
+    seqs = [DataSet(rng.standard_normal((1, 4, 2)).astype(np.float32),
+                    None, features_mask=m),
+            DataSet(rng.standard_normal((1, 4, 2)).astype(np.float32), None)]
+    b = IteratorDataSetIterator(seqs, 4).next()
+    got = np.asarray(b.features_mask)
+    assert got.shape == (2, 4)
+    np.testing.assert_array_equal(got[0], 0)
+    np.testing.assert_array_equal(got[1], 1)
