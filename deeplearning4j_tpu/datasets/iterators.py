@@ -311,14 +311,7 @@ class ExistingDataSetIterator(DataSetIterator):
         self.reset()
 
     def reset(self):
-        src = self._source() if callable(self._source) else self._source
-        it = iter(src)
-        if it is src and not callable(self._source):
-            raise TypeError(
-                "ExistingDataSetIterator got a one-shot iterator/generator; "
-                "reset() could not replay it — pass a list or a zero-arg "
-                "factory (lambda: make_batches()) instead")
-        self._it = it
+        self._it = _resettable_iter(self._source, type(self).__name__)
         self._peek = None
 
     def has_next(self):
@@ -342,6 +335,19 @@ class ExistingDataSetIterator(DataSetIterator):
 
     def batch(self):
         return -1  # unknown/ragged (reference returns the current size)
+
+
+def _resettable_iter(source, cls_name: str):
+    """Resolve a sequence or zero-arg factory into a fresh iterator;
+    reject one-shot iterators (reset could not replay them)."""
+    src = source() if callable(source) else source
+    it = iter(src)
+    if it is src and not callable(source):
+        raise TypeError(
+            f"{cls_name} got a one-shot iterator/generator; reset() could "
+            "not replay it — pass a list or a zero-arg factory "
+            "(lambda: make_batches()) instead")
+    return it
 
 
 class ReconstructionDataSetIterator(DataSetIterator):
@@ -379,13 +385,7 @@ class IteratorDataSetIterator(DataSetIterator):
         self.reset()
 
     def reset(self):
-        src = self._source() if callable(self._source) else self._source
-        it = iter(src)
-        if it is src and not callable(self._source):
-            raise TypeError(
-                "IteratorDataSetIterator got a one-shot iterator; pass a "
-                "sequence or a zero-arg factory so reset() can replay")
-        self._it = it
+        self._it = _resettable_iter(self._source, type(self).__name__)
         self._buf = []
 
     _END = object()  # a None ELEMENT in the source must raise, not truncate
@@ -472,3 +472,51 @@ class MultiDataSetIterator(_PreProcessorSeam):
 
 class ListMultiDataSetIterator(_ListBatchCore, MultiDataSetIterator):
     """Minibatches from an in-memory MultiDataSet."""
+
+
+class MovingWindowDataSetIterator(ListDataSetIterator):
+    """``MovingWindowDataSetFetcher``/``MovingWindowBaseDataSetIterator``
+    — augmentation feed: every example is expanded into all dense
+    [window_rows, window_cols] sub-windows (stride 1, optionally each
+    also rotated 90/180/270, the fetcher's ``windows(true)``), every
+    window keeping the example's label, plus the original example.
+
+    ``features``: [n, rows, cols] (or flat [n, rows*cols] with ``rows``/
+    ``cols`` given). Windows are emitted flattened to [wr*wc]. Unlike
+    the reference fetcher the originals are NOT appended: mixed widths
+    cannot batch (when window == image size the single "window" IS the
+    original, rotations included)."""
+
+    def __init__(self, data: DataSet, window_rows: int, window_cols: int,
+                 batch_size: int = 32, rotations: bool = True,
+                 rows: Optional[int] = None, cols: Optional[int] = None,
+                 shuffle: bool = False, seed: int = 0):
+        from deeplearning4j_tpu.util.viterbi import moving_window_matrix
+
+        if data.labels is None:
+            raise ValueError(
+                "MovingWindowDataSetIterator needs labeled data (every "
+                "window inherits its example's label); for unlabeled "
+                "reconstruction feeds wrap with "
+                "ReconstructionDataSetIterator first")
+        x = np.asarray(data.features)
+        y = np.asarray(data.labels)
+        if x.ndim == 2:
+            if not rows or not cols:
+                raise ValueError("flat features need rows=/cols=")
+            if x.shape[1] != rows * cols:
+                raise ValueError(
+                    f"flat feature width {x.shape[1]} != rows*cols "
+                    f"({rows}*{cols}={rows * cols}) — reshaping would "
+                    "silently merge/split examples")
+            x = x.reshape(-1, rows, cols)
+        feats, labels = [], []
+        rots = (0, 1, 2, 3) if rotations else (0,)
+        for i in range(x.shape[0]):
+            for rot in rots:
+                w = moving_window_matrix(x[i], window_rows, window_cols, rot)
+                feats.append(w.reshape(w.shape[0], -1))
+                labels.append(np.repeat(y[i:i + 1], w.shape[0], axis=0))
+        aug = DataSet(np.concatenate(feats, 0).astype(np.float32),
+                      np.concatenate(labels, 0).astype(np.float32))
+        super().__init__(aug, batch_size, shuffle=shuffle, seed=seed)

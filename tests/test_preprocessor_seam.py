@@ -201,3 +201,19 @@ def test_iterator_dataset_iterator_edge_cases(rng):
     assert got.shape == (2, 4)
     np.testing.assert_array_equal(got[0], 0)
     np.testing.assert_array_equal(got[1], 1)
+
+
+def test_moving_window_iterator(rng):
+    from deeplearning4j_tpu.datasets.iterators import MovingWindowDataSetIterator
+    x = rng.standard_normal((3, 6, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)
+    it = MovingWindowDataSetIterator(DataSet(x, y), 4, 4, batch_size=64)
+    batches = list(it)
+    feats = np.concatenate([np.asarray(b.features) for b in batches])
+    labels = np.concatenate([np.asarray(b.labels) for b in batches])
+    # 3 examples x 4 rotations x 3x3 window positions = 108 windows
+    assert feats.shape == (108, 16)
+    # every window carries its source example's label
+    assert labels[:36].argmax(1).tolist() == [0] * 36
+    # rotations really differ from the unrotated windows
+    assert not np.allclose(feats[:9], feats[9:18])
