@@ -474,25 +474,26 @@ class ListMultiDataSetIterator(_ListBatchCore, MultiDataSetIterator):
     """Minibatches from an in-memory MultiDataSet."""
 
 
-class MovingWindowDataSetIterator(ListDataSetIterator):
+class MovingWindowDataSetIterator(DataSetIterator):
     """``MovingWindowDataSetFetcher``/``MovingWindowBaseDataSetIterator``
     — augmentation feed: every example is expanded into all dense
     [window_rows, window_cols] sub-windows (stride 1, optionally each
     also rotated 90/180/270, the fetcher's ``windows(true)``), every
-    window keeping the example's label, plus the original example.
+    window keeping the example's label.
 
     ``features``: [n, rows, cols] (or flat [n, rows*cols] with ``rows``/
     ``cols`` given). Windows are emitted flattened to [wr*wc]. Unlike
     the reference fetcher the originals are NOT appended: mixed widths
     cannot batch (when window == image size the single "window" IS the
-    original, rotations included)."""
+    original, rotations included). Windows are generated LAZILY, one
+    example at a time — the full expansion (windows × rotations ×
+    examples) is never materialized, so MNIST-scale inputs don't OOM.
+    """
 
     def __init__(self, data: DataSet, window_rows: int, window_cols: int,
                  batch_size: int = 32, rotations: bool = True,
                  rows: Optional[int] = None, cols: Optional[int] = None,
                  shuffle: bool = False, seed: int = 0):
-        from deeplearning4j_tpu.util.viterbi import moving_window_matrix
-
         if data.labels is None:
             raise ValueError(
                 "MovingWindowDataSetIterator needs labeled data (every "
@@ -500,7 +501,6 @@ class MovingWindowDataSetIterator(ListDataSetIterator):
                 "reconstruction feeds wrap with "
                 "ReconstructionDataSetIterator first")
         x = np.asarray(data.features)
-        y = np.asarray(data.labels)
         if x.ndim == 2:
             if not rows or not cols:
                 raise ValueError("flat features need rows=/cols=")
@@ -510,13 +510,57 @@ class MovingWindowDataSetIterator(ListDataSetIterator):
                     f"({rows}*{cols}={rows * cols}) — reshaping would "
                     "silently merge/split examples")
             x = x.reshape(-1, rows, cols)
-        feats, labels = [], []
-        rots = (0, 1, 2, 3) if rotations else (0,)
-        for i in range(x.shape[0]):
-            for rot in rots:
-                w = moving_window_matrix(x[i], window_rows, window_cols, rot)
-                feats.append(w.reshape(w.shape[0], -1))
-                labels.append(np.repeat(y[i:i + 1], w.shape[0], axis=0))
-        aug = DataSet(np.concatenate(feats, 0).astype(np.float32),
-                      np.concatenate(labels, 0).astype(np.float32))
-        super().__init__(aug, batch_size, shuffle=shuffle, seed=seed)
+        self._x = x
+        self._y = np.asarray(data.labels)
+        self._wr, self._wc = window_rows, window_cols
+        self._rots = (0, 1, 2, 3) if rotations else (0,)
+        self._batch = batch_size
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self.reset()
+
+    def reset(self):
+        self._order = np.arange(self._x.shape[0])
+        if self._shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            self._order = rng.permutation(self._x.shape[0])
+            self._epoch += 1
+        self._cursor = 0
+        self._buf_x: List[np.ndarray] = []
+        self._buf_y: List[np.ndarray] = []
+        self._buffered = 0
+
+    def _expand_next_example(self) -> bool:
+        from deeplearning4j_tpu.util.viterbi import moving_window_matrix
+
+        if self._cursor >= self._x.shape[0]:
+            return False
+        i = int(self._order[self._cursor])
+        self._cursor += 1
+        for rot in self._rots:
+            w = moving_window_matrix(self._x[i], self._wr, self._wc, rot)
+            self._buf_x.append(w.reshape(w.shape[0], -1).astype(np.float32))
+            self._buf_y.append(np.repeat(self._y[i:i + 1], w.shape[0], 0))
+            self._buffered += w.shape[0]
+        return True
+
+    def has_next(self):
+        while self._buffered < self._batch:
+            if not self._expand_next_example():
+                break
+        return self._buffered > 0
+
+    def _next_impl(self):
+        if not self.has_next():
+            raise StopIteration
+        xs = np.concatenate(self._buf_x, axis=0)
+        ys = np.concatenate(self._buf_y, axis=0).astype(np.float32)
+        take = min(self._batch, xs.shape[0])
+        self._buf_x = [xs[take:]] if take < xs.shape[0] else []
+        self._buf_y = [ys[take:]] if take < ys.shape[0] else []
+        self._buffered = xs.shape[0] - take
+        return DataSet(xs[:take], ys[:take])
+
+    def batch(self):
+        return self._batch

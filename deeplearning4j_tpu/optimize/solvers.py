@@ -84,27 +84,65 @@ class _FlatOracle:
                                     model.params, self.unravel(jnp.asarray(flat)))
 
 
-def line_gradient_descent(oracle: _FlatOracle, iterations: int) -> Tuple[np.ndarray, float]:
+class TerminationConditions:
+    """``optimize/terminations/`` — convergence tests run between solver
+    iterations (EpsTermination relative-change test, Norm2Termination
+    gradient-norm floor, ZeroDirection): the classic-optimizer loops
+    stop early instead of burning their full iteration budget."""
+
+    def __init__(self, eps: float = 1e-4, tolerance: float = 1e-5,
+                 grad_norm_min: float = 1e-8):
+        self.eps = eps
+        self.tolerance = tolerance
+        self.grad_norm_min = grad_norm_min
+
+    def eps_terminate(self, cost: float, old: float) -> bool:
+        """``EpsTermination.java:41`` relative-change test."""
+        if cost == 0.0 and old == 0.0:
+            return False
+        return 2.0 * abs(old - cost) <= self.tolerance * (
+            abs(old) + abs(cost) + self.eps)
+
+    def terminate(self, cost: float, old: float,
+                  direction: np.ndarray) -> bool:
+        """EpsTermination OR Norm2Termination OR ZeroDirection."""
+        if self.eps_terminate(cost, old):
+            return True
+        n2 = float(np.linalg.norm(direction))
+        return n2 < self.grad_norm_min or n2 == 0.0
+
+
+def line_gradient_descent(oracle: _FlatOracle, iterations: int,
+                          terminations: Optional[TerminationConditions] = None
+                          ) -> Tuple[np.ndarray, float]:
     """``LineGradientDescent.java`` — steepest descent + line search."""
+    term = terminations or TerminationConditions()
     x = np.asarray(oracle.flat0)
     ls = BackTrackLineSearch()
     f = float(oracle.loss(jnp.asarray(x)))
     for _ in range(iterations):
+        old = f
         f, g = oracle.value_and_grad(jnp.asarray(x))
         f, g = float(f), np.asarray(g)
         step, f, d = ls.optimize(oracle.loss, x, -g, f, g)
         x = x + step * d
+        if term.terminate(f, old, d):
+            break
     return x, f
 
 
-def conjugate_gradient(oracle: _FlatOracle, iterations: int) -> Tuple[np.ndarray, float]:
+def conjugate_gradient(oracle: _FlatOracle, iterations: int,
+                       terminations: Optional[TerminationConditions] = None
+                       ) -> Tuple[np.ndarray, float]:
     """``ConjugateGradient.java`` — Polak-Ribière with automatic restart."""
+    term = terminations or TerminationConditions()
     x = np.asarray(oracle.flat0)
     ls = BackTrackLineSearch()
     f, g = oracle.value_and_grad(jnp.asarray(x))
     f, g = float(f), np.asarray(g)
     d = -g
     for _ in range(iterations):
+        old = f
         step, f, d = ls.optimize(oracle.loss, x, d, f, g)
         x = x + step * d
         f_new, g_new = oracle.value_and_grad(jnp.asarray(x))
@@ -112,17 +150,23 @@ def conjugate_gradient(oracle: _FlatOracle, iterations: int) -> Tuple[np.ndarray
         beta = max(0.0, float(np.dot(g_new, g_new - g) / max(np.dot(g, g), 1e-30)))
         d = -g_new + beta * d
         g = g_new
+        if term.terminate(f, old, -g):  # gradient-norm floor, not the
+            break                       # momentum-blended direction
     return x, f
 
 
-def lbfgs(oracle: _FlatOracle, iterations: int, memory: int = 10) -> Tuple[np.ndarray, float]:
+def lbfgs(oracle: _FlatOracle, iterations: int, memory: int = 10,
+          terminations: Optional[TerminationConditions] = None
+          ) -> Tuple[np.ndarray, float]:
     """``LBFGS.java`` — limited-memory BFGS two-loop recursion."""
+    term = terminations or TerminationConditions()
     x = np.asarray(oracle.flat0)
     ls = BackTrackLineSearch()
     f, g = oracle.value_and_grad(jnp.asarray(x))
     f, g = float(f), np.asarray(g)
     s_hist, y_hist = [], []
     for _ in range(iterations):
+        old = f
         # two-loop recursion
         q = g.copy()
         alphas = []
@@ -150,6 +194,8 @@ def lbfgs(oracle: _FlatOracle, iterations: int, memory: int = 10) -> Tuple[np.nd
                 s_hist.pop(0)
                 y_hist.pop(0)
         x, f, g = x_new, f_new, g_new
+        if term.terminate(f, old, -g):
+            break
     return x, f
 
 
@@ -161,7 +207,9 @@ class Solver:
     def __init__(self, model):
         self.model = model
 
-    def optimize(self, ds, iterations: Optional[int] = None) -> float:
+    def optimize(self, ds, iterations: Optional[int] = None,
+                 terminations: Optional[TerminationConditions] = None
+                 ) -> float:
         from deeplearning4j_tpu.nn.conf.configuration import OptimizationAlgorithm as OA
 
         algo = self.model.gc.optimization_algo
@@ -171,11 +219,11 @@ class Solver:
             return self.model.score()
         oracle = _FlatOracle(self.model, ds)
         if algo == OA.LINE_GRADIENT_DESCENT:
-            x, f = line_gradient_descent(oracle, iters)
+            x, f = line_gradient_descent(oracle, iters, terminations)
         elif algo == OA.CONJUGATE_GRADIENT:
-            x, f = conjugate_gradient(oracle, iters)
+            x, f = conjugate_gradient(oracle, iters, terminations)
         elif algo == OA.LBFGS:
-            x, f = lbfgs(oracle, iters)
+            x, f = lbfgs(oracle, iters, terminations=terminations)
         else:
             raise ValueError(f"unknown optimization algorithm {algo}")
         oracle.set_back(self.model, x)

@@ -217,3 +217,18 @@ def test_moving_window_iterator(rng):
     assert labels[:36].argmax(1).tolist() == [0] * 36
     # rotations really differ from the unrotated windows
     assert not np.allclose(feats[:9], feats[9:18])
+
+
+def test_moving_window_is_lazy_and_complete(rng):
+    """Lazy generation serves all windows across batches without ever
+    holding the full expansion (review r4)."""
+    from deeplearning4j_tpu.datasets.iterators import MovingWindowDataSetIterator
+    x = rng.standard_normal((5, 10, 10)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)
+    it = MovingWindowDataSetIterator(DataSet(x, y), 8, 8, batch_size=7)
+    total = sum(np.asarray(b.features).shape[0] for b in it)
+    assert total == 5 * 4 * 9  # examples x rotations x 3x3 positions
+    assert it._buffered <= 7 + 4 * 9  # never more than batch + one example
+    it.reset()
+    b = it.next()
+    assert np.asarray(b.features).shape == (7, 64)

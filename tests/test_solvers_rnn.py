@@ -141,3 +141,41 @@ class TestRnnTimeStep:
         net.rnn_clear_previous_state()
         o3 = net.rnn_time_step(x)
         np.testing.assert_allclose(o1, o3, rtol=1e-6)
+
+
+def test_termination_conditions_stop_converged_solvers(rng):
+    """optimize/terminations parity: EpsTermination/Norm2 stop the
+    classic optimizers early once converged (a quadratic bowl converges
+    in far fewer than the requested iterations)."""
+    from deeplearning4j_tpu.optimize.solvers import (
+        TerminationConditions, conjugate_gradient, lbfgs,
+        line_gradient_descent)
+
+    class Bowl:
+        flat0 = np.asarray([3.0, -2.0], np.float32)
+
+        def loss(self, v):
+            import jax.numpy as jnp
+            return jnp.sum(v * v)
+
+        def value_and_grad(self, v):
+            import jax
+            return jax.value_and_grad(self.loss)(v)
+
+    calls = []
+
+    class Counting(Bowl):
+        def value_and_grad(self, v):
+            calls.append(1)
+            return super().value_and_grad(v)
+
+    for solver in (line_gradient_descent, conjugate_gradient, lbfgs):
+        calls.clear()
+        x, f = solver(Counting(), 200)
+        assert f < 1e-4, (solver.__name__, f)
+        assert len(calls) < 100, (solver.__name__, len(calls))
+
+    t = TerminationConditions()
+    assert not t.eps_terminate(0.0, 0.0)   # initial special case
+    assert t.eps_terminate(1.0, 1.0 + 1e-9)
+    assert t.terminate(5.0, 9.0, np.zeros(3))  # zero direction
