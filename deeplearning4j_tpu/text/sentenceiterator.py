@@ -158,3 +158,129 @@ class LabelAwareIterator:
         self.reset()
         while self.has_next():
             yield self.next_document()
+
+
+class AggregatingSentenceIterator(SentenceIterator):
+    """``AggregatingSentenceIterator`` — chains several sentence
+    iterators into one stream (build corpora from mixed sources)."""
+
+    def __init__(self, iterators: List[SentenceIterator],
+                 preprocessor: Optional[SentencePreProcessor] = None):
+        super().__init__(preprocessor)
+        self._its = list(iterators)
+        self.reset()
+
+    def reset(self):
+        for it in self._its:
+            it.reset()
+        self._idx = 0
+
+    def has_next(self) -> bool:
+        while self._idx < len(self._its):
+            if self._its[self._idx].has_next():
+                return True
+            self._idx += 1
+        return False
+
+    def next_sentence(self) -> str:
+        if not self.has_next():
+            raise StopIteration
+        return self._apply(self._its[self._idx].next_sentence())
+
+
+class PrefetchingSentenceIterator(SentenceIterator):
+    """``PrefetchingSentenceIterator`` — a background thread pulls from
+    the wrapped iterator into a bounded queue so corpus IO (file reads,
+    preprocessing) overlaps training. A worker exception propagates to
+    the consumer (no silently truncated corpora); ``reset`` signals the
+    worker to stop (cost ≤ queue depth, not the remaining corpus) and
+    restarts from a fresh queue."""
+
+    _END = object()
+
+    def __init__(self, wrapped: SentenceIterator, fetch_size: int = 1000,
+                 preprocessor: Optional[SentencePreProcessor] = None):
+        super().__init__(preprocessor)
+        self._wrapped = wrapped
+        self._fetch = fetch_size
+        self._queue = None
+        self._thread = None
+        self._stop = None
+        self._peek = None
+        self._done = False
+
+    def _worker(self, q, stop):
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except Exception:  # queue.Full
+                    continue
+            return False
+
+        try:
+            while not stop.is_set() and self._wrapped.has_next():
+                if not put(self._wrapped.next_sentence()):
+                    return
+        except Exception as e:  # surface to the consumer, don't truncate
+            put(e)
+            return
+        put(self._END)
+
+    def _start(self):
+        import queue
+        import threading
+
+        self._queue = queue.Queue(maxsize=self._fetch)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker,
+                                        args=(self._queue, self._stop),
+                                        daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()  # worker exits without draining the source
+            self._thread.join()
+        self._thread = None
+        self._queue = None
+        self._peek = None
+        self._done = False
+        self._wrapped.reset()
+
+    def has_next(self) -> bool:
+        if self._peek is not None:
+            return True
+        if self._done:
+            return False
+        if self._thread is None:
+            self._start()
+        item = self._queue.get()
+        if isinstance(item, Exception):
+            self._done = True
+            raise item
+        if item is self._END:
+            self._done = True
+            return False
+        self._peek = item
+        return True
+
+    def next_sentence(self) -> str:
+        if not self.has_next():
+            raise StopIteration
+        s, self._peek = self._peek, None
+        return self._apply(s)
+
+
+class LabelAwareListSentenceIterator(LabelAwareIterator):
+    """``LabelAwareListSentenceIterator`` — sentences with one label
+    each (defaults to positional labels), as a LabelAwareIterator."""
+
+    def __init__(self, sentences: List[str],
+                 labels: Optional[List[str]] = None):
+        if labels is not None and len(labels) != len(sentences):
+            raise ValueError(
+                f"{len(labels)} labels for {len(sentences)} sentences")
+        labs = labels or [f"doc_{i}" for i in range(len(sentences))]
+        super().__init__([(s, [l]) for s, l in zip(sentences, labs)])

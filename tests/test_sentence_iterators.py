@@ -1,0 +1,81 @@
+"""Sentence-iterator variants (text/sentenceiterator parity tail)."""
+from deeplearning4j_tpu.text.sentenceiterator import (
+    AggregatingSentenceIterator,
+    CollectionSentenceIterator,
+    LabelAwareListSentenceIterator,
+    PrefetchingSentenceIterator,
+    SentencePreProcessor,
+)
+
+
+class _Up(SentencePreProcessor):
+    def pre_process(self, s):
+        return s.upper()
+
+
+def test_aggregating_chains_sources():
+    a = CollectionSentenceIterator(["one", "two"])
+    b = CollectionSentenceIterator(["three"])
+    it = AggregatingSentenceIterator([a, b], preprocessor=_Up())
+    assert list(it) == ["ONE", "TWO", "THREE"]
+    assert list(it) == ["ONE", "TWO", "THREE"]  # reset via __iter__
+
+
+def test_prefetching_matches_wrapped():
+    src = [f"s{i}" for i in range(250)]
+    it = PrefetchingSentenceIterator(CollectionSentenceIterator(src),
+                                     fetch_size=16)
+    assert list(it) == src
+    assert list(it) == src  # reset restarts the worker cleanly
+
+
+def test_label_aware_list():
+    it = LabelAwareListSentenceIterator(["hello world", "bye"],
+                                        labels=["greet", "farewell"])
+    docs = list(it)
+    assert [d.labels for d in docs] == [["greet"], ["farewell"]]
+    it2 = LabelAwareListSentenceIterator(["a", "b"])
+    assert [d.labels[0] for d in it2] == ["doc_0", "doc_1"]
+
+
+def test_prefetching_edge_cases():
+    """Review r4: post-exhaustion has_next stays False (no deadlock),
+    worker exceptions propagate, reset does not drain the corpus."""
+    import pytest
+
+    src = CollectionSentenceIterator([f"s{i}" for i in range(50)])
+    it = PrefetchingSentenceIterator(src, fetch_size=8)
+    assert len(list(it)) == 50
+    assert it.has_next() is False
+    assert it.has_next() is False  # second call must not block
+
+    class Boom(CollectionSentenceIterator):
+        def next_sentence(self):
+            s = super().next_sentence()
+            if s == "s3":
+                raise IOError("disk gone")
+            return s
+
+    bad = PrefetchingSentenceIterator(Boom([f"s{i}" for i in range(6)]),
+                                      fetch_size=2)
+    got = []
+    with pytest.raises(IOError, match="disk gone"):
+        for s in bad:
+            got.append(s)
+    assert got == ["s0", "s1", "s2"]
+
+    class Counting(CollectionSentenceIterator):
+        pulls = 0
+
+        def next_sentence(self):
+            Counting.pulls += 1
+            return super().next_sentence()
+
+    Counting.pulls = 0
+    big = PrefetchingSentenceIterator(Counting([f"s{i}" for i in range(10000)]),
+                                      fetch_size=4)
+    assert big.has_next()
+    big.next_sentence()
+    big.reset()
+    assert Counting.pulls < 100, Counting.pulls  # no full-corpus drain
+    assert len(list(big)) == 10000  # replays completely after reset
