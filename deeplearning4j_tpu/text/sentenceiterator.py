@@ -272,6 +272,24 @@ class PrefetchingSentenceIterator(SentenceIterator):
         s, self._peek = self._peek, None
         return self._apply(s)
 
+    def close(self) -> None:
+        """Stop the worker without consuming the rest of the corpus —
+        call when abandoning the iterator mid-stream (``__del__`` also
+        signals it, so a dropped iterator cannot leak its polling
+        thread or pin the wrapped source forever)."""
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=1.0)
+        self._thread = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            if self._stop is not None:
+                self._stop.set()  # no join in __del__ (GC context)
+        except Exception:
+            pass
+
 
 class LabelAwareListSentenceIterator(LabelAwareIterator):
     """``LabelAwareListSentenceIterator`` — sentences with one label

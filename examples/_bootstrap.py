@@ -1,0 +1,10 @@
+"""Puts the repo root on sys.path so example scripts run standalone
+(``python examples/train_x.py`` from any cwd). When examples are
+imported as a package (the smoke tests), the root is already there and
+importing this module is a harmless no-op."""
+import os
+import sys
+
+_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _root not in sys.path:
+    sys.path.insert(0, _root)

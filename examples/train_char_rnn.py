@@ -4,6 +4,11 @@ The reference's GravesLSTMCharModellingExample role: LSTM stack over
 one-hot characters, TBPTT-capable fit, stateful rnn_time_step sampling.
 """
 
+try:  # script mode: examples/ is sys.path[0]
+    import _bootstrap  # noqa: F401
+except ImportError:  # package mode: repo root already importable
+    pass
+
 import argparse
 
 import numpy as np

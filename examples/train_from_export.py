@@ -6,6 +6,11 @@ straight from the files holding ONE batch in host RAM at a time, with a
 resumable cursor demonstrating mid-epoch preemption recovery.
 """
 
+try:  # script mode: examples/ is sys.path[0]
+    import _bootstrap  # noqa: F401
+except ImportError:  # package mode: repo root already importable
+    pass
+
 import argparse
 import tempfile
 

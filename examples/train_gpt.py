@@ -6,6 +6,11 @@ for long context (ring attention), or with Mixtral-style routed
 experts — selected by flags, no model changes.
 """
 
+try:  # script mode: examples/ is sys.path[0]
+    import _bootstrap  # noqa: F401
+except ImportError:  # package mode: repo root already importable
+    pass
+
 import argparse
 
 import numpy as np

@@ -9,6 +9,11 @@ sequential container's (tests/test_pipeline.py), so the trained stages
 round-trip back onto the plain model for serving.
 """
 
+try:  # script mode: examples/ is sys.path[0]
+    import _bootstrap  # noqa: F401
+except ImportError:  # package mode: repo root already importable
+    pass
+
 import argparse
 
 import numpy as np

@@ -5,6 +5,11 @@ net). Real IDX files are used when present (datasets/mnist.py search
 paths); otherwise a loud synthetic fallback keeps the example runnable.
 """
 
+try:  # script mode: examples/ is sys.path[0]
+    import _bootstrap  # noqa: F401
+except ImportError:  # package mode: repo root already importable
+    pass
+
 import argparse
 
 import numpy as np

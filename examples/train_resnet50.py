@@ -5,6 +5,11 @@ configuration (BASELINE config #3): ComputationGraph fit_scan, bf16
 compute, image-record-reader input path when a directory is given.
 """
 
+try:  # script mode: examples/ is sys.path[0]
+    import _bootstrap  # noqa: F401
+except ImportError:  # package mode: repo root already importable
+    pass
+
 import argparse
 
 import numpy as np

@@ -8,6 +8,11 @@ FlowIterationListener publishes the model graph at ``/flow``. Run it
 and open the printed URL.
 """
 
+try:  # script mode: examples/ is sys.path[0]
+    import _bootstrap  # noqa: F401
+except ImportError:  # package mode: repo root already importable
+    pass
+
 import argparse
 
 import numpy as np

@@ -5,6 +5,11 @@ tokenizer → vocab → SGNS training → wordsNearest, plus the live
 UiServer nearest-words view.
 """
 
+try:  # script mode: examples/ is sys.path[0]
+    import _bootstrap  # noqa: F401
+except ImportError:  # package mode: repo root already importable
+    pass
+
 import argparse
 
 from deeplearning4j_tpu.models.word2vec.word2vec import Word2Vec

@@ -1,0 +1,59 @@
+"""Word2Vec over Japanese text through the dictionary lattice tokenizer.
+
+The deeplearning4j-nlp-japanese role end to end: Kuromoji-style
+Viterbi-lattice segmentation (TSV dictionary + POS connection costs +
+unknown-word character classes, ``text/lattice.py``) feeding the
+all-epochs-on-device SGNS engine; prints nearest neighbors for a few
+query words. ``--korean`` runs the same pipeline on the Korean
+dictionary.
+"""
+
+try:  # script mode: examples/ is sys.path[0]
+    import _bootstrap  # noqa: F401
+except ImportError:  # package mode: repo root already importable
+    pass
+
+import argparse
+
+from deeplearning4j_tpu.models.word2vec.word2vec import Word2Vec
+from deeplearning4j_tpu.text.tokenization import tokenizer_factory
+
+_JA = [
+    "私は日本語を勉強します",
+    "先生は学校で日本語を話す",
+    "学生は東京大学で勉強します",
+    "私は明日学校へ行く",
+    "今日は新しい仕事です",
+    "東京は日本の世界です",
+] * 40
+
+_KO = [
+    "저는 한국어를 공부합니다",
+    "선생님은 학교에서 한국어를 합니다",
+    "학생은 서울에서 공부합니다",
+    "오늘은 회사에 있습니다",
+] * 40
+
+
+def main(smoke: bool = False, korean: bool = False):
+    lang = "korean" if korean else "japanese"
+    tf = tokenizer_factory(lang)
+    corpus = _KO if korean else _JA
+    sents = [tf.create(s).get_tokens() for s in corpus]
+    w2v = Word2Vec(layer_size=16 if smoke else 64, window_size=3,
+                   min_word_frequency=1, epochs=1 if smoke else 5,
+                   negative_sample=3, seed=7,
+                   batch_size=1024 if smoke else 8192)
+    w2v.fit(sents)
+    queries = ["한국어", "학교"] if korean else ["日本語", "学校"]
+    for q in queries:
+        print(f"nearest({q}):", w2v.words_nearest(q, 3))
+    return w2v
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--korean", action="store_true")
+    a = ap.parse_args()
+    main(smoke=a.smoke, korean=a.korean)

@@ -55,3 +55,11 @@ def test_train_from_export_example():
 def test_train_with_ui_example():
     from examples.train_with_ui import main
     assert np.isfinite(main(smoke=True))
+
+
+def test_word2vec_cjk_example():
+    from examples.train_word2vec_cjk import main
+    w2v = main(smoke=True)
+    assert len(w2v.words_nearest("日本語", 3)) == 3
+    w2v_ko = main(smoke=True, korean=True)
+    assert len(w2v_ko.words_nearest("한국어", 3)) == 3

@@ -79,3 +79,16 @@ def test_prefetching_edge_cases():
     big.reset()
     assert Counting.pulls < 100, Counting.pulls  # no full-corpus drain
     assert len(list(big)) == 10000  # replays completely after reset
+
+
+def test_prefetching_close_stops_abandoned_worker():
+    import time
+
+    src = CollectionSentenceIterator([f"s{i}" for i in range(100000)])
+    it = PrefetchingSentenceIterator(src, fetch_size=2)
+    assert it.has_next()
+    it.next_sentence()  # abandon mid-stream
+    worker = it._thread
+    it.close()
+    time.sleep(0.05)
+    assert worker is None or not worker.is_alive()
