@@ -420,8 +420,15 @@ class IteratorDataSetIterator(DataSetIterator):
         if not self._buf:
             raise StopIteration
         chunk, self._buf = self._buf, []
+        n_labeled = sum(d.labels is not None for d in chunk)
+        if 0 < n_labeled < len(chunk):
+            raise ValueError(
+                "IteratorDataSetIterator chunk mixes labeled and "
+                f"unlabeled examples ({n_labeled}/{len(chunk)} have "
+                "labels) — a merged batch cannot represent both; split "
+                "the stream or drop/fill the missing labels upstream")
         feats = np.concatenate([np.atleast_2d(d.features) for d in chunk], axis=0)
-        labels = (None if all(d.labels is None for d in chunk)
+        labels = (None if n_labeled == 0
                   else np.concatenate([np.atleast_2d(d.labels) for d in chunk], axis=0))
         fmask = self._cat_masks(
             [d.features_mask for d in chunk],

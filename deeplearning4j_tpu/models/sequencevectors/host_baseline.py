@@ -129,12 +129,12 @@ def sgns_host_benchmark(sentences: Sequence[List[int]], vocab_size: int,
     t0 = time.perf_counter()
     done = 0
     while done < centers.shape[0] and time.perf_counter() - t0 <= max_seconds:
-        # re-walks the stream if the corpus is tiny: every timed batch's
-        # pairs are inside the timer, so tokens/sec stays honest and
-        # nonzero for any input
-        lo = done % max(centers.shape[0] - batch + 1, 1)
+        # single pass; the final batch is clamped back so the tail
+        # pairs still train (a corpus smaller than one batch trains
+        # whole in the first iteration)
+        lo = min(done, max(centers.shape[0] - batch, 0))
         train_pairs(centers[lo:lo + batch], contexts[lo:lo + batch])
-        done += min(batch, centers.shape[0] - lo)
+        done = min(lo + batch, centers.shape[0])
     dt = time.perf_counter() - t0
     tokens = done / pairs_per_token
     return {"tokens_per_sec": tokens / dt, "tokens": tokens,

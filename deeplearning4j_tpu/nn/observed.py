@@ -15,20 +15,39 @@ from __future__ import annotations
 
 import threading
 
-# One lock for all pending-sync handoffs (ADVICE r3): reads can come
-# from non-training threads (a UiServer polling model.params while
-# ParallelWrapper.fit runs), and the get-and-clear below must not let
-# two readers both run the thunk, nor let the training thread donate
-# the buffers a reader's thunk is still consuming. Contention is nil —
-# the lock is held only for the thunk run / a pointer clear.
-_SYNC_LOCK = threading.Lock()
+# Per-instance locks for pending-sync handoffs (ADVICE r3/r4): reads
+# can come from non-training threads (a UiServer polling model.params
+# while ParallelWrapper.fit runs), and the get-and-clear below must not
+# let two readers both run the thunk, nor let the training thread
+# donate the buffers a reader's thunk is still consuming. The lock is
+# per model instance so a slow thunk on one model never blocks reads on
+# another, and a thunk that reads a *different* object's synced attrs
+# cannot self-deadlock on a shared non-reentrant lock. _LOCK_CREATION
+# only guards first-touch creation of an instance lock.
+#
+# Constraint on thunk authors: a thunk may READ another object's synced
+# attrs only if those reads form no cycle (a's thunk reading b.params
+# while b's thunk reads a.params is an ABBA deadlock). In-repo thunks
+# only write through the descriptors (writes take no lock), and
+# ParallelWrapper installs at most a one-way read, so the constraint is
+# about custom observers.
+_LOCK_CREATION = threading.Lock()
+
+
+def _sync_lock(obj) -> threading.Lock:
+    lock = obj.__dict__.get("_observer_sync_lock")
+    if lock is None:
+        with _LOCK_CREATION:
+            lock = obj.__dict__.setdefault("_observer_sync_lock",
+                                           threading.Lock())
+    return lock
 
 
 def clear_pending_sync(obj) -> None:
     """Drop ``obj``'s pending observer sync. Blocks while a reader
     thread is mid-thunk, so the caller may safely donate the buffers
     the thunk references once this returns."""
-    with _SYNC_LOCK:
+    with _sync_lock(obj):
         obj.__dict__["_observer_sync"] = None
 
 
@@ -49,7 +68,7 @@ class SyncedStateAttr:
         if obj is None:
             return self
         if obj.__dict__.get("_observer_sync") is not None:  # cheap probe
-            with _SYNC_LOCK:  # atomic get-and-clear + run (ADVICE r3)
+            with _sync_lock(obj):  # atomic get-and-clear + run (ADVICE r3)
                 sync = obj.__dict__.get("_observer_sync")
                 if sync is not None:
                     obj.__dict__["_observer_sync"] = None

@@ -70,7 +70,10 @@ def _char_class(ch: str) -> str:
     if (0x30A0 <= o <= 0x30FF or 0x31F0 <= o <= 0x31FF
             or 0xFF66 <= o <= 0xFF9F):  # incl. halfwidth katakana
         return "KATAKANA"
-    if 0xAC00 <= o <= 0xD7A3 or 0x1100 <= o <= 0x11FF:
+    if (0xAC00 <= o <= 0xD7A3 or 0x1100 <= o <= 0x11FF
+            or 0x3130 <= o <= 0x318F   # Compatibility Jamo (ㄱ ㅏ …)
+            or 0xA960 <= o <= 0xA97F   # Jamo Extended-A
+            or 0xD7B0 <= o <= 0xD7FF):  # Jamo Extended-B
         return "HANGUL"
     if (0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF
             or 0xF900 <= o <= 0xFAFF  # compatibility ideographs

@@ -9,6 +9,7 @@ variant used by ParagraphVectors (``documentiterator/LabelAwareIterator``).
 from __future__ import annotations
 
 import os
+import queue as _queue
 from typing import Callable, Iterable, List, Optional, Tuple
 
 
@@ -229,10 +230,9 @@ class PrefetchingSentenceIterator(SentenceIterator):
         put(self._END)
 
     def _start(self):
-        import queue
         import threading
 
-        self._queue = queue.Queue(maxsize=self._fetch)
+        self._queue = _queue.Queue(maxsize=self._fetch)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._worker,
                                         args=(self._queue, self._stop),
@@ -256,7 +256,25 @@ class PrefetchingSentenceIterator(SentenceIterator):
             return False
         if self._thread is None:
             self._start()
-        item = self._queue.get()
+        while True:
+            try:
+                item = self._queue.get(timeout=0.2)
+                break
+            except _queue.Empty:
+                # A worker killed by close(), or one that died on a
+                # BaseException that skipped the except-Exception
+                # handler, never enqueues _END — surface that as
+                # end-of-stream instead of blocking forever. The worker
+                # may have enqueued its final items (incl. _END) in the
+                # gap between our timeout and this liveness check, so
+                # drain non-blocking before declaring EOS.
+                if self._done or not self._thread.is_alive():
+                    try:
+                        item = self._queue.get_nowait()
+                        break
+                    except _queue.Empty:
+                        self._done = True
+                        return False
         if isinstance(item, Exception):
             self._done = True
             raise item
@@ -277,6 +295,7 @@ class PrefetchingSentenceIterator(SentenceIterator):
         call when abandoning the iterator mid-stream (``__del__`` also
         signals it, so a dropped iterator cannot leak its polling
         thread or pin the wrapped source forever)."""
+        self._done = True  # a consumer that keeps iterating sees EOS
         if self._stop is not None:
             self._stop.set()
         if self._thread is not None and self._thread.is_alive():

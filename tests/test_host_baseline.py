@@ -38,3 +38,14 @@ def test_host_benchmark_tiny_corpus_nonzero():
     assert r["tokens_per_sec"] > 0 and np.isfinite(r["tokens_per_sec"])
     for k in ("tokens", "pairs", "seconds", "pairs_per_token"):
         assert np.isfinite(r[k])
+
+
+def test_host_benchmark_trains_tail_pairs():
+    """ADVICE r4: the final clamped batch covers the tail — every pair
+    in a sub-timeout corpus is counted exactly through to N."""
+    sents = [[0, 1, 2, 3, 4, 5]] * 6
+    r = sgns_host_benchmark(sents, 6, dim=8, window=2, K=2,
+                            batch=32, max_seconds=30.0)
+    # all generated pairs trained: done ran to exactly the pair count
+    assert r["pairs"] == int(r["pairs_per_token"] * r["tokens"])
+    assert r["tokens"] == sum(len(s) for s in sents)

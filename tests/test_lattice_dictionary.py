@@ -115,3 +115,13 @@ def test_lazy_registry_no_side_effect_import():
                        text=True)
     assert r.returncode == 0, r.stderr
     assert "KoreanTokenizerFactory" in r.stdout
+
+
+def test_hangul_jamo_blocks_class_as_hangul():
+    """ADVICE r4: Compatibility Jamo and extended Jamo blocks must not
+    split an otherwise uniform Hangul unknown run."""
+    from deeplearning4j_tpu.text.lattice import _char_class
+    for ch in ("ㄱ", "ㅏ", "ㆎ",   # compatibility jamo
+               "ꥠ", "ힰ",             # extended A / B
+               "가", "ᄀ"):            # syllables / classic jamo
+        assert _char_class(ch) == "HANGUL", hex(ord(ch))

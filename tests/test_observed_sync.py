@@ -52,3 +52,25 @@ def test_clear_blocks_until_reader_thunk_finishes():
     order.append("clear-returned")
     reader.join()
     assert order == ["thunk-done", "clear-returned"]
+
+
+def test_cross_object_thunk_does_not_deadlock():
+    """ADVICE r4: a thunk on one model that reads a synced attr of a
+    DIFFERENT model (itself with a pending sync) must not self-deadlock
+    on a shared non-reentrant lock — locks are per instance now."""
+    a, b = Box(), Box()
+    a.params = "a-stale"
+    b.params = "b-stale"
+    b._observer_sync = lambda: setattr(b, "params", "b-fresh")
+
+    def a_thunk():
+        assert b.params == "b-fresh"  # triggers b's sync under b's lock
+        a.params = "a-fresh"
+
+    a._observer_sync = a_thunk
+    done = []
+    t = threading.Thread(target=lambda: done.append(a.params))
+    t.start()
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "cross-object observer sync deadlocked"
+    assert done == ["a-fresh"]

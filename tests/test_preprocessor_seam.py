@@ -232,3 +232,17 @@ def test_moving_window_is_lazy_and_complete(rng):
     it.reset()
     b = it.next()
     assert np.asarray(b.features).shape == (7, 64)
+
+
+def test_iterator_dsi_mixed_label_presence_is_diagnosed():
+    """ADVICE r4: mixing labeled and unlabeled examples in one chunk
+    raises a descriptive error instead of a concatenate shape crash."""
+    import pytest
+    from deeplearning4j_tpu.datasets.iterators import IteratorDataSetIterator
+    mixed = [
+        DataSet(np.ones((1, 3)), np.ones((1, 2)), None,
+                np.ones((1,), np.float32)),
+        DataSet(np.ones((1, 3)), None, None, None),
+    ]
+    with pytest.raises(ValueError, match="mixes labeled and unlabeled"):
+        IteratorDataSetIterator(mixed, 4).next()

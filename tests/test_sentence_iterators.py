@@ -92,3 +92,14 @@ def test_prefetching_close_stops_abandoned_worker():
     it.close()
     time.sleep(0.05)
     assert worker is None or not worker.is_alive()
+
+
+def test_prefetching_has_next_after_close_returns_false():
+    """ADVICE r4: a consumer that keeps iterating after close() must see
+    end-of-stream, not block forever on an empty queue."""
+    src = CollectionSentenceIterator([f"s{i}" for i in range(100000)])
+    it = PrefetchingSentenceIterator(src, fetch_size=2)
+    assert it.has_next()
+    it.next_sentence()
+    it.close()
+    assert it.has_next() is False  # must return, not hang
