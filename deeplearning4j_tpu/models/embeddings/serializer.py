@@ -125,3 +125,437 @@ def read_full_model(path: str):
     lt.syn0, lt.syn1, lt.syn1neg = syn0, syn1, syn1neg
     w2v.lookup_table = lt
     return w2v
+
+
+# --------------------------------------------------------------------
+# Reference-layout interchange formats (round 5).
+#
+# Byte-layout parity targets in WordVectorSerializer.java:
+#   :380  writeWordVectors(WeightLookupTable)  — headerless "B64:word v…"
+#   :493  writeWord2VecModel       — zip{syn0,syn1,codes,huffman,
+#                                        frequencies,config.json}
+#   :605  writeParagraphVectors    — same zip + labels.txt
+#   :747  readParagraphVectors, :793 readWord2Vec
+#   :891  readWord2VecFromText     — the 4-file HS text format
+#   :964  readParagraphVectorsFromText — legacy "L|E word v…" lines
+#   :1081 writeWordVectors(Glove)  — the headerless table format
+#   :1606 loadTxt                  — header autodetect + B64 decode
+#   :2448 encodeB64 / :2456 decodeB64
+# --------------------------------------------------------------------
+
+import base64
+
+#: the legacy text formats replace spaces inside labels with this token
+#: (``WordVectorSerializer.java:88``)
+WHITESPACE_REPLACEMENT = "_Az92_"
+
+
+def encode_b64(word: str) -> str:
+    """``encodeB64`` — 'B64:' + base64(utf-8 bytes)."""
+    return "B64:" + base64.b64encode(word.encode("utf-8")).decode("ascii")
+
+
+def decode_b64(word: str) -> str:
+    """``decodeB64`` — passes through strings without the prefix, so
+    files written by tools that never encode still load."""
+    if word.startswith("B64:"):
+        return base64.b64decode(word[4:]).decode("utf-8")
+    return word
+
+
+def _write_table_text(words, vectors, f) -> None:
+    """Headerless lookup-table text: one 'B64:word v1 v2 …' per row
+    (``writeWordVectors(WeightLookupTable)`` :380 — note: NO 'V d'
+    header, unlike the Google text format above)."""
+    for w, row in zip(words, vectors):
+        f.write(encode_b64(w) + " "
+                + " ".join(repr(float(x)) for x in row) + "\n")
+
+
+def load_txt(path: str):
+    """``loadTxt`` :1606 — reads the headerless table format, with the
+    reference's header autodetection (a first line that is not
+    'word float float …' or has <4 columns is skipped) and B64 word
+    decoding. Returns ``(words, vectors)`` in file order."""
+    with open(path, "r", encoding="utf-8") as f:
+        lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+    if not lines:
+        return [], np.zeros((0, 0), np.float32)
+    first = lines[0].split(" ")
+    has_header = len(first) < 2
+    if not has_header and not first[0].startswith("B64:"):
+        # a 'B64:'-prefixed first token can never be a header — without
+        # this, the reference's <4-columns heuristic would silently drop
+        # the first row of any d<3 table our own writer produced
+        try:
+            for x in first[1:]:
+                float(x)
+            if len(first) < 4:
+                has_header = True
+        except ValueError:
+            has_header = True
+    if has_header:
+        lines = lines[1:]
+    words, rows = [], []
+    for ln in lines:
+        parts = ln.split(" ")
+        words.append(decode_b64(parts[0]).replace(WHITESPACE_REPLACEMENT, " "))
+        rows.append(np.asarray([float(x) for x in parts[1:]], np.float32))
+    return words, np.vstack(rows) if rows else np.zeros((0, 0), np.float32)
+
+
+def _codes_lines(vocab) -> str:
+    """codes.txt / huffman.txt body: 'B64:word c1 c2 …' per vocab word
+    (empty list for NS-only models — the reference writes the word with
+    no trailing numbers)."""
+    out = []
+    for i in range(vocab.num_words()):
+        w = vocab._index[i]
+        vals = w.codes if w.codes is not None else []
+        out.append(" ".join([encode_b64(w.word)] + [str(int(c)) for c in vals]))
+    return "\n".join(out) + "\n"
+
+
+def _points_lines(vocab) -> str:
+    out = []
+    for i in range(vocab.num_words()):
+        w = vocab._index[i]
+        vals = w.points if w.points is not None else []
+        out.append(" ".join([encode_b64(w.word)] + [str(int(p)) for p in vals]))
+    return "\n".join(out) + "\n"
+
+
+def _config_json(model, extra=None) -> str:
+    """VectorsConfiguration JSON with the reference's field names
+    (``VectorsConfiguration.java:26-60``) so a reference loader finds
+    the knobs it expects."""
+    cfg = {
+        "minWordFrequency": model.min_word_frequency,
+        "learningRate": model.learning_rate,
+        "layersSize": model.vector_length,
+        "batchSize": model.batch_size,
+        "epochs": model.epochs,
+        "window": model.window,
+        "seed": model.seed,
+        "negative": float(model.negative),
+        "useHierarchicSoftmax": bool(model.use_hs),
+        "vocabSize": model.vocab.num_words() if model.vocab else 0,
+    }
+    if extra:
+        cfg.update(extra)
+    return json.dumps(cfg)
+
+
+def _freq_lines(vocab) -> str:
+    """frequencies.txt: 'B64:word elementFrequency docAppearedIn'."""
+    return "\n".join(
+        f"{encode_b64(w.word)} {float(w.count)} 0.0"
+        for w in vocab._index) + "\n"
+
+
+def _zip_write_model(z, vocab, syn0_words, syn0, syn1, config_json) -> None:
+    buf = io.StringIO()
+    _write_table_text(syn0_words, syn0, buf)
+    z.writestr("syn0.txt", buf.getvalue())
+    z.writestr("syn1.txt", "\n".join(
+        " ".join(repr(float(x)) for x in row) for row in syn1) + "\n")
+    z.writestr("codes.txt", _codes_lines(vocab))
+    z.writestr("huffman.txt", _points_lines(vocab))
+    z.writestr("frequencies.txt", _freq_lines(vocab))
+    z.writestr("config.json", config_json)
+
+
+def write_word2vec_model(model, path: str) -> None:
+    """``writeWord2VecModel`` :493 — FULL model zip: syn0.txt,
+    syn1.txt (HS weights; syn1neg for NS-only models, recorded in
+    config.json), codes.txt, huffman.txt, frequencies.txt, config.json."""
+    lt = model.lookup_table
+    syn1 = lt.syn1 if model.use_hs else lt.syn1neg
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        _zip_write_model(z, model.vocab, model.vocab.words(), lt.syn0,
+                         syn1, _config_json(model))
+
+
+def read_word2vec_model(path: str):
+    """``readWord2Vec`` :793 — restores the full-zip model including
+    Huffman codes/points and frequencies (readWord2VecFromText role)."""
+    return _read_word2vec_zip(path)[0]
+
+
+def _read_zip_text(z, name):
+    return z.read(name).decode("utf-8")
+
+
+def _parse_tagged_int_lines(text):
+    """'B64:word n1 n2 …' lines -> {word: [ints]}."""
+    out = {}
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        parts = ln.split(" ")
+        out[decode_b64(parts[0])] = [int(x) for x in parts[1:] if x]
+    return out
+
+
+def _read_word2vec_zip(path: str):
+    """Single-pass zip read. Returns ``(w2v, cfg, freqs, label_set)``
+    so the ParagraphVectors restore path reuses one decompression."""
+    from deeplearning4j_tpu.models.word2vec.word2vec import Word2Vec
+    from deeplearning4j_tpu.models.embeddings.lookup_table import InMemoryLookupTable
+
+    with zipfile.ZipFile(path) as z:
+        names = set(z.namelist())
+        cfg = json.loads(z.read("config.json"))
+        syn0_txt = _read_zip_text(z, "syn0.txt")
+        syn1_txt = _read_zip_text(z, "syn1.txt")
+        codes = _parse_tagged_int_lines(_read_zip_text(z, "codes.txt"))
+        points = _parse_tagged_int_lines(_read_zip_text(z, "huffman.txt"))
+        freqs = {}
+        if "frequencies.txt" in names:
+            for ln in _read_zip_text(z, "frequencies.txt").splitlines():
+                if ln.strip():
+                    p = ln.split(" ")
+                    freqs[decode_b64(p[0])] = int(float(p[1]))
+        label_set = []
+        if "labels.txt" in names:
+            label_set = [decode_b64(ln.strip())
+                         for ln in _read_zip_text(z, "labels.txt").splitlines()
+                         if ln.strip()]
+
+    words, rows = [], []
+    for ln in syn0_txt.splitlines():
+        if not ln.strip():
+            continue
+        parts = ln.split(" ")
+        words.append(decode_b64(parts[0]))
+        rows.append(np.asarray([float(x) for x in parts[1:]], np.float32))
+    syn0 = np.vstack(rows)
+    syn1 = np.vstack([
+        np.asarray([float(x) for x in ln.split(" ")], np.float32)
+        for ln in syn1_txt.splitlines() if ln.strip()])
+
+    use_hs = bool(cfg.get("useHierarchicSoftmax", False))
+    negative = int(float(cfg.get("negative", 0)))
+    w2v = Word2Vec(layer_size=int(cfg.get("layersSize", syn0.shape[1])),
+                   window_size=int(cfg.get("window", 5)),
+                   min_word_frequency=int(cfg.get("minWordFrequency", 1)),
+                   epochs=int(cfg.get("epochs", 1)),
+                   learning_rate=float(cfg.get("learningRate", 0.025)),
+                   negative_sample=negative,
+                   use_hierarchic_softmax=use_hs,
+                   batch_size=int(cfg.get("batchSize", 4096)),
+                   seed=int(cfg.get("seed", 123)))
+    vocab = VocabCache.from_ordered(
+        words, [freqs.get(w, 1) for w in words])
+    for w in vocab._index:
+        if codes.get(w.word):
+            w.codes = codes[w.word]
+        if points.get(w.word):
+            w.points = points[w.word]
+    w2v.vocab = vocab
+    lt = InMemoryLookupTable(vocab, syn0.shape[1])
+    lt.syn0 = syn0
+    if use_hs:
+        lt.syn1 = syn1
+        lt.syn1neg = np.zeros_like(syn0)
+    else:
+        lt.syn1 = np.zeros((max(syn0.shape[0] - 1, 1), syn0.shape[1]),
+                           np.float32)
+        lt.syn1neg = syn1
+    w2v.lookup_table = lt
+    return w2v, cfg, freqs, label_set
+
+
+def write_paragraph_vectors(pv, path: str) -> None:
+    """``writeParagraphVectors`` :605 — the word2vec-model zip plus
+    labels.txt. Label vectors are syn0 rows (the reference keeps labels
+    in the vocab; our doc-vector matrix rows append after the words and
+    labels.txt marks them)."""
+    lt = pv.lookup_table
+    words = pv.vocab.words()
+    syn0 = lt.syn0
+    labels = list(pv.labels)
+    if pv.doc_vectors is not None and len(labels):
+        syn0 = np.vstack([syn0, np.asarray(pv.doc_vectors, np.float32)])
+    syn1 = lt.syn1 if pv.use_hs else lt.syn1neg
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        _zip_write_model(z, pv.vocab, words + labels, syn0, syn1,
+                         _config_json(pv, {"trainElementsVectors":
+                                           bool(pv.train_words)}))
+        z.writestr("labels.txt",
+                   "\n".join(encode_b64(l) for l in labels) + "\n")
+
+
+def read_paragraph_vectors(path: str):
+    """``readParagraphVectors`` :747 — restore the zip, split label
+    rows out of syn0 into the doc-vector matrix via labels.txt."""
+    from deeplearning4j_tpu.models.paragraphvectors.paragraphvectors import (
+        ParagraphVectors)
+    from deeplearning4j_tpu.models.embeddings.lookup_table import InMemoryLookupTable
+
+    w2v, cfg, freqs, label_set = _read_word2vec_zip(path)
+    pv = ParagraphVectors(
+        layer_size=w2v.vector_length, window_size=w2v.window,
+        min_word_frequency=w2v.min_word_frequency, epochs=w2v.epochs,
+        learning_rate=w2v.learning_rate, negative_sample=w2v.negative,
+        train_words=bool(cfg.get("trainElementsVectors", True)),
+        batch_size=w2v.batch_size, seed=w2v.seed)
+    pv.use_hs = w2v.use_hs  # PV builder has no HS knob; carry the flag
+    all_words = w2v.vocab.words()
+    # A label may share its string with a corpus word (the reference
+    # stores both as ONE vocab token marked isLabel). Our writer appends
+    # label rows AFTER the vocab words and lists only words in
+    # frequencies.txt, so a label row is identified as: the LAST
+    # occurrence of the label name, removed from the word table when it
+    # is writer-appended (duplicate name, or absent from frequencies).
+    # A reference-written file keeps labels inside the vocab — there the
+    # shared row stays a word AND is copied into the doc-vector matrix,
+    # matching the reference's own semantics.
+    freq_words = set(freqs)
+    occ = {}
+    for i, w in enumerate(all_words):
+        occ.setdefault(w, []).append(i)
+    labels_found = [l for l in label_set if l in occ]
+    lab_idx = [occ[l][-1] for l in labels_found]
+    label_only_rows = {
+        occ[l][-1] for l in labels_found
+        if len(occ[l]) > 1 or l not in freq_words}
+    word_idx = [i for i in range(len(all_words)) if i not in label_only_rows]
+    word_list = [all_words[i] for i in word_idx]
+    counts = w2v.vocab.word_frequencies()
+    vocab = VocabCache.from_ordered(word_list,
+                                    [int(counts[i]) for i in word_idx])
+    for w in vocab._index:
+        src = w2v.vocab.word_for(w.word)
+        w.codes, w.points = src.codes, src.points
+    pv.vocab = vocab
+    lt = InMemoryLookupTable(vocab, w2v.vector_length)
+    lt.syn0 = w2v.lookup_table.syn0[word_idx]
+    src_syn1 = (w2v.lookup_table.syn1 if w2v.use_hs
+                else w2v.lookup_table.syn1neg)
+    # syn1/syn1neg rows are word-indexed only when the writer kept
+    # labels out of them (our writer does; reference HS trees span all
+    # tokens — keep whatever aligns). Both tables are always populated
+    # so a restored model re-serializes and trains regardless of mode.
+    if w2v.use_hs:
+        lt.syn1 = src_syn1
+        lt.syn1neg = np.zeros_like(lt.syn0)
+    else:
+        lt.syn1 = np.zeros((max(lt.syn0.shape[0] - 1, 1),
+                            lt.syn0.shape[1]), np.float32)
+        lt.syn1neg = (src_syn1[word_idx]
+                      if src_syn1.shape[0] == len(all_words) else src_syn1)
+    pv.lookup_table = lt
+    pv.labels = labels_found
+    pv._label_index = {l: k for k, l in enumerate(pv.labels)}
+    pv.doc_vectors = w2v.lookup_table.syn0[lab_idx]
+    return pv
+
+
+def write_glove(glove, path: str) -> None:
+    """``writeWordVectors(Glove)`` :1081 — the headerless lookup-table
+    text format over the summed GloVe vectors."""
+    with open(path, "w", encoding="utf-8") as f:
+        _write_table_text(glove.vocab.words(), glove.vectors, f)
+
+
+def read_glove(path: str):
+    """GloVe restore: loadTxt the table, return a query-ready Glove
+    (vocab + vectors populated; training state is not part of the
+    reference format either)."""
+    from deeplearning4j_tpu.models.glove.glove import Glove
+
+    words, vectors = load_txt(path)
+    g = Glove(layer_size=vectors.shape[1] if vectors.size else 0)
+    g.vocab = VocabCache.from_ordered(words)
+    g.vectors = vectors
+    return g
+
+
+def write_paragraph_vectors_text(pv, path: str) -> None:
+    """Legacy PV text (``writeWordVectors(ParagraphVectors)`` :1124):
+    'L|E label v1 v2 …' lines, spaces in labels replaced by
+    ``_Az92_`` (not B64 — the legacy format predates it)."""
+    with open(path, "w", encoding="utf-8") as f:
+        for i, w in enumerate(pv.vocab.words()):
+            vec = " ".join(repr(float(x))
+                           for x in pv.lookup_table.syn0[i])
+            f.write(f"E {w.replace(' ', WHITESPACE_REPLACEMENT)} {vec}\n")
+        for k, l in enumerate(pv.labels):
+            vec = " ".join(repr(float(x)) for x in pv.doc_vectors[k])
+            f.write(f"L {l.replace(' ', WHITESPACE_REPLACEMENT)} {vec}\n")
+
+
+def read_paragraph_vectors_text(path: str):
+    """``readParagraphVectorsFromText`` :964 — the legacy L/E lines."""
+    from deeplearning4j_tpu.models.paragraphvectors.paragraphvectors import (
+        ParagraphVectors)
+    from deeplearning4j_tpu.models.embeddings.lookup_table import InMemoryLookupTable
+
+    words, word_rows, labels, label_rows = [], [], [], []
+    with open(path, "r", encoding="utf-8") as f:
+        for ln in f:
+            if not ln.strip():
+                continue
+            parts = ln.rstrip("\n").split(" ")
+            tag, word = parts[0], parts[1].replace(WHITESPACE_REPLACEMENT, " ")
+            row = np.asarray([float(x) for x in parts[2:]], np.float32)
+            if tag == "L":
+                labels.append(word)
+                label_rows.append(row)
+            else:
+                words.append(word)
+                word_rows.append(row)
+    d = (word_rows or label_rows)[0].shape[0]
+    pv = ParagraphVectors(layer_size=d)
+    pv.vocab = VocabCache.from_ordered(words)
+    lt = InMemoryLookupTable(pv.vocab, d)
+    lt.syn0 = (np.vstack(word_rows) if word_rows
+               else np.zeros((0, d), np.float32))
+    lt.syn1neg = np.zeros_like(lt.syn0)
+    pv.lookup_table = lt
+    pv.labels = labels
+    pv._label_index = {l: k for k, l in enumerate(labels)}
+    pv.doc_vectors = (np.vstack(label_rows) if label_rows
+                      else np.zeros((0, d), np.float32))
+    return pv
+
+
+def read_word2vec_from_text(vectors_path: str, hs_path: str,
+                            codes_path: str, points_path: str,
+                            config: Optional[dict] = None):
+    """``readWord2VecFromText`` :891 — externally-originated 4-file HS
+    format: syn0 table, syn1 rows, Huffman codes, Huffman points."""
+    from deeplearning4j_tpu.models.word2vec.word2vec import Word2Vec
+    from deeplearning4j_tpu.models.embeddings.lookup_table import InMemoryLookupTable
+
+    config = config or {}
+    words, syn0 = load_txt(vectors_path)
+    with open(hs_path, "r", encoding="utf-8") as f:
+        syn1 = np.vstack([
+            np.asarray([float(x) for x in ln.split(" ")], np.float32)
+            for ln in f if ln.strip()])
+    with open(codes_path, "r", encoding="utf-8") as f:
+        codes = _parse_tagged_int_lines(f.read())
+    with open(points_path, "r", encoding="utf-8") as f:
+        points = _parse_tagged_int_lines(f.read())
+
+    w2v = Word2Vec(layer_size=syn0.shape[1],
+                   window_size=int(config.get("window", 5)),
+                   negative_sample=int(float(config.get("negative", 0))),
+                   use_hierarchic_softmax=True,
+                   learning_rate=float(config.get("learningRate", 0.025)),
+                   seed=int(config.get("seed", 123)))
+    vocab = VocabCache.from_ordered(words)
+    for w in vocab._index:
+        if w.word in codes:
+            w.codes = codes[w.word]
+        if w.word in points:
+            w.points = points[w.word]
+    w2v.vocab = vocab
+    lt = InMemoryLookupTable(vocab, syn0.shape[1])
+    lt.syn0 = syn0
+    lt.syn1 = syn1
+    lt.syn1neg = np.zeros_like(syn0)
+    w2v.lookup_table = lt
+    return w2v

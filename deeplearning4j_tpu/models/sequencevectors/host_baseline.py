@@ -129,12 +129,13 @@ def sgns_host_benchmark(sentences: Sequence[List[int]], vocab_size: int,
     t0 = time.perf_counter()
     done = 0
     while done < centers.shape[0] and time.perf_counter() - t0 <= max_seconds:
-        # single pass; the final batch is clamped back so the tail
-        # pairs still train (a corpus smaller than one batch trains
-        # whole in the first iteration)
-        lo = min(done, max(centers.shape[0] - batch, 0))
-        train_pairs(centers[lo:lo + batch], contexts[lo:lo + batch])
-        done = min(lo + batch, centers.shape[0])
+        # single pass; the final batch is simply SHORT (numpy has no
+        # static-shape constraint) — a clamped-back full batch would
+        # retrain earlier pairs inside the timer while `done` counted
+        # them once, under-reading the anchor throughput
+        hi = min(done + batch, centers.shape[0])
+        train_pairs(centers[done:hi], contexts[done:hi])
+        done = hi
     dt = time.perf_counter() - t0
     tokens = done / pairs_per_token
     return {"tokens_per_sec": tokens / dt, "tokens": tokens,

@@ -77,6 +77,22 @@ class VocabCache:
         return np.array([w.count for w in self._index], np.int64)
 
     @staticmethod
+    def from_ordered(words: Iterable[str],
+                     counts: Optional[Iterable[int]] = None) -> "VocabCache":
+        """Build a finished vocab whose indices follow ``words`` order
+        verbatim (serializer restore path: syn0 row order IS the index
+        order, regardless of frequency — re-sorting on counts would
+        detach every word from its vector row)."""
+        vc = VocabCache()
+        words = list(words)
+        counts = [1] * len(words) if counts is None else list(counts)
+        for i, (w, c) in enumerate(zip(words, counts)):
+            vw = VocabWord(w, int(c), index=i)
+            vc._words[w] = vw
+            vc._index.append(vw)
+        return vc
+
+    @staticmethod
     def build_from_sentences(token_lists: Iterable[List[str]],
                              min_word_frequency: int = 1) -> "VocabCache":
         vc = VocabCache(min_word_frequency)

@@ -267,8 +267,10 @@ class PrefetchingSentenceIterator(SentenceIterator):
                 # end-of-stream instead of blocking forever. The worker
                 # may have enqueued its final items (incl. _END) in the
                 # gap between our timeout and this liveness check, so
-                # drain non-blocking before declaring EOS.
-                if self._done or not self._thread.is_alive():
+                # drain non-blocking before declaring EOS. Snapshot the
+                # thread: a concurrent close() nulls self._thread.
+                th = self._thread
+                if self._done or th is None or not th.is_alive():
                     try:
                         item = self._queue.get_nowait()
                         break

@@ -1,0 +1,210 @@
+"""Round-trip tests for the reference-layout word-vector interchange
+formats (WordVectorSerializer.java :493/:605/:891/:964/:1081/:1606)."""
+
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.embeddings import serializer as ser
+from deeplearning4j_tpu.models.glove.glove import Glove
+from deeplearning4j_tpu.models.paragraphvectors.paragraphvectors import (
+    ParagraphVectors)
+from deeplearning4j_tpu.models.word2vec.word2vec import Word2Vec
+
+CORPUS = [["king", "queen", "royal", "palace"],
+          ["cat", "dog", "pet", "animal"],
+          ["king", "palace", "throne"],
+          ["dog", "animal", "bark"],
+          ["queen", "royal", "throne"]] * 4
+
+DOCS = [("the king sat in the palace", ["royalty"]),
+        ("the dog and the cat are pets", ["pets"]),
+        ("the queen rules from the throne", ["royalty"]),
+        ("the animal barked at the dog", ["pets"])] * 2
+
+
+def _tiny_w2v(use_hs=False):
+    m = Word2Vec(layer_size=16, window_size=2, epochs=1, negative_sample=3,
+                 use_hierarchic_softmax=use_hs, batch_size=64, seed=7,
+                 device_pairgen=False)
+    m.fit(CORPUS)
+    return m
+
+
+def test_b64_helpers_match_reference_layout():
+    assert ser.encode_b64("day") == "B64:ZGF5"  # fixed fixture
+    assert ser.decode_b64("B64:ZGF5") == "day"
+    assert ser.decode_b64("plain") == "plain"   # pass-through
+    word = "white space & ünïcode"
+    assert ser.decode_b64(ser.encode_b64(word)) == word
+
+
+def test_word2vec_model_zip_round_trip():
+    m = _tiny_w2v()
+    path = "/tmp/w2v_full_model.zip"
+    ser.write_word2vec_model(m, path)
+    # reference entry set (writeWord2VecModel :493)
+    with zipfile.ZipFile(path) as z:
+        assert {"syn0.txt", "syn1.txt", "codes.txt", "huffman.txt",
+                "frequencies.txt", "config.json"} <= set(z.namelist())
+        # syn0 is the HEADERLESS B64 table format (:380)
+        first = z.read("syn0.txt").decode().splitlines()[0]
+        assert first.startswith("B64:")
+    back = ser.read_word2vec_model(path)
+    assert back.vocab.words() == m.vocab.words()
+    np.testing.assert_allclose(back.lookup_table.syn0,
+                               m.lookup_table.syn0, rtol=1e-6)
+    np.testing.assert_allclose(back.lookup_table.syn1neg,
+                               m.lookup_table.syn1neg, rtol=1e-6)
+    assert (back.words_nearest("king", 3) == m.words_nearest("king", 3))
+    # frequencies restored, not the loadTxt placeholder 1s
+    assert (back.vocab.word_frequencies()
+            == m.vocab.word_frequencies()).all()
+
+
+def test_word2vec_hs_codes_points_survive():
+    m = _tiny_w2v(use_hs=True)
+    path = "/tmp/w2v_hs_model.zip"
+    ser.write_word2vec_model(m, path)
+    back = ser.read_word2vec_model(path)
+    assert back.use_hs
+    np.testing.assert_allclose(back.lookup_table.syn1,
+                               m.lookup_table.syn1, rtol=1e-6)
+    for w in m.vocab._index:
+        b = back.vocab.word_for(w.word)
+        assert list(b.codes or []) == list(w.codes or []), w.word
+        assert list(b.points or []) == list(w.points or []), w.word
+
+
+def test_paragraph_vectors_zip_round_trip():
+    pv = ParagraphVectors(layer_size=16, window_size=2, epochs=1,
+                          negative_sample=3, batch_size=64, seed=7,
+                          device_pairgen=False)
+    pv.fit(DOCS)
+    path = "/tmp/paravec_model.zip"
+    ser.write_paragraph_vectors(pv, path)
+    with zipfile.ZipFile(path) as z:  # :605 adds labels.txt
+        assert "labels.txt" in z.namelist()
+    back = ser.read_paragraph_vectors(path)
+    assert back.labels == pv.labels
+    assert back.vocab.words() == pv.vocab.words()
+    np.testing.assert_allclose(back.doc_vectors, pv.doc_vectors, rtol=1e-6)
+    np.testing.assert_allclose(back.lookup_table.syn0,
+                               pv.lookup_table.syn0, rtol=1e-6)
+    # the restored model answers queries
+    for l in back.labels:
+        assert back.get_label_vector(l).shape == (16,)
+
+
+def test_paragraph_vectors_legacy_text_round_trip():
+    pv = ParagraphVectors(layer_size=8, window_size=2, epochs=1,
+                          negative_sample=2, batch_size=64, seed=7,
+                          device_pairgen=False)
+    pv.fit(DOCS)
+    path = "/tmp/paravec_legacy.txt"
+    ser.write_paragraph_vectors_text(pv, path)
+    with open(path) as f:
+        tags = {ln.split(" ", 1)[0] for ln in f if ln.strip()}
+    assert tags == {"L", "E"}  # :1124 line tags
+    back = ser.read_paragraph_vectors_text(path)
+    assert back.labels == pv.labels
+    assert back.vocab.words() == pv.vocab.words()
+    np.testing.assert_allclose(back.doc_vectors, pv.doc_vectors, rtol=1e-6)
+
+
+def test_glove_round_trip_nearest_neighbors():
+    g = Glove(layer_size=8, window=3, epochs=3, batch_size=256, seed=5)
+    g.fit([" ".join(s) for s in CORPUS])
+    path = "/tmp/glove_vectors.txt"
+    ser.write_glove(g, path)
+    back = ser.read_glove(path)
+    assert back.vocab.words() == g.vocab.words()
+    np.testing.assert_allclose(back.vectors, g.vectors, rtol=1e-6)
+    assert (back.word_vectors().words_nearest("king", 3)
+            == g.word_vectors().words_nearest("king", 3))
+
+
+def test_load_txt_header_autodetect_and_b64():
+    # headered Google-style file loads identically to headerless (:1606)
+    rows = [("alpha", [0.1, 0.2, 0.3, 0.4]), ("two words", [1.0, 2.0, 3.0, 4.0])]
+    headerless, headered = "/tmp/lt_nohdr.txt", "/tmp/lt_hdr.txt"
+    with open(headerless, "w") as f:
+        for w, v in rows:
+            f.write(ser.encode_b64(w) + " " + " ".join(map(str, v)) + "\n")
+    with open(headered, "w") as f:
+        f.write("2 4\n")
+        for w, v in rows:
+            f.write(ser.encode_b64(w) + " " + " ".join(map(str, v)) + "\n")
+    for p in (headerless, headered):
+        words, vecs = ser.load_txt(p)
+        assert words == ["alpha", "two words"], p
+        np.testing.assert_allclose(vecs, [r[1] for r in rows])
+
+
+def test_read_word2vec_from_text_four_files():
+    m = _tiny_w2v(use_hs=True)
+    base = "/tmp/w2v_hs_text"
+    paths = [f"{base}_{k}.txt" for k in ("syn0", "syn1", "codes", "points")]
+    with open(paths[0], "w") as f:
+        ser._write_table_text(m.vocab.words(), m.lookup_table.syn0, f)
+    with open(paths[1], "w") as f:
+        for row in m.lookup_table.syn1:
+            f.write(" ".join(repr(float(x)) for x in row) + "\n")
+    with open(paths[2], "w") as f:
+        f.write(ser._codes_lines(m.vocab))
+    with open(paths[3], "w") as f:
+        f.write(ser._points_lines(m.vocab))
+    back = ser.read_word2vec_from_text(*paths, config={"window": 2})
+    assert back.use_hs and back.vocab.words() == m.vocab.words()
+    np.testing.assert_allclose(back.lookup_table.syn0,
+                               m.lookup_table.syn0, rtol=1e-6)
+    np.testing.assert_allclose(back.lookup_table.syn1,
+                               m.lookup_table.syn1, rtol=1e-6)
+    for w in m.vocab._index:
+        b = back.vocab.word_for(w.word)
+        assert list(b.codes or []) == list(w.codes or [])
+        assert list(b.points or []) == list(w.points or [])
+
+
+def test_unicode_and_space_words_cross_the_boundary():
+    m = Word2Vec(layer_size=8, window_size=2, epochs=1, negative_sample=2,
+                 batch_size=32, seed=3, device_pairgen=False)
+    m.fit([["日本語", "naïve", "multi word", "plain"] for _ in range(6)])
+    path = "/tmp/w2v_unicode.zip"
+    ser.write_word2vec_model(m, path)
+    back = ser.read_word2vec_model(path)
+    assert set(back.vocab.words()) == {"日本語", "naïve", "multi word", "plain"}
+
+
+def test_glove_d2_round_trip_no_header_mangle():
+    """Code-review r5: a d<3 table written by our writer must not lose
+    its first row to the reference's header heuristic."""
+    from deeplearning4j_tpu.models.word2vec.vocab import VocabCache
+    from deeplearning4j_tpu.models.glove.glove import Glove
+    g = Glove(layer_size=2)
+    g.vocab = VocabCache.from_ordered(["first", "second"])
+    g.vectors = np.asarray([[0.1, 0.2], [0.3, 0.4]], np.float32)
+    ser.write_glove(g, "/tmp/glove_d2.txt")
+    back = ser.read_glove("/tmp/glove_d2.txt")
+    assert back.vocab.words() == ["first", "second"]
+    np.testing.assert_allclose(back.vectors, g.vectors)
+
+
+def test_paragraph_vectors_hs_zip_round_trip_consistent():
+    """Code-review r5: an HS PV zip restores with use_hs set and both
+    tables populated, and re-serializes without crashing."""
+    pv = ParagraphVectors(layer_size=8, window_size=2, epochs=1,
+                          negative_sample=0, batch_size=64, seed=7,
+                          device_pairgen=False)
+    pv.use_hs = True
+    pv.fit(DOCS)
+    ser.write_paragraph_vectors(pv, "/tmp/paravec_hs.zip")
+    back = ser.read_paragraph_vectors("/tmp/paravec_hs.zip")
+    assert back.use_hs
+    assert back.lookup_table.syn1 is not None
+    assert back.lookup_table.syn1neg is not None
+    ser.write_paragraph_vectors(back, "/tmp/paravec_hs2.zip")  # round 2
+    again = ser.read_paragraph_vectors("/tmp/paravec_hs2.zip")
+    np.testing.assert_allclose(again.lookup_table.syn1,
+                               back.lookup_table.syn1, rtol=1e-6)
