@@ -196,12 +196,28 @@ def load_txt(path: str):
             has_header = True
     if has_header:
         lines = lines[1:]
+    return _parse_table_lines(lines)
+
+
+def _parse_table_lines(lines):
+    """'B64:word v1 v2 …' lines → (words, [N,d]) with B64 decoding and
+    legacy whitespace-token restoration (shared by load_txt and the zip
+    syn0 reader so the two entry paths cannot drift)."""
     words, rows = [], []
     for ln in lines:
+        if not ln.strip():
+            continue
         parts = ln.split(" ")
         words.append(decode_b64(parts[0]).replace(WHITESPACE_REPLACEMENT, " "))
         rows.append(np.asarray([float(x) for x in parts[1:]], np.float32))
     return words, np.vstack(rows) if rows else np.zeros((0, 0), np.float32)
+
+
+def _parse_matrix_lines(lines):
+    """Bare 'v1 v2 …' rows (syn1.txt layout) → [N,d] float32."""
+    return np.vstack([
+        np.asarray([float(x) for x in ln.split(" ")], np.float32)
+        for ln in lines if ln.strip()])
 
 
 def _codes_lines(vocab) -> str:
@@ -322,17 +338,8 @@ def _read_word2vec_zip(path: str):
                          for ln in _read_zip_text(z, "labels.txt").splitlines()
                          if ln.strip()]
 
-    words, rows = [], []
-    for ln in syn0_txt.splitlines():
-        if not ln.strip():
-            continue
-        parts = ln.split(" ")
-        words.append(decode_b64(parts[0]))
-        rows.append(np.asarray([float(x) for x in parts[1:]], np.float32))
-    syn0 = np.vstack(rows)
-    syn1 = np.vstack([
-        np.asarray([float(x) for x in ln.split(" ")], np.float32)
-        for ln in syn1_txt.splitlines() if ln.strip()])
+    words, syn0 = _parse_table_lines(syn0_txt.splitlines())
+    syn1 = _parse_matrix_lines(syn1_txt.splitlines())
 
     use_hs = bool(cfg.get("useHierarchicSoftmax", False))
     negative = int(float(cfg.get("negative", 0)))
@@ -532,9 +539,7 @@ def read_word2vec_from_text(vectors_path: str, hs_path: str,
     config = config or {}
     words, syn0 = load_txt(vectors_path)
     with open(hs_path, "r", encoding="utf-8") as f:
-        syn1 = np.vstack([
-            np.asarray([float(x) for x in ln.split(" ")], np.float32)
-            for ln in f if ln.strip()])
+        syn1 = _parse_matrix_lines(f.read().splitlines())
     with open(codes_path, "r", encoding="utf-8") as f:
         codes = _parse_tagged_int_lines(f.read())
     with open(points_path, "r", encoding="utf-8") as f:

@@ -82,13 +82,19 @@ class VocabCache:
         """Build a finished vocab whose indices follow ``words`` order
         verbatim (serializer restore path: syn0 row order IS the index
         order, regardless of frequency — re-sorting on counts would
-        detach every word from its vector row)."""
+        detach every word from its vector row).
+
+        Duplicate surfaces keep every row in ``_index`` (row-aligned
+        with the vector table) but name lookups resolve to the FIRST
+        occurrence — in the PV zip layout words precede appended label
+        rows, so ``index_of`` answers with the word vector, not the
+        doc vector."""
         vc = VocabCache()
         words = list(words)
         counts = [1] * len(words) if counts is None else list(counts)
         for i, (w, c) in enumerate(zip(words, counts)):
             vw = VocabWord(w, int(c), index=i)
-            vc._words[w] = vw
+            vc._words.setdefault(w, vw)
             vc._index.append(vw)
         return vc
 

@@ -157,6 +157,30 @@ class LatticeDictionary:
     def connection(self, left_pos: str, right_pos: str) -> float:
         return self.connections.get((left_pos, right_pos), 0.0)
 
+    # -- lattice-construction hooks (overridden by IPADICDictionary) --
+
+    #: tag the DP starts from / ends on; base dictionaries key the
+    #: connection map by POS strings, so plain markers suffice
+    bos_tag = "BOS"
+    eos_tag = "EOS"
+
+    def unknown_tag(self, char_class: str) -> str:
+        """DP state tag for an unknown token of ``char_class``."""
+        return "UNK"
+
+    def unknown_cost(self, char_class: str, length: int) -> float:
+        """Cost of an unknown token: per-char class cost × length."""
+        return _UNKNOWN_CLASS_COST.get(char_class,
+                                       _UNKNOWN_CHAR_COST) * length
+
+    def unknown_invoke(self, char_class: str) -> bool:
+        """char.def INVOKE semantics: True = always propose unknown
+        nodes for this class; False = only where no dictionary word
+        starts (MeCab's mechanism that stops cheap unknown runs from
+        swallowing text the dictionary covers). Base dictionaries keep
+        the always-propose behavior."""
+        return True
+
     @staticmethod
     def japanese() -> "LatticeDictionary":
         """Bundled demo Japanese dictionary + connection matrix."""
@@ -196,7 +220,7 @@ def viterbi_segment(text: str, dictionary: LatticeDictionary
     # best[pos_index][pos_tag] = (cost, (prev_s, prev_tag, known))
     best: List[Dict[str, float]] = [{} for _ in range(n + 1)]
     back: List[Dict[str, Tuple[int, str, bool]]] = [{} for _ in range(n + 1)]
-    best[0]["BOS"] = 0.0
+    best[0][dictionary.bos_tag] = 0.0
     entries, max_len = dictionary.entries, dictionary.max_len
     conn = dictionary.connection
 
@@ -213,22 +237,32 @@ def viterbi_segment(text: str, dictionary: LatticeDictionary
             continue
         # dictionary nodes FIRST: strict-< relaxation then lets a known
         # word keep an exact cost tie against the unknown reading
+        dict_word_starts = False
         for e in range(s + 1, min(n, s + max_len) + 1):
             for cost, pos in entries.get(text[s:e], ()):
                 relax(s, e, pos, cost, True)
-        # unknown nodes: every prefix of the same-class run starting at s
+                dict_word_starts = True
+        # unknown nodes: every prefix of the same-class run starting at
+        # s — skipped where a dictionary word starts unless the class's
+        # INVOKE flag says always-propose (char.def semantics)
         cls = _char_class(text[s])
-        per_char = _UNKNOWN_CLASS_COST.get(cls, _UNKNOWN_CHAR_COST)
+        if dict_word_starts and not dictionary.unknown_invoke(cls):
+            continue
+        unk_tag = dictionary.unknown_tag(cls)
         run_end = s + 1
         while (run_end < n and run_end - s < _MAX_UNKNOWN_LEN
                and _char_class(text[run_end]) == cls):
             run_end += 1
         for e in range(s + 1, run_end + 1):
-            relax(s, e, "UNK", per_char * (e - s), False)
+            relax(s, e, unk_tag, dictionary.unknown_cost(cls, e - s), False)
 
     out: List[Tuple[str, bool]] = []
-    # on an exact cost tie, prefer ending on a KNOWN reading over UNK
-    pos_tag = min(best[n], key=lambda t: (best[n][t], t == "UNK"))
+    # final edge pays the EOS connection (unlisted pairs cost 0, so the
+    # demo dictionaries are unaffected); on an exact cost tie, prefer
+    # ending on a KNOWN reading over an unknown
+    pos_tag = min(best[n], key=lambda t: (best[n][t]
+                                          + conn(t, dictionary.eos_tag),
+                                          not back[n][t][2]))
     pos = n
     while pos > 0:
         s, prev_tag, known = back[pos][pos_tag]
@@ -305,3 +339,208 @@ class KoreanTokenizerFactory(LatticeTokenizerFactory):
 
 register_tokenizer_factory("japanese", JapaneseTokenizerFactory)
 register_tokenizer_factory("korean", KoreanTokenizerFactory)
+
+
+# ------------------------------------------- MeCab-IPADIC dictionaries
+
+#: IPADIC char.def category names → this module's character classes
+#: (``_char_class`` already implements the classing the char.def ranges
+#: encode, so the loader only needs the category-name bridge)
+_IPADIC_CATEGORY_MAP = {
+    "DEFAULT": "OTHER", "SPACE": "OTHER", "SYMBOL": "OTHER",
+    "GREEK": "OTHER", "CYRILLIC": "OTHER",
+    "NUMERIC": "DIGIT", "ALPHA": "LATIN",
+    "HIRAGANA": "HIRAGANA", "KATAKANA": "KATAKANA",
+    "KANJI": "KANJI", "KANJINUMERIC": "KANJI",
+    "HANGUL": "HANGUL",
+}
+
+
+class IPADICDictionary(LatticeDictionary):
+    """Lattice dictionary over the standard MeCab-IPADIC distribution
+    format (the data the reference vendors pre-compiled inside Kuromoji
+    — ``com/atilika/kuromoji/viterbi/ViterbiBuilder.java`` +
+    ``TokenInfoDictionary``/``ConnectionCosts``/``UnknownDictionary``).
+
+    IPADIC connections are keyed by numeric context ids, not POS
+    strings: each entry carries (left_id, right_id) and the cost of the
+    edge A→B is ``matrix[right_id(A), left_id(B)]``. The DP state tag
+    encodes the ids as ``"left:right:pos1"`` so the base Viterbi needs
+    no changes — ``connection`` parses the ids back out.
+    """
+
+    bos_tag = "0:0:BOS"  # MeCab convention: context id 0 is BOS/EOS
+    eos_tag = "0:0:EOS"
+
+    #: stock char.def INVOKE flags mapped onto this module's classes:
+    #: 1 = always propose unknowns (loanword katakana, digits, latin,
+    #: hangul, symbols), 0 = only off-dictionary (kanji, hiragana —
+    #: IPADIC covers those scripts, so cheap unknown runs must not
+    #: undercut dictionary paths)
+    _DEFAULT_INVOKE = {
+        "KANJI": False, "HIRAGANA": False,
+        "KATAKANA": True, "DIGIT": True, "LATIN": True,
+        "HANGUL": True, "OTHER": True,
+    }
+
+    def __init__(self):
+        super().__init__()
+        self.matrix = None  # [left_size, right_size] connection costs
+        #: char class → (tag, word_cost) from unk.def
+        self.unknowns: Dict[str, Tuple[str, float]] = {}
+        self.invoke: Dict[str, bool] = dict(self._DEFAULT_INVOKE)
+
+    @staticmethod
+    def tag(left_id: int, right_id: int, pos1: str = "*") -> str:
+        return f"{left_id}:{right_id}:{pos1}"
+
+    def connection(self, left_tag: str, right_tag: str) -> float:
+        if self.matrix is None:
+            return 0.0
+        try:
+            right_of_left = int(left_tag.split(":", 2)[1])
+            left_of_right = int(right_tag.split(":", 1)[0])
+        except (ValueError, IndexError):
+            return 0.0  # foreign tag (mixed dictionaries): no edge cost
+        m = self.matrix
+        if right_of_left >= m.shape[0] or left_of_right >= m.shape[1]:
+            return 0.0
+        return float(m[right_of_left, left_of_right])
+
+    def unknown_tag(self, char_class: str) -> str:
+        hit = (self.unknowns.get(char_class)
+               or self.unknowns.get("OTHER"))  # DEFAULT category
+        return hit[0] if hit else "0:0:UNK"
+
+    def unknown_cost(self, char_class: str, length: int) -> float:
+        """unk.def word cost for the whole token (Kuromoji semantics —
+        NOT per character; the connection matrix prices the joins), plus
+        a small per-extra-char term so pathological long runs still
+        prefer dictionary words. Classes without an unk.def row fall
+        back to the DEFAULT category's cost — the demo per-char costs
+        live on a ~1000× smaller scale than IPADIC word costs and would
+        undercut every dictionary path."""
+        hit = (self.unknowns.get(char_class)
+               or self.unknowns.get("OTHER"))
+        if hit is None:
+            return _UNKNOWN_CLASS_COST.get(char_class,
+                                           _UNKNOWN_CHAR_COST) * length
+        return hit[1] + 50.0 * (length - 1)
+
+    def unknown_invoke(self, char_class: str) -> bool:
+        return self.invoke.get(char_class, True)
+
+    # -- loading ------------------------------------------------------
+
+    def load_entries_csv(self, path: str, encoding: str) -> "IPADICDictionary":
+        """One IPADIC CSV: ``surface,left_id,right_id,cost,pos1,…``
+        (the full 13-column layout; only the first five matter for the
+        lattice)."""
+        import csv
+        with open(path, encoding=encoding, newline="") as f:
+            for row in csv.reader(f):
+                if len(row) < 4 or not row[0]:
+                    continue
+                pos1 = row[4] if len(row) > 4 else "*"
+                self._add(row[0], float(row[3]),
+                          self.tag(int(row[1]), int(row[2]), pos1))
+        return self
+
+    def load_matrix_def(self, path: str, encoding: str) -> "IPADICDictionary":
+        """matrix.def: header ``left_size right_size`` then
+        ``left right cost`` triples."""
+        import numpy as np
+        with open(path, encoding=encoding) as f:
+            first = f.readline().split()
+            L, R = int(first[0]), int(first[1])
+            self.matrix = np.zeros((L, R), np.float32)
+            for line in f:
+                parts = line.split()
+                if len(parts) == 3:
+                    self.matrix[int(parts[0]), int(parts[1])] = float(parts[2])
+        return self
+
+    def load_unk_def(self, path: str, encoding: str) -> "IPADICDictionary":
+        """unk.def: IPADIC-CSV rows keyed by char.def category names;
+        the cheapest row per category wins (multiple rows are multiple
+        POS readings — one DP state is enough for segmentation)."""
+        import csv
+        with open(path, encoding=encoding, newline="") as f:
+            for row in csv.reader(f):
+                if len(row) < 4:
+                    continue
+                cls = _IPADIC_CATEGORY_MAP.get(row[0])
+                if cls is None:
+                    continue
+                cost = float(row[3])
+                pos1 = row[4] if len(row) > 4 else "UNK"
+                cur = self.unknowns.get(cls)
+                if cur is None or cost < cur[1]:
+                    self.unknowns[cls] = (
+                        self.tag(int(row[1]), int(row[2]), pos1), cost)
+        return self
+
+    def load_char_def(self, path: str, encoding: str) -> "IPADICDictionary":
+        """char.def category lines: ``CATEGORY invoke group length`` —
+        only the INVOKE flag matters here (``_char_class`` already
+        encodes the code-point ranges; grouping/length behavior is the
+        run logic in ``viterbi_segment``)."""
+        with open(path, encoding=encoding) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                parts = line.split()
+                # category definition, not a 0x.... range mapping line
+                if len(parts) >= 4 and not parts[0].startswith("0x"):
+                    cls = _IPADIC_CATEGORY_MAP.get(parts[0])
+                    if cls is not None and parts[1] in ("0", "1"):
+                        self.invoke[cls] = parts[1] == "1"
+        return self
+
+
+def _detect_ipadic_encoding(csv_path: str) -> str:
+    """Stock IPADIC downloads are EUC-JP; re-encoded copies are UTF-8.
+    Decode a sample with each and keep the one that succeeds."""
+    import codecs
+    with open(csv_path, "rb") as f:
+        sample = f.read(65536)
+    for enc in ("utf-8", "euc_jp"):
+        try:
+            # incremental decode with final=False: a multibyte char cut
+            # at the 64KB boundary must not disqualify the encoding
+            codecs.getincrementaldecoder(enc)().decode(sample, False)
+            return enc
+        except UnicodeDecodeError:
+            continue
+    raise ValueError(
+        f"{csv_path}: neither UTF-8 nor EUC-JP — pass encoding= explicitly")
+
+
+def load_ipadic(directory: str,
+                encoding: Optional[str] = None) -> IPADICDictionary:
+    """Load a stock MeCab-IPADIC directory: every ``*.csv`` entry file
+    plus ``matrix.def`` and (if present) ``unk.def``. ``char.def`` is
+    not needed — ``_char_class`` covers the category ranges.
+
+    Usage::
+
+        d = load_ipadic("/path/to/mecab-ipadic-2.7.0-20070801")
+        LatticeTokenizerFactory(d).create("すもももももももものうち")
+    """
+    import glob as _glob
+    csvs = sorted(_glob.glob(os.path.join(directory, "*.csv")))
+    if not csvs:
+        raise FileNotFoundError(f"no IPADIC .csv entry files in {directory}")
+    enc = encoding or _detect_ipadic_encoding(csvs[0])
+    d = IPADICDictionary()
+    for p in csvs:
+        d.load_entries_csv(p, enc)
+    matrix = os.path.join(directory, "matrix.def")
+    if os.path.exists(matrix):
+        d.load_matrix_def(matrix, enc)
+    unk = os.path.join(directory, "unk.def")
+    if os.path.exists(unk):
+        d.load_unk_def(unk, enc)
+    chardef = os.path.join(directory, "char.def")
+    if os.path.exists(chardef):
+        d.load_char_def(chardef, enc)
+    return d

@@ -125,3 +125,89 @@ def test_hangul_jamo_blocks_class_as_hangul():
                "ꥠ", "ힰ",             # extended A / B
                "가", "ᄀ"):            # syllables / classic jamo
         assert _char_class(ch) == "HANGUL", hex(ord(ch))
+
+
+# ------------------------------------------- MeCab-IPADIC loader (r5)
+
+def _write_ipadic(dirpath, encoding="utf-8", n_filler=10000):
+    """Generate a synthetic dictionary in the stock MeCab-IPADIC layout:
+    multiple *.csv entry files, matrix.def, unk.def. Context ids:
+    0=BOS/EOS, 1=noun, 2=particle, 5=unknown-katakana."""
+    import os
+    import random
+    os.makedirs(dirpath, exist_ok=True)
+    nouns = [("すもも", 4000), ("もも", 4500), ("うち", 4500),
+             ("東京", 3000), ("京都", 3000), ("東京都", 2500), ("都", 3500)]
+    particles = [("も", 5000), ("の", 4000), ("に", 4000)]
+
+    def row(surface, l, r, cost, pos):
+        return f"{surface},{l},{r},{cost},{pos},*,*,*,*,*,{surface},*,*\n"
+
+    with open(os.path.join(dirpath, "Noun.csv"), "w", encoding=encoding) as f:
+        for w, c in nouns:
+            f.write(row(w, 1, 1, c, "名詞"))
+        rng = random.Random(42)
+        kanji_pool = [chr(0x4E00 + i) for i in range(500)]
+        for _ in range(n_filler):  # ≥10k generated compounds
+            w = "".join(rng.choices(kanji_pool, k=rng.randint(2, 3)))
+            f.write(row(w, 1, 1, rng.randint(3000, 9000), "名詞"))
+    with open(os.path.join(dirpath, "Particle.csv"), "w",
+              encoding=encoding) as f:
+        for w, c in particles:
+            f.write(row(w, 2, 2, c, "助詞"))
+    with open(os.path.join(dirpath, "matrix.def"), "w",
+              encoding=encoding) as f:
+        f.write("6 6\n")
+        costs = {(0, 1): -500, (0, 2): 3000, (1, 0): -500, (2, 0): 500,
+                 (1, 1): 1000, (1, 2): -3000, (2, 1): -3000, (2, 2): 2000,
+                 (5, 0): 0, (0, 5): 0, (5, 1): 0, (1, 5): 0,
+                 (5, 2): -1000, (2, 5): 0}
+        for (a, b), c in costs.items():
+            f.write(f"{a} {b} {c}\n")
+    with open(os.path.join(dirpath, "unk.def"), "w", encoding=encoding) as f:
+        f.write("DEFAULT,0,0,6000,記号,*,*,*,*,*,*,*,*\n")
+        f.write("KATAKANA,5,5,3000,名詞,*,*,*,*,*,*,*,*\n")
+        f.write("KATAKANA,5,5,9000,感動詞,*,*,*,*,*,*,*,*\n")  # min wins
+
+
+def test_ipadic_loader_golden_segmentations(tmp_path):
+    from deeplearning4j_tpu.text.lattice import load_ipadic, viterbi_segment
+    d = _write_ipadic(tmp_path / "ipadic") or load_ipadic(
+        str(tmp_path / "ipadic"))
+    assert len(d.entries) >= 5000  # 10k generated rows (some collide)
+    assert d.matrix is not None and d.matrix.shape == (6, 6)
+    # the classic lattice sentence
+    toks = [t for t, _ in viterbi_segment("すもももももももものうち", d)]
+    assert toks == ["すもも", "も", "もも", "も", "もも", "の", "うち"], toks
+    # longest-match via cost, not greed: 東京都 beats 東京+都
+    toks = [t for t, _ in viterbi_segment("東京都に", d)]
+    assert toks == ["東京都", "に"], toks
+
+
+def test_ipadic_unknowns_use_unk_def(tmp_path):
+    from deeplearning4j_tpu.text.lattice import load_ipadic, viterbi_segment
+    _write_ipadic(tmp_path / "ipadic", n_filler=0)
+    d = load_ipadic(str(tmp_path / "ipadic"))
+    assert d.unknowns["KATAKANA"][1] == 3000.0  # cheapest row won
+    # unknown katakana run stays ONE token and connects like a noun
+    seg = viterbi_segment("パソコンのうち", d)
+    assert [t for t, _ in seg] == ["パソコン", "の", "うち"], seg
+    assert seg[0][1] is False  # marked unknown
+
+
+def test_ipadic_eucjp_autodetection(tmp_path):
+    from deeplearning4j_tpu.text.lattice import load_ipadic, viterbi_segment
+    _write_ipadic(tmp_path / "euc", encoding="euc_jp", n_filler=0)
+    d = load_ipadic(str(tmp_path / "euc"))  # no encoding= passed
+    toks = [t for t, _ in viterbi_segment("すもももももも", d)]
+    assert toks == ["すもも", "も", "もも", "も"], toks
+
+
+def test_ipadic_tokenizer_factory_integration(tmp_path):
+    from deeplearning4j_tpu.text.lattice import (
+        LatticeTokenizerFactory, load_ipadic)
+    _write_ipadic(tmp_path / "ipadic", n_filler=0)
+    d = load_ipadic(str(tmp_path / "ipadic"))
+    toks = LatticeTokenizerFactory(d).create(
+        "東京都の うち").get_tokens()
+    assert toks == ["東京都", "の", "うち"], toks

@@ -40,9 +40,9 @@ def test_b64_helpers_match_reference_layout():
     assert ser.decode_b64(ser.encode_b64(word)) == word
 
 
-def test_word2vec_model_zip_round_trip():
+def test_word2vec_model_zip_round_trip(tmp_path):
     m = _tiny_w2v()
-    path = "/tmp/w2v_full_model.zip"
+    path = str(tmp_path / "w2v_full_model.zip")
     ser.write_word2vec_model(m, path)
     # reference entry set (writeWord2VecModel :493)
     with zipfile.ZipFile(path) as z:
@@ -63,9 +63,9 @@ def test_word2vec_model_zip_round_trip():
             == m.vocab.word_frequencies()).all()
 
 
-def test_word2vec_hs_codes_points_survive():
+def test_word2vec_hs_codes_points_survive(tmp_path):
     m = _tiny_w2v(use_hs=True)
-    path = "/tmp/w2v_hs_model.zip"
+    path = str(tmp_path / "w2v_hs_model.zip")
     ser.write_word2vec_model(m, path)
     back = ser.read_word2vec_model(path)
     assert back.use_hs
@@ -77,12 +77,12 @@ def test_word2vec_hs_codes_points_survive():
         assert list(b.points or []) == list(w.points or []), w.word
 
 
-def test_paragraph_vectors_zip_round_trip():
+def test_paragraph_vectors_zip_round_trip(tmp_path):
     pv = ParagraphVectors(layer_size=16, window_size=2, epochs=1,
                           negative_sample=3, batch_size=64, seed=7,
                           device_pairgen=False)
     pv.fit(DOCS)
-    path = "/tmp/paravec_model.zip"
+    path = str(tmp_path / "paravec_model.zip")
     ser.write_paragraph_vectors(pv, path)
     with zipfile.ZipFile(path) as z:  # :605 adds labels.txt
         assert "labels.txt" in z.namelist()
@@ -97,12 +97,12 @@ def test_paragraph_vectors_zip_round_trip():
         assert back.get_label_vector(l).shape == (16,)
 
 
-def test_paragraph_vectors_legacy_text_round_trip():
+def test_paragraph_vectors_legacy_text_round_trip(tmp_path):
     pv = ParagraphVectors(layer_size=8, window_size=2, epochs=1,
                           negative_sample=2, batch_size=64, seed=7,
                           device_pairgen=False)
     pv.fit(DOCS)
-    path = "/tmp/paravec_legacy.txt"
+    path = str(tmp_path / "paravec_legacy.txt")
     ser.write_paragraph_vectors_text(pv, path)
     with open(path) as f:
         tags = {ln.split(" ", 1)[0] for ln in f if ln.strip()}
@@ -113,10 +113,10 @@ def test_paragraph_vectors_legacy_text_round_trip():
     np.testing.assert_allclose(back.doc_vectors, pv.doc_vectors, rtol=1e-6)
 
 
-def test_glove_round_trip_nearest_neighbors():
+def test_glove_round_trip_nearest_neighbors(tmp_path):
     g = Glove(layer_size=8, window=3, epochs=3, batch_size=256, seed=5)
     g.fit([" ".join(s) for s in CORPUS])
-    path = "/tmp/glove_vectors.txt"
+    path = str(tmp_path / "glove_vectors.txt")
     ser.write_glove(g, path)
     back = ser.read_glove(path)
     assert back.vocab.words() == g.vocab.words()
@@ -125,10 +125,10 @@ def test_glove_round_trip_nearest_neighbors():
             == g.word_vectors().words_nearest("king", 3))
 
 
-def test_load_txt_header_autodetect_and_b64():
+def test_load_txt_header_autodetect_and_b64(tmp_path):
     # headered Google-style file loads identically to headerless (:1606)
     rows = [("alpha", [0.1, 0.2, 0.3, 0.4]), ("two words", [1.0, 2.0, 3.0, 4.0])]
-    headerless, headered = "/tmp/lt_nohdr.txt", "/tmp/lt_hdr.txt"
+    headerless, headered = str(tmp_path / "lt_nohdr.txt"), str(tmp_path / "lt_hdr.txt")
     with open(headerless, "w") as f:
         for w, v in rows:
             f.write(ser.encode_b64(w) + " " + " ".join(map(str, v)) + "\n")
@@ -142,9 +142,9 @@ def test_load_txt_header_autodetect_and_b64():
         np.testing.assert_allclose(vecs, [r[1] for r in rows])
 
 
-def test_read_word2vec_from_text_four_files():
+def test_read_word2vec_from_text_four_files(tmp_path):
     m = _tiny_w2v(use_hs=True)
-    base = "/tmp/w2v_hs_text"
+    base = str(tmp_path / "w2v_hs_text")
     paths = [f"{base}_{k}.txt" for k in ("syn0", "syn1", "codes", "points")]
     with open(paths[0], "w") as f:
         ser._write_table_text(m.vocab.words(), m.lookup_table.syn0, f)
@@ -167,17 +167,17 @@ def test_read_word2vec_from_text_four_files():
         assert list(b.points or []) == list(w.points or [])
 
 
-def test_unicode_and_space_words_cross_the_boundary():
+def test_unicode_and_space_words_cross_the_boundary(tmp_path):
     m = Word2Vec(layer_size=8, window_size=2, epochs=1, negative_sample=2,
                  batch_size=32, seed=3, device_pairgen=False)
     m.fit([["日本語", "naïve", "multi word", "plain"] for _ in range(6)])
-    path = "/tmp/w2v_unicode.zip"
+    path = str(tmp_path / "w2v_unicode.zip")
     ser.write_word2vec_model(m, path)
     back = ser.read_word2vec_model(path)
     assert set(back.vocab.words()) == {"日本語", "naïve", "multi word", "plain"}
 
 
-def test_glove_d2_round_trip_no_header_mangle():
+def test_glove_d2_round_trip_no_header_mangle(tmp_path):
     """Code-review r5: a d<3 table written by our writer must not lose
     its first row to the reference's header heuristic."""
     from deeplearning4j_tpu.models.word2vec.vocab import VocabCache
@@ -185,13 +185,13 @@ def test_glove_d2_round_trip_no_header_mangle():
     g = Glove(layer_size=2)
     g.vocab = VocabCache.from_ordered(["first", "second"])
     g.vectors = np.asarray([[0.1, 0.2], [0.3, 0.4]], np.float32)
-    ser.write_glove(g, "/tmp/glove_d2.txt")
-    back = ser.read_glove("/tmp/glove_d2.txt")
+    ser.write_glove(g, str(tmp_path / "glove_d2.txt"))
+    back = ser.read_glove(str(tmp_path / "glove_d2.txt"))
     assert back.vocab.words() == ["first", "second"]
     np.testing.assert_allclose(back.vectors, g.vectors)
 
 
-def test_paragraph_vectors_hs_zip_round_trip_consistent():
+def test_paragraph_vectors_hs_zip_round_trip_consistent(tmp_path):
     """Code-review r5: an HS PV zip restores with use_hs set and both
     tables populated, and re-serializes without crashing."""
     pv = ParagraphVectors(layer_size=8, window_size=2, epochs=1,
@@ -199,12 +199,29 @@ def test_paragraph_vectors_hs_zip_round_trip_consistent():
                           device_pairgen=False)
     pv.use_hs = True
     pv.fit(DOCS)
-    ser.write_paragraph_vectors(pv, "/tmp/paravec_hs.zip")
-    back = ser.read_paragraph_vectors("/tmp/paravec_hs.zip")
+    ser.write_paragraph_vectors(pv, str(tmp_path / "paravec_hs.zip"))
+    back = ser.read_paragraph_vectors(str(tmp_path / "paravec_hs.zip"))
     assert back.use_hs
     assert back.lookup_table.syn1 is not None
     assert back.lookup_table.syn1neg is not None
-    ser.write_paragraph_vectors(back, "/tmp/paravec_hs2.zip")  # round 2
-    again = ser.read_paragraph_vectors("/tmp/paravec_hs2.zip")
+    ser.write_paragraph_vectors(back, str(tmp_path / "paravec_hs2.zip"))  # round 2
+    again = ser.read_paragraph_vectors(str(tmp_path / "paravec_hs2.zip"))
     np.testing.assert_allclose(again.lookup_table.syn1,
                                back.lookup_table.syn1, rtol=1e-6)
+
+
+def test_shared_label_word_lookup_prefers_word_row(tmp_path):
+    """Code-review r5: reading a PV zip through read_word2vec_model
+    (a label sharing a corpus word's surface) must resolve name lookups
+    to the WORD row, not the appended doc-vector row."""
+    pv = ParagraphVectors(layer_size=8, window_size=2, epochs=1,
+                          negative_sample=2, batch_size=64, seed=7,
+                          device_pairgen=False)
+    pv.fit([("dog and cat are pets", ["pets"]),
+            ("the pets ran home", ["pets"])] * 3)
+    path = str(tmp_path / "pv_shared.zip")
+    ser.write_paragraph_vectors(pv, path)
+    w2v = ser.read_word2vec_model(path)  # flat view over the same zip
+    i = pv.vocab.index_of("pets")
+    np.testing.assert_allclose(w2v.get_word_vector("pets"),
+                               pv.lookup_table.syn0[i], rtol=1e-6)
