@@ -200,15 +200,21 @@ def load_txt(path: str):
 
 
 def _parse_table_lines(lines):
-    """'B64:word v1 v2 …' lines → (words, [N,d]) with B64 decoding and
-    legacy whitespace-token restoration (shared by load_txt and the zip
-    syn0 reader so the two entry paths cannot drift)."""
+    """'B64:word v1 v2 …' lines → (words, [N,d]) (shared by load_txt
+    and the zip syn0 reader so the two entry paths cannot drift). B64
+    words decode verbatim; the legacy ``_Az92_`` whitespace restoration
+    applies ONLY to plain (non-B64) tokens — a B64-encoded surface that
+    literally contains the sentinel must survive a round trip."""
     words, rows = [], []
     for ln in lines:
         if not ln.strip():
             continue
         parts = ln.split(" ")
-        words.append(decode_b64(parts[0]).replace(WHITESPACE_REPLACEMENT, " "))
+        raw = parts[0]
+        w = decode_b64(raw)
+        if not raw.startswith("B64:"):
+            w = w.replace(WHITESPACE_REPLACEMENT, " ")
+        words.append(w)
         rows.append(np.asarray([float(x) for x in parts[1:]], np.float32))
     return words, np.vstack(rows) if rows else np.zeros((0, 0), np.float32)
 

@@ -173,6 +173,27 @@ class PosAnnotator(Annotator):
         return doc
 
 
+class CallableTagAnnotator(Annotator):
+    """Adapter: plug ANY external tagger — a trained model, a service —
+    into the pipeline as a plain callable ``tokens -> tags`` (or
+    ``tokens -> lemmas`` with ``attr="lemma"``). This is the seam the
+    reference filled with downloaded OpenNLP models behind UIMA
+    AnalysisEngines; a list shorter than the tokens leaves the tail
+    untouched."""
+
+    def __init__(self, fn, attr: str = "pos"):
+        if attr not in ("pos", "lemma"):
+            raise ValueError(f"attr must be 'pos' or 'lemma', got {attr!r}")
+        self._fn = fn
+        self._attr = attr
+
+    def process(self, doc: AnnotatedDocument) -> AnnotatedDocument:
+        tags = self._fn([t.text for t in doc.tokens])
+        for t, tag in zip(doc.tokens, tags):
+            setattr(t, self._attr, tag)
+        return doc
+
+
 _IRREGULAR_LEMMAS = {
     "was": "be", "were": "be", "is": "be", "are": "be", "am": "be",
     "been": "be", "being": "be", "has": "have", "had": "have",

@@ -518,8 +518,10 @@ def _detect_ipadic_encoding(csv_path: str) -> str:
 def load_ipadic(directory: str,
                 encoding: Optional[str] = None) -> IPADICDictionary:
     """Load a stock MeCab-IPADIC directory: every ``*.csv`` entry file
-    plus ``matrix.def`` and (if present) ``unk.def``. ``char.def`` is
-    not needed — ``_char_class`` covers the category ranges.
+    plus ``matrix.def``, ``unk.def`` (unknown-word costs; synthesized
+    from the dictionary's cost scale when absent) and ``char.def``
+    (INVOKE flags; stock defaults when absent — the code-point ranges
+    themselves come from ``_char_class``).
 
     Usage::
 
@@ -540,6 +542,15 @@ def load_ipadic(directory: str,
     unk = os.path.join(directory, "unk.def")
     if os.path.exists(unk):
         d.load_unk_def(unk, enc)
+    if not d.unknowns:
+        # no unk.def: synthesize a DEFAULT unknown at the top of the
+        # dictionary's own cost scale — the demo per-char fallback
+        # (~3.5-9/char) would undercut every IPADIC word cost
+        # (thousands) and turn covered katakana/latin/digit text into
+        # always-winning unknowns
+        max_cost = max((c for cps in d.entries.values()
+                        for c, _ in cps), default=6000.0)
+        d.unknowns["OTHER"] = ("0:0:UNK", float(max_cost))
     chardef = os.path.join(directory, "char.def")
     if os.path.exists(chardef):
         d.load_char_def(chardef, enc)

@@ -323,3 +323,108 @@ class LabelAwareListSentenceIterator(LabelAwareIterator):
                 f"{len(labels)} labels for {len(sentences)} sentences")
         labs = labels or [f"doc_{i}" for i in range(len(sentences))]
         super().__init__([(s, [l]) for s, l in zip(sentences, labs)])
+
+
+class SynchronizedSentenceIterator(SentenceIterator):
+    """Thread-safe wrapper over any SentenceIterator — one lock around
+    every SPI method (``SynchronizedSentenceIterator.java:10``), for
+    sharing a single corpus stream between fit workers."""
+
+    def __init__(self, wrapped: SentenceIterator):
+        super().__init__()
+        self._wrapped = wrapped
+        import threading
+        self._lock = threading.Lock()
+
+    def has_next(self) -> bool:
+        with self._lock:
+            return self._wrapped.has_next()
+
+    def next_sentence(self) -> str:
+        with self._lock:
+            return self._wrapped.next_sentence()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._wrapped.reset()
+
+    def set_pre_processor(self, pre: SentencePreProcessor) -> None:
+        with self._lock:
+            self._wrapped.set_pre_processor(pre)
+
+    def close(self) -> None:
+        """Delegated cleanup — wrapping a PrefetchingSentenceIterator
+        must still be able to stop its worker thread."""
+        with self._lock:
+            for name in ("close", "finish"):
+                fn = getattr(self._wrapped, name, None)
+                if fn is not None:
+                    fn()
+                    return
+
+    finish = close  # reference SPI name
+
+
+class BasicResultSetIterator(SentenceIterator):
+    """Sentences from a database query (``BasicResultSetIterator.java:16``
+    — the JDBC ResultSet role, over PEP 249 cursors here).
+
+    DB-API cursors are forward-only, so reset() re-executes: pass a
+    zero-arg ``execute`` callable returning a FRESH cursor (e.g.
+    ``lambda: conn.execute("SELECT text FROM docs")``). ``column``
+    selects by name (via ``cursor.description``) or positional index.
+    Mirrors the reference's peeked-row bookkeeping so ``has_next`` never
+    skips data."""
+
+    def __init__(self, execute: Callable[[], object], column=0,
+                 preprocessor: Optional[SentencePreProcessor] = None):
+        super().__init__(preprocessor)
+        self._execute = execute
+        self._column = column
+        self._cursor = None
+        self._peek = None
+        self._exhausted = False
+
+    def _col_index(self) -> int:
+        if isinstance(self._column, int):
+            return self._column
+        names = [d[0] for d in self._cursor.description]
+        try:
+            return names.index(self._column)
+        except ValueError:
+            raise KeyError(
+                f"column {self._column!r} not in result set {names}")
+
+    def _ensure(self):
+        if self._cursor is None:
+            self._cursor = self._execute()
+            self._peek = None
+            self._exhausted = False
+
+    def has_next(self) -> bool:
+        self._ensure()
+        if self._peek is not None:
+            return True
+        if self._exhausted:
+            return False
+        row = self._cursor.fetchone()
+        if row is None:
+            self._exhausted = True
+            return False
+        self._peek = row
+        return True
+
+    def next_sentence(self) -> str:
+        if not self.has_next():
+            raise StopIteration
+        row, self._peek = self._peek, None
+        return self._apply(str(row[self._col_index()]))
+
+    def reset(self) -> None:
+        close = getattr(self._cursor, "close", None)
+        if close is not None:
+            close()
+        self._cursor = None  # next use re-executes the query
+
+    def finish(self) -> None:
+        self.reset()

@@ -78,3 +78,27 @@ def test_custom_annotator_plugs_in():
                                UpperAnnotator()])
     doc = pipe.annotate("cats ran")
     assert [t.lemma for t in doc.tokens] == ["CAT", "RUN"]
+
+
+def test_callable_tag_annotator_plugs_external_tagger():
+    """The MIGRATION.md seam: any tokens->tags callable slots into the
+    pipeline where the reference required OpenNLP model files."""
+    from deeplearning4j_tpu.text.annotation import (
+        AnnotationPipeline, CallableTagAnnotator, SentenceAnnotator,
+        TokenizerAnnotator)
+
+    def my_model(tokens):
+        return ["TAGGED-" + t.upper() for t in tokens]
+
+    pipe = AnnotationPipeline([SentenceAnnotator(), TokenizerAnnotator(),
+                               CallableTagAnnotator(my_model)])
+    doc = pipe.annotate("dogs run")
+    assert [t.pos for t in doc.tokens] == ["TAGGED-DOGS", "TAGGED-RUN"]
+    pipe2 = AnnotationPipeline([SentenceAnnotator(), TokenizerAnnotator(),
+                                CallableTagAnnotator(lambda ts: ts,
+                                                     attr="lemma")])
+    assert [t.lemma for t in pipe2.annotate("dogs run").tokens] == [
+        "dogs", "run"]
+    import pytest
+    with pytest.raises(ValueError, match="attr"):
+        CallableTagAnnotator(my_model, attr="bogus")

@@ -211,3 +211,19 @@ def test_ipadic_tokenizer_factory_integration(tmp_path):
     toks = LatticeTokenizerFactory(d).create(
         "東京都の うち").get_tokens()
     assert toks == ["東京都", "の", "うち"], toks
+
+
+def test_ipadic_missing_unk_def_synthesizes_cost_scale(tmp_path):
+    """Code-review r5: without unk.def, unknown costs must live on the
+    dictionary's own scale — katakana dictionary words must beat the
+    always-invoked unknown path."""
+    import os
+    from deeplearning4j_tpu.text.lattice import load_ipadic, viterbi_segment
+    _write_ipadic(tmp_path / "ipadic", n_filler=0)
+    os.remove(tmp_path / "ipadic" / "unk.def")
+    with open(tmp_path / "ipadic" / "Noun.csv", "a", encoding="utf-8") as f:
+        f.write("コンピュータ,1,1,3000,名詞,*,*,*,*,*,コンピュータ,*,*\n")
+    d = load_ipadic(str(tmp_path / "ipadic"))
+    assert d.unknowns["OTHER"][1] >= 3000  # synthesized at dict scale
+    seg = viterbi_segment("コンピュータのうち", d)
+    assert seg[0] == ("コンピュータ", True), seg  # dictionary word WON

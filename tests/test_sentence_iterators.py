@@ -103,3 +103,85 @@ def test_prefetching_has_next_after_close_returns_false():
     it.next_sentence()
     it.close()
     assert it.has_next() is False  # must return, not hang
+
+
+def test_synchronized_iterator_parallel_consumers():
+    """SynchronizedSentenceIterator.java:10 — N threads drain one
+    stream; every sentence delivered exactly once."""
+    import threading
+    from deeplearning4j_tpu.text.sentenceiterator import (
+        SynchronizedSentenceIterator)
+
+    n = 5000
+    it = SynchronizedSentenceIterator(
+        CollectionSentenceIterator([f"s{i}" for i in range(n)]))
+    got, lock = [], threading.Lock()
+
+    def drain():
+        while True:
+            with lock:  # has_next+next must still pair atomically at
+                ok = it.has_next()  # the consumer level (ref. contract)
+                s = it.next_sentence() if ok else None
+            if s is None:
+                return
+            got.append(s)
+
+    ts = [threading.Thread(target=drain) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert sorted(got) == sorted(f"s{i}" for i in range(n))
+
+
+def test_basic_result_set_iterator_sqlite():
+    """BasicResultSetIterator.java:16 over a PEP 249 cursor: column by
+    name, peeked-row bookkeeping, reset by re-execute."""
+    import sqlite3
+    from deeplearning4j_tpu.text.sentenceiterator import (
+        BasicResultSetIterator)
+
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE docs (id INTEGER, body TEXT)")
+    conn.executemany("INSERT INTO docs VALUES (?, ?)",
+                     [(i, f"sentence {i}") for i in range(7)])
+    it = BasicResultSetIterator(
+        lambda: conn.execute("SELECT id, body FROM docs ORDER BY id"),
+        column="body")
+    # repeated has_next calls must not skip rows (nextCalled bookkeeping)
+    assert it.has_next() and it.has_next()
+    assert list(it) == [f"sentence {i}" for i in range(7)]
+    assert list(it) == [f"sentence {i}" for i in range(7)]  # reset works
+
+    class Upper:
+        def pre_process(self, s):
+            return s.upper()
+
+    it.set_pre_processor(Upper())
+    it.reset()
+    assert it.next_sentence() == "SENTENCE 0"
+    # positional column + unknown-name diagnostic
+    it2 = BasicResultSetIterator(
+        lambda: conn.execute("SELECT body FROM docs LIMIT 1"), column=0)
+    assert list(it2) == ["sentence 0"]
+    it3 = BasicResultSetIterator(
+        lambda: conn.execute("SELECT body FROM docs"), column="nope")
+    try:
+        it3.next_sentence()
+        raise AssertionError("expected KeyError")
+    except KeyError as e:
+        assert "nope" in str(e)
+
+
+def test_synchronized_close_delegates_to_prefetcher():
+    """Code-review r5: SynchronizedSentenceIterator(Prefetching...)
+    must stop the worker thread on close()."""
+    from deeplearning4j_tpu.text.sentenceiterator import (
+        SynchronizedSentenceIterator)
+
+    inner = PrefetchingSentenceIterator(
+        CollectionSentenceIterator([f"s{i}" for i in range(50000)]),
+        fetch_size=2)
+    it = SynchronizedSentenceIterator(inner)
+    assert it.has_next()
+    it.next_sentence()
+    it.close()
+    assert inner.has_next() is False  # worker stopped, clean EOS

@@ -225,3 +225,15 @@ def test_shared_label_word_lookup_prefers_word_row(tmp_path):
     i = pv.vocab.index_of("pets")
     np.testing.assert_allclose(w2v.get_word_vector("pets"),
                                pv.lookup_table.syn0[i], rtol=1e-6)
+
+
+def test_literal_sentinel_word_survives_zip_round_trip(tmp_path):
+    """Code-review r5: a surface literally containing _Az92_ is B64 on
+    the zip path and must round-trip verbatim."""
+    m = Word2Vec(layer_size=8, window_size=2, epochs=1, negative_sample=2,
+                 batch_size=32, seed=3, device_pairgen=False)
+    m.fit([["weird_Az92_token", "plain", "other"] for _ in range(6)])
+    path = str(tmp_path / "sentinel.zip")
+    ser.write_word2vec_model(m, path)
+    back = ser.read_word2vec_model(path)
+    assert "weird_Az92_token" in back.vocab.words()
