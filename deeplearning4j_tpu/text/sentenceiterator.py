@@ -354,13 +354,16 @@ class SynchronizedSentenceIterator(SentenceIterator):
 
     def close(self) -> None:
         """Delegated cleanup — wrapping a PrefetchingSentenceIterator
-        must still be able to stop its worker thread."""
-        with self._lock:
-            for name in ("close", "finish"):
-                fn = getattr(self._wrapped, name, None)
-                if fn is not None:
-                    fn()
-                    return
+        must still be able to stop its worker thread. Deliberately
+        LOCK-FREE: a consumer may be blocked inside the wrapped
+        iterator's has_next() while holding our lock, and close() is
+        exactly the call that unblocks it (the prefetcher's close() is
+        safe to run concurrently with its readers)."""
+        for name in ("close", "finish"):
+            fn = getattr(self._wrapped, name, None)
+            if fn is not None:
+                fn()
+                return
 
     finish = close  # reference SPI name
 
@@ -384,6 +387,7 @@ class BasicResultSetIterator(SentenceIterator):
         self._cursor = None
         self._peek = None
         self._exhausted = False
+        self._col = None  # resolved once per cursor, not per row
 
     def _col_index(self) -> int:
         if isinstance(self._column, int):
@@ -400,6 +404,7 @@ class BasicResultSetIterator(SentenceIterator):
             self._cursor = self._execute()
             self._peek = None
             self._exhausted = False
+            self._col = self._col_index()
 
     def has_next(self) -> bool:
         self._ensure()
@@ -418,7 +423,7 @@ class BasicResultSetIterator(SentenceIterator):
         if not self.has_next():
             raise StopIteration
         row, self._peek = self._peek, None
-        return self._apply(str(row[self._col_index()]))
+        return self._apply(str(row[self._col]))
 
     def reset(self) -> None:
         close = getattr(self._cursor, "close", None)

@@ -26,6 +26,9 @@ Routes:
   POST /api/reports                       accept one report dict
   GET  /words[?word=w&n=k]                nearest-words view (HTML)
   GET  /api/words/nearest?word=w[&n=k]    {"word": w, "nearest": [...]}
+  GET  /tsne                              2-D embedding scatter (HTML/SVG)
+  GET  /api/tsne                          {"points": [[x,y]..], "labels": [..]}
+  POST /api/tsne                          accept {"points", "labels"} push
 """
 
 from __future__ import annotations
@@ -96,6 +99,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._flow_json()
             if parts == ["activations"]:
                 return self._activations_page()
+            if parts == ["tsne"]:
+                return self._tsne_page()
+            if parts == ["api", "tsne"]:
+                return self._tsne_json()
             return self._json({"error": "not found"}, 404)
         except Exception as e:  # surface handler bugs to the client, not the log
             return self._json({"error": f"{type(e).__name__}: {e}"}, 500)
@@ -103,6 +110,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
+        if parts == ["api", "tsne"]:
+            return self._tsne_post()
         if parts != ["api", "reports"]:
             return self._json({"error": "not found"}, 404)
         try:
@@ -112,6 +121,52 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"ok": True})
         except Exception as e:
             return self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+    # ------------------------------------------------------ /tsne view
+    # (``deeplearning4j-ui-resources/.../ui/tsne/`` dashboard role: the
+    # reference served a d3 scatter over word coordinates; here the page
+    # is one self-contained SVG, data via plot/tsne.py or a POST push)
+
+    def _tsne_post(self):
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            data = json.loads(self.rfile.read(length))
+            pts = [[float(a), float(b)] for a, b in data["points"]]
+            labels = [str(l) for l in data.get("labels") or
+                      [str(i) for i in range(len(pts))]]
+            if len(labels) != len(pts):
+                raise ValueError(
+                    f"{len(labels)} labels for {len(pts)} points")
+            with self.server._tsne_lock:  # type: ignore[attr-defined]
+                self.server._tsne_data = (pts, labels)  # type: ignore
+            return self._json({"ok": True, "n": len(pts)})
+        except Exception as e:
+            return self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+    def _tsne_data(self):
+        with self.server._tsne_lock:  # type: ignore[attr-defined]
+            return self.server._tsne_data  # type: ignore[attr-defined]
+
+    def _tsne_json(self):
+        data = self._tsne_data()
+        if data is None:
+            return self._json({"error": "no t-SNE data attached"}, 404)
+        pts, labels = data
+        return self._json({"points": pts, "labels": labels})
+
+    def _tsne_page(self):
+        data = self._tsne_data()
+        if data is None:
+            return self._html(
+                "<p>(no t-SNE data — pass tsne=(coords, labels) to "
+                "UiServer or POST /api/tsne)</p>")
+        pts, labels = data
+        return self._html(
+            "<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>t-SNE</title></head>"
+            "<body style='font-family:sans-serif'><h1>t-SNE embedding</h1>"
+            f"<p>{len(pts)} points</p>"
+            + render_tsne_svg(pts, labels) + "</body></html>")
 
     def _flow_info(self):
         """Model-graph info: from an attached FlowIterationListener's
@@ -224,6 +279,53 @@ class _Handler(BaseHTTPRequestHandler):
                 "<h1>deeplearning4j_tpu training UI</h1>" + body + "</body></html>")
 
 
+def render_tsne_svg(points, labels, width: int = 760, height: int = 560,
+                    max_text_labels: int = 200) -> str:
+    """Self-contained SVG scatter of a 2-D embedding: one dot + hover
+    tooltip per point, text labels while the plot stays readable
+    (≤``max_text_labels``), color by label group when labels repeat
+    (class-colored MNIST digits) and per-point otherwise (unique word
+    labels). The ``ui/tsne`` dashboard view, sans d3/node_modules."""
+    if not points:
+        return "<p>(empty embedding)</p>"
+    xs = [p[0] for p in points]
+    ys = [p[1] for p in points]
+    x0, x1 = min(xs), max(xs)
+    y0, y1 = min(ys), max(ys)
+    sx = (width - 40) / ((x1 - x0) or 1.0)
+    sy = (height - 40) / ((y1 - y0) or 1.0)
+    groups = sorted(set(labels))
+    grouped = len(groups) < len(labels)  # repeated labels = classes
+    palette = ["#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd",
+               "#8c564b", "#e377c2", "#7f7f7f", "#bcbd22", "#17becf"]
+    color = {g: palette[i % len(palette)] for i, g in enumerate(groups)}
+    show_text = not grouped and len(points) <= max_text_labels
+    dots = []
+    for (x, y), lab in zip(points, labels):
+        px = 20 + (x - x0) * sx
+        py = height - 20 - (y - y0) * sy  # SVG y grows downward
+        c = color[lab] if grouped else "#1f77b4"
+        dots.append(
+            f"<circle cx='{px:.1f}' cy='{py:.1f}' r='3' fill='{c}' "
+            f"fill-opacity='0.75'><title>{html.escape(str(lab))}"
+            f"</title></circle>")
+        if show_text:
+            dots.append(f"<text x='{px + 4:.1f}' y='{py - 3:.1f}' "
+                        f"font-size='9'>{html.escape(str(lab))}</text>")
+    legend = ""
+    if grouped:
+        items = "".join(
+            f"<tspan x='10' dy='14' fill='{color[g]}'>&#9679; "
+            f"{html.escape(str(g))}</tspan>" for g in groups[:20])
+        if len(groups) > 20:  # truncation must be visible, not silent
+            items += (f"<tspan x='10' dy='14' fill='#555'>… "
+                      f"+{len(groups) - 20} more</tspan>")
+        legend = f"<text y='10' font-size='11'>{items}</text>"
+    return (f"<svg xmlns='http://www.w3.org/2000/svg' width='{width}' "
+            f"height='{height}' style='border:1px solid #ccc'>"
+            + "".join(dots) + legend + "</svg>")
+
+
 class UiServer:
     """Embedded dashboard server (``UiServer.java:25``).
 
@@ -235,7 +337,7 @@ class UiServer:
     def __init__(self, storage: StatsStorage, port: int = 0,
                  host: str = "127.0.0.1", verbose: bool = False,
                  word_vectors=None, model=None, conv_listener=None,
-                 flow_listener=None):
+                 flow_listener=None, tsne=None):
         """``word_vectors``: any object with ``words_nearest(word, n)``
         (Word2Vec/WordVectors) — enables the /words nearest-neighbor
         view (legacy dl4j-scaleout/deeplearning4j-nlp render role).
@@ -243,7 +345,10 @@ class UiServer:
         model-graph view (live snapshot); ``flow_listener`` /
         ``conv_listener``: FlowIterationListener /
         ConvolutionalIterationListener instances backing /flow and
-        /activations with training-time snapshots."""
+        /activations with training-time snapshots. ``tsne``: a
+        ``(coords [N,2], labels [N])`` pair for the /tsne scatter view
+        (``plot/tsne.py`` output; also settable later via
+        ``set_tsne`` or POST /api/tsne)."""
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd._storage = storage  # type: ignore[attr-defined]
         self._httpd._verbose = verbose  # type: ignore[attr-defined]
@@ -251,7 +356,22 @@ class UiServer:
         self._httpd._flow_model = model  # type: ignore[attr-defined]
         self._httpd._conv_listener = conv_listener  # type: ignore[attr-defined]
         self._httpd._flow_listener = flow_listener  # type: ignore[attr-defined]
+        self._httpd._tsne_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd._tsne_data = None  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+        if tsne is not None:
+            self.set_tsne(*tsne)
+
+    def set_tsne(self, coords, labels=None) -> None:
+        """Attach/replace the /tsne embedding: ``coords`` [N,2]-like,
+        ``labels`` length-N (defaults to indices)."""
+        pts = [[float(a), float(b)] for a, b in coords]
+        labels = ([str(l) for l in labels] if labels is not None
+                  else [str(i) for i in range(len(pts))])
+        if len(labels) != len(pts):
+            raise ValueError(f"{len(labels)} labels for {len(pts)} points")
+        with self._httpd._tsne_lock:  # type: ignore[attr-defined]
+            self._httpd._tsne_data = (pts, labels)  # type: ignore
 
     @property
     def port(self) -> int:

@@ -1,0 +1,51 @@
+"""MNIST embeddings → t-SNE → live /tsne dashboard view.
+
+The reference's t-SNE scatter dashboard
+(``deeplearning4j-ui-resources/.../ui/tsne/``) end-to-end: embed MNIST
+digit images with on-device t-SNE (``plot/tsne.py``, exact gradients on
+the MXU) and serve the class-colored scatter at ``/tsne``. Run it and
+open the printed URL; with real MNIST on disk the clusters are the ten
+digit classes.
+"""
+
+try:  # script mode: examples/ is sys.path[0]
+    import _bootstrap  # noqa: F401
+except ImportError:  # package mode: repo root already importable
+    pass
+
+import argparse
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.mnist import load_mnist
+from deeplearning4j_tpu.plot.tsne import TSNE
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, UiServer
+
+
+def main(smoke: bool = False, port: int = 0, keep_serving: bool = False):
+    n, iters = (60, 30) if smoke else (1000, 500)
+    ds = load_mnist(train=True, num_examples=n)
+    x = np.asarray(ds.features).reshape(n, -1).astype(np.float32)
+    labels = [str(int(d)) for d in np.argmax(np.asarray(ds.labels), axis=1)]
+
+    coords = TSNE(n_iter=iters, perplexity=min(30.0, n / 4)).fit_transform(x)
+
+    srv = UiServer(InMemoryStatsStorage(), port=port,
+                   tsne=(coords, labels)).start()
+    print(f"t-SNE of {n} MNIST digits at {srv.url}/tsne")
+    if keep_serving:  # pragma: no cover - interactive mode
+        import time
+        while True:
+            time.sleep(3600)
+    srv.stop()
+    return coords
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--serve", action="store_true",
+                    help="keep the dashboard running")
+    a = ap.parse_args()
+    main(smoke=a.smoke, port=a.port, keep_serving=a.serve)

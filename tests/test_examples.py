@@ -63,3 +63,9 @@ def test_word2vec_cjk_example():
     assert len(w2v.words_nearest("日本語", 3)) == 3
     w2v_ko = main(smoke=True, korean=True)
     assert len(w2v_ko.words_nearest("한국어", 3)) == 3
+
+
+def test_tsne_mnist_view_example():
+    from examples.tsne_mnist_view import main
+    coords = main(smoke=True)
+    assert coords.shape == (60, 2) and np.isfinite(coords).all()

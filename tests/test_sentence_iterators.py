@@ -185,3 +185,35 @@ def test_synchronized_close_delegates_to_prefetcher():
     it.next_sentence()
     it.close()
     assert inner.has_next() is False  # worker stopped, clean EOS
+
+
+def test_synchronized_close_unblocks_stalled_consumer():
+    """Code-review r5: close() is lock-free — it must interrupt a
+    consumer blocked inside the wrapped prefetcher's has_next() while
+    holding the sync lock."""
+    import threading
+    import time
+    from deeplearning4j_tpu.text.sentenceiterator import (
+        SynchronizedSentenceIterator)
+
+    class Stalled(CollectionSentenceIterator):
+        def __init__(self):
+            super().__init__(["one"])
+            self.release = threading.Event()
+
+        def has_next(self):
+            if not super().has_next():
+                self.release.wait(timeout=10.0)  # simulate a hung source
+            return super().has_next()
+
+    it = SynchronizedSentenceIterator(PrefetchingSentenceIterator(
+        Stalled(), fetch_size=1))
+    assert it.next_sentence() == "one"
+    out = []
+    t = threading.Thread(target=lambda: out.append(it.has_next()))
+    t.start()
+    time.sleep(0.3)  # consumer is now inside the prefetch wait, lock held
+    it.close()       # must not block on the lock
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "close() deadlocked against the consumer"
+    assert out == [False]
