@@ -71,6 +71,45 @@ def sgns_pairs(flat: np.ndarray, sent_id: np.ndarray, window: int,
     return np.concatenate(cs), np.concatenate(xs)
 
 
+def _flatten(sentences):
+    flat = np.concatenate([np.asarray(s, np.int32) for s in sentences])
+    sent_id = np.concatenate([np.full(len(s), i, np.int32)
+                              for i, s in enumerate(sentences)])
+    return flat, sent_id
+
+
+def _init_tables(vocab_size: int, dim: int, rng: np.random.Generator):
+    W0 = ((rng.random((vocab_size, dim)) - 0.5) / dim).astype(np.float32)
+    W1 = np.zeros((vocab_size, dim), np.float32)
+    return W0, W1
+
+
+def _sgns_minibatch(W0, W1, c, x, table, rng, K: int, lr: float) -> None:
+    """One vectorized SGD minibatch over pairs (c -> x), in place.
+
+    THE shared update rule: both the throughput benchmark and the
+    quality anchor (``sgns_host_train``) call this one body, so the
+    'same per-pair semantics' claim is enforced by construction.
+    word2vec.c details kept: MAX_EXP=±6 logit clip, collision-skip on
+    negatives, unbuffered duplicate summing via np.add.at (measured
+    faster than sort+reduceat at these shapes — the gather of a
+    sorted copy outweighs add.at's unbuffered loop for 128-wide rows).
+    """
+    dim = W0.shape[1]
+    negs = table[rng.integers(0, table.shape[0], (c.shape[0], K))]
+    idx = np.concatenate([x[:, None], negs], axis=1)      # [B, K+1]
+    h = W0[c]                                             # [B, d]
+    u = W1[idx.reshape(-1)].reshape(c.shape[0], K + 1, dim)
+    logits = np.clip(np.einsum("bd,bkd->bk", h, u), -6.0, 6.0)
+    s = 1.0 / (1.0 + np.exp(-logits))
+    g = -s * lr                                           # [B, K+1]
+    g[:, 0] += lr                                         # label col 0
+    g[:, 1:] *= negs != x[:, None]
+    np.add.at(W0, c, np.einsum("bk,bkd->bd", g, u))
+    np.add.at(W1, idx.reshape(-1),
+              (g[:, :, None] * h[:, None, :]).reshape(-1, dim))
+
+
 def sgns_host_benchmark(sentences: Sequence[List[int]], vocab_size: int,
                         dim: int = 128, window: int = 5, K: int = 5,
                         lr: float = 0.025, seed: int = 1,
@@ -85,39 +124,12 @@ def sgns_host_benchmark(sentences: Sequence[List[int]], vocab_size: int,
     fully trained / elapsed.
     """
     rng = np.random.default_rng(seed)
-    flat = np.concatenate([np.asarray(s, np.int32) for s in sentences])
-    sent_id = np.concatenate([np.full(len(s), i, np.int32)
-                              for i, s in enumerate(sentences)])
-    counts = np.bincount(flat, minlength=vocab_size)
-    table = _unigram_table(counts)
-
-    W0 = (rng.random((vocab_size, dim), np.float32) - 0.5) / dim
-    W1 = np.zeros((vocab_size, dim), np.float32)
-    label = np.zeros((1, K + 1), np.float32)
-    label[0, 0] = 1.0
-
-    def scatter_add(W, idx, vals):
-        """np.add.at, measured FASTER than the sort+reduceat segment-sum
-        at these shapes (46 vs 72 ms for [49152]->[2000,128] on this
-        host: the gather `vals[order]` copies the whole 25 MB value
-        matrix, which outweighs add.at's unbuffered loop for 128-wide
-        rows) — the anchor uses the faster of the two."""
-        np.add.at(W, idx, vals)
+    flat, sent_id = _flatten(sentences)
+    table = _unigram_table(np.bincount(flat, minlength=vocab_size))
+    W0, W1 = _init_tables(vocab_size, dim, rng)
 
     def train_pairs(c, x):
-        """One vectorized SGD minibatch over pairs (c -> x)."""
-        negs = table[rng.integers(0, table.shape[0], (c.shape[0], K))]
-        idx = np.concatenate([x[:, None], negs], axis=1)      # [B, K+1]
-        h = W0[c]                                             # [B, d]
-        u = W1[idx.reshape(-1)].reshape(c.shape[0], K + 1, dim)
-        logits = np.clip(np.einsum("bd,bkd->bk", h, u), -6.0, 6.0)
-        s = 1.0 / (1.0 + np.exp(-logits))  # MAX_EXP=6 clip (word2vec.c)
-        g = (label - s) * lr                                  # [B, K+1]
-        g[:, 1:] *= negs != x[:, None]  # collision-skip (engine parity)
-        dh = np.einsum("bk,bkd->bd", g, u)
-        du = g[:, :, None] * h[:, None, :]
-        scatter_add(W0, c, dh)
-        scatter_add(W1, idx.reshape(-1), du.reshape(-1, dim))
+        _sgns_minibatch(W0, W1, c, x, table, rng, K, lr)
 
     # pair generation for the whole stream (cheap relative to training)
     centers, contexts = sgns_pairs(flat, sent_id, window, rng)
@@ -156,32 +168,15 @@ def sgns_host_train(sentences: Sequence[List[int]], vocab_size: int,
     the device engine's ``_ROW_UPDATE_CAP`` is supposed to match, so it
     deliberately has NO cap."""
     rng = np.random.default_rng(seed)
-    flat = np.concatenate([np.asarray(s, np.int32) for s in sentences])
-    sent_id = np.concatenate([np.full(len(s), i, np.int32)
-                              for i, s in enumerate(sentences)])
-    counts = np.bincount(flat, minlength=vocab_size)
-    table = _unigram_table(counts)
-    W0 = ((rng.random((vocab_size, dim)) - 0.5) / dim).astype(np.float32)
-    W1 = np.zeros((vocab_size, dim), np.float32)
-    label = np.zeros((1, K + 1), np.float32)
-    label[0, 0] = 1.0
+    flat, sent_id = _flatten(sentences)
+    table = _unigram_table(np.bincount(flat, minlength=vocab_size))
+    W0, W1 = _init_tables(vocab_size, dim, rng)
 
     for _ in range(epochs):
         centers, contexts = sgns_pairs(flat, sent_id, window, rng)
         perm = rng.permutation(centers.shape[0])
         centers, contexts = centers[perm], contexts[perm]
         for lo in range(0, centers.shape[0], batch):
-            c = centers[lo:lo + batch]
-            x = contexts[lo:lo + batch]
-            negs = table[rng.integers(0, table.shape[0], (c.shape[0], K))]
-            idx = np.concatenate([x[:, None], negs], axis=1)
-            h = W0[c]
-            u = W1[idx.reshape(-1)].reshape(c.shape[0], K + 1, dim)
-            logits = np.clip(np.einsum("bd,bkd->bk", h, u), -6.0, 6.0)
-            s = 1.0 / (1.0 + np.exp(-logits))
-            g = (label - s) * lr
-            g[:, 1:] *= negs != x[:, None]
-            np.add.at(W0, c, np.einsum("bk,bkd->bd", g, u))
-            np.add.at(W1, idx.reshape(-1),
-                      (g[:, :, None] * h[:, None, :]).reshape(-1, dim))
+            _sgns_minibatch(W0, W1, centers[lo:lo + batch],
+                            contexts[lo:lo + batch], table, rng, K, lr)
     return W0

@@ -108,15 +108,20 @@ def test_cap_does_not_degrade_vs_uncapped(corpus):
     overshoot, and diverge outright at higher epoch counts)."""
     sents, classes = corpus
     assert eng._ROW_UPDATE_CAP == 64.0  # gate guards the shipped value
-    _, capped = _fit_device(sents, classes, HOST_EPOCHS)
+    m_c, capped = _fit_device(sents, classes, HOST_EPOCHS)
     old = eng._ROW_UPDATE_CAP
     try:
         eng._ROW_UPDATE_CAP = 1e9  # effectively off
         jax.clear_caches()         # constant is baked at trace time
-        _, uncapped = _fit_device(sents, classes, HOST_EPOCHS)
+        m_u, uncapped = _fit_device(sents, classes, HOST_EPOCHS)
     finally:
         eng._ROW_UPDATE_CAP = old
         jax.clear_caches()
+    # vacuousness guard: if a future caching change makes the retrace
+    # not happen, the two trajectories would be IDENTICAL and this test
+    # would silently compare capped to itself — fail loudly instead
+    assert not np.allclose(m_c.lookup_table.syn0, m_u.lookup_table.syn0), (
+        "cap override had no effect — the uncapped run retraced nothing")
     print(f"purity@3 capped={capped:.3f} uncapped={uncapped:.3f}")
     assert capped >= uncapped - 0.02, (
         f"_ROW_UPDATE_CAP degrades quality: {capped:.3f} vs "
@@ -135,8 +140,7 @@ def test_device_matches_host_quality_per_wallclock(corpus):
     host_w0 = sgns_host_train(ids, m.vocab.num_words(), dim=DIM,
                               window=WINDOW, K=K, lr=LR,
                               epochs=HOST_EPOCHS, seed=7, batch=64)
-    host_purity = _purity_at_k(host_w0, lambda w: m.vocab.index_of(w),
-                               classes)
+    host_purity = _purity_at_k(host_w0, m.vocab.index_of, classes)
 
     chance = (WORDS_PER_CLASS - 1) / (N_CLASSES * WORDS_PER_CLASS - 1)
     print(f"purity@3 device={dev_purity:.3f} host={host_purity:.3f} "
