@@ -117,11 +117,14 @@ def test_cap_does_not_degrade_vs_uncapped(corpus):
     finally:
         eng._ROW_UPDATE_CAP = old
         jax.clear_caches()
-    # vacuousness guard: if a future caching change makes the retrace
-    # not happen, the two trajectories would be IDENTICAL and this test
-    # would silently compare capped to itself — fail loudly instead
+    # vacuousness guard: if the two trajectories are IDENTICAL the test
+    # is comparing capped to itself — either a future caching change
+    # defeated the retrace, or a corpus/batch change made the cap never
+    # bind (no row exceeds 64 per batch); both mean the gate is dead
     assert not np.allclose(m_c.lookup_table.syn0, m_u.lookup_table.syn0), (
-        "cap override had no effect — the uncapped run retraced nothing")
+        "cap override had no effect: either the jitted programs did not "
+        "retrace after the _ROW_UPDATE_CAP change, or the corpus no "
+        "longer makes the cap bind — fix the gate, it guards nothing")
     print(f"purity@3 capped={capped:.3f} uncapped={uncapped:.3f}")
     assert capped >= uncapped - 0.02, (
         f"_ROW_UPDATE_CAP degrades quality: {capped:.3f} vs "

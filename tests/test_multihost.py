@@ -30,7 +30,7 @@ def _free_port() -> int:
     return port
 
 
-def _spawn(pid, nproc, port, out, local_devices=2):
+def _spawn(pid, nproc, port, out, local_devices=2, mode="dp"):
     env = dict(os.environ)
     # the box's sitecustomize registers a TPU plugin at interpreter start
     # when this var is set — must be removed BEFORE the child starts
@@ -40,22 +40,24 @@ def _spawn(pid, nproc, port, out, local_devices=2):
     env["GRAFT_LOCAL_DEVICES"] = str(local_devices)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.Popen(
-        [sys.executable, WORKER, str(pid), str(nproc), str(port), out],
+        [sys.executable, WORKER, str(pid), str(nproc), str(port), out, mode],
         env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True)
 
 
-def test_two_process_dp_matches_single_process(tmp_path):
+def _run_equivalence(tmp_path, mode):
+    """2 processes × 2 devices vs 1 process × 4 devices, same global
+    mesh semantics; final params must match."""
     port = _free_port()
-    out_multi = str(tmp_path / "multi.npz")
-    out_single = str(tmp_path / "single.npz")
+    out_multi = str(tmp_path / f"multi_{mode}.npz")
+    out_single = str(tmp_path / f"single_{mode}.npz")
 
-    procs = [_spawn(i, 2, port, out_multi) for i in range(2)]
+    procs = [_spawn(i, 2, port, out_multi, mode=mode) for i in range(2)]
     for p in procs:
         stdout, stderr = p.communicate(timeout=540)
         assert p.returncode == 0, f"worker failed:\n{stdout}\n{stderr[-3000:]}"
 
-    single = _spawn(0, 1, port, out_single, local_devices=4)
+    single = _spawn(0, 1, port, out_single, local_devices=4, mode=mode)
     stdout, stderr = single.communicate(timeout=540)
     assert single.returncode == 0, f"single failed:\n{stdout}\n{stderr[-3000:]}"
 
@@ -64,7 +66,24 @@ def test_two_process_dp_matches_single_process(tmp_path):
     assert set(a.files) == set(b.files)
     for k in a.files:
         np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6,
-                                   err_msg=k)
+                                   err_msg=f"{mode}:{k}")
+
+
+def test_two_process_dp_matches_single_process(tmp_path):
+    _run_equivalence(tmp_path, "dp")
+
+
+def test_two_process_fsdp_matches_single_process(tmp_path):
+    """VERDICT r4 #6: ZeRO-3 param/opt shards span the process boundary
+    (asserted inside the worker) and the trajectory matches the
+    single-process run."""
+    _run_equivalence(tmp_path, "fsdp")
+
+
+def test_two_process_tp_matches_single_process(tmp_path):
+    """VERDICT r4 #6: tensor-parallel with the model axis ACROSS
+    processes — per-layer collectives ride the process boundary."""
+    _run_equivalence(tmp_path, "tp")
 
 
 def test_make_multihost_mesh_single_process_shapes():
