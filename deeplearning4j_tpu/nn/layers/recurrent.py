@@ -61,21 +61,23 @@ def _lstm_scan(p, x, h0, c0, gate_act: str, block_act: str, mask=None,
     a single [b*t, f]·[f, 4n] matmul — MXU-friendly: the big matmul is
     batched over time, only the small recurrent gemm stays sequential.
 
-    Inference (``train=False``) dispatches the recurrence to the fused
-    Pallas kernel (``ops/lstm_kernel.py``, -32% vs this scan on v5e)
-    when the configuration allows; training keeps this XLA scan — its
-    fused scan-grad measured faster than any split kernel+BPTT (see
-    the kernel module docstring).
+    Both inference AND training dispatch the recurrence to the fused
+    Pallas kernels (``ops/lstm_kernel.py``) when the configuration
+    allows: forward −32% vs this scan, and the r5 Pallas BPTT takes the
+    full train step from 28.8% to 63.5% MFU at the char-RNN bench shape
+    (BASELINE.md). Training additionally requires the backward kernel's
+    VMEM budget (n ≤ 512); everything else keeps this XLA scan.
     """
     n = h0.shape[-1]
     xg = jnp.einsum("btf,fg->btg", x, p["Wx"]) + p["b"]  # [b,t,4n]
     xg_t = jnp.swapaxes(xg, 0, 1)  # [t,b,4n]
 
     from deeplearning4j_tpu.ops.lstm_kernel import (
-        fused_lstm_applicable, fused_lstm_scan)
-    if not train and fused_lstm_applicable(x.shape[0], n, gate_act,
-                                           block_act, mask,
-                                           itemsize=xg.dtype.itemsize):
+        fused_lstm_applicable, fused_lstm_scan, fused_lstm_train_applicable)
+    applicable = (fused_lstm_train_applicable if train
+                  else fused_lstm_applicable)
+    if applicable(x.shape[0], n, gate_act, block_act, mask,
+                  itemsize=xg.dtype.itemsize):
         xg_k = xg_t[::-1] if reverse else xg_t
         h_seq, (h, c) = fused_lstm_scan(xg_k, p["Wr"], p["wci"], p["wcf"],
                                         p["wco"], h0, c0)

@@ -58,7 +58,7 @@ def aggregate_trace(log_dir):
 
         def pop_one():
             end0, e0, child0 = stack.pop()
-            key = re.sub(r"[.\d]+$", "", e0["name"])
+            key = re.sub(r"(\.\d+)+$", "", e0["name"])
             c = e0.get("args", {}).get("hlo_category", "?")
             # whole-module/step container lanes mirror total time;
             # keep only real HLO ops (they carry hlo_category)
