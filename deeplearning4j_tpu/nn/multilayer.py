@@ -111,8 +111,13 @@ class MultiLayerNetwork:
             p = params[impl.name]
             if self._cd is not None:
                 if i == n_last and impl.has_loss():
-                    # output head always runs f32 (stable softmax/loss)
-                    x = x.astype(jnp.float32)
+                    if "W" in p:
+                        # head matmul on bf16 operands, f32 accumulation
+                        # (preout's preferred_element_type): logits and
+                        # the loss math stay f32 at full MXU rate
+                        p = cast_floats(p, self._cd)
+                    else:  # matmul-free heads (LossLayer): loss runs f32
+                        x = x.astype(jnp.float32)
                 else:
                     p = cast_floats(p, self._cd)
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
@@ -145,10 +150,14 @@ class MultiLayerNetwork:
         pre = self.conf.input_preprocessors.get(i_out)
         if pre is not None:
             x = pre(x)
+        p_out = params[self.out.name]
         if self._cd is not None:
-            x = x.astype(jnp.float32)  # loss always f32
+            if "W" in p_out:  # bf16 head matmul, f32 logits (preout)
+                p_out = cast_floats(p_out, self._cd)
+            else:
+                x = x.astype(jnp.float32)  # loss always f32
         lrng = jax.random.fold_in(rng, i_out) if rng is not None else None
-        score = self.out.score(params[self.out.name], x, y, states[self.out.name], train, lrng, mask=lmask)
+        score = self.out.score(p_out, x, y, states[self.out.name], train, lrng, mask=lmask)
         new_states[self.out.name] = states[self.out.name]
         for impl in self.impls:
             score = score + impl.regularization_penalty(params[impl.name]).astype(score.dtype)
