@@ -167,3 +167,51 @@ def test_pallas_bwd_matches_scan_bwd(rng, monkeypatch):
                       jax.tree.leaves(grads_scan)):
         np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
                                    rtol=2e-2, atol=2e-3)
+
+
+def test_layer_training_dispatch_matches_xla(rng, monkeypatch):
+    """r5: the TRAIN path through the fused fwd+Pallas-BPTT kernels
+    (the default on TPU) produces the same fit trajectory as the XLA
+    scan — guarded here on the interpreter so CI covers the layer-level
+    dispatch, not just direct kernel calls."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    def build():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(5).learning_rate(0.05).updater("sgd")
+                .activation("tanh").list()
+                .layer(GravesLSTM(n_in=8, n_out=128))
+                .layer(RnnOutputLayer(n_in=128, n_out=4,
+                                      activation="softmax",
+                                      loss_function="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    x = rng.standard_normal((16, 7, 8)).astype(np.float32)
+    y = np.zeros((16, 7, 4), np.float32)
+    y[np.arange(16)[:, None], np.arange(7)[None, :],
+      rng.integers(0, 4, (16, 7))] = 1.0
+    ds = DataSet(x, y)
+
+    monkeypatch.setattr(lk, "_on_tpu", lambda: True)  # interpreter path
+    net_fused = build()
+    assert lk.fused_lstm_train_applicable(16, 128, "sigmoid", "tanh", None)
+    for _ in range(2):
+        net_fused.fit(ds, batch_size=16)
+
+    monkeypatch.setenv("DL4J_TPU_LSTM_TRAIN", "xla")
+    import jax
+    jax.clear_caches()
+    net_xla = build()
+    for _ in range(2):
+        net_xla.fit(ds, batch_size=16)
+
+    for ln in net_fused.params:
+        for pn in net_fused.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(net_fused.params[ln][pn]),
+                np.asarray(net_xla.params[ln][pn]),
+                rtol=1e-4, atol=1e-5, err_msg=f"{ln}/{pn}")
