@@ -433,3 +433,92 @@ class BasicResultSetIterator(SentenceIterator):
 
     def finish(self) -> None:
         self.reset()
+
+
+class LabelsSource:
+    """Positional label generator (``labels/LabelsSource.java``):
+    template-formatted labels, remembered in order."""
+
+    def __init__(self, template: str = "SENT_%d"):
+        self.template = template
+        self.labels: List[str] = []
+
+    def next_label(self) -> str:
+        label = self.template % len(self.labels)
+        self.labels.append(label)
+        return label
+
+    def reset(self) -> None:
+        self.labels = []
+
+
+class SentenceIteratorConverter(LabelAwareIterator):
+    """Adapts any SentenceIterator into the LabelAwareIterator SPI
+    (``interoperability/SentenceIteratorConverter.java:20``): each
+    sentence becomes a document labeled from a :class:`LabelsSource`
+    (positional by default), so plain corpora feed ParagraphVectors."""
+
+    def __init__(self, iterator: SentenceIterator,
+                 generator: Optional[LabelsSource] = None):
+        self._it = iterator
+        self._gen = generator or LabelsSource()
+
+    def has_next(self) -> bool:
+        return self._it.has_next()
+
+    def next_document(self) -> LabelledDocument:
+        return LabelledDocument(self._it.next_sentence(),
+                                [self._gen.next_label()])
+
+    def reset(self) -> None:
+        self._it.reset()
+        self._gen.reset()
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_document()
+
+
+class LabelAwareFileSentenceIterator(LabelAwareIterator):
+    """Sentences from files under a directory, labeled by the file's
+    parent directory name (``labelaware/LabelAwareFileSentenceIterator``
+    — the one-folder-per-class corpus layout)."""
+
+    def __init__(self, directory: str,
+                 preprocessor: Optional[SentencePreProcessor] = None):
+        self._dir = directory
+        self._pre = preprocessor
+        self.reset()
+
+    def reset(self) -> None:
+        self._files = sorted(
+            os.path.join(r, f)
+            for r, _, fs in os.walk(self._dir) for f in fs)
+        self._fi = 0
+        self._lines: List[str] = []
+        self._li = 0
+        self._label = ""
+        self._load_next()
+
+    def _load_next(self) -> None:
+        self._lines, self._li = [], 0
+        while self._fi < len(self._files) and not self._lines:
+            path = self._files[self._fi]
+            with open(path, encoding="utf-8", errors="replace") as f:
+                self._lines = [l.rstrip("\n") for l in f if l.strip()]
+            self._label = os.path.basename(os.path.dirname(path))
+            self._fi += 1
+
+    def has_next(self) -> bool:
+        return self._li < len(self._lines)
+
+    def next_document(self) -> LabelledDocument:
+        s = self._lines[self._li]
+        self._li += 1
+        label = self._label
+        if self._li >= len(self._lines):
+            self._load_next()
+        if self._pre is not None:
+            s = self._pre.pre_process(s)
+        return LabelledDocument(s, [label])

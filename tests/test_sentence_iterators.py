@@ -217,3 +217,38 @@ def test_synchronized_close_unblocks_stalled_consumer():
     t.join(timeout=5.0)
     assert not t.is_alive(), "close() deadlocked against the consumer"
     assert out == [False]
+
+
+def test_sentence_iterator_converter_positional_labels():
+    """interoperability/SentenceIteratorConverter.java:20 — plain
+    corpora become labeled documents for ParagraphVectors."""
+    from deeplearning4j_tpu.text.sentenceiterator import (
+        LabelsSource, SentenceIteratorConverter)
+
+    conv = SentenceIteratorConverter(
+        CollectionSentenceIterator(["alpha beta", "gamma delta"]))
+    docs = list(conv)
+    assert [d.content for d in docs] == ["alpha beta", "gamma delta"]
+    assert [d.labels for d in docs] == [["SENT_0"], ["SENT_1"]]
+    docs2 = list(conv)  # reset() replays with fresh positional labels
+    assert [d.labels for d in docs2] == [["SENT_0"], ["SENT_1"]]
+    custom = SentenceIteratorConverter(
+        CollectionSentenceIterator(["x"]), LabelsSource("DOC_%d"))
+    assert next(iter(custom)).labels == ["DOC_0"]
+
+
+def test_label_aware_file_sentence_iterator(tmp_path):
+    """labelaware/LabelAwareFileSentenceIterator — folder-per-class
+    corpora: the parent directory names the label."""
+    from deeplearning4j_tpu.text.sentenceiterator import (
+        LabelAwareFileSentenceIterator)
+
+    (tmp_path / "pos").mkdir()
+    (tmp_path / "neg").mkdir()
+    (tmp_path / "pos" / "a.txt").write_text("good one\ngreat two\n")
+    (tmp_path / "neg" / "b.txt").write_text("bad one\n")
+    it = LabelAwareFileSentenceIterator(str(tmp_path))
+    docs = list(it)
+    assert {(d.content, d.labels[0]) for d in docs} == {
+        ("good one", "pos"), ("great two", "pos"), ("bad one", "neg")}
+    assert len(list(it)) == 3  # reset replays
