@@ -76,3 +76,48 @@ def test_word2vec_binary_gensim_convention(tmp_path, rng):
         wv = read_word_vectors_binary(str(path))
         assert [wv.vocab.word_at_index(i) for i in range(3)] == words
         np.testing.assert_allclose(wv.vectors, vecs, rtol=1e-6)
+
+
+# ------------------------- r5 interchange-format frozen fixtures
+
+def test_frozen_paravec_zip_still_loads():
+    """Byte-layout stability: a PV zip written by the r5 serializer is
+    a committed fixture — readers must keep loading it verbatim."""
+    import os
+    import numpy as np
+    from deeplearning4j_tpu.models.embeddings import serializer as ser
+
+    path = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "paravec_r5.zip")
+    pv = ser.read_paragraph_vectors(path)
+    assert sorted(pv.labels) == ["pets", "royalty"]
+    assert pv.doc_vectors.shape == (2, 8)
+    assert "king" in pv.vocab.words() and "dog" in pv.vocab.words()
+    assert np.isfinite(pv.lookup_table.syn0).all()
+    assert pv.predict("the king in the palace") in pv.labels
+
+
+def test_frozen_glove_txt_still_loads():
+    import os
+    import numpy as np
+    from deeplearning4j_tpu.models.embeddings import serializer as ser
+
+    path = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "glove_r5.txt")
+    g = ser.read_glove(path)
+    assert {"king", "queen", "cat", "dog"} <= set(g.vocab.words())
+    assert g.vectors.shape[1] == 6 and np.isfinite(g.vectors).all()
+
+
+def test_frozen_w2v_hs_zip_still_loads():
+    import os
+    import numpy as np
+    from deeplearning4j_tpu.models.embeddings import serializer as ser
+
+    path = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "w2v_hs_r5.zip")
+    m = ser.read_word2vec_model(path)
+    assert m.use_hs and m.vocab.num_words() == 4
+    for w in m.vocab._index:  # HS codes/points survived the freeze
+        assert w.codes is not None and w.points is not None
+    assert np.isfinite(m.lookup_table.syn1).all()
