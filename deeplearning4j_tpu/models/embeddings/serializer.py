@@ -220,10 +220,12 @@ def _parse_table_lines(lines):
 
 
 def _parse_matrix_lines(lines):
-    """Bare 'v1 v2 …' rows (syn1.txt layout) → [N,d] float32."""
-    return np.vstack([
-        np.asarray([float(x) for x in ln.split(" ")], np.float32)
-        for ln in lines if ln.strip()])
+    """Bare 'v1 v2 …' rows (syn1.txt layout) → [N,d] float32 (empty
+    input → [0,0], like _parse_table_lines — not an opaque vstack
+    crash on a malformed/empty zip member)."""
+    rows = [np.asarray([float(x) for x in ln.split(" ")], np.float32)
+            for ln in lines if ln.strip()]
+    return np.vstack(rows) if rows else np.zeros((0, 0), np.float32)
 
 
 def _codes_lines(vocab) -> str:
@@ -388,7 +390,14 @@ def write_paragraph_vectors(pv, path: str) -> None:
     words = pv.vocab.words()
     syn0 = lt.syn0
     labels = list(pv.labels)
-    if pv.doc_vectors is not None and len(labels):
+    if labels and (pv.doc_vectors is None
+                   or len(pv.doc_vectors) != len(labels)):
+        raise ValueError(
+            f"{len(labels)} labels but "
+            f"{0 if pv.doc_vectors is None else len(pv.doc_vectors)} doc "
+            "vectors — fit the model (or restore doc_vectors) before "
+            "writing; a silent mismatch would drop labels on reload")
+    if labels:
         syn0 = np.vstack([syn0, np.asarray(pv.doc_vectors, np.float32)])
     syn1 = lt.syn1 if pv.use_hs else lt.syn1neg
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
@@ -519,6 +528,10 @@ def read_paragraph_vectors_text(path: str):
             else:
                 words.append(word)
                 word_rows.append(row)
+    if not word_rows and not label_rows:
+        raise ValueError(
+            f"{path}: no 'L'/'E' rows — not a legacy ParagraphVectors "
+            "text file (or an empty/failed export)")
     d = (word_rows or label_rows)[0].shape[0]
     pv = ParagraphVectors(layer_size=d)
     pv.vocab = VocabCache.from_ordered(words)

@@ -300,8 +300,12 @@ class ComputationGraph:
                 m = masks[v.inputs[0]]
                 p = params[name]
                 if self._cd is not None:
-                    if impl.has_loss():
-                        x = x.astype(jnp.float32)  # output heads run f32
+                    if impl.has_loss() and "W" not in p:
+                        # matmul-free heads: loss math runs f32. Heads
+                        # WITH a weight matmul keep policy-dtype
+                        # operands — their preout emits f32 logits
+                        # (OutputImpl.preout), same as MultiLayerNetwork
+                        x = x.astype(jnp.float32)
                     else:
                         p = cast_floats(p, self._cd)
                 lrng = jax.random.fold_in(rng, vi) if rng is not None else None
@@ -329,11 +333,15 @@ class ComputationGraph:
             v = self.defs[name]
             impl = self.impls[name]
             x = acts[v.inputs[0]]
+            p_head = params[name]
             if self._cd is not None:
-                x = x.astype(jnp.float32)  # loss always f32
+                if "W" in p_head:  # bf16 head matmul, f32 logits (preout)
+                    p_head = cast_floats(p_head, self._cd)
+                else:
+                    x = x.astype(jnp.float32)  # loss always f32
             lrng = jax.random.fold_in(rng, 10_000 + vi) if rng is not None else None
             lmask = lmasks.get(name) if lmasks else None
-            s = impl.score(params[name], x, labels[name], states[name], train, lrng, mask=lmask)
+            s = impl.score(p_head, x, labels[name], states[name], train, lrng, mask=lmask)
             score = s if score is None else score + s
         for name, impl in self.impls.items():
             score = score + impl.regularization_penalty(params[name]).astype(score.dtype)

@@ -48,17 +48,8 @@ class BaseDenseImpl(LayerImpl):
         return {"W": W, "b": b}
 
     def preout(self, params, x):
-        W = params["W"]
-        if jnp.promote_types(x.dtype, W.dtype) == jnp.bfloat16:
-            # bf16 compute policy: the head matmul runs on bf16 operands
-            # (full MXU rate) but the logits land in f32 for the loss
-            # math. Higher-precision models (incl. the f64 gradcheck
-            # oracle) keep their native matmul — forcing f32 there
-            # would DOWNcast.
-            z = jnp.matmul(x, W, preferred_element_type=jnp.float32)
-        else:
-            z = x @ W
-        return z + params["b"].astype(z.dtype) if "b" in params else z
+        z = x @ params["W"]
+        return z + params["b"] if "b" in params else z
 
     def forward(self, params, x, state, train, rng=None, mask=None):
         x = self.maybe_dropout_input(x, train, rng)
@@ -79,6 +70,21 @@ class OutputImpl(BaseDenseImpl):
 
     def has_loss(self) -> bool:
         return True
+
+    def preout(self, params, x):
+        # OUTPUT-HEAD override only (hidden dense layers keep their
+        # policy dtype end to end): on half-precision operands the head
+        # matmul stays at full MXU rate but the logits land in f32, so
+        # all loss math keeps the documented always-f32 guarantee.
+        # Higher-precision models (incl. the f64 gradcheck oracle) keep
+        # their native matmul — forcing f32 there would DOWNcast.
+        W = params["W"]
+        if jnp.promote_types(x.dtype, W.dtype) in (jnp.bfloat16,
+                                                   jnp.float16):
+            z = jnp.matmul(x, W, preferred_element_type=jnp.float32)
+        else:
+            z = x @ W
+        return z + params["b"].astype(z.dtype) if "b" in params else z
 
     @property
     def loss_function(self) -> str:

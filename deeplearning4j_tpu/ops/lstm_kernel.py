@@ -386,20 +386,24 @@ def _vjp_fwd(xg, wr, wci, wcf, wco, h0, c0, block_b, interpret):
             (res, wr, wci, wcf, wco, h0, c0))
 
 
-def _use_pallas_bwd(t: int, b: int, n: int, block_b: int) -> bool:
+def _use_pallas_bwd(t: int, b: int, n: int, block_b: int,
+                    itemsize: int = 2) -> bool:
     """The fused backward applies within its VMEM budget unless
-    DL4J_TPU_LSTM_BWD=xla forces the scan BPTT (A/B seam)."""
+    DL4J_TPU_LSTM_BWD=xla forces the scan BPTT (A/B seam). The budget
+    (_BWD_MAX_N) was measured for bf16 streams; f32 residual/gout/dg
+    blocks double the footprint, so the admitted n halves with
+    itemsize."""
     import os
     if os.environ.get("DL4J_TPU_LSTM_BWD", "").lower() == "xla":
         return False
-    return n <= _BWD_MAX_N and b % block_b == 0
+    return n * itemsize <= _BWD_MAX_N * 2 and b % block_b == 0
 
 
 def _vjp_bwd(block_b, interpret, saved, cotangents):
     res, wr, wci, wcf, wco, h0, c0 = saved
     g_hseq, g_hlast, g_clast = cotangents
     t, b, n = res[0].shape
-    if _use_pallas_bwd(t, b, n, block_b):
+    if _use_pallas_bwd(t, b, n, block_b, itemsize=res[0].dtype.itemsize):
         # fold the final-h cotangent into the sequence stream; the
         # final-c cotangent enters the kernel's dc carry directly
         gout = g_hseq.astype(jnp.float32).at[-1].add(
@@ -481,8 +485,9 @@ def fused_lstm_train_applicable(b: int, n: int, gate_act: str,
     (n within the dWr-accumulator VMEM budget): falling back to the
     XLA residual BPTT from the fused forward measured SLOWER than the
     plain scan-grad (21% vs 28.8%, r3/r4), so larger hiddens keep the
-    XLA scan for training."""
-    return (train_fused_enabled() and n <= _BWD_MAX_N
+    XLA scan for training. The budget scales with the stream dtype:
+    bf16 admits n<=512, f32 n<=256."""
+    return (train_fused_enabled() and n * itemsize <= _BWD_MAX_N * 2
             and fused_lstm_applicable(b, n, gate_act, block_act, mask,
                                       itemsize=itemsize))
 
