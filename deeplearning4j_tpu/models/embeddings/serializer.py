@@ -583,3 +583,46 @@ def read_word2vec_from_text(vectors_path: str, hs_path: str,
     lt.syn1neg = np.zeros_like(syn0)
     w2v.lookup_table = lt
     return w2v
+
+
+def read_word_vectors_any(path: str):
+    """Format-autodetecting loader (the ``readWord2VecModel`` /
+    ``loadStaticModel`` role the reference points every deprecated
+    reader at): full-model zip → Google binary → headerless/
+    headered text, by sniffing bytes rather than trusting extensions.
+    Returns a :class:`WordVectors` for flat formats and the full model
+    object for zips (its ``word_vectors()``/query API is a superset)."""
+    with open(path, "rb") as f:
+        head = f.read(512)
+    if head[:2] == b"PK":  # zip container
+        import zipfile as _zf
+        with _zf.ZipFile(path) as z:
+            names = set(z.namelist())
+        if "syn0.txt" in names:        # reference-layout full model
+            return read_word2vec_model(path)
+        if "tables.npz" in names:      # this framework's own container
+            return read_full_model(path)
+        raise ValueError(f"{path}: zip has neither syn0.txt nor "
+                         f"tables.npz — not a word-vector container")
+    # flat file: Google binary starts 'V d\n' then binary vectors; text
+    # formats decode fully. Sniff: header line of 2 ints + non-UTF8
+    # payload => binary
+    first_line, _, rest = head.partition(b"\n")
+    parts = first_line.split()
+    if len(parts) == 2:
+        try:
+            int(parts[0]), int(parts[1])
+            is_header = True
+        except ValueError:
+            is_header = False
+        if is_header:
+            try:
+                rest.decode("utf-8")
+            except UnicodeDecodeError:
+                return read_word_vectors_binary(path)
+            return read_word_vectors(path)
+    # headerless table text (B64 or plain words)
+    words, vectors = load_txt(path)
+    if not words:
+        raise ValueError(f"{path}: unrecognized word-vector format")
+    return WordVectors(VocabCache.from_ordered(words), vectors)

@@ -237,3 +237,36 @@ def test_literal_sentinel_word_survives_zip_round_trip(tmp_path):
     ser.write_word2vec_model(m, path)
     back = ser.read_word2vec_model(path)
     assert "weird_Az92_token" in back.vocab.words()
+
+
+def test_read_word_vectors_any_autodetects(tmp_path):
+    """loadStaticModel role: one loader for every shipped format, by
+    byte sniffing."""
+    m = _tiny_w2v()
+    from deeplearning4j_tpu.models.embeddings.lookup_table import WordVectors
+    zipp = str(tmp_path / "any_model.zip")
+    ser.write_word2vec_model(m, zipp)
+    binp = str(tmp_path / "any_vectors.bin")
+    ser.write_word_vectors_binary(m._wv(), binp)
+    txtp = str(tmp_path / "any_vectors.txt")
+    ser.write_word_vectors(m._wv(), txtp)
+    tblp = str(tmp_path / "any_table.txt")
+    with open(tblp, "w") as f:
+        ser._write_table_text(m.vocab.words(), m.lookup_table.syn0, f)
+
+    for p in (zipp, binp, txtp, tblp):
+        got = ser.read_word_vectors_any(p)
+        wv = got.word_vectors() if hasattr(got, "word_vectors") else got
+        assert isinstance(wv, WordVectors) or hasattr(wv, "words_nearest")
+        np.testing.assert_allclose(
+            np.asarray(wv.get_word_vector("king")
+                       if hasattr(wv, "get_word_vector")
+                       else wv.vectors[wv.vocab.index_of("king")]),
+            m.lookup_table.syn0[m.vocab.index_of("king")],
+            rtol=1e-4, atol=1e-5)  # %.6f text rounding on ~0 values
+    import pytest
+    bad = str(tmp_path / "bad.bin")
+    with open(bad, "wb") as f:
+        f.write(b"\x00\x01nonsense")
+    with pytest.raises(ValueError, match="unrecognized|not a word-vector"):
+        ser.read_word_vectors_any(bad)
