@@ -215,3 +215,48 @@ def test_layer_training_dispatch_matches_xla(rng, monkeypatch):
                 np.asarray(net_fused.params[ln][pn]),
                 np.asarray(net_xla.params[ln][pn]),
                 rtol=1e-4, atol=1e-5, err_msg=f"{ln}/{pn}")
+
+
+def test_blstm_training_dispatch_matches_xla(rng, monkeypatch):
+    """r5: the bidirectional train path (reverse direction flips xg
+    into and h_seq out of the fused kernels) must match the XLA scan
+    trajectory too."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import (
+        GravesBidirectionalLSTM, RnnOutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    def build():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(9).learning_rate(0.05).updater("sgd")
+                .activation("tanh").list()
+                .layer(GravesBidirectionalLSTM(n_in=8, n_out=128))
+                .layer(RnnOutputLayer(n_in=128, n_out=4,
+                                      activation="softmax",
+                                      loss_function="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    x = rng.standard_normal((16, 6, 8)).astype(np.float32)
+    y = np.zeros((16, 6, 4), np.float32)
+    y[np.arange(16)[:, None], np.arange(6)[None, :],
+      rng.integers(0, 4, (16, 6))] = 1.0
+    ds = DataSet(x, y)
+
+    monkeypatch.setattr(lk, "_on_tpu", lambda: True)  # interpreter path
+    net_fused = build()
+    net_fused.fit(ds, batch_size=16)
+
+    monkeypatch.setenv("DL4J_TPU_LSTM_TRAIN", "xla")
+    import jax
+    jax.clear_caches()
+    net_xla = build()
+    net_xla.fit(ds, batch_size=16)
+
+    for ln in net_fused.params:
+        for pn in net_fused.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(net_fused.params[ln][pn]),
+                np.asarray(net_xla.params[ln][pn]),
+                rtol=1e-4, atol=1e-5, err_msg=f"{ln}/{pn}")
