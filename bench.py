@@ -392,6 +392,8 @@ def bench_resnet50():
 
 def main():
     _enable_compile_cache()
+    from deeplearning4j_tpu import monitor
+
     subs = {}
     for name, fn in [("gemm_bf16", bench_gemm), ("lenet_mnist", bench_lenet),
                      ("mlp_iris", bench_mlp_iris), ("lstm_char", bench_lstm),
@@ -400,21 +402,32 @@ def main():
                      ("flash_attention_train", bench_flash_attention_train),
                      ("gpt", bench_gpt), ("gpt_large", bench_gpt_large),
                      ("word2vec", bench_word2vec)]:
+        # fresh registry per sub-bench: the monitor spans inside the
+        # fit/stage paths give each result its own per-phase attribution
+        # (data_load/compile/device_step/all_reduce), so BENCH rounds can
+        # tell a staging regression from a device one
+        prev_registry = monitor.set_registry(monitor.MetricsRegistry())
         r = None
         attempts = 3  # tunneled remote-compile can drop transiently
         last_err = None
-        for attempt in range(attempts):
-            try:
-                r = fn()
-                break
-            except Exception as e:  # a broken sub-bench must not hide the rest
-                err = f"{type(e).__name__}: {e}"
-                r = {"error": err}
-                if err == last_err:  # deterministic failure: stop retrying
+        try:
+            for attempt in range(attempts):
+                try:
+                    r = fn()
                     break
-                last_err = err
-                if attempt < attempts - 1:
-                    time.sleep(5)
+                except Exception as e:  # a broken sub-bench must not hide the rest
+                    err = f"{type(e).__name__}: {e}"
+                    r = {"error": err}
+                    if err == last_err:  # deterministic failure: stop retrying
+                        break
+                    last_err = err
+                    if attempt < attempts - 1:
+                        time.sleep(5)
+            phases = monitor.phase_breakdown()
+            if r is not None and phases:
+                r["phases"] = phases
+        finally:
+            monitor.set_registry(prev_registry)
         if r is not None:
             subs[name] = r
 

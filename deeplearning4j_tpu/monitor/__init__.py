@@ -1,0 +1,67 @@
+"""Unified training telemetry: one registry, one clock, many consumers.
+
+The reference stack's observability was three disconnected pieces
+(``PerformanceListener`` wall deltas, Spark ``TrainingStats`` phase
+timers, the SBE ``StatsListener`` → UI pipeline). This package is the
+single seam they all publish through:
+
+- :mod:`registry`  — process-wide counters/gauges/histograms with
+  Prometheus text exposition (served at ``UiServer /metrics``);
+- :mod:`tracing`   — ``span("device_step")`` phase spans against one
+  monotonic clock, JSONL events + Chrome ``trace_event`` export
+  (Perfetto, alongside ``util/profiler.py`` device traces);
+- :mod:`step_health` — NaN/Inf + slow-step watchdog on the listener
+  chain.
+
+Canonical span names threaded through the training paths:
+``data_load`` (iterator/host pipeline + staging source), ``stage``
+(host→device transfer/sharding), ``compile`` (first dispatch of a fresh
+program), ``device_step`` (compiled train step), ``all_reduce``
+(parameter averaging / collective), ``checkpoint``, ``eval``,
+``broadcast``, ``inference``. ``scripts/check_telemetry_schema.py``
+validates the emitted streams.
+"""
+
+from deeplearning4j_tpu.monitor.registry import (  # noqa: F401
+    Counter,
+    DEFAULT_MS_BUCKETS,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from deeplearning4j_tpu.monitor.step_health import (  # noqa: F401
+    NAN_COUNTER,
+    SCORE_GAUGE,
+    SLOW_COUNTER,
+    STEP_HISTOGRAM,
+    StepHealthWatchdog,
+)
+from deeplearning4j_tpu.monitor.tracing import (  # noqa: F401
+    PHASE_HISTOGRAM,
+    PhaseTracer,
+    active_tracer,
+    disable_tracing,
+    enable_tracing,
+    mark,
+    now_us,
+    span,
+)
+
+
+def phase_breakdown(registry=None) -> dict:
+    """Per-phase timing summary from ``dl4j_phase_duration_ms`` —
+    the attribution BENCH rounds attach next to end-to-end numbers:
+    ``{phase: {count, total_ms, mean_ms, p50_ms, p99_ms}}``."""
+    reg = registry if registry is not None else get_registry()
+    out = {}
+    for labels, hist in sorted(reg.family(PHASE_HISTOGRAM).items()):
+        phase = dict(labels).get("phase", "?")
+        s = hist.summary()
+        out[phase] = {"count": int(s["count"]),
+                      "total_ms": round(s["total"], 3),
+                      "mean_ms": round(s["mean"], 3),
+                      "p50_ms": round(s["p50"], 3),
+                      "p99_ms": round(s["p99"], 3)}
+    return out

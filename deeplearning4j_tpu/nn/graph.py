@@ -34,6 +34,7 @@ from deeplearning4j_tpu.datasets.iterators import (
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.graph import GraphVertex, vertex_from_dict
+from deeplearning4j_tpu.monitor import span
 from deeplearning4j_tpu.nn.conf.layers import layer_from_dict
 from deeplearning4j_tpu.nn.layers.base import build_layer
 from deeplearning4j_tpu.nn.observed import SyncedStateAttr
@@ -462,15 +463,20 @@ class ComputationGraph:
 
     def _fit_batch_inner(self, mds: MultiDataSet) -> None:
         key = ("train", self._seq_token())
-        if key not in self._jits:
+        compiling = key not in self._jits
+        if compiling:
             self._jits[key] = self._make_train_step()
         step = self._jits[key]
         rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
-        inputs, labels, fmasks, lmasks = self._tensors(mds)
+        with span("data_load", path="graph_fit"):
+            inputs, labels, fmasks, lmasks = self._tensors(mds)
         for _ in range(max(1, self.gc.iterations)):
-            self.params, self.opt_state, self.states, score = step(
-                self.params, self.opt_state, self.states, inputs, labels, fmasks, lmasks, rng_key)
-            self._score = float(score)
+            # first dispatch of a fresh program is trace+compile-dominated
+            with span("compile" if compiling else "device_step"):
+                self.params, self.opt_state, self.states, score = step(
+                    self.params, self.opt_state, self.states, inputs, labels, fmasks, lmasks, rng_key)
+                self._score = float(score)  # score fetch = device sync
+            compiling = False
             for cb in self.listeners:
                 cb(self, int(self.opt_state["step"]), self._score)
 
@@ -575,9 +581,10 @@ class ComputationGraph:
                 mds.num_examples() - n, mds.num_examples(), batch_size)
         stage = lambda a: jnp.asarray(a[:n], self._dtype).reshape(
             (-1, batch_size) + a.shape[1:])
-        xb = {name: stage(f) for name, f in zip(self.input_names, mds.features)}
-        by_output = dict(zip(self.output_names, mds.labels))
-        yb = {name: stage(by_output[name]) for name in self.loss_outputs}
+        with span("data_load", path="stage_scan", examples=n):
+            xb = {name: stage(f) for name, f in zip(self.input_names, mds.features)}
+            by_output = dict(zip(self.output_names, mds.labels))
+            yb = {name: stage(by_output[name]) for name in self.loss_outputs}
         return xb, yb
 
     def fit_scan(self, data: Optional[Union[DataSet, MultiDataSet]], batch_size: int,
@@ -588,13 +595,16 @@ class ComputationGraph:
             self.init()
         xb, yb = staged if staged is not None else self.stage_scan(data, batch_size)
         key = ("scan_fit", epochs, self._seq_token())
-        if key not in self._jits:
+        compiling = key not in self._jits
+        if compiling:
             self._jits[key] = self._make_scan_fit(epochs)
         fit = self._jits[key]
         rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
-        self.params, self.opt_state, self.states, scores = fit(
-            self.params, self.opt_state, self.states, xb, yb, rng_key)
-        out = np.asarray(scores)
+        with span("compile" if compiling else "device_step",
+                  path="graph_fit_scan", epochs=epochs):
+            self.params, self.opt_state, self.states, scores = fit(
+                self.params, self.opt_state, self.states, xb, yb, rng_key)
+            out = np.asarray(scores)  # score fetch = device sync
         self._score = float(out[-1])
         return out
 
@@ -730,8 +740,9 @@ class ComputationGraph:
             return self._score
         mds = self._to_mds(data)
         inputs, labels, fmasks, lmasks = self._tensors(mds)
-        return float(self._score_fn(self.params, self.states, inputs, labels,
-                                    False, None, fmasks, lmasks)[0])
+        with span("eval", path="graph_score"):
+            return float(self._score_fn(self.params, self.states, inputs, labels,
+                                        False, None, fmasks, lmasks)[0])
 
     # ----------------------------------------------------- flat param views
 

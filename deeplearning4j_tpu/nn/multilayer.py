@@ -35,6 +35,7 @@ from deeplearning4j_tpu.nn.updater import (
     init_updater_state,
     normalize_gradient,
 )
+from deeplearning4j_tpu.monitor import span
 from deeplearning4j_tpu.nn.observed import SyncedStateAttr
 from deeplearning4j_tpu.util.dtypes import cast_floats, cast_like, resolve_compute_dtype
 
@@ -210,6 +211,9 @@ class MultiLayerNetwork:
 
     def _get_jit(self, kind: str, **flags):
         key = (kind, tuple(sorted(flags.items())), self._seq_token())
+        # telemetry: the dispatch after a cache miss traces+compiles, so
+        # callers label it span("compile") instead of "device_step"
+        self._jit_missed = key not in self._jits
         if key not in self._jits:
             if kind == "train":
                 self._jits[key] = self._make_train_step(flags["fm"], flags["lm"])
@@ -453,14 +457,19 @@ class MultiLayerNetwork:
         fm = ds.features_mask is not None
         lm = ds.labels_mask is not None
         step = self._get_jit("train", fm=fm, lm=lm)
-        x = jnp.asarray(ds.features, self._dtype)
-        y = jnp.asarray(ds.labels, self._dtype)
-        fmask = jnp.asarray(ds.features_mask, self._dtype) if fm else jnp.zeros((), self._dtype)
-        lmask = jnp.asarray(ds.labels_mask, self._dtype) if lm else jnp.zeros((), self._dtype)
+        compiling = self._jit_missed
+        with span("data_load", path="fit"):
+            x = jnp.asarray(ds.features, self._dtype)
+            y = jnp.asarray(ds.labels, self._dtype)
+            fmask = jnp.asarray(ds.features_mask, self._dtype) if fm else jnp.zeros((), self._dtype)
+            lmask = jnp.asarray(ds.labels_mask, self._dtype) if lm else jnp.zeros((), self._dtype)
         for _ in range(max(1, self.gc.iterations)):
-            self.params, self.opt_state, self.states, score = step(
-                self.params, self.opt_state, self.states, x, y, fmask, lmask, rng_key)
-            self._score = float(score)
+            # first dispatch of a fresh program is trace+compile-dominated
+            with span("compile" if compiling else "device_step"):
+                self.params, self.opt_state, self.states, score = step(
+                    self.params, self.opt_state, self.states, x, y, fmask, lmask, rng_key)
+                self._score = float(score)  # score fetch = device sync
+            compiling = False
             it_num = int(self.opt_state["step"])
             for cb in self.listeners:
                 cb(self, it_num, self._score)
@@ -515,10 +524,11 @@ class MultiLayerNetwork:
             logging.getLogger("deeplearning4j_tpu").warning(
                 "fit_scan: dropping %d tail examples (dataset %d %% batch %d)",
                 ds.num_examples() - n, ds.num_examples(), batch_size)
-        xb = jnp.asarray(ds.features[:n], self._dtype).reshape(
-            (-1, batch_size) + ds.features.shape[1:])
-        yb = jnp.asarray(ds.labels[:n], self._dtype).reshape(
-            (-1, batch_size) + ds.labels.shape[1:])
+        with span("data_load", path="stage_scan", examples=n):
+            xb = jnp.asarray(ds.features[:n], self._dtype).reshape(
+                (-1, batch_size) + ds.features.shape[1:])
+            yb = jnp.asarray(ds.labels[:n], self._dtype).reshape(
+                (-1, batch_size) + ds.labels.shape[1:])
         return xb, yb
 
     def fit_scan(self, ds: Optional[DataSet], batch_size: int, epochs: int = 1,
@@ -529,13 +539,16 @@ class MultiLayerNetwork:
             self.init()
         xb, yb = staged if staged is not None else self.stage_scan(ds, batch_size)
         key = ("scan_fit", epochs, self._seq_token())
-        if key not in self._jits:
+        compiling = key not in self._jits
+        if compiling:
             self._jits[key] = self._make_scan_fit(epochs)
         fit = self._jits[key]
         rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
-        self.params, self.opt_state, self.states, scores = fit(
-            self.params, self.opt_state, self.states, xb, yb, rng_key)
-        out = np.asarray(scores)
+        with span("compile" if compiling else "device_step",
+                  path="fit_scan", epochs=epochs):
+            self.params, self.opt_state, self.states, scores = fit(
+                self.params, self.opt_state, self.states, xb, yb, rng_key)
+            out = np.asarray(scores)  # score fetch = device sync
         self._score = float(out[-1])
         return out
 
@@ -566,11 +579,12 @@ class MultiLayerNetwork:
         fm = ds.features_mask is not None
         lm = ds.labels_mask is not None
         fn = self._get_jit("score", fm=fm, lm=lm)
-        return float(fn(self.params, self.states,
-                        jnp.asarray(ds.features, self._dtype),
-                        jnp.asarray(ds.labels, self._dtype),
-                        jnp.asarray(ds.features_mask, self._dtype) if fm else jnp.zeros((), self._dtype),
-                        jnp.asarray(ds.labels_mask, self._dtype) if lm else jnp.zeros((), self._dtype)))
+        with span("eval", path="score"):
+            return float(fn(self.params, self.states,
+                            jnp.asarray(ds.features, self._dtype),
+                            jnp.asarray(ds.labels, self._dtype),
+                            jnp.asarray(ds.features_mask, self._dtype) if fm else jnp.zeros((), self._dtype),
+                            jnp.asarray(ds.labels_mask, self._dtype) if lm else jnp.zeros((), self._dtype)))
 
     # ----------------------------------------------------- flat param views
 

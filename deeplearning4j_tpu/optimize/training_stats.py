@@ -23,16 +23,21 @@ Usage::
 from __future__ import annotations
 
 import json
+import os
 import time
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.monitor import tracing as _tracing
 
 
 class TrainingStats:
     def __init__(self, keep_timeline: bool = True, max_events: int = 100_000):
         self.keep_timeline = keep_timeline
         self.max_events = max_events
-        self._origin = time.perf_counter()
+        # one clock, many consumers: share the monitor's process origin so
+        # this timeline aligns with monitor spans in a merged Perfetto view
+        self._origin = _tracing._ORIGIN
         # phase -> [count, total_ms, min_ms, max_ms]
         self._agg: Dict[str, List[float]] = {}
         # (phase, start_ms_since_origin, duration_ms)
@@ -83,6 +88,25 @@ class TrainingStats:
     def export_json(self, path: str) -> str:
         with open(path, "w") as f:
             json.dump(self.to_dict(), f)
+        return path
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """Chrome ``trace_event`` view of the phase timeline (same clock
+        as ``monitor`` spans — StatsUtils.exportStatsAsHtml role, but a
+        format Perfetto opens)."""
+        pid = os.getpid()
+        events: List[Dict[str, Any]] = [
+            {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+             "args": {"name": "TrainingStats phases"}}]
+        for p, s, d in self._events:
+            events.append({"name": p, "cat": "phase", "ph": "X", "pid": pid,
+                           "tid": 0, "ts": s * 1e3, "dur": d * 1e3,
+                           "args": {}})
+        return {"displayTimeUnit": "ms", "traceEvents": events}
+
+    def export_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
         return path
 
     def merge(self, other: "TrainingStats", prefix: str = "") -> None:

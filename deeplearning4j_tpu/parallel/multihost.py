@@ -36,6 +36,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.monitor import span
+
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
@@ -104,13 +106,14 @@ def global_batch(mesh: Mesh, local_arrays: Sequence[np.ndarray],
     replacement for the reference's repartition/data-locality plane:
     data never leaves the host that loaded it."""
     out = []
-    for a in local_arrays:
-        if a is None:
-            out.append(None)
-            continue
-        spec = P(axis, *([None] * (np.ndim(a) - 1)))
-        out.append(jax.make_array_from_process_local_data(
-            NamedSharding(mesh, spec), np.asarray(a)))
+    with span("stage", path="multihost_global_batch", axis=axis):
+        for a in local_arrays:
+            if a is None:
+                out.append(None)
+                continue
+            spec = P(axis, *([None] * (np.ndim(a) - 1)))
+            out.append(jax.make_array_from_process_local_data(
+                NamedSharding(mesh, spec), np.asarray(a)))
     return out
 
 
@@ -118,9 +121,10 @@ def replicate(mesh: Mesh, tree):
     """Replicate a pytree of host arrays over the global mesh (the
     ``NetBroadcastTuple`` broadcast, done by sharding)."""
     sh = NamedSharding(mesh, P())
-    return jax.tree.map(
-        lambda v: jax.make_array_from_process_local_data(sh, np.asarray(v)),
-        tree)
+    with span("broadcast", path="multihost_replicate"):
+        return jax.tree.map(
+            lambda v: jax.make_array_from_process_local_data(sh, np.asarray(v)),
+            tree)
 
 
 def save_checkpoint_process0(model, path: str) -> Optional[str]:
@@ -128,11 +132,13 @@ def save_checkpoint_process0(model, path: str) -> Optional[str]:
     replicated params are fully addressable on every host, so rank 0
     serializes and everyone else synchronizes."""
     from jax.experimental import multihost_utils
-    if is_coordinator():
-        from deeplearning4j_tpu.util.model_serializer import write_model
-        write_model(model, path)
-        result = path
-    else:
-        result = None
-    multihost_utils.sync_global_devices("checkpoint_write")
+    with span("checkpoint", op="process0_save",
+              process=jax.process_index()):
+        if is_coordinator():
+            from deeplearning4j_tpu.util.model_serializer import write_model
+            write_model(model, path)
+            result = path
+        else:
+            result = None
+        multihost_utils.sync_global_devices("checkpoint_write")
     return result

@@ -34,22 +34,29 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator, DataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.monitor import span
 from deeplearning4j_tpu.nn.observed import clear_pending_sync
 from deeplearning4j_tpu.optimize.training_stats import TrainingStats
 from deeplearning4j_tpu.parallel.mesh import MeshContext, make_mesh
 
+# TrainingStats keeps the reference's phase vocabulary (data_wait/stage/
+# step/average — CommonSparkTrainingStats names, pinned by its tests);
+# the monitor trace uses the canonical cross-path span names.
+_SPAN_NAME = {"data_wait": "data_load", "stage": "stage",
+              "step": "device_step", "average": "all_reduce"}
+
 
 def _timed_batches(it: DataSetIterator, stats: Optional[TrainingStats]):
-    """Drain an iterator, attributing blocking time to ``data_wait``."""
-    if stats is None:
-        yield from it
-        return
+    """Drain an iterator, attributing blocking time to ``data_wait`` /
+    span ``data_load``."""
     it.reset()  # keep the for-loop protocol's __iter__ -> reset() semantics
     while True:
-        with stats.time("data_wait"):
-            if not it.has_next():
-                return
-            ds = it.next()
+        with span("data_load", path="parallel_fit"):
+            with (stats.time("data_wait") if stats is not None
+                  else contextlib.nullcontext()):
+                if not it.has_next():
+                    return
+                ds = it.next()
         yield ds
 
 
@@ -97,11 +104,15 @@ class ParallelWrapper:
 
     @contextlib.contextmanager
     def _phase(self, name: str):
-        if self.stats is None:
-            yield
-        else:
-            with self.stats.time(name):
+        # always a monitor span (one clock, many consumers); TrainingStats
+        # additionally aggregates when collect_stats=True
+        with span(_SPAN_NAME.get(name, name), mode=self.mode,
+                  workers=self.workers):
+            if self.stats is None:
                 yield
+            else:
+                with self.stats.time(name):
+                    yield
 
     # ------------------------------------------------------------- allreduce
 
@@ -155,8 +166,14 @@ class ParallelWrapper:
                 lambda v: jnp.broadcast_to(jnp.mean(v, axis=0, keepdims=True), v.shape), t)
             return mean(params), {"step": opt_state["step"], "updater": mean(opt_state["updater"])}
 
-        self._vstep = jax.jit(vstep, donate_argnums=(0, 1, 2))
-        self._avg = jax.jit(avg, donate_argnums=(0, 1))
+        # donation keeps the worker-replicated params in-place on TPU;
+        # on the CPU backend the vmapped-donation aliasing corrupts the
+        # heap (later, unrelated XLA compiles segfault — reproduced with
+        # test_aux_parity::test_listeners_see_fresh_params_in_averaging_mode
+        # followed by any fresh compile), so donate only off-CPU
+        donate = jax.default_backend() != "cpu"
+        self._vstep = jax.jit(vstep, donate_argnums=(0, 1, 2) if donate else ())
+        self._avg = jax.jit(avg, donate_argnums=(0, 1) if donate else ())
 
     def _fit_averaging(self, it: DataSetIterator):
         m = self.model
@@ -224,10 +241,11 @@ class ParallelWrapper:
                 avg0 = lambda t: jax.tree.map(lambda v: jnp.mean(v, axis=0), t)
 
                 def _sync(wp=wparams, wo=wopt, ws=wstates, avg=did_avg):
-                    m.params = take0(wp) if avg else avg0(wp)
-                    m.opt_state = take0(wo) if avg else \
-                        {"step": wo["step"][0], "updater": avg0(wo["updater"])}
-                    m.states = avg0(ws)
+                    with span("averaging_sync", workers=W):
+                        m.params = take0(wp) if avg else avg0(wp)
+                        m.opt_state = take0(wo) if avg else \
+                            {"step": wo["step"][0], "updater": avg0(wo["updater"])}
+                        m.states = avg0(ws)
 
                 m._observer_sync = _sync
             for h in self.hooks:

@@ -19,6 +19,7 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+from deeplearning4j_tpu.monitor import get_registry, span
 from deeplearning4j_tpu.streaming.broker import MessageBroker
 from deeplearning4j_tpu.streaming.serde import (
     dataset_from_bytes, dataset_to_bytes, ndarray_from_bytes, ndarray_to_bytes)
@@ -57,11 +58,12 @@ class StreamingDataSetIterator(DataSetIterator):
 
     def _pull(self) -> bool:
         """Fetch one message into the buffer; False on stop/timeout."""
-        payload = self.broker.consume(self.topic, timeout=self.idle_timeout)
-        if payload is None or payload == _STOP:
-            self._stopped = True
-            return False
-        ds = dataset_from_bytes(payload)
+        with span("data_load", path="stream_consume", topic=self.topic):
+            payload = self.broker.consume(self.topic, timeout=self.idle_timeout)
+            if payload is None or payload == _STOP:
+                self._stopped = True
+                return False
+            ds = dataset_from_bytes(payload)
         self._buffer.append(ds)
         self._buffered += ds.num_examples()
         return True
@@ -131,9 +133,22 @@ class StreamingTrainer:
         self._error: Optional[BaseException] = None
 
     def run(self, max_batches: Optional[int] = None) -> int:
+        reg = get_registry()
+        batches = reg.counter("dl4j_stream_batches_total",
+                              "Micro-batches fit from the stream",
+                              topic=self.iterator.topic)
+        examples = reg.counter("dl4j_stream_examples_total",
+                               "Examples fit from the stream",
+                               topic=self.iterator.topic)
         while self.iterator.has_next():
-            self.net.fit(self.iterator.next())
+            ds = self.iterator.next()
+            self.net.fit(ds)  # the model's own data_load/device_step spans
             self.batches_fit += 1
+            batches.inc()
+            examples.inc(ds.num_examples())
+            reg.gauge("dl4j_stream_buffer_examples",
+                      "Examples buffered awaiting a micro-batch",
+                      topic=self.iterator.topic).set(self.iterator._buffered)
             if max_batches is not None and self.batches_fit >= max_batches:
                 break
         return self.batches_fit
@@ -176,14 +191,21 @@ class StreamingInference:
         self._error: Optional[BaseException] = None
 
     def run(self, max_requests: Optional[int] = None) -> int:
+        requests = get_registry().counter(
+            "dl4j_stream_requests_total", "Inference requests served",
+            topic=self.in_topic)
         while True:
-            payload = self.broker.consume(self.in_topic, timeout=self.idle_timeout)
+            with span("data_load", path="stream_serve", topic=self.in_topic):
+                payload = self.broker.consume(self.in_topic,
+                                              timeout=self.idle_timeout)
             if payload is None or payload == _STOP:
                 break
-            x = ndarray_from_bytes(payload)
-            pred = np.asarray(self.net.output(x))
-            self.broker.publish(self.out_topic, ndarray_to_bytes(pred))
+            with span("inference", topic=self.in_topic):
+                x = ndarray_from_bytes(payload)
+                pred = np.asarray(self.net.output(x))
+                self.broker.publish(self.out_topic, ndarray_to_bytes(pred))
             self.served += 1
+            requests.inc()
             if max_requests is not None and self.served >= max_requests:
                 break
         return self.served

@@ -19,6 +19,8 @@ reference's remote listeners.
 
 Routes:
   GET  /                                  session index (HTML)
+  GET  /metrics                           Prometheus text exposition
+  GET  /healthz                           liveness + watchdog state (JSON)
   GET  /train/<session>[?worker=w]        dashboard (HTML, report.py)
   GET  /api/sessions                      ["s1", ...]
   GET  /api/sessions/<s>/workers          ["w0", ...]
@@ -36,11 +38,14 @@ from __future__ import annotations
 import html
 import json
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, quote, unquote, urlencode, urlparse
 
+from deeplearning4j_tpu.monitor import get_registry
+from deeplearning4j_tpu.monitor.step_health import NAN_COUNTER, SLOW_COUNTER
 from deeplearning4j_tpu.ui.report import render_html
 from deeplearning4j_tpu.ui.stats import StatsReport
 from deeplearning4j_tpu.ui.storage import StatsStorage
@@ -79,6 +84,10 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if not parts:
                 return self._html(self._index())
+            if parts == ["metrics"]:
+                return self._metrics()
+            if parts == ["healthz"]:
+                return self._healthz()
             if parts[0] == "train" and len(parts) == 2:
                 return self._html(render_html(self.storage, parts[1], worker))
             if parts[0] == "api":
@@ -121,6 +130,35 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"ok": True})
         except Exception as e:
             return self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+    # ------------------------------------------- /metrics + /healthz
+    # (the monitor/ registry exposition: Prometheus scrape target + the
+    # k8s-style liveness probe the reference's Dropwizard admin port
+    # provided via its healthcheck servlet)
+
+    @property
+    def registry(self):
+        reg = self.server._registry  # type: ignore[attr-defined]
+        return reg if reg is not None else get_registry()
+
+    def _metrics(self):
+        body = self.registry.prometheus_text().encode()
+        return self._send(200, body,
+                          "text/plain; version=0.0.4; charset=utf-8")
+
+    def _healthz(self):
+        reg = self.registry
+        nan = reg.family_total(NAN_COUNTER)
+        slow = reg.family_total(SLOW_COUNTER)
+        status = "ok" if nan == 0 else "degraded"
+        return self._json({
+            "status": status,
+            "nan_scores": int(nan),
+            "slow_steps": int(slow),
+            "sessions": len(self.storage.list_sessions()),
+            "uptime_s": round(time.monotonic()
+                              - self.server._started_at, 3),  # type: ignore
+        }, 200 if status == "ok" else 503)
 
     # ------------------------------------------------------ /tsne view
     # (``deeplearning4j-ui-resources/.../ui/tsne/`` dashboard role: the
@@ -337,7 +375,7 @@ class UiServer:
     def __init__(self, storage: StatsStorage, port: int = 0,
                  host: str = "127.0.0.1", verbose: bool = False,
                  word_vectors=None, model=None, conv_listener=None,
-                 flow_listener=None, tsne=None):
+                 flow_listener=None, tsne=None, registry=None):
         """``word_vectors``: any object with ``words_nearest(word, n)``
         (Word2Vec/WordVectors) — enables the /words nearest-neighbor
         view (legacy dl4j-scaleout/deeplearning4j-nlp render role).
@@ -348,10 +386,14 @@ class UiServer:
         /activations with training-time snapshots. ``tsne``: a
         ``(coords [N,2], labels [N])`` pair for the /tsne scatter view
         (``plot/tsne.py`` output; also settable later via
-        ``set_tsne`` or POST /api/tsne)."""
+        ``set_tsne`` or POST /api/tsne). ``registry``: MetricsRegistry
+        served at /metrics + /healthz (default: the process-wide one the
+        monitor spans/listeners/watchdogs publish into)."""
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd._storage = storage  # type: ignore[attr-defined]
         self._httpd._verbose = verbose  # type: ignore[attr-defined]
+        self._httpd._registry = registry  # type: ignore[attr-defined]
+        self._httpd._started_at = time.monotonic()  # type: ignore[attr-defined]
         self._httpd._word_vectors = word_vectors  # type: ignore[attr-defined]
         self._httpd._flow_model = model  # type: ignore[attr-defined]
         self._httpd._conv_listener = conv_listener  # type: ignore[attr-defined]

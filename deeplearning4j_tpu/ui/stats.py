@@ -67,6 +67,20 @@ class StatsReport:
             if d.get(k):
                 d[k] = {kk: (float("nan") if v is None else v)
                         for kk, v in d[k].items()}
+        if d.get("param_histograms"):
+            # undo to_dict's non-finite scrubbing here too (a diverged
+            # run's histogram min/max serialize as null): round-trip must
+            # restore the same NaNs param_norms/update_norms/memory get
+            def unscrub(v):
+                if v is None:
+                    return float("nan")
+                if isinstance(v, dict):
+                    return {k: unscrub(x) for k, x in v.items()}
+                if isinstance(v, list):
+                    return [unscrub(x) for x in v]
+                return v
+            d["param_histograms"] = {k: unscrub(v)
+                                     for k, v in d["param_histograms"].items()}
         return StatsReport(**d)
 
 
@@ -97,15 +111,37 @@ class StatsListener(IterationListener):
 
     def __init__(self, storage, frequency: int = 1, session_id: str = "default",
                  worker_id: str = "worker0", histograms: bool = False,
-                 histogram_bins: int = 20):
+                 histogram_bins: int = 20, registry=None):
+        """``registry``: a :class:`~deeplearning4j_tpu.monitor.MetricsRegistry`
+        to publish score/duration samples into (default: the process-wide
+        one) — the listener is a registry consumer, not a private clock."""
         self.storage = storage
         self.frequency = max(1, frequency)
         self.session_id = session_id
         self.worker_id = worker_id
         self.histograms = histograms
         self.histogram_bins = histogram_bins
-        self._last_time: Optional[float] = None
+        self._registry = registry
+        # (time, iteration) of the previous *report*, so duration_ms is
+        # the windowed per-iteration mean, not the last single gap
+        self._last_report: Optional[tuple] = None
         self._last_norms: Optional[Dict[str, float]] = None
+
+    def _publish_metrics(self, score: float, duration_ms: float) -> None:
+        """Publish into the process registry (monitor/) so /metrics serves
+        the same samples the storage gets — one clock, many consumers."""
+        from deeplearning4j_tpu.monitor import get_registry
+        reg = self._registry if self._registry is not None else get_registry()
+        labels = dict(session=self.session_id, worker=self.worker_id)
+        if np.isfinite(score):
+            reg.gauge("dl4j_score", "Latest training score", **labels).set(score)
+        else:
+            reg.counter("dl4j_nan_scores_total",
+                        "Iterations with a non-finite score", **labels).inc()
+        if np.isfinite(duration_ms):
+            reg.histogram("dl4j_step_duration_ms",
+                          "Per-iteration host step duration",
+                          **labels).observe(duration_ms)
 
     def _device_memory(self) -> Dict[str, float]:
         out: Dict[str, float] = {}
@@ -128,13 +164,19 @@ class StatsListener(IterationListener):
         return out
 
     def iteration_done(self, model, iteration, score):
-        now = time.perf_counter()
-        duration = float("nan")
-        if self._last_time is not None:
-            duration = (now - self._last_time) * 1000.0
-        self._last_time = now
         if iteration % self.frequency != 0:
             return
+        now = time.perf_counter()
+        duration = float("nan")
+        if self._last_report is not None:
+            # mean per-iteration duration over the whole reporting window
+            # (with frequency > 1 the previous behavior reported only the
+            # last single iteration's gap)
+            t0, it0 = self._last_report
+            span_iters = max(1, iteration - it0)
+            duration = (now - t0) * 1000.0 / span_iters
+        self._last_report = (now, iteration)
+        self._publish_metrics(float(score), duration)
         report = StatsReport(
             session_id=self.session_id, worker_id=self.worker_id,
             iteration=iteration, timestamp=time.time(), score=float(score),

@@ -90,14 +90,17 @@ def model_from_payload(payload: dict):
 
 def write_model(model, path: str, save_updater: bool = True) -> None:
     """``ModelSerializer.writeModel`` equivalent."""
+    from deeplearning4j_tpu.monitor import span
+
     payload = config_payload(model)
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr("configuration.json", json.dumps(payload, indent=2))
-        z.writestr("coefficients.npz", _npz_bytes(model.params))
-        z.writestr("modelState.npz", _npz_bytes(model.states))
-        if save_updater and model.opt_state is not None:
-            z.writestr("updaterState.npz", _npz_bytes(
-                {"step": model.opt_state["step"], "updater": model.opt_state["updater"]}))
+    with span("checkpoint", op="zip_save", path=path):
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("configuration.json", json.dumps(payload, indent=2))
+            z.writestr("coefficients.npz", _npz_bytes(model.params))
+            z.writestr("modelState.npz", _npz_bytes(model.states))
+            if save_updater and model.opt_state is not None:
+                z.writestr("updaterState.npz", _npz_bytes(
+                    {"step": model.opt_state["step"], "updater": model.opt_state["updater"]}))
 
 
 def restore_multi_layer_network(path: str, load_updater: bool = True):

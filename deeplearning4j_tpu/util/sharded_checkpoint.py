@@ -23,6 +23,8 @@ from typing import Optional
 
 import jax
 
+from deeplearning4j_tpu.monitor import span
+
 
 def _checkpointer():
     import orbax.checkpoint as ocp
@@ -36,11 +38,12 @@ def save_checkpoint(model, directory: str) -> str:
 
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
-    state = {"params": model.params, "opt_state": model.opt_state,
-             "states": model.states}
-    _checkpointer().save(os.path.join(directory, "state"), state, force=True)
-    with open(os.path.join(directory, "configuration.json"), "w") as f:
-        json.dump(config_payload(model), f, indent=2)
+    with span("checkpoint", op="sharded_save", dir=directory):
+        state = {"params": model.params, "opt_state": model.opt_state,
+                 "states": model.states}
+        _checkpointer().save(os.path.join(directory, "state"), state, force=True)
+        with open(os.path.join(directory, "configuration.json"), "w") as f:
+            json.dump(config_payload(model), f, indent=2)
     return directory
 
 
@@ -84,8 +87,9 @@ def restore_checkpoint(directory: str, model=None, shardings=None):
         return ocp.RestoreArgs(restore_type=_np.ndarray)
 
     restore_args = jax.tree.map(_arg, template)
-    restored = _checkpointer().restore(os.path.join(directory, "state"),
-                                       restore_args=restore_args)
+    with span("checkpoint", op="sharded_restore", dir=directory):
+        restored = _checkpointer().restore(os.path.join(directory, "state"),
+                                           restore_args=restore_args)
     model.params = restored["params"]
     model.opt_state = restored["opt_state"]
     model.states = restored["states"]

@@ -4,8 +4,11 @@ The observability stack end-to-end (``UiServer.java:25`` role): a
 StatsListener streams score/norm/histogram reports into a storage the
 UiServer serves at ``/train/<session>``, a ConvolutionalIterationListener
 renders per-conv-layer activation montages at ``/activations``, and a
-FlowIterationListener publishes the model graph at ``/flow``. Run it
-and open the printed URL.
+FlowIterationListener publishes the model graph at ``/flow``. The
+monitor/ layer rides along: phase spans trace to JSONL + a Perfetto-
+loadable Chrome trace (``--trace-dir``), a StepHealthWatchdog counts
+NaN/slow steps, and Prometheus metrics serve at ``/metrics`` (+
+``/healthz``). Run it and open the printed URLs.
 """
 
 try:  # script mode: examples/ is sys.path[0]
@@ -14,9 +17,11 @@ except ImportError:  # package mode: repo root already importable
     pass
 
 import argparse
+import os
 
 import numpy as np
 
+from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.inputs import InputType
@@ -35,7 +40,10 @@ from deeplearning4j_tpu.ui.activations import (
 from deeplearning4j_tpu.ui.stats import StatsListener
 
 
-def main(smoke: bool = False, port: int = 0, keep_serving: bool = False):
+def main(smoke: bool = False, port: int = 0, keep_serving: bool = False,
+         trace_dir: str = "/tmp/dl4j_tpu_trace"):
+    os.makedirs(trace_dir, exist_ok=True)
+    monitor.enable_tracing(os.path.join(trace_dir, "events.jsonl"))
     rng = np.random.default_rng(0)
     side, n, epochs = (10, 64, 2) if smoke else (28, 4096, 12)
     x = rng.standard_normal((n, side, side, 1)).astype(np.float32)
@@ -56,19 +64,29 @@ def main(smoke: bool = False, port: int = 0, keep_serving: bool = False):
     storage = InMemoryStatsStorage()
     conv = ConvolutionalIterationListener(x[:2], frequency=2)
     flow = FlowIterationListener(frequency=2)
-    net.set_listeners(StatsListener(storage, frequency=1), conv, flow)
+    watchdog = monitor.StepHealthWatchdog()
+    net.set_listeners(StatsListener(storage, frequency=1), conv, flow,
+                      watchdog)
 
     srv = UiServer(storage, port=port, conv_listener=conv,
                    flow_listener=flow, model=net).start()
     print(f"dashboard: {srv.url}  (train view: {srv.url}/train/default, "
-          f"activations: {srv.url}/activations, graph: {srv.url}/flow)")
+          f"activations: {srv.url}/activations, graph: {srv.url}/flow, "
+          f"metrics: {srv.url}/metrics, health: {srv.url}/healthz)")
 
     ds = DataSet(x, y)
     for _ in range(epochs):
         net.fit(ds)
+    tracer = monitor.disable_tracing()
+    trace_path = tracer.export_chrome_trace(
+        os.path.join(trace_dir, "trace.json"))
     print(f"final score {net.score():.4f}; "
           f"{len(storage.get_reports('default'))} reports, "
-          f"{len(conv.latest)} activation images")
+          f"{len(conv.latest)} activation images; "
+          f"healthy={watchdog.healthy()}")
+    print(f"phase breakdown: {monitor.phase_breakdown()}")
+    print(f"Perfetto trace: {trace_path} (open at https://ui.perfetto.dev), "
+          f"events: {os.path.join(trace_dir, 'events.jsonl')}")
 
     if keep_serving:
         print("serving until interrupted...")
@@ -84,4 +102,5 @@ if __name__ == "__main__":
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--keep-serving", action="store_true")
+    ap.add_argument("--trace-dir", default="/tmp/dl4j_tpu_trace")
     main(**vars(ap.parse_args()))

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Validate emitted telemetry against the checked-in schema.
+
+Guards the three monitor/ wire formats against drift (a renamed field
+silently breaks every downstream consumer — Perfetto, Prometheus
+scrapers, BENCH attribution):
+
+- JSONL event streams (``monitor.enable_tracing(jsonl_path=...)``)
+- Chrome ``trace_event`` JSON exports (``PhaseTracer.chrome_trace``)
+- Prometheus text exposition (``MetricsRegistry.prometheus_text`` /
+  ``UiServer /metrics``)
+
+Importable (``tests/test_monitor.py`` wires it into tier-1) and a CLI::
+
+    python scripts/check_telemetry_schema.py run/events.jsonl \
+        run/trace.json --metrics metrics.txt
+
+Exit 0 when everything validates; 1 with one line per violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Any, Dict, Iterable, List
+
+# ----------------------------------------------------------- JSONL events
+
+EVENT_TYPES = {"span", "event"}
+# required key -> allowed python types, per event type
+SPAN_KEYS = {"type": str, "name": str, "ts_us": (int, float),
+             "dur_us": (int, float), "pid": int, "tid": int}
+INSTANT_KEYS = {"type": str, "name": str, "ts_us": (int, float),
+                "pid": int, "tid": int}
+OPTIONAL_KEYS = {"attrs": dict}
+
+
+def validate_event(obj: Any, where: str = "event") -> List[str]:
+    errors: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: not a JSON object"]
+    etype = obj.get("type")
+    if etype not in EVENT_TYPES:
+        return [f"{where}: type {etype!r} not in {sorted(EVENT_TYPES)}"]
+    required = SPAN_KEYS if etype == "span" else INSTANT_KEYS
+    for key, types in required.items():
+        if key not in obj:
+            errors.append(f"{where}: missing required key {key!r}")
+        elif not isinstance(obj[key], types):
+            errors.append(f"{where}: key {key!r} has type "
+                          f"{type(obj[key]).__name__}")
+    for key in obj:
+        if key not in required and key not in OPTIONAL_KEYS:
+            errors.append(f"{where}: unknown key {key!r}")
+    if "attrs" in obj and not isinstance(obj["attrs"], dict):
+        errors.append(f"{where}: attrs must be an object")
+    if not errors:
+        if not obj["name"]:
+            errors.append(f"{where}: empty name")
+        if obj["ts_us"] < 0:
+            errors.append(f"{where}: negative ts_us")
+        if etype == "span" and obj["dur_us"] < 0:
+            errors.append(f"{where}: negative dur_us")
+    return errors
+
+
+def validate_events_lines(lines: Iterable[str],
+                          where: str = "events") -> List[str]:
+    errors: List[str] = []
+    n = 0
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        n += 1
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            errors.append(f"{where}:{i}: invalid JSON: {e}")
+            continue
+        errors.extend(validate_event(obj, f"{where}:{i}"))
+    if n == 0:
+        errors.append(f"{where}: no events (empty stream)")
+    return errors
+
+
+def validate_events_file(path: str) -> List[str]:
+    with open(path) as f:
+        return validate_events_lines(f, path)
+
+
+# ------------------------------------------------------ Chrome trace JSON
+
+def validate_chrome_trace(obj: Any, where: str = "trace") -> List[str]:
+    errors: List[str] = []
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        return [f"{where}: must be an object with a traceEvents array"]
+    events = obj["traceEvents"]
+    if not isinstance(events, list):
+        return [f"{where}: traceEvents is not an array"]
+    phases_seen = 0
+    for i, e in enumerate(events):
+        w = f"{where}.traceEvents[{i}]"
+        if not isinstance(e, dict):
+            errors.append(f"{w}: not an object")
+            continue
+        ph = e.get("ph")
+        if ph not in ("X", "i", "M", "B", "E"):
+            errors.append(f"{w}: unknown ph {ph!r}")
+            continue
+        if "name" not in e or "pid" not in e:
+            errors.append(f"{w}: missing name/pid")
+        if ph == "X":
+            phases_seen += 1
+            for k in ("ts", "dur", "tid"):
+                if not isinstance(e.get(k), (int, float)):
+                    errors.append(f"{w}: ph=X needs numeric {k}")
+        if ph == "i" and not isinstance(e.get("ts"), (int, float)):
+            errors.append(f"{w}: ph=i needs numeric ts")
+    if phases_seen == 0:
+        errors.append(f"{where}: no complete (ph=X) span events")
+    return errors
+
+
+def validate_chrome_trace_file(path: str) -> List[str]:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except json.JSONDecodeError as e:
+        return [f"{path}: invalid JSON: {e}"]
+    return validate_chrome_trace(obj, path)
+
+
+# -------------------------------------------------- Prometheus exposition
+
+_METRIC_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^}]*\})?"
+    r" (?P<value>-?[0-9.eE+]+|NaN|\+Inf|-Inf)"
+    r"( -?[0-9]+)?$")
+_LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+
+
+def _base_family(name: str, families: Dict[str, str]) -> str:
+    """Map a sample name to its declared family (histogram samples use
+    the ``_bucket``/``_sum``/``_count`` suffixes)."""
+    if name in families:
+        return name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix) and name[:-len(suffix)] in families:
+            return name[:-len(suffix)]
+    return name
+
+
+def validate_prometheus_text(text: str,
+                             where: str = "metrics") -> List[str]:
+    errors: List[str] = []
+    families: Dict[str, str] = {}  # name -> kind
+    samples: Dict[str, List[Dict[str, str]]] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        w = f"{where}:{i}"
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                errors.append(f"{w}: malformed TYPE line")
+                continue
+            if parts[2] in families:
+                errors.append(f"{w}: duplicate TYPE for {parts[2]}")
+            families[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue  # HELP/comments
+        m = _METRIC_RE.match(line)
+        if m is None:
+            errors.append(f"{w}: unparseable sample: {line!r}")
+            continue
+        name = m.group("name")
+        labels: Dict[str, str] = {}
+        raw = (m.group("labels") or "{}")[1:-1]
+        if raw:
+            for part in raw.split(","):
+                if not _LABEL_RE.match(part):
+                    errors.append(f"{w}: malformed label {part!r}")
+                    continue
+                k, v = part.split("=", 1)
+                labels[k] = v[1:-1]
+        fam = _base_family(name, families)
+        if fam not in families:
+            errors.append(f"{w}: sample {name} has no preceding # TYPE")
+            continue
+        samples.setdefault(fam, []).append(
+            {"name": name, "labels": labels, "value": m.group("value")})
+    # histogram families must ship the full bucket/sum/count triple with a
+    # +Inf bucket whose count equals _count
+    for fam, kind in families.items():
+        fam_samples = samples.get(fam, [])
+        if not fam_samples:
+            errors.append(f"{where}: family {fam} declared but no samples")
+            continue
+        if kind != "histogram":
+            continue
+        names = {s["name"] for s in fam_samples}
+        for suffix in ("_bucket", "_sum", "_count"):
+            if fam + suffix not in names:
+                errors.append(f"{where}: histogram {fam} missing {suffix}")
+        by_key: Dict[tuple, Dict[str, float]] = {}
+        for s in fam_samples:
+            key = tuple(sorted((k, v) for k, v in s["labels"].items()
+                               if k != "le"))
+            slot = by_key.setdefault(key, {})
+            if s["name"] == fam + "_bucket" and s["labels"].get("le") == "+Inf":
+                slot["inf"] = float(s["value"])
+            if s["name"] == fam + "_count":
+                slot["count"] = float(s["value"])
+        for key, slot in by_key.items():
+            if "inf" not in slot:
+                errors.append(f"{where}: histogram {fam}{dict(key)} "
+                              f"missing le=\"+Inf\" bucket")
+            elif slot.get("count") is not None and slot["inf"] != slot["count"]:
+                errors.append(f"{where}: histogram {fam}{dict(key)} +Inf "
+                              f"bucket {slot['inf']} != count {slot['count']}")
+    return errors
+
+
+def validate_prometheus_file(path: str) -> List[str]:
+    with open(path) as f:
+        return validate_prometheus_text(f.read(), path)
+
+
+# ---------------------------------------------------------------- CLI
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("paths", nargs="*",
+                    help=".jsonl = event stream, .json = Chrome trace")
+    ap.add_argument("--metrics", action="append", default=[],
+                    help="Prometheus text exposition file(s)")
+    args = ap.parse_args(argv)
+    if not args.paths and not args.metrics:
+        ap.error("nothing to validate")
+    errors: List[str] = []
+    for path in args.paths:
+        if path.endswith(".jsonl"):
+            errors.extend(validate_events_file(path))
+        else:
+            errors.extend(validate_chrome_trace_file(path))
+    for path in args.metrics:
+        errors.extend(validate_prometheus_file(path))
+    for e in errors:
+        print(e, file=sys.stderr)
+    total = len(args.paths) + len(args.metrics)
+    if not errors:
+        print(f"ok: {total} file(s) validated")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

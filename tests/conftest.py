@@ -17,6 +17,11 @@ import os
 # (same doctrine as __graft_entry__._dryrun_in_subprocess).
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
+# jaxlib's ProfilerSession segfaults (C++-level, uncatchable) when created
+# in this harness after donated-buffer programs have run; util/profiler
+# degrades to its documented warn-and-no-op path under this switch. The
+# monitor/ host-side spans are unaffected and fully tested.
+os.environ["DL4J_TPU_DISABLE_DEVICE_TRACE"] = "1"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -36,6 +41,11 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
+# numpy.testing's import-time SVE probe spawns a subprocess; forking from
+# this process becomes unreliable (C-level segfault in the parent) once
+# enough XLA state has accumulated, so force the probe NOW while fork is
+# still safe — later lazy `np.testing` imports then hit the module cache.
+import numpy.testing  # noqa: E402,F401
 import pytest  # noqa: E402
 
 

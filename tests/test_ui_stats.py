@@ -5,14 +5,18 @@ Parity: ``StatsListener.java:46-187``, ``StatsStorage.java`` +
 (static HTML export here).
 """
 
+import json
+
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.ui import (
-    FileStatsStorage, InMemoryStatsStorage, StatsListener, render_html, save_report)
+    FileStatsStorage, InMemoryStatsStorage, StatsListener, StatsReport,
+    render_html, save_report)
 
 
 def _train(storage, rng, histograms=False, n_iters=6):
@@ -68,3 +72,92 @@ def test_change_listener(rng):
     storage.add_listener(lambda r: seen.append(r.iteration))
     _train(storage, rng, n_iters=3)
     assert len(seen) == 3
+
+
+def _scripted_clock(values):
+    """Fake perf_counter: scripted readings, then keep ticking — patching
+    the stdlib attribute is process-wide, so stray callers in other
+    threads must not exhaust the script."""
+    state = {"i": 0, "last": values[-1]}
+
+    def clock():
+        i = state["i"]
+        if i < len(values):
+            state["i"] = i + 1
+            return values[i]
+        state["last"] += 1.0
+        return state["last"]
+
+    return clock
+
+
+def test_duration_is_windowed_mean_with_frequency(monkeypatch):
+    """With frequency > 1, duration_ms must be the mean per-iteration
+    duration over the whole reporting window — not the gap since the
+    last single call (the bug this pins down reported only the final
+    iteration's duration)."""
+    import types
+
+    from deeplearning4j_tpu.ui import stats as stats_mod
+
+    # the clock is read on report iterations only (2 and 4)
+    monkeypatch.setattr(stats_mod.time, "perf_counter",
+                        _scripted_clock([11.0, 20.0]))
+    storage = InMemoryStatsStorage()
+    listener = stats_mod.StatsListener(storage, frequency=2)
+    model = types.SimpleNamespace(params=None)
+    for it in range(1, 5):
+        listener.iteration_done(model, it, 0.5)
+    reports = storage.get_reports("default")
+    assert [r.iteration for r in reports] == [2, 4]
+    assert np.isnan(reports[0].duration_ms)  # no prior report window
+    # window it2(t=11) -> it4(t=20): 9s over 2 iterations = 4500ms/iter
+    # (the pre-fix behavior reported the last gap alone: 8000ms)
+    assert reports[1].duration_ms == pytest.approx(4500.0)
+
+
+def test_duration_windowed_mean_publishes_to_registry(monkeypatch):
+    import types
+
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.ui import stats as stats_mod
+
+    reg = monitor.MetricsRegistry()
+    monkeypatch.setattr(stats_mod.time, "perf_counter",
+                        _scripted_clock([1.0, 2.0]))
+    listener = stats_mod.StatsListener(InMemoryStatsStorage(), registry=reg)
+    model = types.SimpleNamespace(params=None)
+    listener.iteration_done(model, 1, 0.25)
+    listener.iteration_done(model, 2, float("nan"))
+    assert reg.get("dl4j_score", session="default",
+                   worker="worker0").value == 0.25
+    assert reg.family_total("dl4j_nan_scores_total") == 1
+    hist = reg.get("dl4j_step_duration_ms", session="default",
+                   worker="worker0")
+    assert hist.count == 1 and hist.sum == pytest.approx(1000.0)
+
+
+def test_from_dict_restores_histogram_nans():
+    """to_dict scrubs non-finite floats to null for strict JSON; the
+    round-trip must restore param_histograms the way it already restores
+    param_norms/update_norms/memory (a diverged run's histogram min/max
+    are NaN)."""
+    report = StatsReport(
+        session_id="s", worker_id="w", iteration=3, timestamp=1.0,
+        score=float("nan"),
+        param_norms={"l0/W": float("nan")},
+        param_histograms={"l0/W": {"counts": [1, 2, 3],
+                                   "min": float("nan"),
+                                   "max": float("inf")}})
+    back = StatsReport.from_dict(json.loads(json.dumps(report.to_dict())))
+    assert np.isnan(back.score) and np.isnan(back.param_norms["l0/W"])
+    h = back.param_histograms["l0/W"]
+    assert h["counts"] == [1, 2, 3]
+    assert np.isnan(h["min"]) and np.isnan(h["max"])  # inf scrubs to null too
+    # finite payloads round-trip exactly
+    fin = StatsReport(session_id="s", worker_id="w", iteration=4,
+                      timestamp=2.0, score=0.5, duration_ms=2.5,
+                      param_histograms={"l0/W": {"counts": [4],
+                                                 "min": -1.0, "max": 1.0}})
+    assert StatsReport.from_dict(
+        json.loads(json.dumps(fin.to_dict()))) == fin
