@@ -316,6 +316,74 @@ def bench_mlp_iris():
             "vs_baseline": 1.0}  # reference publishes no number (BASELINE.md)
 
 
+def bench_mlp_per_step_fit():
+    """Per-step ``fit()`` path (NOT fit_scan) with the device-feed
+    pipeline on vs off — the host-loop overhead benchmark. Pipeline on:
+    prefetch-to-device staging thread, deferred score sync (no per-step
+    device round-trip), and a shape-bucketed ragged tail (one compiled
+    program across epochs). Pipeline off: the legacy loop with a
+    blocking ``float(score)`` + h2d transfer on the critical path every
+    iteration. Reports examples/sec both ways plus the feed-pipeline
+    monitor counters so the JSON attributes the gap."""
+    import time
+
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.default_rng(0)
+    batch = 4096
+    n = batch * 10 + 1234  # ragged tail exercises the bucketing stage
+    x = rng.standard_normal((n, 64)).astype(np.float32)
+    y = np.eye(8, dtype=np.float32)[rng.integers(0, 8, n)]
+    data = DataSet(x, y)
+
+    def build():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).learning_rate(0.05).updater("adam").activation("relu")
+                .compute_dtype("bfloat16")
+                .list()
+                .layer(DenseLayer(n_in=64, n_out=512))
+                .layer(DenseLayer(n_in=512, n_out=512))
+                .layer(OutputLayer(n_in=512, n_out=8, activation="softmax",
+                                   loss_function="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    counter_names = (monitor.SCORE_SYNC_COUNTER, monitor.JIT_CACHE_MISS_COUNTER,
+                     monitor.H2D_BYTES_COUNTER, monitor.FEED_PADDED_BATCHES_COUNTER)
+
+    def run(pipeline):
+        reg = monitor.get_registry()
+        net = build()
+        net.fit(ListDataSetIterator(data, batch), feed_pipeline=pipeline)  # warmup/compile
+        before = {c: reg.family_total(c) for c in counter_names}
+        epochs = 4
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            net.fit(ListDataSetIterator(data, batch), feed_pipeline=pipeline)
+        float(net.score())  # drain the dispatch queue before stopping the clock
+        dt = time.perf_counter() - t0
+        counters = {c: round(reg.family_total(c) - before[c], 1)
+                    for c in counter_names}
+        batches = n // batch + (1 if n % batch else 0)
+        return epochs * batches * batch / dt, counters
+
+    on_eps, on_counters = run(True)
+    off_eps, off_counters = run(False)
+    return {"metric": "mlp_per_step_fit_examples_per_sec_per_chip",
+            "value": round(on_eps, 1), "unit": "examples/sec/chip",
+            "pipeline_off_examples_per_sec": round(off_eps, 1),
+            "pipeline_speedup": round(on_eps / off_eps, 3),
+            "counters_pipeline_on": on_counters,
+            "counters_pipeline_off": off_counters,
+            # the comparable baseline is the legacy per-step loop itself
+            "vs_baseline": round(on_eps / off_eps, 3)}
+
+
 def bench_word2vec():
     """Word2Vec skip-gram (BASELINE config #5): the all-epochs-on-device
     SGNS scan engine (device pairgen + table negatives + capped MXU
@@ -396,7 +464,9 @@ def main():
 
     subs = {}
     for name, fn in [("gemm_bf16", bench_gemm), ("lenet_mnist", bench_lenet),
-                     ("mlp_iris", bench_mlp_iris), ("lstm_char", bench_lstm),
+                     ("mlp_iris", bench_mlp_iris),
+                     ("mlp_per_step_fit", bench_mlp_per_step_fit),
+                     ("lstm_char", bench_lstm),
                      ("resnet50", bench_resnet50),
                      ("flash_attention", bench_flash_attention),
                      ("flash_attention_train", bench_flash_attention_train),

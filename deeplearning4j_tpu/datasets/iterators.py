@@ -11,6 +11,7 @@ current one (the reference's device-affinity queue maps to
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Iterator, List, Optional
@@ -18,6 +19,15 @@ from typing import Iterator, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+
+
+def feed_pipeline_enabled(flag: Optional[bool] = None) -> bool:
+    """Resolve the device-feed pipeline switch: an explicit ``fit(...,
+    feed_pipeline=...)`` wins, else on unless
+    ``DL4J_TPU_DISABLE_FEED_PIPELINE=1`` (bench/debug kill-switch)."""
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get("DL4J_TPU_DISABLE_FEED_PIPELINE", "") != "1"
 
 
 class DataSetPreProcessor:
@@ -235,6 +245,268 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def batch(self):
         return self._wrapped.batch()
+
+    def close(self) -> None:
+        """Stop the worker without replaying the source — the
+        mid-epoch-abandon path (a fit() aborted by an exception must not
+        leave a producer thread spinning against a full queue)."""
+        self.reset()
+
+
+class DeviceFeedIterator(DataSetIterator):
+    """Device-staging prefetch stage: while the chip runs step N, a
+    background thread stages batch N+1 on device (``jax.device_put`` via
+    the ``place`` callable) into a bounded buffer — depth 2 is double
+    buffering, 3 triple. The reference's ``AsyncDataSetIterator``
+    device-affinity queue (:36-76) split the same way: a host-side
+    prepare stage (``AsyncDataSetIterator`` here) and a device-affine
+    staging hop; this class is that second hop, so the consumer's
+    ``data_load`` span shrinks to a queue handoff.
+
+    Payload-agnostic: wraps DataSet or MultiDataSet iterators;
+    ``place(batch) -> staged batch`` runs on the worker thread (default
+    identity — the containers pass their dtype/sharding-aware stagers).
+    A worker-side exception is re-raised on the consumer thread instead
+    of silently truncating the epoch."""
+
+    _SENTINEL = object()
+
+    def __init__(self, wrapped, depth: int = 2, place=None):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._wrapped = wrapped
+        self._depth = depth
+        self._place = place if place is not None else (lambda b: b)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._peeked: Optional[object] = None
+        self._exhausted = False
+        self._needs_reset = False
+        self._error: Optional[BaseException] = None
+
+    @staticmethod
+    def _depth_gauge():
+        # late-bound so bench/test registry swaps are picked up
+        from deeplearning4j_tpu.monitor import (FEED_QUEUE_DEPTH_GAUGE,
+                                                get_registry)
+        return get_registry().gauge(
+            FEED_QUEUE_DEPTH_GAUGE,
+            "Batches staged on device awaiting the step loop")
+
+    def _worker(self, q: "queue.Queue", stop: threading.Event):
+        try:
+            while not stop.is_set() and self._wrapped.has_next():
+                item = self._wrapped.next()
+                staged = self._place(item)
+                while not stop.is_set():
+                    try:
+                        q.put(staged, timeout=0.1)
+                        self._depth_gauge().set(q.qsize())
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # surfaced on the consumer thread
+            self._error = e
+        finally:
+            # the sentinel MUST reach the consumer (same stop-aware
+            # retry as AsyncDataSetIterator — see comment there)
+            while not stop.is_set():
+                try:
+                    q.put(self._SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def _start(self):
+        if self._needs_reset:
+            self._wrapped.reset()
+            self._needs_reset = False
+        self._error = None
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._thread = threading.Thread(target=self._worker,
+                                        args=(self._queue, self._stop),
+                                        daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            self._thread.join()
+        self._thread = None
+        self._peeked = None
+        self._exhausted = False
+        self._needs_reset = True
+
+    close = reset  # abandon == reset-without-restart (lazy restart)
+
+    def __del__(self):
+        # GC backstop for an abandoned iterator: release the worker from
+        # its bounded-queue put loop (no join — never block finalizers)
+        try:
+            self._stop.set()
+        except Exception:
+            pass
+
+    def has_next(self):
+        if self._peeked is not None:
+            return True
+        if self._exhausted:
+            return False
+        if self._thread is None:
+            self._start()
+        item = self._queue.get()
+        self._depth_gauge().set(self._queue.qsize())
+        if item is self._SENTINEL:
+            self._exhausted = True
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            return False
+        self._peeked = item
+        return True
+
+    def _next_impl(self):
+        if not self.has_next():
+            raise StopIteration
+        item = self._peeked
+        self._peeked = None
+        return item
+
+    def async_supported(self) -> bool:
+        return False  # already a background stage — never double-wrap
+
+    def set_pre_processor(self, pp) -> None:
+        self._wrapped.set_pre_processor(pp)  # runs on the worker thread
+
+    def pre_processor(self):
+        return self._wrapped.pre_processor()
+
+    def batch(self):
+        return self._wrapped.batch()
+
+
+# ----------------------------------------------------- shape bucketing
+
+def _ones_label_mask(labels: np.ndarray, n_valid: int, n_total: int) -> np.ndarray:
+    """Labels mask marking the first ``n_valid`` of ``n_total`` rows
+    valid: [n_total] for per-example labels, [n_total, T] for
+    per-timestep ([b, T, nOut] dense or [b, T] sparse-id) labels."""
+    if labels.ndim >= 3 or (labels.ndim == 2
+                            and np.issubdtype(labels.dtype, np.integer)):
+        shape = (n_total, labels.shape[1])
+    else:
+        shape = (n_total,)
+    m = np.zeros(shape, np.float32)
+    m[:n_valid] = 1.0
+    return m
+
+
+def _pad_rows(a: np.ndarray, pad: int) -> np.ndarray:
+    return np.concatenate(
+        [a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)], axis=0)
+
+
+class ShapeBucketingIterator(DataSetIterator):
+    """Pads ragged tail batches up to the canonical batch size so every
+    ragged shape a fit() run produces dispatches ONE compiled program.
+
+    ``_ListBatchCore`` emits a smaller final batch; its fresh shape
+    misses the jit cache and pays a full trace+compile (and a stream of
+    heterogeneous batch sizes pays one per distinct size). This wrapper
+    pads the tail with zero rows and emits a labels mask that (a) zeroes
+    the padded rows out of the loss — the masked mean divides by the
+    REAL example count, so the score is exactly the unpadded batch's —
+    and (b) makes their gradient contribution an exact float zero (a
+    zero loss row back-propagates 0 · x = 0). Full batches pass through
+    UNTOUCHED: they keep dispatching the exact legacy unmasked program
+    (no semantic or last-ulp drift on the common path), while every
+    ragged size folds into one canonical masked program. The bucketing
+    parity test asserts bitwise-identical params/scores for the padded
+    tail step against the unpadded run (ops/losses.py ``_masked_mean``
+    reproduces ``jnp.mean``'s exact roundings for that).
+
+    Exactness holds for per-example-independent layers; networks with
+    cross-batch statistics (BatchNormalization batch moments, MoE
+    load-balancing aux loss) must not be padded — the containers gate on
+    ``LayerImpl.batch_statistics`` and skip this wrapper. Batches that
+    already carry masks, or have no labels, pass through untouched.
+    Payload-agnostic (DataSet or MultiDataSet)."""
+
+    def __init__(self, wrapped, batch_size: Optional[int] = None):
+        self._wrapped = wrapped
+        b = batch_size if batch_size is not None else wrapped.batch()
+        self._canon: Optional[int] = b if b and b > 0 else None
+
+    @staticmethod
+    def _count_padded():
+        from deeplearning4j_tpu.monitor import (FEED_PADDED_BATCHES_COUNTER,
+                                                get_registry)
+        get_registry().counter(
+            FEED_PADDED_BATCHES_COUNTER,
+            "Ragged tail batches padded to the canonical shape").inc()
+
+    def _bucket_ds(self, ds: DataSet) -> DataSet:
+        if (ds.features_mask is not None or ds.labels_mask is not None
+                or ds.labels is None):
+            return ds
+        n = ds.num_examples()
+        if self._canon is None:
+            self._canon = n
+        target = self._canon
+        if n >= target:  # full batch: legacy program, untouched
+            return ds
+        self._count_padded()
+        labels = np.asarray(ds.labels)
+        feats = _pad_rows(np.asarray(ds.features), target - n)
+        return DataSet(feats, _pad_rows(labels, target - n), None,
+                       _ones_label_mask(labels, n, target))
+
+    def _bucket_mds(self, mds: MultiDataSet) -> MultiDataSet:
+        masked = any(m is not None for m in (mds.features_masks or [])) or \
+            any(m is not None for m in (mds.labels_masks or []))
+        if masked:
+            return mds
+        n = mds.num_examples()
+        if self._canon is None:
+            self._canon = n
+        target = self._canon
+        if n >= target:  # full batch: legacy program, untouched
+            return mds
+        self._count_padded()
+        labels = [np.asarray(l) for l in mds.labels]
+        pad = target - n
+        return MultiDataSet(
+            features=[_pad_rows(np.asarray(f), pad) for f in mds.features],
+            labels=[_pad_rows(l, pad) for l in labels],
+            labels_masks=[_ones_label_mask(l, n, target) for l in labels])
+
+    def _next_impl(self):
+        b = self._wrapped.next()
+        if isinstance(b, MultiDataSet):
+            return self._bucket_mds(b)
+        if isinstance(b, DataSet):
+            return self._bucket_ds(b)
+        return b
+
+    def reset(self):
+        self._wrapped.reset()
+
+    def has_next(self):
+        return self._wrapped.has_next()
+
+    def batch(self):
+        return self._wrapped.batch()
+
+    def async_supported(self) -> bool:
+        return self._wrapped.async_supported()
+
+    def set_pre_processor(self, pp) -> None:
+        self._wrapped.set_pre_processor(pp)  # pre-process REAL rows only
+
+    def pre_processor(self):
+        return self._wrapped.pre_processor()
 
 
 class MultipleEpochsIterator(DataSetIterator):

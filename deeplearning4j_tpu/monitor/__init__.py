@@ -18,9 +18,28 @@ Canonical span names threaded through the training paths:
 (host→device transfer/sharding), ``compile`` (first dispatch of a fresh
 program), ``device_step`` (compiled train step), ``all_reduce``
 (parameter averaging / collective), ``checkpoint``, ``eval``,
-``broadcast``, ``inference``. ``scripts/check_telemetry_schema.py``
+``broadcast``, ``inference``, ``score_sync`` (batched device→host score
+resolution of the deferred-score ring). ``scripts/check_telemetry_schema.py``
 validates the emitted streams.
+
+The device-feed pipeline (datasets/iterators.py + the fit() paths)
+publishes four counters/gauges under the names below so a BENCH round
+can attribute per-step fit() throughput to host-side stalls:
+``dl4j_feed_h2d_bytes_total`` (host→device staging traffic),
+``dl4j_feed_queue_depth`` (batches staged on device, awaiting the step
+loop), ``dl4j_feed_padded_batches_total`` (ragged tail batches padded
+to the canonical shape), ``dl4j_jit_cache_miss_total`` (train-step
+dispatches that had to trace+compile), ``dl4j_score_sync_total``
+(device→host score fetches — each one is a chip round-trip).
 """
+
+# Device-feed pipeline metric family names (one name, one meaning —
+# scripts/check_telemetry_schema.py pins these against drift).
+H2D_BYTES_COUNTER = "dl4j_feed_h2d_bytes_total"
+FEED_QUEUE_DEPTH_GAUGE = "dl4j_feed_queue_depth"
+FEED_PADDED_BATCHES_COUNTER = "dl4j_feed_padded_batches_total"
+JIT_CACHE_MISS_COUNTER = "dl4j_jit_cache_miss_total"
+SCORE_SYNC_COUNTER = "dl4j_score_sync_total"
 
 from deeplearning4j_tpu.monitor.registry import (  # noqa: F401
     Counter,

@@ -28,14 +28,23 @@ from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.datasets.iterators import (
     AsyncDataSetIterator,
     DataSetIterator,
+    DeviceFeedIterator,
     ListMultiDataSetIterator,
     MultiDataSetIterator,
+    ShapeBucketingIterator,
+    feed_pipeline_enabled,
 )
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.graph import GraphVertex, vertex_from_dict
-from deeplearning4j_tpu.monitor import span
+from deeplearning4j_tpu.monitor import H2D_BYTES_COUNTER, get_registry, span
 from deeplearning4j_tpu.nn.conf.layers import layer_from_dict
+from deeplearning4j_tpu.optimize.deferred import (
+    host_step,
+    note_dispatch,
+    score_sink,
+    set_host_step,
+)
 from deeplearning4j_tpu.nn.layers.base import build_layer
 from deeplearning4j_tpu.nn.observed import SyncedStateAttr
 from deeplearning4j_tpu.nn.updater import (
@@ -204,7 +213,11 @@ class ComputationGraph:
     # by ParallelWrapper's averaging mode (nn/observed.py)
     params = SyncedStateAttr("params")
     states = SyncedStateAttr("states")
-    opt_state = SyncedStateAttr("opt_state")
+    opt_state = SyncedStateAttr("opt_state", invalidates="_host_step_mirror")
+
+    # deferred score resolution (optimize/deferred.py) — same doctrine
+    # as MultiLayerNetwork; fit() flips it to the pipeline switch
+    _defer_scores = True
 
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
@@ -256,6 +269,8 @@ class ComputationGraph:
                         frontier.append(v.name)
             self._input_casts[name] = ok
         self._jits: Dict[Any, Callable] = {}
+        self._dispatch_sigs: set = set()
+        self._train_rng_key = None
 
     # ------------------------------------------------------------------ init
 
@@ -274,11 +289,19 @@ class ComputationGraph:
             upd[name] = {n: init_updater_state(ucfg, v) for n, v in p.items()}
         self.opt_state = {"step": jnp.zeros((), jnp.int32), "updater": upd}
         self._jits = {}
+        self._dispatch_sigs = set()
         self._pretrained = False
         return self
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
+
+    def _train_rng(self) -> jax.Array:
+        """Fit-path PRNG key, built once per model (was rebuilt on host
+        for every minibatch)."""
+        if self._train_rng_key is None:
+            self._train_rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
+        return self._train_rng_key
 
     # -------------------------------------------------------- functional core
 
@@ -379,7 +402,12 @@ class ComputationGraph:
                     new_upd[name][pname] = ust
             return new_params, {"step": it + 1, "updater": new_upd}, new_states, score
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        # states donated too off-CPU; CPU donation is off entirely —
+        # same overlap-aliasing hazard gate as
+        # MultiLayerNetwork._make_train_step (deferred scores remove the
+        # per-step sync that used to serialize donated dispatches)
+        donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
+        return jax.jit(step, donate_argnums=donate)
 
     # ----------------------------------------------------------------- train
 
@@ -410,33 +438,74 @@ class ComputationGraph:
                     lmasks[n] = jnp.asarray(m, self._dtype)
         return inputs, labels, fmasks, lmasks
 
+    def _pad_tail_safe(self) -> bool:
+        """Tail-batch padding is exact only for per-example-independent
+        layers (ShapeBucketingIterator doctrine)."""
+        return not any(getattr(i, "batch_statistics", False)
+                       for i in self.impls.values())
+
+    def _stage_mds(self, b) -> MultiDataSet:
+        """Device-feed placement (worker thread): normalize to
+        MultiDataSet and stage every array so ``_tensors`` becomes a
+        no-op on the step loop."""
+        mds = self._to_mds(b)
+        was_host = isinstance(mds.features[0], np.ndarray)
+        with span("stage", path="device_feed"):
+            out = self._device_mds(mds)
+        if was_host:
+            arrs = list(out.features) + list(out.labels) + \
+                [m for m in (out.features_masks or []) if m is not None] + \
+                [m for m in (out.labels_masks or []) if m is not None]
+            get_registry().counter(
+                H2D_BYTES_COUNTER,
+                "Host->device bytes staged by the feed pipeline").inc(
+                sum(int(a.nbytes) for a in arrs if a is not None))
+        return out
+
     def fit(self, data: Union[DataSet, MultiDataSet, DataSetIterator, MultiDataSetIterator],
-            epochs: int = 1, batch_size: Optional[int] = None) -> None:
+            epochs: int = 1, batch_size: Optional[int] = None,
+            feed_pipeline: Optional[bool] = None) -> None:
         """``fit(MultiDataSet)`` :677 / ``fit(DataSetIterator)`` :621 /
         ``fit(MultiDataSetIterator)`` :640 — iterators stream minibatches
-        through async prefetch, exactly the MLN doctrine."""
+        through async prefetch, exactly the MLN doctrine; with the feed
+        pipeline on (default) batches are shape-bucketed and staged on
+        device by a background thread and scores resolve in deferred
+        batches (see MultiLayerNetwork.fit)."""
         if self.params is None:
             self.init()
-        if self.conf.pretrain and not self._pretrained:
-            self.pretrain(data, batch_size=batch_size)
-            self._pretrained = True
-        if isinstance(data, (DataSet, MultiDataSet)):
-            if batch_size is not None:
-                mds = self._to_mds(data)
-                data = ListMultiDataSetIterator(mds, batch_size)
-            else:
-                # stage arrays to device ONCE; _tensors' jnp.asarray then
-                # becomes a no-op on every subsequent epoch
-                mds = self._device_mds(self._to_mds(data))
-                for _ in range(epochs):
-                    self._fit_batch(mds)
-                return
-        it = data
-        if it.async_supported():
-            it = AsyncDataSetIterator(it)  # payload-agnostic prefetch
-        for _ in range(epochs):
-            for mds in it:
-                self._fit_batch(self._to_mds(mds))
+        pipeline = feed_pipeline_enabled(feed_pipeline)
+        prev_defer, self._defer_scores = self._defer_scores, pipeline
+        feed = None
+        try:
+            if self.conf.pretrain and not self._pretrained:
+                self.pretrain(data, batch_size=batch_size)
+                self._pretrained = True
+            if isinstance(data, (DataSet, MultiDataSet)):
+                if batch_size is not None:
+                    mds = self._to_mds(data)
+                    data = ListMultiDataSetIterator(mds, batch_size)
+                else:
+                    # stage arrays to device ONCE; _tensors' jnp.asarray
+                    # then becomes a no-op on every subsequent epoch
+                    mds = self._device_mds(self._to_mds(data))
+                    for _ in range(epochs):
+                        self._fit_batch(mds)
+                    return
+            it = data
+            if pipeline and self._pad_tail_safe():
+                it = ShapeBucketingIterator(it)
+            if it.async_supported():
+                it = AsyncDataSetIterator(it)  # payload-agnostic prefetch
+            if pipeline:
+                it = feed = DeviceFeedIterator(it, place=self._stage_mds)
+            for _ in range(epochs):
+                for mds in it:
+                    self._fit_batch(self._to_mds(mds))
+        finally:
+            if feed is not None:
+                feed.close()
+            score_sink(self).flush()
+            self._defer_scores = prev_defer
 
     def _device_mds(self, mds: MultiDataSet) -> MultiDataSet:
         dev = lambda a: None if a is None else jnp.asarray(a, self._dtype)
@@ -463,22 +532,32 @@ class ComputationGraph:
 
     def _fit_batch_inner(self, mds: MultiDataSet) -> None:
         key = ("train", self._seq_token())
-        compiling = key not in self._jits
-        if compiling:
+        if key not in self._jits:
             self._jits[key] = self._make_train_step()
         step = self._jits[key]
-        rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
+        rng_key = self._train_rng()
         with span("data_load", path="graph_fit"):
+            # no-ops for device-staged batches (DeviceFeedIterator)
             inputs, labels, fmasks, lmasks = self._tensors(mds)
+        # one jit entry serves many operand signatures: fresh shapes (a
+        # ragged tail) or a fresh mask pytree structure retrace+compile
+        compiling = note_dispatch(self, key + (
+            tuple(sorted((n, a.shape, str(a.dtype)) for n, a in inputs.items())),
+            tuple(sorted((n, a.shape) for n, a in labels.items())),
+            tuple(sorted((n, a.shape) for n, a in fmasks.items())),
+            tuple(sorted((n, a.shape) for n, a in lmasks.items()))))
+        sink = score_sink(self)
+        hs = host_step(self)
         for _ in range(max(1, self.gc.iterations)):
-            # first dispatch of a fresh program is trace+compile-dominated
             with span("compile" if compiling else "device_step"):
                 self.params, self.opt_state, self.states, score = step(
                     self.params, self.opt_state, self.states, inputs, labels, fmasks, lmasks, rng_key)
-                self._score = float(score)  # score fetch = device sync
             compiling = False
-            for cb in self.listeners:
-                cb(self, int(self.opt_state["step"]), self._score)
+            hs += 1
+            set_host_step(self, hs)
+            sink.push(hs, score)  # device scalar; batched resolution
+            if not self._defer_scores:
+                sink.flush()
 
     # --------------------------------------------------------------- tbptt
 
@@ -599,7 +678,7 @@ class ComputationGraph:
         if compiling:
             self._jits[key] = self._make_scan_fit(epochs)
         fit = self._jits[key]
-        rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
+        rng_key = self._train_rng()
         with span("compile" if compiling else "device_step",
                   path="graph_fit_scan", epochs=epochs):
             self.params, self.opt_state, self.states, scores = fit(
@@ -737,7 +816,7 @@ class ComputationGraph:
 
     def score(self, data=None) -> float:
         if data is None:
-            return self._score
+            return float(self._score)  # may be a deferred device scalar
         mds = self._to_mds(data)
         inputs, labels, fmasks, lmasks = self._tensors(mds)
         with span("eval", path="graph_score"):

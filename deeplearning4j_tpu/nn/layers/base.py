@@ -67,6 +67,13 @@ class LayerImpl:
     # others reject the flag loudly instead of silently training a bias.
     supports_no_bias = False
 
+    # True for layers whose train-mode output/loss depends on CROSS-batch
+    # statistics (batch-norm moments, MoE load-balancing aux loss): the
+    # shape-bucketing tail-batch padding is only exact for per-example-
+    # independent layers, so the containers skip padding when any layer
+    # sets this.
+    batch_statistics = False
+
     def __init__(self, global_conf: NeuralNetConfiguration, conf: L.Layer, name: str):
         self.gc = global_conf
         self.conf = conf

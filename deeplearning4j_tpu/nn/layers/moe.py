@@ -61,6 +61,10 @@ def run_moe_ffn(params, x2: jnp.ndarray, capacity_factor: float,
 
 @register_impl(L.MoELayer)
 class MoEImpl(LayerImpl):
+    batch_statistics = True  # load-balancing aux loss + expert capacity
+    # are batch-level quantities: padded rows would skew both, so
+    # shape-bucketing tail padding is gated off for MoE stacks
+
     def init_params(self, key) -> Dict[str, jnp.ndarray]:
         c = self.conf
         if c.n_out != c.n_in:

@@ -24,6 +24,9 @@ class BatchNormalizationImpl(LayerImpl):
     """Normalizes over batch (FF [b,f]) or batch+space (CNN NHWC
     [b,h,w,c], per channel)."""
 
+    batch_statistics = True  # train-mode moments span the batch: padded
+    # rows would pollute them, so tail-batch padding is gated off
+
     def init_params(self, key) -> Dict[str, jnp.ndarray]:
         c = self.conf
         n = c.n_out

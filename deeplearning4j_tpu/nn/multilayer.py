@@ -25,7 +25,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
-from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator, DataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.datasets.iterators import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+    DeviceFeedIterator,
+    ListDataSetIterator,
+    ShapeBucketingIterator,
+    feed_pipeline_enabled,
+)
 from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
 import deeplearning4j_tpu.nn.layers  # noqa: F401  (registers layer impls)
 from deeplearning4j_tpu.nn.layers.base import build_layer
@@ -35,8 +42,14 @@ from deeplearning4j_tpu.nn.updater import (
     init_updater_state,
     normalize_gradient,
 )
-from deeplearning4j_tpu.monitor import span
+from deeplearning4j_tpu.monitor import H2D_BYTES_COUNTER, get_registry, span
 from deeplearning4j_tpu.nn.observed import SyncedStateAttr
+from deeplearning4j_tpu.optimize.deferred import (
+    host_step,
+    note_dispatch,
+    score_sink,
+    set_host_step,
+)
 from deeplearning4j_tpu.util.dtypes import cast_floats, cast_like, resolve_compute_dtype
 
 Params = Dict[str, Dict[str, jnp.ndarray]]
@@ -47,7 +60,11 @@ class MultiLayerNetwork:
     # by ParallelWrapper's averaging mode (nn/observed.py)
     params = SyncedStateAttr("params")
     states = SyncedStateAttr("states")
-    opt_state = SyncedStateAttr("opt_state")
+    opt_state = SyncedStateAttr("opt_state", invalidates="_host_step_mirror")
+
+    # deferred score resolution (optimize/deferred.py): True batches
+    # device→host score fetches; fit() flips it to the pipeline switch
+    _defer_scores = True
 
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -69,6 +86,8 @@ class MultiLayerNetwork:
         # gc.compute_dtype, loss in f32 (util/dtypes.py policy)
         self._cd = resolve_compute_dtype(self.gc.compute_dtype)
         self._jits: Dict[Any, Callable] = {}
+        self._dispatch_sigs: set = set()
+        self._train_rng_key = None
 
     # ------------------------------------------------------------------ init
 
@@ -90,11 +109,19 @@ class MultiLayerNetwork:
             upd[impl.name] = {n: init_updater_state(ucfg, v) for n, v in p.items()}
         self.opt_state = {"step": jnp.zeros((), jnp.int32), "updater": upd}
         self._jits = {}
+        self._dispatch_sigs = set()
         self._pretrained = False
         return self
 
     def set_listeners(self, *listeners) -> None:
         self.listeners = list(listeners)
+
+    def _train_rng(self) -> jax.Array:
+        """The fit-path PRNG key, built once per model — it was
+        reconstructed on host for every minibatch (seed + 7919)."""
+        if self._train_rng_key is None:
+            self._train_rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
+        return self._train_rng_key
 
     # -------------------------------------------------------- functional core
 
@@ -201,7 +228,16 @@ class MultiLayerNetwork:
                     new_upd[name][pname] = ust
             return new_params, {"step": it + 1, "updater": new_upd}, new_states, score
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        # donate states too off-CPU (BN moving stats / RNN carries update
+        # in place); on the CPU backend donation is OFF entirely — the
+        # deferred-score path lets several donated dispatches queue
+        # without a host sync between them, and CPU donation aliasing
+        # under that overlap corrupts results nondeterministically (the
+        # same hazard family that gates ParallelWrapper's averaging-mode
+        # donation; the old (0, 1) set was only safe because the legacy
+        # per-step float(score) fetch serialized every dispatch)
+        donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
+        return jax.jit(step, donate_argnums=donate)
 
     def _seq_token(self):
         """Sequence-parallel context marker for jit cache keys
@@ -230,32 +266,75 @@ class MultiLayerNetwork:
 
     # ----------------------------------------------------------------- train
 
+    def _pad_tail_safe(self) -> bool:
+        """Tail-batch padding is exact only for per-example-independent
+        layers (ShapeBucketingIterator doctrine)."""
+        return not any(getattr(i, "batch_statistics", False) for i in self.impls)
+
+    def _stage_ds(self, ds: DataSet) -> DataSet:
+        """Device-feed placement: runs on the feed worker thread so the
+        host→device transfer of batch N+1 overlaps step N."""
+        if not isinstance(ds, DataSet):
+            return ds
+        was_host = isinstance(ds.features, np.ndarray)
+        dev = lambda a: None if a is None else jnp.asarray(a, self._dtype)
+        with span("stage", path="device_feed"):
+            out = DataSet(dev(ds.features), dev(ds.labels),
+                          dev(ds.features_mask), dev(ds.labels_mask))
+        if was_host:
+            nbytes = sum(int(a.nbytes) for a in
+                         (out.features, out.labels, out.features_mask,
+                          out.labels_mask) if a is not None)
+            get_registry().counter(
+                H2D_BYTES_COUNTER,
+                "Host->device bytes staged by the feed pipeline").inc(nbytes)
+        return out
+
     def fit(self, data: Union[DataSet, DataSetIterator, np.ndarray],
             labels: Optional[np.ndarray] = None,
-            batch_size: Optional[int] = None) -> None:
+            batch_size: Optional[int] = None,
+            feed_pipeline: Optional[bool] = None) -> None:
         """Train: per minibatch run ``conf.iterations`` compiled steps
         (``fit(DataSetIterator)`` :1028; iterator auto-wrapped in async
-        prefetch as at :1032)."""
+        prefetch as at :1032). With the feed pipeline on (default), the
+        iterator is additionally shape-bucketed (ragged tails padded to
+        the canonical batch so one compiled program serves every batch)
+        and device-staged by a background thread, and per-step scores
+        stay on device until a listener needs them (one batched fetch)
+        — the host loop never blocks the chip."""
         if self.params is None:
             self.init()
         if isinstance(data, np.ndarray) or isinstance(data, jnp.ndarray):
             data = DataSet(np.asarray(data), np.asarray(labels))
-        if self.conf.pretrain and not self._pretrained:
-            # layer-wise unsupervised phase before supervised backprop
-            # (fit :1037 → pretrain :163 when conf.pretrain)
-            self.pretrain(data, batch_size=batch_size)
-            self._pretrained = True
-        if isinstance(data, DataSet):
-            if batch_size is not None:
-                data = ListDataSetIterator(data, batch_size)
-            else:
-                self._fit_batch(data)
-                return
-        it = data
-        if it.async_supported():
-            it = AsyncDataSetIterator(it)
-        for ds in it:
-            self._fit_batch(ds)
+        pipeline = feed_pipeline_enabled(feed_pipeline)
+        prev_defer, self._defer_scores = self._defer_scores, pipeline
+        feed = None
+        try:
+            if self.conf.pretrain and not self._pretrained:
+                # layer-wise unsupervised phase before supervised backprop
+                # (fit :1037 → pretrain :163 when conf.pretrain)
+                self.pretrain(data, batch_size=batch_size)
+                self._pretrained = True
+            if isinstance(data, DataSet):
+                if batch_size is not None:
+                    data = ListDataSetIterator(data, batch_size)
+                else:
+                    self._fit_batch(data)
+                    return
+            it = data
+            if pipeline and self._pad_tail_safe():
+                it = ShapeBucketingIterator(it)
+            if it.async_supported():
+                it = AsyncDataSetIterator(it)
+            if pipeline:
+                it = feed = DeviceFeedIterator(it, place=self._stage_ds)
+            for ds in it:
+                self._fit_batch(ds)
+        finally:
+            if feed is not None:
+                feed.close()
+            score_sink(self).flush()
+            self._defer_scores = prev_defer
 
     # ------------------------------------------------------------- pretrain
 
@@ -453,26 +532,37 @@ class MultiLayerNetwork:
         self._fit_batch_inner(ds)
 
     def _fit_batch_inner(self, ds: DataSet) -> None:
-        rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
+        rng_key = self._train_rng()
         fm = ds.features_mask is not None
         lm = ds.labels_mask is not None
         step = self._get_jit("train", fm=fm, lm=lm)
-        compiling = self._jit_missed
         with span("data_load", path="fit"):
+            # a device-staged batch (DeviceFeedIterator) makes these
+            # no-ops — the span shrinks to a queue handoff
             x = jnp.asarray(ds.features, self._dtype)
             y = jnp.asarray(ds.labels, self._dtype)
             fmask = jnp.asarray(ds.features_mask, self._dtype) if fm else jnp.zeros((), self._dtype)
             lmask = jnp.asarray(ds.labels_mask, self._dtype) if lm else jnp.zeros((), self._dtype)
+        # a fresh program OR fresh operand shapes trace+compile on first
+        # dispatch (shape-bucketed tails exist to avoid the latter)
+        compiling = note_dispatch(self, (
+            "train", fm, lm, self._seq_token(),
+            x.shape, str(x.dtype), y.shape, str(y.dtype),
+            fmask.shape, lmask.shape))
+        sink = score_sink(self)
+        hs = host_step(self)
         for _ in range(max(1, self.gc.iterations)):
-            # first dispatch of a fresh program is trace+compile-dominated
             with span("compile" if compiling else "device_step"):
                 self.params, self.opt_state, self.states, score = step(
                     self.params, self.opt_state, self.states, x, y, fmask, lmask, rng_key)
-                self._score = float(score)  # score fetch = device sync
             compiling = False
-            it_num = int(self.opt_state["step"])
-            for cb in self.listeners:
-                cb(self, it_num, self._score)
+            hs += 1
+            set_host_step(self, hs)
+            # scores stay on device; the sink resolves in one batched
+            # fetch when a listener's frequency (or end-of-fit) demands
+            sink.push(hs, score)
+            if not self._defer_scores:
+                sink.flush()
 
     # ------------------------------------------------- scanned multi-step fit
 
@@ -543,7 +633,7 @@ class MultiLayerNetwork:
         if compiling:
             self._jits[key] = self._make_scan_fit(epochs)
         fit = self._jits[key]
-        rng_key = jax.random.PRNGKey(self.gc.seed + 7919)
+        rng_key = self._train_rng()
         with span("compile" if compiling else "device_step",
                   path="fit_scan", epochs=epochs):
             self.params, self.opt_state, self.states, scores = fit(
@@ -573,9 +663,11 @@ class MultiLayerNetwork:
         return np.argmax(self.output(x), axis=-1)
 
     def score(self, ds: Optional[DataSet] = None) -> float:
-        """Loss on a DataSet (eval mode), or the last training score."""
+        """Loss on a DataSet (eval mode), or the last training score
+        (resolved to host on demand — it may still be a device scalar
+        under the deferred-score pipeline)."""
         if ds is None:
-            return self._score
+            return float(self._score)
         fm = ds.features_mask is not None
         lm = ds.labels_mask is not None
         fn = self._get_jit("score", fm=fm, lm=lm)

@@ -59,10 +59,18 @@ class SyncedStateAttr:
     once, and only if somebody actually looks. Writes go straight to
     the backing slot (the thunk itself writes through here while
     already cleared, so there is no recursion).
+
+    ``invalidates`` names an instance-dict key popped on every write —
+    the containers declare ``opt_state`` with
+    ``invalidates="_host_step_mirror"`` so any assignment (a train step,
+    a checkpoint restore, ``fit_scan``) drops the host-side step mirror
+    and the next fit re-resolves it from the device exactly once
+    (optimize/deferred.py host_step).
     """
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, invalidates: str = None):
         self._slot = "_synced_" + name
+        self._invalidates = invalidates
 
     def __get__(self, obj, objtype=None):
         if obj is None:
@@ -76,6 +84,8 @@ class SyncedStateAttr:
         return obj.__dict__.get(self._slot)
 
     def __set__(self, obj, value):
+        if self._invalidates is not None:
+            obj.__dict__.pop(self._invalidates, None)
         obj.__dict__[self._slot] = value
 
     def __delete__(self, obj):

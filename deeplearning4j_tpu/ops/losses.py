@@ -26,6 +26,29 @@ import jax.numpy as jnp
 _EPS = 1e-7
 
 
+def _masked_mean(per_ex: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Masked mean whose value AND gradients are bitwise-identical to
+    ``jnp.mean`` over the unmasked rows (the shape-bucketing tail-batch
+    parity guarantee):
+
+    - gradients flow through the true division ``total / count`` —
+      its cotangent ``g / count`` is the same correctly-rounded value
+      as the constant-folded ``g * (1/n)`` the mean backward emits;
+    - the FORWARD value is corrected to ``total * (1/count)``, the
+      rounding XLA's strength-reduced division-by-compile-time-count
+      produces for ``jnp.mean`` (one extra rounding vs true division
+      when the count is not a power of two). The correction rides a
+      ``stop_gradient`` so the backward graph is exactly the division
+      form; ``d + stop_grad(r - d) == r`` exactly (Sterbenz: r, d are
+      within one ulp, so ``r - d`` and the re-add are exact)."""
+    mask = mask.astype(per_ex.dtype)
+    total = jnp.sum(per_ex * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    d = total / count
+    r = total * (1.0 / count)
+    return d + jax.lax.stop_gradient(r - d)
+
+
 class LossFunction(str, enum.Enum):
     MSE = "mse"
     L1 = "l1"
@@ -173,8 +196,5 @@ def compute_loss(
     if reduction != "mean":
         raise ValueError(f"unknown reduction {reduction!r} (use 'mean' or 'batch')")
     if mask is not None:
-        mask = mask.astype(per_ex.dtype)
-        total = jnp.sum(per_ex * mask)
-        count = jnp.maximum(jnp.sum(mask), 1.0)
-        return total / count
+        return _masked_mean(per_ex, mask)
     return jnp.mean(per_ex)

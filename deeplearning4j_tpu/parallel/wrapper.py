@@ -33,9 +33,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
-from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator, DataSetIterator, ListDataSetIterator
-from deeplearning4j_tpu.monitor import span
+from deeplearning4j_tpu.datasets.iterators import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+    DeviceFeedIterator,
+    ListDataSetIterator,
+    ShapeBucketingIterator,
+    feed_pipeline_enabled,
+)
+from deeplearning4j_tpu.monitor import H2D_BYTES_COUNTER, get_registry, span
 from deeplearning4j_tpu.nn.observed import clear_pending_sync
+from deeplearning4j_tpu.optimize.deferred import (
+    host_step,
+    note_dispatch,
+    score_sink,
+    set_host_step,
+)
 from deeplearning4j_tpu.optimize.training_stats import TrainingStats
 from deeplearning4j_tpu.parallel.mesh import MeshContext, make_mesh
 
@@ -78,12 +91,17 @@ class ParallelWrapper:
     def __init__(self, model, mesh=None, workers: Optional[int] = None,
                  averaging_frequency: int = 1, mode: str = "allreduce",
                  prefetch_buffer: int = 4, collect_stats: bool = False,
-                 hooks: Optional[list] = None):
+                 hooks: Optional[list] = None,
+                 feed_pipeline: Optional[bool] = None):
         """``workers`` defaults to the mesh ``data`` axis size (the
         reference defaulted to device count). ``collect_stats=True``
         records per-phase timings into ``self.stats``
         (``setCollectTrainingStats`` / CommonSparkTrainingStats role).
-        ``hooks``: TrainingHook instances called around every step."""
+        ``hooks``: TrainingHook instances called around every step.
+        ``feed_pipeline``: device-feed pipeline switch (None → env
+        default): in allreduce mode batches are shape-bucketed and
+        device-placed SHARDED over the mesh replicas by a background
+        stage, and scores resolve in deferred batches."""
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.ctx = MeshContext(self.mesh)
@@ -96,6 +114,7 @@ class ParallelWrapper:
             raise ValueError(mode)
         self.mode = mode
         self.prefetch_buffer = prefetch_buffer
+        self.feed_pipeline = feed_pipeline_enabled(feed_pipeline)
         self.hooks = list(hooks or [])
         self.stats: Optional[TrainingStats] = TrainingStats() if collect_stats else None
         self._vstep = None
@@ -116,35 +135,62 @@ class ParallelWrapper:
 
     # ------------------------------------------------------------- allreduce
 
+    def _stage_sharded(self, ds: DataSet) -> DataSet:
+        """Device-feed placement for allreduce mode: batch dim sharded
+        over the ``data`` axis — each replica receives only its slice
+        (runs on the feed worker thread, overlapping the current step)."""
+        m = self.model
+        with span("stage", path="device_feed", mode=self.mode):
+            x, y, fmask, lmask = self.ctx.shard_batch(
+                np.asarray(ds.features, m._dtype),
+                np.asarray(ds.labels, m._dtype),
+                None if ds.features_mask is None else np.asarray(ds.features_mask, m._dtype),
+                None if ds.labels_mask is None else np.asarray(ds.labels_mask, m._dtype))
+        get_registry().counter(
+            H2D_BYTES_COUNTER,
+            "Host->device bytes staged by the feed pipeline").inc(
+            sum(int(a.nbytes) for a in (x, y, fmask, lmask) if a is not None))
+        return DataSet(x, y, fmask, lmask)
+
     def _fit_allreduce(self, it: DataSetIterator):
         m = self.model
         repl = self.ctx.replicated()
         m.params = jax.device_put(m.params, repl)
         m.opt_state = jax.device_put(m.opt_state, repl)
         m.states = jax.device_put(m.states, repl)
-        rng_key = jax.random.PRNGKey(m.gc.seed + 7919)
+        rng_key = m._train_rng()
+        sink = score_sink(m)
+        hs = host_step(m)
         for ds in _timed_batches(it, self.stats):
             fm = ds.features_mask is not None
             lm = ds.labels_mask is not None
             step = m._get_jit("train", fm=fm, lm=lm)
             with self._phase("stage"):
-                x, y, fmask, lmask = self.ctx.shard_batch(
-                    np.asarray(ds.features, m._dtype), np.asarray(ds.labels, m._dtype),
-                    None if not fm else np.asarray(ds.features_mask, m._dtype),
-                    None if not lm else np.asarray(ds.labels_mask, m._dtype))
+                if isinstance(ds.features, jax.Array):
+                    # already placed by the device-feed stage
+                    x, y, fmask, lmask = (ds.features, ds.labels,
+                                          ds.features_mask, ds.labels_mask)
+                else:
+                    x, y, fmask, lmask = self.ctx.shard_batch(
+                        np.asarray(ds.features, m._dtype), np.asarray(ds.labels, m._dtype),
+                        None if not fm else np.asarray(ds.features_mask, m._dtype),
+                        None if not lm else np.asarray(ds.labels_mask, m._dtype))
             zero = jnp.zeros((), m._dtype)
-            it_num = int(m.opt_state["step"])
+            note_dispatch(m, ("pw_train", fm, lm, m._seq_token(),
+                              x.shape, str(x.dtype), y.shape, str(y.dtype)))
             for h in self.hooks:
-                h.pre_update(m, it_num)
+                h.pre_update(m, hs)
             with self._phase("step"):
                 m.params, m.opt_state, m.states, score = step(
                     m.params, m.opt_state, m.states, x, y,
                     fmask if fm else zero, lmask if lm else zero, rng_key)
-                m._score = float(score)  # score fetch = device sync
+            hs += 1
+            set_host_step(m, hs)
+            sink.push(hs, score)  # deferred device→host resolution
+            if not self.feed_pipeline:
+                sink.flush()
             for h in self.hooks:
-                h.post_update(m, int(m.opt_state["step"]))
-            for cb in m.listeners:
-                cb(m, int(m.opt_state["step"]), m._score)
+                h.post_update(m, hs)
 
     # ------------------------------------------------------------- averaging
 
@@ -191,7 +237,8 @@ class ParallelWrapper:
         wparams = spread(m.params)
         wopt = spread(m.opt_state)
         wstates = spread(m.states)
-        rng_key = jax.random.PRNGKey(m.gc.seed + 7919)
+        rng_key = m._train_rng()
+        sink = score_sink(m)
         for ds in _timed_batches(it, self.stats):
             if ds.features_mask is not None or ds.labels_mask is not None:
                 raise ValueError("averaging mode does not support masked DataSets; "
@@ -220,7 +267,7 @@ class ParallelWrapper:
             with self._phase("step"):
                 wparams, wopt, wstates, scores = self._vstep(wparams, wopt, wstates, x, y, rng_key)
                 self._counter += 1
-                m._score = float(jnp.mean(scores))  # score fetch = device sync
+                mean_score = jnp.mean(scores)  # device scalar, no sync
             did_avg = self._counter % self.averaging_frequency == 0
             if did_avg:
                 with self._phase("average"):
@@ -248,10 +295,14 @@ class ParallelWrapper:
                         m.states = avg0(ws)
 
                 m._observer_sync = _sync
+            # deferred resolution: listeners replay with exact per-step
+            # scores; freq-1 listeners flush immediately (the pending
+            # observer sync above is then current for their reads)
+            sink.push(self._counter, mean_score)
+            if not self.feed_pipeline:
+                sink.flush()
             for h in self.hooks:
                 h.post_update(m, self._counter)
-            for cb in m.listeners:
-                cb(m, self._counter, m._score)
         # final average + collapse back onto the wrapped model (:121);
         # layer states (BN moving stats) are averaged too, matching the
         # reference's average-everything semantics. Clear any pending
@@ -268,14 +319,30 @@ class ParallelWrapper:
     # ------------------------------------------------------------------- fit
 
     def fit(self, data) -> None:
-        if self.model.params is None:
-            self.model.init()
+        m = self.model
+        if m.params is None:
+            m.init()
         if isinstance(data, DataSet):
             data = ListDataSetIterator(data, data.num_examples())
         it = data
+        # averaging mode rejects masked batches and reshapes per worker
+        # on host, so the device-feed stages are allreduce-only
+        pipeline = self.feed_pipeline and self.mode == "allreduce"
+        if pipeline and m._pad_tail_safe():
+            # padding to the canonical batch also keeps ragged tails
+            # divisible by the data axis (shard_batch requirement)
+            it = ShapeBucketingIterator(it)
         if it.async_supported():
             it = AsyncDataSetIterator(it, queue_size=self.prefetch_buffer)
-        if self.mode == "allreduce":
-            self._fit_allreduce(it)
-        else:
-            self._fit_averaging(it)
+        feed = None
+        if pipeline:
+            it = feed = DeviceFeedIterator(it, place=self._stage_sharded)
+        try:
+            if self.mode == "allreduce":
+                self._fit_allreduce(it)
+            else:
+                self._fit_averaging(it)
+        finally:
+            if feed is not None:
+                feed.close()
+            score_sink(m).flush()

@@ -217,7 +217,11 @@ class _Handler(BaseHTTPRequestHandler):
             return fl.latest
         model = self.server._flow_model  # type: ignore[attr-defined]
         if model is not None:
-            return model_flow_info(model, getattr(model, "_score", None))
+            score = getattr(model, "_score", None)
+            if score is not None:
+                # may be a deferred device scalar (optimize/deferred.py)
+                score = float(score)
+            return model_flow_info(model, score)
         return None
 
     def _flow_json(self):

@@ -133,6 +133,55 @@ def validate_chrome_trace_file(path: str) -> List[str]:
     return validate_chrome_trace(obj, path)
 
 
+# ------------------------------------------------ known dl4j metric names
+
+# The pinned registry of in-tree ``dl4j_``-prefixed metric families.
+# A renamed family silently breaks every downstream consumer (BENCH
+# attribution, Prometheus dashboards), so ``validate_known_metrics``
+# flags any dl4j_ family an exposition declares that is not listed
+# here — add new names HERE in the same PR that introduces them.
+KNOWN_DL4J_METRICS = {
+    # monitor core (tracing / step health / listeners)
+    "dl4j_phase_duration_ms",
+    "dl4j_step_duration_ms",
+    "dl4j_step_duration_p50_ms",
+    "dl4j_step_duration_p99_ms",
+    "dl4j_score",
+    "dl4j_nan_scores_total",
+    "dl4j_slow_steps_total",
+    "dl4j_iterations_total",
+    "dl4j_iterations_per_sec",
+    "dl4j_examples_per_sec",
+    # streaming pipelines
+    "dl4j_stream_batches_total",
+    "dl4j_stream_buffer_examples",
+    "dl4j_stream_examples_total",
+    # device-feed pipeline (datasets/iterators.py + the fit() paths)
+    "dl4j_feed_h2d_bytes_total",
+    "dl4j_feed_queue_depth",
+    "dl4j_feed_padded_batches_total",
+    "dl4j_jit_cache_miss_total",
+    "dl4j_score_sync_total",
+}
+
+
+def validate_known_metrics(text: str, where: str = "metrics") -> List[str]:
+    """Flag dl4j_ families not in the pinned registry (drift guard)."""
+    errors: List[str] = []
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.startswith("# TYPE "):
+            continue
+        parts = line.split()
+        if len(parts) != 4:
+            continue  # malformed TYPE lines are validate_prometheus_text's job
+        name = parts[2]
+        if name.startswith("dl4j_") and name not in KNOWN_DL4J_METRICS:
+            errors.append(
+                f"{where}:{i}: unknown dl4j_ metric family {name!r} — "
+                "add it to KNOWN_DL4J_METRICS if it is intentional")
+    return errors
+
+
 # -------------------------------------------------- Prometheus exposition
 
 _METRIC_RE = re.compile(
@@ -240,6 +289,9 @@ def main(argv=None) -> int:
                     help=".jsonl = event stream, .json = Chrome trace")
     ap.add_argument("--metrics", action="append", default=[],
                     help="Prometheus text exposition file(s)")
+    ap.add_argument("--check-names", action="store_true",
+                    help="additionally flag dl4j_ metric families missing "
+                         "from the pinned KNOWN_DL4J_METRICS registry")
     args = ap.parse_args(argv)
     if not args.paths and not args.metrics:
         ap.error("nothing to validate")
@@ -251,6 +303,9 @@ def main(argv=None) -> int:
             errors.extend(validate_chrome_trace_file(path))
     for path in args.metrics:
         errors.extend(validate_prometheus_file(path))
+        if args.check_names:
+            with open(path) as f:
+                errors.extend(validate_known_metrics(f.read(), path))
     for e in errors:
         print(e, file=sys.stderr)
     total = len(args.paths) + len(args.metrics)
