@@ -14,7 +14,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -403,9 +403,43 @@ def _ones_label_mask(labels: np.ndarray, n_valid: int, n_total: int) -> np.ndarr
     return m
 
 
-def _pad_rows(a: np.ndarray, pad: int) -> np.ndarray:
+def pad_rows(a: np.ndarray, pad: int) -> np.ndarray:
+    """Append ``pad`` zero rows along axis 0 (the tail-padding primitive
+    shared by ShapeBucketingIterator, the sharded evaluators, and the
+    ParallelInference request coalescer)."""
+    if pad <= 0:
+        return a
     return np.concatenate(
         [a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)], axis=0)
+
+
+_pad_rows = pad_rows  # legacy internal name
+
+
+def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
+    """Canonical batch-size bucket ladder: powers of two up to (and
+    always including) ``max_batch``. Every ragged request/tail size
+    rounds up onto this small fixed set, so the whole serving/eval
+    plane dispatches a handful of pre-compilable programs instead of
+    one per observed size."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    out: List[int] = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n; an oversized n passes through unpadded
+    (its own shape — the caller decides whether that may compile)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return n
 
 
 class ShapeBucketingIterator(DataSetIterator):
@@ -459,8 +493,8 @@ class ShapeBucketingIterator(DataSetIterator):
             return ds
         self._count_padded()
         labels = np.asarray(ds.labels)
-        feats = _pad_rows(np.asarray(ds.features), target - n)
-        return DataSet(feats, _pad_rows(labels, target - n), None,
+        feats = pad_rows(np.asarray(ds.features), target - n)
+        return DataSet(feats, pad_rows(labels, target - n), None,
                        _ones_label_mask(labels, n, target))
 
     def _bucket_mds(self, mds: MultiDataSet) -> MultiDataSet:
@@ -478,8 +512,8 @@ class ShapeBucketingIterator(DataSetIterator):
         labels = [np.asarray(l) for l in mds.labels]
         pad = target - n
         return MultiDataSet(
-            features=[_pad_rows(np.asarray(f), pad) for f in mds.features],
-            labels=[_pad_rows(l, pad) for l in labels],
+            features=[pad_rows(np.asarray(f), pad) for f in mds.features],
+            labels=[pad_rows(l, pad) for l in labels],
             labels_masks=[_ones_label_mask(l, n, target) for l in labels])
 
     def _next_impl(self):

@@ -31,6 +31,17 @@ loop), ``dl4j_feed_padded_batches_total`` (ragged tail batches padded
 to the canonical shape), ``dl4j_jit_cache_miss_total`` (train-step
 dispatches that had to trace+compile), ``dl4j_score_sync_total``
 (device→host score fetches — each one is a chip round-trip).
+
+The serving plane (parallel/inference.py ``ParallelInference``)
+publishes ``dl4j_infer_requests_total`` / ``dl4j_infer_batches_total``
+(request vs dispatched-batch volume — their ratio is the coalescing
+factor), ``dl4j_infer_batch_size`` (rows per dispatched batch, padding
+included), ``dl4j_infer_queue_depth`` (admission-queue backlog),
+``dl4j_infer_padded_ratio`` (cumulative fraction of dispatched rows
+that were bucket padding), and ``dl4j_infer_latency_ms`` (per-request
+submit→result latency). ``dl4j_jit_cache_miss_total`` is shared with
+the training plane: a serve-loop dispatch that traces+compiles ticks it
+too, which is how the AOT ``warmup()`` contract is asserted.
 """
 
 # Device-feed pipeline metric family names (one name, one meaning —
@@ -40,6 +51,21 @@ FEED_QUEUE_DEPTH_GAUGE = "dl4j_feed_queue_depth"
 FEED_PADDED_BATCHES_COUNTER = "dl4j_feed_padded_batches_total"
 JIT_CACHE_MISS_COUNTER = "dl4j_jit_cache_miss_total"
 SCORE_SYNC_COUNTER = "dl4j_score_sync_total"
+
+# Serving plane (parallel/inference.py ParallelInference — the
+# micro-batching engine behind StreamingInference): request/batch
+# volume, coalescing quality (batch size distribution, padded-row
+# ratio), admission-queue depth, and per-request submit→result latency.
+INFER_REQUESTS_COUNTER = "dl4j_infer_requests_total"
+INFER_BATCHES_COUNTER = "dl4j_infer_batches_total"
+INFER_BATCH_SIZE_HISTOGRAM = "dl4j_infer_batch_size"
+INFER_QUEUE_DEPTH_GAUGE = "dl4j_infer_queue_depth"
+INFER_PADDED_RATIO_GAUGE = "dl4j_infer_padded_ratio"
+INFER_LATENCY_HISTOGRAM = "dl4j_infer_latency_ms"
+
+# Bucket bounds for dl4j_infer_batch_size (rows per dispatched batch).
+INFER_BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                            256.0, 512.0, 1024.0)
 
 from deeplearning4j_tpu.monitor.registry import (  # noqa: F401
     Counter,

@@ -814,6 +814,30 @@ class ComputationGraph:
     def output(self, *features: np.ndarray) -> np.ndarray:
         return self.outputs(*features)[0]
 
+    def infer_output_fn(self):
+        """Engine-facing batched output program (the MultiLayerNetwork
+        ``infer_output_fn`` contract): a jit-cached pure ``(params,
+        states, x, fmask) -> predictions`` for single-input /
+        single-output graphs — ParallelInference replicas call it with
+        device-pinned param/state copies."""
+        if len(self.input_names) != 1 or len(self.output_names) != 1:
+            raise ValueError(
+                "ParallelInference serves single-input/single-output "
+                f"graphs; this one has inputs {self.input_names} and "
+                f"outputs {self.output_names} — serve per-output with "
+                "outputs() directly")
+        key = ("infer_output", self._seq_token())
+        if key not in self._jits:
+            inp, outn = self.input_names[0], self.output_names[0]
+
+            def fn(p, s, x, fm):
+                fmasks = {} if fm is None else {inp: fm}
+                acts = self._forward_all(p, s, {inp: x}, False, None, fmasks)[0]
+                return acts[outn]
+
+            self._jits[key] = jax.jit(fn)
+        return self._jits[key]
+
     def score(self, data=None) -> float:
         if data is None:
             return float(self._score)  # may be a deferred device scalar

@@ -256,6 +256,17 @@ class MultiLayerNetwork:
             elif kind == "output":
                 self._jits[key] = jax.jit(
                     lambda p, s, x, fm: self._forward(p, s, x, False, None, fm)[0][-1])
+            elif kind == "predict":
+                # on-device argmax: only [b] class ids cross the wire,
+                # not the full [b, C] probability matrix
+                self._jits[key] = jax.jit(
+                    lambda p, s, x, fm: jnp.argmax(
+                        self._forward(p, s, x, False, None, fm)[0][-1], axis=-1))
+            elif kind == "feed_forward":
+                train = flags["train"]
+                rng = jax.random.PRNGKey(0) if train else None
+                self._jits[key] = jax.jit(
+                    lambda p, s, x: self._forward(p, s, x, train, rng, None)[0])
             elif kind == "score":
                 self._jits[key] = jax.jit(
                     lambda p, s, x, y, fm, lm: self._score_fn(
@@ -654,13 +665,75 @@ class MultiLayerNetwork:
         return np.asarray(fn(self.params, self.states, jnp.asarray(x, self._dtype), fmask))
 
     def feed_forward(self, x: np.ndarray, train: bool = False) -> List[np.ndarray]:
-        """All per-layer activations (``feedForward`` :618)."""
-        acts, _ = self._forward(self.params, self.states, jnp.asarray(x, self._dtype),
-                                train, jax.random.PRNGKey(0) if train else None, None)
+        """All per-layer activations (``feedForward`` :618) — jit-cached
+        (the eager ``_forward`` retraced the whole stack on every call)."""
+        fn = self._get_jit("feed_forward", train=train)
+        acts = fn(self.params, self.states, jnp.asarray(x, self._dtype))
         return [np.asarray(a) for a in acts]
 
     def predict(self, x: np.ndarray) -> np.ndarray:
-        return np.argmax(self.output(x), axis=-1)
+        """Class ids (``predict`` :728) — argmax runs on device inside
+        the jitted output program instead of fetching the full
+        probability matrix to host first."""
+        fn = self._get_jit("predict", fm=False)
+        with span("inference", path="predict"):
+            ids = fn(self.params, self.states, jnp.asarray(x, self._dtype), None)
+        return np.asarray(ids).astype(np.int64)
+
+    def infer_output_fn(self):
+        """The engine-facing batched output program: a jit-cached pure
+        ``(params, states, x, fmask) -> predictions`` shared with
+        ``output()`` — ParallelInference replicas call it with
+        device-pinned param/state copies."""
+        return self._get_jit("output", fm=False)
+
+    def evaluate(self, data, num_classes: Optional[int] = None,
+                 batch_size: Optional[int] = None,
+                 labels_list=None):
+        """Iterator evaluation through the bucketed inference path
+        (``MultiLayerNetwork.evaluate`` role): every batch dispatches
+        the same jit-cached program — ragged tails are padded up to the
+        first batch's canonical size (``ShapeBucketingIterator``
+        doctrine), so evaluation never pays a per-tail-shape recompile —
+        and for plain 2-D classification the argmax happens on device
+        (only ids reach the host). Masked/time-series batches fall back
+        to the probability path (still jit-cached)."""
+        from deeplearning4j_tpu.datasets.iterators import pad_rows
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator(data, batch_size or data.num_examples())
+        ev = Evaluation(num_classes=num_classes, labels_list=labels_list)
+        pad_safe = self._pad_tail_safe()
+        canon: Optional[int] = None
+        for ds in data:
+            n = ds.num_examples()
+            feats = np.asarray(ds.features)
+            masked = ds.features_mask is not None or ds.labels_mask is not None
+            labels = np.asarray(ds.labels)
+            if canon is None:
+                canon = n
+            if pad_safe and not masked and n < canon:
+                feats = pad_rows(feats, canon - n)
+            fast = (not masked and labels.ndim == 2
+                    and not np.issubdtype(labels.dtype, np.integer))
+            compiling = note_dispatch(self, (
+                "predict" if fast else "output", False, self._seq_token(),
+                feats.shape, str(feats.dtype)))
+            with span("eval", path="evaluate",
+                      compile=bool(compiling), rows=n):
+                if fast:
+                    pred = np.asarray(self._get_jit("predict", fm=False)(
+                        self.params, self.states,
+                        jnp.asarray(feats, self._dtype), None))[:n]
+                    ev._ensure(labels.shape[-1])
+                    ev.confusion.add_batch(np.argmax(labels, axis=-1), pred)
+                else:
+                    probs = np.asarray(self._get_jit("output", fm=ds.features_mask is not None)(
+                        self.params, self.states, jnp.asarray(feats, self._dtype),
+                        jnp.asarray(ds.features_mask, self._dtype)
+                        if ds.features_mask is not None else None))[:n]
+                    ev.eval(labels, probs, mask=ds.labels_mask)
+        return ev
 
     def score(self, ds: Optional[DataSet] = None) -> float:
         """Loss on a DataSet (eval mode), or the last training score

@@ -23,5 +23,9 @@ attention for long context (``ring_attention.py``), multi-host DCN via
 from deeplearning4j_tpu.parallel.mesh import MeshContext, make_mesh  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper, TrainingHook  # noqa: F401
 from deeplearning4j_tpu.parallel.evaluation import evaluate_sharded  # noqa: F401
+from deeplearning4j_tpu.parallel.inference import (  # noqa: F401
+    InferenceBackpressure,
+    ParallelInference,
+)
 from deeplearning4j_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
 from deeplearning4j_tpu.parallel.zero import apply_fsdp, apply_zero1, fsdp_specs  # noqa: F401

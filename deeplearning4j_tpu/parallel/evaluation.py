@@ -137,8 +137,14 @@ def _flatten_with_valid(ds: DataSet, preds_rank: int = 2):
     return x, y, valid
 
 
-def _pad_for_mesh(dsize: int, x, y, valid):
-    pad = (-x.shape[0]) % dsize
+def _pad_for_mesh(dsize: int, x, y, valid, target: int = 0):
+    """Zero-pad rows (valid=0 so they never count) up to ``target`` —
+    the canonical batch shape, so ragged tails reuse one compiled
+    program instead of paying a per-tail-shape recompile — and then to
+    a multiple of the mesh data-axis size."""
+    want = max(x.shape[0], target)
+    want += (-want) % dsize
+    pad = want - x.shape[0]
     if pad:
         zeros = lambda a: np.zeros((pad,) + a.shape[1:], a.dtype)
         x = np.concatenate([x, zeros(x)])
@@ -198,11 +204,13 @@ def evaluate_regression_sharded(model, data: Union[DataSet, DataSetIterator],
     states = jax.device_put(model.states, repl)
     total = None
     rank = None
+    canon = 0
     for ds in _batches(data, batch_size):
         if rank is None:
             rank, _ = _preds_shape(model, ds)
         x, y, valid = _flatten_with_valid(ds, rank)
-        x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid)
+        x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid, canon)
+        canon = max(canon, x.shape[0])  # ragged tails reuse this program
         xs, ys, vs = ctx.shard_batch(x, y, valid)
         out = np.asarray(program(params, states, xs, ys, vs), np.float64)
         total = out if total is None else total + out
@@ -250,11 +258,13 @@ def evaluate_roc_sharded(model, data: Union[DataSet, DataSetIterator],
     states = jax.device_put(model.states, repl)
     roc = ROC(threshold_steps)
     rank = None
+    canon = 0
     for ds in _batches(data, batch_size):
         if rank is None:
             rank, _ = _preds_shape(model, ds)
         x, y, valid = _flatten_with_valid(ds, rank)
-        x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid)
+        x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid, canon)
+        canon = max(canon, x.shape[0])  # ragged tails reuse this program
         xs, ys, vs = ctx.shard_batch(x, y, valid)
         tp, fp, pos, neg = program(params, states, xs, ys, vs)
         roc.tp += np.asarray(tp, np.int64)
@@ -283,12 +293,14 @@ def evaluate_sharded(model, data: Union[DataSet, DataSetIterator],
 
     total: Optional[np.ndarray] = None
     rank = width = None
+    canon = 0
     for ds in _batches(data, batch_size):
         if rank is None:
             rank, width = _preds_shape(model, ds)
         x, y, valid = _flatten_with_valid(ds, rank)
         _check_sparse_ids(y, rank, width, valid)
-        x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid)
+        x, y, valid = _pad_for_mesh(ctx.data_axis_size(), x, y, valid, canon)
+        canon = max(canon, x.shape[0])  # ragged tails reuse this program
         xs, ys, vs = ctx.shard_batch(x, y, valid)
         counts = np.asarray(program(params, states, xs, ys, vs))
         total = counts if total is None else total + counts

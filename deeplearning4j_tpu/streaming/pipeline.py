@@ -176,24 +176,37 @@ class StreamingTrainer:
 
 class StreamingInference:
     """Serve route (``DL4jServeRouteBuilder``): consume feature arrays
-    from ``in_topic``, publish ``net.output`` predictions to
-    ``out_topic`` until a stop pill (or idle timeout) arrives."""
+    from ``in_topic``, publish predictions to ``out_topic`` until a stop
+    pill (or idle timeout) arrives.
+
+    The serve loop dispatches through a ``ParallelInference`` engine
+    (``parallel/inference.py``): the consume thread only deserializes
+    and ``submit()``s — concurrent requests coalesce into padded
+    micro-batches on the engine's replicas while a publisher thread
+    awaits each Future in arrival order, serializes, and publishes, so
+    serde never sits on the device-dispatch critical path and ordering
+    on ``out_topic`` is preserved. Pass an ``engine`` to share replicas
+    across routes (and ``warmup()`` it before traffic), or
+    ``engine=False`` for the legacy inline per-request ``net.output``
+    loop (the bench baseline)."""
 
     def __init__(self, net, broker: MessageBroker, in_topic: str,
-                 out_topic: str, idle_timeout: Optional[float] = None):
+                 out_topic: str, idle_timeout: Optional[float] = None,
+                 engine=None, max_batch_size: int = 32,
+                 max_latency_ms: float = 5.0):
         self.net = net
         self.broker = broker
         self.in_topic = in_topic
         self.out_topic = out_topic
         self.idle_timeout = idle_timeout
+        self.engine = engine
+        self.max_batch_size = max_batch_size
+        self.max_latency_ms = max_latency_ms
         self.served = 0
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
 
-    def run(self, max_requests: Optional[int] = None) -> int:
-        requests = get_registry().counter(
-            "dl4j_stream_requests_total", "Inference requests served",
-            topic=self.in_topic)
+    def _run_inline(self, requests, max_requests: Optional[int]) -> int:
         while True:
             with span("data_load", path="stream_serve", topic=self.in_topic):
                 payload = self.broker.consume(self.in_topic,
@@ -208,6 +221,72 @@ class StreamingInference:
             requests.inc()
             if max_requests is not None and self.served >= max_requests:
                 break
+        return self.served
+
+    def run(self, max_requests: Optional[int] = None) -> int:
+        requests = get_registry().counter(
+            "dl4j_stream_requests_total", "Inference requests served",
+            topic=self.in_topic)
+        if self.engine is False:
+            return self._run_inline(requests, max_requests)
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        engine = self.engine
+        own = engine is None
+        if own:
+            engine = ParallelInference(self.net,
+                                       max_batch_size=self.max_batch_size,
+                                       max_latency_ms=self.max_latency_ms,
+                                       replicas=1)
+        import queue as _queue
+        done = object()
+        out_q: "_queue.Queue" = _queue.Queue()
+        pub_error: List[BaseException] = []
+
+        def _publish():
+            # awaits futures in submit order: out_topic keeps the
+            # in_topic arrival order even though batches complete on
+            # whichever replica finishes first
+            while True:
+                fut = out_q.get()
+                if fut is done:
+                    return
+                try:
+                    pred = fut.result()
+                    self.broker.publish(self.out_topic, ndarray_to_bytes(pred))
+                except BaseException as e:
+                    if not pub_error:
+                        pub_error.append(e)
+                    continue
+                self.served += 1
+                requests.inc()
+
+        publisher = threading.Thread(target=_publish, daemon=True,
+                                     name="dl4j-tpu-stream-publish")
+        publisher.start()
+        submitted = 0
+        try:
+            while True:
+                with span("data_load", path="stream_serve",
+                          topic=self.in_topic):
+                    payload = self.broker.consume(self.in_topic,
+                                                  timeout=self.idle_timeout)
+                if payload is None or payload == _STOP:
+                    break
+                out_q.put(engine.submit(ndarray_from_bytes(payload)))
+                submitted += 1
+                if max_requests is not None and submitted >= max_requests:
+                    break
+        finally:
+            out_q.put(done)
+            publisher.join()
+            if own:
+                try:
+                    engine.shutdown()
+                except BaseException as e:
+                    if not pub_error:
+                        pub_error.append(e)
+        if pub_error:
+            raise pub_error[0]
         return self.served
 
     def start(self, max_requests: Optional[int] = None) -> "StreamingInference":

@@ -151,14 +151,20 @@ class _Handler(BaseHTTPRequestHandler):
         nan = reg.family_total(NAN_COUNTER)
         slow = reg.family_total(SLOW_COUNTER)
         status = "ok" if nan == 0 else "degraded"
-        return self._json({
+        body = {
             "status": status,
             "nan_scores": int(nan),
             "slow_steps": int(slow),
             "sessions": len(self.storage.list_sessions()),
             "uptime_s": round(time.monotonic()
                               - self.server._started_at, 3),  # type: ignore
-        }, 200 if status == "ok" else 503)
+        }
+        engine = getattr(self.server, "_infer_engine", None)
+        if engine is not None:
+            # serving-plane snapshot (the dl4j_infer_* metric families
+            # on /metrics carry the full histograms)
+            body["inference"] = engine.stats()
+        return self._json(body, 200 if status == "ok" else 503)
 
     # ------------------------------------------------------ /tsne view
     # (``deeplearning4j-ui-resources/.../ui/tsne/`` dashboard role: the
@@ -379,7 +385,8 @@ class UiServer:
     def __init__(self, storage: StatsStorage, port: int = 0,
                  host: str = "127.0.0.1", verbose: bool = False,
                  word_vectors=None, model=None, conv_listener=None,
-                 flow_listener=None, tsne=None, registry=None):
+                 flow_listener=None, tsne=None, registry=None,
+                 inference_engine=None):
         """``word_vectors``: any object with ``words_nearest(word, n)``
         (Word2Vec/WordVectors) — enables the /words nearest-neighbor
         view (legacy dl4j-scaleout/deeplearning4j-nlp render role).
@@ -392,11 +399,15 @@ class UiServer:
         (``plot/tsne.py`` output; also settable later via
         ``set_tsne`` or POST /api/tsne). ``registry``: MetricsRegistry
         served at /metrics + /healthz (default: the process-wide one the
-        monitor spans/listeners/watchdogs publish into)."""
+        monitor spans/listeners/watchdogs publish into).
+        ``inference_engine``: a ``ParallelInference`` whose ``stats()``
+        snapshot rides along on /healthz (its dl4j_infer_* metric
+        families land on /metrics regardless)."""
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd._storage = storage  # type: ignore[attr-defined]
         self._httpd._verbose = verbose  # type: ignore[attr-defined]
         self._httpd._registry = registry  # type: ignore[attr-defined]
+        self._httpd._infer_engine = inference_engine  # type: ignore[attr-defined]
         self._httpd._started_at = time.monotonic()  # type: ignore[attr-defined]
         self._httpd._word_vectors = word_vectors  # type: ignore[attr-defined]
         self._httpd._flow_model = model  # type: ignore[attr-defined]

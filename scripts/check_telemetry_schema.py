@@ -156,12 +156,20 @@ KNOWN_DL4J_METRICS = {
     "dl4j_stream_batches_total",
     "dl4j_stream_buffer_examples",
     "dl4j_stream_examples_total",
+    "dl4j_stream_requests_total",
     # device-feed pipeline (datasets/iterators.py + the fit() paths)
     "dl4j_feed_h2d_bytes_total",
     "dl4j_feed_queue_depth",
     "dl4j_feed_padded_batches_total",
     "dl4j_jit_cache_miss_total",
     "dl4j_score_sync_total",
+    # serving plane (parallel/inference.py ParallelInference)
+    "dl4j_infer_requests_total",
+    "dl4j_infer_batches_total",
+    "dl4j_infer_batch_size",
+    "dl4j_infer_queue_depth",
+    "dl4j_infer_padded_ratio",
+    "dl4j_infer_latency_ms",
 }
 
 
