@@ -486,6 +486,118 @@ def bench_serving_inference():
             "vs_baseline": round(on / off, 3)}
 
 
+def bench_fault_recovery():
+    """Fault-tolerance recovery-time benchmark, two fault domains:
+
+    (1) training — inject one NaN batch into a supervised per-step fit;
+    report the wall time of the rollback (detect → restore snapshot →
+    LR backoff → recompile) and steps-to-resume (batches from the fault
+    until the next healthy step lands — 1 means the very next batch
+    trained);
+
+    (2) serving — a closed-loop request driver against a 2-replica
+    ParallelInference engine; report p50/p99 per-request latency
+    healthy vs during a replica quarantine (poison hook trips one
+    replica; the engine serves on at reduced capacity) and the
+    recovery time from first injected fault to quarantine."""
+    import time
+
+    import jax
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.faultinject import (FailingDataSetIterator,
+                                                poison_replica)
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.supervisor import TrainingSupervisor
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    rng = np.random.default_rng(0)
+    nin, nc = 64, 8
+
+    def build():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).learning_rate(0.05).updater("adam").activation("relu")
+                .list()
+                .layer(DenseLayer(n_in=nin, n_out=256))
+                .layer(OutputLayer(n_in=256, n_out=nc, activation="softmax",
+                                   loss_function="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    # ---- (1) NaN rollback recovery time
+    n = 256 * 12
+    data = DataSet(rng.standard_normal((n, nin)).astype(np.float32),
+                   np.eye(nc, dtype=np.float32)[rng.integers(0, nc, n)])
+    net = build()
+    net.fit(ListDataSetIterator(data, 256))  # warm the train program
+    sup = TrainingSupervisor(net, max_rollbacks=3)
+    it = FailingDataSetIterator(ListDataSetIterator(data, 256), nan_at={5})
+    steps_to_resume = None
+    t_fault = t_recovered = None
+    it.reset()
+    while it.has_next():
+        ds = it.next()
+        t0 = time.perf_counter()
+        ok = sup.step(ds)
+        if not ok and t_fault is None:
+            t_fault = t0  # the batch that tripped the rollback
+        elif t_fault is not None and ok and t_recovered is None:
+            t_recovered = time.perf_counter()
+            steps_to_resume = sup.steps_done - 1 - sup.batches_skipped[-1]
+    rollback_ms = (t_recovered - t_fault) * 1e3 if t_recovered else None
+
+    # ---- (2) engine p99 during quarantine vs healthy
+    snet = build()
+    dev = jax.devices()[0]
+    eng = ParallelInference(snet, max_batch_size=16, max_latency_ms=2.0,
+                            devices=[dev, dev],
+                            probe_interval_ms=3600_000.0)  # no self-heal mid-run
+    try:
+        eng.warmup([(nin,)])
+
+        def drive(n_requests):
+            lats = []
+            for _ in range(n_requests):
+                x = rng.standard_normal((2, nin)).astype(np.float32)
+                t0 = time.perf_counter()
+                eng.output(x, timeout=60)
+                lats.append((time.perf_counter() - t0) * 1e3)
+            return lats
+
+        healthy = drive(200)
+        t0 = time.perf_counter()
+        poison_replica(eng, replica=0, failures=2)
+        degraded = []
+        for _ in range(100):  # bounded: ~1000 requests to trip the poison
+            if eng.stats()["quarantined"]:
+                break
+            degraded.extend(drive(10))
+        quarantine_ms = (time.perf_counter() - t0) * 1e3
+        degraded.extend(drive(200))
+        q = lambda xs, p: float(np.percentile(np.asarray(xs), p))
+        result_serving = {
+            "healthy_p50_ms": round(q(healthy, 50), 3),
+            "healthy_p99_ms": round(q(healthy, 99), 3),
+            "quarantined_p50_ms": round(q(degraded, 50), 3),
+            "quarantined_p99_ms": round(q(degraded, 99), 3),
+            "time_to_quarantine_ms": round(quarantine_ms, 3),
+            "replicas": 2, "healthy_replicas_during_fault": 1,
+        }
+    finally:
+        eng.shutdown()
+
+    return {"metric": "fault_recovery_nan_rollback_ms",
+            "value": round(rollback_ms, 3) if rollback_ms else -1.0,
+            "unit": "ms",
+            "steps_to_resume": steps_to_resume,
+            "rollbacks": sup.rollbacks,
+            "serving": result_serving,
+            "vs_baseline": 1.0}
+
+
 def bench_word2vec():
     """Word2Vec skip-gram (BASELINE config #5): the all-epochs-on-device
     SGNS scan engine (device pairgen + table negatives + capped MXU
@@ -574,6 +686,7 @@ def main():
                      ("flash_attention_train", bench_flash_attention_train),
                      ("gpt", bench_gpt), ("gpt_large", bench_gpt_large),
                      ("serving_inference", bench_serving_inference),
+                     ("fault_recovery", bench_fault_recovery),
                      ("word2vec", bench_word2vec)]:
         # fresh registry per sub-bench: the monitor spans inside the
         # fit/stage paths give each result its own per-phase attribution

@@ -30,6 +30,19 @@ def feed_pipeline_enabled(flag: Optional[bool] = None) -> bool:
     return os.environ.get("DL4J_TPU_DISABLE_FEED_PIPELINE", "") != "1"
 
 
+def _queue_get_alive(q: "queue.Queue", thread, sentinel):
+    """Blocking queue pull that cannot hang on a dead producer: when the
+    worker thread died (or was stopped by a concurrent ``close()``)
+    without delivering its end-of-stream sentinel, synthesize the
+    sentinel instead of blocking forever — the close-after-error race."""
+    while True:
+        try:
+            return q.get(timeout=0.25)
+        except queue.Empty:
+            if thread is None or not thread.is_alive():
+                return sentinel
+
+
 class DataSetPreProcessor:
     """``DataSetPreProcessor`` contract: mutate-or-replace a minibatch
     before the caller sees it (normalizers implement this too)."""
@@ -158,7 +171,9 @@ class AsyncDataSetIterator(DataSetIterator):
     thread pulls from the wrapped iterator into a bounded queue so batch
     preparation overlaps device compute. ``MultiLayerNetwork.fit`` wraps
     its iterator in this automatically (``MultiLayerNetwork.java:1032``
-    behavior)."""
+    behavior). A worker-side exception is re-raised on the consumer
+    thread (it used to silently truncate the epoch); ``close()`` after a
+    worker death neither hangs nor re-raises."""
 
     _SENTINEL = object()
 
@@ -171,6 +186,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._peeked: Optional[object] = None
         self._exhausted = False
         self._needs_reset = False  # thread starts lazily on first pull
+        self._error: Optional[BaseException] = None
 
     def _worker(self, q: "queue.Queue", stop: threading.Event):
         try:
@@ -182,6 +198,8 @@ class AsyncDataSetIterator(DataSetIterator):
                         break
                     except queue.Full:
                         continue
+        except BaseException as e:  # surfaced on the consumer thread
+            self._error = e
         finally:
             # the sentinel MUST reach the consumer or has_next() blocks
             # forever: a put_nowait here silently dropped it whenever
@@ -199,6 +217,7 @@ class AsyncDataSetIterator(DataSetIterator):
         if self._needs_reset:
             self._wrapped.reset()
             self._needs_reset = False
+        self._error = None
         self._stop = threading.Event()
         self._queue = queue.Queue(maxsize=self._queue_size)
         self._thread = threading.Thread(target=self._worker,
@@ -208,11 +227,12 @@ class AsyncDataSetIterator(DataSetIterator):
     def reset(self):
         if self._thread is not None and self._thread.is_alive():
             self._stop.set()  # worker exits without draining the source
-            self._thread.join()
+            self._thread.join(timeout=5)
         self._thread = None
         self._peeked = None
         self._exhausted = False
         self._needs_reset = True
+        self._error = None  # abandon drops an undelivered worker error
 
     def has_next(self):
         if self._peeked is not None:
@@ -221,9 +241,12 @@ class AsyncDataSetIterator(DataSetIterator):
             return False
         if self._thread is None:
             self._start()
-        item = self._queue.get()
+        item = _queue_get_alive(self._queue, self._thread, self._SENTINEL)
         if item is self._SENTINEL:
             self._exhausted = True
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
             return False
         self._peeked = item
         return True
@@ -333,11 +356,13 @@ class DeviceFeedIterator(DataSetIterator):
     def reset(self):
         if self._thread is not None and self._thread.is_alive():
             self._stop.set()
-            self._thread.join()
+            self._thread.join(timeout=5)
         self._thread = None
         self._peeked = None
         self._exhausted = False
         self._needs_reset = True
+        self._error = None  # abandon drops an undelivered worker error
+        # (close-after-error must not re-raise on the next use)
 
     close = reset  # abandon == reset-without-restart (lazy restart)
 
@@ -356,7 +381,7 @@ class DeviceFeedIterator(DataSetIterator):
             return False
         if self._thread is None:
             self._start()
-        item = self._queue.get()
+        item = _queue_get_alive(self._queue, self._thread, self._SENTINEL)
         self._depth_gauge().set(self._queue.qsize())
         if item is self._SENTINEL:
             self._exhausted = True

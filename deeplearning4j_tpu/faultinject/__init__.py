@@ -1,0 +1,231 @@
+"""Deterministic fault injectors — the harness that proves the
+fault-tolerance layer.
+
+A recovery path that has never run IS a bug; the only way to trust the
+detect → isolate → recover machinery (crash-safe checkpoints, the
+training supervisor, replica quarantine, broker reconnect/dead-letter)
+is to inject each fault class deliberately. Every injector here is
+deterministic: faults fire on explicit schedules (batch/call indices)
+or from a SEEDED rng — a failing test replays bit-identically.
+
+Injector ↔ fault domain map:
+
+- :class:`FailingDataSetIterator` — NaN batches / mid-epoch iterator
+  exceptions (training domain: supervisor rollback, feed-pipeline
+  worker death);
+- :class:`FlakyBroker` — scheduled transport errors on publish/consume
+  (transport domain: reconnect, ``BrokerUnavailable`` surfacing);
+- :func:`tear_file` / :func:`corrupt_file` / :class:`TornWrites` —
+  torn and bit-flipped checkpoint artifacts, and a crash *between* the
+  tmp write and the atomic install (checkpoint domain);
+- :func:`poison_replica` — scheduled device errors on one serving
+  replica (serving domain: retry, quarantine, probe reinstatement).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+from deeplearning4j_tpu.streaming.broker import MessageBroker
+
+
+class InjectedFault(RuntimeError):
+    """The marker exception every injector raises — a test that sees a
+    different exception type knows recovery swallowed the wrong thing."""
+
+
+# ------------------------------------------------------------- training
+
+class FailingDataSetIterator(DataSetIterator):
+    """Wraps an iterator and injects batch-level faults on a
+    deterministic schedule (0-based batch indices, counted across
+    resets): ``nan_at`` batches keep their shape but carry all-NaN
+    features (the classic diverged-upstream-pipeline batch — scores go
+    NaN one step later); ``raise_at`` batches raise
+    :class:`InjectedFault` from ``next()`` (a dead data source).
+    ``p_nan`` adds seeded random NaN batches on top."""
+
+    def __init__(self, wrapped: DataSetIterator, nan_at: Iterable[int] = (),
+                 raise_at: Iterable[int] = (), p_nan: float = 0.0,
+                 seed: int = 0):
+        self._wrapped = wrapped
+        self.nan_at = frozenset(int(i) for i in nan_at)
+        self.raise_at = frozenset(int(i) for i in raise_at)
+        self._p_nan = float(p_nan)
+        self._rng = random.Random(seed)
+        self._count = 0  # batches emitted, across resets (deterministic)
+        self.injected_nan: list = []
+        self.injected_raise: list = []
+
+    def reset(self):
+        self._wrapped.reset()
+
+    def has_next(self):
+        return self._wrapped.has_next()
+
+    def batch(self):
+        return self._wrapped.batch()
+
+    def async_supported(self) -> bool:
+        return self._wrapped.async_supported()
+
+    def set_pre_processor(self, pp) -> None:
+        self._wrapped.set_pre_processor(pp)
+
+    def pre_processor(self):
+        return self._wrapped.pre_processor()
+
+    def _next_impl(self):
+        idx = self._count
+        self._count += 1
+        if idx in self.raise_at:
+            self.injected_raise.append(idx)
+            raise InjectedFault(f"injected iterator failure at batch {idx}")
+        ds = self._wrapped.next()
+        if idx in self.nan_at or (self._p_nan > 0
+                                  and self._rng.random() < self._p_nan):
+            self.injected_nan.append(idx)
+            feats = np.full_like(np.asarray(ds.features), np.nan)
+            ds = DataSet(feats, ds.labels, ds.features_mask, ds.labels_mask)
+        return ds
+
+
+# ------------------------------------------------------------ transport
+
+class FlakyBroker(MessageBroker):
+    """Wraps any ``MessageBroker`` and fails scheduled calls (0-based,
+    per operation kind) with ``exc`` — after its schedule is exhausted
+    the broker heals. ``p_fail`` adds seeded random failures. The
+    wrapped broker is NOT touched on a failed call (the op never
+    happened — the at-most-once half of a real dropped connection)."""
+
+    def __init__(self, wrapped: MessageBroker,
+                 fail_publishes: Iterable[int] = (),
+                 fail_consumes: Iterable[int] = (),
+                 p_fail: float = 0.0, seed: int = 0,
+                 exc=ConnectionError):
+        self._wrapped = wrapped
+        self.fail_publishes = frozenset(int(i) for i in fail_publishes)
+        self.fail_consumes = frozenset(int(i) for i in fail_consumes)
+        self._p_fail = float(p_fail)
+        self._rng = random.Random(seed)
+        self._exc = exc
+        self._publishes = 0
+        self._consumes = 0
+        self.faults_injected = 0
+
+    def _maybe_fail(self, idx: int, schedule: frozenset, what: str) -> None:
+        if idx in schedule or (self._p_fail > 0
+                               and self._rng.random() < self._p_fail):
+            self.faults_injected += 1
+            raise self._exc(f"injected broker failure on {what} #{idx}")
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        idx, self._publishes = self._publishes, self._publishes + 1
+        self._maybe_fail(idx, self.fail_publishes, "publish")
+        self._wrapped.publish(topic, payload)
+
+    def consume(self, topic: str, timeout: Optional[float] = None):
+        idx, self._consumes = self._consumes, self._consumes + 1
+        self._maybe_fail(idx, self.fail_consumes, "consume")
+        return self._wrapped.consume(topic, timeout=timeout)
+
+    def close(self) -> None:
+        self._wrapped.close()
+
+
+# ----------------------------------------------------------- checkpoint
+
+def tear_file(path: str, keep_fraction: float = 0.5) -> None:
+    """Truncate ``path`` to a prefix — the torn write a crash leaves
+    behind on a filesystem without the atomic-install discipline."""
+    size = os.path.getsize(path)
+    keep = max(0, int(size * keep_fraction))
+    with open(path, "rb+") as f:
+        f.truncate(keep)
+
+
+def corrupt_file(path: str, offset: int = -8, flip: int = 0xFF) -> None:
+    """XOR one byte of ``path`` (negative offsets count from the end) —
+    silent media corruption the CRC manifest must catch."""
+    with open(path, "rb+") as f:
+        f.seek(offset, os.SEEK_END if offset < 0 else os.SEEK_SET)
+        pos = f.tell()
+        byte = f.read(1)
+        f.seek(pos)
+        f.write(bytes([byte[0] ^ (flip & 0xFF)]))
+
+
+class TornWrites:
+    """Context manager that crashes the Nth atomic install (1-based
+    count of ``os.replace``/``os.rename`` calls whose destination
+    contains ``path_substr``) with :class:`InjectedFault` — simulating a
+    preemption BETWEEN writing the temp artifact and renaming it into
+    place, the exact window crash-safe persistence must survive."""
+
+    def __init__(self, crash_on_call: int = 1,
+                 path_substr: Optional[str] = None):
+        self.crash_on_call = int(crash_on_call)
+        self.path_substr = path_substr
+        self.calls = 0
+        self._orig_replace = None
+        self._orig_rename = None
+
+    def _wrap(self, orig):
+        def patched(src, dst, *a, **k):
+            if self.path_substr is None or self.path_substr in str(dst):
+                self.calls += 1
+                if self.calls == self.crash_on_call:
+                    raise InjectedFault(
+                        f"injected crash before installing {dst}")
+            return orig(src, dst, *a, **k)
+        return patched
+
+    def __enter__(self) -> "TornWrites":
+        self._orig_replace = os.replace
+        self._orig_rename = os.rename
+        os.replace = self._wrap(self._orig_replace)
+        os.rename = self._wrap(self._orig_rename)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        os.replace = self._orig_replace
+        os.rename = self._orig_rename
+
+
+# -------------------------------------------------------------- serving
+
+class ReplicaPoison:
+    """Poison hook for ``ParallelInference``: the target replica's next
+    ``failures`` dispatches (serving AND probe) raise
+    :class:`InjectedFault`; afterwards the replica heals. Install via
+    :func:`poison_replica` or pass as ``poison_hook=``."""
+
+    def __init__(self, replica: int, failures: int):
+        self.replica = int(replica)
+        self.remaining = int(failures)
+        self.hits = 0
+
+    def __call__(self, replica_idx: int, shape: Sequence[int]) -> None:
+        if replica_idx == self.replica and self.remaining > 0:
+            self.remaining -= 1
+            self.hits += 1
+            raise InjectedFault(
+                f"injected device fault on replica {replica_idx}")
+
+
+def poison_replica(engine, replica: int = 0, failures: int = 2
+                   ) -> ReplicaPoison:
+    """Arm a :class:`ReplicaPoison` on a live engine (the engine's
+    ``poison_hook`` seam); returns the handle so the test can watch
+    ``remaining``/``hits``. ``failures=2`` defeats the single same-replica
+    retry and forces a quarantine; the next probe then heals it."""
+    poison = ReplicaPoison(replica, failures)
+    engine._poison_hook = poison
+    return poison

@@ -42,6 +42,15 @@ that were bucket padding), and ``dl4j_infer_latency_ms`` (per-request
 submit→result latency). ``dl4j_jit_cache_miss_total`` is shared with
 the training plane: a serve-loop dispatch that traces+compiles ticks it
 too, which is how the AOT ``warmup()`` contract is asserted.
+
+The fault-tolerance plane publishes ``dl4j_fault_events_total`` (by
+``domain``: checkpoint/training/serving/transport),
+``dl4j_fault_rollbacks_total`` (supervisor divergence rollbacks),
+``dl4j_fault_quarantined_replicas`` (serving replicas currently out),
+``dl4j_fault_dead_letter_total`` (poison messages routed to DLQs), and
+``dl4j_fault_checkpoint_integrity_failures_total`` (restores that hit a
+torn/checksum-bad unit) — a healthy fleet holds all of them at zero,
+and any nonzero value names the recovery path that ran.
 """
 
 # Device-feed pipeline metric family names (one name, one meaning —
@@ -66,6 +75,27 @@ INFER_LATENCY_HISTOGRAM = "dl4j_infer_latency_ms"
 # Bucket bounds for dl4j_infer_batch_size (rows per dispatched batch).
 INFER_BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                             256.0, 512.0, 1024.0)
+
+# Fault-tolerance plane (detect → isolate → recover): every recovery
+# path in the stack reports through these five families so an operator
+# can tell a self-healed fault from a healthy run. ``domain`` label on
+# the events counter: "checkpoint" (torn/corrupt persistence),
+# "training" (NaN/divergence rollback), "serving" (replica device
+# errors/quarantine), "transport" (broker reconnects, poison messages).
+FAULT_EVENTS_COUNTER = "dl4j_fault_events_total"
+FAULT_ROLLBACKS_COUNTER = "dl4j_fault_rollbacks_total"
+FAULT_QUARANTINED_GAUGE = "dl4j_fault_quarantined_replicas"
+FAULT_DEAD_LETTER_COUNTER = "dl4j_fault_dead_letter_total"
+FAULT_CKPT_INTEGRITY_COUNTER = "dl4j_fault_checkpoint_integrity_failures_total"
+
+
+def record_fault(domain: str) -> None:
+    """Tick the per-domain fault counter (the shared entry point every
+    recovery path calls when it observes a fault, before recovering)."""
+    get_registry().counter(
+        FAULT_EVENTS_COUNTER,
+        "Faults observed (and handled) by the fault-tolerance layer",
+        domain=domain).inc()
 
 from deeplearning4j_tpu.monitor.registry import (  # noqa: F401
     Counter,

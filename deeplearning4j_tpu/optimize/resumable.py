@@ -24,6 +24,7 @@ training mode:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import tempfile
 from typing import Optional
@@ -31,7 +32,12 @@ from typing import Optional
 import shutil
 
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
-from deeplearning4j_tpu.util.model_serializer import restore_model, write_model
+from deeplearning4j_tpu.monitor import record_fault
+from deeplearning4j_tpu.util.model_serializer import (fsync_dir,
+                                                      restore_model,
+                                                      write_model)
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 _UNIT = "checkpoint"
 _TMP_PREFIX = ".ckpt_tmp_"
@@ -56,6 +62,7 @@ class ResumableTrainer:
                               ignore_errors=True)
         self.steps_done = 0
         self.epochs_done = 0
+        self._supervisor = None
 
     # ---- checkpoint plumbing ----
 
@@ -72,10 +79,17 @@ class ResumableTrainer:
                       "epochs_done": self.epochs_done}
             if hasattr(iterator, "state"):
                 cursor["iterator"] = iterator.state()
-            with open(os.path.join(tmp, _CURSOR), "w") as f:
+            if self._supervisor is not None:
+                cursor["supervisor"] = self._supervisor.state()
+            # cursor metadata lands via its own tmp-file + fsync +
+            # os.replace, so even INSIDE the temp unit it is never
+            # observable half-written
+            cursor_tmp = os.path.join(tmp, _CURSOR + ".tmp")
+            with open(cursor_tmp, "w") as f:
                 json.dump(cursor, f)
                 f.flush()
                 os.fsync(f.fileno())
+            os.replace(cursor_tmp, os.path.join(tmp, _CURSOR))
             final = os.path.join(self.directory, _UNIT)
             old = final + ".old"
             # Invariant (ADVICE r3): at EVERY instant at least one
@@ -88,58 +102,97 @@ class ResumableTrainer:
                 os.rename(final, old)
             os.rename(tmp, final)
             shutil.rmtree(old, ignore_errors=True)  # final covers us
+            fsync_dir(self.directory)
         finally:
             if os.path.isdir(tmp):
                 shutil.rmtree(tmp, ignore_errors=True)
 
-    def _unit_dir(self) -> Optional[str]:
-        """The newest COMPLETE checkpoint unit: ``checkpoint``, else
+    def _unit_candidates(self) -> list:
+        """Checkpoint units newest-first: ``checkpoint``, then
         ``checkpoint.old`` (present only when a preemption landed
         between the two install renames — its contents are the last
         complete unit, so recovery still loses at most the final
         interval, never the whole run)."""
-        for cand in (os.path.join(self.directory, _UNIT),
-                     os.path.join(self.directory, _UNIT + ".old")):
-            if (os.path.exists(os.path.join(cand, _MODEL))
-                    and os.path.exists(os.path.join(cand, _CURSOR))):
-                return cand
-        return None
+        return [cand for cand in (os.path.join(self.directory, _UNIT),
+                                  os.path.join(self.directory, _UNIT + ".old"))
+                if (os.path.exists(os.path.join(cand, _MODEL))
+                    and os.path.exists(os.path.join(cand, _CURSOR)))]
+
+    def _unit_dir(self) -> Optional[str]:
+        cands = self._unit_candidates()
+        return cands[0] if cands else None
 
     def has_checkpoint(self) -> bool:
         return self._unit_dir() is not None
 
-    def resume_or_start(self, iterator: Optional[DataSetIterator] = None):
+    def resume_or_start(self, iterator: Optional[DataSetIterator] = None,
+                        supervisor=None):
         """Restore model + cursor when a checkpoint exists; returns the
         (possibly restored) model. ``iterator`` (with ``restore()``) is
-        rewound to the saved position."""
-        unit = self._unit_dir()
-        if unit is None:
+        rewound to the saved position.
+
+        A half-written or checksum-bad unit (possible only when the
+        atomic-install invariant was violated underneath us — a torn
+        filesystem, manual tampering) is tolerated: warn, fall back to
+        the previous unit, and as a last resort start fresh from step 0
+        instead of raising. ``supervisor``: a ``TrainingSupervisor`` to
+        rebind to the restored model and reload the saved rollback/LR
+        policy state into, so the resumed run replays the same policy."""
+        for unit in self._unit_candidates():
+            try:
+                model = restore_model(os.path.join(unit, _MODEL))
+                with open(os.path.join(unit, _CURSOR)) as f:
+                    cursor = json.load(f)
+            except Exception as e:
+                record_fault("checkpoint")
+                logger.warning(
+                    "resume_or_start: checkpoint unit %s is unreadable "
+                    "(%s: %s) — falling back to the previous unit",
+                    unit, type(e).__name__, e)
+                continue
+            self.model = model
+            self.steps_done = int(cursor.get("steps_done", 0))
+            self.epochs_done = int(cursor.get("epochs_done", 0))
+            if iterator is not None and "iterator" in cursor:
+                if not hasattr(iterator, "restore"):
+                    raise ValueError(
+                        "checkpoint carries a data cursor but this iterator "
+                        f"({type(iterator).__name__}) has no restore(); "
+                        "resuming without rewinding would silently re-train "
+                        "already-consumed batches — pass the same resumable "
+                        "iterator type used during training")
+                iterator.restore(cursor["iterator"])
+            if supervisor is not None:
+                supervisor.model = self.model
+                supervisor.restore(cursor.get("supervisor", {}))
             return self.model
-        self.model = restore_model(os.path.join(unit, _MODEL))
-        with open(os.path.join(unit, _CURSOR)) as f:
-            cursor = json.load(f)
-        self.steps_done = int(cursor.get("steps_done", 0))
-        self.epochs_done = int(cursor.get("epochs_done", 0))
-        if iterator is not None and "iterator" in cursor:
-            if not hasattr(iterator, "restore"):
-                raise ValueError(
-                    "checkpoint carries a data cursor but this iterator "
-                    f"({type(iterator).__name__}) has no restore(); "
-                    "resuming without rewinding would silently re-train "
-                    "already-consumed batches — pass the same resumable "
-                    "iterator type used during training")
-            iterator.restore(cursor["iterator"])
+        if self._unit_candidates() or os.path.isdir(
+                os.path.join(self.directory, _UNIT)):
+            logger.warning(
+                "resume_or_start: no readable checkpoint unit under %s — "
+                "starting fresh from step 0", self.directory)
         return self.model
 
     # ---- training loop ----
 
     def fit(self, iterator: DataSetIterator, epochs: int = 1,
-            max_steps: Optional[int] = None) -> int:
+            max_steps: Optional[int] = None, supervisor=None) -> int:
         """Train until ``epochs`` complete (counting epochs finished in
         previous incarnations) or ``max_steps`` NEW batches were
         consumed (the preemption-simulation hook). Checkpoints land
         every ``checkpoint_every`` steps AND at each epoch end; returns
-        the number of batches consumed this call."""
+        the number of batches consumed this call.
+
+        ``supervisor``: a ``TrainingSupervisor`` guarding each batch —
+        its rollback/LR-backoff state is checkpointed with the cursor,
+        so a preempted run resumes under the same recovery policy
+        (pass the same supervisor to ``resume_or_start``)."""
+        if supervisor is not None and supervisor.model is not self.model:
+            raise ValueError(
+                "supervisor guards a different model object; construct it "
+                "over this trainer's model (or pass it through "
+                "resume_or_start, which rebinds it to the restored model)")
+        self._supervisor = supervisor
         consumed = 0
         while self.epochs_done < epochs:
             while iterator.has_next():
@@ -147,7 +200,10 @@ class ResumableTrainer:
                     self._save(iterator)
                     return consumed
                 ds = iterator.next()
-                self.model.fit(ds)
+                if supervisor is not None:
+                    supervisor.step(ds)
+                else:
+                    self.model.fit(ds)
                 self.steps_done += 1
                 consumed += 1
                 if self.steps_done % self.checkpoint_every == 0:

@@ -31,6 +31,19 @@ Mechanics:
 - ``shutdown()`` drains in-flight work and re-raises the first worker
   error; a worker error also lands on every affected Future.
 
+Serving degradation (detect → isolate → recover): a per-batch device
+error is retried once on the same replica; a second failure
+**quarantines** the replica — it leaves the dispatch pool, the
+in-flight batch is redispatched to the surviving replicas (futures are
+never stranded: when no survivor remains the batch's futures carry the
+error), and the engine keeps serving at reduced capacity. A
+quarantined replica is **probed** every ``probe_interval_ms`` with a
+known-good single-row program (or reinstated optimistically when no
+good shape has been seen yet) and rejoins the pool when the probe
+passes. ``stats()["quarantined"]`` / ``dl4j_fault_quarantined_replicas``
+surface the degraded state — ``UiServer /healthz`` turns 503-degraded
+while any replica is out.
+
 Exactness: batched rows are bitwise-equal to an unbatched ``output()``
 run (row-independent programs; the same property PR 2's bucketing
 parity test pins for training). Models with cross-batch statistics
@@ -52,6 +65,7 @@ import numpy as np
 from deeplearning4j_tpu.datasets.iterators import (bucket_for, bucket_sizes,
                                                    pad_rows)
 from deeplearning4j_tpu.monitor import (
+    FAULT_QUARANTINED_GAUGE,
     INFER_BATCH_SIZE_BUCKETS,
     INFER_BATCH_SIZE_HISTOGRAM,
     INFER_BATCHES_COUNTER,
@@ -60,6 +74,8 @@ from deeplearning4j_tpu.monitor import (
     INFER_QUEUE_DEPTH_GAUGE,
     INFER_REQUESTS_COUNTER,
     get_registry,
+    mark,
+    record_fault,
     span,
 )
 from deeplearning4j_tpu.optimize.deferred import note_dispatch
@@ -81,12 +97,13 @@ class _Request:
 
 
 class _Batch:
-    __slots__ = ("requests", "x", "rows")
+    __slots__ = ("requests", "x", "rows", "tried")
 
     def __init__(self, requests: List[_Request], x: np.ndarray, rows: int):
         self.requests = requests
         self.x = x  # bucket-padded, model dtype
         self.rows = rows  # real (unpadded) row count
+        self.tried: set = set()  # replicas that gave up on this batch
 
 
 _STOP = object()
@@ -118,7 +135,10 @@ class ParallelInference:
                  devices: Optional[Sequence] = None,
                  buckets: Optional[Sequence[int]] = None,
                  coalesce: Optional[bool] = None,
-                 eager_when_idle: bool = True, start: bool = True):
+                 eager_when_idle: bool = True, start: bool = True,
+                 max_batch_retries: int = 1,
+                 probe_interval_ms: float = 50.0,
+                 poison_hook=None):
         if net.params is None:
             net.init()
         self.net = net
@@ -154,6 +174,17 @@ class ParallelInference:
         self._closed = False
         self._error: Optional[BaseException] = None
         self._lock = threading.Lock()
+        # fault tolerance: per-batch retry budget on one replica, then
+        # quarantine + probe-based reinstatement
+        self.max_batch_retries = max(0, int(max_batch_retries))
+        self.probe_interval = max(1e-3, float(probe_interval_ms)) / 1e3
+        self._poison_hook = poison_hook  # faultinject seam (tests/bench)
+        self._quarantined: set = set()
+        self._probe_wake: Dict[int, threading.Event] = {
+            i: threading.Event() for i in range(len(self._replicas))}
+        self._stopping = False
+        self._probe_shape: Optional[Tuple[int, ...]] = None
+        self._fault_log: List[str] = []
         self._rows_dispatched = 0
         self._rows_padded = 0
         self._batches = 0
@@ -239,11 +270,15 @@ class ParallelInference:
                               path="warmup", bucket=b, replica=i):
                         np.asarray(self._fn(params, states, x, None))
                     compiled += int(fresh)
+            with self._lock:
+                # a warmed shape doubles as the quarantine probe program
+                self._probe_shape = tuple(shape)
         return compiled
 
     def stats(self) -> Dict[str, float]:
         with self._lock:
             rows, padded = self._rows_dispatched, self._rows_padded
+            quarantined = sorted(self._quarantined)
             return {
                 "requests": self._requests,
                 "batches": self._batches,
@@ -254,7 +289,18 @@ class ParallelInference:
                 "replicas": len(self._replicas),
                 "buckets": list(self.buckets),
                 "coalesce": self.coalesce,
+                "quarantined": quarantined,
+                "healthy_replicas": len(self._replicas) - len(quarantined),
+                "degraded": bool(quarantined),
+                "faults": len(self._fault_log),
             }
+
+    def probe_now(self) -> None:
+        """Wake every quarantined replica's probe immediately (instead
+        of waiting out ``probe_interval_ms``) — the deterministic seam
+        the fault-injection tests and operators use."""
+        for ev in self._probe_wake.values():
+            ev.set()
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None) -> None:
         """Stop accepting work; drain (default) or cancel what is queued,
@@ -272,6 +318,19 @@ class ParallelInference:
         self._rq.put(_STOP)
         for t in self._threads:
             t.join(timeout)
+        # belt-and-braces: a batch redispatched in the shutdown race can
+        # outlive every worker — its futures must still resolve
+        while True:
+            try:
+                b = self._bq.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(b, _Batch):
+                err = self._error or RuntimeError(
+                    "ParallelInference shut down before dispatch")
+                for r in b.requests:
+                    if not r.future.done():
+                        r.future.set_exception(err)
         if self._error is not None:
             raise self._error
 
@@ -315,13 +374,12 @@ class ParallelInference:
         def flush(sig):
             reqs = pending.pop(sig)
             oldest.pop(sig, None)
-            with self._lock:
-                self._inflight += 1
             self._bq.put(self._form_batch(reqs))
 
         def idle_capacity() -> bool:
             with self._lock:
-                return self._inflight < len(self._replicas)
+                healthy = len(self._replicas) - len(self._quarantined)
+                return self._inflight < healthy
 
         while True:
             timeout = None
@@ -344,8 +402,14 @@ class ParallelInference:
                         pending.setdefault(self._sig(late), []).append(late)
                 for sig in list(pending):
                     flush(sig)
+                # after _stopping, workers finish what is queued and
+                # exit on their pill; quarantined workers exit from
+                # their probe wait (woken below)
+                self._stopping = True
                 for _ in self._replicas:
                     self._bq.put(_STOP)
+                for ev in self._probe_wake.values():
+                    ev.set()
                 return
             if item is not None:
                 self._depth_gauge().set(self._rq.qsize())
@@ -378,6 +442,7 @@ class ParallelInference:
         if self.coalesce:
             x = pad_rows(x, bucket_for(rows, self.buckets) - rows)
         with self._lock:
+            self._inflight += 1  # until delivered or failed, not requeues
             self._batches += 1
             self._rows_dispatched += x.shape[0]
             self._rows_padded += x.shape[0] - rows
@@ -395,38 +460,122 @@ class ParallelInference:
 
     # ------------------------------------------------------------ workers
 
+    def _dispatch(self, idx: int, params, states, x):
+        """One replica dispatch; the ``poison_hook`` seam lets the
+        faultinject harness stand in for a device fault
+        deterministically (it raises instead of the device)."""
+        if self._poison_hook is not None:
+            self._poison_hook(idx, x.shape)
+        return self._fn(params, states, x, None)
+
     def _worker_loop(self, idx: int):
         dev, params, states = self._replicas[idx]
         lat = self._reg().histogram(
             INFER_LATENCY_HISTOGRAM,
             "Per-request submit-to-result latency")
+        wake = self._probe_wake[idx]
         while True:
+            if idx in self._quarantined:
+                wake.wait(self.probe_interval)
+                wake.clear()
+                if self._stopping:
+                    return
+                self._probe(idx, dev, params, states)
+                continue
             b = self._bq.get()
             if b is _STOP:
                 return
+            err = self._run_batch(idx, dev, params, states, b, lat)
+            if err is not None:
+                self._quarantine(idx, b, err)
+
+    def _run_batch(self, idx, dev, params, states, b, lat):
+        """Run one batch with the per-replica retry budget; None on
+        success (futures resolved), else the last error (batch NOT yet
+        resolved — the caller decides quarantine/redispatch)."""
+        last: Optional[BaseException] = None
+        for attempt in range(1 + self.max_batch_retries):
             try:
-                try:
-                    with span("stage", path="infer_feed", replica=idx):
-                        x = jax.device_put(b.x, dev)
-                    fresh = note_dispatch(self.net,
-                                          self._dispatch_sig(idx, b.x.shape))
-                    with span("compile" if fresh else "inference",
-                              path="parallel_inference", replica=idx,
-                              rows=b.rows, batch=int(b.x.shape[0])):
-                        y = np.asarray(self._fn(params, states, x, None))
-                except BaseException as e:
-                    if self._error is None:
-                        self._error = e
-                    for r in b.requests:
-                        if not r.future.done():
-                            r.future.set_exception(e)
-                    continue
-                off = 0
-                now = time.perf_counter()
-                for r in b.requests:
-                    r.future.set_result(y[off:off + r.n])
-                    off += r.n
-                    lat.observe((now - r.t_submit) * 1e3)
-            finally:
-                with self._lock:
-                    self._inflight -= 1
+                with span("stage", path="infer_feed", replica=idx):
+                    x = jax.device_put(b.x, dev)
+                fresh = note_dispatch(self.net,
+                                      self._dispatch_sig(idx, b.x.shape))
+                with span("compile" if fresh else "inference",
+                          path="parallel_inference", replica=idx,
+                          rows=b.rows, batch=int(b.x.shape[0])):
+                    y = np.asarray(self._dispatch(idx, params, states, x))
+            except BaseException as e:
+                last = e
+                record_fault("serving")
+                self._fault_log.append(
+                    f"replica {idx} attempt {attempt + 1}: "
+                    f"{type(e).__name__}: {e}")
+                continue
+            with self._lock:
+                self._probe_shape = tuple(b.x.shape[1:])
+            off = 0
+            now = time.perf_counter()
+            for r in b.requests:
+                r.future.set_result(y[off:off + r.n])
+                off += r.n
+                lat.observe((now - r.t_submit) * 1e3)
+            with self._lock:
+                self._inflight -= 1
+            return None
+        return last
+
+    # -------------------------------------------- quarantine + probing
+
+    def _quarantined_gauge(self):
+        return self._reg().gauge(
+            FAULT_QUARANTINED_GAUGE,
+            "Serving replicas currently quarantined after device errors")
+
+    def _quarantine(self, idx: int, b: _Batch, err: BaseException) -> None:
+        """Pull replica ``idx`` from the dispatch pool and hand its batch
+        to a survivor; when every replica has given up on the batch (or
+        none survive), fail its futures — a future is never stranded."""
+        with self._lock:
+            self._quarantined.add(idx)
+            n_quarantined = len(self._quarantined)
+            survivors = [i for i in range(len(self._replicas))
+                         if i not in self._quarantined and i not in b.tried]
+        self._quarantined_gauge().set(n_quarantined)
+        mark("replica_quarantined", replica=idx, error=type(err).__name__)
+        b.tried.add(idx)
+        if survivors and not self._stopping:
+            self._bq.put(b)  # a surviving worker picks it up
+            return
+        for r in b.requests:
+            if not r.future.done():
+                r.future.set_exception(err)
+        if self._error is None:
+            self._error = err
+        with self._lock:
+            self._inflight -= 1
+
+    def _probe(self, idx: int, dev, params, states) -> None:
+        """Reinstatement probe: dispatch a known-good single-row program
+        on the quarantined replica; pass → rejoin the pool. Before any
+        shape has served successfully there is nothing trustworthy to
+        probe with — reinstate optimistically and let real traffic
+        re-quarantine if the replica is still sick."""
+        with self._lock:
+            shape = self._probe_shape
+        if shape is not None:
+            try:
+                zeros = np.zeros((1,) + shape, self._np_dtype)
+                x = jax.device_put(zeros, dev)
+                note_dispatch(self.net, self._dispatch_sig(idx, zeros.shape))
+                with span("inference", path="quarantine_probe", replica=idx):
+                    np.asarray(self._dispatch(idx, params, states, x))
+            except BaseException as e:
+                record_fault("serving")
+                self._fault_log.append(
+                    f"replica {idx} probe: {type(e).__name__}: {e}")
+                return  # still sick — stay quarantined
+        with self._lock:
+            self._quarantined.discard(idx)
+            n_quarantined = len(self._quarantined)
+        self._quarantined_gauge().set(n_quarantined)
+        mark("replica_reinstated", replica=idx)

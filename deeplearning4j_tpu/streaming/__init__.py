@@ -14,6 +14,7 @@ training: a stream is just a DataSetIterator whose ``has_next`` blocks.
 """
 
 from deeplearning4j_tpu.streaming.broker import (  # noqa: F401
+    BrokerUnavailable,
     InMemoryBroker,
     MessageBroker,
     TcpBroker,

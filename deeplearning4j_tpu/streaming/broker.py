@@ -12,14 +12,29 @@ actually use).
 
 from __future__ import annotations
 
+import logging
 import queue
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Dict, Optional
 
+from deeplearning4j_tpu.monitor import record_fault
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
 _MAX_FRAME = 1 << 30
+
+
+class BrokerUnavailable(ConnectionError):
+    """The broker could not be reached within the bounded reconnect
+    budget. Distinct from ``consume`` returning ``None`` — that is a
+    genuine long-poll timeout (broker healthy, topic empty); this means
+    the transport itself is down and the caller should fail over or
+    surface the outage instead of treating it as an idle stream."""
 
 
 class MessageBroker:
@@ -142,35 +157,117 @@ class TcpBroker(MessageBroker):
     """Client half: a ``MessageBroker`` over one TCP connection to a
     :class:`TcpBrokerServer`. Consume long-polls: the server replies
     empty after its poll timeout and the client retries until the
-    caller's ``timeout`` budget runs out."""
+    caller's ``timeout`` budget runs out.
 
-    def __init__(self, host: str, port: int, connect_timeout: float = 5.0):
-        self._sock = socket.create_connection((host, port), timeout=connect_timeout)
-        self._sock.settimeout(None)  # long-poll replies block
+    Transport resilience: a dropped connection (broker restart, network
+    blip) triggers reconnect-and-resend with jittered exponential
+    backoff, bounded by ``max_retries``; when the budget is exhausted
+    every operation raises :class:`BrokerUnavailable` — so ``consume``
+    returning ``None`` ALWAYS means "topic idle", never "transport
+    dead". The jitter RNG is seeded (deterministic fleets don't
+    thundering-herd a restarting broker on the same schedule). Retried
+    publishes are at-least-once: the op may have been applied just
+    before the connection died."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 5.0,
+                 max_retries: int = 4, backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 2.0, seed: int = 0):
+        self._host = host
+        self._port = port
+        self._connect_timeout = connect_timeout
+        self.max_retries = max(0, int(max_retries))
+        self._backoff_base = float(backoff_base_s)
+        self._backoff_max = float(backoff_max_s)
+        self._rng = random.Random(seed)
         self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._closed = False
+        with self._lock:
+            self._ensure_connected(initial=True)
+
+    # ----------------------------------------------------- connection
+
+    def _connect_once(self) -> None:
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._connect_timeout)
+        self._sock.settimeout(None)  # long-poll replies block
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _backoff(self, attempt: int) -> float:
+        delay = min(self._backoff_max, self._backoff_base * (2 ** attempt))
+        return delay * (0.5 + self._rng.random() / 2)  # jitter: [0.5, 1.0)x
+
+    def _ensure_connected(self, initial: bool = False) -> None:
+        if self._closed:
+            raise BrokerUnavailable("broker client is closed")
+        if self._sock is not None:
+            return
+        last: Optional[Exception] = None
+        for attempt in range(1 + self.max_retries):
+            if attempt > 0 or not initial:
+                time.sleep(self._backoff(attempt))
+            try:
+                self._connect_once()
+                if last is not None:
+                    logger.info("TcpBroker: reconnected to %s:%s after %d "
+                                "attempt(s)", self._host, self._port, attempt)
+                return
+            except OSError as e:
+                last = e
+                record_fault("transport")
+                logger.warning(
+                    "TcpBroker: connect to %s:%s failed (%s: %s), attempt "
+                    "%d/%d", self._host, self._port, type(e).__name__, e,
+                    attempt + 1, 1 + self.max_retries)
+        raise BrokerUnavailable(
+            f"broker {self._host}:{self._port} unreachable after "
+            f"{1 + self.max_retries} attempts") from last
+
+    # ------------------------------------------------------ transport
 
     def _roundtrip(self, op: bytes, topic: str, payload: bytes):
         with self._lock:
-            _send_frame(self._sock, op, topic, payload)
-            status = _recv_exact(self._sock, 1)
-            (rlen,) = struct.unpack(">I", _recv_exact(self._sock, 4))
-            return status == b"\x01", _recv_exact(self._sock, rlen)
+            last: Optional[Exception] = None
+            for attempt in range(1 + self.max_retries):
+                try:
+                    self._ensure_connected()
+                    _send_frame(self._sock, op, topic, payload)
+                    status = _recv_exact(self._sock, 1)
+                    (rlen,) = struct.unpack(">I", _recv_exact(self._sock, 4))
+                    return status == b"\x01", _recv_exact(self._sock, rlen)
+                except BrokerUnavailable:
+                    raise
+                except (OSError, ConnectionError, struct.error) as e:
+                    last = e
+                    record_fault("transport")
+                    logger.warning(
+                        "TcpBroker: %s on %s failed mid-roundtrip (%s: %s) — "
+                        "reconnecting", op, topic, type(e).__name__, e)
+                    self._drop()
+            raise BrokerUnavailable(
+                f"broker {self._host}:{self._port} lost mid-operation and "
+                f"unreachable after {1 + self.max_retries} attempts") from last
 
     def publish(self, topic: str, payload: bytes) -> None:
         self._roundtrip(b"P", topic, payload)
 
     def consume(self, topic: str, timeout: Optional[float] = None) -> Optional[bytes]:
-        import time
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             found, reply = self._roundtrip(b"C", topic, b"")
             if found:
                 return reply
             if deadline is not None and time.monotonic() >= deadline:
-                return None
+                return None  # genuine poll timeout — broker is healthy
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._closed = True
+            self._drop()

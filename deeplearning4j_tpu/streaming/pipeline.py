@@ -12,6 +12,7 @@ separate execution engine.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import List, Optional
 
@@ -19,12 +20,39 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
-from deeplearning4j_tpu.monitor import get_registry, span
+from deeplearning4j_tpu.monitor import (FAULT_DEAD_LETTER_COUNTER,
+                                        get_registry, record_fault, span)
 from deeplearning4j_tpu.streaming.broker import MessageBroker
 from deeplearning4j_tpu.streaming.serde import (
     dataset_from_bytes, dataset_to_bytes, ndarray_from_bytes, ndarray_to_bytes)
 
+logger = logging.getLogger("deeplearning4j_tpu")
+
 _STOP = b"__dl4j_tpu_stream_stop__"
+
+DEAD_LETTER_SUFFIX = ".deadletter"
+
+
+def dead_letter(broker: MessageBroker, topic: str, payload: bytes,
+                error: BaseException, dead_letter_topic: Optional[str] = None
+                ) -> None:
+    """Route an undecodable message to the dead-letter topic (default
+    ``<topic>.deadletter``) instead of killing the consume thread — the
+    Kafka DLQ discipline: one poison message must not take down the
+    route; the payload stays inspectable on the DLQ."""
+    dlq = dead_letter_topic or topic + DEAD_LETTER_SUFFIX
+    record_fault("transport")
+    get_registry().counter(
+        FAULT_DEAD_LETTER_COUNTER,
+        "Undecodable messages routed to a dead-letter topic",
+        topic=topic).inc()
+    logger.warning("stream %s: undecodable message (%s: %s) routed to %s",
+                   topic, type(error).__name__, error, dlq)
+    try:
+        broker.publish(dlq, payload)
+    except BaseException:
+        logger.exception("stream %s: dead-letter publish to %s failed "
+                         "(message dropped)", topic, dlq)
 
 
 def publish_dataset(broker: MessageBroker, topic: str, ds: DataSet) -> None:
@@ -42,28 +70,39 @@ class StreamingDataSetIterator(DataSetIterator):
     Accumulates incoming DataSets until ``batch_size`` examples are
     buffered (micro-batching), then emits one concatenated DataSet.
     ``has_next`` returns False after a stop pill or an idle period of
-    ``idle_timeout`` seconds (None = wait forever).
+    ``idle_timeout`` seconds (None = wait forever). An undecodable
+    message goes to ``dead_letter_topic`` (default
+    ``<topic>.deadletter``) and consumption continues.
     """
 
     def __init__(self, broker: MessageBroker, topic: str, batch_size: int = 32,
-                 idle_timeout: Optional[float] = None):
+                 idle_timeout: Optional[float] = None,
+                 dead_letter_topic: Optional[str] = None):
         self.broker = broker
         self.topic = topic
         self.batch_size = batch_size
         self.idle_timeout = idle_timeout
+        self.dead_letter_topic = dead_letter_topic or topic + DEAD_LETTER_SUFFIX
         self._buffer: List[DataSet] = []
         self._buffered = 0
         self._pending: Optional[DataSet] = None
         self._stopped = False
 
     def _pull(self) -> bool:
-        """Fetch one message into the buffer; False on stop/timeout."""
+        """Fetch one message into the buffer; False on stop/timeout
+        (a poison message dead-letters and counts as a successful pull
+        so the caller keeps consuming)."""
         with span("data_load", path="stream_consume", topic=self.topic):
             payload = self.broker.consume(self.topic, timeout=self.idle_timeout)
             if payload is None or payload == _STOP:
                 self._stopped = True
                 return False
-            ds = dataset_from_bytes(payload)
+            try:
+                ds = dataset_from_bytes(payload)
+            except Exception as e:
+                dead_letter(self.broker, self.topic, payload, e,
+                            self.dead_letter_topic)
+                return True
         self._buffer.append(ds)
         self._buffered += ds.num_examples()
         return True
@@ -124,10 +163,12 @@ class StreamingTrainer:
     a daemon thread (``start``/``join``)."""
 
     def __init__(self, net, broker: MessageBroker, topic: str,
-                 batch_size: int = 32, idle_timeout: Optional[float] = None):
+                 batch_size: int = 32, idle_timeout: Optional[float] = None,
+                 dead_letter_topic: Optional[str] = None):
         self.net = net
         self.iterator = StreamingDataSetIterator(
-            broker, topic, batch_size=batch_size, idle_timeout=idle_timeout)
+            broker, topic, batch_size=batch_size, idle_timeout=idle_timeout,
+            dead_letter_topic=dead_letter_topic)
         self.batches_fit = 0
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
@@ -193,12 +234,15 @@ class StreamingInference:
     def __init__(self, net, broker: MessageBroker, in_topic: str,
                  out_topic: str, idle_timeout: Optional[float] = None,
                  engine=None, max_batch_size: int = 32,
-                 max_latency_ms: float = 5.0):
+                 max_latency_ms: float = 5.0,
+                 dead_letter_topic: Optional[str] = None):
         self.net = net
         self.broker = broker
         self.in_topic = in_topic
         self.out_topic = out_topic
         self.idle_timeout = idle_timeout
+        self.dead_letter_topic = dead_letter_topic or (
+            in_topic + DEAD_LETTER_SUFFIX)
         self.engine = engine
         self.max_batch_size = max_batch_size
         self.max_latency_ms = max_latency_ms
@@ -213,8 +257,13 @@ class StreamingInference:
                                               timeout=self.idle_timeout)
             if payload is None or payload == _STOP:
                 break
-            with span("inference", topic=self.in_topic):
+            try:
                 x = ndarray_from_bytes(payload)
+            except Exception as e:
+                dead_letter(self.broker, self.in_topic, payload, e,
+                            self.dead_letter_topic)
+                continue
+            with span("inference", topic=self.in_topic):
                 pred = np.asarray(self.net.output(x))
                 self.broker.publish(self.out_topic, ndarray_to_bytes(pred))
             self.served += 1
@@ -272,7 +321,15 @@ class StreamingInference:
                                                   timeout=self.idle_timeout)
                 if payload is None or payload == _STOP:
                     break
-                out_q.put(engine.submit(ndarray_from_bytes(payload)))
+                try:
+                    x = ndarray_from_bytes(payload)
+                except Exception as e:
+                    # poison request: dead-letter it; the publisher and
+                    # engine never see it, ordering of good requests holds
+                    dead_letter(self.broker, self.in_topic, payload, e,
+                                self.dead_letter_topic)
+                    continue
+                out_q.put(engine.submit(x))
                 submitted += 1
                 if max_requests is not None and submitted >= max_requests:
                     break

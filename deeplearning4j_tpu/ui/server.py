@@ -150,21 +150,23 @@ class _Handler(BaseHTTPRequestHandler):
         reg = self.registry
         nan = reg.family_total(NAN_COUNTER)
         slow = reg.family_total(SLOW_COUNTER)
-        status = "ok" if nan == 0 else "degraded"
         body = {
-            "status": status,
             "nan_scores": int(nan),
             "slow_steps": int(slow),
             "sessions": len(self.storage.list_sessions()),
             "uptime_s": round(time.monotonic()
                               - self.server._started_at, 3),  # type: ignore
         }
+        degraded = nan > 0
         engine = getattr(self.server, "_infer_engine", None)
         if engine is not None:
             # serving-plane snapshot (the dl4j_infer_* metric families
-            # on /metrics carry the full histograms)
+            # on /metrics carry the full histograms); a quarantined
+            # replica means reduced capacity — degraded, still serving
             body["inference"] = engine.stats()
-        return self._json(body, 200 if status == "ok" else 503)
+            degraded = degraded or bool(body["inference"].get("degraded"))
+        body["status"] = "degraded" if degraded else "ok"
+        return self._json(body, 503 if degraded else 200)
 
     # ------------------------------------------------------ /tsne view
     # (``deeplearning4j-ui-resources/.../ui/tsne/`` dashboard role: the

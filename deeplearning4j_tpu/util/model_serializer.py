@@ -20,12 +20,41 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import zipfile
-from typing import Any, Dict, Union
+import zlib
+from typing import Any, Dict, List, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+_MANIFEST = "manifest.json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed its integrity check (torn write, flipped
+    bits, missing members). Raised instead of a random downstream
+    numpy/zip error so callers can fall back to an older checkpoint."""
+
+
+def _crc32(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a rename into it survives power loss (a
+    no-op on filesystems that don't support directory fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def _flatten(tree: Dict[str, Any], prefix: str = "") -> Dict[str, np.ndarray]:
@@ -89,18 +118,75 @@ def model_from_payload(payload: dict):
 
 
 def write_model(model, path: str, save_updater: bool = True) -> None:
-    """``ModelSerializer.writeModel`` equivalent."""
+    """``ModelSerializer.writeModel`` equivalent, crash-safe: the zip is
+    written to a sibling temp file, fsynced, then ``os.replace``d into
+    place — a crash at ANY instant leaves either the previous complete
+    file or no file, never a torn one. A ``manifest.json`` member pins a
+    CRC32 per logical part for the restore-time integrity check."""
     from deeplearning4j_tpu.monitor import span
 
     payload = config_payload(model)
+    members: Dict[str, bytes] = {
+        "configuration.json": json.dumps(payload, indent=2).encode(),
+        "coefficients.npz": _npz_bytes(model.params),
+        "modelState.npz": _npz_bytes(model.states),
+    }
+    if save_updater and model.opt_state is not None:
+        members["updaterState.npz"] = _npz_bytes(
+            {"step": model.opt_state["step"], "updater": model.opt_state["updater"]})
+    manifest = {"format": 1,
+                "crc32": {n: _crc32(b) for n, b in members.items()}}
+    path = os.path.abspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
     with span("checkpoint", op="zip_save", path=path):
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-            z.writestr("configuration.json", json.dumps(payload, indent=2))
-            z.writestr("coefficients.npz", _npz_bytes(model.params))
-            z.writestr("modelState.npz", _npz_bytes(model.states))
-            if save_updater and model.opt_state is not None:
-                z.writestr("updaterState.npz", _npz_bytes(
-                    {"step": model.opt_state["step"], "updater": model.opt_state["updater"]}))
+        try:
+            with open(tmp, "wb") as f:
+                with zipfile.ZipFile(f, "w", zipfile.ZIP_DEFLATED) as z:
+                    for name, data in members.items():
+                        z.writestr(name, data)
+                    z.writestr(_MANIFEST, json.dumps(manifest))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+    fsync_dir(os.path.dirname(path))
+
+
+def verify_model_file(path: str) -> List[str]:
+    """Integrity-check a model zip; returns problems ([] = sound).
+    Catches torn writes (bad zip central directory), flipped bits
+    (member CRC or manifest CRC mismatch), and missing members.
+    Pre-manifest checkpoints are accepted when their zip-internal CRCs
+    and required members check out."""
+    problems: List[str] = []
+    try:
+        with zipfile.ZipFile(path) as z:
+            bad = z.testzip()
+            if bad is not None:
+                return [f"{path}: zip CRC mismatch in member {bad!r}"]
+            names = set(z.namelist())
+            for req in ("configuration.json", "coefficients.npz",
+                        "modelState.npz"):
+                if req not in names:
+                    problems.append(f"{path}: missing member {req!r}")
+            if _MANIFEST in names:
+                manifest = json.loads(z.read(_MANIFEST))
+                for name, crc in manifest.get("crc32", {}).items():
+                    if name not in names:
+                        problems.append(
+                            f"{path}: manifest lists missing member {name!r}")
+                    elif _crc32(z.read(name)) != int(crc):
+                        problems.append(
+                            f"{path}: manifest CRC mismatch for {name!r}")
+    except (OSError, zipfile.BadZipFile, zlib.error, json.JSONDecodeError,
+            ValueError, KeyError) as e:
+        return [f"{path}: unreadable checkpoint ({type(e).__name__}: {e})"]
+    return problems
 
 
 def restore_multi_layer_network(path: str, load_updater: bool = True):
@@ -116,6 +202,15 @@ def restore_model(path: str, load_updater: bool = True):
 
 
 def _restore(path: str, expect: Union[str, None], load_updater: bool):
+    problems = verify_model_file(path)
+    if problems:
+        from deeplearning4j_tpu.monitor import (FAULT_CKPT_INTEGRITY_COUNTER,
+                                                get_registry, record_fault)
+        get_registry().counter(
+            FAULT_CKPT_INTEGRITY_COUNTER,
+            "Checkpoint restores that failed the integrity check").inc()
+        record_fault("checkpoint")
+        raise CheckpointCorruptError("; ".join(problems))
     with zipfile.ZipFile(path) as z:
         payload = json.loads(z.read("configuration.json"))
         model_type = payload["model_type"]

@@ -12,18 +12,40 @@ live model at restore time.
 
 Layout: ``<dir>/state`` (orbax PyTree of params/opt_state/states) +
 ``<dir>/configuration.json`` (same payload the zip format uses, so the
-model can be rebuilt from the checkpoint alone).
+model can be rebuilt from the checkpoint alone) + ``<dir>/manifest.json``
+(per-file CRC32s, written LAST — its presence marks a complete unit).
+
+Crash safety: a checkpoint is assembled in a sibling temp directory and
+renamed into place, so a preemption at any instant leaves either the
+previous complete checkpoint or a sweepable temp — never a torn
+directory that restores garbage. ``save_checkpoint(..., keep=K)``
+switches to a retained history (``<dir>/ckpt-<step>``) and
+``restore_checkpoint`` walks it newest-first, skipping any unit that
+fails its manifest check (``dl4j_fault_checkpoint_integrity_failures_total``
+ticks per skipped unit).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
-from typing import Optional
+import shutil
+import zlib
+from typing import List, Optional
 
 import jax
 
-from deeplearning4j_tpu.monitor import span
+from deeplearning4j_tpu.monitor import (FAULT_CKPT_INTEGRITY_COUNTER,
+                                        get_registry, record_fault, span)
+from deeplearning4j_tpu.util.model_serializer import (CheckpointCorruptError,
+                                                      fsync_dir)
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+_MANIFEST = "manifest.json"
+_STEP_PREFIX = "ckpt-"
+_TMP_PREFIX = ".ckpt_tmp_"
 
 
 def _checkpointer():
@@ -32,23 +54,191 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
-def save_checkpoint(model, directory: str) -> str:
-    """Write config + params + updater state + layer states, sharded."""
+# ------------------------------------------------------------- integrity
+
+def _file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _relative_files(directory: str) -> List[str]:
+    out = []
+    for root, _, files in os.walk(directory):
+        for name in files:
+            out.append(os.path.relpath(os.path.join(root, name), directory))
+    return sorted(out)
+
+
+def _write_manifest(directory: str) -> None:
+    """CRC32 every file under ``directory`` into ``manifest.json`` —
+    written last (tmp + fsync + replace), so its presence certifies a
+    complete, bit-exact unit."""
+    files = [f for f in _relative_files(directory) if f != _MANIFEST]
+    manifest = {"format": 1, "crc32": {
+        f: _file_crc32(os.path.join(directory, f)) for f in files}}
+    tmp = os.path.join(directory, _MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(directory, _MANIFEST))
+    fsync_dir(directory)
+
+
+def verify_checkpoint(directory: str) -> List[str]:
+    """Integrity-check one checkpoint unit; returns problems ([] = sound).
+    A unit without a manifest (pre-fault-tolerance layout) passes when
+    its two required parts exist — it cannot be bit-verified."""
+    problems: List[str] = []
+    if not os.path.isdir(directory):
+        return [f"{directory}: not a directory"]
+    if not os.path.exists(os.path.join(directory, "configuration.json")):
+        problems.append(f"{directory}: missing configuration.json")
+    if not os.path.isdir(os.path.join(directory, "state")):
+        problems.append(f"{directory}: missing state/ pytree")
+    manifest_path = os.path.join(directory, _MANIFEST)
+    if not os.path.exists(manifest_path):
+        return problems  # legacy unit: structural check only
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        for rel, crc in manifest.get("crc32", {}).items():
+            path = os.path.join(directory, rel)
+            if not os.path.exists(path):
+                problems.append(f"{directory}: manifest lists missing {rel!r}")
+            elif _file_crc32(path) != int(crc):
+                problems.append(f"{directory}: CRC mismatch in {rel!r}")
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        problems.append(f"{directory}: unreadable manifest "
+                        f"({type(e).__name__}: {e})")
+    return problems
+
+
+def _note_integrity_failure(problems: List[str]) -> None:
+    get_registry().counter(
+        FAULT_CKPT_INTEGRITY_COUNTER,
+        "Checkpoint restores that failed the integrity check").inc()
+    record_fault("checkpoint")
+    for p in problems:
+        logger.warning("sharded_checkpoint: %s", p)
+
+
+# ------------------------------------------------------------------ save
+
+def _install_dir(tmp: str, final: str) -> None:
+    """Rename ``tmp`` into place as ``final`` keeping the ResumableTrainer
+    invariant: at every instant at least one complete unit is visible
+    (``final`` or ``final + ".old"``)."""
+    old = final + ".old"
+    if os.path.isdir(final):
+        shutil.rmtree(old, ignore_errors=True)  # final still covers us
+        os.rename(final, old)
+    os.rename(tmp, final)
+    shutil.rmtree(old, ignore_errors=True)
+    fsync_dir(os.path.dirname(final))
+
+
+def _sweep_tmp(parent: str) -> None:
+    for name in os.listdir(parent):
+        if name.startswith(_TMP_PREFIX):
+            shutil.rmtree(os.path.join(parent, name), ignore_errors=True)
+
+
+def _write_unit(model, directory: str) -> None:
+    """Assemble one complete checkpoint unit at ``directory`` (already a
+    private temp path) and seal it with the manifest."""
     from deeplearning4j_tpu.util.model_serializer import config_payload
 
+    os.makedirs(directory, exist_ok=True)
+    state = {"params": model.params, "opt_state": model.opt_state,
+             "states": model.states}
+    _checkpointer().save(os.path.join(directory, "state"), state, force=True)
+    cfg_tmp = os.path.join(directory, "configuration.json.tmp")
+    with open(cfg_tmp, "w") as f:
+        json.dump(config_payload(model), f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(cfg_tmp, os.path.join(directory, "configuration.json"))
+    _write_manifest(directory)
+
+
+def save_checkpoint(model, directory: str, keep: Optional[int] = None,
+                    step: Optional[int] = None) -> str:
+    """Write config + params + updater state + layer states, sharded.
+
+    Default: ``directory`` IS the checkpoint unit (overwritten
+    atomically — a crash leaves the previous complete unit). With
+    ``keep=K``, ``directory`` becomes a retained history of the last K
+    units (``ckpt-<step>`` subdirectories, ``step`` defaulting to the
+    model's optimizer step) and older units are pruned; returns the path
+    of the unit just written."""
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
-    with span("checkpoint", op="sharded_save", dir=directory):
-        state = {"params": model.params, "opt_state": model.opt_state,
-                 "states": model.states}
-        _checkpointer().save(os.path.join(directory, "state"), state, force=True)
-        with open(os.path.join(directory, "configuration.json"), "w") as f:
-            json.dump(config_payload(model), f, indent=2)
-    return directory
+    if keep is not None:
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        if step is None:
+            step = int(model.opt_state["step"]) if model.opt_state else 0
+        final = os.path.join(directory, f"{_STEP_PREFIX}{int(step):010d}")
+        parent = directory
+    else:
+        final = directory
+        parent = os.path.dirname(directory)
+    _sweep_tmp(parent)
+    tmp = os.path.join(parent, _TMP_PREFIX + os.path.basename(final)
+                       + f".{os.getpid()}")
+    with span("checkpoint", op="sharded_save", dir=final):
+        try:
+            _write_unit(model, tmp)
+            _install_dir(tmp, final)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    if keep is not None:
+        for stale in checkpoint_steps(directory)[:-keep]:
+            shutil.rmtree(os.path.join(
+                directory, f"{_STEP_PREFIX}{stale:010d}"), ignore_errors=True)
+    return final
+
+
+def checkpoint_steps(directory: str) -> List[int]:
+    """Ascending step numbers of the retained units under ``directory``."""
+    steps = []
+    if os.path.isdir(directory):
+        for name in os.listdir(directory):
+            if name.startswith(_STEP_PREFIX) and not name.endswith(".old"):
+                try:
+                    steps.append(int(name[len(_STEP_PREFIX):]))
+                except ValueError:
+                    continue
+    return sorted(steps)
+
+
+# --------------------------------------------------------------- restore
+
+def _restore_candidates(directory: str) -> List[str]:
+    """Checkpoint units to try, newest first: the retained history when
+    present, else the directory itself (plus its ``.old`` survivor)."""
+    steps = checkpoint_steps(directory)
+    if steps:
+        return [os.path.join(directory, f"{_STEP_PREFIX}{s:010d}")
+                for s in reversed(steps)]
+    cands = [directory]
+    if os.path.isdir(directory + ".old"):
+        cands.append(directory + ".old")
+    return cands
 
 
 def restore_checkpoint(directory: str, model=None, shardings=None):
-    """Restore a checkpoint.
+    """Restore a checkpoint, falling back to the newest VALID unit.
+
+    Each candidate (newest first — see ``save_checkpoint(keep=...)``) is
+    integrity-checked against its manifest before any array is read; a
+    torn or checksum-bad unit is skipped with a warning (and an
+    integrity-failure metric tick) instead of crashing the restore.
+    Raises :class:`CheckpointCorruptError` only when NO unit survives.
 
     ``model=None`` rebuilds the network from the stored config (restore
     on a fresh process). ``shardings``: optional pytree-prefix of
@@ -58,6 +248,28 @@ def restore_checkpoint(directory: str, model=None, shardings=None):
     the checkpoint itself is topology-free.
     """
     directory = os.path.abspath(directory)
+    candidates = _restore_candidates(directory)
+    failures: List[str] = []
+    for cand in candidates:
+        problems = verify_checkpoint(cand)
+        if problems:
+            _note_integrity_failure(problems)
+            failures.extend(problems)
+            continue
+        try:
+            return _restore_unit(cand, model, shardings)
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:  # torn past what the manifest could see
+            problem = [f"{cand}: restore failed ({type(e).__name__}: {e})"]
+            _note_integrity_failure(problem)
+            failures.extend(problem)
+    raise CheckpointCorruptError(
+        f"no restorable checkpoint under {directory}: " + "; ".join(failures)
+        if failures else f"no checkpoint found under {directory}")
+
+
+def _restore_unit(directory: str, model=None, shardings=None):
     if model is None:
         with open(os.path.join(directory, "configuration.json")) as f:
             payload = json.load(f)

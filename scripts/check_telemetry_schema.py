@@ -170,6 +170,13 @@ KNOWN_DL4J_METRICS = {
     "dl4j_infer_queue_depth",
     "dl4j_infer_padded_ratio",
     "dl4j_infer_latency_ms",
+    # fault-tolerance plane (supervisor / quarantine / dead-letter /
+    # checkpoint integrity — see monitor/__init__.py FAULT_* names)
+    "dl4j_fault_events_total",
+    "dl4j_fault_rollbacks_total",
+    "dl4j_fault_quarantined_replicas",
+    "dl4j_fault_dead_letter_total",
+    "dl4j_fault_checkpoint_integrity_failures_total",
 }
 
 

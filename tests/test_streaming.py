@@ -178,11 +178,19 @@ def test_streaming_trainer_tcp_end_to_end(rng):
         server.stop()
 
 
-def test_trainer_propagates_worker_error(rng):
+def test_trainer_dead_letters_poison_message(rng):
+    """An undecodable message must NOT kill the consume thread (the old
+    behavior): it routes to the dead-letter topic and the stream keeps
+    training — tests/test_fault_tolerance.py covers the full DLQ
+    contract."""
     broker = InMemoryBroker()
     net = _net()
     trainer = StreamingTrainer(net, broker, "train", batch_size=8).start()
     broker.publish("train", b"garbage, not an npz")
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    publish_dataset(broker, "train", DataSet(x, y))
     publish_stop(broker, "train")
-    with pytest.raises(Exception):
-        trainer.join(timeout=60)
+    assert trainer.join(timeout=60) == 1  # the good batch trained
+    dead = broker.consume("train.deadletter", timeout=5)
+    assert dead == b"garbage, not an npz"
