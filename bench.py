@@ -11,6 +11,10 @@ Sub-benchmarks (each reported under "sub_benchmarks"):
   - resnet50       — config #3, ComputationGraph fit_scan, bf16 compute
   - serving_inference — ParallelInference micro-batching engine vs the
     naive per-request serve loop (requests/sec, p50/p99 latency)
+  - gpt_decode / lstm_decode — fused autoregressive generation (ONE
+    scan dispatch for all of max_new_tokens, nn/generate.py) vs the
+    eager per-token dispatch loop (tokens/sec/chip, per-token p50,
+    steady-state jit-miss count, greedy identity)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 The headline metric is ResNet-50 MFU when available (the heaviest
@@ -598,6 +602,109 @@ def bench_fault_recovery():
             "vs_baseline": 1.0}
 
 
+def _decode_bench(net, prompt, max_new, flops_per_token=None):
+    """Shared fused-vs-eager decode measurement: warm both paths, pin
+    greedy identity, time best-of-N, and report tokens/sec/chip +
+    per-token p50 + the steady-state jit-miss count (the zero-compiles
+    acceptance gate — the fused path must dispatch exactly its two
+    warmed programs per run)."""
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.nn.generate import generate_eager
+
+    b = prompt.shape[0]
+    # warm/compile both paths (the timed runs then reuse executables)
+    fused0 = net.generate(prompt, max_new)
+    eager0 = generate_eager(net, prompt, max_new)
+    greedy_equal = bool(np.array_equal(fused0, eager0))
+
+    reg = monitor.get_registry()
+    miss0 = reg.family_total(monitor.JIT_CACHE_MISS_COUNTER)
+    trials = 5
+    fused_dts = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        net.generate(prompt, max_new)
+        fused_dts.append(time.perf_counter() - t0)
+    steady_misses = reg.family_total(monitor.JIT_CACHE_MISS_COUNTER) - miss0
+    eager_dts = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        generate_eager(net, prompt, max_new)
+        eager_dts.append(time.perf_counter() - t0)
+
+    tokens = b * max_new
+    fused_tps = tokens / min(fused_dts)
+    eager_tps = tokens / min(eager_dts)
+    per_tok_ms = sorted(dt / max_new * 1e3 for dt in fused_dts)
+    out = {
+        "value": round(fused_tps, 1), "unit": "tokens/sec/chip",
+        "eager_tokens_per_sec": round(eager_tps, 1),
+        "fused_vs_eager": round(fused_tps / eager_tps, 3),
+        "per_token_p50_ms": round(per_tok_ms[len(per_tok_ms) // 2], 4),
+        "steady_state_jit_misses": float(steady_misses),
+        "greedy_matches_eager": greedy_equal,
+        "batch": b, "prompt_len": int(prompt.shape[1]),
+        "max_new_tokens": max_new,
+        # the comparable baseline is the eager per-token loop this
+        # engine replaces (>= 5x is the acceptance bar)
+        "vs_baseline": round(fused_tps / eager_tps, 3),
+    }
+    if flops_per_token is not None:
+        out["mfu"] = round(fused_tps * flops_per_token / PEAK_BF16, 4)
+    return out
+
+
+def bench_gpt_decode():
+    """Fused KV-cache decode (nn/generate.py: bucketed prefill + ALL of
+    max_new_tokens as ONE lax.scan dispatch, on-device sampling) vs the
+    eager per-token loop (one dispatch per token — the pre-PR serving
+    status quo, which on the tunneled platform pays a host round-trip
+    per token). Greedy output must be identical and the fused steady
+    state must perform zero XLA compiles."""
+    from deeplearning4j_tpu.models.zoo.transformer import gpt
+
+    vocab, d, layers, heads, max_len = 8192, 512, 8, 8, 512
+    b, t0, max_new = 8, 64, 128
+    net = gpt(vocab_size=vocab, d_model=d, n_layers=layers,
+              num_heads=heads, max_len=max_len,
+              compute_dtype="bfloat16").init()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, vocab, (b, t0))
+    # decode-step MACs/token: qkv+proj+mlp weights + O(t) attention reads
+    per_layer = 3 * d * d + d * d + 2 * 4 * d * d + (t0 + max_new) * d
+    flops = 2.0 * (layers * per_layer + d * vocab)
+    return {"metric": "gpt_decode_tokens_per_sec_per_chip",
+            **_decode_bench(net, prompt, max_new, flops_per_token=flops)}
+
+
+def bench_lstm_decode():
+    """Char-RNN generation through the scanned LSTM recurrence (config
+    #4 shape family): same fused-vs-eager protocol as gpt_decode."""
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    vocab, hidden = 64, 512
+    b, t0, max_new = 32, 32, 128
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.01).updater("adam").activation("tanh")
+            .list()
+            .layer(GravesLSTM(n_in=vocab, n_out=hidden))
+            .layer(GravesLSTM(n_in=hidden, n_out=hidden))
+            .layer(RnnOutputLayer(n_in=hidden, n_out=vocab,
+                                  activation="softmax",
+                                  loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, vocab, (b, t0))
+    macs = (vocab * 4 * hidden + hidden * 4 * hidden
+            + hidden * 4 * hidden + hidden * 4 * hidden + hidden * vocab)
+    return {"metric": "lstm_decode_tokens_per_sec_per_chip",
+            **_decode_bench(net, prompt, max_new,
+                            flops_per_token=2.0 * macs)}
+
+
 def bench_word2vec():
     """Word2Vec skip-gram (BASELINE config #5): the all-epochs-on-device
     SGNS scan engine (device pairgen + table negatives + capped MXU
@@ -685,6 +792,8 @@ def main():
                      ("flash_attention", bench_flash_attention),
                      ("flash_attention_train", bench_flash_attention_train),
                      ("gpt", bench_gpt), ("gpt_large", bench_gpt_large),
+                     ("gpt_decode", bench_gpt_decode),
+                     ("lstm_decode", bench_lstm_decode),
                      ("serving_inference", bench_serving_inference),
                      ("fault_recovery", bench_fault_recovery),
                      ("word2vec", bench_word2vec)]:

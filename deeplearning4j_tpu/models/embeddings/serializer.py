@@ -616,8 +616,14 @@ def read_word_vectors_any(path: str):
         except ValueError:
             is_header = False
         if is_header:
+            import codecs
             try:
-                rest.decode("utf-8")
+                # incremental decode (final=False): a multibyte char cut
+                # at the 512-byte sample boundary is "incomplete", not
+                # an error — a plain .decode() misrouted such headered
+                # TEXT files to the binary reader (the
+                # _detect_ipadic_encoding sniffing rule)
+                codecs.getincrementaldecoder("utf-8")().decode(rest, False)
             except UnicodeDecodeError:
                 return read_word_vectors_binary(path)
             return read_word_vectors(path)

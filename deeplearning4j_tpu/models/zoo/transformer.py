@@ -57,104 +57,21 @@ def gpt(vocab_size: int = 50257, d_model: int = 512, n_layers: int = 8,
 def generate(net: MultiLayerNetwork, prompt_ids: np.ndarray,
              max_new_tokens: int, temperature: float = 0.0, *,
              top_k: int = 0, top_p: float = 0.0,
-             seed: int = 0) -> np.ndarray:
-    """Autoregressive decoding with per-block KV caches — the
-    transformer analog of the stateful ``rnnTimeStep`` path
-    (``MultiLayerNetwork.java:1233`` role): ONE jitted single-token
-    program (fixed shapes, no per-step recompiles), O(t) attention per
-    token instead of the O(t²) full-window forward.
+             eos_token: int = None, seed: int = 0) -> np.ndarray:
+    """Autoregressive decoding with per-block KV caches — now a thin
+    facade over the fused generation engine (``nn/generate.py``):
+    bucketed batched prefill writes every block's cache in ONE
+    dispatch, then ALL of ``max_new_tokens`` runs as one ``lax.scan``
+    dispatch with on-device greedy/temperature/top-k/top-p sampling
+    (and EOS early-exit when ``eos_token`` is set). The original
+    fed the prompt through the single-token step inside the scan —
+    O(t0) wasted steps the prefill now does as one batched forward.
 
     ``prompt_ids``: [b, t0] int tokens; returns [b, t0 + max_new_tokens].
-    ``temperature`` 0 = greedy, else softmax sampling, optionally
-    restricted to the ``top_k`` highest logits and/or the smallest
-    nucleus with cumulative probability ≥ ``top_p`` (both filters run
-    device-side inside the scan).
     """
-    import jax
-    import jax.numpy as jnp
-
-    from deeplearning4j_tpu.util.dtypes import cast_floats
-
-    emb = net.impls[0]
-    blocks = net.impls[1:-1]
-    head = net.impls[-1]
-    prompt_ids = np.asarray(prompt_ids, np.int64)
-    b, t0 = prompt_ids.shape
-    total = t0 + max_new_tokens
-    max_len = emb.conf.max_len
-    if total > max_len:
-        raise ValueError(f"prompt {t0} + {max_new_tokens} new tokens "
-                         f"exceeds max_len {max_len}")
-    cd = net._cd
-    cache_dtype = cd if cd is not None else jnp.float32
-    # caches sized to the actual generation length, not max_len: each
-    # step's attention then runs over `total` slots (true O(t)/token)
-    caches = [blk.init_cache(b, total, cache_dtype) for blk in blocks]
-
-    def step(params, caches, tok, pos):
-        p_emb = params[emb.name]
-        if cd is not None:
-            p_emb = cast_floats(p_emb, cd)
-        x = jnp.take(p_emb["W"], tok, axis=0) \
-            + jax.lax.dynamic_index_in_dim(p_emb["P"], pos, 0, keepdims=False)
-        new_caches = []
-        for blk, cache in zip(blocks, caches):
-            p = params[blk.name]
-            if cd is not None:
-                p = cast_floats(p, cd)
-            x, cache = blk.decode_step(p, x, cache, pos)
-            new_caches.append(cache)
-        logits = head.preout(params[head.name], x.astype(jnp.float32))
-        return logits, new_caches
-
-    # the WHOLE decode loop runs device-side as one lax.scan — one
-    # dispatch for the entire generation (a host loop pays a tunnel
-    # round-trip + cache copy per token; measured ~250ms/step vs
-    # milliseconds here), sampling included
-    def decode(params, caches, out0, key):
-        def body(carry, pos):
-            caches, out = carry
-            tok = jax.lax.dynamic_index_in_dim(out, pos, 1, keepdims=False)
-            logits, caches = step(params, caches, tok, pos)
-            if temperature <= 0.0:
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            else:
-                lg = logits / temperature
-                neg = jnp.asarray(jnp.finfo(lg.dtype).min, lg.dtype)
-                if top_k and top_k < lg.shape[-1]:
-                    kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
-                    lg = jnp.where(lg < kth, neg, lg)
-                if top_p and top_p < 1.0:
-                    srt = jnp.sort(lg, axis=-1)[:, ::-1]
-                    probs = jax.nn.softmax(srt, axis=-1)
-                    # smallest prefix with cumulative prob >= top_p
-                    keep = jnp.cumsum(probs, axis=-1) - probs < top_p
-                    cutoff = jnp.min(jnp.where(keep, srt, jnp.inf),
-                                     axis=-1, keepdims=True)
-                    lg = jnp.where(lg < cutoff, neg, lg)
-                nxt = jax.random.categorical(
-                    jax.random.fold_in(key, pos), lg, axis=-1).astype(jnp.int32)
-            # keep prompt tokens during prefill; write samples after
-            cur = jax.lax.dynamic_index_in_dim(out, pos + 1, 1, keepdims=False)
-            nxt = jnp.where(pos + 1 < t0, cur, nxt)
-            out = jax.lax.dynamic_update_slice_in_dim(
-                out, nxt[:, None], pos + 1, axis=1)
-            return (caches, out), None
-
-        (caches, out), _ = jax.lax.scan(
-            body, (caches, out0), jnp.arange(total - 1))
-        return out
-
-    out0 = jnp.zeros((b, total), jnp.int32)
-    out0 = out0.at[:, :t0].set(prompt_ids.astype(np.int32))
-    # cache the compiled decode on the model: repeat generate() calls
-    # with the same shapes/temperature reuse the executable
-    key = ("gpt_generate", b, t0, total, float(temperature),
-           int(top_k), float(top_p))
-    if key not in net._jits:
-        net._jits[key] = jax.jit(decode)
-    out = net._jits[key](net.params, caches, out0, jax.random.PRNGKey(seed))
-    return np.asarray(out, np.int64)
+    return net.generate(prompt_ids, max_new_tokens,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        eos_token=eos_token, seed=seed)
 
 
 def gpt_stack_blocks(net: MultiLayerNetwork):

@@ -51,6 +51,13 @@ The fault-tolerance plane publishes ``dl4j_fault_events_total`` (by
 ``dl4j_fault_checkpoint_integrity_failures_total`` (restores that hit a
 torn/checksum-bad unit) — a healthy fleet holds all of them at zero,
 and any nonzero value names the recovery path that ran.
+
+The generation plane (nn/generate.py fused autoregressive decode)
+publishes ``dl4j_decode_requests_total``,
+``dl4j_decode_prefill_tokens_total`` / ``dl4j_decode_tokens_total``
+(prompt tokens prefilled vs tokens sampled), and the
+``dl4j_decode_prefill_latency_ms`` / ``dl4j_decode_latency_ms``
+dispatch-latency histograms.
 """
 
 # Device-feed pipeline metric family names (one name, one meaning —
@@ -75,6 +82,21 @@ INFER_LATENCY_HISTOGRAM = "dl4j_infer_latency_ms"
 # Bucket bounds for dl4j_infer_batch_size (rows per dispatched batch).
 INFER_BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                             256.0, 512.0, 1024.0)
+
+# Autoregressive generation plane (nn/generate.py fused decode engine,
+# served via ParallelInference.submit_generate): request volume, prompt
+# tokens prefilled vs tokens decoded (their ratio is the prompt/decode
+# balance of the workload), and the two dispatch latencies — prefill
+# (one batched prompt forward, bucketed lengths) and decode (ALL of
+# max_new_tokens as ONE lax.scan dispatch). dl4j_jit_cache_miss_total
+# is shared: a generate dispatch that traces+compiles ticks it, which
+# is how the bucketed-prefill single-compile and AOT warmup contracts
+# are asserted.
+DECODE_REQUESTS_COUNTER = "dl4j_decode_requests_total"
+DECODE_PREFILL_TOKENS_COUNTER = "dl4j_decode_prefill_tokens_total"
+DECODE_TOKENS_COUNTER = "dl4j_decode_tokens_total"
+DECODE_PREFILL_LATENCY_HISTOGRAM = "dl4j_decode_prefill_latency_ms"
+DECODE_LATENCY_HISTOGRAM = "dl4j_decode_latency_ms"
 
 # Fault-tolerance plane (detect → isolate → recover): every recovery
 # path in the stack reports through these five families so an operator

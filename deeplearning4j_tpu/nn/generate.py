@@ -1,0 +1,662 @@
+"""Fused autoregressive generation: prefill + single-dispatch decode.
+
+The serving-side complement of the training stack: before this module,
+generating N tokens meant N host round-trips through eager per-token
+dispatches (the exact host-loop shape PR 2 killed on the fit path).
+Here generation is TWO dispatches total, the single-chip version of
+iteration-level batched decoding (Orca's scheduling discipline, vLLM's
+resident-cache doctrine):
+
+- **prefill** — ONE batched forward over the padded prompt that writes
+  every transformer layer's KV cache (``TransformerBlockImpl.prefill``)
+  or streams the prompt through the scanned LSTM recurrence. Prompt
+  lengths are padded up the PR-3 power-of-two bucket ladder and enter
+  the program as a traced per-row ``lengths`` vector, so ANY prompt mix
+  inside a bucket reuses one AOT-warmable compiled program;
+- **decode** — ALL of ``max_new_tokens`` runs as ONE ``jax.lax.scan``
+  dispatch: embed → stacked ``decode_step`` over layers (per-row cache
+  positions) → logits → on-device sample → feed back. The carry is
+  (caches, token, positions, done-mask); cache buffers are donated to
+  the program off-CPU; an EOS done-mask short-circuits the whole step
+  (``lax.cond``) once every row has finished;
+- **on-device sampling** — greedy, temperature, top-k and top-p
+  (nucleus) composed inside the traced step via per-row PRNG keys
+  (gumbel-max), so only the final token ids ever cross the wire and a
+  request's draws are invariant to how the engine coalesces it.
+
+The same API drives LSTM nets (char-RNN generation) through the
+existing scanned ``one_step`` recurrence, and single-input linear-chain
+ComputationGraphs through the identical machinery.
+
+``generate_eager`` is the per-token host-loop reference — one dispatch
+per token, same math and same per-row PRNG fold indices, so fused and
+eager agree token-for-token (the correctness oracle and the bench.py
+``gpt_decode``/``lstm_decode`` comparison baseline).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.iterators import bucket_for, bucket_sizes
+from deeplearning4j_tpu.monitor import (
+    DECODE_LATENCY_HISTOGRAM,
+    DECODE_PREFILL_LATENCY_HISTOGRAM,
+    DECODE_PREFILL_TOKENS_COUNTER,
+    DECODE_REQUESTS_COUNTER,
+    DECODE_TOKENS_COUNTER,
+    get_registry,
+    span,
+)
+from deeplearning4j_tpu.nn.layers.transformer import (
+    SequenceEmbeddingImpl,
+    TransformerBlockImpl,
+)
+from deeplearning4j_tpu.optimize.deferred import note_dispatch
+from deeplearning4j_tpu.util.dtypes import cast_floats
+
+#: (temperature, top_k, top_p, eos_token-or-None) — the hashable static
+#: sampler signature baked into a compiled decode program.
+SamplerSig = Tuple[float, int, float, Optional[int]]
+
+
+def sampler_sig(temperature: float = 0.0, top_k: int = 0,
+                top_p: float = 0.0, eos_token: Optional[int] = None
+                ) -> SamplerSig:
+    """Normalize sampler knobs into the static program signature."""
+    return (float(temperature), int(top_k), float(top_p),
+            None if eos_token is None else int(eos_token))
+
+
+def row_keys(seed: int, rows: int) -> jax.Array:
+    """Per-row PRNG keys [rows, 2]: ``fold_in(PRNGKey(seed), row)``.
+    Sampling draws key off a row's OWN key (folded again by step), so a
+    request's tokens are identical whether it runs solo or coalesced
+    into a served batch with other requests."""
+    return jax.vmap(jax.random.fold_in, (None, 0))(
+        jax.random.PRNGKey(int(seed)), jnp.arange(rows))
+
+
+def _pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n (the un-anchored bucket ladder for
+    recurrent prompts, which have no max_len to cap at)."""
+    return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
+
+
+def sample_tokens(logits, keys, step, temperature: float, top_k: int,
+                  top_p: float):
+    """On-device sampler over [b, V] logits with per-row keys [b, 2]
+    folded by ``step``: greedy (temperature <= 0), temperature softmax,
+    optionally restricted to the ``top_k`` highest logits and/or the
+    smallest nucleus with cumulative probability >= ``top_p``.
+    Traced-code only; sampling is gumbel-max so filtered logits
+    (-inf) can never be drawn."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / float(temperature)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+    vocab = lg.shape[-1]
+    if top_k and top_k < vocab:
+        kth = jax.lax.top_k(lg, int(top_k))[0][:, -1:]
+        lg = jnp.where(lg < kth, neg, lg)
+    if top_p and top_p < 1.0:
+        srt = jnp.sort(lg, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        # smallest prefix with cumulative prob >= top_p
+        keep = jnp.cumsum(probs, axis=-1) - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, srt, jnp.inf),
+                         axis=-1, keepdims=True)
+        lg = jnp.where(lg < cutoff, neg, lg)
+    step_keys = jax.vmap(jax.random.fold_in, (0, None))(keys, step)
+    g = jax.vmap(lambda k: jax.random.gumbel(k, (vocab,), jnp.float32))(
+        step_keys)
+    return jnp.argmax(lg + g, axis=-1).astype(jnp.int32)
+
+
+def _ordered_impls(net) -> List[Any]:
+    """The net's layer impls in forward order. MultiLayerNetwork: the
+    stack as-is. ComputationGraph: the single-input linear layer chain
+    in topological order (anything else — multi-input vertices, op
+    vertices, multiple outputs — has no defined decode order)."""
+    impls = net.impls
+    if isinstance(impls, list):
+        return impls
+    if len(net.input_names) != 1 or len(net.output_names) != 1:
+        raise ValueError(
+            "generate() serves single-input/single-output graphs; this "
+            f"one has inputs {net.input_names} and outputs "
+            f"{net.output_names}")
+    chain: List[Any] = []
+    for name in net.order:
+        v = net.defs[name]
+        if v.kind == "input":
+            continue
+        if v.kind != "layer" or len(v.inputs) != 1:
+            raise ValueError(
+                "generate() supports linear layer chains; vertex "
+                f"'{name}' ({v.kind}, inputs {v.inputs}) breaks the chain")
+        chain.append(impls[name])
+    return chain
+
+
+class _GeneratorBase:
+    """Shared plumbing: jit-cache access on the owning net, dispatch
+    accounting (``dl4j_jit_cache_miss_total`` via note_dispatch, same
+    doctrine as the serving engine), and the decode-metric family."""
+
+    def __init__(self, net, impls: List[Any]):
+        self.net = net
+        self.impls = impls
+        self.head = impls[-1]
+        self.cd = net._cd
+
+    # --- jit cache on the net (resets with init(), like every program)
+
+    def _jit(self, key, builder, donate_caches: bool = False):
+        jits = self.net._jits
+        if key not in jits:
+            donate = (1,) if donate_caches and \
+                jax.default_backend() != "cpu" else ()
+            jits[key] = jax.jit(builder(), donate_argnums=donate)
+        return jits[key]
+
+    def _head_logits(self, params, h):
+        """Final-token logits from the head layer: its ``preout`` when
+        it has one (dense heads — the f32-logits contract OutputImpl
+        already guarantees under a bf16 policy), else the activations
+        themselves (LossLayer-style heads)."""
+        p = params[self.head.name]
+        if hasattr(self.head, "preout"):
+            if self.cd is not None and "W" in p:
+                p = cast_floats(p, self.cd)
+            return self.head.preout(p, h).astype(jnp.float32)
+        return h.astype(jnp.float32)
+
+    def _cast(self, p):
+        return cast_floats(p, self.cd) if self.cd is not None else p
+
+    # ------------------------------------------------------ metrics
+
+    def _observe(self, reg, rows: int, prompt_tokens: int, max_new: int,
+                 pre_ms: float, dec_ms: float) -> None:
+        reg.counter(DECODE_PREFILL_TOKENS_COUNTER,
+                    "Prompt tokens prefilled into decode caches").inc(
+            prompt_tokens)
+        reg.counter(DECODE_TOKENS_COUNTER,
+                    "Tokens produced by fused decode dispatches").inc(
+            rows * max_new)
+        reg.histogram(DECODE_PREFILL_LATENCY_HISTOGRAM,
+                      "Prefill dispatch latency (one batched prompt "
+                      "forward)").observe(pre_ms)
+        reg.histogram(DECODE_LATENCY_HISTOGRAM,
+                      "Fused decode dispatch latency (all of "
+                      "max_new_tokens in one scan)").observe(dec_ms)
+
+
+class TransformerGenerator(_GeneratorBase):
+    """KV-cache generation for SequenceEmbedding → TransformerBlock* →
+    head stacks: bucketed batched prefill + one-scan decode."""
+
+    def __init__(self, net, impls):
+        super().__init__(net, impls)
+        self.emb: SequenceEmbeddingImpl = impls[0]
+        self.blocks: List[TransformerBlockImpl] = list(impls[1:-1])
+
+    def prompt_bucket(self, t_in: int, max_new: int) -> int:
+        max_len = self.emb.conf.max_len
+        if t_in < 1:
+            raise ValueError(f"empty prompt (length {t_in})")
+        if t_in + max_new > max_len:
+            raise ValueError(
+                f"prompt {t_in} + {max_new} new tokens exceeds "
+                f"max_len {max_len}")
+        return bucket_for(t_in, bucket_sizes(max_len))
+
+    # ----------------------------------------------------- programs
+
+    def _embed_token(self, p_emb, tok, pos):
+        """[b] ids at per-row positions [b] → [b, d]."""
+        return jnp.take(p_emb["W"], tok, axis=0) \
+            + jnp.take(p_emb["P"], pos, axis=0)
+
+    def _get_prefill(self, cache_len: int):
+        def builder():
+            def prefill(params, ids, lengths):
+                b, t_pad = ids.shape
+                p_emb = self._cast(params[self.emb.name])
+                x = jnp.take(p_emb["W"], ids, axis=0) \
+                    + p_emb["P"][:t_pad][None]
+                cache_dtype = self.cd if self.cd is not None else jnp.float32
+                caches = []
+                for blk in self.blocks:
+                    cache = blk.init_cache(b, cache_len, cache_dtype)
+                    x, cache = blk.prefill(
+                        self._cast(params[blk.name]), x, cache)
+                    caches.append(cache)
+                # last REAL token's hidden state per row (lengths is
+                # traced: every prompt length in the bucket reuses this
+                # one program); length-0 rows are serving-side padding
+                # and read garbage that their done-mask discards
+                last = x[jnp.arange(b), lengths - 1]
+                return caches, self._head_logits(params, last)
+            return prefill
+        return self._jit(("gen_prefill", cache_len), builder)
+
+    def _get_decode(self, max_new: int, sampler: SamplerSig):
+        temperature, top_k, top_p, eos = sampler
+
+        def builder():
+            def decode(params, caches, logits0, lengths, keys):
+                p_emb = self._cast(params[self.emb.name])
+                tok0 = sample_tokens(logits0, keys, 0,
+                                     temperature, top_k, top_p)
+                if eos is not None:
+                    tok0 = jnp.where(lengths == 0, eos, tok0)
+                    done0 = tok0 == eos
+                else:
+                    done0 = jnp.zeros(tok0.shape, bool)
+
+                def live(args, s):
+                    caches, tok, pos, done = args
+                    x = self._embed_token(p_emb, tok, pos)
+                    new_caches = []
+                    for blk, cache in zip(self.blocks, caches):
+                        x, cache = blk.decode_step(
+                            self._cast(params[blk.name]), x, cache, pos)
+                        new_caches.append(cache)
+                    nxt = sample_tokens(self._head_logits(params, x),
+                                        keys, s + 1,
+                                        temperature, top_k, top_p)
+                    if eos is not None:
+                        nxt = jnp.where(done, eos, nxt)
+                        done = done | (nxt == eos)
+                    return new_caches, nxt, pos + 1, done
+
+                def body(carry, s):
+                    if eos is not None:
+                        # EOS early-exit: one predicate skips the whole
+                        # transformer step once every row is finished
+                        carry = jax.lax.cond(
+                            jnp.all(carry[3]),
+                            lambda a: (a[0], jnp.full_like(a[1], eos),
+                                       a[2] + 1, a[3]),
+                            lambda a: live(a, s), carry)
+                    else:
+                        carry = live(carry, s)
+                    return carry, carry[1]
+
+                carry0 = (caches, tok0, lengths.astype(jnp.int32), done0)
+                _, ys = jax.lax.scan(body, carry0, jnp.arange(max_new - 1))
+                return jnp.concatenate(
+                    [tok0[:, None], jnp.swapaxes(ys, 0, 1)], axis=1)
+            return decode
+        return self._jit(("gen_decode", max_new) + sampler, builder,
+                         donate_caches=True)
+
+    # --------------------------------------------------------- run
+
+    def run(self, params, ids: np.ndarray, lengths: np.ndarray,
+            max_new: int, sampler: SamplerSig, keys,
+            replica=None, device=None) -> np.ndarray:
+        """Fused generation over a bucket-padded prompt batch:
+        ``ids`` [b, t_pad] int32 (rows right-padded past ``lengths``),
+        returns the [b, max_new] generated ids. Two dispatches total."""
+        b, t_pad = ids.shape
+        cache_len = t_pad + max_new
+        reg = get_registry()
+        put = (lambda a: jax.device_put(a, device)) if device is not None \
+            else (lambda a: a)
+        ids_d = put(jnp.asarray(ids, jnp.int32))
+        len_d = put(jnp.asarray(lengths, jnp.int32))
+        keys_d = put(jnp.asarray(keys))
+
+        pre = self._get_prefill(cache_len)
+        fresh = note_dispatch(
+            self.net, ("gen_prefill", replica, b, t_pad, cache_len))
+        t0 = time.perf_counter()
+        with span("compile" if fresh else "inference",
+                  path="generate_prefill", bucket=t_pad, rows=b):
+            caches, logits0 = pre(params, ids_d, len_d)
+            jax.block_until_ready(logits0)
+        t1 = time.perf_counter()
+
+        dec = self._get_decode(max_new, sampler)
+        fresh = note_dispatch(
+            self.net,
+            ("gen_decode", replica, b, cache_len, max_new) + sampler)
+        with span("compile" if fresh else "inference",
+                  path="generate_decode", rows=b, max_new=max_new):
+            toks = np.asarray(dec(params, caches, logits0, len_d, keys_d))
+        t2 = time.perf_counter()
+        self._observe(reg, b, int(np.sum(lengths)), max_new,
+                      (t1 - t0) * 1e3, (t2 - t1) * 1e3)
+        return toks
+
+    def run_eager(self, params, ids, lengths, max_new, sampler, keys,
+                  replica=None) -> np.ndarray:
+        """Per-token host-loop reference: same prefill, then ONE
+        dispatch per generated token (the pre-fused status quo). Same
+        math and same per-row PRNG fold indices as ``run``, so the two
+        agree token-for-token."""
+        temperature, top_k, top_p, eos = sampler
+        b, t_pad = ids.shape
+        cache_len = t_pad + max_new
+        pre = self._get_prefill(cache_len)
+        caches, logits0 = pre(params, jnp.asarray(ids, jnp.int32),
+                              jnp.asarray(lengths, jnp.int32))
+        keys_d = jnp.asarray(keys)
+
+        def builder_sample():
+            return lambda lg, k, s: sample_tokens(
+                lg, k, s, temperature, top_k, top_p)
+        samp = self._jit(("gen_sample",) + sampler[:3], builder_sample)
+
+        def builder_step():
+            def step(params, caches, tok, pos, keys, s):
+                p_emb = self._cast(params[self.emb.name])
+                x = self._embed_token(p_emb, tok, pos)
+                new_caches = []
+                for blk, cache in zip(self.blocks, caches):
+                    x, cache = blk.decode_step(
+                        self._cast(params[blk.name]), x, cache, pos)
+                    new_caches.append(cache)
+                nxt = sample_tokens(self._head_logits(params, x),
+                                    keys, s, temperature, top_k, top_p)
+                return new_caches, nxt
+            return step
+        step = self._jit(("gen_step",) + sampler[:3], builder_step)
+
+        tok = np.asarray(samp(logits0, keys_d, jnp.int32(0)))
+        done = np.zeros(b, bool)
+        if eos is not None:
+            tok = np.where(np.asarray(lengths) == 0, eos, tok)
+            done |= tok == eos
+        pos = np.asarray(lengths, np.int32)
+        out = [tok]
+        for s in range(1, max_new):
+            caches, nxt = step(params, caches, jnp.asarray(tok, jnp.int32),
+                               jnp.asarray(pos, jnp.int32), keys_d,
+                               jnp.int32(s))
+            nxt = np.asarray(nxt)
+            if eos is not None:
+                nxt = np.where(done, eos, nxt)
+                done |= nxt == eos
+            pos = pos + 1
+            out.append(nxt)
+            tok = nxt
+        return np.stack(out, axis=1)
+
+
+class RecurrentGenerator(_GeneratorBase):
+    """Char-RNN generation for GravesLSTM stacks through the existing
+    scanned ``one_step`` recurrence: the prompt streams through one
+    masked scan (bucketed length, carries held past each row's end),
+    then the whole decode runs as one scan feeding sampled ids back as
+    one-hot rows. No positional state — the carry IS the history."""
+
+    def __init__(self, net, impls):
+        super().__init__(net, impls)
+        self.n_in = impls[0].conf.n_in
+        self._rec = [i for i in impls[:-1] if hasattr(i, "rnn_time_step")]
+        self._head_in = impls[-2].conf.n_out
+
+    def prompt_bucket(self, t_in: int, max_new: int) -> int:
+        if t_in < 1:
+            raise ValueError(f"empty prompt (length {t_in})")
+        return _pow2_bucket(t_in)
+
+    def _init_state(self, b: int):
+        dt = self.net._dtype
+        return {i.name: {"h": jnp.zeros((b, i.conf.n_out), dt),
+                         "c": jnp.zeros((b, i.conf.n_out), dt)}
+                for i in self._rec}
+
+    def _one_step(self, params, rstate, xt):
+        """Whole-stack one-timestep forward below the head (the
+        MultiLayerNetwork ``_make_rnn_step`` recurrence): returns the
+        head INPUT [b, f] + new carries."""
+        new_rstate = dict(rstate)
+        for impl in self.impls[:-1]:
+            if hasattr(impl, "rnn_time_step"):
+                xt, new_rstate[impl.name] = impl.rnn_time_step(
+                    params[impl.name], xt, rstate[impl.name])
+            else:
+                xt, _ = impl.forward(params[impl.name], xt,
+                                     self.net.states[impl.name],
+                                     False, None)
+        return xt, new_rstate
+
+    def _get_prefill(self):
+        def builder():
+            def prefill(params, ids, lengths):
+                b, t_pad = ids.shape
+                dt = self.net._dtype
+                xs = jax.nn.one_hot(ids, self.n_in, dtype=dt)  # [b,t,v]
+
+                def body(carry, inp):
+                    rstate, last_h = carry
+                    xt, t = inp
+                    h, new_rstate = self._one_step(params, rstate, xt)
+                    upd = t < lengths  # hold carries past each row's end
+                    rstate = jax.tree.map(
+                        lambda new, old: jnp.where(upd[:, None], new, old),
+                        new_rstate, rstate)
+                    last_h = jnp.where((t == lengths - 1)[:, None],
+                                       h, last_h)
+                    return (rstate, last_h), None
+
+                carry0 = (self._init_state(b),
+                          jnp.zeros((b, self._head_in), dt))
+                (rstate, last_h), _ = jax.lax.scan(
+                    body, carry0,
+                    (jnp.swapaxes(xs, 0, 1), jnp.arange(t_pad)))
+                return rstate, self._head_logits(params, last_h)
+            return prefill
+        return self._jit(("gen_rnn_prefill",), builder)
+
+    def _get_decode(self, max_new: int, sampler: SamplerSig):
+        temperature, top_k, top_p, eos = sampler
+
+        def builder():
+            def decode(params, rstate, logits0, lengths, keys):
+                dt = self.net._dtype
+                tok0 = sample_tokens(logits0, keys, 0,
+                                     temperature, top_k, top_p)
+                if eos is not None:
+                    tok0 = jnp.where(lengths == 0, eos, tok0)
+                    done0 = tok0 == eos
+                else:
+                    done0 = jnp.zeros(tok0.shape, bool)
+
+                def live(args, s):
+                    rstate, tok, done = args
+                    xt = jax.nn.one_hot(tok, self.n_in, dtype=dt)
+                    h, rstate = self._one_step(params, rstate, xt)
+                    nxt = sample_tokens(self._head_logits(params, h),
+                                        keys, s + 1,
+                                        temperature, top_k, top_p)
+                    if eos is not None:
+                        nxt = jnp.where(done, eos, nxt)
+                        done = done | (nxt == eos)
+                    return rstate, nxt, done
+
+                def body(carry, s):
+                    if eos is not None:
+                        carry = jax.lax.cond(
+                            jnp.all(carry[2]),
+                            lambda a: (a[0], jnp.full_like(a[1], eos),
+                                       a[2]),
+                            lambda a: live(a, s), carry)
+                    else:
+                        carry = live(carry, s)
+                    return carry, carry[1]
+
+                _, ys = jax.lax.scan(body, (rstate, tok0, done0),
+                                     jnp.arange(max_new - 1))
+                return jnp.concatenate(
+                    [tok0[:, None], jnp.swapaxes(ys, 0, 1)], axis=1)
+            return decode
+        return self._jit(("gen_rnn_decode", max_new) + sampler, builder,
+                         donate_caches=True)
+
+    def run(self, params, ids, lengths, max_new, sampler, keys,
+            replica=None, device=None) -> np.ndarray:
+        b, t_pad = ids.shape
+        reg = get_registry()
+        put = (lambda a: jax.device_put(a, device)) if device is not None \
+            else (lambda a: a)
+        ids_d = put(jnp.asarray(ids, jnp.int32))
+        len_d = put(jnp.asarray(lengths, jnp.int32))
+        keys_d = put(jnp.asarray(keys))
+
+        pre = self._get_prefill()
+        fresh = note_dispatch(self.net,
+                              ("gen_rnn_prefill", replica, b, t_pad))
+        t0 = time.perf_counter()
+        with span("compile" if fresh else "inference",
+                  path="generate_prefill", bucket=t_pad, rows=b):
+            rstate, logits0 = pre(params, ids_d, len_d)
+            jax.block_until_ready(logits0)
+        t1 = time.perf_counter()
+
+        dec = self._get_decode(max_new, sampler)
+        fresh = note_dispatch(
+            self.net, ("gen_rnn_decode", replica, b, max_new) + sampler)
+        with span("compile" if fresh else "inference",
+                  path="generate_decode", rows=b, max_new=max_new):
+            toks = np.asarray(dec(params, rstate, logits0, len_d, keys_d))
+        t2 = time.perf_counter()
+        self._observe(reg, b, int(np.sum(lengths)), max_new,
+                      (t1 - t0) * 1e3, (t2 - t1) * 1e3)
+        return toks
+
+    def run_eager(self, params, ids, lengths, max_new, sampler, keys,
+                  replica=None) -> np.ndarray:
+        temperature, top_k, top_p, eos = sampler
+        b, _ = ids.shape
+        pre = self._get_prefill()
+        rstate, logits0 = pre(params, jnp.asarray(ids, jnp.int32),
+                              jnp.asarray(lengths, jnp.int32))
+        keys_d = jnp.asarray(keys)
+
+        def builder_sample():
+            return lambda lg, k, s: sample_tokens(
+                lg, k, s, temperature, top_k, top_p)
+        samp = self._jit(("gen_sample",) + sampler[:3], builder_sample)
+
+        def builder_step():
+            def step(params, rstate, tok, keys, s):
+                xt = jax.nn.one_hot(tok, self.n_in, dtype=self.net._dtype)
+                h, rstate = self._one_step(params, rstate, xt)
+                nxt = sample_tokens(self._head_logits(params, h), keys, s,
+                                    temperature, top_k, top_p)
+                return rstate, nxt
+            return step
+        step = self._jit(("gen_rnn_step",) + sampler[:3], builder_step)
+
+        tok = np.asarray(samp(logits0, keys_d, jnp.int32(0)))
+        done = np.zeros(b, bool)
+        if eos is not None:
+            tok = np.where(np.asarray(lengths) == 0, eos, tok)
+            done |= tok == eos
+        out = [tok]
+        for s in range(1, max_new):
+            rstate, nxt = step(params, rstate, jnp.asarray(tok, jnp.int32),
+                               keys_d, jnp.int32(s))
+            nxt = np.asarray(nxt)
+            if eos is not None:
+                nxt = np.where(done, eos, nxt)
+                done |= nxt == eos
+            out.append(nxt)
+            tok = nxt
+        return np.stack(out, axis=1)
+
+
+def build_generator(net):
+    """Detect the net's generation family and build (or return the
+    cached) generator: SequenceEmbedding → TransformerBlock* → head
+    stacks get KV-cache prefill/decode; stacks with ``rnn_time_step``
+    layers get the scanned-recurrence path. Anything else raises."""
+    gen = net.__dict__.get("_generator")
+    if gen is not None and gen.net is net:
+        return gen
+    impls = _ordered_impls(net)
+    if (len(impls) >= 3 and isinstance(impls[0], SequenceEmbeddingImpl)
+            and all(isinstance(i, TransformerBlockImpl)
+                    for i in impls[1:-1])
+            and impls[-1].has_loss()):
+        gen = TransformerGenerator(net, impls)
+    elif (len(impls) >= 2 and impls[-1].has_loss()
+          and any(hasattr(i, "rnn_time_step") for i in impls[:-1])):
+        gen = RecurrentGenerator(net, impls)
+    else:
+        raise ValueError(
+            "generate() needs a SequenceEmbedding + TransformerBlock "
+            "stack or a recurrent (rnn_time_step) stack under an "
+            f"output head; got {[type(i).__name__ for i in impls]}")
+    net.__dict__["_generator"] = gen
+    return gen
+
+
+def _prep(net, prompt_ids, max_new_tokens: int):
+    gen = build_generator(net)
+    prompt = np.asarray(prompt_ids)
+    if prompt.ndim != 2:
+        raise ValueError(
+            f"prompt_ids must be [batch, t] int tokens, got {prompt.shape}")
+    max_new = int(max_new_tokens)
+    if max_new < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+    b, t_in = prompt.shape
+    t_pad = gen.prompt_bucket(t_in, max_new)
+    ids = np.zeros((b, t_pad), np.int32)
+    ids[:, :t_in] = prompt
+    lengths = np.full((b,), t_in, np.int32)
+    return gen, prompt, ids, lengths, max_new
+
+
+def generate(net, prompt_ids, max_new_tokens: int, *,
+             temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+             eos_token: Optional[int] = None, seed: int = 0) -> np.ndarray:
+    """Fused autoregressive generation — the transformer analog of the
+    stateful ``rnnTimeStep`` path (``MultiLayerNetwork.java:1233``
+    role), TWO dispatches end to end (bucketed prefill + one-scan
+    decode) instead of one per token.
+
+    ``prompt_ids``: [b, t0] int tokens. Returns
+    [b, t0 + max_new_tokens] int64 (prompt + generated). With
+    ``eos_token`` set, a finished row's remaining slots are filled with
+    the EOS id and the decode step short-circuits once every row is
+    done. ``temperature`` 0 = greedy; else softmax sampling through the
+    optional ``top_k``/``top_p`` filters, seeded per row by ``seed``.
+    """
+    gen, prompt, ids, lengths, max_new = _prep(net, prompt_ids,
+                                               max_new_tokens)
+    get_registry().counter(DECODE_REQUESTS_COUNTER,
+                           "generate() requests").inc()
+    toks = gen.run(net.params, ids, lengths, max_new,
+                   sampler_sig(temperature, top_k, top_p, eos_token),
+                   row_keys(seed, prompt.shape[0]))
+    return np.concatenate([prompt.astype(np.int64),
+                           toks.astype(np.int64)], axis=1)
+
+
+def generate_eager(net, prompt_ids, max_new_tokens: int, *,
+                   temperature: float = 0.0, top_k: int = 0,
+                   top_p: float = 0.0, eos_token: Optional[int] = None,
+                   seed: int = 0) -> np.ndarray:
+    """Per-token host-loop reference for :func:`generate` — identical
+    math and PRNG schedule, one dispatch per token. The correctness
+    oracle and the ``bench.py`` fused-vs-eager comparison baseline."""
+    gen, prompt, ids, lengths, max_new = _prep(net, prompt_ids,
+                                               max_new_tokens)
+    toks = gen.run_eager(net.params, ids, lengths, max_new,
+                         sampler_sig(temperature, top_k, top_p, eos_token),
+                         row_keys(seed, prompt.shape[0]))
+    return np.concatenate([prompt.astype(np.int64),
+                           toks.astype(np.int64)], axis=1)

@@ -159,14 +159,45 @@ class TransformerBlockImpl(LayerImpl):
         shape = (batch, max_len, h, hd)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
+    def prefill(self, params, x, cache):
+        """Batched prompt forward that ALSO writes every position's K/V
+        into ``cache`` (the ``decode_step`` layout): [b, t, d] →
+        ([b, t, d], cache). The attention/residual math is exactly
+        ``forward``'s (causal flash/ring dispatch, maskless), so prefill
+        hidden states equal ``forward``'s; the FFN routes NO-DROP like
+        ``decode_step`` when MoE (serving never wants dropped tokens).
+        Right-padded prompt rows are safe: a padded position's garbage
+        K/V slot is only ever attended to after a decode step has
+        overwritten it (decode writes slot ``pos`` before reading)."""
+        c = self.conf
+        b, t, d = x.shape
+        h_count, hd = c.num_heads, c.n_out // c.num_heads
+        h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
+        qkv = h @ params["Wqkv"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = lambda z: z.reshape(b, t, h_count, hd)
+        q, k, v = shape(q), shape(k), shape(v)
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            cache["k"], k.astype(cache["k"].dtype), 0, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            cache["v"], v.astype(cache["v"].dtype), 0, axis=1)
+        o = dispatch_attention(q, k, v, causal=c.causal, mask=None)
+        x = x + o.reshape(b, t, d) @ params["Wo"].astype(x.dtype)
+        h2 = _layer_norm(x, params["ln2_g"], params["ln2_b"])
+        mlp, _ = self._ffn(params, h2.reshape(-1, d), {},
+                           capacity_factor=float(max(1, c.num_experts)))
+        return x + mlp.reshape(b, t, d), {"k": ck, "v": cv}
+
     def decode_step(self, params, x_t, cache, pos):
         """One-token forward [b, d] with cached keys/values; ``pos`` is
-        the (traced) current position. Returns (y_t [b, d], new cache).
-        Dense blocks match ``forward`` exactly at every prefix position
-        (tested); MoE blocks route NO-DROP at decode time (capacity =
-        batch) — the training-time capacity heuristic over b*t tokens
-        has no stepwise equivalent, and dropping tokens at inference is
-        never what serving wants."""
+        the (traced) current position — a scalar (whole-batch position)
+        or a [b] vector (per-row positions, the ragged-prompt serving
+        path; the K/V write becomes a per-row one-hot scatter). Returns
+        (y_t [b, d], new cache). Dense blocks match ``forward`` exactly
+        at every prefix position (tested); MoE blocks route NO-DROP at
+        decode time (capacity = batch) — the training-time capacity
+        heuristic over b*t tokens has no stepwise equivalent, and
+        dropping tokens at inference is never what serving wants."""
         c = self.conf
         b, d = x_t.shape
         h_count, hd = c.num_heads, c.n_out // c.num_heads
@@ -175,15 +206,24 @@ class TransformerBlockImpl(LayerImpl):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = lambda z: z.reshape(b, h_count, hd)
         q, k, v = shape(q), shape(k), shape(v)
-        ck = jax.lax.dynamic_update_slice_in_dim(
-            cache["k"], k[:, None].astype(cache["k"].dtype), pos, axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(
-            cache["v"], v[:, None].astype(cache["v"].dtype), pos, axis=1)
+        slots = jnp.arange(cache["k"].shape[1])
+        if jnp.ndim(pos) == 0:
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], k[:, None].astype(cache["k"].dtype), pos, axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], v[:, None].astype(cache["v"].dtype), pos, axis=1)
+            # causal: only positions <= pos are live
+            live = (slots <= pos)[None, :]
+        else:
+            sel = (slots[None, :] == pos[:, None])[:, :, None, None]
+            ck = jnp.where(sel, k[:, None].astype(cache["k"].dtype),
+                           cache["k"])
+            cv = jnp.where(sel, v[:, None].astype(cache["v"].dtype),
+                           cache["v"])
+            live = slots[None, :] <= pos[:, None]  # [b, L] per-row causal
         scale = 1.0 / jnp.sqrt(jnp.asarray(hd, q.dtype))
         s = jnp.einsum("bhd,bkhd->bhk", q, ck.astype(q.dtype)) * scale
-        # causal: only positions <= pos are live
-        live = jnp.arange(ck.shape[1]) <= pos
-        s = jnp.where(live[None, None, :], s,
+        s = jnp.where(live[:, None, :], s,
                       jnp.asarray(jnp.finfo(s.dtype).min, s.dtype))
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhk,bkhd->bhd", w, cv.astype(q.dtype))

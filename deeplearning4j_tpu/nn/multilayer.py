@@ -535,6 +535,21 @@ class MultiLayerNetwork:
     def rnn_clear_previous_state(self) -> None:
         self._rnn_state = None
 
+    # --------------------------------------------------- generation
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 **kwargs) -> np.ndarray:
+        """Fused autoregressive generation (``nn/generate.py``): ONE
+        bucketed prefill dispatch writes the KV caches (or streams the
+        prompt through the LSTM recurrence), then ALL of
+        ``max_new_tokens`` runs as ONE ``lax.scan`` dispatch with
+        on-device sampling — the serving analog of ``rnn_time_step``'s
+        one-program-per-burst doctrine. Knobs: ``temperature`` /
+        ``top_k`` / ``top_p`` / ``eos_token`` / ``seed``. Returns
+        [b, t0 + max_new_tokens] int64 token ids."""
+        from deeplearning4j_tpu.nn.generate import generate
+        return generate(self, prompt_ids, max_new_tokens, **kwargs)
+
     def _fit_batch(self, ds: DataSet) -> None:
         if (self.conf.backprop_type == "truncated_bptt" and ds.features.ndim == 3
                 and ds.features.shape[1] > self.conf.tbptt_fwd_length):

@@ -486,7 +486,13 @@ def fused_lstm_train_applicable(b: int, n: int, gate_act: str,
     XLA residual BPTT from the fused forward measured SLOWER than the
     plain scan-grad (21% vs 28.8%, r3/r4), so larger hiddens keep the
     XLA scan for training. The budget scales with the stream dtype:
-    bf16 admits n<=512, f32 n<=256."""
+    bf16 admits n<=512, f32 n<=256. ``DL4J_TPU_LSTM_BWD=xla`` (the
+    documented A/B seam, mirroring ``_use_pallas_bwd``) restores the
+    plain XLA scan end to end — without this gate it silently
+    dispatched the SLOWER fused-fwd + XLA-bwd combination."""
+    import os
+    if os.environ.get("DL4J_TPU_LSTM_BWD", "").lower() == "xla":
+        return False
     return (train_fused_enabled() and n * itemsize <= _BWD_MAX_N * 2
             and fused_lstm_applicable(b, n, gate_act, block_act, mask,
                                       itemsize=itemsize))

@@ -49,6 +49,17 @@ run (row-independent programs; the same property PR 2's bucketing
 parity test pins for training). Models with cross-batch statistics
 (``LayerImpl.batch_statistics`` — MoE capacity routing) auto-disable
 coalescing: each request dispatches alone, unpadded.
+
+Generation serving: ``submit_generate(prompt_ids, max_new_tokens)``
+routes decode requests through the fused generation engine
+(``nn/generate.py`` — bucketed prefill + one-scan decode with
+on-device sampling). Requests coalesce per (prompt-length bucket,
+max_new_tokens, sampler) across replicas; per-row traced lengths and
+PRNG keys make a request's tokens identical to a solo
+``net.generate`` run regardless of coalescing, and
+``warmup_generate`` AOT-compiles the (bucket × row-bucket × replica)
+program set so steady-state decode serving performs zero XLA
+compiles.
 """
 
 from __future__ import annotations
@@ -65,6 +76,7 @@ import numpy as np
 from deeplearning4j_tpu.datasets.iterators import (bucket_for, bucket_sizes,
                                                    pad_rows)
 from deeplearning4j_tpu.monitor import (
+    DECODE_REQUESTS_COUNTER,
     FAULT_QUARANTINED_GAUGE,
     INFER_BATCH_SIZE_BUCKETS,
     INFER_BATCH_SIZE_HISTOGRAM,
@@ -95,15 +107,57 @@ class _Request:
         self.future: "Future[np.ndarray]" = Future()
         self.t_submit = time.perf_counter()
 
+    def sig(self) -> Tuple:
+        """Coalescing signature: only same-sig requests may share a
+        dispatched batch."""
+        return tuple(self.x.shape[1:])
+
+    def finish(self, rows: np.ndarray) -> np.ndarray:
+        """Map the batch's de-padded result rows onto this request's
+        Future value."""
+        return rows
+
+
+class _GenRequest(_Request):
+    """A decode request: bucket-padded prompt rows [n, t_pad] plus the
+    per-row true lengths and PRNG keys. Coalesces with other requests
+    of the same (prompt bucket, max_new_tokens, sampler) signature —
+    per-row lengths/keys keep each request's tokens identical to a
+    solo ``net.generate`` run of the same rows."""
+
+    __slots__ = ("lengths", "keys", "t_in", "max_new", "sampler")
+
+    def __init__(self, ids_pad: np.ndarray, lengths: np.ndarray,
+                 keys: np.ndarray, t_in: int, max_new: int,
+                 sampler: Tuple):
+        super().__init__(ids_pad)
+        self.lengths = lengths
+        self.keys = keys
+        self.t_in = t_in
+        self.max_new = max_new
+        self.sampler = sampler
+
+    def sig(self) -> Tuple:
+        return ("gen", self.x.shape[1], self.max_new) + self.sampler
+
+    def finish(self, rows: np.ndarray) -> np.ndarray:
+        return np.concatenate(
+            [self.x[:, :self.t_in].astype(np.int64),
+             rows.astype(np.int64)], axis=1)
+
 
 class _Batch:
-    __slots__ = ("requests", "x", "rows", "tried")
+    __slots__ = ("requests", "x", "rows", "tried", "payload")
 
-    def __init__(self, requests: List[_Request], x: np.ndarray, rows: int):
+    def __init__(self, requests: List[_Request], x: np.ndarray, rows: int,
+                 payload: Optional[Tuple] = None):
         self.requests = requests
         self.x = x  # bucket-padded, model dtype
         self.rows = rows  # real (unpadded) row count
         self.tried: set = set()  # replicas that gave up on this batch
+        # generate batches carry (lengths, keys, max_new, sampler);
+        # plain inference batches carry None
+        self.payload = payload
 
 
 _STOP = object()
@@ -231,7 +285,9 @@ class ParallelInference:
             raise ValueError(
                 f"requests carry their batch dimension: got shape {x.shape}; "
                 "a single example must be submitted as x[None, ...]")
-        req = _Request(x)
+        return self._enqueue(_Request(x))
+
+    def _enqueue(self, req: _Request) -> "Future[np.ndarray]":
         try:
             self._rq.put(req, block=not self.reject_when_full)
         except queue.Full:
@@ -249,6 +305,92 @@ class ParallelInference:
         """Blocking facade: inline ``net.output`` semantics through the
         batching engine."""
         return self.submit(x).result(timeout=timeout)
+
+    # ---------------------------------------------------- generation
+
+    def _generator(self):
+        """The net's fused generation engine (nn/generate.py), built
+        lazily — raises on nets with no generation family."""
+        gen = self.__dict__.get("_gen")
+        if gen is None:
+            from deeplearning4j_tpu.nn.generate import build_generator
+            gen = self.__dict__["_gen"] = build_generator(self.net)
+        return gen
+
+    def submit_generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                        temperature: float = 0.0, top_k: int = 0,
+                        top_p: float = 0.0, eos_token: Optional[int] = None,
+                        seed: int = 0) -> "Future[np.ndarray]":
+        """Enqueue one decode request (``prompt_ids``: [n, t0] int
+        tokens); the Future resolves to the [n, t0 + max_new_tokens]
+        ids a solo ``net.generate`` of the same rows would return.
+        Requests coalesce per (prompt-length bucket, max_new_tokens,
+        sampler) across replicas — the prompt length enters the
+        compiled program as a traced per-row vector, so any prompt mix
+        inside a bucket shares one AOT-warmable program, and per-row
+        PRNG keys make a request's draws coalescing-invariant."""
+        if self._closed:
+            raise RuntimeError("ParallelInference is shut down")
+        from deeplearning4j_tpu.nn.generate import row_keys, sampler_sig
+        gen = self._generator()
+        prompt = np.asarray(prompt_ids)
+        if prompt.ndim != 2:
+            raise ValueError(
+                f"prompt_ids must be [n, t0] int tokens, got {prompt.shape}")
+        n, t_in = prompt.shape
+        max_new = int(max_new_tokens)
+        t_pad = gen.prompt_bucket(t_in, max_new)
+        ids = np.zeros((n, t_pad), np.int32)
+        ids[:, :t_in] = prompt
+        lengths = np.full((n,), t_in, np.int32)
+        keys = np.asarray(row_keys(seed, n))
+        self._reg().counter(DECODE_REQUESTS_COUNTER,
+                            "generate() requests").inc()
+        return self._enqueue(_GenRequest(
+            ids, lengths, keys, t_in, max_new,
+            sampler_sig(temperature, top_k, top_p, eos_token)))
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 timeout: Optional[float] = None, **kwargs) -> np.ndarray:
+        """Blocking facade over :meth:`submit_generate`."""
+        return self.submit_generate(prompt_ids, max_new_tokens,
+                                    **kwargs).result(timeout=timeout)
+
+    def warmup_generate(self, prompt_lengths: Sequence[int],
+                        max_new_tokens: int, temperature: float = 0.0,
+                        top_k: int = 0, top_p: float = 0.0,
+                        eos_token: Optional[int] = None) -> int:
+        """AOT-compile the decode program set: for every prompt-length
+        bucket covering ``prompt_lengths``, run a zero-prompt batch of
+        every row-bucket size on every replica (prefill + decode).
+        Returns the number of fresh programs compiled; after it,
+        steady-state ``submit_generate`` serving of any request mix
+        within the covered (bucket, max_new) set performs zero XLA
+        compiles (observable via ``dl4j_jit_cache_miss_total``)."""
+        from deeplearning4j_tpu.monitor import JIT_CACHE_MISS_COUNTER
+        from deeplearning4j_tpu.nn.generate import row_keys, sampler_sig
+        gen = self._generator()
+        sampler = sampler_sig(temperature, top_k, top_p, eos_token)
+        max_new = int(max_new_tokens)
+        sizes = self.buckets if self.coalesce else (1,)
+        reg = self._reg()
+        before = reg.family_total(JIT_CACHE_MISS_COUNTER)
+        done = set()
+        for t_in in prompt_lengths:
+            t_pad = gen.prompt_bucket(int(t_in), max_new)
+            for rows in sizes:
+                if (t_pad, rows) in done:
+                    continue
+                done.add((t_pad, rows))
+                ids = np.zeros((rows, t_pad), np.int32)
+                lengths = np.full((rows,), min(int(t_in), t_pad), np.int32)
+                keys = np.asarray(row_keys(0, rows))
+                for i, (dev, params, states) in enumerate(self._replicas):
+                    with span("stage", path="warmup_generate", bucket=t_pad,
+                              rows=rows, replica=i):
+                        gen.run(params, ids, lengths, max_new, sampler,
+                                keys, replica=i, device=dev)
+        return int(reg.family_total(JIT_CACHE_MISS_COUNTER) - before)
 
     def warmup(self, shapes: Sequence[Tuple[int, ...]]) -> int:
         """AOT-compile the serving program set: for every per-example
@@ -359,7 +501,7 @@ class ParallelInference:
 
     @staticmethod
     def _sig(req: _Request) -> Tuple:
-        return tuple(req.x.shape[1:])
+        return req.sig()
 
     def _dispatch_sig(self, replica: int, shape: Tuple[int, ...]) -> Tuple:
         """jit-cache-miss signature of one device dispatch: program kind
@@ -439,7 +581,20 @@ class ParallelInference:
         rows = sum(r.n for r in reqs)
         x = reqs[0].x if len(reqs) == 1 else np.concatenate(
             [r.x for r in reqs], axis=0)
-        if self.coalesce:
+        payload = None
+        if isinstance(reqs[0], _GenRequest):
+            # decode batch: per-row lengths + PRNG keys ride along;
+            # row-bucket padding uses length 0 — the decode program's
+            # done-mask retires those rows on their first step
+            lengths = np.concatenate([r.lengths for r in reqs])
+            keys = np.concatenate([r.keys for r in reqs], axis=0)
+            if self.coalesce:
+                pad = bucket_for(rows, self.buckets) - rows
+                x = pad_rows(x, pad)
+                lengths = pad_rows(lengths, pad)
+                keys = pad_rows(keys, pad)
+            payload = (lengths, keys, reqs[0].max_new, reqs[0].sampler)
+        elif self.coalesce:
             x = pad_rows(x, bucket_for(rows, self.buckets) - rows)
         with self._lock:
             self._inflight += 1  # until delivered or failed, not requeues
@@ -456,7 +611,7 @@ class ParallelInference:
         reg.gauge(INFER_PADDED_RATIO_GAUGE,
                   "Cumulative fraction of dispatched rows that were bucket "
                   "padding").set(ratio)
-        return _Batch(reqs, x, rows)
+        return _Batch(reqs, x, rows, payload)
 
     # ------------------------------------------------------------ workers
 
@@ -496,14 +651,24 @@ class ParallelInference:
         last: Optional[BaseException] = None
         for attempt in range(1 + self.max_batch_retries):
             try:
-                with span("stage", path="infer_feed", replica=idx):
-                    x = jax.device_put(b.x, dev)
-                fresh = note_dispatch(self.net,
-                                      self._dispatch_sig(idx, b.x.shape))
-                with span("compile" if fresh else "inference",
-                          path="parallel_inference", replica=idx,
-                          rows=b.rows, batch=int(b.x.shape[0])):
-                    y = np.asarray(self._dispatch(idx, params, states, x))
+                if b.payload is not None:
+                    # fused decode batch: prefill + one-scan decode on
+                    # this replica's pinned params (two dispatches)
+                    lengths, keys, max_new, sampler = b.payload
+                    if self._poison_hook is not None:
+                        self._poison_hook(idx, b.x.shape)
+                    y = self._generator().run(
+                        params, b.x, lengths, max_new, sampler, keys,
+                        replica=idx, device=dev)
+                else:
+                    with span("stage", path="infer_feed", replica=idx):
+                        x = jax.device_put(b.x, dev)
+                    fresh = note_dispatch(self.net,
+                                          self._dispatch_sig(idx, b.x.shape))
+                    with span("compile" if fresh else "inference",
+                              path="parallel_inference", replica=idx,
+                              rows=b.rows, batch=int(b.x.shape[0])):
+                        y = np.asarray(self._dispatch(idx, params, states, x))
             except BaseException as e:
                 last = e
                 record_fault("serving")
@@ -511,12 +676,13 @@ class ParallelInference:
                     f"replica {idx} attempt {attempt + 1}: "
                     f"{type(e).__name__}: {e}")
                 continue
-            with self._lock:
-                self._probe_shape = tuple(b.x.shape[1:])
+            if b.payload is None:
+                with self._lock:
+                    self._probe_shape = tuple(b.x.shape[1:])
             off = 0
             now = time.perf_counter()
             for r in b.requests:
-                r.future.set_result(y[off:off + r.n])
+                r.future.set_result(r.finish(y[off:off + r.n]))
                 off += r.n
                 lat.observe((now - r.t_submit) * 1e3)
             with self._lock:

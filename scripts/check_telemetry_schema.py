@@ -170,6 +170,13 @@ KNOWN_DL4J_METRICS = {
     "dl4j_infer_queue_depth",
     "dl4j_infer_padded_ratio",
     "dl4j_infer_latency_ms",
+    # generation plane (nn/generate.py fused autoregressive decode,
+    # served via ParallelInference.submit_generate)
+    "dl4j_decode_requests_total",
+    "dl4j_decode_prefill_tokens_total",
+    "dl4j_decode_tokens_total",
+    "dl4j_decode_prefill_latency_ms",
+    "dl4j_decode_latency_ms",
     # fault-tolerance plane (supervisor / quarantine / dead-letter /
     # checkpoint integrity — see monitor/__init__.py FAULT_* names)
     "dl4j_fault_events_total",

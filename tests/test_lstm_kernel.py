@@ -96,6 +96,22 @@ def test_applicability_gate(monkeypatch):
     assert not fused_lstm_applicable(16, 128, "sigmoid", "tanh", None)
 
 
+def test_train_applicability_honors_bwd_env(monkeypatch):
+    """DL4J_TPU_LSTM_BWD=xla is the documented A/B seam back to the
+    plain XLA scan: the TRAIN gate must refuse too (mirroring
+    _use_pallas_bwd), not silently dispatch the slower fused-fwd +
+    XLA-bwd combination (21% vs 28.8% MFU, r3/r4)."""
+    monkeypatch.setattr(lk, "_on_tpu", lambda: True)
+    assert lk.fused_lstm_train_applicable(16, 128, "sigmoid", "tanh", None)
+    monkeypatch.setenv("DL4J_TPU_LSTM_BWD", "xla")
+    assert not lk.fused_lstm_train_applicable(16, 128, "sigmoid", "tanh",
+                                              None)
+    # inference-only dispatch is untouched by the backward seam
+    assert fused_lstm_applicable(16, 128, "sigmoid", "tanh", None)
+    monkeypatch.delenv("DL4J_TPU_LSTM_BWD")
+    assert lk.fused_lstm_train_applicable(16, 128, "sigmoid", "tanh", None)
+
+
 def test_layer_inference_dispatch_transparent(rng, monkeypatch):
     """MLN.output through the kernel equals the XLA path bit-for-bit at
     test tolerance — the dispatch must be invisible to users."""

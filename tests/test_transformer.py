@@ -159,11 +159,14 @@ def test_generate_moe_and_sampling(rng):
     out = generate(net, prompt, max_new_tokens=4, temperature=1.0, seed=3)
     assert out.shape == (4, 6)
     assert (out >= 0).all() and (out < 11).all()
-    # greedy decode is deterministic and the cached jit reproduces it
+    # greedy decode is deterministic and the cached jits reproduce it
     g1 = generate(net, prompt, max_new_tokens=4)
     g2 = generate(net, prompt, max_new_tokens=4)
     np.testing.assert_array_equal(g1, g2)
-    assert ("gpt_generate", 4, 2, 6, 0.0, 0, 0.0) in net._jits
+    # the fused engine caches one prefill program (per cache length) and
+    # one decode program (per max_new × sampler) on the net
+    assert any(k[0] == "gen_prefill" for k in net._jits)
+    assert ("gen_decode", 4, 0.0, 0, 0.0, None) in net._jits
     # top-k=1 sampling degenerates to greedy regardless of temperature
     g3 = generate(net, prompt, max_new_tokens=4, temperature=5.0, top_k=1)
     np.testing.assert_array_equal(g3, g1)

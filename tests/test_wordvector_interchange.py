@@ -270,3 +270,34 @@ def test_read_word_vectors_any_autodetects(tmp_path):
         f.write(b"\x00\x01nonsense")
     with pytest.raises(ValueError, match="unrecognized|not a word-vector"):
         ser.read_word_vectors_any(bad)
+
+
+def test_read_word_vectors_any_multibyte_cut_at_sample_boundary(tmp_path):
+    """Format sniffing reads a 512-byte sample; a multibyte char cut at
+    that boundary must NOT reroute a headered TEXT file to the binary
+    reader (the incremental-decoder rule _detect_ipadic_encoding uses)."""
+    dim = 4
+    vec = " ".join(f"{0.25 * (k + 1):.6f}" for k in range(dim))
+    lines = ["2 4"]
+    # pad the first word so the sample boundary (byte 512) lands INSIDE
+    # the 2-byte UTF-8 encoding of the é that follows it
+    pad = "a" * (511 - len(lines[0].encode()) - 1)
+    first_word = pad + "ééé"
+    lines.append(f"{first_word} {vec}")
+    lines.append(f"king {vec}")
+    payload = ("\n".join(lines) + "\n").encode("utf-8")
+    # boundary check: byte 512 cuts a multibyte char → the old
+    # rest.decode("utf-8") raised and misrouted to the binary reader
+    try:
+        payload[:512].partition(b"\n")[2].decode("utf-8")
+        cut = False
+    except UnicodeDecodeError:
+        cut = True
+    assert cut, "test setup: boundary must cut a multibyte char"
+    p = str(tmp_path / "cut.txt")
+    with open(p, "wb") as f:
+        f.write(payload)
+    wv = ser.read_word_vectors_any(p)
+    assert wv.vocab.index_of("king") == 1
+    np.testing.assert_allclose(wv.get_word_vector("king"),
+                               [0.25, 0.5, 0.75, 1.0], rtol=1e-6)
