@@ -15,6 +15,10 @@ Sub-benchmarks (each reported under "sub_benchmarks"):
     scan dispatch for all of max_new_tokens, nn/generate.py) vs the
     eager per-token dispatch loop (tokens/sec/chip, per-token p50,
     steady-state jit-miss count, greedy identity)
+  - router_slo — the horizontal serving tier under open-loop Poisson
+    load: rps + p50/p99 healthy vs during a mid-load engine kill
+    (failover, zero lost requests) and the shed rate under a deadline
+    tighter than capacity (serving/router.py InferenceRouter)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 The headline metric is ResNet-50 MFU when available (the heaviest
@@ -705,6 +709,158 @@ def bench_lstm_decode():
                             flops_per_token=2.0 * macs)}
 
 
+def bench_router_slo():
+    """Horizontal serving tier under open-loop Poisson load (the SLO
+    protocol: arrivals don't wait for completions, so queueing shows up
+    in the tail instead of silently throttling the driver).
+
+    A 3-endpoint LocalFleet (thread-mode engine workers behind the
+    broker wire protocol) serves through an InferenceRouter in three
+    phases: (a) healthy steady state; (b) one endpoint KILLED mid-load
+    (the faultinject process-kill seam) — every request must still
+    resolve via failover and the p99 impact is the headline; (c) a
+    deadline tighter than capacity at 2x the arrival rate — the
+    admission controller must shed (RetryAfter) instead of queueing
+    past the SLO, and the shed rate is reported."""
+    import time
+
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.faultinject import kill_endpoint
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving import (InferenceRouter, LocalFleet,
+                                            RetryAfter)
+
+    rng = np.random.default_rng(0)
+    nin, nc = 64, 8
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.05).updater("adam").activation("relu")
+            .list()
+            .layer(DenseLayer(n_in=nin, n_out=256))
+            .layer(OutputLayer(n_in=256, n_out=nc, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    def engine_factory():
+        eng = ParallelInference(net, max_batch_size=16, max_latency_ms=2.0,
+                                replicas=1)
+        eng.warmup([(nin,)])
+        return eng
+
+    router = InferenceRouter(per_try_timeout_s=2.0, eject_backoff_s=0.2,
+                             max_attempts=4)
+    fleet = LocalFleet(engine_factory, router=router, heartbeat_s=0.05,
+                       request_timeout_s=2.0, heartbeat_timeout_s=0.4)
+    for _ in range(3):
+        fleet.add_endpoint()
+    fleet.wait_ready(30)
+    x = rng.standard_normal((1, nin)).astype(np.float32)
+
+    # capacity probe → open-loop rate at ~70% of closed-loop throughput
+    t0 = time.perf_counter()
+    for _ in range(50):
+        router.output(x, timeout=30)
+    svc_s = (time.perf_counter() - t0) / 50
+    rate = 0.7 / svc_s
+
+    def run_phase(duration_s, rate, deadline_ms=None,
+                  priority="interactive"):
+        lats, errors = [], []
+        shed = 0
+        sent = 0
+        done_box = []
+
+        def on_done(f, t_sub):
+            err = f.exception()
+            if err is not None:
+                errors.append(err)
+            else:
+                done_box.append(time.perf_counter() - t_sub)
+
+        end = time.perf_counter() + duration_s
+        next_t = time.perf_counter()
+        while time.perf_counter() < end:
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(min(next_t - now, 2e-3))
+                continue
+            next_t += rng.exponential(1.0 / rate)
+            t_sub = time.perf_counter()
+            try:
+                fut = router.submit(x, deadline_ms=deadline_ms,
+                                    priority=priority)
+            except RetryAfter:
+                shed += 1
+                continue
+            sent += 1
+            fut.add_done_callback(lambda f, t=t_sub: on_done(f, t))
+        # open loop ends: wait out the in-flight tail
+        deadline = time.monotonic() + 60
+        while len(done_box) + len(errors) < sent and \
+                time.monotonic() < deadline:
+            time.sleep(2e-3)
+        lats = sorted(done_box)
+        n = len(lats)
+        return {"sent": sent, "completed": n, "errors": len(errors),
+                "shed": shed,
+                "requests_per_sec": round(n / duration_s, 1),
+                "p50_ms": round(lats[n // 2] * 1e3, 3) if n else None,
+                "p99_ms": round(lats[min(n - 1, int(n * 0.99))] * 1e3, 3)
+                if n else None}
+
+    try:
+        healthy = run_phase(2.0, rate)
+        victim = fleet.names()[0]
+        kill_endpoint(fleet, victim)
+        during_kill = run_phase(2.0, rate)
+        fleet.restart(victim)
+        router.probe_now()
+        recovered = run_phase(1.0, rate)
+        # deadline tighter than capacity at 2x the arrival rate:
+        # admission admits while the latency estimate fits the
+        # deadline's best_effort headroom and sheds as the backlog
+        # estimate climbs — a PARTIAL shed rate, load-dependent, with
+        # the admitted requests keeping a bounded tail
+        tight = run_phase(1.0, rate * 2.0,
+                          deadline_ms=max(1.0, svc_s * 1e3 * 8.0),
+                          priority="best_effort")
+        reg = monitor.get_registry()
+        snap = router.fleet_snapshot()
+    finally:
+        fleet.shutdown(drain=False)
+        router.close()
+
+    lost = (during_kill["sent"] - during_kill["completed"]
+            - during_kill["errors"])
+    shed_rate = tight["shed"] / max(1, tight["shed"] + tight["sent"])
+    return {
+        "metric": "router_slo_requests_per_sec",
+        "value": healthy["requests_per_sec"], "unit": "requests/sec",
+        "healthy": healthy,
+        "during_kill": during_kill,
+        "recovered": recovered,
+        "deadline_tight_2x": tight,
+        "shed_rate_tight_deadline": round(shed_rate, 3),
+        "during_kill_zero_lost": lost == 0
+        and during_kill["errors"] == 0,
+        "p99_impact_during_kill": (
+            None if not (healthy["p99_ms"] and during_kill["p99_ms"])
+            else round(during_kill["p99_ms"] / healthy["p99_ms"], 2)),
+        "failovers": int(reg.family_total(monitor.ROUTER_FAILOVERS_COUNTER)),
+        "hedges": int(reg.family_total(monitor.ROUTER_HEDGES_COUNTER)),
+        "fleet": {k: snap[k] for k in ("healthy_endpoints",
+                                       "total_endpoints", "shed",
+                                       "failovers")},
+        # the SLO story is relative: during-kill p99 over healthy p99
+        "vs_baseline": (
+            0.0 if not (healthy["p99_ms"] and during_kill["p99_ms"])
+            else round(healthy["p99_ms"] / during_kill["p99_ms"], 3)),
+    }
+
+
 def bench_word2vec():
     """Word2Vec skip-gram (BASELINE config #5): the all-epochs-on-device
     SGNS scan engine (device pairgen + table negatives + capped MXU
@@ -796,6 +952,7 @@ def main():
                      ("lstm_decode", bench_lstm_decode),
                      ("serving_inference", bench_serving_inference),
                      ("fault_recovery", bench_fault_recovery),
+                     ("router_slo", bench_router_slo),
                      ("word2vec", bench_word2vec)]:
         # fresh registry per sub-bench: the monitor spans inside the
         # fit/stage paths give each result its own per-phase attribution

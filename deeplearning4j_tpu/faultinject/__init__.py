@@ -19,13 +19,18 @@ Injector ↔ fault domain map:
   torn and bit-flipped checkpoint artifacts, and a crash *between* the
   tmp write and the atomic install (checkpoint domain);
 - :func:`poison_replica` — scheduled device errors on one serving
-  replica (serving domain: retry, quarantine, probe reinstatement).
+  replica (serving domain: retry, quarantine, probe reinstatement);
+- :func:`kill_endpoint` / :class:`NetworkPartition` — abrupt engine
+  endpoint death and broker-level partitions (routing domain: the
+  InferenceRouter's heartbeat death detection, failover, ejection and
+  half-open reinstatement).
 """
 
 from __future__ import annotations
 
 import os
 import random
+import time
 from typing import Iterable, Optional, Sequence
 
 import numpy as np
@@ -229,3 +234,75 @@ def poison_replica(engine, replica: int = 0, failures: int = 2
     poison = ReplicaPoison(replica, failures)
     engine._poison_hook = poison
     return poison
+
+
+# -------------------------------------------------------------- routing
+
+def kill_endpoint(fleet, name: str) -> str:
+    """Process-kill injector for the serving fleet: abruptly stop the
+    named endpoint's engine worker — consumed requests vanish without
+    replies and heartbeats go silent (SIGKILL's wire signature; thread
+    mode stops the worker threads, process mode delivers the real
+    signal). Returns the name so tests can ``fleet.restart(name)``
+    after asserting the failover. The router must keep every affected
+    future resolving (timeout → failover) and eject the endpoint."""
+    fleet.kill(name)
+    return name
+
+
+class NetworkPartition(MessageBroker):
+    """Broker wrapper that partitions deterministically: while
+    ``active``, operations on topics matching ``topic_substr`` (all
+    topics when None) fail with ``exc`` (default: swallow publishes /
+    return-None consumes when ``silent=True`` — a black-holing
+    partition — else raise ``ConnectionError``, a detectable one).
+    ``heal()`` reconnects. Wrap the broker handed to one side of a
+    channel to partition exactly that side."""
+
+    def __init__(self, wrapped: MessageBroker,
+                 topic_substr: Optional[str] = None,
+                 silent: bool = False, exc=ConnectionError):
+        self._wrapped = wrapped
+        self.topic_substr = topic_substr
+        self.silent = bool(silent)
+        self._exc = exc
+        self.active = False
+        self.dropped = 0
+
+    def partition(self) -> "NetworkPartition":
+        self.active = True
+        return self
+
+    def heal(self) -> None:
+        self.active = False
+
+    def _cut(self, topic: str) -> bool:
+        return self.active and (self.topic_substr is None
+                                or self.topic_substr in topic)
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        if self._cut(topic):
+            self.dropped += 1
+            if self.silent:
+                return  # black hole: the message is gone
+            raise self._exc(f"injected partition on publish to {topic}")
+        self._wrapped.publish(topic, payload)
+
+    def consume(self, topic: str, timeout: Optional[float] = None):
+        if self._cut(topic):
+            self.dropped += 1
+            if self.silent:
+                if timeout:
+                    time.sleep(min(timeout, 0.05))
+                return None  # looks exactly like an idle topic
+            raise self._exc(f"injected partition on consume of {topic}")
+        return self._wrapped.consume(topic, timeout=timeout)
+
+    def ping(self) -> float:
+        if self.active and self.topic_substr is None:
+            self.dropped += 1
+            raise self._exc("injected partition on ping")
+        return self._wrapped.ping()
+
+    def close(self) -> None:
+        self._wrapped.close()

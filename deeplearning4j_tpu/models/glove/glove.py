@@ -19,12 +19,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.util.jit import cpu_safe_jit
 from deeplearning4j_tpu.models.embeddings.lookup_table import WordVectors
 from deeplearning4j_tpu.models.word2vec.vocab import VocabCache
 from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+@cpu_safe_jit(donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
 def _glove_step(w, wc, b, bc, hw, hwc, hb, hbc, ii, jj, logx, fx, lr, eps=1e-8):
     """One AdaGrad batch on the GloVe objective."""
     wi = w[ii]

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.util.jit import cpu_safe_jit
 from deeplearning4j_tpu.models.sequencevectors.engine import (
     SequenceVectors,
     _DENSE_UPDATE_MAX_VOCAB,
@@ -33,7 +34,7 @@ from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
 import functools
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1),
+@cpu_safe_jit(donate_argnums=(0, 1),
                    static_argnames=("K", "bs", "n_steps", "dense"))
 def _pv_scan_program(doc_vecs, syn1neg, doc_ids, word_ids, neg_table, key,
                      lr, n_pairs, *, K, bs, n_steps, dense):

@@ -91,7 +91,8 @@ def make_sharded_sgns_step(mesh: Mesh, data_axis: str = "data",
         in_specs=(table_spec, table_spec, P(data_axis), P(data_axis),
                   P(data_axis, None), P(data_axis), P()),
         out_specs=(table_spec, table_spec, P()))
-    return jax.jit(shard, donate_argnums=(0, 1))
+    donate = (0, 1) if jax.default_backend() != "cpu" else ()
+    return jax.jit(shard, donate_argnums=donate)
 
 
 def make_sharded_hs_step(mesh: Mesh, data_axis: str = "data",
@@ -131,7 +132,8 @@ def make_sharded_hs_step(mesh: Mesh, data_axis: str = "data",
         in_specs=(table_spec, table_spec, P(data_axis), P(data_axis, None),
                   P(data_axis, None), P(data_axis, None), P(data_axis), P()),
         out_specs=(table_spec, table_spec, P()))
-    return jax.jit(shard, donate_argnums=(0, 1))
+    donate = (0, 1) if jax.default_backend() != "cpu" else ()
+    return jax.jit(shard, donate_argnums=donate)
 
 
 def make_sharded_cbow_step(mesh: Mesh, data_axis: str = "data",
@@ -185,7 +187,8 @@ def make_sharded_cbow_step(mesh: Mesh, data_axis: str = "data",
         in_specs=(table_spec, table_spec, P(data_axis, None), P(data_axis, None),
                   P(data_axis), P(data_axis, None), P(data_axis), P()),
         out_specs=(table_spec, table_spec, P()))
-    return jax.jit(shard, donate_argnums=(0, 1))
+    donate = (0, 1) if jax.default_backend() != "cpu" else ()
+    return jax.jit(shard, donate_argnums=donate)
 
 
 def pad_to_multiple(n: int, mult: int) -> int:

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.util.jit import cpu_safe_jit
 from deeplearning4j_tpu.models.embeddings.lookup_table import InMemoryLookupTable, WordVectors
 from deeplearning4j_tpu.models.word2vec.vocab import Huffman, VocabCache
 
@@ -137,8 +138,7 @@ def _sgns_math(syn0, syn1neg, centers, contexts, negatives, lr, weights,
     return syn0, syn1neg, loss
 
 
-@functools.partial(jax.jit, static_argnames=("dense",),
-                   donate_argnums=(0, 1))
+@cpu_safe_jit(donate_argnums=(0, 1), static_argnames=("dense",))
 def _sgns_step(syn0, syn1neg, centers, contexts, negatives, lr, weights,
                dense=False):
     """One host-fed SGNS batch (the fallback path; the hot path is
@@ -173,8 +173,8 @@ def _flat_pairs(centers, contexts, ok, bp, n2w):
     return c2, contexts.reshape(-1), ok.reshape(-1)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1),
-                   static_argnames=("window", "K", "bp", "n_steps", "dense"))
+@cpu_safe_jit(donate_argnums=(0, 1),
+              static_argnames=("window", "K", "bp", "n_steps", "dense"))
 def _sgns_scan_program(syn0, syn1neg, flat, pos, slen, neg_table, key,
                        lr0, min_lr, n_tokens, step0, total_steps, *,
                        window, K, bp, n_steps, dense):
@@ -256,7 +256,7 @@ def _hs_math(syn0, syn1, centers, codes, points, code_mask, lr, weights):
     return syn0, syn1, loss
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
+@cpu_safe_jit(donate_argnums=(0, 1))
 def _hs_step(syn0, syn1, centers, codes, points, code_mask, lr, weights):
     """One host-fed HS batch (fallback path; the hot path is
     ``_hs_scan_program``)."""
@@ -264,8 +264,8 @@ def _hs_step(syn0, syn1, centers, codes, points, code_mask, lr, weights):
                     weights)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1),
-                   static_argnames=("window", "bp", "n_steps"))
+@cpu_safe_jit(donate_argnums=(0, 1),
+              static_argnames=("window", "bp", "n_steps"))
 def _hs_scan_program(syn0, syn1, flat, pos, slen, codes_tab, points_tab,
                      cmask_tab, key, lr0, min_lr, n_tokens, step0,
                      total_steps, *, window, bp, n_steps):
@@ -443,14 +443,14 @@ def _cbow_hs_math(syn0, syn1, ctx, ctx_mask, codes, points, code_mask,
     return syn0, syn1, loss
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
+@cpu_safe_jit(donate_argnums=(0, 1))
 def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, codes, points, code_mask, lr,
                   weights):
     return _cbow_hs_math(syn0, syn1, ctx, ctx_mask, codes, points,
                          code_mask, lr, weights)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
+@cpu_safe_jit(donate_argnums=(0, 1))
 def _cbow_sgns_step(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr,
                     weights):
     """One host-fed CBOW batch (fallback path; the hot path is
@@ -459,8 +459,8 @@ def _cbow_sgns_step(syn0, syn1neg, ctx, ctx_mask, centers, negatives, lr,
                       weights)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1),
-                   static_argnames=("window", "K", "bp", "n_steps"))
+@cpu_safe_jit(donate_argnums=(0, 1),
+              static_argnames=("window", "K", "bp", "n_steps"))
 def _cbow_scan_program(syn0, syn1neg, flat, pos, slen, neg_table, key,
                        lr0, min_lr, n_tokens, step0, total_steps, *,
                        window, K, bp, n_steps):

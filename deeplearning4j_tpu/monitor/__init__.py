@@ -43,6 +43,17 @@ submit→result latency). ``dl4j_jit_cache_miss_total`` is shared with
 the training plane: a serve-loop dispatch that traces+compiles ticks it
 too, which is how the AOT ``warmup()`` contract is asserted.
 
+The horizontal serving tier (serving/router.py ``InferenceRouter``)
+publishes ``dl4j_router_requests_total`` (by ``priority`` class),
+``dl4j_router_shed_total`` (deadline-admission rejections — shed beats
+queueing past the SLO), ``dl4j_router_hedges_total`` /
+``dl4j_router_failovers_total`` (tail-latency duplicates and
+post-failure re-dispatches to another endpoint),
+``dl4j_router_queue_wait_ms`` (the admission-time queue-wait estimate
+the deadline decision used), ``dl4j_router_latency_ms`` (end-to-end
+submit→result), and ``dl4j_router_endpoint_healthy`` (per-``endpoint``
+gauge: 1 in the dispatch pool, 0 ejected or dead).
+
 The fault-tolerance plane publishes ``dl4j_fault_events_total`` (by
 ``domain``: checkpoint/training/serving/transport),
 ``dl4j_fault_rollbacks_total`` (supervisor divergence rollbacks),
@@ -98,12 +109,30 @@ DECODE_TOKENS_COUNTER = "dl4j_decode_tokens_total"
 DECODE_PREFILL_LATENCY_HISTOGRAM = "dl4j_decode_prefill_latency_ms"
 DECODE_LATENCY_HISTOGRAM = "dl4j_decode_latency_ms"
 
+# Horizontal serving tier (serving/router.py InferenceRouter — the
+# fleet-level plane above ParallelInference): request volume by
+# priority class, deadline sheds (admission control rejected with
+# RetryAfter rather than queueing past the SLO), hedged dispatches
+# (duplicate sent to a second endpoint after the hedge threshold),
+# failovers (request re-dispatched to a different endpoint after an
+# endpoint error/timeout), the admission-time queue-wait estimate and
+# the end-to-end submit→result latency, and a per-endpoint health
+# gauge (1 healthy / 0 ejected-or-dead).
+ROUTER_REQUESTS_COUNTER = "dl4j_router_requests_total"
+ROUTER_SHED_COUNTER = "dl4j_router_shed_total"
+ROUTER_HEDGES_COUNTER = "dl4j_router_hedges_total"
+ROUTER_FAILOVERS_COUNTER = "dl4j_router_failovers_total"
+ROUTER_QUEUE_WAIT_HISTOGRAM = "dl4j_router_queue_wait_ms"
+ROUTER_LATENCY_HISTOGRAM = "dl4j_router_latency_ms"
+ROUTER_ENDPOINT_HEALTHY_GAUGE = "dl4j_router_endpoint_healthy"
+
 # Fault-tolerance plane (detect → isolate → recover): every recovery
 # path in the stack reports through these five families so an operator
 # can tell a self-healed fault from a healthy run. ``domain`` label on
 # the events counter: "checkpoint" (torn/corrupt persistence),
 # "training" (NaN/divergence rollback), "serving" (replica device
-# errors/quarantine), "transport" (broker reconnects, poison messages).
+# errors/quarantine), "transport" (broker reconnects, poison messages),
+# "routing" (endpoint failures the router failed over / ejected).
 FAULT_EVENTS_COUNTER = "dl4j_fault_events_total"
 FAULT_ROLLBACKS_COUNTER = "dl4j_fault_rollbacks_total"
 FAULT_QUARANTINED_GAUGE = "dl4j_fault_quarantined_replicas"

@@ -243,6 +243,8 @@ class ParallelInference:
         self._rows_padded = 0
         self._batches = 0
         self._requests = 0
+        self._resolved = 0  # futures delivered (result or error)
+        self._warmed = False
         self._started = False
         self._threads: List[threading.Thread] = []
         if start:
@@ -390,6 +392,7 @@ class ParallelInference:
                               rows=rows, replica=i):
                         gen.run(params, ids, lengths, max_new, sampler,
                                 keys, replica=i, device=dev)
+        self._warmed = True
         return int(reg.family_total(JIT_CACHE_MISS_COUNTER) - before)
 
     def warmup(self, shapes: Sequence[Tuple[int, ...]]) -> int:
@@ -415,6 +418,7 @@ class ParallelInference:
             with self._lock:
                 # a warmed shape doubles as the quarantine probe program
                 self._probe_shape = tuple(shape)
+        self._warmed = True
         return compiled
 
     def stats(self) -> Dict[str, float]:
@@ -428,14 +432,41 @@ class ParallelInference:
                 "rows_padded": padded,
                 "padded_ratio": (padded / rows) if rows else 0.0,
                 "queue_depth": self._rq.qsize(),
+                "inflight": self._inflight,
                 "replicas": len(self._replicas),
                 "buckets": list(self.buckets),
                 "coalesce": self.coalesce,
                 "quarantined": quarantined,
                 "healthy_replicas": len(self._replicas) - len(quarantined),
                 "degraded": bool(quarantined),
+                "warmed": self._warmed,
                 "faults": len(self._fault_log),
             }
+
+    def drain(self, timeout: Optional[float] = None,
+              poll_s: float = 2e-3) -> bool:
+        """Block until every accepted request has resolved (admission
+        queue empty, no batch queued or running) WITHOUT stopping the
+        engine — the graceful half of shutdown a fleet worker runs
+        before leaving the serving pool, so a drained engine can be
+        stopped with zero stranded futures. Returns False when
+        ``timeout`` elapses first."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                # resolved-vs-accepted, not queue emptiness: a request
+                # coalescing inside the dispatcher window is in neither
+                # queue, but it has not resolved yet either
+                idle = self._resolved >= self._requests
+            if idle:
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+    def _note_resolved(self, n: int) -> None:
+        with self._lock:
+            self._resolved += n
 
     def probe_now(self) -> None:
         """Wake every quarantined replica's probe immediately (instead
@@ -473,6 +504,7 @@ class ParallelInference:
                 for r in b.requests:
                     if not r.future.done():
                         r.future.set_exception(err)
+                        self._note_resolved(1)
         if self._error is not None:
             raise self._error
 
@@ -496,6 +528,7 @@ class ParallelInference:
                 return
             if isinstance(item, _Request):
                 item.future.set_exception(err)
+                self._note_resolved(1)
 
     # --------------------------------------------------------- dispatcher
 
@@ -687,6 +720,7 @@ class ParallelInference:
                 lat.observe((now - r.t_submit) * 1e3)
             with self._lock:
                 self._inflight -= 1
+                self._resolved += len(b.requests)
             return None
         return last
 
@@ -712,13 +746,16 @@ class ParallelInference:
         if survivors and not self._stopping:
             self._bq.put(b)  # a surviving worker picks it up
             return
+        failed = 0
         for r in b.requests:
             if not r.future.done():
                 r.future.set_exception(err)
+                failed += 1
         if self._error is None:
             self._error = err
         with self._lock:
             self._inflight -= 1
+            self._resolved += failed
 
     def _probe(self, idx: int, dev, params, states) -> None:
         """Reinstatement probe: dispatch a known-good single-row program

@@ -1,0 +1,36 @@
+"""Horizontal serving tier: router, fleet, SLO-aware admission.
+
+PRs 3–5 built ONE fault-tolerant serving engine; this package is the
+tier above it — the difference between "a serving engine" and "a
+serving system" (ROADMAP item 5): an
+:class:`~deeplearning4j_tpu.serving.router.InferenceRouter` dispatches
+over a fleet of engine endpoints (in-process
+:class:`~deeplearning4j_tpu.serving.endpoint.LocalEndpoint` or
+broker-reached :class:`~deeplearning4j_tpu.serving.endpoint.
+RemoteEndpoint` / :class:`~deeplearning4j_tpu.serving.worker.
+EngineWorker` pairs), with heartbeat health, outlier ejection +
+half-open reinstatement, failover/hedging, deadline-aware admission
+control (:class:`~deeplearning4j_tpu.serving.router.RetryAfter`
+sheds), decode session affinity, and
+:class:`~deeplearning4j_tpu.serving.policy.ScalePolicy`-driven
+autoscaling applied by :class:`~deeplearning4j_tpu.serving.fleet.
+LocalFleet`.
+"""
+
+from deeplearning4j_tpu.serving.endpoint import (  # noqa: F401
+    EndpointError,
+    EndpointTimeout,
+    EngineEndpoint,
+    LocalEndpoint,
+    RemoteEndpoint,
+)
+from deeplearning4j_tpu.serving.fleet import LocalFleet  # noqa: F401
+from deeplearning4j_tpu.serving.policy import (  # noqa: F401
+    ScaleDecision,
+    ScalePolicy,
+)
+from deeplearning4j_tpu.serving.router import (  # noqa: F401
+    InferenceRouter,
+    RetryAfter,
+)
+from deeplearning4j_tpu.serving.worker import EngineWorker  # noqa: F401

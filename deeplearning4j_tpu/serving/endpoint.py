@@ -1,0 +1,304 @@
+"""Engine endpoints — the units the router dispatches over.
+
+An :class:`EngineEndpoint` is one serving engine the
+:class:`~deeplearning4j_tpu.serving.router.InferenceRouter` can send
+classify / generate requests to:
+
+- :class:`LocalEndpoint` wraps an in-process
+  :class:`~deeplearning4j_tpu.parallel.inference.ParallelInference`
+  (stats are live, liveness is trivially the engine being up);
+- :class:`RemoteEndpoint` reaches an engine process behind a
+  ``MessageBroker`` request/reply channel (``serving/wire.py`` frames
+  with correlation ids) and tracks health from its heartbeat stream —
+  a worker that stops heartbeating is *dead*, positively, without a
+  single request having to time out first.
+
+Both expose the same surface: ``submit`` / ``submit_generate``
+returning Futures, ``stats()`` (latest engine snapshot), ``alive()``
+and ``last_seen`` for the health plane. Remote futures that outlive
+``request_timeout_s`` fail with :class:`EndpointTimeout` — the router
+treats that exactly like an endpoint error and fails over, so a killed
+engine process never strands a caller's future.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving import wire
+from deeplearning4j_tpu.streaming.broker import MessageBroker
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class EndpointError(RuntimeError):
+    """A request failed on the endpoint (engine error reply, transport
+    death, or endpoint shutdown)."""
+
+
+class EndpointTimeout(EndpointError):
+    """No reply within the endpoint's ``request_timeout_s`` — the
+    worker is gone or wedged; the router fails the request over."""
+
+
+class EngineEndpoint:
+    """SPI one serving engine presents to the router."""
+
+    name: str
+
+    def submit(self, x: np.ndarray,
+               timeout_s: Optional[float] = None) -> "Future[np.ndarray]":
+        raise NotImplementedError
+
+    def submit_generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                        timeout_s: Optional[float] = None,
+                        **kwargs) -> "Future[np.ndarray]":
+        raise NotImplementedError
+
+    def stats(self) -> Dict[str, Any]:
+        """Latest known engine ``stats()`` snapshot (may be stale for a
+        remote endpoint — ``last_seen`` dates it)."""
+        raise NotImplementedError
+
+    def alive(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def last_seen(self) -> float:
+        """Monotonic timestamp of the endpoint's last proof of life."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LocalEndpoint(EngineEndpoint):
+    """An in-process ``ParallelInference`` as a fleet endpoint."""
+
+    def __init__(self, engine, name: str = "local"):
+        self.engine = engine
+        self.name = name
+
+    def submit(self, x, timeout_s=None):
+        return self.engine.submit(x)
+
+    def submit_generate(self, prompt_ids, max_new_tokens,
+                        timeout_s=None, **kwargs):
+        return self.engine.submit_generate(prompt_ids, max_new_tokens,
+                                           **kwargs)
+
+    def stats(self):
+        return self.engine.stats()
+
+    def alive(self):
+        return not self.engine._closed
+
+    @property
+    def last_seen(self) -> float:
+        return time.monotonic()  # in-process: always fresh
+
+    def close(self):
+        self.engine.shutdown()
+
+
+class _Pending:
+    __slots__ = ("future", "deadline")
+
+    def __init__(self, future: Future, deadline: float):
+        self.future = future
+        self.deadline = deadline
+
+
+class RemoteEndpoint(EngineEndpoint):
+    """A broker-reached engine worker as a fleet endpoint.
+
+    ``broker`` carries this endpoint's publishes; the reply and
+    heartbeat consumers each get their own connection via
+    ``broker_factory`` when given (recommended for ``TcpBroker``, whose
+    long-poll holds the connection lock), else they share ``broker``
+    (fine for ``InMemoryBroker``).
+
+    The reply consumer matches replies to futures by correlation id
+    and sweeps expired entries every poll — a pending future ALWAYS
+    resolves: with the reply, with the worker's error, or with
+    :class:`EndpointTimeout` after ``request_timeout_s``.
+    """
+
+    def __init__(self, broker: MessageBroker, service: str,
+                 name: Optional[str] = None,
+                 broker_factory=None,
+                 request_timeout_s: float = 10.0,
+                 heartbeat_timeout_s: float = 2.0,
+                 poll_s: float = 0.05):
+        self.name = name or service
+        self.service = service
+        self.request_timeout = float(request_timeout_s)
+        self.heartbeat_timeout = float(heartbeat_timeout_s)
+        self._poll = float(poll_s)
+        self._broker = broker
+        self._reply_broker = broker_factory() if broker_factory else broker
+        self._hb_broker = broker_factory() if broker_factory else broker
+        self.reply_topic = f"{service}.rsp.{uuid.uuid4().hex[:12]}"
+        self._pending: Dict[str, _Pending] = {}
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._closed = False
+        self._hb: Dict[str, Any] = {}
+        self._hb_at: Optional[float] = None
+        self._threads = [
+            threading.Thread(target=self._reply_loop, daemon=True,
+                             name=f"dl4j-tpu-ep-{self.name}-rsp"),
+            threading.Thread(target=self._hb_loop, daemon=True,
+                             name=f"dl4j-tpu-ep-{self.name}-hb"),
+        ]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------ submit
+
+    def _submit_frame(self, kind: str, x: np.ndarray,
+                      gen: Optional[Dict[str, Any]],
+                      timeout_s: Optional[float]) -> "Future[np.ndarray]":
+        if self._closed:
+            raise EndpointError(f"endpoint {self.name} is closed")
+        corr = f"{self.name}-{next(self._ids)}"
+        fut: "Future[np.ndarray]" = Future()
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self.request_timeout)
+        with self._lock:
+            self._pending[corr] = _Pending(fut, deadline)
+        try:
+            self._broker.publish(
+                self.service + wire.REQ_SUFFIX,
+                wire.pack_request(corr, self.reply_topic, kind, x, gen))
+        except BaseException as e:
+            with self._lock:
+                self._pending.pop(corr, None)
+            fut.set_exception(EndpointError(
+                f"publish to {self.name} failed: {type(e).__name__}: {e}"))
+        return fut
+
+    def submit(self, x, timeout_s=None):
+        return self._submit_frame(wire.KIND_CLASSIFY, np.asarray(x), None,
+                                  timeout_s)
+
+    def submit_generate(self, prompt_ids, max_new_tokens, timeout_s=None,
+                        temperature: float = 0.0, top_k: int = 0,
+                        top_p: float = 0.0, eos_token: Optional[int] = None,
+                        seed: int = 0):
+        gen = {"max_new": int(max_new_tokens), "temperature": temperature,
+               "top_k": top_k, "top_p": top_p, "eos_token": eos_token,
+               "seed": seed}
+        return self._submit_frame(wire.KIND_GENERATE,
+                                  np.asarray(prompt_ids), gen, timeout_s)
+
+    # ----------------------------------------------------------- health
+
+    def stats(self):
+        with self._lock:
+            return dict(self._hb.get("stats") or {})
+
+    def state(self) -> Optional[str]:
+        with self._lock:
+            return self._hb.get("state")
+
+    def alive(self) -> bool:
+        with self._lock:
+            hb_at, state = self._hb_at, self._hb.get("state")
+        if hb_at is None or state == wire.STATE_STOPPED:
+            return False
+        return time.monotonic() - hb_at < self.heartbeat_timeout
+
+    @property
+    def last_seen(self) -> float:
+        with self._lock:
+            return self._hb_at if self._hb_at is not None else float("-inf")
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # ------------------------------------------------------------ loops
+
+    def _reply_loop(self):
+        while not self._closed:
+            try:
+                msg = self._reply_broker.consume(self.reply_topic,
+                                                 timeout=self._poll)
+            except BaseException:
+                if self._closed:
+                    return
+                msg = None
+            if msg is not None:
+                try:
+                    header, result = wire.unpack_reply(msg)
+                except Exception as e:
+                    logger.warning("endpoint %s: undecodable reply (%s)",
+                                   self.name, e)
+                    continue
+                with self._lock:
+                    p = self._pending.pop(header.get("id"), None)
+                    if p is not None:
+                        self._hb_at = time.monotonic()  # proof of life
+                if p is not None and not p.future.done():
+                    if header.get("ok"):
+                        p.future.set_result(result)
+                    else:
+                        p.future.set_exception(EndpointError(
+                            f"{self.name}: {header.get('error')}"))
+            self._sweep_expired()
+
+    def _sweep_expired(self):
+        now = time.monotonic()
+        expired = []
+        with self._lock:
+            for corr, p in list(self._pending.items()):
+                if now >= p.deadline:
+                    expired.append(self._pending.pop(corr))
+        for p in expired:
+            if not p.future.done():
+                p.future.set_exception(EndpointTimeout(
+                    f"no reply from {self.name} within "
+                    f"{self.request_timeout}s"))
+
+    def _hb_loop(self):
+        topic = self.service + wire.HB_SUFFIX
+        while not self._closed:
+            try:
+                msg = self._hb_broker.consume(topic, timeout=self._poll)
+            except BaseException:
+                if self._closed:
+                    return
+                msg = None
+            if msg is None:
+                continue
+            try:
+                hb = wire.unpack_heartbeat(msg)
+            except Exception:
+                continue
+            with self._lock:
+                # seq guards against out-of-order delivery after a
+                # worker restart resets the counter: accept resets too
+                if (not self._hb or hb.get("seq", 0) >= self._hb.get("seq", 0)
+                        or hb.get("state") == wire.STATE_SERVING):
+                    self._hb = hb
+                self._hb_at = time.monotonic()
+
+    def close(self):
+        self._closed = True
+        err = EndpointError(f"endpoint {self.name} closed")
+        with self._lock:
+            pending, self._pending = list(self._pending.values()), {}
+        for p in pending:
+            if not p.future.done():
+                p.future.set_exception(err)
+        for t in self._threads:
+            t.join(timeout=2)

@@ -1,0 +1,266 @@
+"""LocalFleet — spawn, kill, restart and autoscale engine endpoints.
+
+The fleet manager the tests and the ``router_slo`` bench drive: it
+owns a broker, spawns engine workers (each with its OWN
+``ParallelInference`` engine from ``engine_factory``), wires a
+``RemoteEndpoint`` per worker, and applies
+:class:`~deeplearning4j_tpu.serving.policy.ScalePolicy` decisions.
+
+Endpoint modes:
+
+- ``mode="thread"`` (default): workers run on daemon threads in this
+  process, reached through the SAME broker wire protocol remote
+  workers use. ``kill()`` stops a worker abruptly — no replies, no
+  heartbeats, requests already consumed vanish — which is exactly the
+  wire signature of SIGKILL on an engine process, while staying
+  deterministic and safe on this box (the conftest notes:
+  fork-after-jax segfaults, so tier-1 tests must not spawn compute
+  subprocesses).
+- ``mode="process"``: workers are real OS processes
+  (``python -m deeplearning4j_tpu.serving.procworker``) reached over a
+  ``TcpBrokerServer``; ``kill()`` is SIGKILL. The model is shipped as
+  a zip via ``util/model_serializer``. For benches/deployments — not
+  used by tier-1 tests.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.serving.endpoint import RemoteEndpoint
+from deeplearning4j_tpu.serving.policy import ScaleDecision, ScalePolicy
+from deeplearning4j_tpu.serving.worker import EngineWorker
+from deeplearning4j_tpu.streaming.broker import (InMemoryBroker,
+                                                 MessageBroker, TcpBroker,
+                                                 TcpBrokerServer)
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class _Member:
+    """One fleet slot: endpoint + however it is backed."""
+
+    def __init__(self, name: str, endpoint: RemoteEndpoint,
+                 worker: Optional[EngineWorker] = None,
+                 proc: Optional[subprocess.Popen] = None):
+        self.name = name
+        self.endpoint = endpoint
+        self.worker = worker
+        self.proc = proc
+
+
+class LocalFleet:
+    """Manage a fleet of engine endpoints behind one broker.
+
+    ``engine_factory()`` must return a fresh started
+    ``ParallelInference`` (thread mode). ``router=`` (optional) keeps
+    an :class:`InferenceRouter` membership in sync with the fleet.
+    """
+
+    def __init__(self, engine_factory: Optional[Callable] = None,
+                 mode: str = "thread",
+                 service_prefix: str = "engine",
+                 router=None,
+                 heartbeat_s: float = 0.1,
+                 request_timeout_s: float = 5.0,
+                 heartbeat_timeout_s: float = 1.0,
+                 model_path: Optional[str] = None,
+                 procworker_args: Optional[List[str]] = None):
+        if mode not in ("thread", "process"):
+            raise ValueError(f"mode must be thread|process, got {mode!r}")
+        if mode == "thread" and engine_factory is None:
+            raise ValueError("thread mode needs engine_factory")
+        if mode == "process" and model_path is None:
+            raise ValueError("process mode needs model_path")
+        self.mode = mode
+        self.engine_factory = engine_factory
+        self.service_prefix = service_prefix
+        self.router = router
+        self.heartbeat_s = float(heartbeat_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.model_path = model_path
+        self.procworker_args = list(procworker_args or [])
+        self._members: Dict[str, _Member] = {}
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self._server: Optional[TcpBrokerServer] = None
+        if mode == "process":
+            self._server = TcpBrokerServer().start()
+            self._broker: MessageBroker = self._connect()
+        else:
+            self._broker = InMemoryBroker()
+
+    def _connect(self) -> MessageBroker:
+        if self._server is not None:
+            host, port = self._server.address
+            return TcpBroker(host, port)
+        return self._broker
+
+    # --------------------------------------------------------- members
+
+    def add_endpoint(self, name: Optional[str] = None) -> RemoteEndpoint:
+        name = name or f"{self.service_prefix}-{next(self._ids)}"
+        service = name
+        if self.mode == "thread":
+            engine = self.engine_factory()
+            worker = EngineWorker(engine, self._broker, service, name=name,
+                                  heartbeat_s=self.heartbeat_s)
+            proc = None
+        else:
+            worker = None
+            host, port = self._server.address
+            proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 "deeplearning4j_tpu.serving.procworker",
+                 "--broker", f"{host}:{port}", "--service", service,
+                 "--model", self.model_path,
+                 "--heartbeat-s", str(self.heartbeat_s),
+                 *self.procworker_args])
+        factory = (self._connect if self._server is not None else None)
+        endpoint = RemoteEndpoint(
+            self._connect(), service, name=name, broker_factory=factory,
+            request_timeout_s=self.request_timeout_s,
+            heartbeat_timeout_s=self.heartbeat_timeout_s)
+        with self._lock:
+            self._members[name] = _Member(name, endpoint, worker, proc)
+        if self.router is not None:
+            self.router.add_endpoint(endpoint)
+        return endpoint
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def endpoint(self, name: str) -> RemoteEndpoint:
+        with self._lock:
+            return self._members[name].endpoint
+
+    def wait_ready(self, timeout: float = 10.0) -> bool:
+        """Block until every member heartbeats alive (bounded)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                members = list(self._members.values())
+            if members and all(m.endpoint.alive() for m in members):
+                return True
+            time.sleep(5e-3)
+        return False
+
+    # ------------------------------------------------------ fault seams
+
+    def kill(self, name: str) -> None:
+        """Abrupt endpoint death (the faultinject process-kill seam):
+        thread mode stops the worker without replies or heartbeats;
+        process mode SIGKILLs. The endpoint object stays registered —
+        the router observes the death through missed heartbeats and
+        reply timeouts, exactly as it would a remote host loss."""
+        with self._lock:
+            m = self._members[name]
+        if m.worker is not None:
+            m.worker.kill()
+            try:  # the process's engine dies with it
+                m.worker.engine.shutdown(drain=False)
+            except BaseException:
+                pass
+        if m.proc is not None:
+            m.proc.send_signal(signal.SIGKILL)
+            m.proc.wait(timeout=10)
+        logger.info("fleet: killed %s", name)
+
+    def restart(self, name: str) -> None:
+        """Bring a killed member back on the SAME service topics (the
+        endpoint reconnects through its existing consumer threads)."""
+        with self._lock:
+            m = self._members[name]
+        if self.mode == "thread":
+            if m.worker is not None and not m.worker._killed.is_set():
+                m.worker.kill()
+            engine = self.engine_factory()
+            m.worker = EngineWorker(engine, self._broker, name, name=name,
+                                    heartbeat_s=self.heartbeat_s)
+        else:
+            host, port = self._server.address
+            m.proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 "deeplearning4j_tpu.serving.procworker",
+                 "--broker", f"{host}:{port}", "--service", name,
+                 "--model", self.model_path,
+                 "--heartbeat-s", str(self.heartbeat_s),
+                 *self.procworker_args])
+        logger.info("fleet: restarted %s", name)
+
+    def remove_endpoint(self, name: str,
+                        drain_timeout: float = 30.0) -> None:
+        """Planned scale-down: drain, stop, deregister — zero lost
+        requests."""
+        with self._lock:
+            m = self._members.pop(name)
+        if self.router is not None:
+            self.router.remove_endpoint(name)
+        if m.worker is not None:
+            m.worker.drain_and_stop(timeout=drain_timeout)
+        if m.proc is not None:
+            m.proc.terminate()  # procworker drains on SIGTERM
+            try:
+                m.proc.wait(timeout=drain_timeout)
+            except subprocess.TimeoutExpired:
+                m.proc.kill()
+                m.proc.wait(timeout=10)
+        m.endpoint.close()
+
+    # -------------------------------------------------------- autoscale
+
+    def apply(self, decisions: List[ScaleDecision]) -> List[str]:
+        """Apply ScalePolicy decisions; returns a log of actions."""
+        log = []
+        for d in decisions:
+            if d.action == "add":
+                ep = self.add_endpoint()
+                log.append(f"add {ep.name}: {d.reason}")
+            elif d.action == "remove" and d.endpoint in self._members:
+                self.remove_endpoint(d.endpoint)
+                log.append(f"remove {d.endpoint}: {d.reason}")
+        return log
+
+    def autoscale(self, policy: ScalePolicy,
+                  now: Optional[float] = None) -> List[str]:
+        """One policy step against the live router snapshot."""
+        if self.router is None:
+            raise RuntimeError("autoscale needs a router")
+        snap = self.router.fleet_snapshot()
+        return self.apply(policy.decide(
+            snap, time.monotonic() if now is None else now))
+
+    # -------------------------------------------------------- lifecycle
+
+    def shutdown(self, drain: bool = True) -> None:
+        for name in self.names():
+            try:
+                if drain:
+                    self.remove_endpoint(name, drain_timeout=10.0)
+                else:
+                    self.kill(name)
+                    with self._lock:
+                        m = self._members.pop(name, None)
+                    if m is not None:
+                        m.endpoint.close()
+            except KeyError:
+                pass
+        if self._server is not None:
+            self._server.stop()
+
+    def __enter__(self) -> "LocalFleet":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.shutdown(drain=exc_type is None)

@@ -1,0 +1,93 @@
+"""ScalePolicy — queue-depth / p99-driven fleet sizing decisions.
+
+The router only *observes* load; this policy turns its
+``fleet_snapshot()`` into explicit ``add`` / ``remove`` endpoint
+decisions a fleet manager (``LocalFleet`` here, a k8s operator in a
+real deployment) applies. Decisions are pure functions of the
+snapshot + the policy's own hysteresis state, and time is an explicit
+argument — the same snapshot sequence always yields the same decision
+sequence, so autoscaling is unit-testable without a clock.
+
+Scale-up triggers on EITHER signal (queue backlog per healthy endpoint
+above ``target_queue_per_endpoint``, or p99 above ``p99_high_ms``);
+scale-down only when BOTH are comfortably low (backlog under
+``queue_low`` per endpoint and p99 under half the high-water mark) —
+the asymmetry is deliberate: adding capacity late costs SLO, removing
+it late costs only money. ``cooldown_s`` gates consecutive decisions
+so one burst cannot flap the fleet.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional
+
+
+class ScaleDecision(NamedTuple):
+    action: str               # "add" | "remove"
+    endpoint: Optional[str]   # which to remove (None for add)
+    reason: str
+
+
+class ScalePolicy:
+    def __init__(self, min_endpoints: int = 1, max_endpoints: int = 8,
+                 target_queue_per_endpoint: float = 4.0,
+                 queue_low: float = 0.5,
+                 p99_high_ms: Optional[float] = None,
+                 cooldown_s: float = 5.0):
+        self.min_endpoints = int(min_endpoints)
+        self.max_endpoints = int(max_endpoints)
+        self.target_queue = float(target_queue_per_endpoint)
+        self.queue_low = float(queue_low)
+        self.p99_high_ms = p99_high_ms
+        self.cooldown_s = float(cooldown_s)
+        self._last_decision_at: Optional[float] = None
+
+    def decide(self, snapshot: Dict[str, Any],
+               now: float) -> List[ScaleDecision]:
+        """One add/remove decision (or none) from a router
+        ``fleet_snapshot()``. ``now`` is any monotonic clock the caller
+        owns — pass a counter in tests for full determinism."""
+        if self._last_decision_at is not None and \
+                now - self._last_decision_at < self.cooldown_s:
+            return []
+        healthy = max(0, int(snapshot.get("healthy_endpoints", 0)))
+        total = int(snapshot.get("total_endpoints", 0))
+        backlog = float(snapshot.get("queue_depth", 0.0))
+        p99 = snapshot.get("p99_ms")
+        per_ep = backlog / healthy if healthy else float("inf")
+        decisions: List[ScaleDecision] = []
+        if total < self.min_endpoints:
+            decisions.append(ScaleDecision(
+                "add", None, f"below min_endpoints ({total} < "
+                f"{self.min_endpoints})"))
+        elif total < self.max_endpoints and (
+                per_ep > self.target_queue
+                or (self.p99_high_ms is not None and p99 is not None
+                    and p99 > self.p99_high_ms)):
+            decisions.append(ScaleDecision(
+                "add", None,
+                f"backlog/endpoint {per_ep:.1f} > {self.target_queue} "
+                f"or p99 {p99} > {self.p99_high_ms}"))
+        elif total > self.min_endpoints and healthy == total and \
+                per_ep < self.queue_low and (
+                    self.p99_high_ms is None or p99 is None
+                    or p99 < self.p99_high_ms / 2):
+            victim = self._pick_victim(snapshot)
+            if victim is not None:
+                decisions.append(ScaleDecision(
+                    "remove", victim,
+                    f"backlog/endpoint {per_ep:.2f} < {self.queue_low}"))
+        if decisions:
+            self._last_decision_at = now
+        return decisions
+
+    @staticmethod
+    def _pick_victim(snapshot: Dict[str, Any]) -> Optional[str]:
+        """Least-loaded endpoint with no pinned sessions preferred;
+        stable name order for determinism."""
+        eps = snapshot.get("endpoints") or {}
+        candidates = sorted(
+            (info.get("inflight", 0),
+             float(info.get("stats", {}).get("queue_depth", 0) or 0), name)
+            for name, info in eps.items() if info.get("in_pool"))
+        return candidates[0][2] if candidates else None

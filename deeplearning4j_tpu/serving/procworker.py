@@ -1,0 +1,65 @@
+"""Process-mode engine worker: ``python -m
+deeplearning4j_tpu.serving.procworker --broker host:port --service s
+--model model.zip``.
+
+One OS process = one fleet endpoint: load the model zip, build a
+``ParallelInference`` engine, optionally AOT-warm it, and serve the
+broker request channel until SIGTERM (drain, then exit 0) or SIGKILL
+(the failure mode the router's failover exists for). This is the
+deployment shape of :class:`~deeplearning4j_tpu.serving.worker.
+EngineWorker`; ``LocalFleet(mode="process")`` spawns it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--broker", required=True, help="host:port")
+    ap.add_argument("--service", required=True)
+    ap.add_argument("--model", required=True, help="model zip path")
+    ap.add_argument("--heartbeat-s", type=float, default=0.25)
+    ap.add_argument("--max-batch-size", type=int, default=32)
+    ap.add_argument("--max-latency-ms", type=float, default=5.0)
+    ap.add_argument("--replicas", type=int, default=None)
+    ap.add_argument("--warmup-shapes", default=None,
+                    help='JSON list of per-example shapes, e.g. "[[64]]"')
+    args = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving.worker import EngineWorker
+    from deeplearning4j_tpu.streaming.broker import TcpBroker
+    from deeplearning4j_tpu.util.model_serializer import restore_model
+
+    host, port = args.broker.rsplit(":", 1)
+    net = restore_model(args.model)
+    engine = ParallelInference(net, max_batch_size=args.max_batch_size,
+                               max_latency_ms=args.max_latency_ms,
+                               replicas=args.replicas)
+    if args.warmup_shapes:
+        engine.warmup([tuple(s) for s in json.loads(args.warmup_shapes)])
+    worker = EngineWorker(engine, TcpBroker(host, int(port)), args.service,
+                          reply_broker=TcpBroker(host, int(port)),
+                          hb_broker=TcpBroker(host, int(port)),
+                          heartbeat_s=args.heartbeat_s)
+
+    done = threading.Event()
+
+    def _term(signum, frame):
+        worker.drain_and_stop(timeout=30.0)
+        done.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    done.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,552 @@
+"""InferenceRouter — the SLO-aware front end of the serving fleet.
+
+The engine (PR 3/4/5) made ONE process serve well; this tier makes a
+FLEET survivable, the Clipper (NSDI '17) layered-serving shape: a
+front-end router dispatches classify / generate requests over N engine
+endpoints (in-process or broker-reached) and owns the robustness plane
+the engine cannot see from inside one process:
+
+- **Health**: per-endpoint state from heartbeats + ``engine.stats()``.
+  An endpoint is in the dispatch pool only while alive and not
+  ejected; ``dl4j_router_endpoint_healthy{endpoint=...}`` mirrors it.
+- **Outlier ejection** with backoff-probed reinstatement: repeated
+  failures eject the endpoint for ``eject_backoff_s * 2**n``; after
+  the backoff it turns *half-open* — the next request is routed to it
+  as the probe, success reinstates, failure re-ejects with a doubled
+  backoff. ``probe_now()`` collapses the wait for deterministic tests.
+- **Failover + hedging**: a failed or timed-out dispatch retries on a
+  different endpoint (bounded attempts, the request's Future never
+  strands); a request still unresolved after ``hedge_after_ms`` sends
+  ONE duplicate to a second endpoint and the first reply wins — the
+  tail-latency discipline. Hedges are skipped for session-pinned
+  requests (their KV state lives on one endpoint).
+- **Deadline-aware admission control** (the Orca lesson: admission
+  must be deadline-aware, not FIFO): each request carries a priority
+  class and optional deadline; the router estimates completion time
+  from live endpoint telemetry (queue depth / healthy replicas ×
+  an EWMA of observed service time) and **sheds with**
+  :class:`RetryAfter` any request that cannot meet its deadline —
+  rejecting beats queueing past the SLO. Lower priority classes shed
+  earlier (their estimate must fit a smaller fraction of the
+  deadline).
+- **Session affinity**: ``session=`` pins a multi-burst decode stream
+  to the endpoint holding its KV state; the pin survives until that
+  endpoint leaves the pool, then the session re-pins on first use.
+- **Autoscale signals**: ``fleet_snapshot()`` feeds
+  :class:`~deeplearning4j_tpu.serving.policy.ScalePolicy` (queue-depth
+  and p99 driven add/remove-endpoint decisions).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.monitor import (
+    ROUTER_ENDPOINT_HEALTHY_GAUGE,
+    ROUTER_FAILOVERS_COUNTER,
+    ROUTER_HEDGES_COUNTER,
+    ROUTER_LATENCY_HISTOGRAM,
+    ROUTER_QUEUE_WAIT_HISTOGRAM,
+    ROUTER_REQUESTS_COUNTER,
+    ROUTER_SHED_COUNTER,
+    get_registry,
+    mark,
+    record_fault,
+)
+from deeplearning4j_tpu.serving.endpoint import EndpointError, EngineEndpoint
+
+#: priority class → fraction of the deadline the completion estimate
+#: may consume before the request is shed. Interactive requests use
+#: the whole deadline; batch and best-effort shed earlier, so under
+#: pressure the low classes drain first and the SLO class keeps its
+#: headroom (the admission half of priority scheduling — no
+#: in-router reordering needed when rejection is this cheap).
+PRIORITY_HEADROOM: Dict[str, float] = {
+    "interactive": 1.0,
+    "batch": 0.7,
+    "best_effort": 0.4,
+}
+
+
+class RetryAfter(RuntimeError):
+    """Admission control rejected the request: it cannot meet its
+    deadline (or no endpoint is available). ``retry_after_s`` is the
+    router's estimate of when capacity frees up — the HTTP
+    Retry-After discipline, surfaced as data so any transport can
+    relay it."""
+
+    def __init__(self, msg: str, retry_after_s: float):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class _EndpointState:
+    """Router-side bookkeeping for one endpoint."""
+
+    __slots__ = ("endpoint", "consecutive_failures", "ejections",
+                 "ejected_until", "in_trial", "ewma_ms", "inflight",
+                 "requests", "failures")
+
+    def __init__(self, endpoint: EngineEndpoint):
+        self.endpoint = endpoint
+        self.consecutive_failures = 0
+        self.ejections = 0
+        self.ejected_until = 0.0  # monotonic; 0 = not ejected
+        self.in_trial = False     # half-open probe outstanding
+        self.ewma_ms: Optional[float] = None
+        self.inflight = 0         # router-dispatched, unresolved
+        self.requests = 0
+        self.failures = 0
+
+
+class _Routed:
+    """One router request across its (possibly several) dispatches."""
+
+    __slots__ = ("future", "kind", "x", "gen", "deadline", "t0", "tried",
+                 "attempts", "outstanding", "lock", "hedged", "session",
+                 "priority", "timer", "per_try_timeout")
+
+    def __init__(self, kind: str, x, gen, deadline: Optional[float],
+                 priority: str, session: Optional[str],
+                 per_try_timeout: Optional[float]):
+        self.future: "Future[np.ndarray]" = Future()
+        self.kind = kind
+        self.x = x
+        self.gen = gen
+        self.deadline = deadline    # monotonic, None = no deadline
+        self.t0 = time.perf_counter()
+        self.tried: set = set()
+        self.attempts = 0
+        self.outstanding = 0
+        self.lock = threading.Lock()
+        self.hedged = False
+        self.session = session
+        self.priority = priority
+        self.timer: Optional[threading.Timer] = None
+        self.per_try_timeout = per_try_timeout
+
+
+class InferenceRouter:
+    """Dispatch classify/generate requests over a fleet of endpoints.
+
+    Knobs: ``max_attempts`` bounds dispatches per request (first try +
+    failovers + the hedge); ``eject_threshold`` consecutive failures
+    eject an endpoint for ``eject_backoff_s`` (doubling per ejection,
+    capped at ``eject_backoff_max_s``); ``hedge_after_ms`` arms the
+    tail-latency duplicate (0 disables); ``heartbeat_timeout_s`` is
+    how stale an endpoint's proof-of-life may grow before it leaves
+    the pool; ``default_deadline_ms`` applies per priority class when
+    a request names none (None = no deadline)."""
+
+    def __init__(self, endpoints: Optional[List[EngineEndpoint]] = None,
+                 max_attempts: int = 3,
+                 eject_threshold: int = 2,
+                 eject_backoff_s: float = 0.5,
+                 eject_backoff_max_s: float = 30.0,
+                 hedge_after_ms: float = 0.0,
+                 per_try_timeout_s: Optional[float] = None,
+                 default_deadline_ms: Optional[Dict[str, float]] = None,
+                 ewma_alpha: float = 0.2):
+        self._eps: Dict[str, _EndpointState] = {}
+        self._lock = threading.Lock()
+        self._affinity: Dict[str, str] = {}
+        self.max_attempts = max(1, int(max_attempts))
+        self.eject_threshold = max(1, int(eject_threshold))
+        self.eject_backoff = float(eject_backoff_s)
+        self.eject_backoff_max = float(eject_backoff_max_s)
+        self.hedge_after = max(0.0, float(hedge_after_ms)) / 1e3
+        self.per_try_timeout = per_try_timeout_s
+        self.default_deadline_ms = dict(default_deadline_ms or {})
+        self.ewma_alpha = float(ewma_alpha)
+        self._closed = False
+        for ep in endpoints or []:
+            self.add_endpoint(ep)
+
+    # -------------------------------------------------------- membership
+
+    def add_endpoint(self, endpoint: EngineEndpoint) -> None:
+        with self._lock:
+            if endpoint.name in self._eps:
+                raise ValueError(f"duplicate endpoint {endpoint.name!r}")
+            self._eps[endpoint.name] = _EndpointState(endpoint)
+        self._health_gauge(endpoint.name).set(1.0)
+        mark("router_endpoint_added", endpoint=endpoint.name)
+
+    def remove_endpoint(self, name: str) -> Optional[EngineEndpoint]:
+        with self._lock:
+            st = self._eps.pop(name, None)
+            self._affinity = {s: n for s, n in self._affinity.items()
+                              if n != name}
+        if st is None:
+            return None
+        self._health_gauge(name).set(0.0)
+        mark("router_endpoint_removed", endpoint=name)
+        return st.endpoint
+
+    def endpoints(self) -> List[str]:
+        with self._lock:
+            return sorted(self._eps)
+
+    # ----------------------------------------------------------- metrics
+
+    def _reg(self):
+        return get_registry()
+
+    def _health_gauge(self, name: str):
+        return self._reg().gauge(
+            ROUTER_ENDPOINT_HEALTHY_GAUGE,
+            "Endpoint in the router dispatch pool (1) or ejected/dead (0)",
+            endpoint=name)
+
+    # ------------------------------------------------------------ health
+
+    def _pool(self, now: float) -> List[_EndpointState]:
+        """Dispatchable endpoints: alive, and either not ejected or
+        half-open (backoff elapsed, no trial outstanding yet)."""
+        out = []
+        for st in self._eps.values():
+            if not st.endpoint.alive():
+                continue
+            if st.ejected_until > now and st.consecutive_failures:
+                continue  # still serving out its ejection backoff
+            out.append(st)
+        return out
+
+    def _note_success(self, st: _EndpointState, latency_ms: float) -> None:
+        with self._lock:
+            st.inflight = max(0, st.inflight - 1)
+            was_ejected = st.consecutive_failures >= self.eject_threshold
+            st.consecutive_failures = 0
+            st.in_trial = False
+            st.ejected_until = 0.0
+            st.ewma_ms = (latency_ms if st.ewma_ms is None else
+                          (1 - self.ewma_alpha) * st.ewma_ms
+                          + self.ewma_alpha * latency_ms)
+        self._health_gauge(st.endpoint.name).set(1.0)
+        if was_ejected:
+            mark("router_endpoint_reinstated", endpoint=st.endpoint.name)
+
+    def _note_failure(self, st: _EndpointState) -> None:
+        with self._lock:
+            st.inflight = max(0, st.inflight - 1)
+            st.failures += 1
+            st.consecutive_failures += 1
+            st.in_trial = False
+            ejected = st.consecutive_failures >= self.eject_threshold
+            if ejected:
+                backoff = min(self.eject_backoff_max,
+                              self.eject_backoff * (2 ** st.ejections))
+                st.ejections += 1
+                st.ejected_until = time.monotonic() + backoff
+        record_fault("routing")
+        if ejected:
+            self._health_gauge(st.endpoint.name).set(0.0)
+            mark("router_endpoint_ejected", endpoint=st.endpoint.name,
+                 failures=st.consecutive_failures)
+
+    def probe_now(self) -> None:
+        """Collapse every ejection backoff: each ejected endpoint turns
+        half-open immediately (its next request is the reinstatement
+        probe) — the deterministic seam tests and operators use."""
+        with self._lock:
+            for st in self._eps.values():
+                st.ejected_until = 0.0
+                st.in_trial = False
+
+    # --------------------------------------------------------- admission
+
+    def _estimate_ms(self, st: _EndpointState) -> Tuple[float, float]:
+        """(queue_wait_ms, total_ms) completion estimate for one more
+        request on this endpoint, from its last stats snapshot and the
+        router's observed EWMA service time. Cold endpoints (no
+        latency observed yet) estimate 0 — admit optimistically and
+        let observation catch up."""
+        if st.ewma_ms is None:
+            return 0.0, 0.0
+        stats = st.endpoint.stats()
+        depth = float(stats.get("queue_depth", 0) or 0)
+        replicas = max(1.0, float(stats.get("healthy_replicas",
+                                            stats.get("replicas", 1)) or 1))
+        backlog = depth + st.inflight
+        wait = (backlog / replicas) * st.ewma_ms
+        return wait, wait + st.ewma_ms
+
+    def _admit(self, deadline_ms: Optional[float], priority: str,
+               session: Optional[str]) -> _EndpointState:
+        """Pick the endpoint AND make the shed decision against it.
+        Raises :class:`RetryAfter` when nothing can serve in time."""
+        now = time.monotonic()
+        pool = self._pool(now)
+        if not pool:
+            self._shed(priority, "no_endpoint")
+            raise RetryAfter("no endpoint available", self.eject_backoff)
+        # a half-open endpoint gets the next request as its probe
+        with self._lock:
+            trial = next((st for st in pool
+                          if st.consecutive_failures >= self.eject_threshold
+                          and not st.in_trial), None)
+        pick: Optional[_EndpointState] = None
+        if session is not None:
+            pinned = self._affinity.get(session)
+            if pinned is not None:
+                pick = next((st for st in pool
+                             if st.endpoint.name == pinned), None)
+        if pick is None and trial is not None:
+            pick = trial
+            with self._lock:
+                trial.in_trial = True
+        if pick is None:
+            # least estimated wait; stable name tie-break
+            pick = min(pool, key=lambda st: (self._estimate_ms(st)[0],
+                                             st.endpoint.name))
+        wait_ms, total_ms = self._estimate_ms(pick)
+        self._reg().histogram(
+            ROUTER_QUEUE_WAIT_HISTOGRAM,
+            "Estimated queue wait at admission time").observe(wait_ms)
+        if deadline_ms is not None:
+            headroom = PRIORITY_HEADROOM.get(priority, 1.0)
+            if total_ms > deadline_ms * headroom:
+                self._shed(priority, "deadline")
+                raise RetryAfter(
+                    f"estimated completion {total_ms:.1f}ms exceeds "
+                    f"deadline {deadline_ms:.1f}ms × {headroom} headroom "
+                    f"({priority})", max(1e-3, wait_ms / 1e3))
+        if session is not None:
+            self._affinity[session] = pick.endpoint.name
+        return pick
+
+    def _shed(self, priority: str, reason: str) -> None:
+        self._reg().counter(
+            ROUTER_SHED_COUNTER,
+            "Requests rejected by deadline admission control",
+            priority=priority, reason=reason).inc()
+        mark("router_shed", priority=priority, reason=reason)
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, x: np.ndarray, deadline_ms: Optional[float] = None,
+               priority: str = "interactive",
+               session: Optional[str] = None) -> "Future[np.ndarray]":
+        """Route one classify request (x: [n, ...features]); the Future
+        resolves to the [n, ...out] predictions, possibly after
+        failover/hedging, or raises :class:`RetryAfter` HERE (before a
+        Future exists) when admission sheds it."""
+        return self._route(np.asarray(x), None, "classify", deadline_ms,
+                           priority, session)
+
+    def submit_generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                        deadline_ms: Optional[float] = None,
+                        priority: str = "interactive",
+                        session: Optional[str] = None,
+                        **gen_kwargs) -> "Future[np.ndarray]":
+        """Route one decode request; ``session=`` keeps every burst of
+        a decode stream on the endpoint holding its KV state."""
+        gen = dict(gen_kwargs, max_new_tokens=int(max_new_tokens))
+        return self._route(np.asarray(prompt_ids), gen, "generate",
+                           deadline_ms, priority, session)
+
+    def output(self, x, timeout: Optional[float] = None, **kwargs):
+        return self.submit(x, **kwargs).result(timeout=timeout)
+
+    def generate(self, prompt_ids, max_new_tokens,
+                 timeout: Optional[float] = None, **kwargs):
+        return self.submit_generate(prompt_ids, max_new_tokens,
+                                    **kwargs).result(timeout=timeout)
+
+    def _route(self, x, gen, kind, deadline_ms, priority, session):
+        if self._closed:
+            raise RuntimeError("router is closed")
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms.get(priority)
+        self._reg().counter(
+            ROUTER_REQUESTS_COUNTER, "Requests routed",
+            priority=priority).inc()
+        st = self._admit(deadline_ms, priority, session)
+        rf = _Routed(kind, x, gen,
+                     None if deadline_ms is None
+                     else time.monotonic() + deadline_ms / 1e3,
+                     priority, session, self.per_try_timeout)
+        self._dispatch(rf, st)
+        if self.hedge_after > 0 and session is None and \
+                self.max_attempts > 1:
+            # candidate availability is checked when the timer FIRES —
+            # an endpoint added after dispatch is a valid hedge target
+            rf.timer = threading.Timer(self.hedge_after, self._hedge, (rf,))
+            rf.timer.daemon = True
+            rf.timer.start()
+        return rf.future
+
+    # --------------------------------------------------------- dispatch
+
+    def _dispatch(self, rf: _Routed, st: _EndpointState) -> None:
+        with rf.lock:
+            rf.attempts += 1
+            rf.outstanding += 1
+            rf.tried.add(st.endpoint.name)
+        with self._lock:
+            st.requests += 1
+            st.inflight += 1
+        t_disp = time.perf_counter()
+        try:
+            if rf.kind == "generate":
+                g = dict(rf.gen)
+                inner = st.endpoint.submit_generate(
+                    rf.x, g.pop("max_new_tokens"),
+                    timeout_s=rf.per_try_timeout, **g)
+            else:
+                inner = st.endpoint.submit(rf.x,
+                                           timeout_s=rf.per_try_timeout)
+        except BaseException as e:
+            # submit itself failed (endpoint closed/backpressure):
+            # resolve through the same failure path as a bad reply
+            inner = Future()
+            inner.set_exception(
+                e if isinstance(e, EndpointError) else EndpointError(str(e)))
+        inner.add_done_callback(
+            lambda f: self._on_done(rf, st, f, t_disp))
+
+    def _hedge(self, rf: _Routed) -> None:
+        """Tail-latency duplicate: one extra dispatch to an untried
+        endpoint when the primary is slow; first reply wins. The
+        duplicate is safe by construction — classify is pure, and a
+        duplicate's Future result is simply dropped (``set_result``
+        first-wins under ``rf.lock``)."""
+        with rf.lock:
+            if rf.future.done() or rf.hedged or \
+                    rf.attempts >= self.max_attempts:
+                return
+            rf.hedged = True
+            tried = set(rf.tried)
+        st = self._pick_excluding(tried)
+        if st is None:
+            return
+        self._reg().counter(
+            ROUTER_HEDGES_COUNTER,
+            "Hedged duplicate dispatches (tail-latency)").inc()
+        mark("router_hedge", endpoint=st.endpoint.name)
+        self._dispatch(rf, st)
+
+    def _pick_excluding(self, tried: set) -> Optional[_EndpointState]:
+        now = time.monotonic()
+        pool = [st for st in self._pool(now)
+                if st.endpoint.name not in tried]
+        if not pool:
+            return None
+        return min(pool, key=lambda st: (self._estimate_ms(st)[0],
+                                         st.endpoint.name))
+
+    def _on_done(self, rf: _Routed, st: _EndpointState, inner: Future,
+                 t_disp: float):
+        err = inner.exception()
+        if err is None:
+            now = time.perf_counter()
+            # the endpoint's EWMA tracks ITS dispatch→reply time only;
+            # attributing the full request latency would pollute a
+            # healthy endpoint's estimate with the timeout a dead
+            # sibling burned before the failover reached it
+            self._note_success(st, (now - t_disp) * 1e3)
+            with rf.lock:
+                rf.outstanding -= 1
+                won = not rf.future.done()
+                if won:
+                    rf.future.set_result(inner.result())
+            if won:
+                if rf.timer is not None:
+                    rf.timer.cancel()
+                self._reg().histogram(
+                    ROUTER_LATENCY_HISTOGRAM,
+                    "End-to-end submit→result latency through the "
+                    "router").observe((now - rf.t0) * 1e3)
+            return
+        # failure: endpoint bookkeeping, then failover if budget allows
+        self._note_failure(st)
+        retry_to: Optional[_EndpointState] = None
+        give_up = False
+        with rf.lock:
+            rf.outstanding -= 1
+            if rf.future.done():
+                return
+            expired = rf.deadline is not None and \
+                time.monotonic() >= rf.deadline
+            if rf.attempts < self.max_attempts and not expired:
+                retry_to = self._pick_excluding(rf.tried)
+            if retry_to is None and rf.outstanding == 0:
+                give_up = True
+        if retry_to is not None:
+            if rf.session is not None:
+                # the pinned endpoint failed: re-pin the session
+                self._affinity[rf.session] = retry_to.endpoint.name
+            self._reg().counter(
+                ROUTER_FAILOVERS_COUNTER,
+                "Requests re-dispatched to another endpoint after an "
+                "endpoint failure").inc()
+            mark("router_failover", frm=st.endpoint.name,
+                 to=retry_to.endpoint.name)
+            self._dispatch(rf, retry_to)
+        elif give_up:
+            if rf.timer is not None:
+                rf.timer.cancel()
+            if not rf.future.done():
+                rf.future.set_exception(err)
+
+    # ------------------------------------------------------------- state
+
+    def fleet_snapshot(self) -> Dict[str, Any]:
+        """Aggregated fleet state: what ``/healthz`` serves and what
+        :class:`ScalePolicy` consumes."""
+        now = time.monotonic()
+        eps = {}
+        with self._lock:
+            items = list(self._eps.items())
+        healthy = 0
+        queue_depth = 0.0
+        for name, st in items:
+            alive = st.endpoint.alive()
+            ejected = bool(st.ejected_until > now
+                           and st.consecutive_failures)
+            in_pool = alive and not ejected
+            healthy += in_pool
+            stats = st.endpoint.stats()
+            queue_depth += float(stats.get("queue_depth", 0) or 0)
+            last = st.endpoint.last_seen
+            eps[name] = {
+                "alive": alive,
+                "ejected": ejected,
+                "in_pool": in_pool,
+                "consecutive_failures": st.consecutive_failures,
+                "ejections": st.ejections,
+                "requests": st.requests,
+                "failures": st.failures,
+                "inflight": st.inflight,
+                "ewma_ms": (None if st.ewma_ms is None
+                            else round(st.ewma_ms, 3)),
+                "last_seen_age_s": (None if last == float("-inf")
+                                    else round(now - last, 3)),
+                "stats": stats,
+            }
+        reg = self._reg()
+        lat = reg.get(ROUTER_LATENCY_HISTOGRAM)
+        return {
+            "endpoints": eps,
+            "healthy_endpoints": healthy,
+            "total_endpoints": len(eps),
+            "degraded": healthy < len(eps) or healthy == 0,
+            "queue_depth": queue_depth,
+            "sessions": len(self._affinity),
+            "p99_ms": (None if lat is None or lat.count == 0
+                       else round(lat.percentile(0.99), 3)),
+            "shed": int(reg.family_total(ROUTER_SHED_COUNTER)),
+            "hedges": int(reg.family_total(ROUTER_HEDGES_COUNTER)),
+            "failovers": int(reg.family_total(ROUTER_FAILOVERS_COUNTER)),
+        }
+
+    def session_endpoint(self, session: str) -> Optional[str]:
+        return self._affinity.get(session)
+
+    def close(self) -> None:
+        self._closed = True

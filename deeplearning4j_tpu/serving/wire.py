@@ -1,0 +1,99 @@
+"""Request/reply + heartbeat wire format for the serving tier.
+
+The fleet speaks over any :class:`~deeplearning4j_tpu.streaming.broker.
+MessageBroker` (in-memory in tests, ``TcpBroker`` across hosts), so the
+router ↔ engine-worker channel is framed *inside* broker payloads:
+
+- request / reply: u32 big-endian header length + JSON header + binary
+  body (npz via ``streaming/serde.py`` — self-describing dtype+shape).
+  The header carries the correlation id (``id``), the caller's private
+  reply topic (``reply``), and the request kind (``classify`` /
+  ``generate`` with its sampler params). Correlation ids make the
+  channel safe for pipelining: replies may arrive out of order and the
+  endpoint matches them back to futures by id, never by position.
+- heartbeat: plain JSON — worker name, monotonically increasing
+  ``seq``, lifecycle ``state`` (serving / draining / stopped) and the
+  engine's ``stats()`` snapshot. The router's health plane consumes
+  these instead of inferring engine death from reply timeouts alone.
+
+Topic layout for a worker serving ``service``::
+
+    <service>.req          requests (worker consumes)
+    <service>.hb           heartbeats (router consumes)
+    <reply topic from the request header>   replies (router consumes;
+        one private topic per router/client, so N routers can share a
+        worker without stealing each other's replies)
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.streaming.serde import (ndarray_from_bytes,
+                                                ndarray_to_bytes)
+
+REQ_SUFFIX = ".req"
+HB_SUFFIX = ".hb"
+
+KIND_CLASSIFY = "classify"
+KIND_GENERATE = "generate"
+
+STATE_SERVING = "serving"
+STATE_DRAINING = "draining"
+STATE_STOPPED = "stopped"
+
+
+def pack_frame(header: Dict[str, Any], body: bytes = b"") -> bytes:
+    h = json.dumps(header, separators=(",", ":")).encode()
+    return struct.pack(">I", len(h)) + h + body
+
+
+def unpack_frame(payload: bytes) -> Tuple[Dict[str, Any], bytes]:
+    if len(payload) < 4:
+        raise ValueError(f"short frame ({len(payload)} bytes)")
+    (hlen,) = struct.unpack(">I", payload[:4])
+    if 4 + hlen > len(payload):
+        raise ValueError("header length exceeds frame")
+    header = json.loads(payload[4:4 + hlen])
+    return header, payload[4 + hlen:]
+
+
+def pack_request(corr_id: str, reply_topic: str, kind: str, x: np.ndarray,
+                 gen: Optional[Dict[str, Any]] = None) -> bytes:
+    header = {"id": corr_id, "reply": reply_topic, "kind": kind}
+    if gen is not None:
+        header["gen"] = gen
+    return pack_frame(header, ndarray_to_bytes(x))
+
+
+def unpack_request(payload: bytes) -> Tuple[Dict[str, Any], np.ndarray]:
+    header, body = unpack_frame(payload)
+    return header, ndarray_from_bytes(body)
+
+
+def pack_reply(corr_id: str, result: Optional[np.ndarray] = None,
+               error: Optional[str] = None) -> bytes:
+    if error is not None:
+        return pack_frame({"id": corr_id, "ok": False, "error": error})
+    return pack_frame({"id": corr_id, "ok": True},
+                      ndarray_to_bytes(result))
+
+
+def unpack_reply(payload: bytes) -> Tuple[Dict[str, Any],
+                                          Optional[np.ndarray]]:
+    header, body = unpack_frame(payload)
+    return header, (ndarray_from_bytes(body) if header.get("ok") else None)
+
+
+def pack_heartbeat(name: str, seq: int, state: str,
+                   stats: Dict[str, Any]) -> bytes:
+    return json.dumps({"name": name, "seq": seq, "state": state,
+                       "stats": stats}, separators=(",", ":")).encode()
+
+
+def unpack_heartbeat(payload: bytes) -> Dict[str, Any]:
+    return json.loads(payload)

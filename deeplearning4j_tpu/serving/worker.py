@@ -1,0 +1,197 @@
+"""Engine-side worker: serves a broker request channel from a
+``ParallelInference`` engine.
+
+One :class:`EngineWorker` = one fleet endpoint. It consumes
+``<service>.req`` frames, submits them to its engine, and publishes
+each reply to the requester's private reply topic with the request's
+correlation id — the engine's own micro-batching coalesces concurrent
+broker requests exactly like in-process ones, so the fleet tier adds
+routing without giving up batching efficiency.
+
+Lifecycle (the shutdown half the router's failover depends on):
+
+- ``serving`` — heartbeats flow every ``heartbeat_s`` with the engine's
+  ``stats()`` snapshot riding along;
+- ``drain_and_stop()`` — stop consuming NEW requests, let every
+  accepted one resolve (``engine.drain``), announce ``draining`` then
+  ``stopped`` heartbeats, and only then stop the engine: planned
+  scale-down loses zero requests;
+- ``kill()`` — the faultinject seam: stop everything abruptly,
+  replying to nothing (what SIGKILL on the engine process looks like
+  from the wire). In-flight requesters see silence; their endpoint
+  times the futures out and the router fails over.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving import wire
+from deeplearning4j_tpu.streaming.broker import MessageBroker
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class EngineWorker:
+    """Serve one ``ParallelInference`` engine over a broker channel."""
+
+    def __init__(self, engine, broker: MessageBroker, service: str,
+                 name: Optional[str] = None,
+                 hb_broker: Optional[MessageBroker] = None,
+                 reply_broker: Optional[MessageBroker] = None,
+                 heartbeat_s: float = 0.25, poll_s: float = 0.05,
+                 start: bool = True):
+        """``broker`` carries the request consume loop. Over a
+        ``TcpBroker`` pass SEPARATE connections as ``reply_broker`` and
+        ``hb_broker``: the consume long-poll holds its connection's
+        lock for up to the server's poll window, and replies queued
+        behind it would trickle out at the poll rate instead of
+        resolving as the engine finishes (an ``InMemoryBroker`` has no
+        such contention — sharing is fine there)."""
+        self.engine = engine
+        self.service = service
+        self.name = name or service
+        self._broker = broker
+        self._reply_broker = reply_broker or broker
+        self._hb_broker = hb_broker or broker
+        self.heartbeat_s = float(heartbeat_s)
+        self._poll = float(poll_s)
+        self._state = wire.STATE_SERVING
+        self._seq = 0
+        self._stop = threading.Event()      # stop consuming new work
+        self._killed = threading.Event()    # abrupt: no replies either
+        self._served = 0
+        self._threads = []
+        if start:
+            self.start()
+
+    def start(self) -> "EngineWorker":
+        if self._threads:
+            return self
+        self._threads = [
+            threading.Thread(target=self._serve_loop, daemon=True,
+                             name=f"dl4j-tpu-worker-{self.name}"),
+            threading.Thread(target=self._hb_loop, daemon=True,
+                             name=f"dl4j-tpu-worker-{self.name}-hb"),
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    # ------------------------------------------------------------ serve
+
+    def _serve_loop(self):
+        topic = self.service + wire.REQ_SUFFIX
+        while not self._stop.is_set():
+            try:
+                msg = self._broker.consume(topic, timeout=self._poll)
+            except BaseException as e:
+                if self._stop.is_set():
+                    return
+                logger.warning("worker %s: consume failed (%s: %s)",
+                               self.name, type(e).__name__, e)
+                time.sleep(self._poll)
+                continue
+            if msg is None:
+                continue
+            try:
+                header, x = wire.unpack_request(msg)
+            except Exception as e:
+                logger.warning("worker %s: undecodable request (%s)",
+                               self.name, e)
+                continue
+            self._served += 1
+            corr, reply_topic = header.get("id"), header.get("reply")
+            try:
+                if header.get("kind") == wire.KIND_GENERATE:
+                    g = header.get("gen") or {}
+                    fut = self.engine.submit_generate(
+                        x.astype(np.int32, copy=False), g.get("max_new", 1),
+                        temperature=g.get("temperature", 0.0),
+                        top_k=g.get("top_k", 0), top_p=g.get("top_p", 0.0),
+                        eos_token=g.get("eos_token"),
+                        seed=g.get("seed", 0))
+                else:
+                    fut = self.engine.submit(x)
+            except BaseException as e:
+                self._reply(reply_topic, wire.pack_reply(
+                    corr, error=f"{type(e).__name__}: {e}"))
+                continue
+            fut.add_done_callback(
+                lambda f, c=corr, rt=reply_topic: self._deliver(c, rt, f))
+
+    def _deliver(self, corr, reply_topic, fut):
+        if self._killed.is_set():
+            return  # a killed worker answers nothing
+        err = fut.exception()
+        if err is None:
+            payload = wire.pack_reply(corr, np.asarray(fut.result()))
+        else:
+            payload = wire.pack_reply(
+                corr, error=f"{type(err).__name__}: {err}")
+        self._reply(reply_topic, payload)
+
+    def _reply(self, reply_topic, payload):
+        if self._killed.is_set() or not reply_topic:
+            return
+        try:
+            self._reply_broker.publish(reply_topic, payload)
+        except BaseException as e:
+            logger.warning("worker %s: reply publish failed (%s: %s)",
+                           self.name, type(e).__name__, e)
+
+    # -------------------------------------------------------- heartbeat
+
+    def _hb_loop(self):
+        topic = self.service + wire.HB_SUFFIX
+        while not self._killed.is_set():
+            self._beat(topic)
+            if self._state == wire.STATE_STOPPED:
+                return
+            self._killed.wait(self.heartbeat_s)
+
+    def _beat(self, topic):
+        self._seq += 1
+        try:
+            stats = dict(self.engine.stats())
+            stats["served"] = self._served
+            self._hb_broker.publish(topic, wire.pack_heartbeat(
+                self.name, self._seq, self._state, stats))
+        except BaseException as e:
+            logger.warning("worker %s: heartbeat failed (%s: %s)",
+                           self.name, type(e).__name__, e)
+
+    # -------------------------------------------------------- lifecycle
+
+    def drain_and_stop(self, timeout: Optional[float] = 30.0) -> bool:
+        """Graceful exit: stop consuming, resolve everything accepted,
+        announce the drain, stop the engine. Returns False when the
+        engine did not drain within ``timeout``."""
+        self._state = wire.STATE_DRAINING
+        self._stop.set()
+        drained = self.engine.drain(timeout=timeout)
+        self._state = wire.STATE_STOPPED
+        self._beat(self.service + wire.HB_SUFFIX)  # announce the exit
+        self._killed.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self.engine.shutdown()
+        return drained
+
+    def kill(self) -> None:
+        """Abrupt death (faultinject): stop consuming AND replying
+        immediately — pending requesters hear nothing, heartbeats go
+        silent, exactly the SIGKILL signature."""
+        self._stop.set()
+        self._killed.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    @property
+    def state(self) -> str:
+        return self._state

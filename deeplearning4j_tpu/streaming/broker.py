@@ -38,7 +38,17 @@ class BrokerUnavailable(ConnectionError):
 
 
 class MessageBroker:
-    """Transport SPI: byte payloads on named topics."""
+    """Transport SPI: byte payloads on named topics.
+
+    Liveness: ``ping()`` performs one cheap round-trip against the
+    transport (raises on a dead one) and every successful operation
+    refreshes ``last_seen`` (``time.monotonic()``), so a health plane
+    can read connection liveness directly instead of inferring death
+    from consume timeouts."""
+
+    #: monotonic timestamp of the last successful broker round-trip
+    #: (None until the first one).
+    last_seen: Optional[float] = None
 
     def publish(self, topic: str, payload: bytes) -> None:
         raise NotImplementedError
@@ -47,6 +57,14 @@ class MessageBroker:
         """Pop the next payload, blocking up to ``timeout`` seconds.
         Returns None on timeout."""
         raise NotImplementedError
+
+    def ping(self) -> float:
+        """One liveness round-trip; returns the RTT in seconds and
+        refreshes ``last_seen``. Raises (e.g.
+        :class:`BrokerUnavailable`) when the transport is dead."""
+        t0 = time.monotonic()
+        self.last_seen = time.monotonic()
+        return time.monotonic() - t0
 
     def close(self) -> None:
         pass
@@ -58,6 +76,14 @@ class InMemoryBroker(MessageBroker):
     def __init__(self):
         self._topics: Dict[str, "queue.Queue[bytes]"] = {}
         self._lock = threading.Lock()
+        self.last_seen: Optional[float] = None
+
+    def ping(self) -> float:
+        t0 = time.monotonic()
+        with self._lock:
+            pass  # in-process: the lock round-trip IS the transport
+        self.last_seen = time.monotonic()
+        return self.last_seen - t0
 
     def _q(self, topic: str) -> "queue.Queue[bytes]":
         with self._lock:
@@ -67,20 +93,25 @@ class InMemoryBroker(MessageBroker):
 
     def publish(self, topic: str, payload: bytes) -> None:
         self._q(topic).put(bytes(payload))
+        self.last_seen = time.monotonic()
 
     def consume(self, topic: str, timeout: Optional[float] = None) -> Optional[bytes]:
         try:
-            return self._q(topic).get(timeout=timeout)
+            msg = self._q(topic).get(timeout=timeout)
         except queue.Empty:
-            return None
+            msg = None
+        self.last_seen = time.monotonic()
+        return msg
 
 
 # --- TCP transport ----------------------------------------------------------
-# Frame: 1-byte op ('P' publish / 'C' consume) + u16 topic len + topic utf-8
-#        + u32 payload len + payload.
+# Frame: 1-byte op ('P' publish / 'C' consume / 'G' ping) + u16 topic len +
+#        topic utf-8 + u32 payload len + payload.
 # Reply: 1-byte status (1 = payload follows / 0 = none-or-ack) + u32 len +
 #        payload. The status byte keeps zero-length payloads distinguishable
-#        from a consume poll timeout.
+#        from a consume poll timeout. 'G' frames carry an empty topic and
+#        payload and are acked with status 0 — a pure liveness round-trip
+#        that also refreshes the server's per-peer last_seen table.
 
 def _send_frame(sock: socket.socket, op: bytes, topic: str, payload: bytes) -> None:
     t = topic.encode()
@@ -102,26 +133,35 @@ class _BrokerHandler(socketserver.BaseRequestHandler):
     def handle(self):
         broker: InMemoryBroker = self.server._broker  # type: ignore[attr-defined]
         timeout = self.server._poll_timeout  # type: ignore[attr-defined]
-        while True:
-            try:
-                op = _recv_exact(self.request, 1)
-            except ConnectionError:
-                return
-            tlen, plen = struct.unpack(">HI", _recv_exact(self.request, 6))
-            if plen > _MAX_FRAME:
-                return
-            topic = _recv_exact(self.request, tlen).decode()
-            payload = _recv_exact(self.request, plen)
-            if op == b"P":
-                broker.publish(topic, payload)
-                status, reply = b"\x00", b""
-            elif op == b"C":
-                msg = broker.consume(topic, timeout=timeout)
-                status = b"\x00" if msg is None else b"\x01"
-                reply = msg or b""
-            else:
-                return
-            self.request.sendall(status + struct.pack(">I", len(reply)) + reply)
+        peers = self.server._peers  # type: ignore[attr-defined]
+        peer = "%s:%s" % self.client_address[:2]
+        try:
+            while True:
+                try:
+                    op = _recv_exact(self.request, 1)
+                except ConnectionError:
+                    return
+                tlen, plen = struct.unpack(">HI", _recv_exact(self.request, 6))
+                if plen > _MAX_FRAME:
+                    return
+                topic = _recv_exact(self.request, tlen).decode()
+                payload = _recv_exact(self.request, plen)
+                if op == b"P":
+                    broker.publish(topic, payload)
+                    status, reply = b"\x00", b""
+                elif op == b"C":
+                    msg = broker.consume(topic, timeout=timeout)
+                    status = b"\x00" if msg is None else b"\x01"
+                    reply = msg or b""
+                elif op == b"G":
+                    status, reply = b"\x00", b""
+                else:
+                    return
+                peers[peer] = time.monotonic()
+                self.request.sendall(
+                    status + struct.pack(">I", len(reply)) + reply)
+        finally:
+            peers.pop(peer, None)
 
 
 class TcpBrokerServer:
@@ -134,11 +174,19 @@ class TcpBrokerServer:
         self._srv.daemon_threads = True
         self._srv._broker = InMemoryBroker()  # type: ignore[attr-defined]
         self._srv._poll_timeout = poll_timeout  # type: ignore[attr-defined]
+        self._srv._peers = {}  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
     def address(self):
         return self._srv.server_address[:2]
+
+    def peers(self) -> Dict[str, float]:
+        """Connected clients → monotonic ``last_seen`` of their most
+        recent completed frame (a peer that vanished without a clean
+        close disappears once its handler thread notices the dead
+        socket)."""
+        return dict(self._srv._peers)  # type: ignore[attr-defined]
 
     def start(self) -> "TcpBrokerServer":
         self._thread = threading.Thread(target=self._srv.serve_forever,
@@ -182,6 +230,7 @@ class TcpBroker(MessageBroker):
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._closed = False
+        self.last_seen: Optional[float] = None
         with self._lock:
             self._ensure_connected(initial=True)
 
@@ -241,7 +290,9 @@ class TcpBroker(MessageBroker):
                     _send_frame(self._sock, op, topic, payload)
                     status = _recv_exact(self._sock, 1)
                     (rlen,) = struct.unpack(">I", _recv_exact(self._sock, 4))
-                    return status == b"\x01", _recv_exact(self._sock, rlen)
+                    reply = _recv_exact(self._sock, rlen)
+                    self.last_seen = time.monotonic()
+                    return status == b"\x01", reply
                 except BrokerUnavailable:
                     raise
                 except (OSError, ConnectionError, struct.error) as e:
@@ -257,6 +308,16 @@ class TcpBroker(MessageBroker):
 
     def publish(self, topic: str, payload: bytes) -> None:
         self._roundtrip(b"P", topic, payload)
+
+    def ping(self) -> float:
+        """One 'G' liveness round-trip; returns the RTT in seconds and
+        refreshes ``last_seen``. Raises :class:`BrokerUnavailable` when
+        the reconnect budget is exhausted — a clean positive death
+        signal, so health planes never have to infer a dead transport
+        from consume timeouts."""
+        t0 = time.monotonic()
+        self._roundtrip(b"G", "", b"")
+        return time.monotonic() - t0
 
     def consume(self, topic: str, timeout: Optional[float] = None) -> Optional[bytes]:
         deadline = None if timeout is None else time.monotonic() + timeout

@@ -20,7 +20,10 @@ reference's remote listeners.
 Routes:
   GET  /                                  session index (HTML)
   GET  /metrics                           Prometheus text exposition
-  GET  /healthz                           liveness + watchdog state (JSON)
+  GET  /healthz                           combined health (JSON; 503 degraded)
+  GET  /healthz/live                      liveness — process up, always 200
+  GET  /healthz/ready                     readiness — warmed + not degraded,
+                                          503 otherwise (k8s probe split)
   GET  /train/<session>[?worker=w]        dashboard (HTML, report.py)
   GET  /api/sessions                      ["s1", ...]
   GET  /api/sessions/<s>/workers          ["w0", ...]
@@ -88,6 +91,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._metrics()
             if parts == ["healthz"]:
                 return self._healthz()
+            if parts == ["healthz", "live"]:
+                return self._healthz_live()
+            if parts == ["healthz", "ready"]:
+                return self._healthz_ready()
             if parts[0] == "train" and len(parts) == 2:
                 return self._html(render_html(self.storage, parts[1], worker))
             if parts[0] == "api":
@@ -146,7 +153,19 @@ class _Handler(BaseHTTPRequestHandler):
         return self._send(200, body,
                           "text/plain; version=0.0.4; charset=utf-8")
 
-    def _healthz(self):
+    def _health_body(self):
+        """(body, degraded, unwarmed) shared by the health routes.
+
+        Liveness vs readiness split (the k8s probe discipline):
+        ``/healthz/live`` answers "is the process up" — ALWAYS 200
+        while the server can answer at all, so orchestrators never
+        restart a pod for being degraded-but-serving; ``/healthz/ready``
+        answers "should this pod take traffic" — 503 while any engine
+        is un-warmed (first request would eat an XLA compile) or the
+        serving plane is degraded (replica quarantined, fleet endpoint
+        out). ``/healthz`` keeps its historical combined semantics
+        (503 on degraded; warmup does NOT gate it) for existing
+        monitors, and carries ``live`` + ``ready`` fields."""
         reg = self.registry
         nan = reg.family_total(NAN_COUNTER)
         slow = reg.family_total(SLOW_COUNTER)
@@ -158,6 +177,7 @@ class _Handler(BaseHTTPRequestHandler):
                               - self.server._started_at, 3),  # type: ignore
         }
         degraded = nan > 0
+        unwarmed = False
         engine = getattr(self.server, "_infer_engine", None)
         if engine is not None:
             # serving-plane snapshot (the dl4j_infer_* metric families
@@ -165,8 +185,34 @@ class _Handler(BaseHTTPRequestHandler):
             # replica means reduced capacity — degraded, still serving
             body["inference"] = engine.stats()
             degraded = degraded or bool(body["inference"].get("degraded"))
+            unwarmed = unwarmed or not body["inference"].get("warmed", True)
+        router = getattr(self.server, "_router", None)
+        if router is not None:
+            # fleet aggregation: every endpoint's health/stats as the
+            # router sees them (heartbeats + ejection state)
+            body["fleet"] = router.fleet_snapshot()
+            degraded = degraded or bool(body["fleet"].get("degraded"))
+        body["live"] = True
+        body["ready"] = not degraded and not unwarmed
+        return body, degraded, unwarmed
+
+    def _healthz(self):
+        body, degraded, _ = self._health_body()
         body["status"] = "degraded" if degraded else "ok"
         return self._json(body, 503 if degraded else 200)
+
+    def _healthz_live(self):
+        body, degraded, _ = self._health_body()
+        body["status"] = "degraded" if degraded else "ok"
+        return self._json(body, 200)  # process up == live, always 200
+
+    def _healthz_ready(self):
+        body, degraded, unwarmed = self._health_body()
+        ready = not degraded and not unwarmed
+        body["status"] = ("ok" if ready else
+                          "unwarmed" if unwarmed and not degraded
+                          else "degraded")
+        return self._json(body, 200 if ready else 503)
 
     # ------------------------------------------------------ /tsne view
     # (``deeplearning4j-ui-resources/.../ui/tsne/`` dashboard role: the
@@ -388,7 +434,7 @@ class UiServer:
                  host: str = "127.0.0.1", verbose: bool = False,
                  word_vectors=None, model=None, conv_listener=None,
                  flow_listener=None, tsne=None, registry=None,
-                 inference_engine=None):
+                 inference_engine=None, router=None):
         """``word_vectors``: any object with ``words_nearest(word, n)``
         (Word2Vec/WordVectors) — enables the /words nearest-neighbor
         view (legacy dl4j-scaleout/deeplearning4j-nlp render role).
@@ -404,12 +450,16 @@ class UiServer:
         monitor spans/listeners/watchdogs publish into).
         ``inference_engine``: a ``ParallelInference`` whose ``stats()``
         snapshot rides along on /healthz (its dl4j_infer_* metric
-        families land on /metrics regardless)."""
+        families land on /metrics regardless). ``router``: an
+        ``InferenceRouter`` whose ``fleet_snapshot()`` is aggregated
+        into /healthz (per-endpoint health, ejections, shed/hedge/
+        failover totals) and gates /healthz/ready."""
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd._storage = storage  # type: ignore[attr-defined]
         self._httpd._verbose = verbose  # type: ignore[attr-defined]
         self._httpd._registry = registry  # type: ignore[attr-defined]
         self._httpd._infer_engine = inference_engine  # type: ignore[attr-defined]
+        self._httpd._router = router  # type: ignore[attr-defined]
         self._httpd._started_at = time.monotonic()  # type: ignore[attr-defined]
         self._httpd._word_vectors = word_vectors  # type: ignore[attr-defined]
         self._httpd._flow_model = model  # type: ignore[attr-defined]

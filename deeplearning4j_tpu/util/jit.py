@@ -1,0 +1,45 @@
+"""Backend-aware ``jax.jit`` wrapper for buffer-donating programs.
+
+On this jaxlib's CPU backend, donated-buffer aliasing corrupts the
+process heap (the PR-1/2 hazard family: vmapped donation and queued
+donated dispatches scribble over reused pages — symptoms range from a
+handful of garbage rows in an otherwise-converged table to double-free
+aborts at interpreter exit, and they surface nondeterministically in
+whatever code runs NEXT). Every donation site therefore gates on the
+backend: ``nn/multilayer.py`` / ``nn/graph.py`` / ``nn/generate.py`` /
+``parallel/wrapper.py`` already do it inline at jit-build time; this
+helper is the same gate for module-level ``@jax.jit`` decorators, where
+the backend must be resolved lazily at the FIRST CALL so importing a
+model module never initializes the platform.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def cpu_safe_jit(fn=None, *, donate_argnums=(), **jit_kw):
+    """``jax.jit(fn, donate_argnums=..., **jit_kw)`` with donation
+    dropped entirely when the default backend is CPU.
+
+    Usable as ``@cpu_safe_jit(donate_argnums=(0, 1))`` (with or without
+    extra jit kwargs such as ``static_argnames``). The underlying jit
+    object is built on first call and cached; ``jax.clear_caches()``
+    still forces a retrace exactly as with a plain ``@jax.jit``.
+    """
+    if fn is None:
+        return functools.partial(cpu_safe_jit,
+                                 donate_argnums=donate_argnums, **jit_kw)
+    cell = []
+
+    @functools.wraps(fn)
+    def call(*args, **kwargs):
+        if not cell:
+            donate = (donate_argnums
+                      if jax.default_backend() != "cpu" else ())
+            cell.append(jax.jit(fn, donate_argnums=donate, **jit_kw))
+        return cell[0](*args, **kwargs)
+
+    return call

@@ -1,0 +1,111 @@
+"""Horizontal serving tier end-to-end: fleet, router, SLO admission.
+
+Spin up a 3-endpoint LocalFleet (engine workers behind the broker wire
+protocol), route traffic through the InferenceRouter, kill one engine
+mid-load (the faultinject seam) and watch the fleet serve through it:
+every request resolves via failover, the dead endpoint is ejected and
+then reinstated after restart, and a deadline tighter than capacity is
+shed with RetryAfter instead of queueing past the SLO. The UiServer
+aggregates fleet health at /healthz (with the /healthz/live vs
+/healthz/ready split) and the dl4j_router_* families at /metrics.
+"""
+
+try:  # script mode: examples/ is sys.path[0]
+    import _bootstrap  # noqa: F401
+except ImportError:  # package mode: repo root already importable
+    pass
+
+import argparse
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.faultinject import kill_endpoint
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (InferenceRouter, LocalFleet,
+                                        RetryAfter, ScalePolicy)
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, UiServer
+
+N_IN, N_OUT = 16, 4
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--endpoints", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=60)
+    ap.add_argument("--serve-seconds", type=float, default=0.0,
+                    help="keep the UiServer up afterwards (0 = exit)")
+    args = ap.parse_args(argv)
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05).updater("adam").activation("relu")
+            .list()
+            .layer(DenseLayer(n_in=N_IN, n_out=32))
+            .layer(OutputLayer(n_in=32, n_out=N_OUT, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    def engine_factory():
+        eng = ParallelInference(net, max_batch_size=8, max_latency_ms=1.0,
+                                replicas=1)
+        eng.warmup([(N_IN,)])
+        return eng
+
+    router = InferenceRouter(per_try_timeout_s=1.0, eject_backoff_s=0.2,
+                             max_attempts=4)
+    fleet = LocalFleet(engine_factory, router=router, heartbeat_s=0.05,
+                       request_timeout_s=1.0, heartbeat_timeout_s=0.4)
+    for _ in range(args.endpoints):
+        fleet.add_endpoint()
+    fleet.wait_ready(30)
+    server = UiServer(InMemoryStatsStorage(), router=router).start()
+    print(f"fleet up: {fleet.names()}  healthz: {server.url}/healthz")
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, N_IN)).astype(np.float32)
+
+    futs = [router.submit(x) for _ in range(args.requests // 2)]
+    victim = fleet.names()[0]
+    kill_endpoint(fleet, victim)
+    print(f"killed {victim} mid-load")
+    futs += [router.submit(x) for _ in range(args.requests // 2)]
+    for f in futs:
+        f.result(timeout=30)
+    snap = router.fleet_snapshot()
+    print(f"all {len(futs)} requests served through the kill "
+          f"(failovers={snap['failovers']}, healthy="
+          f"{snap['healthy_endpoints']}/{snap['total_endpoints']})")
+
+    fleet.restart(victim)
+    router.probe_now()
+    for _ in range(10):
+        router.output(x, timeout=30)
+    print(f"{victim} reinstated: "
+          f"{router.fleet_snapshot()['endpoints'][victim]['in_pool']}")
+
+    # SLO admission: an unmeetable deadline is shed, not queued
+    try:
+        router.submit(x, deadline_ms=1e-6, priority="best_effort")
+        print("tight deadline admitted (cold estimate)")
+    except RetryAfter as e:
+        print(f"tight deadline shed: retry after {e.retry_after_s:.4f}s")
+
+    # autoscaling: policy decisions from the live snapshot
+    pol = ScalePolicy(min_endpoints=1, max_endpoints=args.endpoints + 1,
+                      target_queue_per_endpoint=4.0, cooldown_s=0.0)
+    print("autoscale:", fleet.autoscale(pol) or "steady")
+
+    if args.serve_seconds > 0:
+        print(f"serving /healthz for {args.serve_seconds}s …")
+        time.sleep(args.serve_seconds)
+    server.stop()
+    fleet.shutdown()
+    return snap
+
+
+if __name__ == "__main__":
+    main()

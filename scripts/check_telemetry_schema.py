@@ -177,6 +177,14 @@ KNOWN_DL4J_METRICS = {
     "dl4j_decode_tokens_total",
     "dl4j_decode_prefill_latency_ms",
     "dl4j_decode_latency_ms",
+    # horizontal serving tier (serving/router.py InferenceRouter)
+    "dl4j_router_requests_total",
+    "dl4j_router_shed_total",
+    "dl4j_router_hedges_total",
+    "dl4j_router_failovers_total",
+    "dl4j_router_queue_wait_ms",
+    "dl4j_router_latency_ms",
+    "dl4j_router_endpoint_healthy",
     # fault-tolerance plane (supervisor / quarantine / dead-letter /
     # checkpoint integrity — see monitor/__init__.py FAULT_* names)
     "dl4j_fault_events_total",

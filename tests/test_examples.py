@@ -69,3 +69,9 @@ def test_tsne_mnist_view_example():
     from examples.tsne_mnist_view import main
     coords = main(smoke=True)
     assert coords.shape == (60, 2) and np.isfinite(coords).all()
+
+
+def test_serve_fleet_example():
+    from examples.serve_fleet import main
+    snap = main(["--endpoints", "2", "--requests", "8"])
+    assert snap["failovers"] >= 0 and snap["total_endpoints"] == 2
