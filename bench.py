@@ -19,6 +19,11 @@ Sub-benchmarks (each reported under "sub_benchmarks"):
     load: rps + p50/p99 healthy vs during a mid-load engine kill
     (failover, zero lost requests) and the shed rate under a deadline
     tighter than capacity (serving/router.py InferenceRouter)
+  - multi_model — 8 models served from one chip through the
+    ModelRegistry engine: aggregate rps + per-model p99, a hot-swap
+    deploy under load (zero lost requests, bounded p99 impact), a
+    corrupt-checkpoint deploy auto-rejected, and a NaN-poisoned canary
+    auto-rolled-back — all while the prior versions keep serving
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 The headline metric is ResNet-50 MFU when available (the heaviest
@@ -861,6 +866,213 @@ def bench_router_slo():
     }
 
 
+def bench_multi_model():
+    """Multi-model serving from ONE chip (serving/registry.py +
+    registry-mode ParallelInference): 8 models behind one engine.
+
+    Four phases, each pinning an acceptance criterion: (a) aggregate
+    rps + per-model p99 under a concurrent cross-model mix; (b) a
+    hot-swap deploy UNDER open-loop load — zero lost requests, bounded
+    p99 impact, post-cutover traffic bitwise on the new version; (c) a
+    corrupt-checkpoint deploy auto-rejected while the old version
+    keeps serving; (d) a NaN-poisoned canary auto-rolled-back by the
+    watch while the stable version keeps serving."""
+    import os
+    import tempfile
+    import threading
+    import time
+
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.faultinject import corrupt_file
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving import ModelRegistry
+    from deeplearning4j_tpu.util.model_serializer import (
+        CheckpointCorruptError, write_model)
+
+    rng = np.random.default_rng(0)
+    nin, nc, n_models = 32, 8, 8
+
+    def make_net(seed, width):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(seed).learning_rate(0.05).updater("adam")
+                .activation("relu").list()
+                .layer(DenseLayer(n_in=nin, n_out=width))
+                .layer(OutputLayer(n_in=width, n_out=nc,
+                                   activation="softmax",
+                                   loss_function="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    names = [f"m{i}" for i in range(n_models)]
+    nets = {n: make_net(i + 1, 64 + 32 * (i % 3))
+            for i, n in enumerate(names)}
+    registry = ModelRegistry()
+    for name in names:
+        registry.register(name, net=nets[name], warm_shapes=[(nin,)])
+    engine = ParallelInference(registry=registry, max_batch_size=16,
+                               max_latency_ms=2.0, replicas=1,
+                               queue_capacity=4096)
+    x = rng.standard_normal((1, nin)).astype(np.float32)
+    results = {}
+    try:
+        t0 = time.perf_counter()
+        compiled = engine.warmup([(nin,)])
+        results["warmup_s"] = round(time.perf_counter() - t0, 2)
+        results["warmup_programs"] = compiled
+
+        def drive(duration_s, concurrency=8, on_submit=None):
+            """Closed-loop cross-model drive; returns per-model
+            latencies + error/lost accounting."""
+            lats = {n: [] for n in names}
+            errors = []
+            stop = time.perf_counter() + duration_s
+
+            def worker(widx):
+                i = widx
+                while time.perf_counter() < stop:
+                    name = names[i % n_models]
+                    i += 1
+                    t_sub = time.perf_counter()
+                    try:
+                        fut = engine.submit(x, model=name)
+                        fut.result(timeout=60)
+                    except BaseException as e:
+                        errors.append((name, type(e).__name__))
+                        continue
+                    lats[name].append(time.perf_counter() - t_sub)
+                    if on_submit is not None:
+                        on_submit()
+
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(concurrency)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return lats, errors
+
+        def summarize(lats, duration_s):
+            per_model = {}
+            total = 0
+            for name, ls in lats.items():
+                total += len(ls)
+                if ls:
+                    s = sorted(ls)
+                    per_model[name] = {
+                        "requests": len(ls),
+                        "p50_ms": round(s[len(s) // 2] * 1e3, 3),
+                        "p99_ms": round(
+                            s[min(len(s) - 1, int(len(s) * 0.99))] * 1e3, 3),
+                    }
+            return total / duration_s, per_model
+
+        # (a) steady-state aggregate throughput + per-model p99
+        lats, errors = drive(3.0)
+        rps, per_model = summarize(lats, 3.0)
+        results["aggregate_requests_per_sec"] = round(rps, 1)
+        results["per_model"] = per_model
+        results["steady_errors"] = len(errors)
+        miss0 = monitor.get_registry().family_total(
+            monitor.JIT_CACHE_MISS_COUNTER)
+
+        # (b) hot-swap m0 under load: v2 trained to different params
+        v2 = make_net(101, 64)
+        y_v2 = np.asarray(v2.output(x))
+        swap_done = {}
+
+        def deploy_midway():
+            time.sleep(0.8)
+            t = time.perf_counter()
+            registry.deploy("m0", net=v2)  # verify + warm + atomic cut
+            swap_done["deploy_s"] = round(time.perf_counter() - t, 3)
+
+        deployer = threading.Thread(target=deploy_midway)
+        deployer.start()
+        lats, errors = drive(2.5)
+        deployer.join()
+        rps_swap, per_model_swap = summarize(lats, 2.5)
+        results["hot_swap"] = {
+            "deploy_s": swap_done.get("deploy_s"),
+            "requests_per_sec": round(rps_swap, 1),
+            "lost_requests": len(errors),
+            "zero_lost": len(errors) == 0,
+            "m0_p99_ms_during_swap": per_model_swap.get("m0", {}).get("p99_ms"),
+            "m0_p99_ms_healthy": per_model.get("m0", {}).get("p99_ms"),
+            "post_swap_bitwise_v2": bool(np.array_equal(
+                engine.output(x, model="m0", timeout=30), y_v2)),
+            "active_version": registry.active_version("m0"),
+        }
+
+        # (c) corrupt-checkpoint deploy: rejected, old keeps serving
+        with tempfile.TemporaryDirectory() as td:
+            bad = os.path.join(td, "bad.zip")
+            write_model(make_net(102, 64), bad)
+            corrupt_file(bad, offset=-64)
+            rejected = False
+            try:
+                registry.deploy("m1", path=bad)
+            except CheckpointCorruptError:
+                rejected = True
+            still_serving = bool(np.array_equal(
+                engine.output(x, model="m1", timeout=30),
+                np.asarray(nets["m1"].output(x))))
+            results["corrupt_deploy"] = {
+                "rejected": rejected,
+                "old_version_keeps_serving": still_serving,
+                "active_version": registry.active_version("m1"),
+            }
+
+        # (d) NaN-poisoned canary: the watch rolls it back on its own
+        poisoned = make_net(103, 64)
+        poisoned.params["layer0"]["W"] = jax.numpy.asarray(
+            np.full_like(np.asarray(poisoned.params["layer0"]["W"]),
+                         np.nan))
+        registry.deploy("m2", net=poisoned, canary_fraction=0.5,
+                        warm=False)
+        rolled_back = False
+        for _ in range(32):
+            engine.output(x, model="m2", timeout=30)
+            if registry.entry("m2").canary is None:
+                rolled_back = True
+                break
+        results["poisoned_canary"] = {
+            "rolled_back": rolled_back,
+            "stable_keeps_serving": bool(np.array_equal(
+                engine.output(x, model="m2", timeout=30),
+                np.asarray(nets["m2"].output(x)))),
+            "active_version": registry.active_version("m2"),
+        }
+        results["steady_state_jit_misses"] = int(
+            monitor.get_registry().family_total(
+                monitor.JIT_CACHE_MISS_COUNTER) - miss0
+            )  # hot-swap warms v2 off the hot path; steady mix adds 0
+        stats = engine.stats()
+        results["models_served"] = len(stats["models"])
+    finally:
+        engine.shutdown()
+
+    return {
+        "metric": "multi_model_aggregate_rps",
+        "value": results["aggregate_requests_per_sec"],
+        "unit": "requests/sec",
+        # acceptance composite: hot-swap zero-lost + corrupt-deploy
+        # rejected + canary rolled back, all while serving
+        "vs_baseline": float(
+            results["hot_swap"]["zero_lost"]
+            and results["corrupt_deploy"]["rejected"]
+            and results["corrupt_deploy"]["old_version_keeps_serving"]
+            and results["poisoned_canary"]["rolled_back"]
+            and results["poisoned_canary"]["stable_keeps_serving"]),
+        **results,
+    }
+
+
 def bench_word2vec():
     """Word2Vec skip-gram (BASELINE config #5): the all-epochs-on-device
     SGNS scan engine (device pairgen + table negatives + capped MXU
@@ -953,6 +1165,7 @@ def main():
                      ("serving_inference", bench_serving_inference),
                      ("fault_recovery", bench_fault_recovery),
                      ("router_slo", bench_router_slo),
+                     ("multi_model", bench_multi_model),
                      ("word2vec", bench_word2vec)]:
         # fresh registry per sub-bench: the monitor spans inside the
         # fit/stage paths give each result its own per-phase attribution

@@ -20,6 +20,10 @@ Injector ↔ fault domain map:
   tmp write and the atomic install (checkpoint domain);
 - :func:`poison_replica` — scheduled device errors on one serving
   replica (serving domain: retry, quarantine, probe reinstatement);
+- :func:`poison_model` — scheduled device errors on ONE model across
+  every replica (multi-model domain: the per-model circuit breaker
+  must quarantine the model, leave the replicas serving its cotenants,
+  and probe it back once the poison clears);
 - :func:`kill_endpoint` / :class:`NetworkPartition` — abrupt engine
   endpoint death and broker-level partitions (routing domain: the
   InferenceRouter's heartbeat death detection, failover, ejection and
@@ -232,6 +236,55 @@ def poison_replica(engine, replica: int = 0, failures: int = 2
     ``remaining``/``hits``. ``failures=2`` defeats the single same-replica
     retry and forces a quarantine; the next probe then heals it."""
     poison = ReplicaPoison(replica, failures)
+    engine._poison_hook = poison
+    return poison
+
+
+class ModelPoison:
+    """Model-scoped poison hook for a multi-model ``ParallelInference``:
+    dispatches (serving AND probe) of the target ``model`` — any
+    replica, optionally one ``version`` — raise :class:`InjectedFault`
+    for the next ``failures`` hits; afterwards the model heals.
+    ``wants_model=True`` makes the engine pass the dispatch's model
+    name to the hook. The recovery contract under test: the model's
+    circuit breaker opens (its batch fails with ``ModelQuarantined``
+    and its submits reject at admission), replicas stay in the pool for
+    cotenant models, and a probe closes the breaker once healed."""
+
+    wants_model = True
+
+    def __init__(self, model: str, failures: int,
+                 version: Optional[int] = None):
+        self.model = model
+        self.version = version  # None = any version of the model
+        self.remaining = int(failures)
+        self.hits = 0
+
+    def __call__(self, replica_idx: int, shape: Sequence[int],
+                 model: Optional[str]) -> None:
+        if model == self.model and self.remaining > 0:
+            self.remaining -= 1
+            self.hits += 1
+            raise InjectedFault(
+                f"injected device fault for model {model!r} "
+                f"on replica {replica_idx}")
+
+
+def poison_model(engine, model: str, failures: Optional[int] = None,
+                 version: Optional[int] = None) -> ModelPoison:
+    """Arm a :class:`ModelPoison` on a live registry-mode engine.
+    ``failures`` counts per-dispatch-attempt hits: opening the breaker
+    takes ``breaker_threshold`` FAILED BATCHES, each burning
+    ``1 + max_batch_retries`` attempts — the default arms exactly that
+    many (e.g. 4 with the stock 1-retry engine and threshold 2), so the
+    model's breaker opens and then the very next probe heals it.
+    Cotenant models keep serving throughout."""
+    if failures is None:
+        threshold = 2
+        if getattr(engine, "_registry", None) is not None:
+            threshold = engine._registry.breaker_threshold
+        failures = threshold * (1 + engine.max_batch_retries)
+    poison = ModelPoison(model, failures, version)
     engine._poison_hook = poison
     return poison
 

@@ -126,6 +126,26 @@ ROUTER_QUEUE_WAIT_HISTOGRAM = "dl4j_router_queue_wait_ms"
 ROUTER_LATENCY_HISTOGRAM = "dl4j_router_latency_ms"
 ROUTER_ENDPOINT_HEALTHY_GAUGE = "dl4j_router_endpoint_healthy"
 
+# Multi-model serving plane (serving/registry.py ModelRegistry + the
+# multi-model ParallelInference): per-model request/error volume and
+# latency (labeled ``model=``), lifecycle events — deploys by
+# ``outcome`` (accepted / rejected-corrupt / canary), rollbacks by
+# ``reason`` (manual / canary_error_rate / canary_nan / canary_p99 /
+# breaker), device-memory-budget evictions — plus three gauges: the
+# active version per model, the per-model circuit breaker (1 = open:
+# the model is quarantined and probed without touching its cotenants),
+# and the bytes of device-pinned parameters the registry accounts
+# against its memory budget.
+MODEL_REQUESTS_COUNTER = "dl4j_model_requests_total"
+MODEL_ERRORS_COUNTER = "dl4j_model_errors_total"
+MODEL_LATENCY_HISTOGRAM = "dl4j_model_latency_ms"
+MODEL_DEPLOYS_COUNTER = "dl4j_model_deploys_total"
+MODEL_ROLLBACKS_COUNTER = "dl4j_model_rollbacks_total"
+MODEL_EVICTIONS_COUNTER = "dl4j_model_evictions_total"
+MODEL_ACTIVE_VERSION_GAUGE = "dl4j_model_active_version"
+MODEL_BREAKER_OPEN_GAUGE = "dl4j_model_breaker_open"
+MODEL_PINNED_BYTES_GAUGE = "dl4j_model_pinned_bytes"
+
 # Fault-tolerance plane (detect → isolate → recover): every recovery
 # path in the stack reports through these five families so an operator
 # can tell a self-healed fault from a healthy run. ``domain`` label on

@@ -638,7 +638,10 @@ class ComputationGraph:
                 epoch, (params, opt_state, states), None, length=epochs)
             return p, o, s, scores.reshape((-1,))
 
-        return jax.jit(run, donate_argnums=(0, 1, 2))
+        # same CPU donation gate as _make_train_step: donated-buffer
+        # aliasing on the CPU backend corrupts the heap
+        donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
+        return jax.jit(run, donate_argnums=donate)
 
     def stage_scan(self, data: Union[DataSet, MultiDataSet], batch_size: int):
         """Stage a dataset on device as scan-ready minibatch stacks — do

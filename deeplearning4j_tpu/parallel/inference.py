@@ -60,6 +60,25 @@ PRNG keys make a request's tokens identical to a solo
 ``warmup_generate`` AOT-compiles the (bucket × row-bucket × replica)
 program set so steady-state decode serving performs zero XLA
 compiles.
+
+Multi-model serving (``registry=`` mode): instead of one pinned net,
+the engine serves every model in a
+:class:`~deeplearning4j_tpu.serving.registry.ModelRegistry` —
+``submit(x, model=..., version=...)``. Versions resolve at submit
+time (so a registry deploy cuts traffic over atomically — in-flight
+requests finish on the version they resolved), params pin per device
+through the registry's LRU/priority memory budget, batches never mix
+models (the coalescing signature carries model+version), each model
+can override the row-bucket ladder, and formed batches dispatch
+through a **deficit-weighted round-robin** queue so one hot model
+cannot starve its cotenants. A model whose batches fault across more
+than one replica trips its per-model circuit breaker: its futures
+fail with :class:`~deeplearning4j_tpu.serving.registry.
+ModelQuarantined`, its submits reject at admission, replicas stay in
+the pool for the other models, and the engine probes the opened model
+(``probe_interval_ms`` / ``probe_now()``) until it heals. A decode
+``session=`` pins its version on first use — a mid-stream hot-swap
+never switches the KV-cache owner; new sessions get the new version.
 """
 
 from __future__ import annotations
@@ -67,6 +86,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -99,18 +119,23 @@ class InferenceBackpressure(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("x", "n", "future", "t_submit")
+    __slots__ = ("x", "n", "future", "t_submit", "model", "version",
+                 "coalescible")
 
-    def __init__(self, x: np.ndarray):
+    def __init__(self, x: np.ndarray, model: Optional[str] = None,
+                 version: Optional[int] = None, coalescible: bool = True):
         self.x = x
         self.n = int(x.shape[0])
         self.future: "Future[np.ndarray]" = Future()
         self.t_submit = time.perf_counter()
+        self.model = model
+        self.version = version
+        self.coalescible = coalescible
 
     def sig(self) -> Tuple:
         """Coalescing signature: only same-sig requests may share a
-        dispatched batch."""
-        return tuple(self.x.shape[1:])
+        dispatched batch (a batch never mixes models or versions)."""
+        return (self.model, self.version) + tuple(self.x.shape[1:])
 
     def finish(self, rows: np.ndarray) -> np.ndarray:
         """Map the batch's de-padded result rows onto this request's
@@ -129,8 +154,9 @@ class _GenRequest(_Request):
 
     def __init__(self, ids_pad: np.ndarray, lengths: np.ndarray,
                  keys: np.ndarray, t_in: int, max_new: int,
-                 sampler: Tuple):
-        super().__init__(ids_pad)
+                 sampler: Tuple, model: Optional[str] = None,
+                 version: Optional[int] = None, coalescible: bool = True):
+        super().__init__(ids_pad, model, version, coalescible)
         self.lengths = lengths
         self.keys = keys
         self.t_in = t_in
@@ -138,7 +164,8 @@ class _GenRequest(_Request):
         self.sampler = sampler
 
     def sig(self) -> Tuple:
-        return ("gen", self.x.shape[1], self.max_new) + self.sampler
+        return ("gen", self.model, self.version, self.x.shape[1],
+                self.max_new) + self.sampler
 
     def finish(self, rows: np.ndarray) -> np.ndarray:
         return np.concatenate(
@@ -147,10 +174,13 @@ class _GenRequest(_Request):
 
 
 class _Batch:
-    __slots__ = ("requests", "x", "rows", "tried", "payload")
+    __slots__ = ("requests", "x", "rows", "tried", "payload", "model",
+                 "version")
 
     def __init__(self, requests: List[_Request], x: np.ndarray, rows: int,
-                 payload: Optional[Tuple] = None):
+                 payload: Optional[Tuple] = None,
+                 model: Optional[str] = None,
+                 version: Optional[int] = None):
         self.requests = requests
         self.x = x  # bucket-padded, model dtype
         self.rows = rows  # real (unpadded) row count
@@ -158,9 +188,97 @@ class _Batch:
         # generate batches carry (lengths, keys, max_new, sampler);
         # plain inference batches carry None
         self.payload = payload
+        self.model = model
+        self.version = version
 
 
 _STOP = object()
+
+
+class _FairBatchQueue:
+    """Deficit-weighted round-robin over per-model batch FIFOs (DRR,
+    Shreedhar & Varghese) — the cross-model fairness half of the
+    multi-model dispatcher. Each model key owns a FIFO and a deficit
+    counter measured in rows; a ``get()`` serves the head of the ring
+    while its deficit covers the head batch, refilling deficits by
+    ``quantum × weight`` per ring pass, so a model flooding the queue
+    advances the ring instead of monopolizing it. With a single active
+    key the queue degenerates to plain FIFO (no deficit churn).
+    ``_STOP`` pills deliver only once no batch remains — workers drain
+    formed work before exiting, same contract as the FIFO it replaces.
+    """
+
+    def __init__(self, quantum: int, weight_of=None):
+        self._cv = threading.Condition()
+        self._quantum = max(1, int(quantum))
+        self._weight_of = weight_of
+        self._subq: Dict[object, deque] = {}
+        self._ring: deque = deque()
+        self._deficit: Dict[object, float] = {}
+        self._stops = 0
+        self._size = 0
+
+    def put(self, item) -> None:
+        with self._cv:
+            if item is _STOP:
+                self._stops += 1
+            else:
+                key = item.model
+                q = self._subq.get(key)
+                if q is None:
+                    q = self._subq[key] = deque()
+                    self._deficit[key] = 0.0
+                    self._ring.append(key)
+                q.append(item)
+                self._size += 1
+            self._cv.notify()
+
+    def _pop_locked(self):
+        if self._size == 0:
+            return None
+        while True:
+            key = self._ring[0]
+            q = self._subq.get(key)
+            if not q:
+                # retire the idle key; a fresh arrival re-enters the
+                # ring with a zero deficit (no banked credit)
+                self._ring.popleft()
+                self._subq.pop(key, None)
+                self._deficit.pop(key, None)
+                continue
+            head = q[0]
+            need = max(1, head.rows)
+            if len(self._ring) == 1 or self._deficit[key] >= need:
+                self._deficit[key] = max(0.0, self._deficit[key] - need)
+                q.popleft()
+                self._size -= 1
+                return head
+            w = 1.0 if self._weight_of is None else \
+                max(1e-3, float(self._weight_of(key)))
+            self._deficit[key] += self._quantum * w
+            self._ring.rotate(-1)
+
+    def get(self):
+        with self._cv:
+            while True:
+                item = self._pop_locked()
+                if item is not None:
+                    return item
+                if self._stops:
+                    self._stops -= 1
+                    return _STOP
+                self._cv.wait()
+
+    def get_nowait(self):
+        with self._cv:
+            item = self._pop_locked()
+            if item is None:
+                raise queue.Empty
+            return item
+
+    def qsize(self) -> int:
+        with self._cv:
+            return self._size
 
 
 class ParallelInference:
@@ -182,7 +300,7 @@ class ParallelInference:
     entries get a pinned copy of the model, ``coalesce=False`` is
     INPLACE mode (one request = one dispatch, no padding)."""
 
-    def __init__(self, net, max_batch_size: int = 32,
+    def __init__(self, net=None, max_batch_size: int = 32,
                  max_latency_ms: float = 5.0, queue_capacity: int = 256,
                  reject_when_full: bool = False,
                  replicas: Optional[int] = None,
@@ -192,17 +310,28 @@ class ParallelInference:
                  eager_when_idle: bool = True, start: bool = True,
                  max_batch_retries: int = 1,
                  probe_interval_ms: float = 50.0,
-                 poison_hook=None):
-        if net.params is None:
+                 poison_hook=None,
+                 registry=None,
+                 max_sessions: int = 4096):
+        if net is None and registry is None:
+            raise ValueError("ParallelInference needs a net or a registry")
+        if net is not None and registry is not None:
+            raise ValueError(
+                "net= and registry= are exclusive: register the net as a "
+                "model in the registry instead")
+        if net is not None and net.params is None:
             net.init()
         self.net = net
+        self._registry = registry
         self.max_batch_size = int(max_batch_size)
         if self.max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
         self.max_latency = max(0.0, float(max_latency_ms)) / 1e3
         self.reject_when_full = bool(reject_when_full)
         if coalesce is None:
-            coalesce = net._pad_tail_safe() if hasattr(net, "_pad_tail_safe") else True
+            coalesce = (net._pad_tail_safe()
+                        if net is not None and hasattr(net, "_pad_tail_safe")
+                        else True)
         self.coalesce = bool(coalesce)
         self.buckets: Tuple[int, ...] = tuple(sorted(
             buckets if buckets is not None else bucket_sizes(self.max_batch_size)))
@@ -211,12 +340,30 @@ class ParallelInference:
             devs = devs[:max(1, int(replicas))]
         if not devs:
             raise ValueError("no devices to place replicas on")
-        self._fn = net.infer_output_fn()
-        self._np_dtype = np.dtype(net._dtype)
-        with span("stage", path="infer_replicas", replicas=len(devs)):
-            self._replicas = [
-                (d, jax.device_put(net.params, d), jax.device_put(net.states, d))
-                for d in devs]
+        if net is not None:
+            self._fn = net.infer_output_fn()
+            self._np_dtype = np.dtype(net._dtype)
+            with span("stage", path="infer_replicas", replicas=len(devs)):
+                self._replicas = [
+                    (d, jax.device_put(net.params, d),
+                     jax.device_put(net.states, d))
+                    for d in devs]
+        else:
+            # registry mode: params pin lazily per (model, version,
+            # device) through the registry's memory budget
+            self._fn = None
+            self._np_dtype = None
+            self._replicas = [(d, None, None) for d in devs]
+            registry.attach(self)
+        # decode sessions pin the version they started on — a
+        # mid-stream hot-swap must never switch the KV-cache owner
+        self._session_versions: "OrderedDict[Tuple[str, str], int]" = \
+            OrderedDict()
+        self._max_sessions = max(1, int(max_sessions))
+        # model -> (version, per-example shape): the known-good probe
+        # program per model, and the last wall time model probes ran
+        self._model_probe: Dict[str, Tuple[int, Tuple[int, ...]]] = {}
+        self._model_probe_at = 0.0
         # adaptive-batching discipline (Clipper/TF-Serving): requests
         # wait out the coalescing window ONLY while every replica is
         # busy — idle capacity dispatches immediately, so light load
@@ -224,7 +371,11 @@ class ParallelInference:
         self.eager_when_idle = bool(eager_when_idle)
         self._inflight = 0  # batches queued or running on a replica
         self._rq: "queue.Queue" = queue.Queue(maxsize=max(1, int(queue_capacity)))
-        self._bq: "queue.Queue" = queue.Queue()
+        # formed batches dispatch in deficit-weighted round-robin order
+        # across models (plain FIFO when only one model is in flight)
+        self._bq = _FairBatchQueue(
+            quantum=self.max_batch_size,
+            weight_of=registry.weight if registry is not None else None)
         self._closed = False
         self._error: Optional[BaseException] = None
         self._lock = threading.Lock()
@@ -277,17 +428,69 @@ class ParallelInference:
             self._threads.append(w)
         return self
 
-    def submit(self, x: np.ndarray) -> "Future[np.ndarray]":
+    def _resolve_model(self, model: Optional[str], version: Optional[int],
+                       session: Optional[str]):
+        """(model, version, ModelVersion|None, coalescible) for one
+        request. Registry mode resolves the version AT SUBMIT TIME —
+        that is what makes a deploy's cutover atomic: requests resolved
+        before the swap finish on the old version, requests after it
+        get the new one. A ``session`` pins the version it first
+        resolved (decode streams must not switch KV-cache owners
+        mid-stream); rejected/pruned pinned versions re-resolve."""
+        if self._registry is None:
+            if model is not None:
+                raise ValueError(
+                    "this engine serves one pinned net; build it with "
+                    "registry= for model= routing")
+            return None, None, None, True
+        if model is None:
+            raise ValueError("registry-mode engine requires model=")
+        from deeplearning4j_tpu.serving.registry import (STATE_REJECTED,
+                                                         ModelUnavailable)
+        pinned = None
+        if session is not None and version is None:
+            with self._lock:
+                pinned = self._session_versions.get((model, session))
+        if pinned is not None:
+            try:
+                mv = self._registry.version(model, pinned)
+                if mv.state != STATE_REJECTED:
+                    version = pinned
+            except ModelUnavailable:
+                pass  # pruned: the session re-pins on the fresh resolve
+        v = self._registry.resolve(model, version)
+        if session is not None:
+            with self._lock:
+                self._session_versions[(model, session)] = v
+                while len(self._session_versions) > self._max_sessions:
+                    self._session_versions.popitem(last=False)
+        mv = self._registry.version(model, v)
+        return model, v, mv, self._registry.entry(model).coalesce
+
+    def release_session(self, session: str, model: Optional[str] = None) -> None:
+        """Drop a session's version pins (stream finished)."""
+        with self._lock:
+            for key in [k for k in self._session_versions
+                        if k[1] == session and (model is None or k[0] == model)]:
+                self._session_versions.pop(key, None)
+
+    def submit(self, x: np.ndarray, model: Optional[str] = None,
+               version: Optional[int] = None,
+               session: Optional[str] = None) -> "Future[np.ndarray]":
         """Enqueue one request (``x``: [n, ...features]); the Future
-        resolves to the [n, ...out] predictions for exactly those rows."""
+        resolves to the [n, ...out] predictions for exactly those rows.
+        Registry mode routes by ``model=`` (and optionally a pinned
+        ``version=``); the version is resolved here, atomically with
+        respect to deploys."""
         if self._closed:
             raise RuntimeError("ParallelInference is shut down")
-        x = np.asarray(x, dtype=self._np_dtype)
+        model, v, mv, coalescible = self._resolve_model(model, version, session)
+        x = np.asarray(x, dtype=self._np_dtype if mv is None else mv.np_dtype)
         if x.ndim < 2:
             raise ValueError(
                 f"requests carry their batch dimension: got shape {x.shape}; "
                 "a single example must be submitted as x[None, ...]")
-        return self._enqueue(_Request(x))
+        return self._enqueue(_Request(x, model, v, coalescible))
 
     def _enqueue(self, req: _Request) -> "Future[np.ndarray]":
         try:
@@ -303,10 +506,11 @@ class ParallelInference:
         self._depth_gauge().set(self._rq.qsize())
         return req.future
 
-    def output(self, x: np.ndarray, timeout: Optional[float] = None) -> np.ndarray:
+    def output(self, x: np.ndarray, timeout: Optional[float] = None,
+               **kwargs) -> np.ndarray:
         """Blocking facade: inline ``net.output`` semantics through the
-        batching engine."""
-        return self.submit(x).result(timeout=timeout)
+        batching engine (``model=``/``version=`` in registry mode)."""
+        return self.submit(x, **kwargs).result(timeout=timeout)
 
     # ---------------------------------------------------- generation
 
@@ -322,7 +526,9 @@ class ParallelInference:
     def submit_generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                         temperature: float = 0.0, top_k: int = 0,
                         top_p: float = 0.0, eos_token: Optional[int] = None,
-                        seed: int = 0) -> "Future[np.ndarray]":
+                        seed: int = 0, model: Optional[str] = None,
+                        version: Optional[int] = None,
+                        session: Optional[str] = None) -> "Future[np.ndarray]":
         """Enqueue one decode request (``prompt_ids``: [n, t0] int
         tokens); the Future resolves to the [n, t0 + max_new_tokens]
         ids a solo ``net.generate`` of the same rows would return.
@@ -330,11 +536,15 @@ class ParallelInference:
         sampler) across replicas — the prompt length enters the
         compiled program as a traced per-row vector, so any prompt mix
         inside a bucket shares one AOT-warmable program, and per-row
-        PRNG keys make a request's draws coalescing-invariant."""
+        PRNG keys make a request's draws coalescing-invariant. A
+        ``session`` pins the (model, version) its first burst resolved
+        — later bursts of the stream stay on that version through any
+        deploy (the KV state lives with the version's programs)."""
         if self._closed:
             raise RuntimeError("ParallelInference is shut down")
         from deeplearning4j_tpu.nn.generate import row_keys, sampler_sig
-        gen = self._generator()
+        model, v, mv, coalescible = self._resolve_model(model, version, session)
+        gen = self._generator() if mv is None else mv.generator()
         prompt = np.asarray(prompt_ids)
         if prompt.ndim != 2:
             raise ValueError(
@@ -350,7 +560,8 @@ class ParallelInference:
                             "generate() requests").inc()
         return self._enqueue(_GenRequest(
             ids, lengths, keys, t_in, max_new,
-            sampler_sig(temperature, top_k, top_p, eos_token)))
+            sampler_sig(temperature, top_k, top_p, eos_token),
+            model, v, coalescible))
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  timeout: Optional[float] = None, **kwargs) -> np.ndarray:
@@ -361,7 +572,9 @@ class ParallelInference:
     def warmup_generate(self, prompt_lengths: Sequence[int],
                         max_new_tokens: int, temperature: float = 0.0,
                         top_k: int = 0, top_p: float = 0.0,
-                        eos_token: Optional[int] = None) -> int:
+                        eos_token: Optional[int] = None,
+                        model: Optional[str] = None,
+                        version: Optional[int] = None) -> int:
         """AOT-compile the decode program set: for every prompt-length
         bucket covering ``prompt_lengths``, run a zero-prompt batch of
         every row-bucket size on every replica (prefill + decode).
@@ -371,10 +584,18 @@ class ParallelInference:
         compiles (observable via ``dl4j_jit_cache_miss_total``)."""
         from deeplearning4j_tpu.monitor import JIT_CACHE_MISS_COUNTER
         from deeplearning4j_tpu.nn.generate import row_keys, sampler_sig
-        gen = self._generator()
+        if model is not None and self._registry is None:
+            raise ValueError("model= needs a registry-mode engine")
+        mv = None
+        if model is not None:
+            v = self._registry.resolve(model, version)
+            mv = self._registry.version(model, v)
+        gen = self._generator() if mv is None else mv.generator()
         sampler = sampler_sig(temperature, top_k, top_p, eos_token)
         max_new = int(max_new_tokens)
         sizes = self.buckets if self.coalesce else (1,)
+        if mv is not None:
+            sizes = self._model_buckets(model) if self.coalesce else (1,)
         reg = self._reg()
         before = reg.family_total(JIT_CACHE_MISS_COUNTER)
         done = set()
@@ -388,11 +609,17 @@ class ParallelInference:
                 lengths = np.full((rows,), min(int(t_in), t_pad), np.int32)
                 keys = np.asarray(row_keys(0, rows))
                 for i, (dev, params, states) in enumerate(self._replicas):
+                    if mv is not None:
+                        _, params, states = self._registry.acquire(
+                            model, mv.version, dev)
                     with span("stage", path="warmup_generate", bucket=t_pad,
                               rows=rows, replica=i):
                         gen.run(params, ids, lengths, max_new, sampler,
                                 keys, replica=i, device=dev)
-        self._warmed = True
+        if mv is not None:
+            mv.warmed = True
+        else:
+            self._warmed = True
         return int(reg.family_total(JIT_CACHE_MISS_COUNTER) - before)
 
     def warmup(self, shapes: Sequence[Tuple[int, ...]]) -> int:
@@ -401,7 +628,17 @@ class ParallelInference:
         bucket size on every replica (sequentially, blocking until each
         executable is built). Returns the number of fresh programs
         compiled; after it, steady-state serving of any request mix
-        within the bucket set performs zero XLA compiles."""
+        within the bucket set performs zero XLA compiles. In registry
+        mode this warms EVERY registered model's serving version with
+        ``shapes`` (per-model ``warm_shapes`` take precedence when
+        set); use :meth:`warmup_model` for one model."""
+        if self._registry is not None:
+            compiled = 0
+            for name in self._registry.models():
+                entry = self._registry.entry(name)
+                compiled += self.warmup_model(
+                    name, shapes=entry.warm_shapes or shapes)
+            return compiled
         sizes = self.buckets if self.coalesce else (1,)
         compiled = 0
         for shape in shapes:
@@ -421,11 +658,63 @@ class ParallelInference:
         self._warmed = True
         return compiled
 
+    def _model_buckets(self, model: Optional[str]) -> Tuple[int, ...]:
+        """The row-bucket ladder for one model: its registry override,
+        else the engine ladder."""
+        if model is not None and self._registry is not None:
+            entry = self._registry.entry(model)
+            if entry.buckets:
+                return entry.buckets
+        return self.buckets
+
+    def warmup_model(self, model: str, version: Optional[int] = None,
+                     shapes: Optional[Sequence[Tuple[int, ...]]] = None) -> int:
+        """AOT-compile one model version's serving programs (every
+        bucket × replica) OFF the hot path — what a registry deploy
+        runs before its atomic cutover, so the first post-cutover
+        request never eats an XLA compile. ``version=None`` warms the
+        version fresh requests would resolve to. Returns fresh-program
+        count."""
+        if self._registry is None:
+            raise ValueError("warmup_model needs a registry-mode engine")
+        if version is not None:
+            # explicit version bypasses the breaker check: deploying a
+            # FIXED version is how a quarantined model gets replaced
+            v = int(version)
+        else:
+            v = self._registry.resolve(model, None)
+        mv = self._registry.version(model, v)
+        shapes = [tuple(s) for s in
+                  (shapes or self._registry.entry(model).warm_shapes or [])]
+        entry = self._registry.entry(model)
+        sizes = self._model_buckets(model) if (self.coalesce and entry.coalesce) \
+            else (1,)
+        compiled = 0
+        net = mv.net()
+        for shape in shapes:
+            for b in sizes:
+                zeros = np.zeros((b,) + tuple(shape), mv.np_dtype)
+                for i, (dev, _, _) in enumerate(self._replicas):
+                    fn, params, states = self._registry.acquire(model, v, dev)
+                    x = jax.device_put(zeros, dev)
+                    fresh = note_dispatch(
+                        net, self._dispatch_sig(i, zeros.shape, model, v))
+                    with span("compile" if fresh else "inference",
+                              path="warmup_model", model=model, version=v,
+                              bucket=b, replica=i):
+                        np.asarray(fn(params, states, x, None))
+                    compiled += int(fresh)
+            with self._lock:
+                self._model_probe[model] = (v, tuple(shape))
+        mv.warmed = True
+        return compiled
+
     def stats(self) -> Dict[str, float]:
         with self._lock:
             rows, padded = self._rows_dispatched, self._rows_padded
             quarantined = sorted(self._quarantined)
-            return {
+            sessions = len(self._session_versions)
+            out = {
                 "requests": self._requests,
                 "batches": self._batches,
                 "rows_dispatched": rows,
@@ -442,6 +731,19 @@ class ParallelInference:
                 "warmed": self._warmed,
                 "faults": len(self._fault_log),
             }
+        if self._registry is not None:
+            # per-model lifecycle view (outside the engine lock: the
+            # registry has its own)
+            models = self._registry.stats()
+            open_models = sorted(n for n, m in models.items()
+                                 if m["breaker_open"])
+            out["models"] = models
+            out["models_quarantined"] = open_models
+            out["sessions"] = sessions
+            out["degraded"] = out["degraded"] or bool(open_models)
+            out["warmed"] = bool(models) and all(
+                m["warmed"] for m in models.values())
+        return out
 
     def drain(self, timeout: Optional[float] = None,
               poll_s: float = 2e-3) -> bool:
@@ -470,10 +772,12 @@ class ParallelInference:
 
     def probe_now(self) -> None:
         """Wake every quarantined replica's probe immediately (instead
-        of waiting out ``probe_interval_ms``) — the deterministic seam
-        the fault-injection tests and operators use."""
+        of waiting out ``probe_interval_ms``) and probe every
+        open-breaker model synchronously — the deterministic seam the
+        fault-injection tests and operators use."""
         for ev in self._probe_wake.values():
             ev.set()
+        self._probe_open_models()
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None) -> None:
         """Stop accepting work; drain (default) or cancel what is queued,
@@ -536,11 +840,15 @@ class ParallelInference:
     def _sig(req: _Request) -> Tuple:
         return req.sig()
 
-    def _dispatch_sig(self, replica: int, shape: Tuple[int, ...]) -> Tuple:
+    def _dispatch_sig(self, replica: int, shape: Tuple[int, ...],
+                      model: Optional[str] = None,
+                      version: Optional[int] = None) -> Tuple:
         """jit-cache-miss signature of one device dispatch: program kind
         + operand shape + replica (each replica's placement compiles its
-        own executable, so warmup must cover all of them)."""
-        return ("infer_output", replica, tuple(shape), str(self._np_dtype))
+        own executable, so warmup must cover all of them) + the model
+        version it ran for (multi-model engines compile per version)."""
+        return ("infer_output", replica, tuple(shape),
+                str(self._np_dtype), model, version)
 
     def _dispatch_loop(self):
         pending: Dict[Tuple, List[_Request]] = {}
@@ -561,10 +869,16 @@ class ParallelInference:
             if oldest:
                 timeout = max(
                     1e-4, min(oldest.values()) + self.max_latency - time.perf_counter())
+            elif self._registry is not None:
+                # bounded idle wakeups so open model breakers get their
+                # probes even when no submit arrives to trigger one
+                timeout = self.probe_interval
             try:
                 item = self._rq.get(timeout=timeout)
             except queue.Empty:
                 item = None
+            if item is None and self._registry is not None:
+                self._maybe_probe_models()
             if item is _STOP:
                 # a submit() racing shutdown may have enqueued behind the
                 # stop pill — drain it too so no accepted future strands
@@ -588,8 +902,10 @@ class ParallelInference:
                 return
             if item is not None:
                 self._depth_gauge().set(self._rq.qsize())
-                if not self.coalesce or item.n >= self.max_batch_size:
-                    # INPLACE mode / oversized request: its own batch
+                if not self.coalesce or not item.coalescible \
+                        or item.n >= self.max_batch_size:
+                    # INPLACE mode / batch-statistics model / oversized
+                    # request: its own batch
                     self._bq.put(self._form_batch([item]))
                 else:
                     sig = self._sig(item)
@@ -615,20 +931,22 @@ class ParallelInference:
         x = reqs[0].x if len(reqs) == 1 else np.concatenate(
             [r.x for r in reqs], axis=0)
         payload = None
+        pad_ok = self.coalesce and reqs[0].coalescible
+        buckets = self._model_buckets(reqs[0].model)
         if isinstance(reqs[0], _GenRequest):
             # decode batch: per-row lengths + PRNG keys ride along;
             # row-bucket padding uses length 0 — the decode program's
             # done-mask retires those rows on their first step
             lengths = np.concatenate([r.lengths for r in reqs])
             keys = np.concatenate([r.keys for r in reqs], axis=0)
-            if self.coalesce:
-                pad = bucket_for(rows, self.buckets) - rows
+            if pad_ok:
+                pad = bucket_for(rows, buckets) - rows
                 x = pad_rows(x, pad)
                 lengths = pad_rows(lengths, pad)
                 keys = pad_rows(keys, pad)
             payload = (lengths, keys, reqs[0].max_new, reqs[0].sampler)
-        elif self.coalesce:
-            x = pad_rows(x, bucket_for(rows, self.buckets) - rows)
+        elif pad_ok:
+            x = pad_rows(x, bucket_for(rows, buckets) - rows)
         with self._lock:
             self._inflight += 1  # until delivered or failed, not requeues
             self._batches += 1
@@ -644,17 +962,30 @@ class ParallelInference:
         reg.gauge(INFER_PADDED_RATIO_GAUGE,
                   "Cumulative fraction of dispatched rows that were bucket "
                   "padding").set(ratio)
-        return _Batch(reqs, x, rows, payload)
+        return _Batch(reqs, x, rows, payload,
+                      model=reqs[0].model, version=reqs[0].version)
 
     # ------------------------------------------------------------ workers
 
-    def _dispatch(self, idx: int, params, states, x):
+    def _hook(self, idx: int, shape, model: Optional[str]) -> None:
+        """Invoke the faultinject poison seam; model-aware hooks
+        (``wants_model=True`` — ``ModelPoison``) also see which model
+        the dispatch ran for."""
+        h = self._poison_hook
+        if h is None:
+            return
+        if getattr(h, "wants_model", False):
+            h(idx, shape, model)
+        else:
+            h(idx, shape)
+
+    def _dispatch(self, idx: int, params, states, x, fn=None,
+                  model: Optional[str] = None):
         """One replica dispatch; the ``poison_hook`` seam lets the
         faultinject harness stand in for a device fault
         deterministically (it raises instead of the device)."""
-        if self._poison_hook is not None:
-            self._poison_hook(idx, x.shape)
-        return self._fn(params, states, x, None)
+        self._hook(idx, x.shape, model)
+        return (self._fn if fn is None else fn)(params, states, x, None)
 
     def _worker_loop(self, idx: int):
         dev, params, states = self._replicas[idx]
@@ -675,33 +1006,93 @@ class ParallelInference:
                 return
             err = self._run_batch(idx, dev, params, states, b, lat)
             if err is not None:
-                self._quarantine(idx, b, err)
+                self._fault_verdict(idx, b, err)
+
+    def _fault_verdict(self, idx: int, b: _Batch, err: BaseException) -> None:
+        """Attribute a batch fault (same-replica retries exhausted):
+        multi-model batches ask the registry first — a fault the
+        breaker pins on the MODEL fails the batch model-scoped and
+        leaves the replica in the pool for its cotenants; a canary
+        fault that just rolled the canary back fails the batch without
+        touching either; anything else follows the PR-4 replica
+        quarantine/redispatch path."""
+        verdict = "retry"
+        if b.model is not None:
+            verdict = self._registry.note_error(b.model, b.version)
+        if verdict == "model_open":
+            from deeplearning4j_tpu.serving.registry import ModelQuarantined
+            mq = ModelQuarantined(
+                f"model {b.model!r} v{b.version} quarantined after "
+                f"cross-replica faults ({type(err).__name__}: {err})")
+            mq.__cause__ = err
+            mark("model_batch_failed", model=b.model, version=b.version,
+                 scope="model")
+            self._fail_batch(b, mq)
+        elif verdict == "version_rejected":
+            mark("model_batch_failed", model=b.model, version=b.version,
+                 scope="version")
+            self._fail_batch(b, err)
+        else:
+            self._quarantine(idx, b, err)
+
+    def _fail_batch(self, b: _Batch, err: BaseException) -> None:
+        """Resolve a model-scoped failed batch: futures carry the typed
+        error, the engine (and its replicas) stay healthy."""
+        failed = 0
+        for r in b.requests:
+            if not r.future.done():
+                r.future.set_exception(err)
+                failed += 1
+        with self._lock:
+            self._inflight -= 1
+            self._resolved += failed
 
     def _run_batch(self, idx, dev, params, states, b, lat):
         """Run one batch with the per-replica retry budget; None on
         success (futures resolved), else the last error (batch NOT yet
-        resolved — the caller decides quarantine/redispatch)."""
+        resolved — the caller decides quarantine/redispatch). Model
+        batches resolve (fn, params, states) through the registry's
+        per-device pins; canary batches additionally pay a host-side
+        NaN scan so the canary watch sees poisoned outputs."""
+        fn, gen, net, nan_check = self._fn, None, self.net, False
+        if b.model is not None:
+            try:
+                mv = self._registry.version(b.model, b.version)
+                fn, params, states = self._registry.acquire(
+                    b.model, b.version, dev)
+                net = mv.net()
+                if b.payload is not None:
+                    gen = mv.generator()
+                nan_check = self._registry.wants_nan_check(b.model, b.version)
+            except BaseException as e:
+                record_fault("serving")
+                self._fault_log.append(
+                    f"replica {idx} acquire {b.model} v{b.version}: "
+                    f"{type(e).__name__}: {e}")
+                return e
         last: Optional[BaseException] = None
         for attempt in range(1 + self.max_batch_retries):
+            t_disp = time.perf_counter()
             try:
                 if b.payload is not None:
                     # fused decode batch: prefill + one-scan decode on
                     # this replica's pinned params (two dispatches)
                     lengths, keys, max_new, sampler = b.payload
-                    if self._poison_hook is not None:
-                        self._poison_hook(idx, b.x.shape)
-                    y = self._generator().run(
+                    self._hook(idx, b.x.shape, b.model)
+                    y = (gen if gen is not None else self._generator()).run(
                         params, b.x, lengths, max_new, sampler, keys,
                         replica=idx, device=dev)
                 else:
                     with span("stage", path="infer_feed", replica=idx):
                         x = jax.device_put(b.x, dev)
-                    fresh = note_dispatch(self.net,
-                                          self._dispatch_sig(idx, b.x.shape))
+                    fresh = note_dispatch(
+                        net, self._dispatch_sig(idx, b.x.shape,
+                                                b.model, b.version))
                     with span("compile" if fresh else "inference",
                               path="parallel_inference", replica=idx,
                               rows=b.rows, batch=int(b.x.shape[0])):
-                        y = np.asarray(self._dispatch(idx, params, states, x))
+                        y = np.asarray(self._dispatch(
+                            idx, params, states, x, fn=fn, model=b.model))
             except BaseException as e:
                 last = e
                 record_fault("serving")
@@ -712,6 +1103,13 @@ class ParallelInference:
             if b.payload is None:
                 with self._lock:
                     self._probe_shape = tuple(b.x.shape[1:])
+                    if b.model is not None:
+                        self._model_probe[b.model] = (
+                            b.version, tuple(b.x.shape[1:]))
+            nan = False
+            if nan_check and np.issubdtype(np.asarray(y).dtype, np.floating):
+                # canary-only host scan: the NaN-output rollback signal
+                nan = bool(np.isnan(np.asarray(y)).any())
             off = 0
             now = time.perf_counter()
             for r in b.requests:
@@ -721,6 +1119,12 @@ class ParallelInference:
             with self._lock:
                 self._inflight -= 1
                 self._resolved += len(b.requests)
+            if b.model is not None:
+                self._registry.note_result(
+                    b.model, b.version, (now - t_disp) * 1e3,
+                    rows=len(b.requests), nan=nan,
+                    shape=(tuple(b.x.shape[1:]) if b.payload is None
+                           else None))
             return None
         return last
 
@@ -757,21 +1161,47 @@ class ParallelInference:
             self._inflight -= 1
             self._resolved += failed
 
+    def _probe_program(self, idx: int, dev, params, states):
+        """(fn, params, states, shape, dtype, net, model, version) of a
+        known-good single-row probe, or None when nothing trustworthy
+        has served yet. Registry mode picks a model whose breaker is
+        CLOSED — probing a quarantined replica with a poisoned model
+        would pin the model's fault on the replica forever."""
+        if self._registry is None:
+            with self._lock:
+                shape = self._probe_shape
+            if shape is None:
+                return None
+            return (self._fn, params, states, shape, self._np_dtype,
+                    self.net, None, None)
+        with self._lock:
+            cands = sorted(self._model_probe.items())
+        for m, (v, shape) in cands:
+            if self._registry.breaker_open(m):
+                continue
+            try:
+                fn, p, s = self._registry.acquire(m, v, dev)
+                mv = self._registry.version(m, v)
+                return fn, p, s, shape, mv.np_dtype, mv.net(), m, v
+            except BaseException:
+                continue
+        return None
+
     def _probe(self, idx: int, dev, params, states) -> None:
         """Reinstatement probe: dispatch a known-good single-row program
         on the quarantined replica; pass → rejoin the pool. Before any
         shape has served successfully there is nothing trustworthy to
         probe with — reinstate optimistically and let real traffic
         re-quarantine if the replica is still sick."""
-        with self._lock:
-            shape = self._probe_shape
-        if shape is not None:
+        probe = self._probe_program(idx, dev, params, states)
+        if probe is not None:
+            fn, p, s, shape, dtype, net, m, v = probe
             try:
-                zeros = np.zeros((1,) + shape, self._np_dtype)
+                zeros = np.zeros((1,) + tuple(shape), dtype)
                 x = jax.device_put(zeros, dev)
-                note_dispatch(self.net, self._dispatch_sig(idx, zeros.shape))
+                note_dispatch(net, self._dispatch_sig(idx, zeros.shape, m, v))
                 with span("inference", path="quarantine_probe", replica=idx):
-                    np.asarray(self._dispatch(idx, params, states, x))
+                    np.asarray(self._dispatch(idx, p, s, x, fn=fn, model=m))
             except BaseException as e:
                 record_fault("serving")
                 self._fault_log.append(
@@ -782,3 +1212,53 @@ class ParallelInference:
             n_quarantined = len(self._quarantined)
         self._quarantined_gauge().set(n_quarantined)
         mark("replica_reinstated", replica=idx)
+
+    # ---------------------------------------------- model circuit probes
+
+    def _maybe_probe_models(self) -> None:
+        """Throttled idle-path model probing (the dispatcher calls this
+        on its bounded wakeups)."""
+        now = time.monotonic()
+        if now - self._model_probe_at < self.probe_interval:
+            return
+        self._model_probe_at = now
+        self._probe_open_models()
+
+    def _probe_open_models(self) -> None:
+        """Probe every open-breaker model with a one-row known-good
+        dispatch; a pass closes the breaker and the model rejoins the
+        pool — the version-level mirror of replica reinstatement."""
+        if self._registry is None:
+            return
+        for name in self._registry.open_models():
+            version, shape, dtype = self._registry.probe_info(name)
+            if version is None:
+                continue
+            if shape is None:
+                # nothing known-good to probe with: reinstate
+                # optimistically; real traffic re-opens if still sick
+                self._registry.close_breaker(name)
+                continue
+            with self._lock:
+                healthy = [i for i in range(len(self._replicas))
+                           if i not in self._quarantined]
+            idx = healthy[0] if healthy else 0
+            dev = self._replicas[idx][0]
+            try:
+                fn, params, states = self._registry.acquire(
+                    name, version, dev)
+                net = self._registry.version(name, version).net()
+                zeros = np.zeros((1,) + tuple(shape), dtype)
+                x = jax.device_put(zeros, dev)
+                note_dispatch(net, self._dispatch_sig(idx, zeros.shape,
+                                                      name, version))
+                with span("inference", path="model_probe", model=name,
+                          replica=idx):
+                    self._hook(idx, zeros.shape, name)
+                    np.asarray(fn(params, states, x, None))
+            except BaseException as e:
+                record_fault("serving")
+                self._fault_log.append(
+                    f"model {name} probe: {type(e).__name__}: {e}")
+                continue  # still sick — breaker stays open
+            self._registry.close_breaker(name)

@@ -25,6 +25,12 @@ from deeplearning4j_tpu.serving.endpoint import (  # noqa: F401
     RemoteEndpoint,
 )
 from deeplearning4j_tpu.serving.fleet import LocalFleet  # noqa: F401
+from deeplearning4j_tpu.serving.registry import (  # noqa: F401
+    ModelQuarantined,
+    ModelRegistry,
+    ModelUnavailable,
+    ModelVersion,
+)
 from deeplearning4j_tpu.serving.policy import (  # noqa: F401
     ScaleDecision,
     ScalePolicy,

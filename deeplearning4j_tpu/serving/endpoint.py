@@ -50,16 +50,24 @@ class EndpointTimeout(EndpointError):
 
 
 class EngineEndpoint:
-    """SPI one serving engine presents to the router."""
+    """SPI one serving engine presents to the router. ``model=`` /
+    ``version=`` / ``session=`` route multi-model engines; a
+    single-model engine ignores them (None)."""
 
     name: str
 
     def submit(self, x: np.ndarray,
-               timeout_s: Optional[float] = None) -> "Future[np.ndarray]":
+               timeout_s: Optional[float] = None,
+               model: Optional[str] = None,
+               version: Optional[int] = None,
+               session: Optional[str] = None) -> "Future[np.ndarray]":
         raise NotImplementedError
 
     def submit_generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                         timeout_s: Optional[float] = None,
+                        model: Optional[str] = None,
+                        version: Optional[int] = None,
+                        session: Optional[str] = None,
                         **kwargs) -> "Future[np.ndarray]":
         raise NotImplementedError
 
@@ -87,13 +95,19 @@ class LocalEndpoint(EngineEndpoint):
         self.engine = engine
         self.name = name
 
-    def submit(self, x, timeout_s=None):
-        return self.engine.submit(x)
+    def submit(self, x, timeout_s=None, model=None, version=None,
+               session=None):
+        kw = {k: v for k, v in (("model", model), ("version", version),
+                                ("session", session)) if v is not None}
+        return self.engine.submit(x, **kw)
 
     def submit_generate(self, prompt_ids, max_new_tokens,
-                        timeout_s=None, **kwargs):
+                        timeout_s=None, model=None, version=None,
+                        session=None, **kwargs):
+        kw = {k: v for k, v in (("model", model), ("version", version),
+                                ("session", session)) if v is not None}
         return self.engine.submit_generate(prompt_ids, max_new_tokens,
-                                           **kwargs)
+                                           **kw, **kwargs)
 
     def stats(self):
         return self.engine.stats()
@@ -166,7 +180,10 @@ class RemoteEndpoint(EngineEndpoint):
 
     def _submit_frame(self, kind: str, x: np.ndarray,
                       gen: Optional[Dict[str, Any]],
-                      timeout_s: Optional[float]) -> "Future[np.ndarray]":
+                      timeout_s: Optional[float],
+                      model: Optional[str] = None,
+                      version: Optional[int] = None,
+                      session: Optional[str] = None) -> "Future[np.ndarray]":
         if self._closed:
             raise EndpointError(f"endpoint {self.name} is closed")
         corr = f"{self.name}-{next(self._ids)}"
@@ -178,7 +195,9 @@ class RemoteEndpoint(EngineEndpoint):
         try:
             self._broker.publish(
                 self.service + wire.REQ_SUFFIX,
-                wire.pack_request(corr, self.reply_topic, kind, x, gen))
+                wire.pack_request(corr, self.reply_topic, kind, x, gen,
+                                  model=model, version=version,
+                                  session=session))
         except BaseException as e:
             with self._lock:
                 self._pending.pop(corr, None)
@@ -186,19 +205,22 @@ class RemoteEndpoint(EngineEndpoint):
                 f"publish to {self.name} failed: {type(e).__name__}: {e}"))
         return fut
 
-    def submit(self, x, timeout_s=None):
+    def submit(self, x, timeout_s=None, model=None, version=None,
+               session=None):
         return self._submit_frame(wire.KIND_CLASSIFY, np.asarray(x), None,
-                                  timeout_s)
+                                  timeout_s, model, version, session)
 
     def submit_generate(self, prompt_ids, max_new_tokens, timeout_s=None,
                         temperature: float = 0.0, top_k: int = 0,
                         top_p: float = 0.0, eos_token: Optional[int] = None,
-                        seed: int = 0):
+                        seed: int = 0, model=None, version=None,
+                        session=None):
         gen = {"max_new": int(max_new_tokens), "temperature": temperature,
                "top_k": top_k, "top_p": top_p, "eos_token": eos_token,
                "seed": seed}
         return self._submit_frame(wire.KIND_GENERATE,
-                                  np.asarray(prompt_ids), gen, timeout_s)
+                                  np.asarray(prompt_ids), gen, timeout_s,
+                                  model, version, session)
 
     # ----------------------------------------------------------- health
 
@@ -251,6 +273,12 @@ class RemoteEndpoint(EngineEndpoint):
                 if p is not None and not p.future.done():
                     if header.get("ok"):
                         p.future.set_result(result)
+                    elif header.get("etype"):
+                        # typed engine error: reconstruct the SAME
+                        # exception class a LocalEndpoint would raise
+                        # (shed / quarantine isolation contract)
+                        p.future.set_exception(wire.typed_error(
+                            header, fallback=EndpointError))
                     else:
                         p.future.set_exception(EndpointError(
                             f"{self.name}: {header.get('error')}"))

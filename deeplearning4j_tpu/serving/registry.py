@@ -1,0 +1,859 @@
+"""ModelRegistry — N models × versions behind one serving engine, with
+a crash-safe, zero-downtime lifecycle.
+
+Production traffic is many models (zoo variants, fine-tunes, A/B arms —
+the DL4J ModelZoo / TransferLearning shape), not one, and the hard part
+is the robustness contract, the model-lifecycle discipline of
+TF-Serving's version manager and Clipper's model-container isolation:
+
+- **Registry**: named models with integer-versioned params. A version
+  is backed by a live net, a ``util/model_serializer`` zip, or a PR-4
+  ``sharded_checkpoint`` unit — checkpoint-backed versions load lazily
+  and can be dropped from host memory under pressure and reloaded on
+  demand, so the registry can hold more models than fit at once.
+- **Device-memory budget** with LRU/priority eviction: parameters are
+  pinned per device on first dispatch and accounted by size; when a pin
+  would exceed ``memory_budget_bytes`` the least-recently-used,
+  lowest-priority pins are evicted (``dl4j_model_evictions_total``).
+  An evicted checkpoint-backed version reloads lazily from disk.
+- **Zero-downtime deploy**: :meth:`deploy` integrity-checks the new
+  version FIRST (``verify_model_file`` — a
+  :class:`~deeplearning4j_tpu.util.model_serializer.
+  CheckpointCorruptError` rejects the deploy while the old version
+  keeps serving), AOT-warms it off the hot path on every replica, then
+  atomically cuts over: requests resolved after the swap get the new
+  version, in-flight ones finish on the version they resolved.
+  :meth:`rollback` is instant — prior versions are retained
+  (``keep_versions``), exactly the ``ckpt-<step>`` history discipline.
+- **Canary**: ``deploy(..., canary_fraction=f)`` keeps the old version
+  active and routes a deterministic ``f`` of traffic to the new one;
+  the watch plane (the PR-4 supervisor/watchdog discipline applied to
+  versions) auto-rolls-back on error-rate, NaN-output, or p99
+  regression against the stable version; :meth:`promote` cuts over.
+- **Isolation**: a per-model circuit breaker. A model whose dispatches
+  fault on more than one replica is *model*-poisoned, not
+  replica-poisoned — the breaker opens
+  (``dl4j_model_breaker_open{model=...}``), its submits fail fast with
+  :class:`ModelQuarantined`, and the engine probes it with a known-good
+  one-row dispatch until it heals — cotenant models never stop
+  serving and no replica is taken out for a model's own fault.
+
+The registry itself never dispatches; the multi-model
+:class:`~deeplearning4j_tpu.parallel.inference.ParallelInference`
+(``registry=`` mode) resolves versions at submit time, pins params
+through :meth:`acquire` inside its workers, and reports outcomes back
+through :meth:`note_result` / :meth:`note_error`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.monitor import (
+    MODEL_ACTIVE_VERSION_GAUGE,
+    MODEL_BREAKER_OPEN_GAUGE,
+    MODEL_DEPLOYS_COUNTER,
+    MODEL_ERRORS_COUNTER,
+    MODEL_EVICTIONS_COUNTER,
+    MODEL_LATENCY_HISTOGRAM,
+    MODEL_PINNED_BYTES_GAUGE,
+    MODEL_REQUESTS_COUNTER,
+    MODEL_ROLLBACKS_COUNTER,
+    get_registry,
+    mark,
+    record_fault,
+)
+from deeplearning4j_tpu.util.model_serializer import (CheckpointCorruptError,
+                                                      restore_model,
+                                                      verify_model_file)
+
+
+class ModelUnavailable(RuntimeError):
+    """The named model (or version) cannot serve: unknown, retired, or
+    its parameters are gone and cannot be reloaded."""
+
+
+class ModelQuarantined(ModelUnavailable):
+    """The model's circuit breaker is open: its recent dispatches
+    faulted across replicas, so it is isolated from the serving pool
+    (cotenant models keep serving) until a probe heals it."""
+
+
+# version lifecycle states
+STATE_STAGED = "staged"      # loaded + warmed, not yet taking traffic
+STATE_ACTIVE = "active"      # the version new requests resolve to
+STATE_CANARY = "canary"      # taking canary_fraction of traffic
+STATE_RETIRED = "retired"    # superseded; retained for rollback
+STATE_REJECTED = "rejected"  # failed deploy/canary; never serves again
+
+
+def _tree_nbytes(tree) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += int(np.asarray(leaf).nbytes) if not hasattr(leaf, "nbytes") \
+            else int(leaf.nbytes)
+    return total
+
+
+class ModelVersion:
+    """One (model, version) — its params source, lazily-built programs,
+    per-device pins, and the per-version serving stats the canary watch
+    consumes."""
+
+    def __init__(self, name: str, version: int, net=None,
+                 path: Optional[str] = None):
+        if net is None and path is None:
+            raise ValueError("a version needs a net or a checkpoint path")
+        self.name = name
+        self.version = int(version)
+        self.path = path
+        self.state = STATE_STAGED
+        self.warmed = False
+        self._net = net
+        self._lock = threading.Lock()
+        self._nbytes: Optional[int] = None
+        # devkey -> (params, states); managed under the REGISTRY lock
+        self.pins: Dict[str, Tuple[Any, Any]] = {}
+        self.last_used = 0.0  # registry LRU tick
+        # serving stats (under the registry lock)
+        self.requests = 0
+        self.errors = 0
+        self.nans = 0
+        self.ewma_ms: Optional[float] = None
+        self.latencies: deque = deque(maxlen=256)
+
+    # ------------------------------------------------------------- load
+
+    def net(self):
+        """The live net, loading (and integrity-checking) from the
+        checkpoint path when the host copy was dropped or never built."""
+        with self._lock:
+            if self._net is None:
+                self._net = self._load()
+            if self._net.params is None:
+                self._net.init()
+            return self._net
+
+    def _load(self):
+        if self.path is None:
+            raise ModelUnavailable(
+                f"{self.name} v{self.version}: parameters were dropped and "
+                "there is no checkpoint path to reload from")
+        if os.path.isdir(self.path):
+            from deeplearning4j_tpu.util.sharded_checkpoint import (
+                restore_checkpoint, verify_checkpoint)
+            problems = verify_checkpoint(self.path)
+            if problems:
+                raise CheckpointCorruptError("; ".join(problems))
+            return restore_checkpoint(self.path)
+        return restore_model(self.path)  # verify_model_file runs inside
+
+    def drop_host(self) -> bool:
+        """Release the host copy (evicted past the device pins); only
+        checkpoint-backed versions can — others must keep their params.
+        Returns True when dropped."""
+        with self._lock:
+            if self.path is None:
+                return False
+            self._net = None
+            return True
+
+    # ---------------------------------------------------------- derived
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(self.net()._dtype)
+
+    def nbytes(self) -> int:
+        if self._nbytes is None:
+            self._nbytes = _tree_nbytes(self.net().params)
+        return self._nbytes
+
+    def fn(self):
+        """The version's jit-cached batched output program (each
+        version owns its net, so jit caches never mix versions)."""
+        return self.net().infer_output_fn()
+
+    def generator(self):
+        net = self.net()
+        gen = getattr(net, "_registry_gen", None)
+        if gen is None:
+            from deeplearning4j_tpu.nn.generate import build_generator
+            gen = net._registry_gen = build_generator(net)
+        return gen
+
+    def p99_ms(self) -> Optional[float]:
+        if not self.latencies:
+            return None
+        lats = sorted(self.latencies)
+        return lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+
+    def serving(self) -> bool:
+        return self.state in (STATE_ACTIVE, STATE_CANARY)
+
+
+class _CanaryWatch:
+    """Auto-rollback policy state for one in-flight canary."""
+
+    __slots__ = ("fraction", "min_requests", "max_error_rate", "p99_factor",
+                 "counter")
+
+    def __init__(self, fraction: float, min_requests: int,
+                 max_error_rate: float, p99_factor: float):
+        self.fraction = min(1.0, max(0.0, float(fraction)))
+        self.min_requests = max(1, int(min_requests))
+        self.max_error_rate = float(max_error_rate)
+        self.p99_factor = float(p99_factor)
+        self.counter = 0  # deterministic routing: every k-th request
+
+
+class _ModelEntry:
+    """Registry-side bookkeeping for one named model."""
+
+    def __init__(self, name: str, priority: int, weight: float,
+                 buckets: Optional[Sequence[int]],
+                 warm_shapes: Optional[Sequence[Tuple[int, ...]]]):
+        self.name = name
+        self.priority = int(priority)
+        self.weight = float(weight)
+        self.buckets = tuple(sorted(buckets)) if buckets else None
+        self.warm_shapes = [tuple(s) for s in (warm_shapes or [])]
+        self.versions: Dict[int, ModelVersion] = {}
+        self.active: Optional[int] = None
+        self.canary: Optional[int] = None
+        self.canary_watch: Optional[_CanaryWatch] = None
+        # circuit breaker: consecutive cross-replica batch faults
+        self.breaker_failures = 0
+        self.breaker_open = False
+        # last shape that served successfully — the probe program
+        self.probe_shape: Optional[Tuple[int, ...]] = None
+        self.coalesce = True  # batch_statistics models dispatch alone
+
+
+class ModelRegistry:
+    """Named models × versions with lifecycle, budget, and isolation.
+
+    ``memory_budget_bytes`` bounds the registry-accounted device pins
+    (None = unbounded). ``keep_versions`` retired versions are retained
+    per model for instant rollback. ``breaker_threshold`` consecutive
+    cross-replica batch faults open a model's circuit breaker. The
+    ``canary_*`` knobs are the auto-rollback policy defaults
+    (overridable per :meth:`deploy`)."""
+
+    def __init__(self, memory_budget_bytes: Optional[int] = None,
+                 keep_versions: int = 3,
+                 breaker_threshold: int = 2,
+                 canary_min_requests: int = 8,
+                 canary_max_error_rate: float = 0.25,
+                 canary_p99_factor: float = 3.0):
+        self.memory_budget = (None if memory_budget_bytes is None
+                              else int(memory_budget_bytes))
+        self.keep_versions = max(1, int(keep_versions))
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.canary_min_requests = int(canary_min_requests)
+        self.canary_max_error_rate = float(canary_max_error_rate)
+        self.canary_p99_factor = float(canary_p99_factor)
+        self._models: Dict[str, _ModelEntry] = {}
+        self._lock = threading.RLock()
+        self._tick = 0
+        self._pinned_bytes = 0
+        self._engines: List[Any] = []
+
+    # ----------------------------------------------------------- metrics
+
+    def _reg(self):
+        return get_registry()
+
+    def _gauge_active(self, name: str, version: Optional[int]) -> None:
+        self._reg().gauge(
+            MODEL_ACTIVE_VERSION_GAUGE,
+            "Active (traffic-taking) version per registered model",
+            model=name).set(float(version if version is not None else -1))
+
+    def _gauge_breaker(self, name: str, is_open: bool) -> None:
+        self._reg().gauge(
+            MODEL_BREAKER_OPEN_GAUGE,
+            "Per-model circuit breaker (1 = quarantined, being probed)",
+            model=name).set(1.0 if is_open else 0.0)
+
+    def _gauge_pinned(self) -> None:
+        self._reg().gauge(
+            MODEL_PINNED_BYTES_GAUGE,
+            "Device-pinned parameter bytes accounted against the "
+            "registry memory budget").set(float(self._pinned_bytes))
+
+    def _count_deploy(self, name: str, outcome: str) -> None:
+        self._reg().counter(
+            MODEL_DEPLOYS_COUNTER,
+            "Model version deploys by outcome",
+            model=name, outcome=outcome).inc()
+
+    def _count_rollback(self, name: str, reason: str) -> None:
+        self._reg().counter(
+            MODEL_ROLLBACKS_COUNTER,
+            "Model version rollbacks by reason",
+            model=name, reason=reason).inc()
+
+    # -------------------------------------------------------- membership
+
+    def attach(self, engine) -> None:
+        """Register a serving engine so deploys can AOT-warm new
+        versions on its replicas before cutover."""
+        with self._lock:
+            if engine not in self._engines:
+                self._engines.append(engine)
+
+    def detach(self, engine) -> None:
+        with self._lock:
+            if engine in self._engines:
+                self._engines.remove(engine)
+
+    def register(self, name: str, net=None, path: Optional[str] = None,
+                 version: int = 1, priority: int = 0, weight: float = 1.0,
+                 buckets: Optional[Sequence[int]] = None,
+                 warm_shapes: Optional[Sequence[Tuple[int, ...]]] = None
+                 ) -> int:
+        """Add a model with its first version (immediately active).
+        ``priority`` orders evictions (higher survives longer),
+        ``weight`` is the fair-scheduling share, ``buckets`` overrides
+        the engine's row-bucket ladder for this model, ``warm_shapes``
+        are the per-example shapes deploys warm with."""
+        with self._lock:
+            if name in self._models:
+                raise ValueError(f"model {name!r} already registered")
+            entry = _ModelEntry(name, priority, weight, buckets, warm_shapes)
+            ver = ModelVersion(name, version, net=net, path=path)
+            if net is not None and hasattr(net, "_pad_tail_safe"):
+                entry.coalesce = bool(net._pad_tail_safe())
+            ver.state = STATE_ACTIVE
+            entry.versions[ver.version] = ver
+            entry.active = ver.version
+            self._models[name] = entry
+        self._gauge_active(name, version)
+        self._gauge_breaker(name, False)
+        mark("model_registered", model=name, version=version)
+        return ver.version
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            entry = self._models.pop(name, None)
+            if entry is None:
+                return
+            for ver in entry.versions.values():
+                self._unpin_all(ver)
+        self._gauge_active(name, None)
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def _entry(self, name: str) -> _ModelEntry:
+        entry = self._models.get(name)
+        if entry is None:
+            raise ModelUnavailable(f"unknown model {name!r}")
+        return entry
+
+    def entry(self, name: str) -> _ModelEntry:
+        with self._lock:
+            return self._entry(name)
+
+    def version(self, name: str, version: int) -> ModelVersion:
+        with self._lock:
+            entry = self._entry(name)
+            ver = entry.versions.get(int(version))
+            if ver is None:
+                raise ModelUnavailable(
+                    f"model {name!r} has no version {version}")
+            return ver
+
+    def active_version(self, name: str) -> int:
+        with self._lock:
+            entry = self._entry(name)
+            if entry.active is None:
+                raise ModelUnavailable(f"model {name!r} has no active version")
+            return entry.active
+
+    def versions(self, name: str) -> Dict[int, str]:
+        with self._lock:
+            return {v: ver.state
+                    for v, ver in sorted(self._entry(name).versions.items())}
+
+    def weight(self, name: Optional[str]) -> float:
+        if name is None:
+            return 1.0
+        with self._lock:
+            entry = self._models.get(name)
+            return entry.weight if entry is not None else 1.0
+
+    # --------------------------------------------------------- resolve
+
+    def resolve(self, name: str, version: Optional[int] = None) -> int:
+        """Pick the version a fresh request serves on: the explicit ask,
+        else the canary (every k-th request, deterministically — k from
+        ``canary_fraction``), else the active version. Fails fast with
+        :class:`ModelQuarantined` while the model's breaker is open —
+        isolation means a poisoned model rejects at admission instead
+        of burning replica dispatches."""
+        with self._lock:
+            entry = self._entry(name)
+            if entry.breaker_open:
+                raise ModelQuarantined(
+                    f"model {name!r} is quarantined (circuit breaker open "
+                    f"after {entry.breaker_failures} cross-replica faults)")
+            if version is not None:
+                ver = entry.versions.get(int(version))
+                if ver is None or ver.state == STATE_REJECTED:
+                    raise ModelUnavailable(
+                        f"model {name!r} version {version} is not servable")
+                return int(version)
+            watch = entry.canary_watch
+            if entry.canary is not None and watch is not None \
+                    and watch.fraction > 0.0:
+                watch.counter += 1
+                period = max(1, round(1.0 / watch.fraction))
+                if watch.counter % period == 0:
+                    return entry.canary
+            if entry.active is None:
+                raise ModelUnavailable(f"model {name!r} has no active version")
+            return entry.active
+
+    # ----------------------------------------------------- device pins
+
+    @staticmethod
+    def _devkey(device) -> str:
+        return str(device)
+
+    def acquire(self, name: str, version: int, device):
+        """(fn, params, states) for one dispatch: params pinned on
+        ``device``, LRU-touched, budget-accounted (evicting colder pins
+        when needed). Called from engine workers — the returned refs
+        stay valid even if the pin is evicted mid-dispatch."""
+        import jax
+
+        ver = self.version(name, version)
+        key = self._devkey(device)
+        with self._lock:
+            self._tick += 1
+            ver.last_used = self._tick
+            pinned = ver.pins.get(key)
+        if pinned is not None:
+            return ver.fn(), pinned[0], pinned[1]
+        # pin outside the lock (device_put + possible lazy reload are
+        # slow); racing workers may both pin — the second install wins
+        # accounting-wise and the loser's copy is garbage collected
+        net = ver.net()
+        params = jax.device_put(net.params, device)
+        states = jax.device_put(net.states, device)
+        size = ver.nbytes()
+        with self._lock:
+            if key not in ver.pins:
+                self._evict_for(size, exclude=ver)
+                ver.pins[key] = (params, states)
+                self._pinned_bytes += size
+        self._gauge_pinned()
+        return ver.fn(), params, states
+
+    def _evict_for(self, size: int, exclude: ModelVersion) -> None:
+        """Free budget for ``size`` new bytes: drop the least-recently
+        used, lowest-priority pins first (never the version being
+        pinned). Checkpoint-backed versions also drop their host copy.
+        Holds the registry lock."""
+        if self.memory_budget is None:
+            return
+        candidates = []
+        for entry in self._models.values():
+            for ver in entry.versions.values():
+                if ver is exclude or not ver.pins:
+                    continue
+                candidates.append((entry.priority, ver.last_used, ver))
+        candidates.sort(key=lambda t: (t[0], t[1]))
+        for _, _, ver in candidates:
+            if self._pinned_bytes + size <= self.memory_budget:
+                return
+            freed = self._unpin_all(ver)
+            if freed:
+                ver.drop_host()
+                self._reg().counter(
+                    MODEL_EVICTIONS_COUNTER,
+                    "Model versions evicted from the device-memory budget",
+                    model=ver.name).inc()
+                mark("model_evicted", model=ver.name, version=ver.version,
+                     bytes=freed)
+        # over budget with nothing left to evict: serve anyway — a
+        # model the budget cannot fit is better served than refused
+
+    def _unpin_all(self, ver: ModelVersion) -> int:
+        freed = len(ver.pins) * ver.nbytes() if ver.pins else 0
+        ver.pins.clear()
+        self._pinned_bytes = max(0, self._pinned_bytes - freed)
+        self._gauge_pinned()
+        return freed
+
+    def pinned_bytes(self) -> int:
+        with self._lock:
+            return self._pinned_bytes
+
+    # ---------------------------------------------------------- deploy
+
+    def _next_version(self, entry: _ModelEntry) -> int:
+        return (max(entry.versions) + 1) if entry.versions else 1
+
+    def deploy(self, name: str, net=None, path: Optional[str] = None,
+               version: Optional[int] = None, canary_fraction: float = 0.0,
+               warm: bool = True,
+               canary_min_requests: Optional[int] = None,
+               canary_max_error_rate: Optional[float] = None,
+               canary_p99_factor: Optional[float] = None) -> int:
+        """Zero-downtime deploy of a new version.
+
+        Order of operations is the whole contract: (1) integrity-check
+        — a corrupt checkpoint raises :class:`CheckpointCorruptError`
+        HERE and the old version never stops serving; (2) load + AOT-
+        warm the staged version on every attached engine's replicas,
+        off the hot path; (3) atomically cut over (or enter canary —
+        ``canary_fraction > 0`` keeps the old version active and routes
+        the fraction to the new one until :meth:`promote` or the watch
+        rolls it back). Returns the new version number."""
+        entry = self.entry(name)
+        if net is None and path is None:
+            raise ValueError("deploy needs a net or a checkpoint path")
+        if path is not None and not os.path.isdir(path):
+            problems = verify_model_file(path)
+            if problems:
+                self._count_deploy(name, "rejected_corrupt")
+                record_fault("deploy")
+                mark("model_deploy_rejected", model=name,
+                     reason="corrupt_checkpoint")
+                raise CheckpointCorruptError("; ".join(problems))
+        with self._lock:
+            new_v = self._next_version(entry) if version is None else int(version)
+            if new_v in entry.versions:
+                raise ValueError(f"model {name!r} already has version {new_v}")
+            ver = ModelVersion(name, new_v, net=net, path=path)
+            entry.versions[new_v] = ver
+        try:
+            ver.net()  # force the load (and its integrity check) now
+            if warm:
+                self._warm(entry, ver)
+        except BaseException:
+            with self._lock:
+                entry.versions.pop(new_v, None)
+            self._count_deploy(name, "rejected_corrupt")
+            record_fault("deploy")
+            mark("model_deploy_rejected", model=name, version=new_v)
+            raise
+        with self._lock:
+            if canary_fraction > 0.0:
+                entry.canary = new_v
+                ver.state = STATE_CANARY
+                entry.canary_watch = _CanaryWatch(
+                    canary_fraction,
+                    self.canary_min_requests if canary_min_requests is None
+                    else canary_min_requests,
+                    self.canary_max_error_rate if canary_max_error_rate is None
+                    else canary_max_error_rate,
+                    self.canary_p99_factor if canary_p99_factor is None
+                    else canary_p99_factor)
+                outcome = "canary"
+            else:
+                self._cutover(entry, new_v)
+                outcome = "accepted"
+            active_now = entry.active
+            breaker_now = entry.breaker_open
+        self._count_deploy(name, outcome)
+        self._gauge_active(name, active_now)
+        self._gauge_breaker(name, breaker_now)
+        mark("model_deployed", model=name, version=new_v, outcome=outcome)
+        return new_v
+
+    def _warm(self, entry: _ModelEntry, ver: ModelVersion) -> None:
+        """AOT-compile the staged version's program set on every
+        attached engine — the deploy pays the XLA compiles, not the
+        first post-cutover request."""
+        shapes = entry.warm_shapes
+        with self._lock:
+            engines = list(self._engines)
+        for engine in engines:
+            engine.warmup_model(entry.name, version=ver.version,
+                                shapes=shapes or None)
+        ver.warmed = True
+
+    def _cutover(self, entry: _ModelEntry, new_v: int) -> None:
+        """Atomic pointer swap + retention pruning (registry lock held).
+        In-flight requests hold their resolved ModelVersion and finish
+        on it; the retired version stays rollback-able."""
+        prev = entry.active
+        if prev is not None and prev != new_v:
+            entry.versions[prev].state = STATE_RETIRED
+        entry.versions[new_v].state = STATE_ACTIVE
+        entry.active = new_v
+        entry.canary = None
+        entry.canary_watch = None
+        # a fresh version gets a fresh chance: cutover resets the
+        # breaker (deploying a fixed version IS the recovery path for a
+        # quarantined model)
+        entry.breaker_open = False
+        entry.breaker_failures = 0
+        # prune beyond the retention window (never the active version)
+        retired = sorted(v for v, mv in entry.versions.items()
+                         if mv.state == STATE_RETIRED)
+        for stale in retired[:-self.keep_versions]:
+            mv = entry.versions.pop(stale)
+            self._unpin_all(mv)
+
+    def promote(self, name: str) -> int:
+        """Cut the canary over to active (the healthy end of a canary)."""
+        with self._lock:
+            entry = self._entry(name)
+            if entry.canary is None:
+                raise ModelUnavailable(f"model {name!r} has no canary")
+            new_v = entry.canary
+            self._cutover(entry, new_v)
+        self._gauge_active(name, new_v)
+        self._gauge_breaker(name, False)
+        self._count_deploy(name, "promoted")
+        mark("model_promoted", model=name, version=new_v)
+        return new_v
+
+    def rollback(self, name: str, reason: str = "manual") -> int:
+        """Instant rollback. With a live canary: reject the canary (the
+        active version never stopped serving). Otherwise: reactivate the
+        newest retired version — versions are retained exactly so this
+        is a pointer swap, not a reload."""
+        with self._lock:
+            entry = self._entry(name)
+            if entry.canary is not None:
+                bad = entry.versions[entry.canary]
+                bad.state = STATE_REJECTED
+                entry.canary = None
+                entry.canary_watch = None
+                self._unpin_all(bad)
+                active = entry.active
+            else:
+                retired = sorted(v for v, mv in entry.versions.items()
+                                 if mv.state == STATE_RETIRED)
+                if not retired:
+                    raise ModelUnavailable(
+                        f"model {name!r} has no version to roll back to")
+                prev = entry.active
+                active = retired[-1]
+                entry.versions[active].state = STATE_ACTIVE
+                entry.active = active
+                if prev is not None:
+                    entry.versions[prev].state = STATE_REJECTED
+        self._count_rollback(name, reason)
+        self._gauge_active(name, active)
+        record_fault("deploy")
+        mark("model_rollback", model=name, reason=reason, active=active)
+        return active
+
+    # ------------------------------------------------- serving feedback
+
+    def wants_nan_check(self, name: str, version: int) -> bool:
+        """Only canary versions pay the host-side NaN scan — the watch
+        plane needs the signal; steady-state traffic stays cheap."""
+        with self._lock:
+            entry = self._models.get(name)
+            return entry is not None and entry.canary == int(version)
+
+    def note_result(self, name: str, version: int, latency_ms: float,
+                    rows: int = 1, nan: bool = False,
+                    shape: Optional[Tuple[int, ...]] = None) -> None:
+        """One successful batch dispatch for (model, version): closes
+        the breaker, feeds the canary watch (NaN output = immediate
+        rollback), updates the per-model metric family."""
+        rollback_reason = None
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                return
+            ver = entry.versions.get(int(version))
+            if ver is None:
+                return
+            entry.breaker_failures = 0
+            if shape is not None:
+                entry.probe_shape = tuple(shape)
+            ver.requests += rows
+            ver.latencies.append(latency_ms)
+            ver.ewma_ms = (latency_ms if ver.ewma_ms is None
+                           else 0.8 * ver.ewma_ms + 0.2 * latency_ms)
+            if nan:
+                ver.nans += 1
+            if entry.canary == int(version):
+                rollback_reason = self._canary_verdict(entry, ver)
+        reg = self._reg()
+        reg.counter(MODEL_REQUESTS_COUNTER,
+                    "Requests served per model", model=name).inc(rows)
+        reg.histogram(MODEL_LATENCY_HISTOGRAM,
+                      "Per-batch dispatch latency per model",
+                      model=name).observe(latency_ms)
+        if rollback_reason is not None:
+            self.rollback(name, reason=rollback_reason)
+
+    def _canary_verdict(self, entry: _ModelEntry,
+                        ver: ModelVersion) -> Optional[str]:
+        """The auto-rollback decision (registry lock held): NaN output
+        kills a canary immediately; error-rate and p99-regression need
+        ``min_requests`` of evidence first."""
+        watch = entry.canary_watch
+        if watch is None:
+            return None
+        if ver.nans > 0:
+            return "canary_nan"
+        served = ver.requests + ver.errors
+        if served < watch.min_requests:
+            return None
+        if ver.errors / max(1, served) > watch.max_error_rate:
+            return "canary_error_rate"
+        stable = entry.versions.get(entry.active) if entry.active else None
+        if stable is not None:
+            base = stable.p99_ms()
+            canary_p99 = ver.p99_ms()
+            if base is not None and canary_p99 is not None and base > 0 \
+                    and canary_p99 > watch.p99_factor * base:
+                return "canary_p99"
+        return None
+
+    def note_error(self, name: str, version: int) -> str:
+        """One failed batch (same-replica retries already exhausted) for
+        (model, version). Returns the isolation verdict the engine acts
+        on:
+
+        - ``"model_open"`` — the model's circuit breaker just opened
+          (``breaker_threshold`` consecutive cross-replica faults on a
+          serving version): fail the batch model-scoped, do NOT
+          quarantine the replica;
+        - ``"version_rejected"`` — the faulting version was a canary
+          and the watch just rolled it back: fail the batch (callers
+          retry onto the stable version), do NOT quarantine the
+          replica — the stable version never stopped serving;
+        - ``"retry"`` — not yet attributable to the model: follow the
+          replica-quarantine/redispatch path."""
+        rollback_reason = None
+        opened = False
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                return "retry"
+            ver = entry.versions.get(int(version))
+            if ver is not None:
+                ver.errors += 1
+            is_canary = entry.canary == int(version)
+            if is_canary and ver is not None:
+                # a canary's faults indict the canary, never the model:
+                # the stable version is healthy by construction
+                rollback_reason = self._canary_error_verdict(entry, ver)
+            else:
+                entry.breaker_failures += 1
+                opened = (not entry.breaker_open
+                          and entry.breaker_failures >= self.breaker_threshold)
+                if opened:
+                    entry.breaker_open = True
+                elif entry.breaker_open:
+                    opened = True  # already open: still model-scoped
+        self._reg().counter(MODEL_ERRORS_COUNTER,
+                            "Failed dispatches per model", model=name).inc()
+        if opened:
+            self._gauge_breaker(name, True)
+            record_fault("serving")
+            mark("model_breaker_open", model=name, version=version)
+            return "model_open"
+        if rollback_reason is not None:
+            self.rollback(name, reason=rollback_reason)
+            return "version_rejected"
+        return "retry"
+
+    def _canary_error_verdict(self, entry: _ModelEntry,
+                              ver: ModelVersion) -> Optional[str]:
+        """Registry lock held. A deterministically-failing canary dies
+        after ``breaker_threshold`` faults (no need for min_requests of
+        pain); a flaky one dies when its error rate is provably above
+        the bar even granting it ``min_requests`` of clean traffic."""
+        watch = entry.canary_watch
+        if watch is None:
+            return None
+        if ver.errors >= self.breaker_threshold:
+            return "canary_error_rate"
+        served = ver.requests + ver.errors
+        worst_possible = ver.errors / max(1, max(served, watch.min_requests))
+        if worst_possible > watch.max_error_rate:
+            return "canary_error_rate"
+        return None
+
+    def breaker_open(self, name: str) -> bool:
+        with self._lock:
+            entry = self._models.get(name)
+            return bool(entry is not None and entry.breaker_open)
+
+    def open_models(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, e in self._models.items() if e.breaker_open)
+
+    def close_breaker(self, name: str) -> None:
+        """A probe passed: the model rejoins the serving pool."""
+        with self._lock:
+            entry = self._entry(name)
+            entry.breaker_open = False
+            entry.breaker_failures = 0
+        self._gauge_breaker(name, False)
+        mark("model_breaker_closed", model=name)
+
+    def probe_info(self, name: str):
+        """(version, shape, np_dtype) for a one-row known-good probe of
+        an open-breaker model; shape may be None when nothing has ever
+        served (the caller reinstates optimistically)."""
+        with self._lock:
+            entry = self._entry(name)
+            version = entry.active if entry.canary is None else entry.canary
+            shape = entry.probe_shape
+            if shape is None and entry.warm_shapes:
+                shape = entry.warm_shapes[0]
+        if version is None:
+            return None, None, None
+        return version, shape, self.version(name, version).np_dtype
+
+    # ------------------------------------------------------------ state
+
+    def stats(self) -> Dict[str, Any]:
+        """Per-model snapshot: what ``engine.stats()["models"]`` and
+        ``/healthz`` serve."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for name, entry in sorted(self._models.items()):
+                versions = {}
+                for v, ver in sorted(entry.versions.items()):
+                    versions[str(v)] = {
+                        "state": ver.state,
+                        "warmed": ver.warmed,
+                        "requests": ver.requests,
+                        "errors": ver.errors,
+                        "nans": ver.nans,
+                        "ewma_ms": (None if ver.ewma_ms is None
+                                    else round(ver.ewma_ms, 3)),
+                        "p99_ms": (None if ver.p99_ms() is None
+                                   else round(ver.p99_ms(), 3)),
+                        "pinned_devices": len(ver.pins),
+                    }
+                active = entry.versions.get(entry.active) \
+                    if entry.active is not None else None
+                out[name] = {
+                    "active_version": entry.active,
+                    "canary_version": entry.canary,
+                    "canary_fraction": (entry.canary_watch.fraction
+                                        if entry.canary_watch else 0.0),
+                    "breaker_open": entry.breaker_open,
+                    "priority": entry.priority,
+                    "weight": entry.weight,
+                    "ready": bool(active is not None
+                                  and not entry.breaker_open),
+                    "warmed": bool(active is not None and active.warmed),
+                    "versions": versions,
+                }
+        return out

@@ -90,7 +90,7 @@ class _EndpointState:
 
     __slots__ = ("endpoint", "consecutive_failures", "ejections",
                  "ejected_until", "in_trial", "ewma_ms", "inflight",
-                 "requests", "failures")
+                 "requests", "failures", "model_ewma_ms")
 
     def __init__(self, endpoint: EngineEndpoint):
         self.endpoint = endpoint
@@ -99,6 +99,11 @@ class _EndpointState:
         self.ejected_until = 0.0  # monotonic; 0 = not ejected
         self.in_trial = False     # half-open probe outstanding
         self.ewma_ms: Optional[float] = None
+        # per-model dispatch-latency EWMAs: different models on one
+        # endpoint can be orders of magnitude apart, so admission
+        # estimates completion with the MODEL's observed service time
+        # when it has one (overall EWMA as the cold fallback)
+        self.model_ewma_ms: Dict[str, float] = {}
         self.inflight = 0         # router-dispatched, unresolved
         self.requests = 0
         self.failures = 0
@@ -109,11 +114,13 @@ class _Routed:
 
     __slots__ = ("future", "kind", "x", "gen", "deadline", "t0", "tried",
                  "attempts", "outstanding", "lock", "hedged", "session",
-                 "priority", "timer", "per_try_timeout")
+                 "priority", "timer", "per_try_timeout", "model", "version")
 
     def __init__(self, kind: str, x, gen, deadline: Optional[float],
                  priority: str, session: Optional[str],
-                 per_try_timeout: Optional[float]):
+                 per_try_timeout: Optional[float],
+                 model: Optional[str] = None,
+                 version: Optional[int] = None):
         self.future: "Future[np.ndarray]" = Future()
         self.kind = kind
         self.x = x
@@ -129,6 +136,8 @@ class _Routed:
         self.priority = priority
         self.timer: Optional[threading.Timer] = None
         self.per_try_timeout = per_try_timeout
+        self.model = model
+        self.version = version
 
 
 class InferenceRouter:
@@ -180,8 +189,8 @@ class InferenceRouter:
     def remove_endpoint(self, name: str) -> Optional[EngineEndpoint]:
         with self._lock:
             st = self._eps.pop(name, None)
-            self._affinity = {s: n for s, n in self._affinity.items()
-                              if n != name}
+            self._affinity = {s: pin for s, pin in self._affinity.items()
+                              if pin[0] != name}
         if st is None:
             return None
         self._health_gauge(name).set(0.0)
@@ -217,7 +226,8 @@ class InferenceRouter:
             out.append(st)
         return out
 
-    def _note_success(self, st: _EndpointState, latency_ms: float) -> None:
+    def _note_success(self, st: _EndpointState, latency_ms: float,
+                      model: Optional[str] = None) -> None:
         with self._lock:
             st.inflight = max(0, st.inflight - 1)
             was_ejected = st.consecutive_failures >= self.eject_threshold
@@ -227,6 +237,12 @@ class InferenceRouter:
             st.ewma_ms = (latency_ms if st.ewma_ms is None else
                           (1 - self.ewma_alpha) * st.ewma_ms
                           + self.ewma_alpha * latency_ms)
+            if model is not None:
+                prev = st.model_ewma_ms.get(model)
+                st.model_ewma_ms[model] = (
+                    latency_ms if prev is None else
+                    (1 - self.ewma_alpha) * prev
+                    + self.ewma_alpha * latency_ms)
         self._health_gauge(st.endpoint.name).set(1.0)
         if was_ejected:
             mark("router_endpoint_reinstated", endpoint=st.endpoint.name)
@@ -260,30 +276,38 @@ class InferenceRouter:
 
     # --------------------------------------------------------- admission
 
-    def _estimate_ms(self, st: _EndpointState) -> Tuple[float, float]:
+    def _estimate_ms(self, st: _EndpointState,
+                     model: Optional[str] = None) -> Tuple[float, float]:
         """(queue_wait_ms, total_ms) completion estimate for one more
         request on this endpoint, from its last stats snapshot and the
-        router's observed EWMA service time. Cold endpoints (no
-        latency observed yet) estimate 0 — admit optimistically and
-        let observation catch up."""
-        if st.ewma_ms is None:
+        router's observed EWMA service time — the MODEL's own EWMA when
+        the request names one and it has history (per-model admission:
+        a heavy cotenant must not inflate a light model's estimate, nor
+        hide its own). Cold endpoints (no latency observed yet)
+        estimate 0 — admit optimistically and let observation catch
+        up."""
+        svc = st.ewma_ms
+        if model is not None:
+            svc = st.model_ewma_ms.get(model, svc)
+        if svc is None:
             return 0.0, 0.0
         stats = st.endpoint.stats()
         depth = float(stats.get("queue_depth", 0) or 0)
         replicas = max(1.0, float(stats.get("healthy_replicas",
                                             stats.get("replicas", 1)) or 1))
         backlog = depth + st.inflight
-        wait = (backlog / replicas) * st.ewma_ms
-        return wait, wait + st.ewma_ms
+        wait = (backlog / replicas) * svc
+        return wait, wait + svc
 
     def _admit(self, deadline_ms: Optional[float], priority: str,
-               session: Optional[str]) -> _EndpointState:
+               session: Optional[str],
+               model: Optional[str] = None) -> _EndpointState:
         """Pick the endpoint AND make the shed decision against it.
         Raises :class:`RetryAfter` when nothing can serve in time."""
         now = time.monotonic()
         pool = self._pool(now)
         if not pool:
-            self._shed(priority, "no_endpoint")
+            self._shed(priority, "no_endpoint", model)
             raise RetryAfter("no endpoint available", self.eject_backoff)
         # a half-open endpoint gets the next request as its probe
         with self._lock:
@@ -295,60 +319,76 @@ class InferenceRouter:
             pinned = self._affinity.get(session)
             if pinned is not None:
                 pick = next((st for st in pool
-                             if st.endpoint.name == pinned), None)
+                             if st.endpoint.name == pinned[0]), None)
         if pick is None and trial is not None:
             pick = trial
             with self._lock:
                 trial.in_trial = True
         if pick is None:
             # least estimated wait; stable name tie-break
-            pick = min(pool, key=lambda st: (self._estimate_ms(st)[0],
+            pick = min(pool, key=lambda st: (self._estimate_ms(st, model)[0],
                                              st.endpoint.name))
-        wait_ms, total_ms = self._estimate_ms(pick)
+        wait_ms, total_ms = self._estimate_ms(pick, model)
         self._reg().histogram(
             ROUTER_QUEUE_WAIT_HISTOGRAM,
             "Estimated queue wait at admission time").observe(wait_ms)
         if deadline_ms is not None:
             headroom = PRIORITY_HEADROOM.get(priority, 1.0)
             if total_ms > deadline_ms * headroom:
-                self._shed(priority, "deadline")
+                self._shed(priority, "deadline", model)
                 raise RetryAfter(
                     f"estimated completion {total_ms:.1f}ms exceeds "
                     f"deadline {deadline_ms:.1f}ms × {headroom} headroom "
                     f"({priority})", max(1e-3, wait_ms / 1e3))
         if session is not None:
-            self._affinity[session] = pick.endpoint.name
+            # pin (endpoint, model): the stream's KV state lives on one
+            # endpoint, and the version pin rides engine-side on the
+            # same session key
+            self._affinity[session] = (pick.endpoint.name, model)
         return pick
 
-    def _shed(self, priority: str, reason: str) -> None:
+    def _shed(self, priority: str, reason: str,
+              model: Optional[str] = None) -> None:
+        labels = {"priority": priority, "reason": reason}
+        if model is not None:
+            labels["model"] = model
         self._reg().counter(
             ROUTER_SHED_COUNTER,
             "Requests rejected by deadline admission control",
-            priority=priority, reason=reason).inc()
+            **labels).inc()
         mark("router_shed", priority=priority, reason=reason)
 
     # ------------------------------------------------------------ submit
 
     def submit(self, x: np.ndarray, deadline_ms: Optional[float] = None,
                priority: str = "interactive",
-               session: Optional[str] = None) -> "Future[np.ndarray]":
+               session: Optional[str] = None,
+               model: Optional[str] = None,
+               version: Optional[int] = None) -> "Future[np.ndarray]":
         """Route one classify request (x: [n, ...features]); the Future
         resolves to the [n, ...out] predictions, possibly after
         failover/hedging, or raises :class:`RetryAfter` HERE (before a
-        Future exists) when admission sheds it."""
+        Future exists) when admission sheds it. ``model=``/``version=``
+        route multi-model engines; admission then estimates with that
+        model's per-endpoint latency EWMA."""
         return self._route(np.asarray(x), None, "classify", deadline_ms,
-                           priority, session)
+                           priority, session, model, version)
 
     def submit_generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                         deadline_ms: Optional[float] = None,
                         priority: str = "interactive",
                         session: Optional[str] = None,
+                        model: Optional[str] = None,
+                        version: Optional[int] = None,
                         **gen_kwargs) -> "Future[np.ndarray]":
         """Route one decode request; ``session=`` keeps every burst of
-        a decode stream on the endpoint holding its KV state."""
+        a decode stream on the (endpoint, model, version) it started on
+        — the endpoint pin lives here, the version pin rides the same
+        session key down in the engine, so a mid-stream hot-swap never
+        switches KV-cache owners."""
         gen = dict(gen_kwargs, max_new_tokens=int(max_new_tokens))
         return self._route(np.asarray(prompt_ids), gen, "generate",
-                           deadline_ms, priority, session)
+                           deadline_ms, priority, session, model, version)
 
     def output(self, x, timeout: Optional[float] = None, **kwargs):
         return self.submit(x, **kwargs).result(timeout=timeout)
@@ -358,19 +398,23 @@ class InferenceRouter:
         return self.submit_generate(prompt_ids, max_new_tokens,
                                     **kwargs).result(timeout=timeout)
 
-    def _route(self, x, gen, kind, deadline_ms, priority, session):
+    def _route(self, x, gen, kind, deadline_ms, priority, session,
+               model=None, version=None):
         if self._closed:
             raise RuntimeError("router is closed")
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms.get(priority)
+        labels = {"priority": priority}
+        if model is not None:
+            labels["model"] = model
         self._reg().counter(
-            ROUTER_REQUESTS_COUNTER, "Requests routed",
-            priority=priority).inc()
-        st = self._admit(deadline_ms, priority, session)
+            ROUTER_REQUESTS_COUNTER, "Requests routed", **labels).inc()
+        st = self._admit(deadline_ms, priority, session, model)
         rf = _Routed(kind, x, gen,
                      None if deadline_ms is None
                      else time.monotonic() + deadline_ms / 1e3,
-                     priority, session, self.per_try_timeout)
+                     priority, session, self.per_try_timeout,
+                     model, version)
         self._dispatch(rf, st)
         if self.hedge_after > 0 and session is None and \
                 self.max_attempts > 1:
@@ -383,6 +427,17 @@ class InferenceRouter:
 
     # --------------------------------------------------------- dispatch
 
+    @staticmethod
+    def _typed_engine_error(e: BaseException) -> bool:
+        """Engine errors that must surface to the caller as their own
+        type (not wrapped in EndpointError) — the same classes a
+        LocalEndpoint's in-process engine raises for a shed or a
+        quarantined model."""
+        from deeplearning4j_tpu.parallel.inference import \
+            InferenceBackpressure
+        from deeplearning4j_tpu.serving.registry import ModelUnavailable
+        return isinstance(e, (InferenceBackpressure, ModelUnavailable))
+
     def _dispatch(self, rf: _Routed, st: _EndpointState) -> None:
         with rf.lock:
             rf.attempts += 1
@@ -392,21 +447,31 @@ class InferenceRouter:
             st.requests += 1
             st.inflight += 1
         t_disp = time.perf_counter()
+        # routing fields travel only when set, so single-model
+        # endpoints (and minimal EngineEndpoint stubs) keep working
+        route = {k: v for k, v in (("model", rf.model),
+                                   ("version", rf.version),
+                                   ("session", rf.session))
+                 if v is not None}
         try:
             if rf.kind == "generate":
                 g = dict(rf.gen)
                 inner = st.endpoint.submit_generate(
                     rf.x, g.pop("max_new_tokens"),
-                    timeout_s=rf.per_try_timeout, **g)
+                    timeout_s=rf.per_try_timeout, **route, **g)
             else:
                 inner = st.endpoint.submit(rf.x,
-                                           timeout_s=rf.per_try_timeout)
+                                           timeout_s=rf.per_try_timeout,
+                                           **route)
         except BaseException as e:
-            # submit itself failed (endpoint closed/backpressure):
-            # resolve through the same failure path as a bad reply
+            # submit itself failed (endpoint closed / backpressure /
+            # model quarantine): resolve through the same failure path
+            # as a bad reply, PRESERVING the typed engine errors so the
+            # caller sees the same exception a local engine would raise
             inner = Future()
             inner.set_exception(
-                e if isinstance(e, EndpointError) else EndpointError(str(e)))
+                e if isinstance(e, (EndpointError, RetryAfter))
+                or self._typed_engine_error(e) else EndpointError(str(e)))
         inner.add_done_callback(
             lambda f: self._on_done(rf, st, f, t_disp))
 
@@ -422,7 +487,7 @@ class InferenceRouter:
                 return
             rf.hedged = True
             tried = set(rf.tried)
-        st = self._pick_excluding(tried)
+        st = self._pick_excluding(tried, rf.model)
         if st is None:
             return
         self._reg().counter(
@@ -431,13 +496,15 @@ class InferenceRouter:
         mark("router_hedge", endpoint=st.endpoint.name)
         self._dispatch(rf, st)
 
-    def _pick_excluding(self, tried: set) -> Optional[_EndpointState]:
+    def _pick_excluding(self, tried: set,
+                        model: Optional[str] = None
+                        ) -> Optional[_EndpointState]:
         now = time.monotonic()
         pool = [st for st in self._pool(now)
                 if st.endpoint.name not in tried]
         if not pool:
             return None
-        return min(pool, key=lambda st: (self._estimate_ms(st)[0],
+        return min(pool, key=lambda st: (self._estimate_ms(st, model)[0],
                                          st.endpoint.name))
 
     def _on_done(self, rf: _Routed, st: _EndpointState, inner: Future,
@@ -449,7 +516,7 @@ class InferenceRouter:
             # attributing the full request latency would pollute a
             # healthy endpoint's estimate with the timeout a dead
             # sibling burned before the failover reached it
-            self._note_success(st, (now - t_disp) * 1e3)
+            self._note_success(st, (now - t_disp) * 1e3, rf.model)
             with rf.lock:
                 rf.outstanding -= 1
                 won = not rf.future.done()
@@ -474,13 +541,14 @@ class InferenceRouter:
             expired = rf.deadline is not None and \
                 time.monotonic() >= rf.deadline
             if rf.attempts < self.max_attempts and not expired:
-                retry_to = self._pick_excluding(rf.tried)
+                retry_to = self._pick_excluding(rf.tried, rf.model)
             if retry_to is None and rf.outstanding == 0:
                 give_up = True
         if retry_to is not None:
             if rf.session is not None:
                 # the pinned endpoint failed: re-pin the session
-                self._affinity[rf.session] = retry_to.endpoint.name
+                self._affinity[rf.session] = (retry_to.endpoint.name,
+                                              rf.model)
             self._reg().counter(
                 ROUTER_FAILOVERS_COUNTER,
                 "Requests re-dispatched to another endpoint after an "
@@ -525,6 +593,8 @@ class InferenceRouter:
                 "inflight": st.inflight,
                 "ewma_ms": (None if st.ewma_ms is None
                             else round(st.ewma_ms, 3)),
+                "model_ewma_ms": {m: round(v, 3)
+                                  for m, v in sorted(st.model_ewma_ms.items())},
                 "last_seen_age_s": (None if last == float("-inf")
                                     else round(now - last, 3)),
                 "stats": stats,
@@ -546,6 +616,12 @@ class InferenceRouter:
         }
 
     def session_endpoint(self, session: str) -> Optional[str]:
+        pin = self._affinity.get(session)
+        return pin[0] if pin is not None else None
+
+    def session_pin(self, session: str) -> Optional[Tuple[str, Optional[str]]]:
+        """The (endpoint, model) pin of a decode session — the version
+        half of the pin lives engine-side on the same session key."""
         return self._affinity.get(session)
 
     def close(self) -> None:

@@ -7,10 +7,23 @@ router ↔ engine-worker channel is framed *inside* broker payloads:
 - request / reply: u32 big-endian header length + JSON header + binary
   body (npz via ``streaming/serde.py`` — self-describing dtype+shape).
   The header carries the correlation id (``id``), the caller's private
-  reply topic (``reply``), and the request kind (``classify`` /
-  ``generate`` with its sampler params). Correlation ids make the
-  channel safe for pipelining: replies may arrive out of order and the
-  endpoint matches them back to futures by id, never by position.
+  reply topic (``reply``), the request kind (``classify`` /
+  ``generate`` with its sampler params), and the multi-model routing
+  fields (``model`` / ``version`` / ``session`` — absent for a
+  single-model engine). Correlation ids make the channel safe for
+  pipelining: replies may arrive out of order and the endpoint matches
+  them back to futures by id, never by position.
+
+Error replies are TYPED: the reply header carries ``etype`` (the
+exception class name) plus any wire-safe payload fields
+(``retry_after_s``), and :func:`typed_error` reconstructs the SAME
+exception type on the caller's side for the registered engine-error
+family (backpressure sheds, model quarantine, corrupt-checkpoint
+deploys, router ``RetryAfter``) — a remote worker's shed surfaces to
+the router caller exactly like an in-process ``LocalEndpoint``'s
+would, for both classify and generate paths. Unregistered types
+degrade to :class:`~deeplearning4j_tpu.serving.endpoint.
+EndpointError` with the message preserved.
 - heartbeat: plain JSON — worker name, monotonically increasing
   ``seq``, lifecycle ``state`` (serving / draining / stopped) and the
   engine's ``stats()`` snapshot. The router's health plane consumes
@@ -63,10 +76,19 @@ def unpack_frame(payload: bytes) -> Tuple[Dict[str, Any], bytes]:
 
 
 def pack_request(corr_id: str, reply_topic: str, kind: str, x: np.ndarray,
-                 gen: Optional[Dict[str, Any]] = None) -> bytes:
+                 gen: Optional[Dict[str, Any]] = None,
+                 model: Optional[str] = None,
+                 version: Optional[int] = None,
+                 session: Optional[str] = None) -> bytes:
     header = {"id": corr_id, "reply": reply_topic, "kind": kind}
     if gen is not None:
         header["gen"] = gen
+    if model is not None:
+        header["model"] = model
+    if version is not None:
+        header["version"] = int(version)
+    if session is not None:
+        header["session"] = session
     return pack_frame(header, ndarray_to_bytes(x))
 
 
@@ -75,12 +97,65 @@ def unpack_request(payload: bytes) -> Tuple[Dict[str, Any], np.ndarray]:
     return header, ndarray_from_bytes(body)
 
 
+def _error_fields(error) -> Dict[str, Any]:
+    """Wire encoding of an error reply: message + type name + any
+    wire-safe payload the reconstructed exception needs."""
+    if isinstance(error, BaseException):
+        fields: Dict[str, Any] = {"error": str(error),
+                                  "etype": type(error).__name__}
+        retry = getattr(error, "retry_after_s", None)
+        if retry is not None:
+            fields["retry_after_s"] = float(retry)
+        return fields
+    return {"error": str(error)}
+
+
 def pack_reply(corr_id: str, result: Optional[np.ndarray] = None,
-               error: Optional[str] = None) -> bytes:
+               error=None) -> bytes:
+    """``error`` may be a string (legacy) or an exception instance —
+    the latter ships typed so :func:`typed_error` can reconstruct it."""
     if error is not None:
-        return pack_frame({"id": corr_id, "ok": False, "error": error})
+        header = {"id": corr_id, "ok": False}
+        header.update(_error_fields(error))
+        return pack_frame(header)
     return pack_frame({"id": corr_id, "ok": True},
                       ndarray_to_bytes(result))
+
+
+def _typed_error_registry() -> Dict[str, Any]:
+    """The engine-error family that crosses the wire typed. Imported
+    lazily — wire.py sits below router/registry in the import graph."""
+    from deeplearning4j_tpu.parallel.inference import InferenceBackpressure
+    from deeplearning4j_tpu.serving.registry import (ModelQuarantined,
+                                                     ModelUnavailable)
+    from deeplearning4j_tpu.serving.router import RetryAfter
+    from deeplearning4j_tpu.util.model_serializer import \
+        CheckpointCorruptError
+    return {
+        "InferenceBackpressure": InferenceBackpressure,
+        "ModelUnavailable": ModelUnavailable,
+        "ModelQuarantined": ModelQuarantined,
+        "CheckpointCorruptError": CheckpointCorruptError,
+        "RetryAfter": RetryAfter,
+    }
+
+
+def typed_error(header: Dict[str, Any],
+                fallback=None) -> BaseException:
+    """Reconstruct a reply header's error as the SAME exception type
+    the remote engine raised, when it is one of the registered
+    wire-safe types; otherwise build ``fallback(message)`` (default
+    ``RuntimeError``). The contract the router depends on: a remote
+    worker's shed/quarantine is indistinguishable, by type, from a
+    local engine's."""
+    msg = str(header.get("error", "remote error"))
+    etype = header.get("etype")
+    cls = _typed_error_registry().get(etype) if etype else None
+    if cls is not None:
+        if etype == "RetryAfter":
+            return cls(msg, float(header.get("retry_after_s", 0.0)))
+        return cls(msg)
+    return (fallback or RuntimeError)(msg)
 
 
 def unpack_reply(payload: bytes) -> Tuple[Dict[str, Any],

@@ -107,6 +107,10 @@ class EngineWorker:
                 continue
             self._served += 1
             corr, reply_topic = header.get("id"), header.get("reply")
+            # multi-model routing fields ride the header; absent for a
+            # single-model engine (whose submit() takes no model=)
+            route = {k: header[k] for k in ("model", "version", "session")
+                     if header.get(k) is not None}
             try:
                 if header.get("kind") == wire.KIND_GENERATE:
                     g = header.get("gen") or {}
@@ -115,12 +119,13 @@ class EngineWorker:
                         temperature=g.get("temperature", 0.0),
                         top_k=g.get("top_k", 0), top_p=g.get("top_p", 0.0),
                         eos_token=g.get("eos_token"),
-                        seed=g.get("seed", 0))
+                        seed=g.get("seed", 0), **route)
                 else:
-                    fut = self.engine.submit(x)
+                    fut = self.engine.submit(x, **route)
             except BaseException as e:
-                self._reply(reply_topic, wire.pack_reply(
-                    corr, error=f"{type(e).__name__}: {e}"))
+                # typed: the caller's endpoint reconstructs the same
+                # exception class (shed/quarantine isolation contract)
+                self._reply(reply_topic, wire.pack_reply(corr, error=e))
                 continue
             fut.add_done_callback(
                 lambda f, c=corr, rt=reply_topic: self._deliver(c, rt, f))
@@ -132,8 +137,7 @@ class EngineWorker:
         if err is None:
             payload = wire.pack_reply(corr, np.asarray(fut.result()))
         else:
-            payload = wire.pack_reply(
-                corr, error=f"{type(err).__name__}: {err}")
+            payload = wire.pack_reply(corr, error=err)
         self._reply(reply_topic, payload)
 
     def _reply(self, reply_topic, payload):

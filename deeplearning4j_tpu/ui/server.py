@@ -186,6 +186,20 @@ class _Handler(BaseHTTPRequestHandler):
             body["inference"] = engine.stats()
             degraded = degraded or bool(body["inference"].get("degraded"))
             unwarmed = unwarmed or not body["inference"].get("warmed", True)
+            models = body["inference"].get("models")
+            if models:
+                # per-model readiness (multi-model engine): a model is
+                # ready when it has a warmed serving version and a
+                # closed breaker; /healthz/ready 503s until EVERY model
+                # is — an orchestrator must not route traffic at a pod
+                # whose newest deploy is still compiling
+                body["models_ready"] = {
+                    name: bool(m.get("ready") and m.get("warmed"))
+                    for name, m in models.items()}
+                unwarmed = unwarmed or not all(
+                    m.get("warmed") for m in models.values())
+                degraded = degraded or any(
+                    m.get("breaker_open") for m in models.values())
         router = getattr(self.server, "_router", None)
         if router is not None:
             # fleet aggregation: every endpoint's health/stats as the

@@ -177,6 +177,19 @@ KNOWN_DL4J_METRICS = {
     "dl4j_decode_tokens_total",
     "dl4j_decode_prefill_latency_ms",
     "dl4j_decode_latency_ms",
+    # multi-model serving plane (serving/registry.py ModelRegistry +
+    # the registry-mode ParallelInference): per-model traffic/latency,
+    # lifecycle events (deploys by outcome, rollbacks by reason,
+    # budget evictions), active-version / breaker / pinned-bytes gauges
+    "dl4j_model_requests_total",
+    "dl4j_model_errors_total",
+    "dl4j_model_latency_ms",
+    "dl4j_model_deploys_total",
+    "dl4j_model_rollbacks_total",
+    "dl4j_model_evictions_total",
+    "dl4j_model_active_version",
+    "dl4j_model_breaker_open",
+    "dl4j_model_pinned_bytes",
     # horizontal serving tier (serving/router.py InferenceRouter)
     "dl4j_router_requests_total",
     "dl4j_router_shed_total",

@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Stress the fault-injection suite: rerun it K times with rotating
+seeds and fail on ANY nondeterminism.
+
+Order-dependent flakes (the w2v trained-vector family PR 6 root-caused
+to CPU donation aliasing) present as tests whose outcome depends on
+what ran before them — a single green run proves nothing. This tool
+pins the determinism contract the ``faultinject`` marker promises
+("a failing test replays bit-identically") two ways:
+
+**Full mode (CLI)** — spawn ``pytest -m faultinject`` in a FRESH
+process K times (subprocess-per-run is mandatory: fork-after-jax is
+unreliable on this box, and a fresh interpreter is the only honest
+replay), rotating ``PYTHONHASHSEED`` / ``DL4J_TPU_STRESS_SEED`` across
+runs. Any test whose outcome differs between runs is nondeterministic
+→ exit 1 (a test that fails identically every run is a deterministic
+failure — also exit 1, but reported as such)::
+
+    python scripts/stress_faultinject.py --runs 3 [--seed-base 0]
+        [-m faultinject] [--pytest-args ...]
+
+**Quick mode (importable — wired into tier-1)** — :func:`quick_check`
+replays the in-process deterministic injector battery (seeded NaN/raise
+schedules, flaky-broker schedules, torn-write counting, replica/model
+poison sequences) twice per seed across rotating seeds and compares
+the full event logs bit-for-bit. It runs in milliseconds with no
+subprocess and no jax compute, so the tier-1 sweep carries it on every
+run; the full mode is the pre-merge / CI deep check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+from typing import Dict, List
+
+# runnable from anywhere: the repo root (the package's parent) must be
+# importable when invoked as a script rather than through pytest
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# ----------------------------------------------------------- quick mode
+
+
+def _scenario_log(seed: int) -> str:
+    """One deterministic pass over the injector battery; returns the
+    full event log. The determinism contract: same seed → identical
+    log, bit for bit."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.faultinject import (FailingDataSetIterator,
+                                                FlakyBroker, InjectedFault,
+                                                ModelPoison, ReplicaPoison,
+                                                TornWrites)
+    from deeplearning4j_tpu.streaming.broker import InMemoryBroker
+
+    events: List[str] = []
+
+    # 1) seeded NaN/raise schedules across resets
+    rng = np.random.default_rng(seed)
+    ds = DataSet(rng.standard_normal((8, 3)).astype(np.float32),
+                 np.tile(np.eye(2, dtype=np.float32), (4, 1)))
+    it = FailingDataSetIterator(ListDataSetIterator(ds, batch_size=2),
+                                nan_at=(seed % 3,), raise_at=(5,),
+                                p_nan=0.3, seed=seed)
+    for epoch in range(2):
+        it.reset()
+        while it.has_next():
+            try:
+                batch = it.next()
+            except InjectedFault as e:
+                events.append(f"iter raise: {e}")
+                continue
+            nan = bool(np.isnan(np.asarray(batch.features)).any())
+            events.append(f"iter batch nan={nan}")
+    events.append(f"iter injected nan={it.injected_nan} "
+                  f"raise={it.injected_raise}")
+
+    # 2) flaky broker schedules + seeded random failures
+    broker = FlakyBroker(InMemoryBroker(), fail_publishes=(1,),
+                         fail_consumes=(0,), p_fail=0.25, seed=seed)
+    for i in range(6):
+        try:
+            broker.publish("t", f"m{i}".encode())
+            events.append(f"pub {i} ok")
+        except ConnectionError as e:
+            events.append(f"pub {i} fail: {e}")
+    for i in range(8):
+        try:
+            msg = broker.consume("t", timeout=0)
+            events.append(f"con {i} -> "
+                          f"{msg.decode() if msg is not None else None}")
+        except ConnectionError as e:
+            events.append(f"con {i} fail: {e}")
+    events.append(f"broker faults={broker.faults_injected}")
+
+    # 3) torn-write crash scheduling (counted os.replace/rename installs)
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        with TornWrites(crash_on_call=2, path_substr="unit") as torn:
+            for i in range(3):
+                tmp = os.path.join(td, f"t{i}")
+                dst = os.path.join(td, f"unit{i}")
+                with open(tmp, "w") as f:
+                    f.write("x")
+                try:
+                    os.replace(tmp, dst)
+                    events.append(f"install {i} ok")
+                except InjectedFault:
+                    # log the index, not the message — the tempdir path
+                    # inside it is fresh per run by design
+                    events.append(f"install {i} crash")
+        events.append(f"torn calls={torn.calls}")
+
+    # 4) replica/model poison hit sequences
+    rp = ReplicaPoison(replica=1, failures=2)
+    for i in range(4):
+        for replica in (0, 1):
+            try:
+                rp(replica, (1, 3))
+                events.append(f"rp {i}/{replica} ok")
+            except InjectedFault:
+                events.append(f"rp {i}/{replica} hit")
+    mp = ModelPoison("m", failures=3)
+    for i in range(5):
+        for model in ("m", "other"):
+            try:
+                mp(i % 2, (1, 3), model)
+                events.append(f"mp {i}/{model} ok")
+            except InjectedFault:
+                events.append(f"mp {i}/{model} hit")
+    events.append(f"rp hits={rp.hits} mp hits={mp.hits}")
+    return "\n".join(events)
+
+
+def quick_check(seeds=(0, 1, 2), runs_per_seed: int = 2) -> List[str]:
+    """Replay the injector battery ``runs_per_seed`` times per seed;
+    returns violations ([] = deterministic). Tier-1 runs this."""
+    problems: List[str] = []
+    for seed in seeds:
+        logs = [_scenario_log(int(seed)) for _ in range(runs_per_seed)]
+        for i, log in enumerate(logs[1:], 2):
+            if log != logs[0]:
+                a, b = logs[0].splitlines(), log.splitlines()
+                diff = next((j for j, (x, y) in enumerate(zip(a, b))
+                             if x != y), min(len(a), len(b)))
+                problems.append(
+                    f"seed {seed}: run {i} diverged from run 1 at event "
+                    f"{diff}: {a[diff] if diff < len(a) else '<end>'!r} vs "
+                    f"{b[diff] if diff < len(b) else '<end>'!r}")
+    return problems
+
+
+# ------------------------------------------------------------ full mode
+
+_RESULT_RE = re.compile(r"^(PASSED|FAILED|ERROR|XFAIL|XPASS|SKIPPED) "
+                        r"(\S+)", re.MULTILINE)
+
+
+def _run_suite(seed: int, marker: str, extra: List[str]) -> Dict[str, str]:
+    """One fresh-process pytest run; returns {test_id: outcome}."""
+    env = dict(os.environ)
+    env["PYTHONHASHSEED"] = str(seed)
+    env["DL4J_TPU_STRESS_SEED"] = str(seed)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "-m", "pytest", "tests/", "-m", marker, "-q",
+           "-rA", "--tb=no", "-p", "no:cacheprovider", "-p", "no:randomly",
+           "--continue-on-collection-errors", *extra]
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    outcomes: Dict[str, str] = {}
+    for m in _RESULT_RE.finditer(proc.stdout):
+        outcomes[m.group(2)] = m.group(1)
+    if not outcomes:
+        outcomes["<collection>"] = f"rc={proc.returncode}"
+    return outcomes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--runs", type=int, default=3,
+                    help="fresh-process pytest runs (default 3)")
+    ap.add_argument("--seed-base", type=int, default=0)
+    ap.add_argument("-m", "--marker", default="faultinject",
+                    help="pytest marker expression (default: faultinject)")
+    ap.add_argument("--quick", action="store_true",
+                    help="run only the in-process injector battery "
+                         "(what tier-1 wires in)")
+    ap.add_argument("--pytest-args", nargs=argparse.REMAINDER, default=[],
+                    help="extra args forwarded to pytest")
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        problems = quick_check(
+            seeds=range(args.seed_base, args.seed_base + args.runs))
+        for p in problems:
+            print(p, file=sys.stderr)
+        if not problems:
+            print(f"ok: injector battery deterministic over {args.runs} "
+                  "seeds x 2 runs")
+        return 1 if problems else 0
+
+    runs: List[Dict[str, str]] = []
+    for i in range(args.runs):
+        seed = args.seed_base + i
+        print(f"run {i + 1}/{args.runs} (seed {seed}) ...", flush=True)
+        outcomes = _run_suite(seed, args.marker, args.pytest_args)
+        n_fail = sum(1 for o in outcomes.values()
+                     if o in ("FAILED", "ERROR"))
+        print(f"  {len(outcomes)} tests, {n_fail} failed", flush=True)
+        runs.append(outcomes)
+
+    flaky: List[str] = []
+    all_tests = sorted(set().union(*runs))
+    for test in all_tests:
+        seen = {r.get(test, "<missing>") for r in runs}
+        if len(seen) > 1:
+            flaky.append(f"NONDETERMINISTIC {test}: "
+                         + " / ".join(sorted(seen)))
+    deterministic_failures = sorted(
+        t for t in all_tests
+        if all(r.get(t) in ("FAILED", "ERROR") for r in runs))
+    for f in flaky:
+        print(f, file=sys.stderr)
+    for t in deterministic_failures:
+        print(f"DETERMINISTIC FAILURE {t}", file=sys.stderr)
+    if not flaky and not deterministic_failures:
+        print(f"ok: {len(all_tests)} tests deterministic over "
+              f"{args.runs} runs")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

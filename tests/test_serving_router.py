@@ -618,3 +618,177 @@ def test_router_metric_schema(net, rng, fresh_registry):
     finally:
         router.close()
         ep.close()
+
+
+# ----------------------- typed engine errors across the wire boundary
+# (ISSUE-7 satellite: a remote worker's shed/quarantine must surface to
+# the router caller as the SAME exception type as a LocalEndpoint's,
+# for both classify and generate paths)
+
+def _shedding_engine(net):
+    """An engine that sheds deterministically: nothing consumes the
+    1-slot admission queue (start=False), so the second submit raises
+    InferenceBackpressure synchronously."""
+    return ParallelInference(net, queue_capacity=1, reject_when_full=True,
+                             replicas=1, start=False)
+
+
+def _first_error(router, submit):
+    """Submit one request at a time (the first may park in a 1-slot
+    queue and never resolve); returns the first engine error seen —
+    checked after EVERY submit so a router-side ejection can't mask
+    the typed error under test."""
+    futs = []
+    for _ in range(3):
+        try:
+            futs.append(submit())
+        except Exception as e:
+            return e
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            err = next((f.exception() for f in futs
+                        if f.done() and f.exception() is not None), None)
+            if err is not None:
+                return err
+            if all(f.done() for f in futs):
+                break
+            time.sleep(0.01)
+    raise AssertionError("engine never shed")
+
+
+def test_backpressure_shed_same_type_local_and_remote(net, rng,
+                                                      fresh_registry):
+    from deeplearning4j_tpu.parallel.inference import InferenceBackpressure
+    x = rng.standard_normal((1, N_IN)).astype(np.float32)
+    prompt = rng.integers(0, 11, (1, 3))
+    g = gpt(vocab_size=11, d_model=16, n_layers=2, num_heads=2, max_len=32,
+            compute_dtype="float32", learning_rate=0.01).init()
+
+    # local path: the engine's typed exception reaches the router caller
+    local_errs = {}
+    for kind, engine, submit_args in (
+            ("classify", _shedding_engine(net), ("submit", (x,))),
+            ("generate", _shedding_engine(g), ("submit_generate", (prompt, 2)))):
+        router = InferenceRouter([LocalEndpoint(engine, "solo")],
+                                 max_attempts=1)
+        try:
+            local_errs[kind] = _first_error(
+                router, lambda: getattr(router, submit_args[0])(*submit_args[1]))
+        finally:
+            router.close()
+            engine.shutdown()
+
+    # remote path: the worker packs the typed error, the endpoint
+    # reconstructs it, the router caller sees the SAME class
+    remote_errs = {}
+    for kind, engine, submit_args in (
+            ("classify", _shedding_engine(net), ("submit", (x,))),
+            ("generate", _shedding_engine(g), ("submit_generate", (prompt, 2)))):
+        broker = InMemoryBroker()
+        from deeplearning4j_tpu.serving import EngineWorker
+        worker = EngineWorker(engine, broker, f"shed-{kind}",
+                              heartbeat_s=0.05)
+        ep = RemoteEndpoint(broker, f"shed-{kind}", request_timeout_s=30.0)
+        router = InferenceRouter([ep], max_attempts=1)
+        try:
+            assert _spin_until(ep.alive, timeout=10)
+            remote_errs[kind] = _first_error(
+                router, lambda: getattr(router, submit_args[0])(*submit_args[1]))
+        finally:
+            router.close()
+            worker.kill()
+            ep.close()
+            engine.shutdown()
+
+    for kind in ("classify", "generate"):
+        assert isinstance(local_errs[kind], InferenceBackpressure), kind
+        assert type(remote_errs[kind]) is type(local_errs[kind]), (
+            kind, remote_errs[kind], local_errs[kind])
+
+
+def test_model_quarantine_same_type_local_and_remote(net, rng,
+                                                     fresh_registry):
+    from deeplearning4j_tpu.serving import (EngineWorker, ModelQuarantined,
+                                            ModelRegistry)
+
+    def quarantined_engine():
+        reg = ModelRegistry()
+        reg.register("m", net=net)
+        eng = ParallelInference(registry=reg, max_batch_size=4, replicas=1)
+        with reg._lock:  # deterministic: breaker opened by hand
+            reg._models["m"].breaker_open = True
+        return eng
+
+    x = rng.standard_normal((1, N_IN)).astype(np.float32)
+    local = quarantined_engine()
+    router = InferenceRouter([LocalEndpoint(local, "solo")], max_attempts=1)
+    try:
+        local_err = _first_error(router, lambda: router.submit(x, model="m"))
+    finally:
+        router.close()
+        local.shutdown()
+
+    remote = quarantined_engine()
+    broker = InMemoryBroker()
+    worker = EngineWorker(remote, broker, "quar", heartbeat_s=0.05)
+    ep = RemoteEndpoint(broker, "quar", request_timeout_s=30.0)
+    router = InferenceRouter([ep], max_attempts=1)
+    try:
+        assert _spin_until(ep.alive, timeout=10)
+        remote_err = _first_error(router, lambda: router.submit(x, model="m"))
+    finally:
+        router.close()
+        worker.kill()
+        ep.close()
+        remote.shutdown()
+
+    assert isinstance(local_err, ModelQuarantined)
+    assert type(remote_err) is type(local_err)
+    assert "quarantined" in str(remote_err)
+
+
+def test_retry_after_roundtrips_typed_through_wire():
+    from deeplearning4j_tpu.serving import wire
+    payload = wire.pack_reply("c1", error=RetryAfter("try later", 1.5))
+    header, result = wire.unpack_reply(payload)
+    assert result is None and header["ok"] is False
+    err = wire.typed_error(header)
+    assert isinstance(err, RetryAfter)
+    assert err.retry_after_s == 1.5 and "try later" in str(err)
+
+
+# ---------------------- session (endpoint, model, version) vs cutover
+
+def test_router_session_pins_endpoint_model_and_version(fresh_registry):
+    from deeplearning4j_tpu.serving import ModelRegistry
+    g1 = gpt(vocab_size=11, d_model=16, n_layers=2, num_heads=2, max_len=32,
+             compute_dtype="float32", learning_rate=0.01, seed=1).init()
+    g2 = gpt(vocab_size=11, d_model=16, n_layers=2, num_heads=2, max_len=32,
+             compute_dtype="float32", learning_rate=0.01, seed=9).init()
+    reg = ModelRegistry()
+    reg.register("g", net=g1)
+    eng = ParallelInference(registry=reg, max_batch_size=8,
+                            max_latency_ms=0.0, replicas=1)
+    ep = LocalEndpoint(eng, "e0")
+    router = InferenceRouter([ep])
+    try:
+        prompt = np.asarray([[1, 2, 3]], np.int64)
+        solo1 = np.asarray(g1.generate(prompt, 5))
+        solo2 = np.asarray(g2.generate(prompt, 5))
+        assert not np.array_equal(solo1, solo2)
+        np.testing.assert_array_equal(
+            router.generate(prompt, 5, session="s1", model="g", timeout=60),
+            solo1)
+        assert router.session_pin("s1") == ("e0", "g")
+        reg.deploy("g", net=g2, warm=False)  # hot-swap mid-stream
+        # the pinned stream finishes on the version it started on; the
+        # version half of the pin lives engine-side on the session key
+        np.testing.assert_array_equal(
+            router.generate(prompt, 5, session="s1", model="g", timeout=60),
+            solo1)
+        np.testing.assert_array_equal(
+            router.generate(prompt, 5, session="s2", model="g", timeout=60),
+            solo2)
+    finally:
+        router.close()
+        eng.shutdown()
