@@ -24,6 +24,14 @@ Sub-benchmarks (each reported under "sub_benchmarks"):
     deploy under load (zero lost requests, bounded p99 impact), a
     corrupt-checkpoint deploy auto-rejected, and a NaN-poisoned canary
     auto-rolled-back — all while the prior versions keep serving
+  - continuous_decode — iteration-level decode scheduling over the
+    paged KV block pool (serving/continuous.py) vs the PR-5
+    whole-burst submit_generate path, both under the SAME open-loop
+    Poisson arrival trace with mixed prompt lengths and EOS-mixed
+    generation lengths under a generous max_new cap: sustained USEFUL
+    tokens/sec, time-to-first-token and per-token p50/p99, pool
+    occupancy/preemptions, zero steady-state compiles and zero leaked
+    blocks (pool free returns to total after drain)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 The headline metric is ResNet-50 MFU when available (the heaviest
@@ -714,6 +722,154 @@ def bench_lstm_decode():
                             flops_per_token=2.0 * macs)}
 
 
+def bench_continuous_decode():
+    """Continuous batching vs whole-burst decode under the SAME seeded
+    open-loop Poisson trace (arrivals don't wait for completions) with
+    mixed prompt lengths and EOS-mixed GENERATION lengths — every
+    request carries a generous max_new cap (the API max_tokens shape)
+    but terminates at its own sampled EOS, typically far earlier. This
+    is the traffic the whole-burst path structurally cannot serve
+    well: a coalesced group computes until its SLOWEST row finishes
+    (expected max of n geometric lengths grows with ln n while useful
+    work stays at the mean), and every row pins a dense
+    bucket+max_new cache for the group's whole lifetime. The
+    iteration-level scheduler retires each row at ITS eos between
+    K-token bursts, backfills the slot from the queue, and recycles
+    the row's pool blocks immediately. Throughput counts USEFUL tokens
+    (through each row's eos). Acceptance: >= 1.5x sustained tokens/sec
+    and lower p99 time-to-first-token, with zero steady-state XLA
+    compiles and zero leaked KV blocks."""
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.models.zoo.transformer import gpt
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    vocab, d, layers, heads, max_len = 32, 128, 4, 4, 256
+    eos, max_new, temp = 0, 160, 2.0
+    net = gpt(vocab_size=vocab, d_model=d, n_layers=layers,
+              num_heads=heads, max_len=max_len,
+              compute_dtype="float32", learning_rate=0.01).init()
+    rng = np.random.default_rng(0)
+    # saturating Poisson arrivals; mixed prompt buckets; generation
+    # lengths ~ geometric via sampled EOS (mean ~vocab steps), capped
+    # far above the mean by max_new — the realistic serving mix
+    n_req = 96
+    arrivals = np.cumsum(rng.exponential(0.0025, n_req))
+    plens = rng.choice([6, 14, 30], n_req)
+    prompts = [rng.integers(1, vocab, (1, int(t))) for t in plens]
+    reg = monitor.get_registry()
+
+    def useful(row, t_in):
+        """Tokens through the row's own EOS (inclusive); the cap when
+        no EOS was sampled."""
+        gen = row[t_in:]
+        idx = np.where(gen == eos)[0]
+        return int(idx[0]) + 1 if len(idx) else len(gen)
+
+    def drive(engine, scheduler=None):
+        """One open-loop pass: submit on the trace clock, poll to
+        completion, return per-request timings + pool peek."""
+        done_t = {}
+
+        def cb(i):
+            return lambda f: done_t.__setitem__(i, time.perf_counter())
+
+        t0 = time.perf_counter()
+        subs, futs = [], []
+        for i in range(n_req):
+            target = t0 + arrivals[i]
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            subs.append(time.perf_counter())
+            f = engine.submit_generate(prompts[i], max_new,
+                                       temperature=temp, eos_token=eos,
+                                       seed=i)
+            f.add_done_callback(cb(i))
+            futs.append(f)
+        peak_occ = 0.0
+        while len(done_t) < n_req:
+            if scheduler is not None:
+                peak_occ = max(peak_occ,
+                               scheduler.stats()["pool"]["occupancy"])
+            time.sleep(5e-3)
+        tokens = [useful(f.result(0)[0], int(plens[i]))
+                  for i, f in enumerate(futs)]
+        t_end = max(done_t.values())
+        total = int(np.sum(tokens))
+        per_tok = sorted((done_t[i] - subs[i]) / tokens[i] * 1e3
+                         for i in range(n_req))
+        if scheduler is not None:
+            ttfts = sorted((c["t_first"] - c["t_submit"]) * 1e3
+                           for c in scheduler.completed)
+        else:
+            # whole-burst: the first token only exists when the whole
+            # burst resolves — TTFT IS completion latency
+            ttfts = sorted((done_t[i] - subs[i]) * 1e3
+                           for i in range(n_req))
+        q = lambda xs, p: xs[min(len(xs) - 1, int(len(xs) * p))]
+        return {
+            "tokens": total,
+            "tokens_per_sec": total / (t_end - t0),
+            "ttft_p50_ms": q(ttfts, 0.5), "ttft_p99_ms": q(ttfts, 0.99),
+            "per_token_p50_ms": q(per_tok, 0.5),
+            "per_token_p99_ms": q(per_tok, 0.99),
+            "peak_pool_occupancy": peak_occ,
+        }
+
+    warm_lens = [6, 14, 30]
+    # --- baseline: the PR-5 whole-burst coalescing path, OUT-OF-THE-BOX
+    # knobs (max_batch_size=32, 5ms window — its designed operating
+    # point; smaller batches would just trade its waste for latency)
+    base_eng = ParallelInference(net, replicas=1)
+    base_eng.warmup_generate(warm_lens, max_new, temperature=temp,
+                             eos_token=eos)
+    base = drive(base_eng)
+    base_eng.shutdown()
+
+    # --- continuous: iteration-level scheduler + paged KV pool sized
+    # for the COMMON-case context (not slots x max cap: rare long
+    # generations preempt instead of reserving worst-case memory)
+    cont_eng = ParallelInference(net, replicas=1, continuous=True,
+                                 decode_slots=16, decode_burst=8,
+                                 kv_block_size=16, kv_blocks=97)
+    cont_eng.warmup_generate(warm_lens, max_new)
+    miss0 = reg.family_total(monitor.JIT_CACHE_MISS_COUNTER)
+    sched = cont_eng._continuous_scheduler()
+    cont = drive(cont_eng, scheduler=sched)
+    steady_misses = reg.family_total(monitor.JIT_CACHE_MISS_COUNTER) - miss0
+    cont_eng.drain(60)
+    pool = sched.stats()["pool"]
+    leaked = int(pool["blocks_total"] - pool["blocks_free"])
+    sstats = sched.stats()
+    cont_eng.shutdown()
+
+    ratio = cont["tokens_per_sec"] / base["tokens_per_sec"]
+    return {
+        "metric": "continuous_decode_sustained_tokens_per_sec",
+        "value": round(cont["tokens_per_sec"], 1), "unit": "tokens/sec",
+        "whole_burst_tokens_per_sec": round(base["tokens_per_sec"], 1),
+        # acceptance composite: the >= 1.5x sustained-throughput bar
+        "vs_baseline": round(ratio, 3),
+        "ttft_p50_ms": round(cont["ttft_p50_ms"], 2),
+        "ttft_p99_ms": round(cont["ttft_p99_ms"], 2),
+        "whole_burst_ttft_p50_ms": round(base["ttft_p50_ms"], 2),
+        "whole_burst_ttft_p99_ms": round(base["ttft_p99_ms"], 2),
+        "ttft_p99_improvement": round(
+            base["ttft_p99_ms"] / max(1e-9, cont["ttft_p99_ms"]), 3),
+        "per_token_p50_ms": round(cont["per_token_p50_ms"], 3),
+        "per_token_p99_ms": round(cont["per_token_p99_ms"], 3),
+        "whole_burst_per_token_p99_ms": round(base["per_token_p99_ms"], 3),
+        "useful_tokens": cont["tokens"],
+        "peak_pool_occupancy": round(cont["peak_pool_occupancy"], 3),
+        "preemptions": int(sstats["preemptions"]),
+        "bursts": int(sstats["bursts"]),
+        "steady_state_jit_misses": float(steady_misses),
+        "leaked_blocks": leaked,
+        "requests": n_req,
+        "max_new_cap": max_new,
+    }
+
+
 def bench_router_slo():
     """Horizontal serving tier under open-loop Poisson load (the SLO
     protocol: arrivals don't wait for completions, so queueing shows up
@@ -1164,6 +1320,7 @@ def main():
                      ("lstm_decode", bench_lstm_decode),
                      ("serving_inference", bench_serving_inference),
                      ("fault_recovery", bench_fault_recovery),
+                     ("continuous_decode", bench_continuous_decode),
                      ("router_slo", bench_router_slo),
                      ("multi_model", bench_multi_model),
                      ("word2vec", bench_word2vec)]:

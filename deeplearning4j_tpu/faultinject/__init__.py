@@ -291,6 +291,39 @@ def poison_model(engine, model: str, failures: Optional[int] = None,
 
 # -------------------------------------------------------------- routing
 
+class BurstKill:
+    """Kill-mid-burst injector for the continuous decode scheduler
+    (``ContinuousDecodeScheduler``'s ``burst_hook`` /
+    ``ParallelInference(decode_burst_hook=...)`` seam): the hook fires
+    once per accounted burst dispatch, and burst indices
+    ``[after, after + failures)`` raise :class:`InjectedFault` BEFORE
+    the device program runs — a deterministic stand-in for a dispatch
+    dying under live sequences. The recovery contract under test: the
+    scheduler fails every riding sequence's future with a typed
+    ``DecodeBurstError``, frees their KV blocks immediately (pool free
+    count returns to total after drain — never a leaked block), and
+    keeps serving later admissions. Optionally scoped to one ``lane``
+    key (a (model, version) pair) in multi-model schedulers."""
+
+    def __init__(self, after: int = 1, failures: int = 1,
+                 lane: Optional[tuple] = None):
+        self.after = int(after)
+        self.failures = int(failures)
+        self.lane = lane
+        self.calls = 0
+        self.hits = 0
+
+    def __call__(self, lane_key, burst_index: int) -> None:
+        if self.lane is not None and tuple(lane_key) != tuple(self.lane):
+            return
+        idx = self.calls
+        self.calls += 1
+        if self.after <= idx < self.after + self.failures:
+            self.hits += 1
+            raise InjectedFault(
+                f"injected burst kill at dispatch {idx} (lane {lane_key})")
+
+
 def kill_endpoint(fleet, name: str) -> str:
     """Process-kill injector for the serving fleet: abruptly stop the
     named endpoint's engine worker — consumed requests vanish without

@@ -43,6 +43,14 @@ submit→result latency). ``dl4j_jit_cache_miss_total`` is shared with
 the training plane: a serve-loop dispatch that traces+compiles ticks it
 too, which is how the AOT ``warmup()`` contract is asserted.
 
+The continuous-batching plane (serving/continuous.py +
+nn/kvpool.py) publishes ``dl4j_kvpool_blocks_total`` /
+``dl4j_kvpool_blocks_free`` / ``dl4j_kvpool_alloc_failures_total``
+(paged KV pool occupancy and exhaustion) and the ``dl4j_sched_*``
+family (rows admitted/retired between bursts, preemptions, burst
+count + latency histogram, active-sequence and queued-prefill gauges)
+— the iteration-level decode scheduler's health at a glance.
+
 The horizontal serving tier (serving/router.py ``InferenceRouter``)
 publishes ``dl4j_router_requests_total`` (by ``priority`` class),
 ``dl4j_router_shed_total`` (deadline-admission rejections — shed beats
@@ -108,6 +116,29 @@ DECODE_PREFILL_TOKENS_COUNTER = "dl4j_decode_prefill_tokens_total"
 DECODE_TOKENS_COUNTER = "dl4j_decode_tokens_total"
 DECODE_PREFILL_LATENCY_HISTOGRAM = "dl4j_decode_prefill_latency_ms"
 DECODE_LATENCY_HISTOGRAM = "dl4j_decode_latency_ms"
+
+# Continuous batching plane (serving/continuous.py
+# ContinuousDecodeScheduler + nn/kvpool.py PagedKVCachePool): paged
+# KV-cache pool occupancy (allocatable blocks, free blocks — both
+# labeled ``pool=``) and exhaustion (allocations that found no free
+# block: the scheduler's preempt-or-shed trigger), and the
+# iteration-level decode scheduler — sequences admitted into / retired
+# from batch slots between bursts, preemptions (victim freed + re-queued
+# with its prompt + generated prefix), burst dispatches and their
+# latency histogram, plus live gauges for active sequences and queued
+# prefills. dl4j_jit_cache_miss_total is shared: a burst dispatch that
+# traces+compiles ticks it, which is how the fixed-(slots × K)-shape
+# zero-steady-state-compile contract is asserted.
+KVPOOL_BLOCKS_TOTAL_GAUGE = "dl4j_kvpool_blocks_total"
+KVPOOL_BLOCKS_FREE_GAUGE = "dl4j_kvpool_blocks_free"
+KVPOOL_ALLOC_FAILURES_COUNTER = "dl4j_kvpool_alloc_failures_total"
+SCHED_ADMITTED_COUNTER = "dl4j_sched_admitted_rows_total"
+SCHED_RETIRED_COUNTER = "dl4j_sched_retired_rows_total"
+SCHED_PREEMPTIONS_COUNTER = "dl4j_sched_preemptions_total"
+SCHED_BURSTS_COUNTER = "dl4j_sched_bursts_total"
+SCHED_BURST_LATENCY_HISTOGRAM = "dl4j_sched_burst_latency_ms"
+SCHED_ACTIVE_GAUGE = "dl4j_sched_active_sequences"
+SCHED_QUEUED_GAUGE = "dl4j_sched_queued_prefills"
 
 # Horizontal serving tier (serving/router.py InferenceRouter — the
 # fleet-level plane above ParallelInference): request volume by

@@ -118,6 +118,41 @@ def sample_tokens(logits, keys, step, temperature: float, top_k: int,
     return jnp.argmax(lg + g, axis=-1).astype(jnp.int32)
 
 
+def sample_tokens_rowwise(logits, keys, folds, temp_v, top_k_v, top_p_v):
+    """Per-row sampler over [b, V] logits — the continuous-batching
+    variant of :func:`sample_tokens`: every sampler knob is a traced
+    [b] vector (temperature, top-k, top-p) and the PRNG fold index is
+    per row (``folds`` — each sequence's own generated-token counter),
+    so ONE compiled burst program serves any sampler mix and a
+    sequence's draws depend only on its own key and token index, never
+    on which batch slot or cotenants it shares a burst with.
+    ``temp_v <= 0`` rows are greedy. Same filter semantics as the
+    static sampler: top-k first, then the top-p nucleus over the
+    k-filtered logits."""
+    b, vocab = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / jnp.maximum(temp_v, 1e-6)[:, None]
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+    # top-k: the kth-largest value per row (k <= 0 or k >= V: no filter)
+    srt = jnp.sort(lg, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(top_k_v - 1, 0, vocab - 1)
+    kth = jnp.take_along_axis(srt, k_idx[:, None], axis=1)
+    use_k = ((top_k_v > 0) & (top_k_v < vocab))[:, None]
+    lg = jnp.where(use_k & (lg < kth), neg, lg)
+    # top-p over the k-filtered logits (matches the static ordering)
+    srt2 = jnp.sort(lg, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(srt2, axis=-1)
+    keep = jnp.cumsum(probs, axis=-1) - probs < top_p_v[:, None]
+    cutoff = jnp.min(jnp.where(keep, srt2, jnp.inf), axis=-1, keepdims=True)
+    use_p = ((top_p_v > 0.0) & (top_p_v < 1.0))[:, None]
+    lg = jnp.where(use_p & (lg < cutoff), neg, lg)
+    step_keys = jax.vmap(jax.random.fold_in)(keys, folds)
+    g = jax.vmap(lambda k: jax.random.gumbel(k, (vocab,), jnp.float32))(
+        step_keys)
+    sampled = jnp.argmax(lg + g, axis=-1).astype(jnp.int32)
+    return jnp.where(temp_v > 0.0, sampled, greedy)
+
+
 def _ordered_impls(net) -> List[Any]:
     """The net's layer impls in forward order. MultiLayerNetwork: the
     stack as-is. ComputationGraph: the single-input linear layer chain
@@ -157,12 +192,17 @@ class _GeneratorBase:
 
     # --- jit cache on the net (resets with init(), like every program)
 
-    def _jit(self, key, builder, donate_caches: bool = False):
+    def _jit(self, key, builder, donate_caches: bool = False,
+             donate: Optional[Tuple[int, ...]] = None):
         jits = self.net._jits
         if key not in jits:
-            donate = (1,) if donate_caches and \
-                jax.default_backend() != "cpu" else ()
-            jits[key] = jax.jit(builder(), donate_argnums=donate)
+            argnums: Tuple[int, ...] = ()
+            if jax.default_backend() != "cpu":
+                if donate is not None:
+                    argnums = donate
+                elif donate_caches:
+                    argnums = (1,)
+            jits[key] = jax.jit(builder(), donate_argnums=argnums)
         return jits[key]
 
     def _head_logits(self, params, h):
@@ -336,6 +376,134 @@ class TransformerGenerator(_GeneratorBase):
         self._observe(reg, b, int(np.sum(lengths)), max_new,
                       (t1 - t0) * 1e3, (t2 - t1) * 1e3)
         return toks
+
+    # ------------------------------------ continuous paged decoding
+    # (serving/continuous.py drives these: vLLM-style block-table
+    # attention + Orca-style fixed-K bursts — see nn/kvpool.py)
+
+    def kv_layout(self) -> Tuple[int, int, int, Any]:
+        """(num_layers, num_heads, head_dim, cache dtype) — the pool
+        layout this net's paged caches need."""
+        c = self.blocks[0].conf
+        dtype = self.cd if self.cd is not None else jnp.float32
+        return (len(self.blocks), c.num_heads, c.n_out // c.num_heads,
+                dtype)
+
+    def max_context(self) -> int:
+        return int(self.emb.conf.max_len)
+
+    def prefill_program(self, cache_len: int):
+        """The bucketed prompt prefill, reused verbatim for the paged
+        path: dense per-row caches [b, cache_len, h, hd] the scatter
+        program then pages into pool blocks (cache_len = the prompt
+        bucket rounded up to a whole number of blocks)."""
+        return self._get_prefill(cache_len)
+
+    def scatter_program(self, rows: int, t_blk: int, block_size: int):
+        """Pages a prefill's dense caches into the shared pool: every
+        layer's [rows, t_blk, h, hd] K/V reshapes into t_blk/block_size
+        block-sized chunks and scatters to the rows' block-table ids
+        (unallocated tail entries are 0 — the trash block)."""
+        if t_blk % block_size != 0:
+            raise ValueError(
+                f"t_blk {t_blk} not a multiple of block_size {block_size}")
+        nb = t_blk // block_size
+
+        def builder():
+            def scatter(pools, caches, tables):
+                out = []
+                for pool, cache in zip(pools, caches):
+                    tail = cache["k"].shape[2:]
+                    kr = cache["k"].reshape(rows, nb, block_size, *tail)
+                    vr = cache["v"].reshape(rows, nb, block_size, *tail)
+                    out.append({
+                        "k": pool["k"].at[tables].set(
+                            kr.astype(pool["k"].dtype)),
+                        "v": pool["v"].at[tables].set(
+                            vr.astype(pool["v"].dtype))})
+                return out
+            return scatter
+        return self._jit(("gen_pool_scatter", rows, t_blk, block_size),
+                         builder, donate=(0,))
+
+    def row_sample_program(self):
+        """One rowwise-sampler dispatch off prefill logits: per-row
+        keys, fold indices (a resumed sequence continues its own token
+        clock) and sampler knobs — the admission-time tok0 sample."""
+        def builder():
+            def rsample(logits, keys, folds, temp_v, top_k_v, top_p_v):
+                return sample_tokens_rowwise(logits, keys, folds,
+                                             temp_v, top_k_v, top_p_v)
+            return rsample
+        return self._jit(("gen_row_sample",), builder)
+
+    def burst_program(self, slots: int, k_burst: int, max_blocks: int,
+                      num_blocks: int, block_size: int,
+                      sampling: bool = True):
+        """ONE fixed-shape program for a whole scheduler burst: K
+        decode steps over ``slots`` batch rows with paged block-table
+        attention, per-row traced positions / sampler knobs / PRNG fold
+        clocks / max-new quotas, and a done-mask that freezes finished
+        rows (their writes redirect to the trash block, so a retired
+        slot can never touch the pool between bursts). The shape is
+        (slots × K × max_blocks) — static no matter which sequences
+        occupy the slots, which is what makes steady state compile-free.
+        Returns (pools, ys [slots, K], tok, pos, n_gen, done).
+        ``sampling=False`` compiles the greedy-only variant (argmax,
+        no sorts/PRNG in the step — the scheduler picks it whenever no
+        active row has a temperature, mirroring the static sampler
+        specialization of the whole-burst programs)."""
+        def builder():
+            def burst(params, pools, tables, pos, tok, n_gen, done, keys,
+                      temp_v, top_k_v, top_p_v, eos_v, max_new_v):
+                p_emb = self._cast(params[self.emb.name])
+
+                def live(carry):
+                    pools, tok, pos, n_gen, done = carry
+                    active = ~done
+                    x = self._embed_token(p_emb, tok, pos)
+                    new_pools = []
+                    for blk, pool in zip(self.blocks, pools):
+                        cache = {"k": pool["k"], "v": pool["v"],
+                                 "table": tables}
+                        x, cache = blk.decode_step(
+                            self._cast(params[blk.name]), x, cache, pos,
+                            write_mask=active)
+                        new_pools.append({"k": cache["k"],
+                                          "v": cache["v"]})
+                    logits = self._head_logits(params, x)
+                    if sampling:
+                        nxt = sample_tokens_rowwise(logits, keys, n_gen,
+                                                    temp_v, top_k_v, top_p_v)
+                    else:
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    step = active.astype(jnp.int32)
+                    n2 = n_gen + step
+                    new_done = done | (active & (eos_v >= 0)
+                                       & (nxt == eos_v)) \
+                        | (n2 >= max_new_v)
+                    out = jnp.where(active, nxt, jnp.int32(0))
+                    return (new_pools, jnp.where(active, nxt, tok),
+                            pos + step, n2, new_done), out
+
+                def body(carry, _):
+                    # every row done: skip the whole transformer step
+                    # (the whole-burst EOS short-circuit, per burst)
+                    return jax.lax.cond(
+                        jnp.all(carry[4]),
+                        lambda c: (c, jnp.zeros_like(c[1])),
+                        live, carry)
+
+                carry0 = (pools, tok, pos.astype(jnp.int32),
+                          n_gen.astype(jnp.int32), done)
+                (pools, tok, pos, n_gen, done), ys = jax.lax.scan(
+                    body, carry0, jnp.arange(k_burst))
+                return (pools, jnp.swapaxes(ys, 0, 1), tok, pos, n_gen,
+                        done)
+            return burst
+        return self._jit(
+            ("gen_burst", slots, k_burst, max_blocks, num_blocks,
+             block_size, bool(sampling)), builder, donate=(1,))
 
     def run_eager(self, params, ids, lengths, max_new, sampler, keys,
                   replica=None) -> np.ndarray:

@@ -1,0 +1,209 @@
+"""Paged KV-cache block pool — vLLM's PagedAttention memory discipline.
+
+The whole-burst decode path (nn/generate.py, PR 5) gives every sequence
+a DENSE cache of ``prompt_bucket + max_new_tokens`` slots for its whole
+lifetime: a short generation pins the same memory as a long one, and a
+batch slot cannot be recycled until its burst finishes. This module is
+the fix's memory half: KV state lives in a shared pool of fixed-size
+**token blocks** (``[num_blocks, block_size, heads, head_dim]`` per
+transformer layer), each sequence owns an ordered **block table** of
+pool indices, and attention gathers/scatters through the table
+(``TransformerBlockImpl.decode_step`` paged branch). Blocks are
+allocated as a sequence grows and freed the moment it retires, so cache
+memory recycles continuously under sustained traffic instead of
+fragmenting per (bucket, max_new) burst.
+
+Layout invariants:
+
+- **block 0 is the trash block** — never allocated, never freed. Block
+  tables are zero-padded past a sequence's allocation, and masked
+  writes (retired rows, row-bucket padding, warmup dispatches) are
+  redirected to it, so a stale slot can never scribble over another
+  sequence's blocks and warmup never perturbs accounting;
+- one *logical* block id indexes every layer's pool (the vLLM layout):
+  ``alloc``/``free`` account logical blocks, device arrays are per
+  layer;
+- allocation is **deterministic**: the free list hands out the lowest
+  ids first, so a replayed schedule produces identical tables (the
+  property the preemption-order and fault-injection tests pin);
+- accounting is host-side only — freed blocks are NOT zeroed on
+  device; a freed block's garbage is only ever re-read after the next
+  owner's prefill/decode has overwritten the positions its causal mask
+  exposes (the same invariant the dense prefill documents).
+
+The pool publishes ``dl4j_kvpool_blocks_total`` /
+``dl4j_kvpool_blocks_free`` gauges and
+``dl4j_kvpool_alloc_failures_total`` so occupancy and exhaustion are
+first-class signals (the scheduler preempts on exactly the condition
+the failure counter counts).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.monitor import (
+    KVPOOL_ALLOC_FAILURES_COUNTER,
+    KVPOOL_BLOCKS_FREE_GAUGE,
+    KVPOOL_BLOCKS_TOTAL_GAUGE,
+    get_registry,
+)
+
+#: Hashable KV layout a pool serves: (num_layers, heads, head_dim,
+#: block_size, dtype name). Lanes (model versions) whose nets share a
+#: spec share one pool — a canary and its stable version recycle the
+#: same block budget across a cutover.
+PoolSpec = Tuple[int, int, int, int, str]
+
+
+def pool_spec(num_layers: int, num_heads: int, head_dim: int,
+              block_size: int, dtype) -> PoolSpec:
+    return (int(num_layers), int(num_heads), int(head_dim),
+            int(block_size), str(jnp.dtype(dtype)))
+
+
+class PagedKVCachePool:
+    """Fixed-size token-block KV pool shared by every sequence of a
+    matching layout, with deterministic host-side alloc/free accounting.
+
+    ``layers`` holds the device arrays — one ``{"k", "v"}`` dict of
+    ``[num_blocks, block_size, heads, head_dim]`` buffers per
+    transformer layer. The scheduler treats them functionally: each
+    burst/scatter program consumes the current arrays (donated
+    off-CPU) and the pool is handed the outputs via
+    :meth:`set_layers`. Accounting (``alloc`` / ``free_blocks``) is
+    mutex-guarded so ``stats()`` reads race-free, but only the
+    scheduler thread mutates it.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, num_layers: int,
+                 num_heads: int, head_dim: int, dtype=jnp.float32,
+                 device=None, name: str = "default"):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is the reserved trash "
+                f"block), got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = jnp.dtype(dtype)
+        self.name = name
+        self.spec: PoolSpec = pool_spec(num_layers, num_heads, head_dim,
+                                        block_size, dtype)
+        shape = (self.num_blocks, self.block_size, self.num_heads,
+                 self.head_dim)
+        put = (lambda a: jax.device_put(a, device)) if device is not None \
+            else (lambda a: a)
+        self.layers: List[Dict[str, jnp.ndarray]] = [
+            {"k": put(jnp.zeros(shape, self.dtype)),
+             "v": put(jnp.zeros(shape, self.dtype))}
+            for _ in range(self.num_layers)]
+        # block 0 = trash: masked/padding writes land there, reads past
+        # a causal mask may see it — never owned by a sequence
+        self._free: List[int] = list(range(1, self.num_blocks))
+        self._lock = threading.Lock()
+        self._alloc_failures = 0
+        self._publish()
+
+    # ------------------------------------------------------- accounting
+
+    @property
+    def total_blocks(self) -> int:
+        """Allocatable blocks (the trash block is not one)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def blocks_for(self, tokens: int) -> int:
+        """Logical blocks covering ``tokens`` cache positions."""
+        return max(0, math.ceil(int(tokens) / self.block_size))
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Claim ``n`` blocks (lowest free ids first — deterministic),
+        or None when the pool cannot cover them (nothing is claimed;
+        the failure counter ticks — the scheduler's preempt signal)."""
+        n = int(n)
+        if n <= 0:
+            return []
+        with self._lock:
+            if n > len(self._free):
+                self._alloc_failures += 1
+                got = None
+            else:
+                got = self._free[:n]
+                del self._free[:n]
+        if got is None:
+            get_registry().counter(
+                KVPOOL_ALLOC_FAILURES_COUNTER,
+                "KV block allocations that found the pool exhausted",
+                pool=self.name).inc()
+        self._publish()
+        return got
+
+    def free_blocks(self, ids: List[int]) -> None:
+        """Return blocks to the pool (kept sorted so replayed schedules
+        re-allocate identically)."""
+        if not ids:
+            return
+        with self._lock:
+            for b in ids:
+                b = int(b)
+                if b <= 0 or b >= self.num_blocks:
+                    raise ValueError(f"block id {b} is not allocatable")
+            self._free.extend(int(b) for b in ids)
+            self._free.sort()
+            if len(self._free) > self.total_blocks:
+                raise RuntimeError(
+                    f"pool {self.name!r} over-freed: {len(self._free)} free "
+                    f"of {self.total_blocks} allocatable (double free)")
+        self._publish()
+
+    def occupancy(self) -> float:
+        with self._lock:
+            used = self.total_blocks - len(self._free)
+        return used / self.total_blocks if self.total_blocks else 0.0
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            free = len(self._free)
+            failures = self._alloc_failures
+        return {"blocks_total": self.total_blocks, "blocks_free": free,
+                "block_size": self.block_size,
+                "occupancy": ((self.total_blocks - free) / self.total_blocks
+                              if self.total_blocks else 0.0),
+                "alloc_failures": failures}
+
+    # ----------------------------------------------------- device arrays
+
+    def set_layers(self, layers: List[Dict[str, jnp.ndarray]]) -> None:
+        """Install the pool arrays a burst/scatter program returned
+        (the functional-update half of the scheduler loop)."""
+        if len(layers) != self.num_layers:
+            raise ValueError(
+                f"expected {self.num_layers} layer pools, got {len(layers)}")
+        self.layers = layers
+
+    # --------------------------------------------------------- metrics
+
+    def _publish(self) -> None:
+        reg = get_registry()
+        reg.gauge(KVPOOL_BLOCKS_TOTAL_GAUGE,
+                  "Allocatable KV cache blocks in the paged pool",
+                  pool=self.name).set(self.total_blocks)
+        with self._lock:
+            free = len(self._free)
+        reg.gauge(KVPOOL_BLOCKS_FREE_GAUGE,
+                  "KV cache blocks currently free in the paged pool",
+                  pool=self.name).set(free)

@@ -188,7 +188,7 @@ class TransformerBlockImpl(LayerImpl):
                            capacity_factor=float(max(1, c.num_experts)))
         return x + mlp.reshape(b, t, d), {"k": ck, "v": cv}
 
-    def decode_step(self, params, x_t, cache, pos):
+    def decode_step(self, params, x_t, cache, pos, write_mask=None):
         """One-token forward [b, d] with cached keys/values; ``pos`` is
         the (traced) current position — a scalar (whole-batch position)
         or a [b] vector (per-row positions, the ragged-prompt serving
@@ -197,7 +197,19 @@ class TransformerBlockImpl(LayerImpl):
         at every prefix position (tested); MoE blocks route NO-DROP at
         decode time (capacity = batch) — the training-time capacity
         heuristic over b*t tokens has no stepwise equivalent, and
-        dropping tokens at inference is never what serving wants."""
+        dropping tokens at inference is never what serving wants.
+
+        **Paged mode** (the vLLM PagedAttention layout, nn/kvpool.py):
+        when ``cache`` carries a ``"table"`` entry, ``cache["k"]`` /
+        ``cache["v"]`` are the SHARED pool buffers
+        ``[num_blocks, block_size, h, hd]`` and ``cache["table"]`` is
+        the per-row block table ``[b, max_blocks]`` of pool indices.
+        The K/V write scatters into (table[pos // bs], pos % bs) and
+        attention gathers the row's blocks back into causal order;
+        ``write_mask`` [b] bool redirects masked rows' writes to the
+        reserved trash block 0, so retired rows / batch-slot padding /
+        warmup dispatches can never scribble over a live sequence's
+        blocks. ``pos`` must be a [b] vector in paged mode."""
         c = self.conf
         b, d = x_t.shape
         h_count, hd = c.num_heads, c.n_out // c.num_heads
@@ -206,6 +218,9 @@ class TransformerBlockImpl(LayerImpl):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = lambda z: z.reshape(b, h_count, hd)
         q, k, v = shape(q), shape(k), shape(v)
+        if "table" in cache:
+            return self._decode_step_paged(params, x_t, cache, pos,
+                                           q, k, v, write_mask)
         slots = jnp.arange(cache["k"].shape[1])
         if jnp.ndim(pos) == 0:
             ck = jax.lax.dynamic_update_slice_in_dim(
@@ -234,3 +249,46 @@ class TransformerBlockImpl(LayerImpl):
         mlp, _ = self._ffn(params, h2, {},
                            capacity_factor=float(max(1, c.num_experts)))
         return x_t + mlp, {"k": ck, "v": cv}
+
+    def _decode_step_paged(self, params, x_t, cache, pos, q, k, v,
+                           write_mask):
+        """Gather/scatter attention over a block table (decode_step's
+        paged-pool branch — q/k/v already projected): scatter this
+        token's K/V into its row's (block, offset) pool slot, gather
+        the row's blocks back as a contiguous [b, MB*bs] view, and run
+        the same masked softmax attention as the dense branch. Gathered
+        positions past ``pos`` (including every trash/garbage block the
+        table pads with) are causally masked, so pool garbage is
+        numerically inert exactly like the dense path's padded tail."""
+        c = self.conf
+        b, d = x_t.shape
+        kp, vp = cache["k"], cache["v"]      # [NB, bs, h, hd] shared pool
+        table = cache["table"]               # [b, MB] int32 block ids
+        bs = kp.shape[1]
+        mb = table.shape[1]
+        blk_of = pos // bs
+        off = pos % bs
+        blk = jnp.take_along_axis(table, blk_of[:, None], axis=1)[:, 0]
+        if write_mask is not None:
+            # masked rows write the trash block — never a live sequence
+            blk = jnp.where(write_mask, blk, 0)
+            off = jnp.where(write_mask, off, 0)
+        kp = kp.at[blk, off].set(k.astype(kp.dtype))
+        vp = vp.at[blk, off].set(v.astype(vp.dtype))
+        # gather the row's cache back into causal order: [b, MB*bs, h, hd]
+        kg = jnp.take(kp, table, axis=0).reshape(b, mb * bs, *kp.shape[2:])
+        vg = jnp.take(vp, table, axis=0).reshape(b, mb * bs, *vp.shape[2:])
+        hd = c.n_out // c.num_heads
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, q.dtype))
+        s = jnp.einsum("bhd,bkhd->bhk", q, kg.astype(q.dtype)) * scale
+        live = jnp.arange(mb * bs)[None, :] <= pos[:, None]
+        s = jnp.where(live[:, None, :], s,
+                      jnp.asarray(jnp.finfo(s.dtype).min, s.dtype))
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhk,bkhd->bhd", w, vg.astype(q.dtype))
+        x_t = x_t + o.reshape(b, d) @ params["Wo"].astype(x_t.dtype)
+
+        h2 = _layer_norm(x_t, params["ln2_g"], params["ln2_b"])
+        mlp, _ = self._ffn(params, h2, {},
+                           capacity_factor=float(max(1, c.num_experts)))
+        return x_t + mlp, {"k": kp, "v": vp, "table": table}

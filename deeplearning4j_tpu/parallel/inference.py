@@ -61,6 +61,19 @@ PRNG keys make a request's tokens identical to a solo
 program set so steady-state decode serving performs zero XLA
 compiles.
 
+Continuous batching (``continuous=True``): ``submit_generate`` routes
+through a :class:`~deeplearning4j_tpu.serving.continuous.
+ContinuousDecodeScheduler` instead of the per-(bucket, max_new,
+sampler) coalescing dispatcher — decode runs in short fixed-K bursts
+over a paged KV block pool (``nn/kvpool.py``); between bursts the
+scheduler retires finished rows (freeing their blocks immediately),
+admits queued prefills into the vacated batch slots, and preempts
+deterministically (lowest-priority / youngest-first, re-queued with
+the generated prefix) when the pool is exhausted. ``decode_slots`` /
+``decode_burst`` / ``kv_block_size`` / ``kv_blocks`` size the slot
+batch and the pool; ``stats()["scheduler"]`` exposes the live state
+and ``/healthz/ready`` gates on its warmup.
+
 Multi-model serving (``registry=`` mode): instead of one pinned net,
 the engine serves every model in a
 :class:`~deeplearning4j_tpu.serving.registry.ModelRegistry` —
@@ -312,7 +325,13 @@ class ParallelInference:
                  probe_interval_ms: float = 50.0,
                  poison_hook=None,
                  registry=None,
-                 max_sessions: int = 4096):
+                 max_sessions: int = 4096,
+                 continuous: bool = False,
+                 decode_slots: int = 8,
+                 decode_burst: int = 8,
+                 kv_block_size: int = 16,
+                 kv_blocks: Optional[int] = None,
+                 decode_burst_hook=None):
         if net is None and registry is None:
             raise ValueError("ParallelInference needs a net or a registry")
         if net is not None and registry is not None:
@@ -398,6 +417,16 @@ class ParallelInference:
         self._warmed = False
         self._started = False
         self._threads: List[threading.Thread] = []
+        # continuous batching (serving/continuous.py): submit_generate
+        # routes through an iteration-level decode scheduler + paged KV
+        # pool instead of the whole-burst coalescing dispatcher
+        self.continuous = bool(continuous)
+        self.decode_slots = int(decode_slots)
+        self.decode_burst = int(decode_burst)
+        self.kv_block_size = int(kv_block_size)
+        self.kv_blocks = kv_blocks
+        self._decode_burst_hook = decode_burst_hook
+        self._scheduler = None
         if start:
             self.start()
 
@@ -426,6 +455,8 @@ class ParallelInference:
                                  daemon=True, name=f"dl4j-tpu-infer-w{i}")
             w.start()
             self._threads.append(w)
+        if self._scheduler is not None:
+            self._scheduler.start()
         return self
 
     def _resolve_model(self, model: Optional[str], version: Optional[int],
@@ -523,12 +554,33 @@ class ParallelInference:
             gen = self.__dict__["_gen"] = build_generator(self.net)
         return gen
 
+    def _continuous_scheduler(self):
+        """The engine's iteration-level decode scheduler (built lazily:
+        transformer nets only). Runs on the first replica's device —
+        one slot batch, one shared paged KV pool; classify traffic
+        keeps using every replica."""
+        sched = self._scheduler
+        if sched is None:
+            from deeplearning4j_tpu.serving.continuous import (
+                ContinuousDecodeScheduler)
+            dev = self._replicas[0][0]
+            sched = self._scheduler = ContinuousDecodeScheduler(
+                net=self.net, registry=self._registry, device=dev,
+                slots=self.decode_slots, burst_tokens=self.decode_burst,
+                block_size=self.kv_block_size, num_blocks=self.kv_blocks,
+                queue_capacity=self._rq.maxsize,
+                burst_hook=self._decode_burst_hook,
+                on_resolve=self._note_resolved,
+                start=self._started)
+        return sched
+
     def submit_generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                         temperature: float = 0.0, top_k: int = 0,
                         top_p: float = 0.0, eos_token: Optional[int] = None,
                         seed: int = 0, model: Optional[str] = None,
                         version: Optional[int] = None,
-                        session: Optional[str] = None) -> "Future[np.ndarray]":
+                        session: Optional[str] = None,
+                        priority: int = 0) -> "Future[np.ndarray]":
         """Enqueue one decode request (``prompt_ids``: [n, t0] int
         tokens); the Future resolves to the [n, t0 + max_new_tokens]
         ids a solo ``net.generate`` of the same rows would return.
@@ -544,6 +596,20 @@ class ParallelInference:
             raise RuntimeError("ParallelInference is shut down")
         from deeplearning4j_tpu.nn.generate import row_keys, sampler_sig
         model, v, mv, coalescible = self._resolve_model(model, version, session)
+        if self.continuous:
+            # iteration-level path: the scheduler admits/retires rows
+            # between fixed-K bursts over the paged KV pool; the
+            # (model, version) resolved HERE — atomically vs deploys,
+            # session-pinned — stays with the sequence for its
+            # lifetime (its blocks and programs live with the version)
+            self._reg().counter(DECODE_REQUESTS_COUNTER,
+                                "generate() requests").inc()
+            with self._lock:
+                self._requests += 1
+            return self._continuous_scheduler().submit(
+                prompt_ids, max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, eos_token=eos_token, seed=seed,
+                priority=priority, model=model, version=v, session=session)
         gen = self._generator() if mv is None else mv.generator()
         prompt = np.asarray(prompt_ids)
         if prompt.ndim != 2:
@@ -586,6 +652,12 @@ class ParallelInference:
         from deeplearning4j_tpu.nn.generate import row_keys, sampler_sig
         if model is not None and self._registry is None:
             raise ValueError("model= needs a registry-mode engine")
+        if self.continuous:
+            v = None
+            if model is not None:
+                v = self._registry.resolve(model, version)
+            return self._continuous_scheduler().warmup(
+                prompt_lengths, int(max_new_tokens), model=model, version=v)
         mv = None
         if model is not None:
             v = self._registry.resolve(model, version)
@@ -731,6 +803,14 @@ class ParallelInference:
                 "warmed": self._warmed,
                 "faults": len(self._fault_log),
             }
+        if self.continuous:
+            # decode-scheduler state (active sequences, queued
+            # prefills, pool occupancy) — /healthz/ready gates on its
+            # warmed flag, mirroring the models_ready pattern
+            out["scheduler"] = (
+                self._scheduler.stats() if self._scheduler is not None
+                else {"warmed": False, "active_sequences": 0,
+                      "queued_prefills": 0, "pool": {}})
         if self._registry is not None:
             # per-model lifecycle view (outside the engine lock: the
             # registry has its own)
@@ -786,6 +866,9 @@ class ParallelInference:
         if self._closed:
             return
         self._closed = True
+        if self._scheduler is not None:
+            self._scheduler.shutdown(drain=drain and self._started,
+                                     timeout=timeout)
         if not self._started:
             # never ran: resolve queued futures so no caller hangs
             self._drain_cancel()

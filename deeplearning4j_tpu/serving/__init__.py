@@ -17,6 +17,11 @@ autoscaling applied by :class:`~deeplearning4j_tpu.serving.fleet.
 LocalFleet`.
 """
 
+from deeplearning4j_tpu.serving.continuous import (  # noqa: F401
+    ContinuousDecodeScheduler,
+    DecodeBurstError,
+    KVPoolExhausted,
+)
 from deeplearning4j_tpu.serving.endpoint import (  # noqa: F401
     EndpointError,
     EndpointTimeout,

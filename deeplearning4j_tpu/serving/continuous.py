@@ -1,0 +1,1139 @@
+"""Continuous batching: iteration-level decode scheduling over a paged
+KV-cache block pool.
+
+The PR-5 whole-burst path dispatches ALL of ``max_new_tokens`` as one
+scan per coalesced (prompt bucket, max_new, sampler) group: a request
+arriving one step after dispatch waits out the entire burst, and every
+sequence pins a dense ``bucket + max_new`` cache for its lifetime —
+the head-of-line and fragmentation problems Orca's iteration-level
+scheduling and vLLM's PagedAttention were built to kill. This module
+is the fix:
+
+- decode runs in **short fixed-K bursts** (one ``lax.scan`` dispatch
+  over ``slots`` batch rows — ``TransformerGenerator.burst_program``);
+  between bursts the scheduler **retires** EOS/max-len rows (freeing
+  their KV blocks immediately), **admits** queued prefills into the
+  vacated batch slots, and goes straight into the next burst, so a new
+  request waits at most K tokens, not a whole generation;
+- KV state lives in a :class:`~deeplearning4j_tpu.nn.kvpool.
+  PagedKVCachePool`: sequences own ordered block tables, grow by one
+  block at a time, and free everything the moment they finish — cache
+  memory recycles continuously under sustained traffic;
+- when the pool is exhausted the scheduler **preempts or sheds**
+  deterministically: the victim is the lowest-priority, then
+  youngest-admitted active sequence (its blocks are freed and it is
+  re-queued AT THE FRONT with its prompt + generated prefix, resuming
+  on its own PRNG token clock so the final tokens are identical to an
+  uninterrupted run); a sequence that cannot fit even alone fails
+  typed with :class:`KVPoolExhausted`, and a full admission queue
+  rejects with ``InferenceBackpressure``;
+- every device program has a **fixed shape** — prefill is bucketed
+  (PR-3 ladder), the burst is (slots × K × max_blocks) no matter which
+  sequences occupy the slots, and sampler knobs/PRNG clocks enter as
+  traced per-row vectors — so :meth:`warmup` AOT-compiles the whole
+  set and steady state pays zero XLA compiles
+  (``dl4j_jit_cache_miss_total`` asserts it);
+- **lanes**: in registry mode each (model, version) pair schedules its
+  own batch slots (a dispatched burst runs one params pytree), but
+  lanes whose nets share a KV layout share ONE pool — a sequence's
+  blocks and version stay pinned across bursts through a PR-7 canary
+  cutover, while stable and canary recycle the same block budget.
+
+``ParallelInference(continuous=True)`` routes ``submit_generate``
+through a scheduler; the scheduler is also usable standalone (and
+``start=False`` + :meth:`step` gives tests a fully deterministic
+single-threaded drive). Transformer (KV-cache) stacks only — the
+recurrent path has no paged cache to schedule.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.monitor import (
+    SCHED_ACTIVE_GAUGE,
+    SCHED_ADMITTED_COUNTER,
+    SCHED_BURST_LATENCY_HISTOGRAM,
+    SCHED_BURSTS_COUNTER,
+    SCHED_PREEMPTIONS_COUNTER,
+    SCHED_QUEUED_GAUGE,
+    SCHED_RETIRED_COUNTER,
+    get_registry,
+    mark,
+    record_fault,
+    span,
+)
+from deeplearning4j_tpu.datasets.iterators import bucket_for
+from deeplearning4j_tpu.nn.generate import (
+    TransformerGenerator,
+    build_generator,
+    row_keys,
+)
+from deeplearning4j_tpu.nn.kvpool import PagedKVCachePool, pool_spec
+from deeplearning4j_tpu.optimize.deferred import note_dispatch
+from deeplearning4j_tpu.parallel.inference import InferenceBackpressure
+
+
+class DecodeBurstError(RuntimeError):
+    """A burst/prefill dispatch died under a sequence: its future
+    carries this (typed, with the device error as ``__cause__``), its
+    blocks are freed, and the scheduler keeps serving everyone else."""
+
+
+class KVPoolExhausted(RuntimeError):
+    """A sequence needs more KV blocks than the pool can EVER provide
+    (even with every other sequence preempted) — a sizing error, not a
+    transient: fail fast instead of deadlocking the admission queue."""
+
+
+class _DecodeRequest:
+    """One ``submit()`` — n prompt rows sharing a sampler/seed; the
+    Future resolves to [n, t0 + max_new] ids once every row retires."""
+
+    __slots__ = ("prompt", "n", "t_in", "max_new", "temperature", "top_k",
+                 "top_p", "eos", "seed", "priority", "model", "version",
+                 "session", "future", "rows_done", "t_submit", "t_first",
+                 "rows")
+
+    def __init__(self, prompt: np.ndarray, max_new: int, temperature: float,
+                 top_k: int, top_p: float, eos: Optional[int], seed: int,
+                 priority: int, model, version, session):
+        self.prompt = np.asarray(prompt, np.int64)
+        self.n, self.t_in = self.prompt.shape
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos = None if eos is None else int(eos)
+        self.seed = int(seed)
+        self.priority = int(priority)
+        self.model = model
+        self.version = version
+        self.session = session
+        self.future: "Future[np.ndarray]" = Future()
+        self.rows_done = 0
+        self.t_submit = time.perf_counter()
+        self.t_first: Optional[float] = None
+        self.rows: List["_Seq"] = []
+
+
+class _Seq:
+    """One decode row: the schedulable unit. ``fed`` is what the next
+    (re)prefill feeds — original prompt plus everything generated
+    before the last preemption; ``generated`` is the full output-so-far
+    across preemptions; ``n_gen`` is the row's PRNG token clock (fold
+    index of the NEXT sample), which is what makes a resumed sequence's
+    draws identical to an uninterrupted run."""
+
+    __slots__ = ("req", "row", "fed", "generated", "key", "n_gen", "slot",
+                 "blocks", "pos", "seq_id", "preemptions")
+
+    def __init__(self, req: _DecodeRequest, row: int, key: np.ndarray,
+                 seq_id: int):
+        self.req = req
+        self.row = row
+        self.fed = req.prompt[row].astype(np.int32)
+        self.generated: List[int] = []
+        self.key = key
+        self.n_gen = 0
+        self.slot: Optional[int] = None
+        self.blocks: List[int] = []
+        self.pos = 0
+        self.seq_id = seq_id
+        self.preemptions = 0
+
+    @property
+    def priority(self) -> int:
+        return self.req.priority
+
+    @property
+    def remaining(self) -> int:
+        return self.req.max_new - self.n_gen
+
+
+class _Lane:
+    """The per-(model, version) slot batch: one params pytree per
+    dispatched burst, host-mirrored slot state vectors, and a shared
+    pool reference. Empty slots are ``done`` rows with all-trash block
+    tables, so the burst program's shape never changes."""
+
+    def __init__(self, key: Tuple, net, gen: TransformerGenerator,
+                 pool: PagedKVCachePool, slots: int):
+        self.key = key
+        self.net = net
+        self.gen = gen
+        self.pool = pool
+        self.slots = slots
+        self.mb = pool.blocks_for(gen.max_context())
+        self.seqs: List[Optional[_Seq]] = [None] * slots
+        self.tables = np.zeros((slots, self.mb), np.int32)
+        self.pos = np.zeros(slots, np.int32)
+        self.tok = np.zeros(slots, np.int32)
+        self.n_gen = np.zeros(slots, np.int32)
+        self.done = np.ones(slots, bool)
+        self.keys = np.zeros((slots, 2), np.asarray(row_keys(0, 1)).dtype)
+        self.temp = np.zeros(slots, np.float32)
+        self.top_k = np.zeros(slots, np.int32)
+        self.top_p = np.zeros(slots, np.float32)
+        self.eos = np.full(slots, -1, np.int32)
+        self.max_new_v = np.zeros(slots, np.int32)
+
+    def free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.seqs):
+            if s is None:
+                return i
+        return None
+
+    def active(self) -> List[_Seq]:
+        return [s for s in self.seqs if s is not None]
+
+    def clear_slot(self, slot: int) -> None:
+        self.seqs[slot] = None
+        self.tables[slot] = 0
+        self.pos[slot] = 0
+        self.tok[slot] = 0
+        self.n_gen[slot] = 0
+        self.done[slot] = True
+        self.keys[slot] = 0
+        self.temp[slot] = 0.0
+        self.top_k[slot] = 0
+        self.top_p[slot] = 0.0
+        self.eos[slot] = -1
+        self.max_new_v[slot] = 0
+
+
+class ContinuousDecodeScheduler:
+    """Iteration-level decode scheduler over a paged KV block pool.
+
+    Knobs: ``slots`` batch rows per lane (the burst program's row
+    count), ``burst_tokens`` = K (a new request waits at most K steps;
+    smaller K = lower time-to-first-token, larger K = fewer host
+    round-trips), ``block_size`` tokens per KV block, ``num_blocks``
+    pool budget (default: enough for every slot at full context — no
+    preemption unless oversubscribed), ``queue_capacity`` bounded
+    admission (full queue sheds with ``InferenceBackpressure``).
+
+    ``start=False`` skips the scheduler thread; tests drive
+    :meth:`step` directly for fully deterministic schedules. The
+    ``burst_hook(lane_key, burst_index)`` seam lets the faultinject
+    harness kill a burst deterministically (the affected sequences
+    fail typed :class:`DecodeBurstError`, their blocks are freed, and
+    the pool drains back to fully free)."""
+
+    def __init__(self, net=None, registry=None, device=None, slots: int = 8,
+                 burst_tokens: int = 8, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 queue_capacity: int = 256, admit_rows: int = 4,
+                 start: bool = True, burst_hook=None, on_resolve=None):
+        if net is None and registry is None:
+            raise ValueError(
+                "ContinuousDecodeScheduler needs a net or a registry")
+        if net is not None and registry is not None:
+            raise ValueError("net= and registry= are exclusive")
+        self.net = net
+        self._registry = registry
+        # committing arrays to the default device is a pure loss (every
+        # dispatch then pays placement copies — measured 2.5x on CPU);
+        # an explicit device only matters when it is NOT the default
+        if device is not None and device == jax.devices()[0]:
+            device = None
+        self.device = device
+        self.slots = max(1, int(slots))
+        self.burst_tokens = max(1, int(burst_tokens))
+        self.block_size = max(1, int(block_size))
+        self._num_blocks = num_blocks
+        self.queue_capacity = max(1, int(queue_capacity))
+        # same-(lane, bucket) admissions coalesce into one prefill up
+        # the row ladder (a spike pays one dispatch chain, not N)
+        self.admit_rows = max(1, min(int(admit_rows), self.slots))
+
+        def pow2_ladder(top: int) -> Tuple[int, ...]:
+            out, t = [], 1
+            while t < top:
+                out.append(t)
+                t *= 2
+            out.append(top)
+            return tuple(out)
+
+        self._admit_ladder = pow2_ladder(self.admit_rows)
+        # burst row-bucket ladder: a burst dispatches the smallest slot
+        # bucket covering the ACTIVE rows (compacted), so a half-empty
+        # batch never pays full-slot compute — same doctrine as the
+        # admit and block-tier ladders
+        self._slot_ladder = pow2_ladder(self.slots)
+        self._burst_hook = burst_hook
+        self._on_resolve = on_resolve
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: Deque[_Seq] = deque()
+        self._lanes: Dict[Tuple, _Lane] = {}
+        self._pools: Dict[Tuple, PagedKVCachePool] = {}
+        self._params_cache: Dict[Tuple, Any] = {}
+        self._seq_counter = 0
+        self._accepted = 0
+        self._resolved = 0
+        self._admitted_rows = 0
+        self._retired_rows = 0
+        self._preemptions = 0
+        self._bursts = 0
+        self._warmed = False
+        self._stopping = False
+        self._cancel = False
+        self._closed = False
+        #: bounded audit trail the deterministic tests read — every
+        #: admit/retire/preempt/burst-fail event, in schedule order
+        self.events: Deque[str] = deque(maxlen=4096)
+        #: per-request completion log for the bench: t_submit/t_first/
+        #: t_done/rows/tokens of every resolved request
+        self.completed: Deque[Dict[str, float]] = deque(maxlen=65536)
+        self._thread: Optional[threading.Thread] = None
+        if net is not None:
+            # net-mode: one lane, built eagerly so submit validates fast
+            self._lane_for(None, None)
+        if start:
+            self.start()
+
+    # ---------------------------------------------------------- public
+
+    def start(self) -> "ContinuousDecodeScheduler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="dl4j-tpu-decode-sched")
+            self._thread.start()
+        return self
+
+    def submit(self, prompt_ids: np.ndarray, max_new_tokens: int, *,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+               eos_token: Optional[int] = None, seed: int = 0,
+               priority: int = 0, model: Optional[str] = None,
+               version: Optional[int] = None,
+               session: Optional[str] = None) -> "Future[np.ndarray]":
+        """Enqueue one decode request; the Future resolves to the
+        [n, t0 + max_new_tokens] ids a solo ``net.generate`` of the
+        same rows would return (greedy: token-for-token; sampled: the
+        same seeded draws regardless of admission timing, cotenants,
+        or preemptions). Higher ``priority`` sequences are preempted
+        last."""
+        if self._closed:
+            raise RuntimeError("ContinuousDecodeScheduler is shut down")
+        prompt = np.asarray(prompt_ids)
+        if prompt.ndim != 2:
+            raise ValueError(
+                f"prompt_ids must be [n, t0] int tokens, got {prompt.shape}")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        lane = self._lane_for(model, version)
+        # validates prompt-length/max_new against the net's context
+        lane.gen.prompt_bucket(prompt.shape[1], max_new)
+        req = _DecodeRequest(prompt, max_new, temperature, top_k, top_p,
+                             eos_token, seed, priority, model, version,
+                             session)
+        keys = np.asarray(row_keys(req.seed, req.n))
+        with self._cv:
+            if len(self._queue) + req.n > self.queue_capacity:
+                raise InferenceBackpressure(
+                    f"decode admission queue full "
+                    f"({self.queue_capacity} rows)")
+            for row in range(req.n):
+                self._seq_counter += 1
+                seq = _Seq(req, row, keys[row], self._seq_counter)
+                req.rows.append(seq)
+                self._queue.append(seq)
+            self._accepted += 1
+            self._cv.notify_all()
+        self._gauges()
+        return req.future
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            active = sum(len(lane.active()) for lane in self._lanes.values())
+            queued = len(self._queue)
+            pools = [p.stats() for _, p in sorted(self._pools.items())]
+            out = {
+                "slots": self.slots,
+                "burst_tokens": self.burst_tokens,
+                "block_size": self.block_size,
+                "lanes": len(self._lanes),
+                "active_sequences": active,
+                "queued_prefills": queued,
+                "accepted": self._accepted,
+                "resolved": self._resolved,
+                "admitted_rows": self._admitted_rows,
+                "retired_rows": self._retired_rows,
+                "preemptions": self._preemptions,
+                "bursts": self._bursts,
+                "warmed": self._warmed,
+            }
+        agg = {"blocks_total": sum(p["blocks_total"] for p in pools),
+               "blocks_free": sum(p["blocks_free"] for p in pools),
+               "alloc_failures": sum(p["alloc_failures"] for p in pools)}
+        agg["occupancy"] = (
+            (agg["blocks_total"] - agg["blocks_free"]) / agg["blocks_total"]
+            if agg["blocks_total"] else 0.0)
+        out["pool"] = agg
+        out["pools"] = pools
+        return out
+
+    def drain(self, timeout: Optional[float] = None,
+              poll_s: float = 2e-3) -> bool:
+        """Block until every accepted request has resolved (the
+        zero-leaked-blocks assertion point: a drained scheduler's pools
+        are fully free). False when ``timeout`` elapses first."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                idle = self._resolved >= self._accepted
+            if idle:
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            if self._thread is None:
+                self.step()  # manual mode: drive the schedule ourselves
+            else:
+                time.sleep(poll_s)
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop accepting work; drain (default) or fail what is queued
+        and in flight, then join the scheduler thread."""
+        if self._closed:
+            return
+        self._closed = True
+        if drain and self._thread is not None:
+            self.drain(timeout)
+        with self._cv:
+            self._stopping = True
+            self._cancel = not drain
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        else:
+            if drain:
+                self.drain(timeout)
+            else:
+                self._fail_everything(
+                    RuntimeError("scheduler shut down before dispatch"))
+
+    def warmup(self, prompt_lengths, max_new_tokens: int = 1,
+               model: Optional[str] = None,
+               version: Optional[int] = None) -> int:
+        """AOT-compile the continuous-decode program set for one lane:
+        the rowwise sampler, every covering prompt bucket's prefill +
+        pool scatter, and THE burst program (its (slots × K ×
+        max_blocks) shape is sequence-independent, so one compile
+        covers every admission mix — the structural reason steady
+        state is compile-free). Warm dispatches run all-masked: writes
+        land in the trash block, pool accounting is untouched. Returns
+        the fresh-program count."""
+        from deeplearning4j_tpu.monitor import JIT_CACHE_MISS_COUNTER
+        lane = self._lane_for(model, version)
+        pool = lane.pool
+        reg = get_registry()
+        before = reg.family_total(JIT_CACHE_MISS_COUNTER)
+        params = self._params(lane)
+        gen = lane.gen
+        with span("stage", path="warmup_continuous", slots=self.slots,
+                  burst=self.burst_tokens):
+            # rowwise sampler (admission tok0 program) over the REAL
+            # vocab width and every admit-ladder row count — the
+            # programs are shape-keyed
+            rs = gen.row_sample_program()
+            vocab = int(gen.emb.conf.n_in)
+            for rows in self._admit_ladder:
+                note_dispatch(lane.net, ("gen_row_sample", "sched", rows))
+                np.asarray(rs(np.zeros((rows, vocab), np.float32),
+                              np.zeros((rows, 2), lane.keys.dtype),
+                              np.zeros(rows, np.int32),
+                              np.zeros(rows, np.float32),
+                              np.zeros(rows, np.int32),
+                              np.zeros(rows, np.float32)))
+            done_buckets = set()
+            for t_in in prompt_lengths:
+                t_pad = gen.prompt_bucket(int(t_in), int(max_new_tokens))
+                t_blk = self._round_blocks(t_pad)
+                # the prefill program is shaped by the prompt bucket,
+                # its block-rounded cache length AND the admit-ladder
+                # row count
+                if (t_pad, t_blk) in done_buckets:
+                    continue
+                done_buckets.add((t_pad, t_blk))
+                for rows in self._admit_ladder:
+                    ids = np.zeros((rows, t_pad), np.int32)
+                    lens = np.full(rows, min(int(t_in), t_pad), np.int32)
+                    pre = gen.prefill_program(t_blk)
+                    fresh = note_dispatch(
+                        lane.net,
+                        ("gen_prefill", "sched", rows, t_pad, t_blk))
+                    with span("compile" if fresh else "inference",
+                              path="warmup_continuous_prefill",
+                              bucket=t_pad, rows=rows):
+                        caches, logits = pre(params, ids, lens)
+                        jax.block_until_ready(logits)
+                    scat = gen.scatter_program(rows, t_blk,
+                                               self.block_size)
+                    tnb = np.zeros((rows, t_blk // self.block_size),
+                                   np.int32)
+                    note_dispatch(lane.net,
+                                  ("gen_pool_scatter", "sched", rows,
+                                   t_blk))
+                    pool.set_layers(scat(pool.layers, caches, tnb))
+            # the full burst-program ladder: every (slot bucket ×
+            # block tier), greedy AND sampling variants (all slots
+            # empty: masked writes land in the trash block only)
+            for tier in self._burst_tiers(lane):
+                for rows in self._slot_ladder:
+                    for sampling in (False, True):
+                        self._dispatch_burst(lane, params, tier=tier,
+                                             sampling=sampling, rows=rows)
+        self._warmed = True
+        return int(reg.family_total(JIT_CACHE_MISS_COUNTER) - before)
+
+    def step(self) -> bool:
+        """One scheduling iteration: admit queued prefills into free
+        slots, top up every active sequence's block horizon (preempting
+        deterministically when the pool is exhausted), dispatch one
+        fixed-K burst per lane with active rows, and retire finished
+        rows (blocks freed immediately). Returns whether any work
+        happened — the thread loop's park signal, and the manual-drive
+        entry point for deterministic tests."""
+        progressed = self._admit()
+        for key in sorted(self._lanes, key=repr):
+            lane = self._lanes[key]
+            if not lane.active():
+                continue
+            self._ensure_blocks(lane)
+            if not lane.active():
+                continue
+            try:
+                params = self._params(lane)
+                outs = self._dispatch_burst(lane, params, accounted=True)
+            except BaseException as e:
+                self._burst_failed(lane, e)
+                progressed = True
+                continue
+            self._retire(lane, outs)
+            progressed = True
+        self._gauges()
+        return progressed
+
+    # ------------------------------------------------------ lanes/pools
+
+    def _lane_for(self, model: Optional[str],
+                  version: Optional[int]) -> _Lane:
+        key = (model, version)
+        with self._lock:
+            lane = self._lanes.get(key)
+        if lane is not None:
+            return lane
+        if model is None:
+            net = self.net
+        else:
+            if self._registry is None:
+                raise ValueError("model= needs a registry-mode scheduler")
+            net = self._registry.version(model, version).net()
+        gen = build_generator(net)
+        if not isinstance(gen, TransformerGenerator):
+            raise ValueError(
+                "continuous batching schedules paged KV caches; "
+                f"{type(gen).__name__} nets have none — serve them through "
+                "the whole-burst submit_generate path")
+        n_layers, heads, hd, dtype = gen.kv_layout()
+        spec = pool_spec(n_layers, heads, hd, self.block_size, dtype)
+        with self._lock:
+            pool = self._pools.get(spec)
+            if pool is None:
+                blocks = self._num_blocks
+                if blocks is None:
+                    # default: every slot can reach full context — the
+                    # no-preemption budget; size DOWN to exercise
+                    # preemption/shedding
+                    mb = -(-gen.max_context() // self.block_size)
+                    blocks = self.slots * mb + 1
+                pool = PagedKVCachePool(
+                    int(blocks), self.block_size, n_layers, heads, hd,
+                    dtype, device=self.device,
+                    name=model if model is not None else "decode")
+                self._pools[spec] = pool
+            lane = self._lanes.get(key)
+            if lane is None:
+                lane = _Lane(key, net, gen, pool, self.slots)
+                self._lanes[key] = lane
+        return lane
+
+    def _params(self, lane: _Lane):
+        model, version = lane.key
+        if model is not None:
+            return self._registry.acquire(model, version, self.device)[1]
+        cached = self._params_cache.get(lane.key)
+        if cached is None:
+            p = lane.net.params
+            if self.device is not None:
+                p = jax.device_put(p, self.device)
+            cached = self._params_cache[lane.key] = p
+        return cached
+
+    def _round_blocks(self, tokens: int) -> int:
+        bs = self.block_size
+        return -(-int(tokens) // bs) * bs
+
+    # -------------------------------------------------------- admission
+
+    def _admit(self) -> bool:
+        """Admit queued sequences FIFO (preempted resumes ride at the
+        front): same-(lane, bucket) neighbors coalesce into ONE
+        row-bucketed prefill + pool scatter (padding rows carry length
+        0 and all-trash tables), so a traffic spike pays one dispatch
+        chain, not one per sequence. A sequence whose lane has no free
+        slot or whose blocks do not fit is skipped this round —
+        running sequences retiring is what unblocks it; admission
+        never preempts."""
+        admitted = False
+        while True:
+            group = self._pick_admissions()
+            if not group:
+                return admitted
+            lane, t_pad, entries = group
+            try:
+                self._prefill_batch(lane, t_pad, entries)
+            except BaseException as e:
+                record_fault("serving")
+                for seq, blocks in entries:
+                    lane.pool.free_blocks(blocks)
+                    seq.blocks = []
+                    self._fail_seq(seq, self._typed(e, seq))
+                continue
+            admitted = True
+
+    def _lane_key(self, seq: _Seq) -> Tuple:
+        return (seq.req.model, seq.req.version)
+
+    def _pick_admissions(self):
+        """Claim the next admissible FIFO group: the first sequence
+        with a free slot + allocable blocks anchors the (lane, prompt
+        bucket); later queue entries with the same signature ride the
+        same prefill while slots, blocks and the admit ladder allow.
+        Each picked sequence's blocks are claimed HERE (rolled back by
+        the caller on prefill failure)."""
+        with self._lock:
+            pending = list(self._queue)
+        anchor = None
+        entries: List[Tuple[_Seq, List[int]]] = []
+        free_slots = 0
+        for seq in pending:
+            if seq.req.future.done():
+                with self._lock:
+                    self._queue.remove(seq)
+                continue
+            lane = self._lane_for(*self._lane_key(seq))
+            t_full = len(seq.fed)
+            t_pad = lane.gen.prompt_bucket(t_full, max(1, seq.remaining))
+            if anchor is None:
+                if lane.free_slot() is None:
+                    continue
+                need = lane.pool.blocks_for(t_full)
+                if need > lane.pool.total_blocks or need > lane.mb:
+                    with self._lock:
+                        self._queue.remove(seq)
+                    self._fail_seq(seq, KVPoolExhausted(
+                        f"sequence needs {need} KV blocks; pool holds "
+                        f"{lane.pool.total_blocks} (max {lane.mb}"
+                        f"/sequence)"))
+                    continue
+                got = lane.pool.alloc(need)
+                if got is None:
+                    continue  # blocks return as running rows retire
+                anchor = (lane, t_pad)
+                free_slots = sum(1 for s in lane.seqs if s is None)
+            else:
+                if (lane, t_pad) != anchor:
+                    continue
+                if len(entries) >= min(free_slots, self._admit_ladder[-1]):
+                    break
+                got = lane.pool.alloc(lane.pool.blocks_for(t_full))
+                if got is None:
+                    break
+            with self._lock:
+                self._queue.remove(seq)
+            entries.append((seq, got))
+            if anchor is not None and \
+                    len(entries) >= min(free_slots, self._admit_ladder[-1]):
+                break
+        if not entries:
+            return None
+        return anchor[0], anchor[1], entries
+
+    def _prefill_batch(self, lane: _Lane, t_pad: int,
+                       entries: List[Tuple[_Seq, List[int]]]) -> None:
+        """One row-bucketed prefill of a same-bucket admission group →
+        page every row's dense cache into its blocks (ONE scatter) →
+        sample each row's next token on its own PRNG clock → install
+        into batch slots (rows whose first token already finishes them
+        retire immediately, never occupying a slot)."""
+        gen, pool = lane.gen, lane.pool
+        n = len(entries)
+        rows = bucket_for(n, self._admit_ladder)
+        t_blk = self._round_blocks(t_pad)
+        nb = t_blk // self.block_size
+        ids = np.zeros((rows, t_pad), np.int32)
+        lens = np.zeros(rows, np.int32)
+        tnb = np.zeros((rows, nb), np.int32)
+        keys = np.zeros((rows, 2), lane.keys.dtype)
+        folds = np.zeros(rows, np.int32)
+        temp = np.zeros(rows, np.float32)
+        top_k = np.zeros(rows, np.int32)
+        top_p = np.zeros(rows, np.float32)
+        for i, (seq, blocks) in enumerate(entries):
+            t_full = len(seq.fed)
+            ids[i, :t_full] = seq.fed
+            lens[i] = t_full
+            tnb[i, :len(blocks)] = blocks
+            keys[i] = seq.key
+            folds[i] = seq.n_gen
+            temp[i] = seq.req.temperature
+            top_k[i] = seq.req.top_k
+            top_p[i] = seq.req.top_p
+        params = self._params(lane)
+        pre = gen.prefill_program(t_blk)
+        fresh = note_dispatch(lane.net,
+                              ("gen_prefill", "sched", rows, t_pad, t_blk))
+        with span("compile" if fresh else "inference",
+                  path="continuous_prefill", bucket=t_pad, rows=n):
+            caches, logits = pre(params, ids, lens)
+        scat = gen.scatter_program(rows, t_blk, self.block_size)
+        note_dispatch(lane.net, ("gen_pool_scatter", "sched", rows, t_blk))
+        pool.set_layers(scat(pool.layers, caches, tnb))
+        rs = gen.row_sample_program()
+        note_dispatch(lane.net, ("gen_row_sample", "sched", rows))
+        toks = np.asarray(rs(logits, keys, folds, temp, top_k, top_p))
+        for i, (seq, blocks) in enumerate(entries):
+            self._install(lane, seq, blocks, int(toks[i]))
+
+    def _install(self, lane: _Lane, seq: _Seq, blocks: List[int],
+                 tok0: int) -> None:
+        req = seq.req
+        seq.blocks = blocks
+        seq.pos = len(seq.fed)
+        seq.generated.append(tok0)
+        seq.n_gen += 1
+        self._note_first_token(req)
+        self._admitted_rows += 1
+        get_registry().counter(
+            SCHED_ADMITTED_COUNTER,
+            "Decode rows admitted into batch slots between bursts").inc()
+        slot = lane.free_slot()
+        self.events.append(
+            f"admit seq={seq.seq_id} slot={slot} lane={lane.key} "
+            f"t={seq.pos} blocks={len(blocks)}")
+        done0 = seq.n_gen >= req.max_new or (
+            req.eos is not None and tok0 == req.eos)
+        if done0:
+            # the prefill's first token already finished the row:
+            # retire without ever occupying the slot
+            lane.pool.free_blocks(seq.blocks)
+            seq.blocks = []
+            self._retire_seq(lane, seq)
+            return
+        lane.seqs[slot] = seq
+        lane.tables[slot] = 0
+        lane.tables[slot, :len(blocks)] = blocks
+        lane.pos[slot] = seq.pos
+        lane.tok[slot] = tok0
+        lane.n_gen[slot] = seq.n_gen
+        lane.done[slot] = False
+        lane.keys[slot] = seq.key
+        lane.temp[slot] = req.temperature
+        lane.top_k[slot] = req.top_k
+        lane.top_p[slot] = req.top_p
+        lane.eos[slot] = -1 if req.eos is None else req.eos
+        lane.max_new_v[slot] = req.max_new
+        seq.slot = slot
+
+    # ------------------------------------------------ pool growth/preempt
+
+    def _ensure_blocks(self, lane: _Lane) -> None:
+        """Top up every active sequence's block table to cover the next
+        K positions (capped at its remaining quota). Exhaustion
+        preempts the lowest-priority, youngest active sequence across
+        every lane sharing the pool — possibly the grower itself."""
+        for slot in range(lane.slots):
+            seq = lane.seqs[slot]
+            if seq is None:
+                continue
+            horizon = int(lane.pos[slot]) + min(self.burst_tokens,
+                                                max(1, seq.remaining))
+            while seq.slot is not None:
+                delta = lane.pool.blocks_for(horizon) - len(seq.blocks)
+                if delta <= 0:
+                    break
+                got = lane.pool.alloc(delta)
+                if got is not None:
+                    start = len(seq.blocks)
+                    seq.blocks.extend(got)
+                    lane.tables[slot, start:start + len(got)] = got
+                    break
+                victim = self._pick_victim(lane.pool)
+                if victim is None or victim is seq:
+                    # nobody (else) to evict: the grower yields its own
+                    # slot (or, alone and still too big, fails typed)
+                    if victim is seq and lane.pool.blocks_for(horizon) \
+                            <= lane.pool.total_blocks:
+                        self._preempt(victim)
+                    else:
+                        self._evict_fail(lane, seq, KVPoolExhausted(
+                            f"sequence {seq.seq_id} needs "
+                            f"{lane.pool.blocks_for(horizon)} blocks; pool "
+                            f"holds {lane.pool.total_blocks}"))
+                    break
+                self._preempt(victim)
+
+    def _pick_victim(self, pool: PagedKVCachePool) -> Optional[_Seq]:
+        """Deterministic preemption policy: among every active sequence
+        whose lane shares ``pool``, the LOWEST priority loses first and
+        the YOUNGEST admission breaks ties (oldest work is closest to
+        finishing — evicting it wastes the most compute)."""
+        cands: List[_Seq] = []
+        for lane in self._lanes.values():
+            if lane.pool is pool:
+                cands.extend(lane.active())
+        if not cands:
+            return None
+        return min(cands, key=lambda s: (s.priority, -s.seq_id))
+
+    def _preempt(self, seq: _Seq) -> None:
+        """Free the victim's blocks and re-queue it AT THE FRONT with
+        prompt + generated prefix; its PRNG clock (``n_gen``) rides
+        along, so the resumed tokens equal an uninterrupted run's."""
+        lane = self._lane_for(*self._lane_key(seq))
+        slot = seq.slot
+        lane.pool.free_blocks(seq.blocks)
+        seq.blocks = []
+        seq.fed = np.concatenate(
+            [seq.req.prompt[seq.row].astype(np.int32),
+             np.asarray(seq.generated, np.int32)])
+        seq.slot = None
+        seq.preemptions += 1
+        if slot is not None:
+            lane.clear_slot(slot)
+        with self._lock:
+            self._queue.appendleft(seq)
+            self._preemptions += 1
+        get_registry().counter(
+            SCHED_PREEMPTIONS_COUNTER,
+            "Sequences preempted (blocks freed, re-queued with their "
+            "generated prefix) because the KV pool was exhausted").inc()
+        mark("decode_preempted", seq=seq.seq_id, priority=seq.priority)
+        self.events.append(
+            f"preempt seq={seq.seq_id} prio={seq.priority} "
+            f"n_gen={seq.n_gen}")
+
+    def _evict_fail(self, lane: _Lane, seq: _Seq,
+                    err: BaseException) -> None:
+        lane.pool.free_blocks(seq.blocks)
+        seq.blocks = []
+        if seq.slot is not None:
+            lane.clear_slot(seq.slot)
+            seq.slot = None
+        self._fail_seq(seq, err)
+
+    # ----------------------------------------------------------- bursts
+
+    def _burst_tiers(self, lane: _Lane) -> List[int]:
+        """The power-of-two block-count ladder for one lane's burst
+        programs (the PR-3 bucket doctrine applied to attention
+        length): a burst attends only as many table columns as its
+        LONGEST active sequence needs, rounded up the ladder, so short
+        contexts never pay full-max_len gather cost — and the ladder is
+        small enough to AOT-warm completely."""
+        tiers, t = [], 1
+        while t < lane.mb:
+            tiers.append(t)
+            t *= 2
+        tiers.append(lane.mb)
+        return tiers
+
+    def _tier_for(self, lane: _Lane) -> int:
+        need = 1
+        for seq in lane.active():
+            need = max(need, len(seq.blocks))
+        for t in self._burst_tiers(lane):
+            if need <= t:
+                return t
+        return lane.mb
+
+    def _dispatch_burst(self, lane: _Lane, params, accounted: bool = False,
+                        tier: Optional[int] = None,
+                        sampling: Optional[bool] = None,
+                        rows: Optional[int] = None):
+        """ONE fixed-shape device dispatch: K decode steps over the
+        ACTIVE rows compacted into the smallest slot bucket that covers
+        them (``rows``), attending ``tier`` block-table columns (the
+        ladder slot covering the longest active sequence), through the
+        greedy-only program when no active row samples. The (rows ×
+        K × tier) shape set is a small pre-compilable ladder — a
+        half-empty batch never pays full-slot compute. Donated pools
+        are re-installed from the program's outputs whether or not any
+        slot was live (warmup runs it all-masked). Returns full-slot
+        (ys, tok, pos, n_gen, done) views so retirement indexes by
+        slot."""
+        pool = lane.pool
+        active = [i for i, s in enumerate(lane.seqs) if s is not None]
+        if tier is None:
+            tier = self._tier_for(lane)
+        if sampling is None:
+            sampling = any(s.req.temperature > 0.0 for s in lane.active())
+        if rows is None:
+            rows = bucket_for(max(1, len(active)), self._slot_ladder)
+        if self._burst_hook is not None and accounted:
+            self._burst_hook(lane.key, self._bursts)
+        n = min(len(active), rows)
+        sel = active[:n]
+        tables = np.zeros((rows, tier), np.int32)
+        tables[:n] = lane.tables[sel, :tier]
+        pos = np.zeros(rows, np.int32)
+        pos[:n] = lane.pos[sel]
+        tok = np.zeros(rows, np.int32)
+        tok[:n] = lane.tok[sel]
+        n_gen = np.zeros(rows, np.int32)
+        n_gen[:n] = lane.n_gen[sel]
+        done = np.ones(rows, bool)
+        done[:n] = lane.done[sel]
+        keys = np.zeros((rows, 2), lane.keys.dtype)
+        keys[:n] = lane.keys[sel]
+        temp = np.zeros(rows, np.float32)
+        temp[:n] = lane.temp[sel]
+        top_k = np.zeros(rows, np.int32)
+        top_k[:n] = lane.top_k[sel]
+        top_p = np.zeros(rows, np.float32)
+        top_p[:n] = lane.top_p[sel]
+        eos = np.full(rows, -1, np.int32)
+        eos[:n] = lane.eos[sel]
+        max_new_v = np.zeros(rows, np.int32)
+        max_new_v[:n] = lane.max_new_v[sel]
+        bp = lane.gen.burst_program(rows, self.burst_tokens, tier,
+                                    pool.num_blocks, pool.block_size,
+                                    sampling=sampling)
+        fresh = note_dispatch(
+            lane.net, ("gen_burst", "sched", rows, self.burst_tokens,
+                       tier, pool.num_blocks, pool.block_size,
+                       bool(sampling)))
+        t0 = time.perf_counter()
+        with span("compile" if fresh else "inference",
+                  path="continuous_burst", slots=rows,
+                  k=self.burst_tokens, tier=tier,
+                  rows=n if accounted else 0):
+            pools, ys, tok2, pos2, ng2, done2 = bp(
+                params, pool.layers, tables, pos, tok, n_gen, done, keys,
+                temp, top_k, top_p, eos, max_new_v)
+            ys = np.asarray(ys)
+        pool.set_layers(pools)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if accounted:
+            reg = get_registry()
+            reg.counter(SCHED_BURSTS_COUNTER,
+                        "Fixed-K decode bursts dispatched").inc()
+            reg.histogram(SCHED_BURST_LATENCY_HISTOGRAM,
+                          "Decode burst dispatch latency (K steps, one "
+                          "scan)").observe(dt_ms)
+            with self._lock:
+                self._bursts += 1
+        # scatter the compact outputs back onto full-slot views
+        ys_f = np.zeros((lane.slots, self.burst_tokens), np.int32)
+        tok_f = lane.tok.copy()
+        pos_f = lane.pos.copy()
+        ng_f = lane.n_gen.copy()
+        done_f = lane.done.copy()
+        ys_f[sel] = ys[:n]
+        tok_f[sel] = np.asarray(tok2)[:n]
+        pos_f[sel] = np.asarray(pos2)[:n]
+        ng_f[sel] = np.asarray(ng2)[:n]
+        done_f[sel] = np.asarray(done2)[:n]
+        return ys_f, tok_f, pos_f, ng_f, done_f
+
+    def _retire(self, lane: _Lane, outs) -> None:
+        ys, tok, pos, n_gen, done = outs
+        for slot in range(lane.slots):
+            seq = lane.seqs[slot]
+            if seq is None:
+                continue
+            emitted = int(n_gen[slot]) - int(lane.n_gen[slot])
+            if emitted > 0:
+                seq.generated.extend(int(t) for t in ys[slot, :emitted])
+                seq.n_gen = int(n_gen[slot])
+                seq.pos = int(pos[slot])
+                self._note_first_token(seq.req)
+            lane.tok[slot] = tok[slot]
+            lane.pos[slot] = pos[slot]
+            lane.n_gen[slot] = n_gen[slot]
+            if bool(done[slot]):
+                lane.pool.free_blocks(seq.blocks)
+                seq.blocks = []
+                lane.clear_slot(slot)
+                seq.slot = None
+                self._retire_seq(lane, seq)
+
+    def _burst_failed(self, lane: _Lane, err: BaseException) -> None:
+        """A burst dispatch died: every sequence that was riding it
+        fails typed, its blocks free immediately (the kill-mid-burst
+        contract: the pool must drain back to fully free), and the
+        scheduler keeps serving later admissions."""
+        record_fault("serving")
+        mark("decode_burst_failed", lane=str(lane.key),
+             error=type(err).__name__)
+        self.events.append(f"burst_failed lane={lane.key} "
+                           f"err={type(err).__name__}")
+        for slot in range(lane.slots):
+            seq = lane.seqs[slot]
+            if seq is None:
+                continue
+            lane.pool.free_blocks(seq.blocks)
+            seq.blocks = []
+            lane.clear_slot(slot)
+            seq.slot = None
+            self._fail_seq(seq, self._typed(err, seq))
+
+    def _typed(self, err: BaseException, seq: _Seq) -> DecodeBurstError:
+        e = DecodeBurstError(
+            f"decode dispatch failed under sequence {seq.seq_id} "
+            f"({type(err).__name__}: {err})")
+        e.__cause__ = err
+        return e
+
+    # ------------------------------------------------------- completion
+
+    def _note_first_token(self, req: _DecodeRequest) -> None:
+        if req.t_first is None:
+            req.t_first = time.perf_counter()
+
+    def _retire_seq(self, lane: _Lane, seq: _Seq) -> None:
+        req = seq.req
+        self._retired_rows += 1
+        get_registry().counter(
+            SCHED_RETIRED_COUNTER,
+            "Decode rows retired (EOS/max-len) between bursts, blocks "
+            "freed").inc()
+        self.events.append(
+            f"retire seq={seq.seq_id} n_gen={seq.n_gen} "
+            f"preemptions={seq.preemptions}")
+        req.rows_done += 1
+        if req.rows_done >= req.n and not req.future.done():
+            self._resolve(req)
+
+    def _resolve(self, req: _DecodeRequest) -> None:
+        out = np.zeros((req.n, req.t_in + req.max_new), np.int64)
+        out[:, :req.t_in] = req.prompt
+        tokens = 0
+        for seq in self._seqs_of(req):
+            row = np.asarray(seq.generated, np.int64)
+            tokens += len(row)
+            fill = req.eos if req.eos is not None else 0
+            padded = np.full(req.max_new, fill, np.int64)
+            padded[:len(row)] = row[:req.max_new]
+            out[seq.row, req.t_in:] = padded
+        t_done = time.perf_counter()
+        self.completed.append({
+            "t_submit": req.t_submit,
+            "t_first": req.t_first if req.t_first is not None else t_done,
+            "t_done": t_done, "rows": req.n, "tokens": tokens})
+        req.future.set_result(out)
+        self._count_resolved()
+
+    def _seqs_of(self, req: _DecodeRequest) -> List[_Seq]:
+        return req.rows
+
+    def _fail_seq(self, seq: _Seq, err: BaseException) -> None:
+        req = seq.req
+        self.events.append(f"fail seq={seq.seq_id} err={type(err).__name__}")
+        if not req.future.done():
+            req.future.set_exception(err)
+            self._count_resolved()
+        # drop the request's other queued rows: the future already failed
+        with self._lock:
+            for other in [s for s in self._queue if s.req is req]:
+                self._queue.remove(other)
+        for lane in self._lanes.values():
+            for slot in range(lane.slots):
+                s = lane.seqs[slot]
+                if s is not None and s.req is req and s is not seq:
+                    lane.pool.free_blocks(s.blocks)
+                    s.blocks = []
+                    lane.clear_slot(slot)
+                    s.slot = None
+
+    def _count_resolved(self) -> None:
+        with self._lock:
+            self._resolved += 1
+        if self._on_resolve is not None:
+            self._on_resolve(1)
+
+    def _fail_everything(self, err: BaseException) -> None:
+        with self._lock:
+            queued = list(self._queue)
+            self._queue.clear()
+        failed = set()
+        for seq in queued:
+            if seq.req not in failed and not seq.req.future.done():
+                seq.req.future.set_exception(err)
+                failed.add(seq.req)
+                self._count_resolved()
+        for lane in self._lanes.values():
+            for slot in range(lane.slots):
+                seq = lane.seqs[slot]
+                if seq is None:
+                    continue
+                lane.pool.free_blocks(seq.blocks)
+                seq.blocks = []
+                lane.clear_slot(slot)
+                seq.slot = None
+                if seq.req not in failed and not seq.req.future.done():
+                    seq.req.future.set_exception(err)
+                    failed.add(seq.req)
+                    self._count_resolved()
+
+    # -------------------------------------------------------- thread/gauges
+
+    def _work_available(self) -> bool:
+        if self._queue:
+            return True
+        return any(lane.active() for lane in self._lanes.values())
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stopping and not self._work_available():
+                    self._cv.wait(0.05)
+                if self._stopping and (self._cancel
+                                       or not self._work_available()):
+                    break
+            try:
+                progressed = self.step()
+            except BaseException as e:  # never die silently
+                record_fault("serving")
+                self._fail_everything(e)
+                return
+            if not progressed:
+                with self._cv:
+                    if self._stopping:
+                        break
+                    self._cv.wait(0.01)
+        if self._cancel:
+            self._fail_everything(
+                RuntimeError("scheduler shut down before dispatch"))
+
+    def _gauges(self) -> None:
+        reg = get_registry()
+        with self._lock:
+            active = sum(len(lane.active()) for lane in self._lanes.values())
+            queued = len(self._queue)
+        reg.gauge(SCHED_ACTIVE_GAUGE,
+                  "Decode sequences currently occupying batch slots"
+                  ).set(active)
+        reg.gauge(SCHED_QUEUED_GAUGE,
+                  "Decode sequences queued awaiting admission").set(queued)

@@ -200,6 +200,13 @@ class _Handler(BaseHTTPRequestHandler):
                     m.get("warmed") for m in models.values())
                 degraded = degraded or any(
                     m.get("breaker_open") for m in models.values())
+            sched = body["inference"].get("scheduler")
+            if sched is not None:
+                # continuous-decode readiness (mirrors models_ready):
+                # an un-warmed scheduler means the first admitted
+                # sequence would eat the prefill/burst XLA compiles
+                body["scheduler_ready"] = bool(sched.get("warmed"))
+                unwarmed = unwarmed or not sched.get("warmed", True)
         router = getattr(self.server, "_router", None)
         if router is not None:
             # fleet aggregation: every endpoint's health/stats as the

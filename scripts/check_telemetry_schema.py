@@ -190,6 +190,20 @@ KNOWN_DL4J_METRICS = {
     "dl4j_model_active_version",
     "dl4j_model_breaker_open",
     "dl4j_model_pinned_bytes",
+    # continuous batching plane (serving/continuous.py decode
+    # scheduler + nn/kvpool.py paged KV block pool): pool occupancy /
+    # exhaustion and the iteration-level scheduler's admit / retire /
+    # preempt / burst accounting
+    "dl4j_kvpool_blocks_total",
+    "dl4j_kvpool_blocks_free",
+    "dl4j_kvpool_alloc_failures_total",
+    "dl4j_sched_admitted_rows_total",
+    "dl4j_sched_retired_rows_total",
+    "dl4j_sched_preemptions_total",
+    "dl4j_sched_bursts_total",
+    "dl4j_sched_burst_latency_ms",
+    "dl4j_sched_active_sequences",
+    "dl4j_sched_queued_prefills",
     # horizontal serving tier (serving/router.py InferenceRouter)
     "dl4j_router_requests_total",
     "dl4j_router_shed_total",

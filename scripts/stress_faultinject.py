@@ -54,7 +54,8 @@ def _scenario_log(seed: int) -> str:
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
-    from deeplearning4j_tpu.faultinject import (FailingDataSetIterator,
+    from deeplearning4j_tpu.faultinject import (BurstKill,
+                                                FailingDataSetIterator,
                                                 FlakyBroker, InjectedFault,
                                                 ModelPoison, ReplicaPoison,
                                                 TornWrites)
@@ -136,6 +137,22 @@ def _scenario_log(seed: int) -> str:
             except InjectedFault:
                 events.append(f"mp {i}/{model} hit")
     events.append(f"rp hits={rp.hits} mp hits={mp.hits}")
+
+    # 5) kill-mid-burst schedules (continuous decode scheduler seam):
+    # seeded window, lane-scoped filtering — the injector the
+    # tests/test_continuous.py kill-mid-burst scenario arms; here its
+    # hit schedule itself is pinned deterministic
+    bk = BurstKill(after=seed % 3, failures=2)
+    bk_lane = BurstKill(after=0, failures=2, lane=("m", 1))
+    for i in range(6):
+        for lane in ((None, None), ("m", 1)):
+            for inj in (bk, bk_lane):
+                try:
+                    inj(lane, i)
+                    events.append(f"bk {i}/{lane} ok")
+                except InjectedFault:
+                    events.append(f"bk {i}/{lane} hit")
+    events.append(f"bk hits={bk.hits} lane_hits={bk_lane.hits}")
     return "\n".join(events)
 
 
