@@ -32,6 +32,11 @@ Sub-benchmarks (each reported under "sub_benchmarks"):
     tokens/sec, time-to-first-token and per-token p50/p99, pool
     occupancy/preemptions, zero steady-state compiles and zero leaked
     blocks (pool free returns to total after drain)
+  - mesh_train — the rebuilt mesh plane (parallel/mesh.py MeshPlane):
+    dp/fsdp/tp one-step fit throughput on a forced-8-device CPU mesh
+    vs the single-device step, steady-state jit-miss counts, and
+    checkpoint save + restore-with-relayout (8→4, 8→1) latency — the
+    MULTICHIP_r*.json trajectory feed
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 The headline metric is ResNet-50 MFU when available (the heaviest
@@ -1229,6 +1234,168 @@ def bench_multi_model():
     }
 
 
+def _mesh_train_worker():
+    """Worker half of ``bench_mesh_train`` — runs in a FRESH interpreter
+    whose env forces an 8-device CPU mesh (the bench's main process may
+    hold a 1-device/TPU backend; the mesh plane needs width). Prints ONE
+    JSON line: per-layout one-step throughput, steady-state jit-miss
+    counts, and the checkpoint save / restore-with-relayout latencies."""
+    import os
+    import tempfile
+
+    import jax
+
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.monitor import JIT_CACHE_MISS_COUNTER
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.mesh import MeshPlane, make_mesh
+    from deeplearning4j_tpu.parallel.tensor_parallel import (apply_shardings,
+                                                             dense_tp_specs)
+    from deeplearning4j_tpu.parallel.zero import apply_fsdp
+    from deeplearning4j_tpu.util.sharded_checkpoint import (
+        restore_checkpoint, save_checkpoint)
+
+    assert len(jax.devices()) == 8, jax.devices()
+    rng = np.random.default_rng(0)
+    nin, width, nc, batch = 64, 256, 8, 512
+    ds = DataSet(rng.standard_normal((batch, nin)).astype(np.float32),
+                 np.eye(nc, dtype=np.float32)[rng.integers(0, nc, batch)])
+
+    def build():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).learning_rate(0.05).updater("adam").activation("relu")
+                .list()
+                .layer(DenseLayer(n_in=nin, n_out=width))
+                .layer(DenseLayer(n_in=width, n_out=width))
+                .layer(OutputLayer(n_in=width, n_out=nc, activation="softmax",
+                                   loss_function="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def setup_single(net):
+        return None
+
+    def setup_dp(net):
+        # batch sharded over data, params replicated — GSPMD inserts the
+        # gradient all-reduce inside the step (jit-with-shardings, no
+        # hand-rolled collective)
+        plane = MeshPlane.build({"data": 8})
+        net.params = jax.device_put(net.params, plane.replicated())
+        net.opt_state = jax.device_put(net.opt_state, plane.replicated())
+        net.states = jax.device_put(net.states, plane.replicated())
+        return plane
+
+    def setup_fsdp(net):
+        mesh = make_mesh({"data": 8})
+        apply_fsdp(net, mesh)
+        return net.mesh_plane
+
+    def setup_tp(net):
+        mesh = make_mesh({"tp": 8})
+        apply_shardings(net, mesh, dense_tp_specs(
+            ["layer0", "layer1"], axis="tp"))
+        return net.mesh_plane
+
+    steps = 30
+    results = {}
+    for name, setup in (("single", setup_single), ("dp", setup_dp),
+                        ("fsdp", setup_fsdp), ("tp", setup_tp)):
+        monitor.set_registry(monitor.MetricsRegistry())
+        net = build()
+        plane = setup(net)
+        fit_ds = ds
+        if plane is not None and name == "dp":
+            x, y = plane.shard_batch(ds.features, ds.labels)
+            fit_ds = DataSet(x, y)
+        net.fit(fit_ds)  # compile
+        miss0 = monitor.get_registry().counter(
+            JIT_CACHE_MISS_COUNTER, "").value
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            net.fit(fit_ds)
+        float(net.score())
+        dt = time.perf_counter() - t0
+        results[name] = {
+            "examples_per_sec": round(steps * batch / dt, 1),
+            "step_ms": round(dt / steps * 1e3, 3),
+            "steady_state_jit_misses": int(monitor.get_registry().counter(
+                JIT_CACHE_MISS_COUNTER, "").value - miss0),
+        }
+
+    # checkpoint save + restore-with-relayout latency (8 → 4 → 1): the
+    # mesh-portability path an on-call actually pays during a shrink
+    monitor.set_registry(monitor.MetricsRegistry())
+    net = build()
+    apply_fsdp(net, make_mesh({"data": 8}))
+    net.fit(ds)
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "ckpt")
+        t0 = time.perf_counter()
+        save_checkpoint(net, ck)
+        t_save = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        restore_checkpoint(ck, mesh=make_mesh({"data": 4},
+                                              devices=jax.devices()[:4]))
+        t_r4 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        restore_checkpoint(ck, mesh=make_mesh({"data": 1},
+                                              devices=jax.devices()[:1]))
+        t_r1 = time.perf_counter() - t0
+    results["checkpoint"] = {
+        "save_ms": round(t_save * 1e3, 1),
+        "restore_relayout_8to4_ms": round(t_r4 * 1e3, 1),
+        "restore_relayout_8to1_ms": round(t_r1 * 1e3, 1),
+        "relayouts": int(monitor.get_registry().counter(
+            "dl4j_mesh_restore_relayouts_total", "").value),
+    }
+    print(json.dumps(results))
+
+
+def bench_mesh_train():
+    """Mesh-plane training benchmark (ISSUE 9): dp / fsdp / tp one-step
+    throughput on the forced-8-device CPU mesh vs the single-device
+    step, steady-state jit-miss counts (zero once the layout's program
+    is compiled), and checkpoint save / restore-with-relayout latency
+    (8 → 4 and 8 → 1 — the MeshShrink recovery path, timed).
+
+    Runs in a subprocess with ``XLA_FLAGS`` forcing 8 CPU devices: the
+    bench process itself may sit on a 1-device or TPU backend, and the
+    mesh semantics under test need width. On one PHYSICAL core the
+    8-way layouts cannot beat the single-device step (eight programs
+    timeshare one core — ``vs_single`` is a semantics+overhead number
+    there, not a scaling claim); on real chips the same harness reads
+    out the scaling curve."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU plugin in the worker
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["DL4J_TPU_DISABLE_DEVICE_TRACE"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "_mesh_train_worker"],
+        env=env, capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(f"mesh_train worker failed:\n{proc.stderr[-3000:]}")
+    results = json.loads(proc.stdout.strip().splitlines()[-1])
+    single = results["single"]["examples_per_sec"]
+    for name in ("dp", "fsdp", "tp"):
+        results[name]["vs_single"] = round(
+            results[name]["examples_per_sec"] / max(single, 1e-9), 3)
+    return {
+        "metric": "mesh_train_dp_examples_per_sec",
+        "value": results["dp"]["examples_per_sec"],
+        "unit": "examples/sec",
+        "vs_baseline": results["dp"]["vs_single"],
+        **results,
+    }
+
+
 def bench_word2vec():
     """Word2Vec skip-gram (BASELINE config #5): the all-epochs-on-device
     SGNS scan engine (device pairgen + table negatives + capped MXU
@@ -1323,6 +1490,7 @@ def main():
                      ("continuous_decode", bench_continuous_decode),
                      ("router_slo", bench_router_slo),
                      ("multi_model", bench_multi_model),
+                     ("mesh_train", bench_mesh_train),
                      ("word2vec", bench_word2vec)]:
         # fresh registry per sub-bench: the monitor spans inside the
         # fit/stage paths give each result its own per-phase attribution
@@ -1368,4 +1536,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+
+    if len(_sys.argv) > 1 and _sys.argv[1] == "_mesh_train_worker":
+        _mesh_train_worker()
+    else:
+        main()

@@ -27,7 +27,11 @@ Injector ↔ fault domain map:
 - :func:`kill_endpoint` / :class:`NetworkPartition` — abrupt engine
   endpoint death and broker-level partitions (routing domain: the
   InferenceRouter's heartbeat death detection, failover, ejection and
-  half-open reinstatement).
+  half-open reinstatement);
+- :class:`MeshShrink` / :class:`ChipFailure` — chips dying out of the
+  mesh plane mid-epoch (mesh domain: checkpoint fallback, MeshPlane
+  rebuild from the survivors, ``restore_checkpoint(mesh=...)``
+  re-lowering, bitwise-deterministic resume on the smaller mesh).
 """
 
 from __future__ import annotations
@@ -287,6 +291,69 @@ def poison_model(engine, model: str, failures: Optional[int] = None,
     poison = ModelPoison(model, failures, version)
     engine._poison_hook = poison
     return poison
+
+
+# ----------------------------------------------------------------- mesh
+
+class ChipFailure(InjectedFault):
+    """A chip (subset of the mesh's devices) died mid-run. Carries the
+    SURVIVING device ids so the recovery path can rebuild a smaller
+    MeshPlane from exactly the devices the drill left alive."""
+
+    def __init__(self, message: str, survivor_ids: Sequence[int]):
+        super().__init__(message)
+        self.survivor_ids = tuple(int(i) for i in survivor_ids)
+
+
+class MeshShrink:
+    """Deterministic mesh-shrink drill: at training step
+    ``fail_at_step`` (0-based, counted across :meth:`step` calls) the
+    drill raises :class:`ChipFailure` naming ``survivors`` devices
+    chosen by a SEEDED rng from the ``total`` the mesh started with —
+    the stand-in for chips dropping out of the plane mid-epoch.
+
+    The recovery contract under test (tests/test_mesh_plane.py, marker
+    ``faultinject``): the training loop falls back to its newest
+    checkpoint, rebuilds a MeshPlane from the survivors, restores via
+    ``restore_checkpoint(..., mesh=...)`` (saved shards re-lowered onto
+    the smaller topology) and resumes — with a bitwise-identical
+    forward on the restored step across drill reruns. Same
+    ``(seed, fail_at_step, survivors)`` → identical failure step AND
+    identical survivor set, so a failing drill replays exactly."""
+
+    def __init__(self, fail_at_step: int, survivors: int,
+                 total: Optional[int] = None, seed: int = 0):
+        if survivors < 1:
+            raise ValueError(f"survivors must be >= 1, got {survivors}")
+        self.fail_at_step = int(fail_at_step)
+        self.survivors = int(survivors)
+        self.total = total
+        self.seed = int(seed)
+        self.steps_seen = 0
+        self.fired = False
+
+    def survivor_ids(self, total: Optional[int] = None) -> tuple:
+        """The seeded choice of surviving device ids out of ``total``
+        (ascending — a stable mesh rebuild order)."""
+        n = int(total if total is not None else self.total)
+        if self.survivors > n:
+            raise ValueError(f"{self.survivors} survivors > {n} devices")
+        rng = random.Random(self.seed)
+        return tuple(sorted(rng.sample(range(n), self.survivors)))
+
+    def step(self, total: Optional[int] = None) -> int:
+        """Account one training step; raises :class:`ChipFailure` when
+        the schedule says the chips die. Returns the step index."""
+        idx = self.steps_seen
+        self.steps_seen += 1
+        if idx == self.fail_at_step and not self.fired:
+            self.fired = True
+            ids = self.survivor_ids(total)
+            raise ChipFailure(
+                f"injected chip failure at step {idx}: "
+                f"{self.survivors} of {total if total is not None else self.total} "
+                f"devices survive ({list(ids)})", ids)
+        return idx
 
 
 # -------------------------------------------------------------- routing

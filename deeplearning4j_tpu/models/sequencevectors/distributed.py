@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.parallel.mesh import device_collective
+
 
 from deeplearning4j_tpu.models.sequencevectors.engine import _row_denom
 
@@ -86,8 +88,8 @@ def make_sharded_sgns_step(mesh: Mesh, data_axis: str = "data",
         count = jax.lax.psum(jnp.sum(w), data_axis)
         return syn0 + d0, syn1neg + d1, loss_sum / jnp.maximum(count, 1.0)
 
-    shard = jax.shard_map(
-        local, mesh=mesh,
+    shard = device_collective(
+        local, mesh,
         in_specs=(table_spec, table_spec, P(data_axis), P(data_axis),
                   P(data_axis, None), P(data_axis), P()),
         out_specs=(table_spec, table_spec, P()))
@@ -127,8 +129,8 @@ def make_sharded_hs_step(mesh: Mesh, data_axis: str = "data",
         count = jax.lax.psum(jnp.sum(cm), data_axis)
         return syn0 + d0, syn1 + d1, loss_sum / jnp.maximum(count, 1.0)
 
-    shard = jax.shard_map(
-        local, mesh=mesh,
+    shard = device_collective(
+        local, mesh,
         in_specs=(table_spec, table_spec, P(data_axis), P(data_axis, None),
                   P(data_axis, None), P(data_axis, None), P(data_axis), P()),
         out_specs=(table_spec, table_spec, P()))
@@ -182,8 +184,8 @@ def make_sharded_cbow_step(mesh: Mesh, data_axis: str = "data",
         count = jax.lax.psum(jnp.sum(w), data_axis)
         return syn0 + d0, syn1neg + d1, loss_sum / jnp.maximum(count, 1.0)
 
-    shard = jax.shard_map(
-        local, mesh=mesh,
+    shard = device_collective(
+        local, mesh,
         in_specs=(table_spec, table_spec, P(data_axis, None), P(data_axis, None),
                   P(data_axis), P(data_axis, None), P(data_axis), P()),
         out_specs=(table_spec, table_spec, P()))

@@ -71,6 +71,12 @@ The fault-tolerance plane publishes ``dl4j_fault_events_total`` (by
 torn/checksum-bad unit) — a healthy fleet holds all of them at zero,
 and any nonzero value names the recovery path that ran.
 
+The mesh plane (parallel/mesh.py ``MeshPlane``) publishes
+``dl4j_mesh_devices`` / ``dl4j_mesh_axis_size{axis}`` (the active
+named-axis topology — what ``/healthz`` also reports) and
+``dl4j_mesh_restore_relayouts_total`` (checkpoint restores that
+re-lowered saved shards onto a different mesh shape).
+
 The generation plane (nn/generate.py fused autoregressive decode)
 publishes ``dl4j_decode_requests_total``,
 ``dl4j_decode_prefill_tokens_total`` / ``dl4j_decode_tokens_total``
@@ -176,6 +182,16 @@ MODEL_EVICTIONS_COUNTER = "dl4j_model_evictions_total"
 MODEL_ACTIVE_VERSION_GAUGE = "dl4j_model_active_version"
 MODEL_BREAKER_OPEN_GAUGE = "dl4j_model_breaker_open"
 MODEL_PINNED_BYTES_GAUGE = "dl4j_model_pinned_bytes"
+
+# Mesh plane (parallel/mesh.py MeshPlane — the named-axis GSPMD mesh
+# every multi-chip path shares): device count and per-axis size of the
+# active plane (``axis=`` label: data/fsdp/tp/seq/pp), and the count of
+# checkpoint restores that had to RE-LOWER saved shards onto a
+# different mesh shape (the mesh-portability path — save-on-8 /
+# restore-on-4 — running in production; zero on a stable topology).
+MESH_DEVICES_GAUGE = "dl4j_mesh_devices"
+MESH_AXIS_SIZE_GAUGE = "dl4j_mesh_axis_size"
+MESH_RESTORE_RELAYOUT_COUNTER = "dl4j_mesh_restore_relayouts_total"
 
 # Fault-tolerance plane (detect → isolate → recover): every recovery
 # path in the stack reports through these five families so an operator

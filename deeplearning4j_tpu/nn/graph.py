@@ -271,6 +271,9 @@ class ComputationGraph:
         self._jits: Dict[Any, Callable] = {}
         self._dispatch_sigs: set = set()
         self._train_rng_key = None
+        # mesh plane seam (see MultiLayerNetwork): sharding appliers pin
+        # the MeshPlane here; sharded checkpoints + /healthz read it
+        self.mesh_plane = None
 
     # ------------------------------------------------------------------ init
 
@@ -291,6 +294,7 @@ class ComputationGraph:
         self._jits = {}
         self._dispatch_sigs = set()
         self._pretrained = False
+        self.mesh_plane = None  # init() re-places on the default device
         return self
 
     def set_listeners(self, *listeners):

@@ -88,6 +88,11 @@ class MultiLayerNetwork:
         self._jits: Dict[Any, Callable] = {}
         self._dispatch_sigs: set = set()
         self._train_rng_key = None
+        # the mesh plane seam: parallel.mesh.MeshPlane.apply / the
+        # sharding appliers pin the plane (mesh + SpecLayout) here so
+        # sharded checkpoints can record the layout and /healthz can
+        # report the topology; None = single-device placement
+        self.mesh_plane = None
 
     # ------------------------------------------------------------------ init
 
@@ -111,6 +116,7 @@ class MultiLayerNetwork:
         self._jits = {}
         self._dispatch_sigs = set()
         self._pretrained = False
+        self.mesh_plane = None  # init() re-places on the default device
         return self
 
     def set_listeners(self, *listeners) -> None:

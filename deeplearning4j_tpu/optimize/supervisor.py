@@ -138,6 +138,12 @@ class TrainingSupervisor:
         # roll back across; copy on device (async, no host round-trip).
         # On CPU donation is globally off (see _make_train_step) and the
         # arrays are immutable — reference capture is free AND exact.
+        # SHARDED pytrees (FSDP/TP over a MeshPlane) take the same two
+        # paths: jnp.copy of a sharded jax.Array copies each shard on
+        # its own device and the result carries the identical
+        # NamedSharding, so a rollback restores both the bits and the
+        # placement — no host gather, no relayout (pinned by
+        # test_mesh_plane's sharded-rollback test).
         if jax.default_backend() == "cpu":
             return tree
         return jax.tree.map(jnp.copy, tree)

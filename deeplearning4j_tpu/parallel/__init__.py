@@ -12,15 +12,30 @@ data-parallel when the batch is sharded over the ``data`` axis — XLA
 inserts the gradient all-reduce over ICI inside the step (there is no
 separate communication phase to schedule, overlap is the compiler's
 job). Parameter-averaging semantics (``averagingFrequency > 1``) are
-kept for parity via shard_map-isolated local steps + periodic pmean.
+kept for parity via vmapped worker-local steps + periodic in-step mean.
+
+Every multi-chip path hangs off ONE abstraction: ``mesh.MeshPlane``
+(named-axis mesh + ``SpecLayout``). Jit-with-shardings is the default
+discipline (GSPMD derives the collectives); genuinely per-device
+programs (ring/pipeline ppermute schedules, psum'd embedding
+scatter-adds) go through ``mesh.device_collective`` — the one
+sanctioned shard_map entry point (``scripts/check_mesh_api.py`` lints
+both rules).
 
 Extensions with no reference counterpart: tensor parallelism via
-parameter PartitionSpecs (``model`` axis), sequence parallelism / ring
-attention for long context (``ring_attention.py``), multi-host DCN via
-``jax.distributed`` initialization.
+parameter PartitionSpecs (``tp``/``model`` axis), sequence parallelism /
+ring attention for long context (``ring_attention.py``), multi-host DCN
+via ``jax.distributed`` initialization.
 """
 
-from deeplearning4j_tpu.parallel.mesh import MeshContext, make_mesh  # noqa: F401
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    MeshContext,
+    MeshPlane,
+    SpecLayout,
+    active_plane,
+    device_collective,
+    make_mesh,
+)
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper, TrainingHook  # noqa: F401
 from deeplearning4j_tpu.parallel.evaluation import evaluate_sharded  # noqa: F401
 from deeplearning4j_tpu.parallel.inference import (  # noqa: F401

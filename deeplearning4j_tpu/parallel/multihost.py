@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.monitor import span
+from deeplearning4j_tpu.parallel.mesh import mesh_from_grid
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -97,7 +98,7 @@ def make_multihost_mesh(dcn_axes: Optional[Dict[str, int]] = None,
         raise ValueError(f"axes {names}={sizes} need {int(np.prod(sizes))} "
                          f"devices, have {len(devices)}")
     arr = np.asarray(devices).reshape(sizes)
-    return Mesh(arr, tuple(names))
+    return mesh_from_grid(arr, tuple(names))
 
 
 def global_batch(mesh: Mesh, local_arrays: Sequence[np.ndarray],

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deeplearning4j_tpu.parallel.mesh import device_collective
+
 
 def pipeline_apply(stage_params, fn: Callable, x: jnp.ndarray,
                    mesh: Mesh, axis: str = "pp",
@@ -78,19 +80,19 @@ def pipeline_apply(stage_params, fn: Callable, x: jnp.ndarray,
                                       [(i, (i + 1) % p) for i in range(p)])
             return h_next, outs
 
-        # carries become device-varying after tick 1; mark them so from
-        # the start or the fori_loop carry types mismatch under shard_map
-        h0 = jax.lax.pcast(jnp.zeros(mb_shape, x.dtype), (axis,), to="varying")
-        outs0 = jax.lax.pcast(jnp.zeros((m,) + mb_shape, x.dtype), (axis,),
-                              to="varying")
+        h0 = jnp.zeros(mb_shape, x.dtype)
+        outs0 = jnp.zeros((m,) + mb_shape, x.dtype)
         _, outs = jax.lax.fori_loop(0, n_ticks, tick, (h0, outs0))
         # only the last stage holds real outputs; broadcast over the axis
         outs = jnp.where(my == p - 1, outs, jnp.zeros_like(outs))
         return jax.lax.psum(outs, axis)
 
+    # a genuinely per-device program (every device ticks its stage and
+    # rotates activations around the ring) — the plane's one sanctioned
+    # shard_map entry point
     spec_params = jax.tree.map(lambda _: P(axis), stage_params)
-    out = jax.shard_map(
-        staged, mesh=mesh,
+    out = device_collective(
+        staged, mesh,
         in_specs=(spec_params, P()), out_specs=P(),
     )(stage_params, xm)
     return out.reshape((b,) + x.shape[1:])

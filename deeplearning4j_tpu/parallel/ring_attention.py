@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.parallel.mesh import device_collective
+
 
 def _block_attend(q, k, v, scores_mask, m_prev, l_prev, acc_prev):
     """One block of online-softmax attention accumulation.
@@ -68,12 +70,6 @@ def ring_attention(
         m0 = jnp.full((b, h, tq), jnp.finfo(qb.dtype).min, qb.dtype)
         l0 = jnp.zeros((b, h, tq), qb.dtype)
         a0 = jnp.zeros_like(qb)
-        # carries become device-varying after step 1; mark them so from the
-        # start or the fori_loop carry types mismatch under shard_map
-        # (over every axis the inputs vary on, incl. the DP batch axis)
-        vary = (axis,) if batch_axis is None else (batch_axis, axis)
-        m0 = jax.lax.pcast(m0, vary, to="varying")
-        l0 = jax.lax.pcast(l0, vary, to="varying")
         qpos = my * blk + jnp.arange(blk)
 
         def body(i, carry):
@@ -95,6 +91,9 @@ def ring_attention(
         l_t = l.transpose(0, 2, 1)[..., None]  # [b, tq, h, 1]
         return acc / jnp.maximum(l_t, jnp.asarray(1e-30, l_t.dtype))
 
+    # a genuinely per-device program (the ppermute ring schedule IS the
+    # algorithm) — routed through the plane's one sanctioned shard_map
+    # entry; everything jit-with-shardings-expressible must not be here
     spec = P(batch_axis, axis, None, None)
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    return device_collective(local, mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec)(q, k, v)

@@ -89,12 +89,19 @@ def place_updater_state(model, mesh: Mesh,
 
 
 def apply_shardings(model, mesh: Mesh,
-                    specs: Dict[str, Dict[str, P]]) -> None:
+                    specs: Dict[str, Dict[str, P]], plane=None) -> None:
     """Place the model's params (and matching updater state) according to
     ``specs``; unlisted params are replicated. Subsequent ``fit`` calls
-    compile SPMD with these placements."""
+    compile SPMD with these placements. The layout is pinned on the
+    model as ``model.mesh_plane`` (a :class:`~..mesh.MeshPlane`) — the
+    seam mesh-portable checkpoints and the supervisor read."""
+    from deeplearning4j_tpu.parallel.mesh import MeshPlane, SpecLayout
+
     place = _placer(mesh, specs)
     model.params = {ln: {pn: place(ln, pn, v) for pn, v in ld.items()}
                     for ln, ld in model.params.items()}
     place_updater_state(model, mesh, specs)
     model.states = jax.device_put(model.states, NamedSharding(mesh, P()))
+    if plane is None:
+        plane = MeshPlane(mesh, SpecLayout(specs))
+    model.mesh_plane = plane

@@ -50,7 +50,7 @@ from deeplearning4j_tpu.optimize.deferred import (
     set_host_step,
 )
 from deeplearning4j_tpu.optimize.training_stats import TrainingStats
-from deeplearning4j_tpu.parallel.mesh import MeshContext, make_mesh
+from deeplearning4j_tpu.parallel.mesh import MeshPlane, make_mesh
 
 # TrainingStats keeps the reference's phase vocabulary (data_wait/stage/
 # step/average — CommonSparkTrainingStats names, pinned by its tests);
@@ -103,8 +103,14 @@ class ParallelWrapper:
         device-placed SHARDED over the mesh replicas by a background
         stage, and scores resolve in deferred batches."""
         self.model = model
-        self.mesh = mesh if mesh is not None else make_mesh()
-        self.ctx = MeshContext(self.mesh)
+        # mesh= accepts a raw Mesh (legacy) or a MeshPlane — training
+        # rides the same plane the inference engine can later slice
+        if isinstance(mesh, MeshPlane):
+            self.ctx = mesh
+            self.mesh = mesh.mesh
+        else:
+            self.mesh = mesh if mesh is not None else make_mesh()
+            self.ctx = MeshPlane(self.mesh)
         self.workers = workers if workers is not None else self.ctx.data_axis_size()
         if self.workers < 1 or self.workers % self.ctx.data_axis_size() != 0:
             raise ValueError(f"workers={self.workers} must be a positive multiple of "

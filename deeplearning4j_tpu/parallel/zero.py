@@ -23,6 +23,7 @@ from typing import Dict
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.parallel.mesh import MeshPlane, SpecLayout
 from deeplearning4j_tpu.parallel.tensor_parallel import (
     apply_shardings, place_updater_state)
 
@@ -60,4 +61,8 @@ def apply_zero1(model, mesh: Mesh, axis: str = "data") -> Dict[str, Dict[str, P]
     model.params = jax.device_put(model.params, repl)
     model.states = jax.device_put(model.states, repl)
     place_updater_state(model, mesh, specs)
+    # params replicated → empty param layout; the plane still pins the
+    # topology (checkpoint save reads the updater specs off the live
+    # arrays, so ZeRO-1's asymmetric placement round-trips regardless)
+    model.mesh_plane = MeshPlane(mesh, SpecLayout())
     return specs

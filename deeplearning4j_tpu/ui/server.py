@@ -213,6 +213,14 @@ class _Handler(BaseHTTPRequestHandler):
             # router sees them (heartbeats + ejection state)
             body["fleet"] = router.fleet_snapshot()
             degraded = degraded or bool(body["fleet"].get("degraded"))
+        # mesh topology: the active MeshPlane (named axes + device ids)
+        # — an operator reading /healthz sees at a glance what topology
+        # this process is actually training/serving on (and a restore
+        # onto a shrunken mesh shows up as the changed axis sizes)
+        from deeplearning4j_tpu.parallel.mesh import active_plane
+        plane = active_plane()
+        if plane is not None:
+            body["mesh"] = plane.topology()
         body["live"] = True
         body["ready"] = not degraded and not unwarmed
         return body, degraded, unwarmed

@@ -12,8 +12,12 @@ live model at restore time.
 
 Layout: ``<dir>/state`` (orbax PyTree of params/opt_state/states) +
 ``<dir>/configuration.json`` (same payload the zip format uses, so the
-model can be rebuilt from the checkpoint alone) + ``<dir>/manifest.json``
-(per-file CRC32s, written LAST — its presence marks a complete unit).
+model can be rebuilt from the checkpoint alone) + ``<dir>/layout.json``
+(the ``SpecLayout`` + saving-mesh topology — what makes the unit
+MESH-PORTABLE: ``restore_checkpoint(..., mesh=)`` re-lowers the saved
+shards onto ANY current mesh, 8 → 4 → 1 chips, restricting each spec to
+the axes the new mesh has) + ``<dir>/manifest.json`` (per-file CRC32s,
+written LAST — its presence marks a complete unit).
 
 Crash safety: a checkpoint is assembled in a sibling temp directory and
 renamed into place, so a preemption at any instant leaves either the
@@ -37,6 +41,7 @@ from typing import List, Optional
 import jax
 
 from deeplearning4j_tpu.monitor import (FAULT_CKPT_INTEGRITY_COUNTER,
+                                        MESH_RESTORE_RELAYOUT_COUNTER,
                                         get_registry, record_fault, span)
 from deeplearning4j_tpu.util.model_serializer import (CheckpointCorruptError,
                                                       fsync_dir)
@@ -44,6 +49,7 @@ from deeplearning4j_tpu.util.model_serializer import (CheckpointCorruptError,
 logger = logging.getLogger("deeplearning4j_tpu")
 
 _MANIFEST = "manifest.json"
+_LAYOUT = "layout.json"
 _STEP_PREFIX = "ckpt-"
 _TMP_PREFIX = ".ckpt_tmp_"
 
@@ -126,6 +132,98 @@ def _note_integrity_failure(problems: List[str]) -> None:
         logger.warning("sharded_checkpoint: %s", p)
 
 
+# ------------------------------------------------------- mesh portability
+
+def _first_sharded_spec(subtree):
+    """The PartitionSpec of the first non-replicated NamedSharding leaf
+    in ``subtree`` (updater-state mirrors share one spec per param)."""
+    from jax.sharding import NamedSharding
+
+    for leaf in jax.tree.leaves(subtree):
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding) and \
+                any(p is not None for p in tuple(sh.spec)):
+            return sh.spec
+    return None
+
+
+def _layout_payload(model):
+    """The SpecLayout + mesh-topology record a checkpoint unit carries
+    (``layout.json``, CRC-sealed by the manifest): whatever sharding the
+    live arrays actually hold — params and the updater mirror recorded
+    separately so asymmetric placements (ZeRO-1) round-trip — plus the
+    saving mesh shape, so a restore onto a different topology knows it
+    is re-lowering."""
+    from deeplearning4j_tpu.parallel.mesh import SpecLayout
+
+    params_layout = SpecLayout.from_params(model.params)
+    upd_layout = SpecLayout()
+    for ln, ld in ((model.opt_state or {}).get("updater") or {}).items():
+        for pn, st in ld.items():
+            spec = _first_sharded_spec(st)
+            if spec is not None:
+                upd_layout.set(ln, pn, spec)
+    mesh_info = None
+    plane = getattr(model, "mesh_plane", None)
+    if plane is not None:
+        mesh_info = plane.topology()
+    else:
+        from jax.sharding import NamedSharding
+
+        for leaf in jax.tree.leaves((model.params, model.opt_state)):
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                mesh = sh.mesh
+                mesh_info = {
+                    "devices": int(mesh.devices.size),
+                    "axes": {str(k): int(v) for k, v in mesh.shape.items()},
+                    "device_ids": [int(d.id) for d in mesh.devices.flat]}
+                break
+    return {"format": 1, "mesh": mesh_info,
+            "params": params_layout.to_payload(),
+            "updater": upd_layout.to_payload()}
+
+
+def _read_layout(directory: str):
+    """(params SpecLayout, updater SpecLayout, mesh info | None) from a
+    unit's ``layout.json`` — empty layouts for pre-mesh-plane units."""
+    from deeplearning4j_tpu.parallel.mesh import SpecLayout
+
+    path = os.path.join(directory, _LAYOUT)
+    if not os.path.exists(path):
+        return SpecLayout(), SpecLayout(), None
+    with open(path) as f:
+        payload = json.load(f)
+    return (SpecLayout.from_payload(payload.get("params")),
+            SpecLayout.from_payload(payload.get("updater")),
+            payload.get("mesh"))
+
+
+def _mesh_template(model, mesh, params_layout, updater_layout):
+    """Target-sharding template for an orbax restore onto ``mesh``:
+    every leaf gets a ``NamedSharding`` on the CURRENT mesh, with the
+    saved specs re-lowered (axes the mesh lacks dropped, indivisible
+    dims replicated). states + step are replicated."""
+    import numpy as _np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    params_sh = params_layout.param_shardings(model.params, mesh)
+
+    def _upd_sh(ln, pn, st):
+        return jax.tree.map(
+            lambda leaf: NamedSharding(mesh, updater_layout.restricted_spec(
+                ln, pn, _np.shape(leaf), mesh)), st)
+
+    upd = (model.opt_state or {}).get("updater") or {}
+    opt_sh = {"step": repl,
+              "updater": {ln: {pn: _upd_sh(ln, pn, st)
+                               for pn, st in ld.items()}
+                          for ln, ld in upd.items()}}
+    states_sh = jax.tree.map(lambda _: repl, model.states)
+    return {"params": params_sh, "opt_state": opt_sh, "states": states_sh}
+
+
 # ------------------------------------------------------------------ save
 
 def _install_dir(tmp: str, final: str) -> None:
@@ -162,6 +260,12 @@ def _write_unit(model, directory: str) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(cfg_tmp, os.path.join(directory, "configuration.json"))
+    lay_tmp = os.path.join(directory, _LAYOUT + ".tmp")
+    with open(lay_tmp, "w") as f:
+        json.dump(_layout_payload(model), f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(lay_tmp, os.path.join(directory, _LAYOUT))
     _write_manifest(directory)
 
 
@@ -231,7 +335,8 @@ def _restore_candidates(directory: str) -> List[str]:
     return cands
 
 
-def restore_checkpoint(directory: str, model=None, shardings=None):
+def restore_checkpoint(directory: str, model=None, shardings=None,
+                       mesh=None):
     """Restore a checkpoint, falling back to the newest VALID unit.
 
     Each candidate (newest first — see ``save_checkpoint(keep=...)``) is
@@ -246,6 +351,14 @@ def restore_checkpoint(directory: str, model=None, shardings=None):
     ``fsdp_specs``); default keeps the restoring model's current
     placements when it has any, else single-device default placement —
     the checkpoint itself is topology-free.
+
+    ``mesh``: a ``Mesh`` or ``MeshPlane`` to restore ONTO — the
+    mesh-portability path. The unit's recorded ``SpecLayout`` is
+    re-lowered onto the given mesh (axes the new mesh lacks are
+    dropped; dims that stop dividing fall back to replication), so a
+    checkpoint written on 8 chips restores on 4 or 1 without the saving
+    topology existing anymore. When the target shape differs from the
+    saving shape, ``dl4j_mesh_restore_relayouts_total`` ticks.
     """
     directory = os.path.abspath(directory)
     candidates = _restore_candidates(directory)
@@ -257,7 +370,7 @@ def restore_checkpoint(directory: str, model=None, shardings=None):
             failures.extend(problems)
             continue
         try:
-            return _restore_unit(cand, model, shardings)
+            return _restore_unit(cand, model, shardings, mesh)
         except CheckpointCorruptError:
             raise
         except Exception as e:  # torn past what the manifest could see
@@ -269,7 +382,7 @@ def restore_checkpoint(directory: str, model=None, shardings=None):
         if failures else f"no checkpoint found under {directory}")
 
 
-def _restore_unit(directory: str, model=None, shardings=None):
+def _restore_unit(directory: str, model=None, shardings=None, mesh=None):
     if model is None:
         with open(os.path.join(directory, "configuration.json")) as f:
             payload = json.load(f)
@@ -285,17 +398,36 @@ def _restore_unit(directory: str, model=None, shardings=None):
     import numpy as _np
     import orbax.checkpoint as ocp
 
-    template = {"params": model.params, "opt_state": model.opt_state,
-                "states": model.states}
-    if shardings is not None:
-        template = dict(template)
-        template["params"] = shardings
+    plane = None
+    if mesh is not None:
+        from deeplearning4j_tpu.parallel.mesh import MeshPlane
+
+        plane = mesh if isinstance(mesh, MeshPlane) else MeshPlane(mesh)
+        params_layout, upd_layout, saved_mesh = _read_layout(directory)
+        template = _mesh_template(model, plane.mesh, params_layout,
+                                  upd_layout)
+        saved_axes = (saved_mesh or {}).get("axes")
+        cur_axes = {str(k): int(v) for k, v in plane.mesh.shape.items()}
+        if saved_axes is not None and saved_axes != cur_axes:
+            # the portability path proper: the saved shards are being
+            # re-lowered onto a topology the writer never saw
+            get_registry().counter(
+                MESH_RESTORE_RELAYOUT_COUNTER,
+                "Checkpoint restores re-lowered onto a different mesh "
+                "shape").inc()
+        plane.layout = params_layout
+    else:
+        template = {"params": model.params, "opt_state": model.opt_state,
+                    "states": model.states}
+        if shardings is not None:
+            template = dict(template)
+            template["params"] = shardings
 
     def _arg(leaf):
-        if hasattr(leaf, "sharding"):  # live jax.Array target
-            return ocp.ArrayRestoreArgs(sharding=leaf.sharding)
         if isinstance(leaf, jax.sharding.Sharding):  # explicit spec
             return ocp.ArrayRestoreArgs(sharding=leaf)
+        if hasattr(leaf, "sharding"):  # live jax.Array target
+            return ocp.ArrayRestoreArgs(sharding=leaf.sharding)
         return ocp.RestoreArgs(restore_type=_np.ndarray)
 
     restore_args = jax.tree.map(_arg, template)
@@ -305,5 +437,7 @@ def _restore_unit(directory: str, model=None, shardings=None):
     model.params = restored["params"]
     model.opt_state = restored["opt_state"]
     model.states = restored["states"]
+    if plane is not None:
+        model.mesh_plane = plane
     model._jits = {}
     return model

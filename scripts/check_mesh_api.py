@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Mesh-API lint: the dead ``jax.shard_map`` attribute can never come
+back, and every mesh is built by ``parallel/mesh.py``.
+
+The multi-chip plane was dead code for eight PRs because call sites
+used ``jax.shard_map`` — an attribute that simply does not exist on
+this jax (0.4.x); every ring-attention / pipeline / multihost /
+seq-mesh test failed identically with AttributeError since the seed.
+The rebuilt plane (``parallel/mesh.py`` MeshPlane/SpecLayout) holds two
+disciplines this lint enforces STATICALLY, the way
+``check_donation_gates.py`` pins the donation hazard:
+
+1. **No dead API**: any ``jax.shard_map`` attribute access is an error,
+   and the working ``jax.experimental.shard_map`` may be imported or
+   referenced ONLY by ``parallel/mesh.py`` — everything per-device goes
+   through its one sanctioned ``device_collective`` wrapper, so a jax
+   upgrade/rename breaks exactly one file.
+2. **One mesh factory**: ``Mesh(...)`` construction (bare or via
+   ``jax.sharding.Mesh`` / ``sharding.Mesh``) outside ``parallel/mesh.py``
+   is an error — topology decisions live on the MeshPlane, where the
+   lint, the checkpoint layout recorder and /healthz can see them.
+
+Importable (a tier-1 test runs :func:`check_repo`) and a CLI::
+
+    python scripts/check_mesh_api.py [root]
+
+Exit 0 when the repo is clean; 1 with one line per violation.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+#: the one file allowed to import/construct the raw primitives.
+ALLOWED_FILES = ("parallel/mesh.py",)
+
+
+def _attr_chain(node) -> str:
+    """Dotted name of an attribute chain ('jax.experimental.shard_map'),
+    '' when the base is not a plain name."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_mesh_ctor(node: ast.Call) -> bool:
+    """Match ``Mesh(...)`` / ``jax.sharding.Mesh(...)`` /
+    ``sharding.Mesh(...)`` — raw mesh construction."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == "Mesh"
+    if isinstance(f, ast.Attribute):
+        return f.attr == "Mesh"
+    return False
+
+
+def check_file(path: str, rel: str = "") -> List[str]:
+    """Violations ([] = clean) for one file."""
+    rel = rel or path
+    allowed = any(rel.endswith(a) for a in ALLOWED_FILES)
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{rel}: unparseable ({e})"]
+    problems: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            chain = _attr_chain(node)
+            if chain == "jax.shard_map":
+                problems.append(
+                    f"{rel}:{node.lineno}: jax.shard_map does not exist on "
+                    "this jax (the dead API that killed the multi-chip "
+                    "plane) — use parallel.mesh.device_collective, or "
+                    "jax.jit with shardings")
+            elif "shard_map" in chain.split(".") and not allowed:
+                problems.append(
+                    f"{rel}:{node.lineno}: shard_map reference outside "
+                    "parallel/mesh.py — per-device programs go through "
+                    "parallel.mesh.device_collective")
+        elif isinstance(node, (ast.Import, ast.ImportFrom)) and not allowed:
+            mod = getattr(node, "module", "") or ""
+            names = [a.name for a in node.names]
+            if "shard_map" in mod or any("shard_map" in n for n in names):
+                problems.append(
+                    f"{rel}:{node.lineno}: shard_map import outside "
+                    "parallel/mesh.py — per-device programs go through "
+                    "parallel.mesh.device_collective")
+        elif isinstance(node, ast.Call) and _is_mesh_ctor(node) \
+                and not allowed:
+            problems.append(
+                f"{rel}:{node.lineno}: raw Mesh(...) construction outside "
+                "parallel/mesh.py — build meshes via parallel.mesh "
+                "(make_mesh / mesh_from_grid / MeshPlane)")
+    return problems
+
+
+def _tracked_py_files(root: str) -> List[Tuple[str, str]]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in (".git", "__pycache__", ".pytest_cache",
+                                    "node_modules")]
+        for name in filenames:
+            if name.endswith(".py"):
+                path = os.path.join(dirpath, name)
+                out.append((path, os.path.relpath(path, root)))
+    return sorted(out)
+
+
+def check_repo(root: str) -> List[str]:
+    """Violations across every ``.py`` file under ``root``."""
+    problems: List[str] = []
+    for path, rel in _tracked_py_files(root):
+        problems.extend(check_file(path, rel))
+    return problems
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    problems = check_repo(root)
+    for p in problems:
+        print(p, file=sys.stderr)
+    if not problems:
+        print(f"ok: no dead shard_map API and no rogue mesh construction "
+              f"under {root}")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -212,6 +212,12 @@ KNOWN_DL4J_METRICS = {
     "dl4j_router_queue_wait_ms",
     "dl4j_router_latency_ms",
     "dl4j_router_endpoint_healthy",
+    # mesh plane (parallel/mesh.py MeshPlane): active named-axis
+    # topology (devices + per-axis size) and checkpoint restores that
+    # re-lowered saved shards onto a different mesh shape
+    "dl4j_mesh_devices",
+    "dl4j_mesh_axis_size",
+    "dl4j_mesh_restore_relayouts_total",
     # fault-tolerance plane (supervisor / quarantine / dead-letter /
     # checkpoint integrity — see monitor/__init__.py FAULT_* names)
     "dl4j_fault_events_total",

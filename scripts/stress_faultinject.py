@@ -54,11 +54,11 @@ def _scenario_log(seed: int) -> str:
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
-    from deeplearning4j_tpu.faultinject import (BurstKill,
+    from deeplearning4j_tpu.faultinject import (BurstKill, ChipFailure,
                                                 FailingDataSetIterator,
                                                 FlakyBroker, InjectedFault,
-                                                ModelPoison, ReplicaPoison,
-                                                TornWrites)
+                                                MeshShrink, ModelPoison,
+                                                ReplicaPoison, TornWrites)
     from deeplearning4j_tpu.streaming.broker import InMemoryBroker
 
     events: List[str] = []
@@ -153,6 +153,23 @@ def _scenario_log(seed: int) -> str:
                 except InjectedFault:
                     events.append(f"bk {i}/{lane} hit")
     events.append(f"bk hits={bk.hits} lane_hits={bk_lane.hits}")
+
+    # 6) mesh-shrink drill schedule (the MeshShrink/ChipFailure seam
+    # tests/test_mesh_plane.py arms against a real training loop): the
+    # failure STEP and the seeded SURVIVOR SET are pinned deterministic
+    # here — so the full drill's kill → checkpoint fallback → resume-on-
+    # smaller-mesh sequence replays identically across stress reruns
+    ms = MeshShrink(fail_at_step=seed % 4 + 1, survivors=4, total=8,
+                    seed=seed)
+    for i in range(8):
+        try:
+            idx = ms.step()
+            events.append(f"ms step {idx} ok")
+        except ChipFailure as e:
+            events.append(f"ms step {i} chipfail survivors="
+                          f"{list(e.survivor_ids)}")
+    events.append(f"ms survivors={list(ms.survivor_ids())} "
+                  f"fired={ms.fired} seen={ms.steps_seen}")
     return "\n".join(events)
 
 

@@ -17,7 +17,10 @@ boundary, not just DP):
         cross the process boundary every step
 
 Env (set by the spawner, BEFORE interpreter start): JAX_PLATFORMS=cpu,
-GRAFT_LOCAL_DEVICES=<M>, PALLAS_AXON_POOL_IPS removed.
+GRAFT_LOCAL_DEVICES=<M> mirrored into
+XLA_FLAGS=--xla_force_host_platform_device_count=<M> (the worker
+asserts the resulting device count — the count must never silently
+degrade to 1 again), PALLAS_AXON_POOL_IPS removed.
 """
 
 import os
@@ -30,9 +33,6 @@ assert mode in ("dp", "fsdp", "tp"), f"unknown mode {mode!r}"
 
 import jax  # noqa: E402
 
-jax.config.update("jax_num_cpu_devices",
-                  int(os.environ.get("GRAFT_LOCAL_DEVICES", "2")))
-
 import numpy as np  # noqa: E402
 
 from deeplearning4j_tpu.parallel import multihost  # noqa: E402
@@ -40,6 +40,21 @@ from deeplearning4j_tpu.parallel import multihost  # noqa: E402
 if nproc > 1:
     multihost.initialize(coordinator_address=f"127.0.0.1:{port}",
                          num_processes=nproc, process_id=pid)
+
+# re-assert the device count EXPLICITLY: the spawner sets XLA_FLAGS to
+# --xla_force_host_platform_device_count=<GRAFT_LOCAL_DEVICES> before
+# interpreter start (this jax has no jax_num_cpu_devices config — the
+# old spelling silently left the worker on ONE device). A mismatch here
+# means the env plumbing regressed and every "multi-host" assertion
+# below would be vacuous.
+_want_local = int(os.environ.get("GRAFT_LOCAL_DEVICES", "4"))
+assert len(jax.local_devices()) == _want_local, (
+    f"worker {pid}: expected {_want_local} local devices from XLA_FLAGS, "
+    f"got {len(jax.local_devices())} "
+    f"(XLA_FLAGS={os.environ.get('XLA_FLAGS')!r})")
+assert len(jax.devices()) == _want_local * nproc, (
+    f"worker {pid}: global mesh has {len(jax.devices())} devices, "
+    f"expected {_want_local * nproc}")
 
 import jax.numpy as jnp  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402

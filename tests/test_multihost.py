@@ -30,13 +30,19 @@ def _free_port() -> int:
     return port
 
 
-def _spawn(pid, nproc, port, out, local_devices=2, mode="dp"):
+def _spawn(pid, nproc, port, out, local_devices=4, mode="dp"):
     env = dict(os.environ)
     # the box's sitecustomize registers a TPU plugin at interpreter start
     # when this var is set — must be removed BEFORE the child starts
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
+    # the worker needs ITS OWN device count, not whatever the parent's
+    # XLA_FLAGS carries (conftest forces 8 — blindly popping the var,
+    # as this spawner used to, silently left the count to a jax config
+    # option this jax does not even have); set the flag explicitly and
+    # the worker re-asserts the resulting count after backend init
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local_devices}")
     env["GRAFT_LOCAL_DEVICES"] = str(local_devices)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.Popen(
@@ -46,8 +52,9 @@ def _spawn(pid, nproc, port, out, local_devices=2, mode="dp"):
 
 
 def _run_equivalence(tmp_path, mode):
-    """2 processes × 2 devices vs 1 process × 4 devices, same global
-    mesh semantics; final params must match."""
+    """2 processes × 4 devices vs 1 process × 8 devices — a REAL
+    8-device global mesh (the same width conftest forces in-process),
+    same global mesh semantics; final params must match."""
     port = _free_port()
     out_multi = str(tmp_path / f"multi_{mode}.npz")
     out_single = str(tmp_path / f"single_{mode}.npz")
@@ -57,7 +64,7 @@ def _run_equivalence(tmp_path, mode):
         stdout, stderr = p.communicate(timeout=540)
         assert p.returncode == 0, f"worker failed:\n{stdout}\n{stderr[-3000:]}"
 
-    single = _spawn(0, 1, port, out_single, local_devices=4, mode=mode)
+    single = _spawn(0, 1, port, out_single, local_devices=8, mode=mode)
     stdout, stderr = single.communicate(timeout=540)
     assert single.returncode == 0, f"single failed:\n{stdout}\n{stderr[-3000:]}"
 
