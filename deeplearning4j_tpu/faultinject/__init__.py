@@ -24,10 +24,18 @@ Injector ↔ fault domain map:
   every replica (multi-model domain: the per-model circuit breaker
   must quarantine the model, leave the replicas serving its cotenants,
   and probe it back once the poison clears);
-- :func:`kill_endpoint` / :class:`NetworkPartition` — abrupt engine
-  endpoint death and broker-level partitions (routing domain: the
-  InferenceRouter's heartbeat death detection, failover, ejection and
-  half-open reinstatement);
+- :func:`kill_endpoint` / :class:`NetworkPartition` /
+  :class:`WedgeEndpoint` — abrupt engine endpoint death, broker-level
+  partitions, and liveness-without-progress wedges (routing domain:
+  the InferenceRouter's heartbeat death detection, progress watchdog,
+  failover, ejection and half-open reinstatement, and decode-stream
+  migration);
+- :class:`ChaosSchedule` / :func:`run_chaos_drill`
+  (``faultinject/chaos.py``) — the COMPOSED drill: several injectors
+  on one seeded event clock against a 3-endpoint fleet under mixed
+  decode+classify load, asserting the global invariants (zero
+  lost/duplicated tokens, zero stranded futures, zero leaked KV
+  blocks, ``/healthz`` converges healthy) after drain;
 - :class:`MeshShrink` / :class:`ChipFailure` — chips dying out of the
   mesh plane mid-epoch (mesh domain: checkpoint fallback, MeshPlane
   rebuild from the survivors, ``restore_checkpoint(mesh=...)``
@@ -391,6 +399,48 @@ class BurstKill:
                 f"injected burst kill at dispatch {idx} (lane {lane_key})")
 
 
+class WedgeEndpoint:
+    """Wedge injector for the serving fleet: the named member keeps
+    heartbeating (liveness intact) but silently drops every consumed
+    request — zero progress with work queued, the failure mode a
+    heartbeat-only health plane can NEVER see. Context-managed so the
+    drill always unwedges::
+
+        with WedgeEndpoint(fleet, "engine-0"):
+            ...  # router's wedge watchdog must eject + migrate
+
+    The recovery contract under test: the router's progress watchdog
+    (``wedge_timeout_s``) observes flat ``resolved``/``served``/burst
+    counters while its own inflight count is nonzero, ejects the
+    endpoint exactly like a crash, and the endpoint's in-flight
+    requests resolve through timeout → failover (streams migrate with
+    their journaled prefix)."""
+
+    def __init__(self, fleet, name: str):
+        self.fleet = fleet
+        self.name = name
+        self.active = False
+
+    def wedge(self) -> "WedgeEndpoint":
+        self.fleet.wedge(self.name)
+        self.active = True
+        return self
+
+    def heal(self) -> None:
+        if self.active:
+            self.active = False
+            try:
+                self.fleet.unwedge(self.name)
+            except KeyError:
+                pass  # the member was removed while wedged
+
+    def __enter__(self) -> "WedgeEndpoint":
+        return self.wedge()
+
+    def __exit__(self, *exc) -> None:
+        self.heal()
+
+
 def kill_endpoint(fleet, name: str) -> str:
     """Process-kill injector for the serving fleet: abruptly stop the
     named endpoint's engine worker — consumed requests vanish without
@@ -459,3 +509,14 @@ class NetworkPartition(MessageBroker):
 
     def close(self) -> None:
         self._wrapped.close()
+
+
+# ------------------------------------------------------ composed drill
+# (imported last: chaos.py composes the injectors defined above)
+
+from deeplearning4j_tpu.faultinject.chaos import (  # noqa: E402,F401
+    ACTIONS as CHAOS_ACTIONS,
+    ChaosEvent,
+    ChaosSchedule,
+    run_chaos_drill,
+)

@@ -1,0 +1,449 @@
+"""Composed chaos drill: every injector on ONE seeded event clock.
+
+Each faultinject class proves one recovery path in isolation; real
+outages compose — an endpoint dies while another is wedged, a burst is
+killed during a heartbeat partition, a checkpoint install tears while
+the canary model is poisoned. :class:`ChaosSchedule` generates a
+deterministic event schedule from a seed (same seed ⇒ the same ticks,
+actions and targets, bit for bit) and :func:`run_chaos_drill` replays
+it against a live 3-endpoint serving fleet (thread-mode
+``LocalFleet`` + ``InferenceRouter``) under mixed decode-stream +
+classify load, composing:
+
+- ``kill`` — :func:`~deeplearning4j_tpu.faultinject.kill_endpoint`
+  (abrupt worker death; SIGKILL wire signature) + restart;
+- ``partition_hb`` — :class:`~deeplearning4j_tpu.faultinject.
+  NetworkPartition` black-holing one endpoint's heartbeats (the
+  router must pull it from the pool on staleness alone) + heal;
+- ``wedge`` — :class:`~deeplearning4j_tpu.faultinject.WedgeEndpoint`
+  (liveness without progress; the wedge watchdog's fault);
+- ``burst_kill`` — :class:`~deeplearning4j_tpu.faultinject.BurstKill`
+  under a live decode stream (typed ``DecodeBurstError`` → the stream
+  MIGRATES with its journaled prefix);
+- ``replica_poison`` / ``poison_model`` — scheduled device faults on
+  one replica / one model (quarantine + breaker + probe heal);
+- ``torn_write`` — :class:`~deeplearning4j_tpu.faultinject.TornWrites`
+  crashing a checkpoint install mid-drill (the previous artifact must
+  survive and restore).
+
+The drill's verdict is a set of GLOBAL invariants checked after drain,
+and they are the whole point: **no request ever observes the
+failure** — every submitted future resolves (zero stranded), every
+decode stream delivers exactly the uninterrupted token sequence (zero
+lost, zero duplicated offsets — greedy and seeded-sampled pinned
+against ``generate_eager``), every KV pool drains back to fully free
+(zero leaked blocks), and the fleet converges healthy. The returned
+summary contains only schedule- and invariant-valued fields, so a
+passing drill is bitwise-deterministic across reruns — the contract
+``scripts/stress_faultinject.py --chaos`` enforces in fresh
+subprocesses with rotating seeds.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: the composable action set, index-addressed by the seeded schedule
+ACTIONS: Tuple[str, ...] = ("kill", "partition_hb", "wedge", "burst_kill",
+                            "replica_poison", "poison_model", "torn_write")
+
+
+class ChaosEvent:
+    """One scheduled fault: fire at request-count ``tick`` against
+    endpoint ``target``; disruptive actions heal at ``heal_tick`` (the
+    event clock is the open-loop submission counter, not wall time —
+    that is what makes the schedule replayable)."""
+
+    __slots__ = ("tick", "action", "target", "heal_tick")
+
+    def __init__(self, tick: int, action: str, target: int,
+                 heal_tick: int):
+        self.tick = int(tick)
+        self.action = action
+        self.target = int(target)
+        self.heal_tick = int(heal_tick)
+
+    def __repr__(self) -> str:
+        return (f"{self.action}@{self.tick}->e{self.target}"
+                f"(heal@{self.heal_tick})")
+
+
+class ChaosSchedule:
+    """Seeded, deterministic composition schedule. Same
+    ``(seed, n_events, n_endpoints, actions)`` ⇒ the identical event
+    list — the replay contract every stress rerun pins."""
+
+    def __init__(self, seed: int, n_events: int = 6, n_endpoints: int = 3,
+                 actions: Tuple[str, ...] = ACTIONS,
+                 min_gap: int = 2, max_gap: int = 4):
+        self.seed = int(seed)
+        self.n_endpoints = int(n_endpoints)
+        rng = random.Random(self.seed * 7919 + 13)
+        tick = 0
+        self.events: List[ChaosEvent] = []
+        for _ in range(int(n_events)):
+            tick += rng.randint(int(min_gap), int(max_gap))
+            action = actions[rng.randrange(len(actions))]
+            target = rng.randrange(self.n_endpoints)
+            self.events.append(
+                ChaosEvent(tick, action, target, tick + rng.randint(1, 2)))
+
+    def signature(self) -> str:
+        return ";".join(repr(e) for e in self.events)
+
+
+class _StreamCollector:
+    """Per-stream delivery audit: tokens must arrive append-only —
+    offset == len(received) on every delivery, across migrations."""
+
+    def __init__(self):
+        self.tokens: List[int] = []
+        self.dups = 0
+        self.gaps = 0
+
+    def __call__(self, off, toks) -> None:
+        import numpy as np
+        for i, t in enumerate(np.asarray(toks).reshape(-1).tolist()):
+            idx = int(off) + i
+            if idx < len(self.tokens):
+                self.dups += 1
+            elif idx == len(self.tokens):
+                self.tokens.append(int(t))
+            else:
+                self.gaps += 1
+
+
+def _clf_net(n_in: int, n_out: int):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(11).learning_rate(0.05)
+            .updater("adam").activation("tanh")
+            .list()
+            .layer(DenseLayer(n_in=n_in, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=n_out, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def run_chaos_drill(seed: int = 0, n_requests: int = 16, n_events: int = 4,
+                    max_new: int = 6, timeout_s: float = 120.0,
+                    per_try_timeout_s: float = 4.0,
+                    wedge_timeout_s: float = 1.0,
+                    pace_s: float = 0.02) -> Dict[str, Any]:
+    """Run the composed drill; returns the invariant summary (see the
+    module docstring). Deterministic by construction when it passes:
+    every field is either derived from the seeded schedule or pinned
+    to an invariant value by the assertions the caller makes."""
+    import numpy as np
+
+    from deeplearning4j_tpu.faultinject import (BurstKill, InjectedFault,
+                                                NetworkPartition,
+                                                TornWrites, kill_endpoint,
+                                                poison_model, poison_replica)
+    from deeplearning4j_tpu.models.zoo.transformer import gpt
+    from deeplearning4j_tpu.nn.generate import generate_eager
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving import (InferenceRouter, LocalFleet,
+                                            ModelRegistry, RetryAfter)
+    from deeplearning4j_tpu.util.model_serializer import (restore_model,
+                                                          write_model)
+
+    vocab, n_in, n_cls = 11, 6, 3
+    lm = gpt(vocab_size=vocab, d_model=16, n_layers=2, num_heads=2,
+             max_len=32, compute_dtype="float32", learning_rate=0.01,
+             seed=0).init()
+    clf = _clf_net(n_in, n_cls)
+    schedule = ChaosSchedule(seed, n_events=n_events, n_endpoints=3)
+    rng = np.random.default_rng(int(seed) * 104729 + 7)
+
+    engines: List[ParallelInference] = []
+
+    def engine_factory():
+        mreg = ModelRegistry()
+        mreg.register("lm", net=lm)
+        mreg.register("clf", net=clf)
+        eng = ParallelInference(registry=mreg, replicas=1,
+                                max_batch_size=8, max_latency_ms=1.0,
+                                queue_capacity=512, continuous=True,
+                                decode_slots=4, decode_burst=4,
+                                kv_block_size=4)
+        engines.append(eng)
+        return eng
+
+    router = InferenceRouter(per_try_timeout_s=per_try_timeout_s,
+                             eject_backoff_s=0.1, max_attempts=6,
+                             wedge_timeout_s=wedge_timeout_s)
+    fleet = LocalFleet(engine_factory, router=router, heartbeat_s=0.05,
+                       request_timeout_s=per_try_timeout_s,
+                       heartbeat_timeout_s=0.5)
+    for _ in range(3):
+        fleet.add_endpoint()
+    fleet.wait_ready(30)
+    names = fleet.names()
+    # pre-arm a heartbeat partition per endpoint (swapped in as the
+    # endpoint's hb consumer so one side can be cut live)
+    partitions = {}
+    for name in names:
+        part = NetworkPartition(fleet._broker,
+                                topic_substr=name + ".hb", silent=True)
+        fleet.endpoint(name)._hb_broker = part
+        partitions[name] = part
+
+    killed: Dict[str, bool] = {}
+    ckpt_fallback_ok: Optional[bool] = None
+    ckpt_dir = tempfile.mkdtemp(prefix="dl4j-chaos-")
+    ckpt_path = os.path.join(ckpt_dir, "unit-model.zip")
+    write_model(clf, ckpt_path)
+
+    def _engine_of(name: str):
+        m = fleet._members.get(name)
+        return None if m is None or m.worker is None else m.worker.engine
+
+    def apply(ev: ChaosEvent) -> Callable[[], None]:
+        """Fire one event; returns its heal thunk (no-op when the
+        injector self-limits)."""
+        nonlocal ckpt_fallback_ok
+        name = names[ev.target % len(names)]
+        if ev.action == "kill":
+            if killed.get(name):
+                fleet.restart(name)
+                killed[name] = False
+                return lambda: None
+            kill_endpoint(fleet, name)
+            killed[name] = True
+
+            def heal_kill():
+                if killed.get(name):
+                    fleet.restart(name)
+                    killed[name] = False
+            return heal_kill
+        if ev.action == "partition_hb":
+            part = partitions[name].partition()
+            return part.heal
+        if ev.action == "wedge":
+            if killed.get(name):
+                return lambda: None
+            fleet.wedge(name)
+            return lambda: fleet.unwedge(name)
+        if ev.action == "burst_kill":
+            eng = _engine_of(name)
+            if eng is not None and not eng._closed:
+                hook = BurstKill(after=0, failures=1)
+                if eng._scheduler is not None:
+                    eng._scheduler._burst_hook = hook
+                else:
+                    eng._decode_burst_hook = hook
+            return lambda: None
+        if ev.action == "replica_poison":
+            eng = _engine_of(name)
+            if eng is not None and not eng._closed:
+                poison_replica(eng, replica=0, failures=2)
+            return lambda: None
+        if ev.action == "poison_model":
+            eng = _engine_of(name)
+            if eng is not None and not eng._closed:
+                poison_model(eng, "clf")
+            return lambda: None
+        if ev.action == "torn_write":
+            # checkpoint domain, composed in: the install crashes
+            # between tmp write and rename; the PREVIOUS artifact must
+            # survive and restore
+            try:
+                with TornWrites(crash_on_call=1, path_substr="unit-model"):
+                    write_model(clf, ckpt_path)
+            except InjectedFault:
+                pass
+            try:
+                restore_model(ckpt_path)
+                ok = True
+            except BaseException:
+                ok = False
+            ckpt_fallback_ok = ok if ckpt_fallback_ok is None \
+                else (ckpt_fallback_ok and ok)
+            return lambda: None
+        raise ValueError(f"unknown chaos action {ev.action!r}")
+
+    # ---- open-loop load on the event clock ------------------------------
+    pending_events = list(schedule.events)
+    pending_heals: List[Tuple[int, Callable[[], None]]] = []
+    futs: List[list] = []  # [kind, fut, oracle, collector, request]
+    submitted = 0
+
+    def _fire(r: Dict[str, Any], attempt: int = 0):
+        """(future, collector) for one dispatch of a logical request;
+        a retry gets a FRESH stream/session so its delivery audit
+        stands alone."""
+        if r["kind"] == "decode":
+            coll = _StreamCollector()
+            fut = router.submit_generate(
+                r["x"], max_new, temperature=r["temp"], seed=r["seed"],
+                model="lm", session=f"chaos-{r['seed']}-{attempt}",
+                on_tokens=coll)
+            return fut, coll
+        return router.submit(r["x"], model="clf"), None
+
+    try:
+        for tick in range(n_requests):
+            for _, heal in [h for h in pending_heals if h[0] <= tick]:
+                heal()
+            pending_heals = [h for h in pending_heals if h[0] > tick]
+            for ev in [e for e in pending_events if e.tick <= tick]:
+                pending_heals.append((ev.heal_tick, apply(ev)))
+            pending_events = [e for e in pending_events if e.tick > tick]
+
+            decode = tick % 2 == 0
+            if decode:
+                t0 = int(rng.integers(3, 6))
+                prompt = rng.integers(1, vocab, (1, t0))
+                temp = 0.7 if tick % 4 == 0 else 0.0
+                oracle = generate_eager(lm, prompt, max_new,
+                                        temperature=temp, seed=tick)
+                req = {"kind": "decode", "x": prompt, "temp": temp,
+                       "seed": tick, "oracle": oracle}
+            else:
+                x = rng.standard_normal((1, n_in)).astype(np.float32)
+                req = {"kind": "classify", "x": x,
+                       "oracle": np.asarray(clf.output(x))}
+
+            for _ in range(200):  # shed ⇒ bounded retry-after loop
+                try:
+                    fut, coll = _fire(req)
+                    futs.append([req["kind"], fut, req["oracle"], coll,
+                                 req])
+                    submitted += 1
+                    break
+                except RetryAfter:
+                    time.sleep(0.05)
+            time.sleep(pace_s)
+
+        # ---- heal the world, then drain ---------------------------------
+        for _, heal in pending_heals:
+            heal()
+        for ev in pending_events:  # events past the last tick: skipped
+            pass
+        for name in names:
+            partitions[name].heal()
+            try:
+                fleet.unwedge(name)
+            except BaseException:
+                pass
+            if killed.get(name):
+                fleet.restart(name)
+                killed[name] = False
+        router.probe_now()
+        for eng in engines:
+            if not eng._closed:
+                try:
+                    eng.probe_now()
+                except BaseException:
+                    pass
+
+        deadline = time.monotonic() + timeout_s
+        for entry in futs:
+            try:
+                entry[1].result(
+                    timeout=max(0.1, deadline - time.monotonic()))
+            except BaseException:
+                pass
+        # a request that exhausted its failover budget WHILE every
+        # endpoint was simultaneously bad fails typed — correct router
+        # behavior (fail fast, never strand). The world is healed now,
+        # so the drill does what any real client does with a typed
+        # failure: bounded resubmission. The zero-lost/zero-dup audit
+        # applies to each delivered stream (the final attempt).
+        for retry_round in range(1, 4):
+            pending = [e for e in futs
+                       if e[1].done() and e[1].exception() is not None]
+            if not pending:
+                break
+            for entry in pending:
+                for _ in range(100):
+                    try:
+                        entry[1], entry[3] = _fire(entry[4], retry_round)
+                        break
+                    except RetryAfter:
+                        time.sleep(0.05)
+            for entry in pending:
+                try:
+                    entry[1].result(
+                        timeout=max(0.1, deadline - time.monotonic()))
+                except BaseException:
+                    pass
+        failed = sum(1 for _, f, _, _, _ in futs
+                     if f.done() and f.exception() is not None)
+        stranded = sum(1 for _, f, _, _, _ in futs if not f.done())
+
+        mismatches = 0
+        dup_offsets = 0
+        gap_events = 0
+        for kind, fut, oracle, coll, _r in futs:
+            if not fut.done() or fut.exception() is not None:
+                continue
+            got = np.asarray(fut.result())
+            if not np.array_equal(got, oracle):
+                mismatches += 1
+            if coll is not None:
+                dup_offsets += coll.dups
+                gap_events += coll.gaps
+                if coll.tokens != [int(t) for t in oracle[0, -max_new:]]:
+                    mismatches += 1
+
+        # ---- healthz convergence: traffic probes the half-open pool -----
+        healthy = 0
+        conv_deadline = time.monotonic() + 30
+        x = rng.standard_normal((1, n_in)).astype("float32")
+        while time.monotonic() < conv_deadline:
+            router.probe_now()
+            try:
+                router.output(x, model="clf", timeout=10)
+            except BaseException:
+                pass
+            snap = router.fleet_snapshot()
+            healthy = snap["healthy_endpoints"]
+            if healthy >= 3:
+                break
+            time.sleep(0.05)
+
+        # ---- zero leaked KV blocks, across EVERY engine ever alive ------
+        leaked = 0
+        for eng in engines:
+            if not eng._closed:
+                eng.drain(timeout=30)
+            sched = eng._scheduler
+            if sched is None:
+                continue
+            free_deadline = time.monotonic() + 10
+            while time.monotonic() < free_deadline:
+                pool = sched.stats()["pool"]
+                if pool["blocks_free"] >= pool["blocks_total"]:
+                    break
+                time.sleep(0.02)
+            pool = sched.stats()["pool"]
+            leaked += int(pool["blocks_total"] - pool["blocks_free"])
+    finally:
+        try:
+            fleet.shutdown(drain=False)
+        except BaseException:
+            pass
+        router.close()
+
+    return {
+        "seed": int(seed),
+        "schedule": schedule.signature(),
+        "submitted": submitted,
+        "completed": submitted - failed - stranded,
+        "failed": failed,
+        "stranded_futures": stranded,
+        "token_mismatches": mismatches,
+        "dup_offsets": dup_offsets,
+        "gap_events": gap_events,
+        "leaked_blocks": leaked,
+        "healthy_endpoints": healthy,
+        "ckpt_fallback_ok": ckpt_fallback_ok,
+    }

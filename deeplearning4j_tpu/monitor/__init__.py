@@ -163,6 +163,21 @@ ROUTER_QUEUE_WAIT_HISTOGRAM = "dl4j_router_queue_wait_ms"
 ROUTER_LATENCY_HISTOGRAM = "dl4j_router_latency_ms"
 ROUTER_ENDPOINT_HEALTHY_GAUGE = "dl4j_router_endpoint_healthy"
 
+# Durable decode streams (the stream/journal/migration plane):
+# incremental token chunks emitted by the decode path (the
+# ``on_tokens`` seam — scheduler bursts, whole-burst terminal deltas),
+# decode-session migrations by ``reason`` (timeout / burst_error /
+# endpoint_error / wedged / drain / endpoint_lost — the router re-pins
+# the stream and re-submits prompt + received prefix as a resume
+# request), the live byte size of the router's per-stream token
+# journals (what a migration would re-prefill), and the cumulative
+# prefix tokens re-submitted by migrations (the resume cost: prefix
+# re-prefill instead of full re-generation).
+STREAM_CHUNKS_COUNTER = "dl4j_stream_chunks_total"
+SESSION_MIGRATIONS_COUNTER = "dl4j_session_migrations_total"
+SESSION_JOURNAL_BYTES_GAUGE = "dl4j_session_journal_bytes"
+ROUTER_RESUME_PREFIX_COUNTER = "dl4j_router_resume_prefix_tokens_total"
+
 # Multi-model serving plane (serving/registry.py ModelRegistry + the
 # multi-model ParallelInference): per-model request/error volume and
 # latency (labeled ``model=``), lifecycle events — deploys by

@@ -580,7 +580,10 @@ class ParallelInference:
                         seed: int = 0, model: Optional[str] = None,
                         version: Optional[int] = None,
                         session: Optional[str] = None,
-                        priority: int = 0) -> "Future[np.ndarray]":
+                        priority: int = 0,
+                        on_tokens=None,
+                        prefix: Optional[np.ndarray] = None
+                        ) -> "Future[np.ndarray]":
         """Enqueue one decode request (``prompt_ids``: [n, t0] int
         tokens); the Future resolves to the [n, t0 + max_new_tokens]
         ids a solo ``net.generate`` of the same rows would return.
@@ -591,7 +594,16 @@ class ParallelInference:
         PRNG keys make a request's draws coalescing-invariant. A
         ``session`` pins the (model, version) its first burst resolved
         — later bursts of the stream stay on that version through any
-        deploy (the KV state lives with the version's programs)."""
+        deploy (the KV state lives with the version's programs).
+
+        ``on_tokens(offset, tokens)`` (single-row requests) streams
+        incremental token deltas: the continuous scheduler emits one
+        chunk per retiring burst; the whole-burst path emits one
+        terminal chunk when the burst resolves (a single-chunk stream —
+        same contract, coarser granularity). ``prefix`` resumes a
+        migrated stream from prompt + already-generated tokens; it
+        rides the continuous scheduler's preempt/resume machinery and
+        therefore requires ``continuous=True``."""
         if self._closed:
             raise RuntimeError("ParallelInference is shut down")
         from deeplearning4j_tpu.nn.generate import row_keys, sampler_sig
@@ -609,13 +621,22 @@ class ParallelInference:
             return self._continuous_scheduler().submit(
                 prompt_ids, max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_token=eos_token, seed=seed,
-                priority=priority, model=model, version=v, session=session)
+                priority=priority, model=model, version=v, session=session,
+                on_tokens=on_tokens, prefix=prefix)
+        if prefix is not None:
+            raise ValueError(
+                "prefix resume rides the iteration-level preempt/resume "
+                "machinery: build the engine with continuous=True")
         gen = self._generator() if mv is None else mv.generator()
         prompt = np.asarray(prompt_ids)
         if prompt.ndim != 2:
             raise ValueError(
                 f"prompt_ids must be [n, t0] int tokens, got {prompt.shape}")
         n, t_in = prompt.shape
+        if on_tokens is not None and n != 1:
+            raise ValueError(
+                f"token streaming is per-stream: prompt must be [1, t0], "
+                f"got {prompt.shape}")
         max_new = int(max_new_tokens)
         t_pad = gen.prompt_bucket(t_in, max_new)
         ids = np.zeros((n, t_pad), np.int32)
@@ -624,10 +645,29 @@ class ParallelInference:
         keys = np.asarray(row_keys(seed, n))
         self._reg().counter(DECODE_REQUESTS_COUNTER,
                             "generate() requests").inc()
-        return self._enqueue(_GenRequest(
+        fut = self._enqueue(_GenRequest(
             ids, lengths, keys, t_in, max_new,
             sampler_sig(temperature, top_k, top_p, eos_token),
             model, v, coalescible))
+        if on_tokens is not None:
+            # whole-burst streaming degrades to ONE terminal chunk: the
+            # first token only exists when the whole scan resolves
+            from deeplearning4j_tpu.monitor import STREAM_CHUNKS_COUNTER
+
+            def _emit(f, t0=t_in):
+                if f.exception() is not None:
+                    return
+                self._reg().counter(
+                    STREAM_CHUNKS_COUNTER,
+                    "Incremental decode-token chunks emitted through "
+                    "the on_tokens streaming seam").inc()
+                try:
+                    on_tokens(0, np.asarray(f.result())[0, t0:]
+                              .astype(np.int64))
+                except BaseException:
+                    pass  # consumer bug; the Future already carries all
+            fut.add_done_callback(_emit)
+        return fut
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  timeout: Optional[float] = None, **kwargs) -> np.ndarray:
@@ -788,6 +828,7 @@ class ParallelInference:
             sessions = len(self._session_versions)
             out = {
                 "requests": self._requests,
+                "resolved": self._resolved,
                 "batches": self._batches,
                 "rows_dispatched": rows,
                 "rows_padded": padded,

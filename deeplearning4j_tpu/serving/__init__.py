@@ -15,6 +15,12 @@ sheds), decode session affinity, and
 :class:`~deeplearning4j_tpu.serving.policy.ScalePolicy`-driven
 autoscaling applied by :class:`~deeplearning4j_tpu.serving.fleet.
 LocalFleet`.
+
+Decode streams are DURABLE: ``submit_generate(on_tokens=...)`` streams
+wire-v2 token chunks, the router journals them per stream, and an
+engine death mid-generation migrates the stream (re-pin + resume from
+prompt + journaled prefix) with append-only delivery — no lost, no
+duplicated token, output equal to an uninterrupted run.
 """
 
 from deeplearning4j_tpu.serving.continuous import (  # noqa: F401
@@ -43,5 +49,9 @@ from deeplearning4j_tpu.serving.policy import (  # noqa: F401
 from deeplearning4j_tpu.serving.router import (  # noqa: F401
     InferenceRouter,
     RetryAfter,
+)
+from deeplearning4j_tpu.serving.wire import (  # noqa: F401
+    WIRE_VERSION,
+    WireVersionError,
 )
 from deeplearning4j_tpu.serving.worker import EngineWorker  # noqa: F401

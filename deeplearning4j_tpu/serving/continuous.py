@@ -65,6 +65,7 @@ from deeplearning4j_tpu.monitor import (
     SCHED_PREEMPTIONS_COUNTER,
     SCHED_QUEUED_GAUGE,
     SCHED_RETIRED_COUNTER,
+    STREAM_CHUNKS_COUNTER,
     get_registry,
     mark,
     record_fault,
@@ -95,16 +96,23 @@ class KVPoolExhausted(RuntimeError):
 
 class _DecodeRequest:
     """One ``submit()`` — n prompt rows sharing a sampler/seed; the
-    Future resolves to [n, t0 + max_new] ids once every row retires."""
+    Future resolves to [n, t0 + max_new] ids once every row retires.
+    ``on_tokens`` (single-row streams only) receives ``(offset,
+    tokens)`` deltas as bursts retire; ``prefix`` seeds a RESUME — the
+    row re-prefills prompt + prefix and its PRNG clock starts at
+    ``len(prefix)``, so the continuation is token-for-token what an
+    uninterrupted run would have produced (and offsets continue after
+    the prefix, never re-emitting delivered tokens)."""
 
     __slots__ = ("prompt", "n", "t_in", "max_new", "temperature", "top_k",
                  "top_p", "eos", "seed", "priority", "model", "version",
                  "session", "future", "rows_done", "t_submit", "t_first",
-                 "rows")
+                 "rows", "on_tokens", "prefix")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float,
                  top_k: int, top_p: float, eos: Optional[int], seed: int,
-                 priority: int, model, version, session):
+                 priority: int, model, version, session,
+                 on_tokens=None, prefix: Optional[np.ndarray] = None):
         self.prompt = np.asarray(prompt, np.int64)
         self.n, self.t_in = self.prompt.shape
         self.max_new = int(max_new)
@@ -117,6 +125,8 @@ class _DecodeRequest:
         self.model = model
         self.version = version
         self.session = session
+        self.on_tokens = on_tokens
+        self.prefix = prefix  # [p] int64 generated-so-far (row 0)
         self.future: "Future[np.ndarray]" = Future()
         self.rows_done = 0
         self.t_submit = time.perf_counter()
@@ -133,7 +143,7 @@ class _Seq:
     draws identical to an uninterrupted run."""
 
     __slots__ = ("req", "row", "fed", "generated", "key", "n_gen", "slot",
-                 "blocks", "pos", "seq_id", "preemptions")
+                 "blocks", "pos", "seq_id", "preemptions", "emitted")
 
     def __init__(self, req: _DecodeRequest, row: int, key: np.ndarray,
                  seq_id: int):
@@ -148,6 +158,17 @@ class _Seq:
         self.pos = 0
         self.seq_id = seq_id
         self.preemptions = 0
+        # tokens already delivered through on_tokens — the append-only
+        # stream cursor. A resume request starts it at len(prefix):
+        # those tokens were delivered by the engine the stream migrated
+        # off, so re-emitting them would violate no-repeat.
+        self.emitted = 0
+        if req.prefix is not None and len(req.prefix):
+            pre = np.asarray(req.prefix, np.int32)
+            self.fed = np.concatenate([self.fed, pre])
+            self.generated = [int(t) for t in pre]
+            self.n_gen = len(self.generated)
+            self.emitted = self.n_gen
 
     @property
     def priority(self) -> int:
@@ -314,13 +335,26 @@ class ContinuousDecodeScheduler:
                eos_token: Optional[int] = None, seed: int = 0,
                priority: int = 0, model: Optional[str] = None,
                version: Optional[int] = None,
-               session: Optional[str] = None) -> "Future[np.ndarray]":
+               session: Optional[str] = None,
+               on_tokens=None,
+               prefix: Optional[np.ndarray] = None) -> "Future[np.ndarray]":
         """Enqueue one decode request; the Future resolves to the
         [n, t0 + max_new_tokens] ids a solo ``net.generate`` of the
         same rows would return (greedy: token-for-token; sampled: the
         same seeded draws regardless of admission timing, cotenants,
         or preemptions). Higher ``priority`` sequences are preempted
-        last."""
+        last.
+
+        ``on_tokens(offset, tokens)`` (single-row requests only) is the
+        incremental streaming seam: as bursts retire, the row's new
+        tokens are delivered tagged with their sequence offset —
+        append-only, no gap, no repeat, across preemptions included.
+        ``prefix`` (single-row) makes this a RESUME request: the row
+        re-prefills prompt + prefix, its PRNG clock starts at
+        ``len(prefix)``, and ``max_new_tokens`` still counts the TOTAL
+        generated tokens (prefix included) — the cross-engine migration
+        contract: a resumed stream's tokens equal an uninterrupted
+        run's, with the delivered prefix never re-emitted."""
         if self._closed:
             raise RuntimeError("ContinuousDecodeScheduler is shut down")
         prompt = np.asarray(prompt_ids)
@@ -330,12 +364,34 @@ class ContinuousDecodeScheduler:
         max_new = int(max_new_tokens)
         if max_new < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        pre = None
+        if prefix is not None:
+            pre = np.asarray(prefix, np.int64).reshape(-1)
+        if (on_tokens is not None or pre is not None) and prompt.shape[0] != 1:
+            raise ValueError(
+                "token streaming / prefix resume are per-stream: "
+                f"prompt must be [1, t0], got {prompt.shape}")
+        if pre is not None and len(pre) >= max_new:
+            # every token was already generated before the migration —
+            # only the terminal frame was lost; synthesize it
+            out = np.concatenate(
+                [np.asarray(prompt, np.int64), pre[None, :max_new]], axis=1)
+            req = _DecodeRequest(prompt, max_new, temperature, top_k, top_p,
+                                 eos_token, seed, priority, model, version,
+                                 session, on_tokens, pre)
+            with self._cv:
+                self._accepted += 1
+            req.future.set_result(out)
+            self._count_resolved()
+            return req.future
         lane = self._lane_for(model, version)
-        # validates prompt-length/max_new against the net's context
-        lane.gen.prompt_bucket(prompt.shape[1], max_new)
+        # validates prompt(+prefix)-length/max_new against the context
+        lane.gen.prompt_bucket(
+            prompt.shape[1] + (0 if pre is None else len(pre)),
+            max(1, max_new - (0 if pre is None else len(pre))))
         req = _DecodeRequest(prompt, max_new, temperature, top_k, top_p,
                              eos_token, seed, priority, model, version,
-                             session)
+                             session, on_tokens, pre)
         keys = np.asarray(row_keys(req.seed, req.n))
         with self._cv:
             if len(self._queue) + req.n > self.queue_capacity:
@@ -716,6 +772,28 @@ class ContinuousDecodeScheduler:
         for i, (seq, blocks) in enumerate(entries):
             self._install(lane, seq, blocks, int(toks[i]))
 
+    def _emit_tokens(self, seq: _Seq) -> None:
+        """Deliver the row's not-yet-delivered tokens through the
+        request's ``on_tokens`` seam, tagged with their stream offset.
+        Append-only by construction: ``seq.emitted`` only advances, so
+        a preempted-and-resumed (or migrated-in) row never re-delivers.
+        A callback error is the CONSUMER's bug — it must not take the
+        scheduler (and every cotenant stream) down with it."""
+        req = seq.req
+        if req.on_tokens is None or seq.emitted >= len(seq.generated):
+            return
+        off = seq.emitted
+        new = seq.generated[off:]
+        seq.emitted = len(seq.generated)
+        get_registry().counter(
+            STREAM_CHUNKS_COUNTER,
+            "Incremental decode-token chunks emitted through the "
+            "on_tokens streaming seam").inc()
+        try:
+            req.on_tokens(off, np.asarray(new, np.int64))
+        except BaseException as e:
+            mark("stream_callback_error", error=type(e).__name__)
+
     def _install(self, lane: _Lane, seq: _Seq, blocks: List[int],
                  tok0: int) -> None:
         req = seq.req
@@ -724,6 +802,7 @@ class ContinuousDecodeScheduler:
         seq.generated.append(tok0)
         seq.n_gen += 1
         self._note_first_token(req)
+        self._emit_tokens(seq)
         self._admitted_rows += 1
         get_registry().counter(
             SCHED_ADMITTED_COUNTER,
@@ -969,6 +1048,7 @@ class ContinuousDecodeScheduler:
                 seq.n_gen = int(n_gen[slot])
                 seq.pos = int(pos[slot])
                 self._note_first_token(seq.req)
+                self._emit_tokens(seq)
             lane.tok[slot] = tok[slot]
             lane.pos[slot] = pos[slot]
             lane.n_gen[slot] = n_gen[slot]

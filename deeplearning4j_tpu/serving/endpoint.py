@@ -68,7 +68,13 @@ class EngineEndpoint:
                         model: Optional[str] = None,
                         version: Optional[int] = None,
                         session: Optional[str] = None,
+                        on_tokens=None,
+                        prefix: Optional[np.ndarray] = None,
                         **kwargs) -> "Future[np.ndarray]":
+        """``on_tokens(offset, tokens)`` streams incremental decode
+        chunks (wire v2); ``prefix`` resumes a migrated stream from
+        prompt + already-delivered tokens. Both optional — a plain
+        endpoint serves whole replies."""
         raise NotImplementedError
 
     def stats(self) -> Dict[str, Any]:
@@ -103,9 +109,12 @@ class LocalEndpoint(EngineEndpoint):
 
     def submit_generate(self, prompt_ids, max_new_tokens,
                         timeout_s=None, model=None, version=None,
-                        session=None, **kwargs):
+                        session=None, on_tokens=None, prefix=None,
+                        **kwargs):
         kw = {k: v for k, v in (("model", model), ("version", version),
-                                ("session", session)) if v is not None}
+                                ("session", session),
+                                ("on_tokens", on_tokens),
+                                ("prefix", prefix)) if v is not None}
         return self.engine.submit_generate(prompt_ids, max_new_tokens,
                                            **kw, **kwargs)
 
@@ -124,11 +133,14 @@ class LocalEndpoint(EngineEndpoint):
 
 
 class _Pending:
-    __slots__ = ("future", "deadline")
+    __slots__ = ("future", "deadline", "timeout", "on_tokens")
 
-    def __init__(self, future: Future, deadline: float):
+    def __init__(self, future: Future, deadline: float, timeout: float,
+                 on_tokens=None):
         self.future = future
         self.deadline = deadline
+        self.timeout = timeout   # per-chunk silence budget (streams)
+        self.on_tokens = on_tokens
 
 
 class RemoteEndpoint(EngineEndpoint):
@@ -183,15 +195,17 @@ class RemoteEndpoint(EngineEndpoint):
                       timeout_s: Optional[float],
                       model: Optional[str] = None,
                       version: Optional[int] = None,
-                      session: Optional[str] = None) -> "Future[np.ndarray]":
+                      session: Optional[str] = None,
+                      on_tokens=None) -> "Future[np.ndarray]":
         if self._closed:
             raise EndpointError(f"endpoint {self.name} is closed")
         corr = f"{self.name}-{next(self._ids)}"
         fut: "Future[np.ndarray]" = Future()
-        deadline = time.monotonic() + (timeout_s if timeout_s is not None
-                                       else self.request_timeout)
+        timeout = (timeout_s if timeout_s is not None
+                   else self.request_timeout)
+        deadline = time.monotonic() + timeout
         with self._lock:
-            self._pending[corr] = _Pending(fut, deadline)
+            self._pending[corr] = _Pending(fut, deadline, timeout, on_tokens)
         try:
             self._broker.publish(
                 self.service + wire.REQ_SUFFIX,
@@ -214,13 +228,23 @@ class RemoteEndpoint(EngineEndpoint):
                         temperature: float = 0.0, top_k: int = 0,
                         top_p: float = 0.0, eos_token: Optional[int] = None,
                         seed: int = 0, model=None, version=None,
-                        session=None):
+                        session=None, on_tokens=None, prefix=None):
         gen = {"max_new": int(max_new_tokens), "temperature": temperature,
                "top_k": top_k, "top_p": top_p, "eos_token": eos_token,
                "seed": seed}
+        if on_tokens is not None:
+            # wire v2: ask the worker for chunked token deltas; each
+            # chunk also refreshes this request's silence deadline, so
+            # a long stream never times out WHILE it is progressing
+            gen["stream"] = True
+        if prefix is not None:
+            # resume request: the worker re-prefills prompt + prefix
+            # and continues the stream's PRNG clock (no re-generation
+            # of delivered tokens, no re-emission of their offsets)
+            gen["prefix"] = [int(t) for t in np.asarray(prefix).reshape(-1)]
         return self._submit_frame(wire.KIND_GENERATE,
                                   np.asarray(prompt_ids), gen, timeout_s,
-                                  model, version, session)
+                                  model, version, session, on_tokens)
 
     # ----------------------------------------------------------- health
 
@@ -265,6 +289,28 @@ class RemoteEndpoint(EngineEndpoint):
                 except Exception as e:
                     logger.warning("endpoint %s: undecodable reply (%s)",
                                    self.name, e)
+                    continue
+                if wire.is_chunk(header):
+                    # incremental decode chunk: deliver WITHOUT
+                    # resolving the future, and refresh the request's
+                    # silence deadline — visible progress is proof the
+                    # stream is alive, so only a stalled stream can
+                    # time out. A chunk for an already-swept request is
+                    # dropped here (the caller migrated past it).
+                    with self._lock:
+                        p = self._pending.get(header.get("id"))
+                        if p is not None:
+                            self._hb_at = time.monotonic()
+                            p.deadline = time.monotonic() + p.timeout
+                    if p is not None and p.on_tokens is not None \
+                            and result is not None:
+                        try:
+                            p.on_tokens(int(header.get("off", 0)), result)
+                        except BaseException as e:
+                            logger.warning(
+                                "endpoint %s: on_tokens callback failed "
+                                "(%s: %s)", self.name, type(e).__name__, e)
+                    self._sweep_expired()
                     continue
                 with self._lock:
                     p = self._pending.pop(header.get("id"), None)

@@ -177,6 +177,25 @@ class LocalFleet:
             m.proc.wait(timeout=10)
         logger.info("fleet: killed %s", name)
 
+    def wedge(self, name: str) -> None:
+        """Faultinject seam (thread mode): the member keeps
+        heartbeating but silently drops every consumed request — the
+        liveness-without-progress failure the router's wedge watchdog
+        exists for."""
+        with self._lock:
+            m = self._members[name]
+        if m.worker is None:
+            raise RuntimeError("wedge() is a thread-mode seam")
+        m.worker.wedge()
+        logger.info("fleet: wedged %s", name)
+
+    def unwedge(self, name: str) -> None:
+        with self._lock:
+            m = self._members[name]
+        if m.worker is not None:
+            m.worker.unwedge()
+        logger.info("fleet: unwedged %s", name)
+
     def restart(self, name: str) -> None:
         """Bring a killed member back on the SAME service topics (the
         endpoint reconnects through its existing consumer threads)."""

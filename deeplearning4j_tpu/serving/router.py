@@ -32,6 +32,22 @@ the engine cannot see from inside one process:
 - **Session affinity**: ``session=`` pins a multi-burst decode stream
   to the endpoint holding its KV state; the pin survives until that
   endpoint leaves the pool, then the session re-pins on first use.
+- **Durable decode streams**: ``submit_generate(on_tokens=...)``
+  streams incremental token deltas (wire-v2 chunks) while the router
+  journals every received token per stream. When the serving endpoint
+  dies mid-generation — reply timeout, heartbeat loss, a typed
+  ``DecodeBurstError``, a wedge — the stream MIGRATES: re-pin,
+  re-submit prompt + journaled prefix as a resume request, and the
+  surviving engine continues the stream's PRNG clock. Delivered
+  tokens are append-only (dedupe by offset: no gap, no repeat) and
+  token-for-token equal to an uninterrupted run; the cost is a prefix
+  re-prefill (``dl4j_router_resume_prefix_tokens_total``), not a
+  re-generation.
+- **Wedge watchdog** (``wedge_timeout_s``): heartbeats prove liveness,
+  not progress. An endpoint with router-dispatched work in flight
+  whose monotonic progress counters (engine ``resolved``, worker
+  ``served``, scheduler ``bursts``) stay flat for the window is
+  ejected like a crash and its streams migrate off it.
 - **Autoscale signals**: ``fleet_snapshot()`` feeds
   :class:`~deeplearning4j_tpu.serving.policy.ScalePolicy` (queue-depth
   and p99 driven add/remove-endpoint decisions).
@@ -39,6 +55,7 @@ the engine cannot see from inside one process:
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from concurrent.futures import Future
@@ -53,11 +70,15 @@ from deeplearning4j_tpu.monitor import (
     ROUTER_LATENCY_HISTOGRAM,
     ROUTER_QUEUE_WAIT_HISTOGRAM,
     ROUTER_REQUESTS_COUNTER,
+    ROUTER_RESUME_PREFIX_COUNTER,
     ROUTER_SHED_COUNTER,
+    SESSION_JOURNAL_BYTES_GAUGE,
+    SESSION_MIGRATIONS_COUNTER,
     get_registry,
     mark,
     record_fault,
 )
+from deeplearning4j_tpu.serving import wire
 from deeplearning4j_tpu.serving.endpoint import EndpointError, EngineEndpoint
 
 #: priority class → fraction of the deadline the completion estimate
@@ -90,7 +111,8 @@ class _EndpointState:
 
     __slots__ = ("endpoint", "consecutive_failures", "ejections",
                  "ejected_until", "in_trial", "ewma_ms", "inflight",
-                 "requests", "failures", "model_ewma_ms")
+                 "requests", "failures", "model_ewma_ms",
+                 "progress_sig", "progress_at", "wedged")
 
     def __init__(self, endpoint: EngineEndpoint):
         self.endpoint = endpoint
@@ -107,20 +129,37 @@ class _EndpointState:
         self.inflight = 0         # router-dispatched, unresolved
         self.requests = 0
         self.failures = 0
+        # wedge watchdog: heartbeats prove liveness, these prove
+        # PROGRESS — the last observed (resolved/served/bursts)
+        # signature and when it last moved while work was in flight
+        self.progress_sig: Optional[Tuple] = None
+        self.progress_at: Optional[float] = None
+        self.wedged = False
 
 
 class _Routed:
-    """One router request across its (possibly several) dispatches."""
+    """One router request across its (possibly several) dispatches.
+
+    For a STREAMING decode request (``on_tokens`` set) this is also the
+    stream's journal: ``received`` is the append-only token log (the
+    dedupe-by-offset ledger AND the resume prefix a migration
+    re-submits), ``epoch`` stamps the active dispatch so a late chunk
+    from a dispatch the stream already migrated off can never corrupt
+    the log, and ``dups``/``gaps``/``late`` account every chunk that
+    was dropped rather than delivered (the no-gap/no-repeat audit)."""
 
     __slots__ = ("future", "kind", "x", "gen", "deadline", "t0", "tried",
                  "attempts", "outstanding", "lock", "hedged", "session",
-                 "priority", "timer", "per_try_timeout", "model", "version")
+                 "priority", "timer", "per_try_timeout", "model", "version",
+                 "on_tokens", "received", "epoch", "dups", "gaps", "late",
+                 "journal_dropped", "migrations")
 
     def __init__(self, kind: str, x, gen, deadline: Optional[float],
                  priority: str, session: Optional[str],
                  per_try_timeout: Optional[float],
                  model: Optional[str] = None,
-                 version: Optional[int] = None):
+                 version: Optional[int] = None,
+                 on_tokens=None):
         self.future: "Future[np.ndarray]" = Future()
         self.kind = kind
         self.x = x
@@ -138,6 +177,14 @@ class _Routed:
         self.per_try_timeout = per_try_timeout
         self.model = model
         self.version = version
+        self.on_tokens = on_tokens
+        self.received: List[int] = []   # the session journal (tokens)
+        self.epoch = 0                  # active-dispatch stamp
+        self.dups = 0
+        self.gaps = 0
+        self.late = 0
+        self.journal_dropped = False    # over budget: restart, not resume
+        self.migrations = 0
 
 
 class InferenceRouter:
@@ -160,7 +207,9 @@ class InferenceRouter:
                  hedge_after_ms: float = 0.0,
                  per_try_timeout_s: Optional[float] = None,
                  default_deadline_ms: Optional[Dict[str, float]] = None,
-                 ewma_alpha: float = 0.2):
+                 ewma_alpha: float = 0.2,
+                 wedge_timeout_s: Optional[float] = None,
+                 journal_limit_tokens: int = 4096):
         self._eps: Dict[str, _EndpointState] = {}
         self._lock = threading.Lock()
         self._affinity: Dict[str, str] = {}
@@ -172,6 +221,17 @@ class InferenceRouter:
         self.per_try_timeout = per_try_timeout_s
         self.default_deadline_ms = dict(default_deadline_ms or {})
         self.ewma_alpha = float(ewma_alpha)
+        # wedge watchdog: an endpoint with router-dispatched work in
+        # flight whose progress counters stay flat this long is treated
+        # as FAILED (ejected; its streams migrate) even while its
+        # heartbeats keep arriving. None = heartbeat-only health.
+        self.wedge_timeout = (None if wedge_timeout_s is None
+                              else float(wedge_timeout_s))
+        # a stream whose journal outgrows this many tokens migrates by
+        # RESTART instead of prefix-resume (the journal stays usable as
+        # the dedupe ledger; it just stops being shipped as a prefix)
+        self.journal_limit = max(1, int(journal_limit_tokens))
+        self._streams: set = set()      # in-flight streaming _Routed
         self._closed = False
         for ep in endpoints or []:
             self.add_endpoint(ep)
@@ -215,16 +275,73 @@ class InferenceRouter:
     # ------------------------------------------------------------ health
 
     def _pool(self, now: float) -> List[_EndpointState]:
-        """Dispatchable endpoints: alive, and either not ejected or
-        half-open (backoff elapsed, no trial outstanding yet)."""
+        """Dispatchable endpoints: alive, not draining/stopped, and
+        either not ejected or half-open (backoff elapsed, no trial
+        outstanding yet). The wedge watchdog runs here — liveness
+        alone does not keep a non-progressing endpoint in the pool."""
         out = []
         for st in self._eps.values():
             if not st.endpoint.alive():
                 continue
+            if self._endpoint_state(st) in (wire.STATE_DRAINING,
+                                            wire.STATE_STOPPED):
+                continue  # scale-down hand-off: finish there, pin here
+            if self.wedge_timeout is not None:
+                self._check_wedge(st, now)
             if st.ejected_until > now and st.consecutive_failures:
                 continue  # still serving out its ejection backoff
             out.append(st)
         return out
+
+    @staticmethod
+    def _endpoint_state(st: _EndpointState) -> Optional[str]:
+        state = getattr(st.endpoint, "state", None)
+        return state() if callable(state) else None
+
+    def _check_wedge(self, st: _EndpointState, now: float) -> None:
+        """Progress watchdog: with router-dispatched work in flight,
+        the endpoint's monotonic counters (engine ``resolved``, worker
+        ``served``, scheduler ``bursts``/``retired_rows`` — riding in
+        every heartbeat) must keep moving. A configurable window of
+        zero progress is a FAILURE: the endpoint is ejected exactly
+        like a crash, and its pinned streams migrate on their next
+        failover. Heartbeats prove liveness; this proves work."""
+        with self._lock:
+            inflight = st.inflight
+        if inflight <= 0:
+            with self._lock:
+                st.progress_sig = None
+                st.progress_at = None
+            return
+        stats = st.endpoint.stats()
+        sched = stats.get("scheduler") or {}
+        sig = (stats.get("resolved"), stats.get("served"),
+               sched.get("bursts"), sched.get("retired_rows"))
+        wedged = False
+        with self._lock:
+            if sig != st.progress_sig or st.progress_at is None:
+                st.progress_sig = sig
+                st.progress_at = now
+                return
+            if now - st.progress_at < self.wedge_timeout:
+                return
+            if st.ejected_until > now and st.consecutive_failures:
+                return  # already out of the pool
+            # zero progress with queued work for a full window: wedged
+            st.wedged = True
+            st.consecutive_failures = max(st.consecutive_failures,
+                                          self.eject_threshold)
+            backoff = min(self.eject_backoff_max,
+                          self.eject_backoff * (2 ** st.ejections))
+            st.ejections += 1
+            st.ejected_until = now + backoff
+            st.progress_at = now
+            wedged = True
+        if wedged:
+            record_fault("routing")
+            self._health_gauge(st.endpoint.name).set(0.0)
+            mark("router_endpoint_wedged", endpoint=st.endpoint.name,
+                 inflight=inflight)
 
     def _note_success(self, st: _EndpointState, latency_ms: float,
                       model: Optional[str] = None) -> None:
@@ -234,6 +351,9 @@ class InferenceRouter:
             st.consecutive_failures = 0
             st.in_trial = False
             st.ejected_until = 0.0
+            st.wedged = False
+            st.progress_sig = None
+            st.progress_at = None
             st.ewma_ms = (latency_ms if st.ewma_ms is None else
                           (1 - self.ewma_alpha) * st.ewma_ms
                           + self.ewma_alpha * latency_ms)
@@ -320,6 +440,23 @@ class InferenceRouter:
             if pinned is not None:
                 pick = next((st for st in pool
                              if st.endpoint.name == pinned[0]), None)
+                if pick is None:
+                    # the KV-holding endpoint left the pool (died,
+                    # drained, or was ejected): this admission is a
+                    # session migration — the stream re-pins below
+                    st0 = self._eps.get(pinned[0])
+                    if st0 is None:
+                        reason = "endpoint_lost"
+                    elif self._endpoint_state(st0) in (
+                            wire.STATE_DRAINING, wire.STATE_STOPPED):
+                        reason = "drain"
+                    elif st0.wedged:
+                        reason = "wedged"
+                    else:
+                        reason = "endpoint_lost"
+                    self._note_migration(reason)
+                    mark("router_session_repinned", session=session,
+                         frm=pinned[0], reason=reason)
         if pick is None and trial is not None:
             pick = trial
             with self._lock:
@@ -346,6 +483,33 @@ class InferenceRouter:
             # same session key
             self._affinity[session] = (pick.endpoint.name, model)
         return pick
+
+    def _note_migration(self, reason: str) -> None:
+        self._reg().counter(
+            SESSION_MIGRATIONS_COUNTER,
+            "Decode-session migrations: the stream's endpoint failed "
+            "(or drained/wedged) and the router re-pinned it, resuming "
+            "from the journaled prefix where possible",
+            reason=reason).inc()
+
+    def _migration_reason(self, st: _EndpointState,
+                          err: BaseException) -> str:
+        from deeplearning4j_tpu.serving.endpoint import EndpointTimeout
+        if st.wedged:
+            return "wedged"
+        if isinstance(err, EndpointTimeout):
+            return "timeout"
+        if type(err).__name__ == "DecodeBurstError":
+            return "burst_error"
+        return "endpoint_error"
+
+    def _journal_gauge(self) -> None:
+        with self._lock:
+            size = sum(len(rf.received) for rf in self._streams)
+        self._reg().gauge(
+            SESSION_JOURNAL_BYTES_GAUGE,
+            "Live bytes of journaled stream tokens (what a migration "
+            "would re-prefill)").set(8 * size)
 
     def _shed(self, priority: str, reason: str,
               model: Optional[str] = None) -> None:
@@ -380,15 +544,50 @@ class InferenceRouter:
                         session: Optional[str] = None,
                         model: Optional[str] = None,
                         version: Optional[int] = None,
+                        on_tokens=None,
                         **gen_kwargs) -> "Future[np.ndarray]":
         """Route one decode request; ``session=`` keeps every burst of
         a decode stream on the (endpoint, model, version) it started on
         — the endpoint pin lives here, the version pin rides the same
         session key down in the engine, so a mid-stream hot-swap never
-        switches KV-cache owners."""
+        switches KV-cache owners.
+
+        ``on_tokens(offset, tokens)`` makes this a DURABLE STREAM: the
+        callback receives append-only token deltas (dedupe-by-offset —
+        no gap, no repeat, asserted in the journal), the router records
+        every received token in a per-stream journal, and when the
+        serving endpoint dies mid-generation (timeout, heartbeat loss,
+        typed burst error, wedge) the stream MIGRATES: it re-pins and
+        re-submits prompt + received prefix as a resume request, so the
+        surviving engine re-prefills only the prefix instead of
+        re-generating it, and the delivered tokens are token-for-token
+        what an uninterrupted run would have produced."""
         gen = dict(gen_kwargs, max_new_tokens=int(max_new_tokens))
         return self._route(np.asarray(prompt_ids), gen, "generate",
-                           deadline_ms, priority, session, model, version)
+                           deadline_ms, priority, session, model, version,
+                           on_tokens)
+
+    def stream(self, prompt_ids, max_new_tokens,
+               timeout: Optional[float] = None, **kwargs):
+        """Generator facade over the streaming seam: yields ``(offset,
+        tokens)`` deltas as they arrive (migration-transparent — the
+        offsets are contiguous across an engine death) and returns
+        after the terminal frame; raises the stream's error if it
+        ultimately failed. ``stream=True`` ergonomics for callers that
+        would rather iterate than register a callback."""
+        q: "queue.Queue" = queue.Queue()
+        fut = self.submit_generate(
+            prompt_ids, max_new_tokens,
+            on_tokens=lambda off, toks: q.put((off, toks)), **kwargs)
+        fut.add_done_callback(lambda f: q.put(None))
+        while True:
+            item = q.get(timeout=timeout)
+            if item is None:
+                err = fut.exception()
+                if err is not None:
+                    raise err
+                return
+            yield item
 
     def output(self, x, timeout: Optional[float] = None, **kwargs):
         return self.submit(x, **kwargs).result(timeout=timeout)
@@ -399,7 +598,7 @@ class InferenceRouter:
                                     **kwargs).result(timeout=timeout)
 
     def _route(self, x, gen, kind, deadline_ms, priority, session,
-               model=None, version=None):
+               model=None, version=None, on_tokens=None):
         if self._closed:
             raise RuntimeError("router is closed")
         if deadline_ms is None:
@@ -414,12 +613,16 @@ class InferenceRouter:
                      None if deadline_ms is None
                      else time.monotonic() + deadline_ms / 1e3,
                      priority, session, self.per_try_timeout,
-                     model, version)
+                     model, version, on_tokens)
+        if on_tokens is not None:
+            with self._lock:
+                self._streams.add(rf)
         self._dispatch(rf, st)
         if self.hedge_after > 0 and session is None and \
-                self.max_attempts > 1:
+                on_tokens is None and self.max_attempts > 1:
             # candidate availability is checked when the timer FIRES —
-            # an endpoint added after dispatch is a valid hedge target
+            # an endpoint added after dispatch is a valid hedge target.
+            # Streams never hedge: a duplicate stream would double-emit.
             rf.timer = threading.Timer(self.hedge_after, self._hedge, (rf,))
             rf.timer.daemon = True
             rf.timer.start()
@@ -439,13 +642,33 @@ class InferenceRouter:
         return isinstance(e, (InferenceBackpressure, ModelUnavailable))
 
     def _dispatch(self, rf: _Routed, st: _EndpointState) -> None:
+        resume_prefix = None
         with rf.lock:
             rf.attempts += 1
             rf.outstanding += 1
             rf.tried.add(st.endpoint.name)
+            if rf.on_tokens is not None:
+                # stamp the active dispatch: chunks from any earlier
+                # dispatch (a slow-not-dead engine replying late) are
+                # dropped by epoch, never merged into the journal
+                rf.epoch += 1
+                epoch = rf.epoch
+                if rf.attempts > 1 and rf.received \
+                        and not rf.journal_dropped:
+                    # MIGRATION RESUME: ship the journaled prefix; the
+                    # new engine re-prefills prompt + prefix and emits
+                    # from offset len(prefix) — no re-generation, no
+                    # re-emission
+                    resume_prefix = np.asarray(rf.received, np.int64)
         with self._lock:
             st.requests += 1
             st.inflight += 1
+        if resume_prefix is not None:
+            self._reg().counter(
+                ROUTER_RESUME_PREFIX_COUNTER,
+                "Journaled prefix tokens re-submitted by stream "
+                "migrations (re-prefilled, not re-generated)"
+            ).inc(len(resume_prefix))
         t_disp = time.perf_counter()
         # routing fields travel only when set, so single-model
         # endpoints (and minimal EngineEndpoint stubs) keep working
@@ -456,6 +679,12 @@ class InferenceRouter:
         try:
             if rf.kind == "generate":
                 g = dict(rf.gen)
+                if rf.on_tokens is not None:
+                    g["on_tokens"] = (
+                        lambda off, toks, e=epoch:
+                        self._on_chunk(rf, e, off, toks))
+                if resume_prefix is not None:
+                    g["prefix"] = resume_prefix
                 inner = st.endpoint.submit_generate(
                     rf.x, g.pop("max_new_tokens"),
                     timeout_s=rf.per_try_timeout, **route, **g)
@@ -474,6 +703,42 @@ class InferenceRouter:
                 or self._typed_engine_error(e) else EndpointError(str(e)))
         inner.add_done_callback(
             lambda f: self._on_done(rf, st, f, t_disp))
+
+    def _on_chunk(self, rf: _Routed, epoch: int, off: int, toks) -> None:
+        """Journal + dedupe one incremental chunk, then deliver ONLY
+        the genuinely-new tokens to the caller. The append-only
+        invariant lives here: a token enters the journal exactly when
+        its offset equals the journal length, so across timeouts,
+        migrations and late replies the caller observes every offset
+        once, in order — no gap, no repeat."""
+        toks = np.asarray(toks).reshape(-1)
+        with rf.lock:
+            if epoch != rf.epoch or rf.future.done():
+                rf.late += len(toks)
+                return
+            start = len(rf.received)
+            for i, t in enumerate(toks.tolist()):
+                idx = int(off) + i
+                if idx < len(rf.received):
+                    rf.dups += 1       # already delivered: dropped
+                elif idx == len(rf.received):
+                    rf.received.append(int(t))
+                else:
+                    rf.gaps += 1       # out-of-order hole: never valid
+            if len(rf.received) > self.journal_limit:
+                # over the journal budget: keep the dedupe ledger but
+                # stop offering it as a resume prefix — a migration of
+                # this stream restarts (still exact, just costlier)
+                rf.journal_dropped = True
+            new = rf.received[start:]
+            noff = start
+            cb = rf.on_tokens
+        self._journal_gauge()
+        if new and cb is not None:
+            try:
+                cb(noff, np.asarray(new, np.int64))
+            except BaseException as e:
+                mark("stream_callback_error", error=type(e).__name__)
 
     def _hedge(self, rf: _Routed) -> None:
         """Tail-latency duplicate: one extra dispatch to an untried
@@ -529,6 +794,7 @@ class InferenceRouter:
                     ROUTER_LATENCY_HISTOGRAM,
                     "End-to-end submit→result latency through the "
                     "router").observe((now - rf.t0) * 1e3)
+                self._stream_done(rf)
             return
         # failure: endpoint bookkeeping, then failover if budget allows
         self._note_failure(st)
@@ -549,6 +815,15 @@ class InferenceRouter:
                 # the pinned endpoint failed: re-pin the session
                 self._affinity[rf.session] = (retry_to.endpoint.name,
                                               rf.model)
+            if rf.on_tokens is not None or rf.session is not None:
+                # this failover moves a decode stream: account the
+                # migration (the resume prefix rides in _dispatch)
+                reason = self._migration_reason(st, err)
+                rf.migrations += 1
+                self._note_migration(reason)
+                mark("router_stream_migrated", frm=st.endpoint.name,
+                     to=retry_to.endpoint.name, reason=reason,
+                     prefix=len(rf.received))
             self._reg().counter(
                 ROUTER_FAILOVERS_COUNTER,
                 "Requests re-dispatched to another endpoint after an "
@@ -561,6 +836,14 @@ class InferenceRouter:
                 rf.timer.cancel()
             if not rf.future.done():
                 rf.future.set_exception(err)
+            self._stream_done(rf)
+
+    def _stream_done(self, rf: _Routed) -> None:
+        if rf.on_tokens is None:
+            return
+        with self._lock:
+            self._streams.discard(rf)
+        self._journal_gauge()
 
     # ------------------------------------------------------------- state
 
@@ -574,6 +857,10 @@ class InferenceRouter:
         healthy = 0
         queue_depth = 0.0
         for name, st in items:
+            if self.wedge_timeout is not None:
+                # the watchdog also runs on observation, so a wedged
+                # endpoint is caught even while no new submit arrives
+                self._check_wedge(st, now)
             alive = st.endpoint.alive()
             ejected = bool(st.ejected_until > now
                            and st.consecutive_failures)
@@ -586,6 +873,8 @@ class InferenceRouter:
                 "alive": alive,
                 "ejected": ejected,
                 "in_pool": in_pool,
+                "wedged": st.wedged,
+                "state": self._endpoint_state(st),
                 "consecutive_failures": st.consecutive_failures,
                 "ejections": st.ejections,
                 "requests": st.requests,
@@ -601,6 +890,9 @@ class InferenceRouter:
             }
         reg = self._reg()
         lat = reg.get(ROUTER_LATENCY_HISTOGRAM)
+        with self._lock:
+            active_streams = len(self._streams)
+            journal_tokens = sum(len(rf.received) for rf in self._streams)
         return {
             "endpoints": eps,
             "healthy_endpoints": healthy,
@@ -608,6 +900,11 @@ class InferenceRouter:
             "degraded": healthy < len(eps) or healthy == 0,
             "queue_depth": queue_depth,
             "sessions": len(self._affinity),
+            "active_streams": active_streams,
+            "journal_bytes": 8 * journal_tokens,
+            "migrations": int(reg.family_total(SESSION_MIGRATIONS_COUNTER)),
+            "resume_prefix_tokens": int(
+                reg.family_total(ROUTER_RESUME_PREFIX_COUNTER)),
             "p99_ms": (None if lat is None or lat.count == 0
                        else round(lat.percentile(0.99), 3)),
             "shed": int(reg.family_total(ROUTER_SHED_COUNTER)),

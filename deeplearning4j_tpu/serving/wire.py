@@ -27,7 +27,17 @@ EndpointError` with the message preserved.
 - heartbeat: plain JSON — worker name, monotonically increasing
   ``seq``, lifecycle ``state`` (serving / draining / stopped) and the
   engine's ``stats()`` snapshot. The router's health plane consumes
-  these instead of inferring engine death from reply timeouts alone.
+  these instead of inferring engine death from reply timeouts alone;
+  the ``resolved`` / scheduler ``bursts`` counters riding in the stats
+  double as PROGRESS proof — a heartbeat proves liveness, the counters
+  prove the worker is actually advancing its queued work.
+- v2 (``WIRE_VERSION``): decode replies may be CHUNKED — per-burst
+  :func:`pack_chunk` frames carry token deltas tagged with sequence
+  offsets, and the terminal :func:`pack_reply` still carries the full
+  payload; ``gen.prefix`` on a request makes it a RESUME (the engine
+  re-prefills prompt + prefix and continues the stream's PRNG clock).
+  Version skew fails typed: :func:`check_version` raises
+  :class:`WireVersionError` instead of serving a newer frame garbled.
 
 Topic layout for a worker serving ``service``::
 
@@ -59,6 +69,29 @@ STATE_SERVING = "serving"
 STATE_DRAINING = "draining"
 STATE_STOPPED = "stopped"
 
+#: Wire protocol version, carried as ``v`` in every request header.
+#: v1: request/reply + heartbeat. v2: chunked decode replies (per-burst
+#: token deltas tagged with sequence offsets; the terminal frame still
+#: carries the final payload) and resume requests (``gen.prefix`` — the
+#: already-generated tokens a migrated stream re-prefills instead of
+#: re-generating). A worker receiving a frame NEWER than it speaks
+#: rejects it with a typed :class:`WireVersionError` rather than
+#: serving it garbled.
+WIRE_VERSION = 2
+
+
+class WireVersionError(RuntimeError):
+    """The peer speaks a newer wire protocol than this worker: the
+    request was rejected typed (never half-parsed) — upgrade the worker
+    or drop the client's feature set."""
+
+
+def check_version(header: Dict[str, Any]) -> None:
+    v = int(header.get("v", 1))
+    if v > WIRE_VERSION:
+        raise WireVersionError(
+            f"frame speaks wire v{v}; this worker speaks v{WIRE_VERSION}")
+
 
 def pack_frame(header: Dict[str, Any], body: bytes = b"") -> bytes:
     h = json.dumps(header, separators=(",", ":")).encode()
@@ -80,7 +113,8 @@ def pack_request(corr_id: str, reply_topic: str, kind: str, x: np.ndarray,
                  model: Optional[str] = None,
                  version: Optional[int] = None,
                  session: Optional[str] = None) -> bytes:
-    header = {"id": corr_id, "reply": reply_topic, "kind": kind}
+    header = {"id": corr_id, "reply": reply_topic, "kind": kind,
+              "v": WIRE_VERSION}
     if gen is not None:
         header["gen"] = gen
     if model is not None:
@@ -122,10 +156,29 @@ def pack_reply(corr_id: str, result: Optional[np.ndarray] = None,
                       ndarray_to_bytes(result))
 
 
+def pack_chunk(corr_id: str, offset: int, tokens: np.ndarray) -> bytes:
+    """A v2 incremental decode chunk: ``tokens`` are the stream's
+    generated ids at sequence offsets ``[offset, offset + len)`` (offset
+    0 = the first GENERATED token, prompt excluded). Chunks are
+    advisory progress — the terminal :func:`pack_reply` still carries
+    the full payload, so a consumer that drops chunks stays correct and
+    a consumer that dedupes by offset never double-delivers."""
+    return pack_frame(
+        {"id": corr_id, "ok": True, "chunk": True, "off": int(offset),
+         "v": WIRE_VERSION},
+        ndarray_to_bytes(np.asarray(tokens, np.int64)))
+
+
+def is_chunk(header: Dict[str, Any]) -> bool:
+    return bool(header.get("chunk"))
+
+
 def _typed_error_registry() -> Dict[str, Any]:
     """The engine-error family that crosses the wire typed. Imported
     lazily — wire.py sits below router/registry in the import graph."""
     from deeplearning4j_tpu.parallel.inference import InferenceBackpressure
+    from deeplearning4j_tpu.serving.continuous import (DecodeBurstError,
+                                                       KVPoolExhausted)
     from deeplearning4j_tpu.serving.registry import (ModelQuarantined,
                                                      ModelUnavailable)
     from deeplearning4j_tpu.serving.router import RetryAfter
@@ -137,6 +190,9 @@ def _typed_error_registry() -> Dict[str, Any]:
         "ModelQuarantined": ModelQuarantined,
         "CheckpointCorruptError": CheckpointCorruptError,
         "RetryAfter": RetryAfter,
+        "DecodeBurstError": DecodeBurstError,
+        "KVPoolExhausted": KVPoolExhausted,
+        "WireVersionError": WireVersionError,
     }
 
 

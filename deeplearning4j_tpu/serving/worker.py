@@ -65,7 +65,9 @@ class EngineWorker:
         self._seq = 0
         self._stop = threading.Event()      # stop consuming new work
         self._killed = threading.Event()    # abrupt: no replies either
+        self._wedged = threading.Event()    # faultinject: alive, no work
         self._served = 0
+        self._wedge_dropped = 0
         self._threads = []
         if start:
             self.start()
@@ -105,8 +107,22 @@ class EngineWorker:
                 logger.warning("worker %s: undecodable request (%s)",
                                self.name, e)
                 continue
-            self._served += 1
             corr, reply_topic = header.get("id"), header.get("reply")
+            try:
+                # a frame from a NEWER protocol is rejected typed, not
+                # served garbled (the wire v2 skew contract)
+                wire.check_version(header)
+            except wire.WireVersionError as e:
+                self._reply(reply_topic, wire.pack_reply(corr, error=e))
+                continue
+            if self._wedged.is_set():
+                # faultinject wedge: the request is consumed and then
+                # silently dropped — heartbeats keep flowing (liveness)
+                # while every progress counter stays flat, the failure
+                # mode heartbeats alone cannot see
+                self._wedge_dropped += 1
+                continue
+            self._served += 1
             # multi-model routing fields ride the header; absent for a
             # single-model engine (whose submit() takes no model=)
             route = {k: header[k] for k in ("model", "version", "session")
@@ -114,12 +130,22 @@ class EngineWorker:
             try:
                 if header.get("kind") == wire.KIND_GENERATE:
                     g = header.get("gen") or {}
+                    kwargs = dict(route)
+                    if g.get("prefix") is not None:
+                        kwargs["prefix"] = np.asarray(g["prefix"], np.int64)
+                    if g.get("stream"):
+                        # chunked v2 reply: each burst's token delta is
+                        # published as it retires; the terminal reply
+                        # still carries the full payload
+                        kwargs["on_tokens"] = (
+                            lambda off, toks, c=corr, rt=reply_topic:
+                            self._reply(rt, wire.pack_chunk(c, off, toks)))
                     fut = self.engine.submit_generate(
                         x.astype(np.int32, copy=False), g.get("max_new", 1),
                         temperature=g.get("temperature", 0.0),
                         top_k=g.get("top_k", 0), top_p=g.get("top_p", 0.0),
                         eos_token=g.get("eos_token"),
-                        seed=g.get("seed", 0), **route)
+                        seed=g.get("seed", 0), **kwargs)
                 else:
                     fut = self.engine.submit(x, **route)
             except BaseException as e:
@@ -173,11 +199,18 @@ class EngineWorker:
     # -------------------------------------------------------- lifecycle
 
     def drain_and_stop(self, timeout: Optional[float] = 30.0) -> bool:
-        """Graceful exit: stop consuming, resolve everything accepted,
-        announce the drain, stop the engine. Returns False when the
+        """Graceful exit: stop consuming, announce the drain
+        IMMEDIATELY (so routers pull this endpoint from their pools and
+        re-pin its decode sessions before any request can strand),
+        resolve everything accepted — in-flight decode streams finish
+        here, chunks and terminal frames included: zero lost tokens —
+        then say goodbye and stop the engine. Returns False when the
         engine did not drain within ``timeout``."""
         self._state = wire.STATE_DRAINING
         self._stop.set()
+        # the draining beat precedes the drain itself: session hand-off
+        # happens while this worker is still resolving its last work
+        self._beat(self.service + wire.HB_SUFFIX)
         drained = self.engine.drain(timeout=timeout)
         self._state = wire.STATE_STOPPED
         self._beat(self.service + wire.HB_SUFFIX)  # announce the exit
@@ -195,6 +228,16 @@ class EngineWorker:
         self._killed.set()
         for t in self._threads:
             t.join(timeout=2)
+
+    def wedge(self) -> None:
+        """Faultinject seam: keep heartbeating (liveness) but silently
+        drop every consumed request — zero progress with queued work,
+        the wedged-worker signature the router's progress watchdog (not
+        its heartbeat plane) must catch."""
+        self._wedged.set()
+
+    def unwedge(self) -> None:
+        self._wedged.clear()
 
     @property
     def state(self) -> str:

@@ -212,6 +212,14 @@ KNOWN_DL4J_METRICS = {
     "dl4j_router_queue_wait_ms",
     "dl4j_router_latency_ms",
     "dl4j_router_endpoint_healthy",
+    # durable decode streams (chunked token deltas, session journals,
+    # cross-engine migration resume): chunks emitted by the decode
+    # plane, migrations by reason, live journal bytes, and the resume
+    # cost in re-submitted prefix tokens
+    "dl4j_stream_chunks_total",
+    "dl4j_session_migrations_total",
+    "dl4j_session_journal_bytes",
+    "dl4j_router_resume_prefix_tokens_total",
     # mesh plane (parallel/mesh.py MeshPlane): active named-axis
     # topology (devices + per-axis size) and checkpoint restores that
     # re-lowered saved shards onto a different mesh shape

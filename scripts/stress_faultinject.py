@@ -22,10 +22,22 @@ failure — also exit 1, but reported as such)::
 **Quick mode (importable — wired into tier-1)** — :func:`quick_check`
 replays the in-process deterministic injector battery (seeded NaN/raise
 schedules, flaky-broker schedules, torn-write counting, replica/model
-poison sequences) twice per seed across rotating seeds and compares
-the full event logs bit-for-bit. It runs in milliseconds with no
-subprocess and no jax compute, so the tier-1 sweep carries it on every
-run; the full mode is the pre-merge / CI deep check.
+poison sequences, burst-kill windows, mesh-shrink drills, and the
+composed ChaosSchedule event clock — sections 1–7) twice per seed
+across rotating seeds and compares the full event logs bit-for-bit.
+It runs in milliseconds with no subprocess and no jax compute, so the
+tier-1 sweep carries it on every run; the full mode is the pre-merge /
+CI deep check.
+
+**Chaos mode (CLI)** — ``--chaos`` runs the COMPOSED drill
+(:func:`deeplearning4j_tpu.faultinject.chaos.run_chaos_drill` — every
+injector on one seeded event clock against a live 3-endpoint fleet)
+twice per rotating seed in fresh subprocesses, failing on any global
+invariant violation (lost/duplicated tokens, stranded futures, leaked
+KV blocks, unhealthy fleet) or ANY outcome drift between the two
+replays of one seed::
+
+    python scripts/stress_faultinject.py --chaos --runs 3
 """
 
 from __future__ import annotations
@@ -170,6 +182,17 @@ def _scenario_log(seed: int) -> str:
                           f"{list(e.survivor_ids)}")
     events.append(f"ms survivors={list(ms.survivor_ids())} "
                   f"fired={ms.fired} seen={ms.steps_seen}")
+
+    # 7) composed chaos schedule (faultinject/chaos.py ChaosSchedule —
+    # the seeded event clock run_chaos_drill replays against a live
+    # fleet): the schedule ITSELF is pinned deterministic here (same
+    # seed ⇒ identical ticks/actions/targets/heals, and wedge
+    # injector state transitions replay); the full live drill runs in
+    # fresh subprocesses via `--chaos` (outcome-drift contract)
+    from deeplearning4j_tpu.faultinject import ChaosSchedule
+    for n_events, n_eps in ((4, 3), (seed % 5 + 2, 3)):
+        cs = ChaosSchedule(seed, n_events=n_events, n_endpoints=n_eps)
+        events.append(f"chaos[{n_events}x{n_eps}]={cs.signature()}")
     return "\n".join(events)
 
 
@@ -189,6 +212,82 @@ def quick_check(seeds=(0, 1, 2), runs_per_seed: int = 2) -> List[str]:
                     f"{diff}: {a[diff] if diff < len(a) else '<end>'!r} vs "
                     f"{b[diff] if diff < len(b) else '<end>'!r}")
     return problems
+
+
+# ----------------------------------------------------------- chaos mode
+
+
+def _run_chaos_subprocess(seed: int, n_requests: int,
+                          n_events: int) -> Dict[str, object]:
+    """One composed chaos drill in a FRESH interpreter (the only
+    honest replay on this box — see the full-mode rationale); returns
+    the drill's invariant summary, or a synthetic failure record when
+    the subprocess died."""
+    import json
+    code = (
+        "import json\n"
+        "from deeplearning4j_tpu.faultinject.chaos import run_chaos_drill\n"
+        f"out = run_chaos_drill(seed={int(seed)}, "
+        f"n_requests={int(n_requests)}, n_events={int(n_events)})\n"
+        "print('CHAOS_JSON ' + json.dumps(out, sort_keys=True))\n")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONHASHSEED"] = str(seed)
+    proc = subprocess.run([sys.executable, "-c", code], cwd=_ROOT,
+                          capture_output=True, text=True, env=env,
+                          timeout=900)
+    for line in proc.stdout.splitlines():
+        if line.startswith("CHAOS_JSON "):
+            return json.loads(line[len("CHAOS_JSON "):])
+    return {"error": f"rc={proc.returncode}",
+            "stderr": proc.stderr[-2000:]}
+
+
+def run_chaos(runs: int, seed_base: int, n_requests: int = 14,
+              n_events: int = 4) -> int:
+    """The `chaos` section: run the composed drill TWICE per seed in
+    fresh subprocesses across rotating seeds; fail on any invariant
+    violation OR any outcome drift between the two replays of one
+    seed — the same determinism contract sections 1–7 pin for the
+    injectors, applied to the whole composed drill."""
+    bad = 0
+    for i in range(runs):
+        seed = seed_base + i
+        print(f"chaos seed {seed} ({i + 1}/{runs}) ...", flush=True)
+        a = _run_chaos_subprocess(seed, n_requests, n_events)
+        b = _run_chaos_subprocess(seed, n_requests, n_events)
+        for run_id, out in (("run1", a), ("run2", b)):
+            if "error" in out:
+                print(f"  {run_id} DIED: {out}", file=sys.stderr)
+                bad += 1
+                continue
+            violations = [
+                k for k, want in (
+                    ("failed", 0), ("stranded_futures", 0),
+                    ("token_mismatches", 0), ("dup_offsets", 0),
+                    ("gap_events", 0), ("leaked_blocks", 0))
+                if out.get(k) != want]
+            if out.get("healthy_endpoints") != 3:
+                violations.append("healthy_endpoints")
+            if out.get("completed") != out.get("submitted"):
+                violations.append("completed")
+            if violations:
+                print(f"  {run_id} INVARIANT VIOLATIONS {violations}: "
+                      f"{out}", file=sys.stderr)
+                bad += 1
+        if "error" not in a and "error" not in b and a != b:
+            drift = sorted(k for k in set(a) | set(b)
+                           if a.get(k) != b.get(k))
+            print(f"  OUTCOME DRIFT between replays of seed {seed}: "
+                  f"{drift}", file=sys.stderr)
+            bad += 1
+        elif "error" not in a:
+            print(f"  ok: {a['submitted']} requests, "
+                  f"schedule {a['schedule']}", flush=True)
+    if not bad:
+        print(f"ok: composed chaos drill deterministic + invariant-clean "
+              f"over {runs} seeds x 2 fresh-process replays")
+    return 1 if bad else 0
 
 
 # ------------------------------------------------------------ full mode
@@ -225,9 +324,21 @@ def main(argv=None) -> int:
     ap.add_argument("--quick", action="store_true",
                     help="run only the in-process injector battery "
                          "(what tier-1 wires in)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the COMPOSED chaos drill in fresh "
+                         "subprocesses (2 replays per rotating seed), "
+                         "failing on invariant violations or outcome "
+                         "drift")
+    ap.add_argument("--chaos-requests", type=int, default=14)
+    ap.add_argument("--chaos-events", type=int, default=4)
     ap.add_argument("--pytest-args", nargs=argparse.REMAINDER, default=[],
                     help="extra args forwarded to pytest")
     args = ap.parse_args(argv)
+
+    if args.chaos:
+        return run_chaos(args.runs, args.seed_base,
+                         n_requests=args.chaos_requests,
+                         n_events=args.chaos_events)
 
     if args.quick:
         problems = quick_check(

@@ -372,6 +372,162 @@ def test_engine_kill_mid_burst_seam(rng, fresh_registry):
         eng.shutdown()
 
 
+# ------------------------------------- durable streams (token deltas)
+
+class _Collector:
+    """on_tokens audit: asserts append-only delivery while recording."""
+
+    def __init__(self):
+        self.chunks = []
+
+    def __call__(self, off, toks):
+        self.chunks.append((int(off),
+                            [int(t) for t in np.asarray(toks).reshape(-1)]))
+
+    def tokens(self, base=0):
+        """Concatenated deltas, asserting contiguous offsets from
+        ``base`` (0 for a fresh stream, len(prefix) for a resume)."""
+        toks = []
+        for off, ts in self.chunks:
+            assert off == base + len(toks), \
+                f"gap/repeat at {off}: {self.chunks}"
+            toks.extend(ts)
+        return toks
+
+
+def test_stream_deltas_match_eager(rng, fresh_registry):
+    """on_tokens receives per-burst deltas whose concatenation IS the
+    eager sequence — offsets contiguous from 0, chunk counter ticks."""
+    net = _tiny_gpt()
+    p = rng.integers(0, VOCAB, (1, 5))
+    want = generate_eager(net, p, 10)
+    coll = _Collector()
+    s = _sched(net)
+    f = s.submit(p, 10, on_tokens=coll)
+    _drive(s, [f])
+    assert np.array_equal(f.result(0), want)
+    assert coll.tokens() == [int(t) for t in want[0, 5:]]
+    assert len(coll.chunks) > 1  # genuinely incremental, not terminal
+    assert fresh_registry.family_total(
+        monitor.STREAM_CHUNKS_COUNTER) == len(coll.chunks)
+
+
+def test_stream_deltas_survive_preemption(rng):
+    """A preempted-and-resumed stream keeps its delivery cursor: no
+    token is re-emitted after the resume, and the delivered stream is
+    still the uninterrupted eager sequence."""
+    net = _tiny_gpt()
+    s = _sched(net, num_blocks=9)  # tiny pool: forces preemption
+    prompts = [rng.integers(0, VOCAB, (1, 5)) for _ in range(3)]
+    colls = [_Collector() for _ in prompts]
+    futs = [s.submit(p, 10, on_tokens=c) for p, c in zip(prompts, colls)]
+    _drive(s, futs)
+    assert s.stats()["preemptions"] > 0
+    for f, p, c in zip(futs, prompts, colls):
+        want = generate_eager(net, p, 10)
+        assert np.array_equal(f.result(0), want)
+        assert c.tokens() == [int(t) for t in want[0, 5:]]
+
+
+def test_prefix_resume_matches_eager_and_reprefills_only_prefix(rng):
+    """The cross-engine migration contract, scheduler-level: a stream
+    interrupted after k tokens resumes on a FRESH scheduler from
+    prompt + prefix — greedy AND seeded-sampled output token-for-token
+    equal to an uninterrupted run, offsets continuing at k, and the
+    resume admitted ONE row prefilled at t0 + k (resumed, not
+    restarted — pinned via the admit event and the admitted-rows
+    count)."""
+    net = _tiny_gpt()
+    p = rng.integers(0, VOCAB, (1, 5))
+    for sampler in ({}, {"temperature": 0.8, "top_k": 5, "seed": 7}):
+        want = generate_eager(net, p, 10, **sampler)
+        k = 4
+        prefix = np.asarray([int(t) for t in want[0, 5:5 + k]])
+        s2 = _sched(net)
+        coll = _Collector()
+        f = s2.submit(p, 10, prefix=prefix, on_tokens=coll, **sampler)
+        _drive(s2, [f])
+        assert np.array_equal(f.result(0), want), sampler
+        # delivered offsets CONTINUE after the prefix — nothing re-emitted
+        assert coll.chunks[0][0] == k
+        assert coll.tokens(base=k) == [int(t) for t in want[0, 5 + k:]]
+        # resumed, not restarted: one admission, prefilled at t0+k
+        admits = [e for e in s2.events if e.startswith("admit")]
+        assert len(admits) == 1 and f" t={5 + k} " in admits[0], admits
+        assert s2.stats()["admitted_rows"] == 1
+        st = s2.stats()
+        assert st["pool"]["blocks_free"] == st["pool"]["blocks_total"]
+
+
+def test_prefix_covering_max_new_short_circuits(rng):
+    """Only the terminal frame was lost: a resume whose prefix already
+    holds every token resolves immediately, no admission at all."""
+    net = _tiny_gpt()
+    p = rng.integers(0, VOCAB, (1, 5))
+    want = generate_eager(net, p, 6)
+    s = _sched(net)
+    f = s.submit(p, 6, prefix=np.asarray(want[0, 5:]))
+    assert f.done()
+    assert np.array_equal(f.result(0), want)
+    assert s.stats()["admitted_rows"] == 0
+    assert s.drain(1)  # accounting stayed consistent
+
+
+def test_streaming_requires_single_row(rng):
+    net = _tiny_gpt()
+    s = _sched(net)
+    with pytest.raises(ValueError, match="per-stream"):
+        s.submit(rng.integers(0, VOCAB, (2, 5)), 4, on_tokens=lambda o, t: 0)
+    with pytest.raises(ValueError, match="per-stream"):
+        s.submit(rng.integers(0, VOCAB, (2, 5)), 4, prefix=np.asarray([1]))
+
+
+def test_engine_stream_and_prefix_seams(rng, fresh_registry):
+    """ParallelInference plumbs on_tokens/prefix: the continuous
+    engine streams per-burst deltas and resumes from a prefix; the
+    whole-burst engine degrades to ONE terminal chunk and rejects
+    prefix typed (resume rides the iteration-level machinery)."""
+    net = _tiny_gpt()
+    p = rng.integers(0, VOCAB, (1, 5))
+    want = generate_eager(net, p, 8)
+    cont = ParallelInference(net, replicas=1, continuous=True,
+                             decode_slots=4, decode_burst=4,
+                             kv_block_size=4)
+    try:
+        coll = _Collector()
+        f = cont.submit_generate(p, 8, on_tokens=coll)
+        assert np.array_equal(f.result(30), want)
+        assert coll.tokens() == [int(t) for t in want[0, 5:]]
+        coll2 = _Collector()
+        f2 = cont.submit_generate(p, 8, prefix=np.asarray(want[0, 5:8]),
+                                  on_tokens=coll2)
+        assert np.array_equal(f2.result(30), want)
+        assert coll2.tokens(base=3) == [int(t) for t in want[0, 8:]]
+    finally:
+        cont.shutdown()
+    whole = ParallelInference(net, replicas=1)
+    try:
+        coll3 = _Collector()
+        f3 = whole.submit_generate(p, 8, on_tokens=coll3)
+        assert np.array_equal(f3.result(30), want)
+        assert _spin(lambda: len(coll3.chunks) == 1)
+        assert coll3.tokens() == [int(t) for t in want[0, 5:]]
+        with pytest.raises(ValueError, match="continuous"):
+            whole.submit_generate(p, 8, prefix=np.asarray([1, 2]))
+    finally:
+        whole.shutdown()
+
+
+def _spin(cond, timeout=10.0):
+    import time
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.005)
+    return True
+
+
 # ------------------------------------------------ stats / healthz / schema
 
 def test_stats_and_ready_gate(rng, fresh_registry):

@@ -757,6 +757,418 @@ def test_retry_after_roundtrips_typed_through_wire():
     assert err.retry_after_s == 1.5 and "try later" in str(err)
 
 
+# ----------------------------- durable decode streams (ISSUE 10)
+
+class _Chunks:
+    """Router-side delivery audit: offsets must be contiguous from 0
+    across ANY number of migrations (no gap, no repeat)."""
+
+    def __init__(self):
+        self.chunks = []
+
+    def __call__(self, off, toks):
+        self.chunks.append((int(off),
+                            [int(t) for t in np.asarray(toks).reshape(-1)]))
+
+    def tokens(self):
+        toks = []
+        for off, ts in self.chunks:
+            assert off == len(toks), f"gap/repeat at {off}: {self.chunks}"
+            toks.extend(ts)
+        return toks
+
+
+def _mk_gpt_fleet(net, router, n=2, hooks=None, request_timeout_s=30.0):
+    """Continuous-decode engine fleet; ``hooks[i]`` arms a
+    decode_burst_hook on the i-th engine built (None = no hook)."""
+    built = []
+
+    def engine_factory():
+        hook = None
+        if hooks is not None and len(built) < len(hooks):
+            hook = hooks[len(built)]
+        eng = ParallelInference(net, replicas=1, continuous=True,
+                                decode_slots=4, decode_burst=4,
+                                kv_block_size=4, decode_burst_hook=hook)
+        built.append(eng)
+        return eng
+
+    fleet = LocalFleet(engine_factory, router=router, heartbeat_s=0.05,
+                       request_timeout_s=request_timeout_s,
+                       heartbeat_timeout_s=1.0)
+    for _ in range(n):
+        fleet.add_endpoint()
+    assert fleet.wait_ready(30)
+    return fleet
+
+
+def _warm_endpoint(fleet, name, prompt, max_new):
+    """Pre-compile one endpoint's decode programs by dispatching to it
+    DIRECTLY (bypassing router placement), so a later migration's
+    resume isn't racing XLA compiles against the silence timeout."""
+    fleet.endpoint(name).submit_generate(prompt, max_new).result(60)
+
+
+def test_stream_migrates_on_burst_kill_resumed_not_restarted(rng,
+                                                             fresh_registry):
+    """THE acceptance scenario, deterministic: the pinned engine's
+    second decode burst dies under the stream (typed DecodeBurstError
+    across the wire) → the router migrates the stream with its
+    journaled prefix → the surviving engine RESUMES (re-prefills
+    prompt + prefix only, pinned via its scheduler's admit event and
+    the resume-prefix counter) → delivered tokens are token-for-token
+    the uninterrupted generate_eager run with zero duplicate/missing
+    offsets."""
+    from deeplearning4j_tpu.faultinject import BurstKill
+    from deeplearning4j_tpu.nn.generate import generate_eager
+    g = gpt(vocab_size=11, d_model=16, n_layers=2, num_heads=2, max_len=64,
+            compute_dtype="float32", learning_rate=0.01).init()
+    for sampler in ({}, {"temperature": 0.8, "top_k": 5, "seed": 3}):
+        reg = monitor.set_registry(monitor.MetricsRegistry())
+        router = InferenceRouter(per_try_timeout_s=15.0,
+                                 eject_backoff_s=0.1, max_attempts=4)
+        kill = BurstKill(after=1, failures=1)
+        fleet = _mk_gpt_fleet(g, router, n=2, hooks=[kill])
+        try:
+            prompt = rng.integers(0, 11, (1, 5))
+            want = generate_eager(g, prompt, 16, **sampler)
+            coll = _Chunks()
+            fut = router.submit_generate(prompt, 16, session="mig",
+                                         on_tokens=coll, **sampler)
+            got = fut.result(90)
+            np.testing.assert_array_equal(got, want)
+            assert coll.tokens() == [int(t) for t in want[0, 5:]]
+            assert kill.hits == 1
+            mreg = monitor.get_registry()
+            assert mreg.family_total(monitor.SESSION_MIGRATIONS_COUNTER) == 1
+            prefix = mreg.family_total(monitor.ROUTER_RESUME_PREFIX_COUNTER)
+            assert prefix > 0  # resumed from the journal, not restarted
+            # the survivor admitted the resume at t0 + prefix — it
+            # prefilled the prefix instead of re-generating it
+            survivor = fleet._members["engine-1"].worker.engine
+            admits = [e for e in survivor._scheduler.events
+                      if e.startswith("admit")]
+            assert len(admits) == 1
+            assert f" t={5 + int(prefix)} " in admits[0], (admits, prefix)
+            snap = router.fleet_snapshot()
+            assert snap["migrations"] == 1
+            assert snap["resume_prefix_tokens"] == int(prefix)
+            assert snap["active_streams"] == 0  # terminal frame landed
+            assert router.session_endpoint("mig") == "engine-1"
+        finally:
+            fleet.shutdown(drain=False)
+            router.close()
+            monitor.set_registry(reg)
+
+
+def test_stream_survives_stalled_endpoint_timeout(rng, fresh_registry):
+    """The wedged-mid-burst shape: the pinned engine stalls (burst
+    gated, no chunks, no reply — but heartbeats keep flowing) → the
+    stream's silence deadline fires → migration with prefix → exact
+    tokens; the stalled engine's LATE chunks are dropped by the
+    dispatch epoch, never double-delivered."""
+    import threading
+    from deeplearning4j_tpu.nn.generate import generate_eager
+
+    class _Gate:
+        def __init__(self):
+            self.ev = threading.Event()
+            self.calls = 0
+
+        def __call__(self, lane, idx):
+            self.calls += 1
+            if self.calls == 2:
+                self.ev.wait(60)
+
+    g = gpt(vocab_size=11, d_model=16, n_layers=2, num_heads=2, max_len=64,
+            compute_dtype="float32", learning_rate=0.01).init()
+    router = InferenceRouter(per_try_timeout_s=3.0, eject_backoff_s=0.1,
+                             max_attempts=4)
+    gate = _Gate()
+    fleet = _mk_gpt_fleet(g, router, n=2, hooks=[gate],
+                          request_timeout_s=3.0)
+    try:
+        prompt = rng.integers(0, 11, (1, 5))
+        want = generate_eager(g, prompt, 16)
+        # warm the survivor — original shape AND the resume shape
+        # (prompt+prefix prefill is a different bucket) — so the
+        # migrated dispatch isn't racing XLA compiles against the
+        # silence budget on a loaded box
+        _warm_endpoint(fleet, "engine-1", prompt, 16)
+        _warm_endpoint(fleet, "engine-1",
+                       rng.integers(0, 11, (1, 10)), 11)
+        coll = _Chunks()
+        fut = router.submit_generate(prompt, 16, session="stall",
+                                     on_tokens=coll)
+        got = fut.result(90)
+        np.testing.assert_array_equal(got, want)
+        gate.ev.set()  # release the stalled engine: late chunks fire
+        time.sleep(0.2)  # ...and are dropped (epoch + swept pending)
+        assert coll.tokens() == [int(t) for t in want[0, 5:]]
+        reg = monitor.get_registry()
+        assert reg.family_total(monitor.SESSION_MIGRATIONS_COUNTER) >= 1
+        assert router.session_endpoint("stall") == "engine-1"
+    finally:
+        gate.ev.set()
+        fleet.shutdown(drain=False)
+        router.close()
+
+
+def test_mid_generation_kill_restarted_stream_matches_eager(rng,
+                                                            fresh_registry):
+    """The satellite regression pinning (pre-journal) behavior for
+    NON-streaming sessions: kill the pinned endpoint mid-generation —
+    the request restarts elsewhere (no journal ⇒ zero resume prefix)
+    and the result still matches eager exactly."""
+    import threading
+    from deeplearning4j_tpu.nn.generate import generate_eager
+
+    class _Gate:
+        def __init__(self):
+            self.ev = threading.Event()
+            self.calls = 0
+
+        def __call__(self, lane, idx):
+            self.calls += 1
+            if self.calls == 2:
+                self.ev.wait(60)
+
+    g = gpt(vocab_size=11, d_model=16, n_layers=2, num_heads=2, max_len=64,
+            compute_dtype="float32", learning_rate=0.01).init()
+    router = InferenceRouter(per_try_timeout_s=1.5, eject_backoff_s=0.1,
+                             max_attempts=4)
+    gate = _Gate()
+    fleet = _mk_gpt_fleet(g, router, n=2, hooks=[gate],
+                          request_timeout_s=1.5)
+    try:
+        prompt = rng.integers(0, 11, (1, 5))
+        want = generate_eager(g, prompt, 16)
+        _warm_endpoint(fleet, "engine-1", prompt, 16)
+        fut = router.submit_generate(prompt, 16, session="res")
+        assert _spin_until(lambda: gate.calls >= 2, timeout=30)
+        kill_endpoint(fleet, "engine-0")  # mid-generation engine death
+        np.testing.assert_array_equal(fut.result(90), want)
+        reg = monitor.get_registry()
+        assert reg.family_total(monitor.SESSION_MIGRATIONS_COUNTER) >= 1
+        # no journal (non-streaming): restarted, not resumed
+        assert reg.family_total(monitor.ROUTER_RESUME_PREFIX_COUNTER) == 0
+        assert router.session_endpoint("res") == "engine-1"
+    finally:
+        gate.ev.set()
+        fleet.shutdown(drain=False)
+        router.close()
+
+
+def test_router_stream_generator_yields_deltas(rng, fresh_registry):
+    from deeplearning4j_tpu.nn.generate import generate_eager
+    g = gpt(vocab_size=11, d_model=16, n_layers=2, num_heads=2, max_len=32,
+            compute_dtype="float32", learning_rate=0.01).init()
+    router = InferenceRouter(per_try_timeout_s=30.0)
+    fleet = _mk_gpt_fleet(g, router, n=1)
+    try:
+        prompt = rng.integers(0, 11, (1, 4))
+        want = generate_eager(g, prompt, 8)
+        toks = []
+        for off, delta in router.stream(prompt, 8, timeout=60):
+            assert off == len(toks)
+            toks.extend(int(t) for t in delta)
+        assert toks == [int(t) for t in want[0, 4:]]
+    finally:
+        fleet.shutdown(drain=False)
+        router.close()
+
+
+# -------------------------------------------- wedged-endpoint watchdog
+
+def test_wedged_endpoint_detected_ejected_migrated(net, rng,
+                                                   fresh_registry):
+    """Heartbeats prove liveness, not progress: a wedged worker (keeps
+    beating, drops every request) is ejected by the progress watchdog
+    BEFORE any reply timeout scores a failure, its in-flight request
+    resolves via timeout → failover, and after healing it probes back
+    into the pool."""
+    from deeplearning4j_tpu.faultinject import WedgeEndpoint
+    router = InferenceRouter(per_try_timeout_s=2.0, eject_backoff_s=0.2,
+                             max_attempts=4, wedge_timeout_s=0.3)
+    fleet = _mk_fleet(net, router, n=2, request_timeout_s=2.0)
+    try:
+        x = rng.standard_normal((1, N_IN)).astype(np.float32)
+        inline = np.asarray(net.output(x))
+        for _ in range(4):
+            router.output(x, timeout=30)
+        victim = "engine-0"
+        with WedgeEndpoint(fleet, victim):
+            fut = router.submit(x)  # may land on the wedged endpoint
+            assert _spin_until(lambda: router.fleet_snapshot()
+                               ["endpoints"][victim]["wedged"], timeout=20)
+            snap = router.fleet_snapshot()
+            assert snap["endpoints"][victim]["alive"]  # still beating!
+            assert not snap["endpoints"][victim]["in_pool"]
+            # the stuck request resolves (timeout → failover), new
+            # traffic avoids the wedge
+            np.testing.assert_array_equal(fut.result(30), inline)
+            np.testing.assert_array_equal(router.output(x, timeout=30),
+                                          inline)
+        # healed: probe reinstates, wedged flag clears
+        def reinstated():
+            router.probe_now()
+            try:
+                router.output(x, timeout=30)
+            except BaseException:
+                return False
+            ep = router.fleet_snapshot()["endpoints"][victim]
+            return ep["in_pool"] and not ep["wedged"]
+        assert _spin_until(reinstated, timeout=30, tick=0.05)
+    finally:
+        fleet.shutdown(drain=False)
+        router.close()
+
+
+# --------------------------------------- scale-down drain vs migration
+
+def test_scale_down_drains_active_stream_zero_token_loss(rng,
+                                                         fresh_registry):
+    """drain_and_stop × migration: removing the endpoint a live stream
+    is pinned to must let the stream FINISH there (every token
+    delivered exactly once, no migration needed) before the goodbye
+    frame; the session re-pins for its next burst."""
+    import threading
+    from deeplearning4j_tpu.nn.generate import generate_eager
+
+    class _Gate:
+        def __init__(self):
+            self.ev = threading.Event()
+            self.calls = 0
+
+        def __call__(self, lane, idx):
+            self.calls += 1
+            if self.calls == 2:
+                self.ev.wait(60)
+
+    g = gpt(vocab_size=11, d_model=16, n_layers=2, num_heads=2, max_len=64,
+            compute_dtype="float32", learning_rate=0.01).init()
+    router = InferenceRouter(per_try_timeout_s=30.0)
+    gate = _Gate()
+    fleet = _mk_gpt_fleet(g, router, n=2, hooks=[gate])
+    try:
+        prompt = rng.integers(0, 11, (1, 5))
+        want = generate_eager(g, prompt, 16)
+        coll = _Chunks()
+        fut = router.submit_generate(prompt, 16, session="sd",
+                                     on_tokens=coll)
+        assert _spin_until(lambda: gate.calls >= 2, timeout=30)
+        assert router.session_endpoint("sd") == "engine-0"
+        # scale down the pinned endpoint while the stream is gated
+        done = []
+        th = threading.Thread(
+            target=lambda: done.append(fleet.remove_endpoint("engine-0")))
+        th.start()
+        time.sleep(0.2)
+        assert not fut.done()  # drain is WAITING on the live stream
+        gate.ev.set()          # release: the stream finishes on the drainer
+        got = fut.result(90)
+        th.join(60)
+        np.testing.assert_array_equal(got, want)
+        assert coll.tokens() == [int(t) for t in want[0, 5:]]
+        # zero-loss hand-off: no migration was needed for the stream
+        reg = monitor.get_registry()
+        assert reg.family_total(monitor.ROUTER_RESUME_PREFIX_COUNTER) == 0
+        # the session's NEXT burst lands on the survivor
+        y = router.generate(prompt, 8, session="sd", timeout=90)
+        np.testing.assert_array_equal(y, generate_eager(g, prompt, 8))
+        assert router.session_endpoint("sd") == "engine-1"
+    finally:
+        gate.ev.set()
+        fleet.shutdown(drain=False)
+        router.close()
+
+
+# ----------------------------------------------- wire protocol version
+
+def test_wire_version_skew_rejected_typed(net, rng, fresh_registry):
+    """A frame from a NEWER protocol is rejected with a typed
+    WireVersionError reply — never served garbled. Pinned end-to-end:
+    a crafted v99 request through a live worker surfaces the SAME
+    exception class at the endpoint's future."""
+    from deeplearning4j_tpu.serving import wire
+    # unit: check_version + typed roundtrip
+    with pytest.raises(wire.WireVersionError):
+        wire.check_version({"v": wire.WIRE_VERSION + 1})
+    wire.check_version({})          # legacy v1 headers stay accepted
+    header, _ = wire.unpack_reply(
+        wire.pack_reply("c", error=wire.WireVersionError("skew")))
+    assert isinstance(wire.typed_error(header), wire.WireVersionError)
+    # end-to-end: live worker rejects a v99 frame typed
+    eng = ParallelInference(net, max_batch_size=4, replicas=1)
+    broker = InMemoryBroker()
+    worker = EngineWorker(eng, broker, "vskew", heartbeat_s=0.05)
+    ep = RemoteEndpoint(broker, "vskew", request_timeout_s=10.0)
+    try:
+        assert _spin_until(ep.alive, timeout=10)
+        x = rng.standard_normal((1, N_IN)).astype(np.float32)
+        fut = ep.submit(x)
+        corr = list(ep._pending)[0]
+        # re-publish the same correlation id as a FUTURE-version frame
+        import json as _json
+        import struct as _struct
+        payload = wire.pack_request(corr, ep.reply_topic,
+                                    wire.KIND_CLASSIFY, x)
+        hlen = _struct.unpack(">I", payload[:4])[0]
+        hdr = _json.loads(payload[4:4 + hlen])
+        hdr["v"] = 99
+        h = _json.dumps(hdr, separators=(",", ":")).encode()
+        broker.publish("vskew" + wire.REQ_SUFFIX,
+                       _struct.pack(">I", len(h)) + h + payload[4 + hlen:])
+        with pytest.raises(wire.WireVersionError):
+            fut.result(30)
+    finally:
+        ep.close()
+        worker.kill()
+        eng.shutdown(drain=False)
+
+
+# ------------------------------------------- stream metrics + healthz
+
+def test_stream_metric_schema_and_healthz_counts(rng, fresh_registry):
+    import scripts.check_telemetry_schema as schema
+    from deeplearning4j_tpu.nn.generate import generate_eager
+    for name in ("dl4j_stream_chunks_total",
+                 "dl4j_session_migrations_total",
+                 "dl4j_session_journal_bytes",
+                 "dl4j_router_resume_prefix_tokens_total"):
+        assert name in schema.KNOWN_DL4J_METRICS, name
+    from deeplearning4j_tpu.faultinject import BurstKill
+    g = gpt(vocab_size=11, d_model=16, n_layers=2, num_heads=2, max_len=64,
+            compute_dtype="float32", learning_rate=0.01).init()
+    router = InferenceRouter(per_try_timeout_s=15.0, eject_backoff_s=0.1,
+                             max_attempts=4)
+    fleet = _mk_gpt_fleet(g, router, n=2,
+                          hooks=[BurstKill(after=1, failures=1)])
+    try:
+        prompt = rng.integers(0, 11, (1, 5))
+        want = generate_eager(g, prompt, 16)
+        fut = router.submit_generate(prompt, 16, session="m",
+                                     on_tokens=lambda o, t: None)
+        np.testing.assert_array_equal(fut.result(90), want)
+        text = fresh_registry.prometheus_text()
+        assert schema.validate_prometheus_text(text) == []
+        assert schema.validate_known_metrics(text) == []
+        for family in ("dl4j_stream_chunks_total",
+                       "dl4j_session_migrations_total",
+                       "dl4j_session_journal_bytes",
+                       "dl4j_router_resume_prefix_tokens_total"):
+            assert f"# TYPE {family}" in text, family
+        assert 'reason="burst_error"' in text
+        snap = router.fleet_snapshot()
+        for key in ("active_streams", "journal_bytes", "migrations",
+                    "resume_prefix_tokens"):
+            assert key in snap, key
+        assert snap["migrations"] == 1
+    finally:
+        fleet.shutdown(drain=False)
+        router.close()
+
+
 # ---------------------- session (endpoint, model, version) vs cutover
 
 def test_router_session_pins_endpoint_model_and_version(fresh_registry):
