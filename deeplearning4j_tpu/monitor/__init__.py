@@ -49,7 +49,11 @@ nn/kvpool.py) publishes ``dl4j_kvpool_blocks_total`` /
 (paged KV pool occupancy and exhaustion) and the ``dl4j_sched_*``
 family (rows admitted/retired between bursts, preemptions, burst
 count + latency histogram, active-sequence and queued-prefill gauges)
-— the iteration-level decode scheduler's health at a glance.
+— the iteration-level decode scheduler's health at a glance. The
+cross-request prefix cache (serving/prefixcache.py) adds the
+``dl4j_prefixcache_*`` family: hit/miss/eviction/copy-on-write
+counters, cached/shared block gauges, and the prompt tokens whose
+prefill was skipped because their KV blocks were already cached.
 
 The horizontal serving tier (serving/router.py ``InferenceRouter``)
 publishes ``dl4j_router_requests_total`` (by ``priority`` class),
@@ -145,6 +149,24 @@ SCHED_BURSTS_COUNTER = "dl4j_sched_bursts_total"
 SCHED_BURST_LATENCY_HISTOGRAM = "dl4j_sched_burst_latency_ms"
 SCHED_ACTIVE_GAUGE = "dl4j_sched_active_sequences"
 SCHED_QUEUED_GAUGE = "dl4j_sched_queued_prefills"
+
+# Cross-request prefix cache (serving/prefixcache.py PrefixCache over
+# the refcounted paged pool): admission probes that matched a cached
+# block-aligned prefix (hits) vs found nothing (misses), deterministic
+# LRU evictions of cached-but-unreferenced blocks, copy-on-write block
+# duplications (a writer's refcount>1 partial tail block copied before
+# its scatter lands), live gauges for blocks the cache holds pinned and
+# blocks currently shared by more than one holder, and the cumulative
+# prompt tokens whose prefill was SKIPPED because their K/V was already
+# cached — the prefill-FLOP savings the bench reports.
+PREFIXCACHE_HITS_COUNTER = "dl4j_prefixcache_hits_total"
+PREFIXCACHE_MISSES_COUNTER = "dl4j_prefixcache_misses_total"
+PREFIXCACHE_EVICTIONS_COUNTER = "dl4j_prefixcache_evictions_total"
+PREFIXCACHE_COW_COPIES_COUNTER = "dl4j_prefixcache_cow_copies_total"
+PREFIXCACHE_CACHED_BLOCKS_GAUGE = "dl4j_prefixcache_cached_blocks"
+PREFIXCACHE_SHARED_BLOCKS_GAUGE = "dl4j_prefixcache_shared_blocks"
+PREFIXCACHE_SAVED_TOKENS_COUNTER = \
+    "dl4j_prefixcache_saved_prefill_tokens_total"
 
 # Horizontal serving tier (serving/router.py InferenceRouter — the
 # fleet-level plane above ParallelInference): request volume by

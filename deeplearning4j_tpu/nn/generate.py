@@ -426,6 +426,55 @@ class TransformerGenerator(_GeneratorBase):
         return self._jit(("gen_pool_scatter", rows, t_blk, block_size),
                          builder, donate=(0,))
 
+    def tail_prefill_program(self, rows: int, t_tail: int, tier: int,
+                             num_blocks: int, block_size: int):
+        """Prefill ONLY a prompt's uncached tail through the paged pool
+        (the prefix-cache admission path): each row's table carries its
+        matched cached blocks followed by its fresh tail blocks, tail
+        token positions enter as per-row traced ``starts`` (any cached
+        prefix length reuses this one program — the bucket doctrine
+        applied to cache hits), tail K/V scatters into the fresh blocks
+        and attention runs tail-queries × whole-table causally. Returns
+        (pools, last-tail-token logits) — the logits the admission
+        sampler needs for tok0. Shape = (rows × t_tail bucket × tier),
+        a small AOT-warmable ladder like every other program here."""
+        def builder():
+            def tail_prefill(params, pools, ids, starts, lens, tables):
+                p_emb = self._cast(params[self.emb.name])
+                pos = starts[:, None] + jnp.arange(t_tail)[None, :]
+                x = jnp.take(p_emb["W"], ids, axis=0) \
+                    + jnp.take(p_emb["P"], pos, axis=0)
+                write_ok = jnp.arange(t_tail)[None, :] < lens[:, None]
+                new_pools = []
+                for blk, pool in zip(self.blocks, pools):
+                    x, pool = blk.prefill_paged(
+                        self._cast(params[blk.name]), x, pool, tables,
+                        pos, write_ok)
+                    new_pools.append(pool)
+                last = x[jnp.arange(x.shape[0]), jnp.maximum(lens - 1, 0)]
+                return new_pools, self._head_logits(params, last)
+            return tail_prefill
+        return self._jit(("gen_tail_prefill", rows, t_tail, tier,
+                          num_blocks, block_size), builder, donate=(1,))
+
+    def block_copy_program(self, n: int, num_blocks: int, block_size: int):
+        """Copy-on-write: duplicate ``n`` pool blocks (src → dst ids,
+        traced) across every layer's K/V pools in one dispatch — the
+        copy a writer makes before scattering into a refcount>1 partial
+        tail block. Bitwise block clones; interior shared blocks are
+        never written, so this is the ONLY mutation sharing needs."""
+        def builder():
+            def copy(pools, src, dst):
+                out = []
+                for pool in pools:
+                    out.append({
+                        "k": pool["k"].at[dst].set(pool["k"][src]),
+                        "v": pool["v"].at[dst].set(pool["v"][src])})
+                return out
+            return copy
+        return self._jit(("gen_block_copy", n, num_blocks, block_size),
+                         builder, donate=(0,))
+
     def row_sample_program(self):
         """One rowwise-sampler dispatch off prefill logits: per-row
         keys, fold indices (a resumed sequence continues its own token

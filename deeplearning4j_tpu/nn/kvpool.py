@@ -29,7 +29,23 @@ Layout invariants:
 - accounting is host-side only — freed blocks are NOT zeroed on
   device; a freed block's garbage is only ever re-read after the next
   owner's prefill/decode has overwritten the positions its causal mask
-  exposes (the same invariant the dense prefill documents).
+  exposes (the same invariant the dense prefill documents);
+- blocks are **refcounted** (PR 11, the vLLM copy-on-write
+  discipline): ``alloc`` hands a block out at refcount 1,
+  :meth:`share_blocks` lets another holder (a cross-request prefix
+  cache, a sequence reusing a cached prefix) take an extra reference,
+  and :meth:`free_blocks` only returns a block to the free list when
+  its LAST reference drops — so "free" means "nobody can read this",
+  never "someone might still gather it". A holder that wants to WRITE
+  into a block with refcount > 1 must copy it first (copy-on-write —
+  the scheduler's partial-tail-block path; full interior blocks are
+  immutable once written). Releasing an unreferenced block is a
+  **double free** and raises — the invariant the chaos drill pins;
+- the free list is unified with cache eviction: a registered
+  **reclaimer** (``register_reclaimer``) is consulted when ``alloc``
+  finds the free list short, so cached-but-unreferenced blocks are
+  reclaimable memory, not leaks — eviction feeds the same sorted
+  lowest-id-first free list that deterministic replay depends on.
 
 The pool publishes ``dl4j_kvpool_blocks_total`` /
 ``dl4j_kvpool_blocks_free`` gauges and
@@ -112,6 +128,15 @@ class PagedKVCachePool:
         self._free: List[int] = list(range(1, self.num_blocks))
         self._lock = threading.Lock()
         self._alloc_failures = 0
+        # block id -> reference count; a block is in EXACTLY one of
+        # (_free, _refs). alloc() creates refcount 1; share_blocks()
+        # adds holders; free_blocks() drops one reference per call and
+        # only the last drop returns the block to the free list.
+        self._refs: Dict[int, int] = {}
+        # cache-eviction seam: called (n_short) OUTSIDE the lock when
+        # alloc finds the free list short; returns blocks to the free
+        # list (via free_blocks) so the retry below can claim them
+        self._reclaimer = None
         self._publish()
 
     # ------------------------------------------------------- accounting
@@ -131,20 +156,28 @@ class PagedKVCachePool:
         return max(0, math.ceil(int(tokens) / self.block_size))
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Claim ``n`` blocks (lowest free ids first — deterministic),
-        or None when the pool cannot cover them (nothing is claimed;
-        the failure counter ticks — the scheduler's preempt signal)."""
+        """Claim ``n`` blocks at refcount 1 (lowest free ids first —
+        deterministic), or None when the pool cannot cover them
+        (nothing is claimed; the failure counter ticks — the
+        scheduler's preempt signal). When a reclaimer is registered
+        (the prefix cache), a short free list first asks it to evict
+        cached-but-unreferenced blocks — cache memory yields to live
+        demand before preemption ever runs."""
         n = int(n)
         if n <= 0:
             return []
-        with self._lock:
-            if n > len(self._free):
-                self._alloc_failures += 1
-                got = None
-            else:
-                got = self._free[:n]
-                del self._free[:n]
+        got = self._try_alloc(n)
+        if got is None and self._reclaimer is not None:
+            with self._lock:
+                short = n - len(self._free)
+            try:
+                self._reclaimer(short)
+            except BaseException:  # a broken evictor must not kill alloc
+                pass
+            got = self._try_alloc(n)
         if got is None:
+            with self._lock:
+                self._alloc_failures += 1
             get_registry().counter(
                 KVPOOL_ALLOC_FAILURES_COUNTER,
                 "KV block allocations that found the pool exhausted",
@@ -152,9 +185,46 @@ class PagedKVCachePool:
         self._publish()
         return got
 
+    def _try_alloc(self, n: int) -> Optional[List[int]]:
+        with self._lock:
+            if n > len(self._free):
+                return None
+            got = self._free[:n]
+            del self._free[:n]
+            for b in got:
+                self._refs[b] = 1
+        return got
+
+    def share_blocks(self, ids: List[int]) -> List[int]:
+        """Take one extra reference on each (allocated) block — the
+        sharing half of copy-on-write: a prefix cache pinning a retired
+        sequence's blocks, or an admitted sequence cloning the block
+        table of its matched prefix. Sharing a free (or trash) block is
+        an accounting bug and raises. Returns ``ids`` for chaining."""
+        with self._lock:
+            for b in ids:
+                b = int(b)
+                if b <= 0 or b >= self.num_blocks:
+                    raise ValueError(f"block id {b} is not allocatable")
+                if b not in self._refs:
+                    raise ValueError(
+                        f"block {b} is free — cannot share an unowned "
+                        f"block (pool {self.name!r})")
+            for b in ids:
+                self._refs[int(b)] += 1
+        return list(ids)
+
+    def ref_count(self, block: int) -> int:
+        """Current reference count (0 = free). A writer seeing > 1 on
+        its target block must copy-on-write before its scatter lands."""
+        with self._lock:
+            return self._refs.get(int(block), 0)
+
     def free_blocks(self, ids: List[int]) -> None:
-        """Return blocks to the pool (kept sorted so replayed schedules
-        re-allocate identically)."""
+        """Drop ONE reference per listed block; blocks whose last
+        reference drops return to the free list (kept sorted so
+        replayed schedules re-allocate identically). Dropping a
+        reference on a free block is a double free and raises."""
         if not ids:
             return
         with self._lock:
@@ -162,13 +232,41 @@ class PagedKVCachePool:
                 b = int(b)
                 if b <= 0 or b >= self.num_blocks:
                     raise ValueError(f"block id {b} is not allocatable")
-            self._free.extend(int(b) for b in ids)
+                if b not in self._refs:
+                    raise RuntimeError(
+                        f"pool {self.name!r}: double free of block {b} "
+                        f"(refcount already 0)")
+            released = []
+            for b in ids:
+                b = int(b)
+                r = self._refs[b] - 1
+                if r == 0:
+                    del self._refs[b]
+                    released.append(b)
+                else:
+                    self._refs[b] = r
+            self._free.extend(released)
             self._free.sort()
-            if len(self._free) > self.total_blocks:
+            if len(self._free) + len(self._refs) > self.total_blocks:
                 raise RuntimeError(
                     f"pool {self.name!r} over-freed: {len(self._free)} free "
-                    f"of {self.total_blocks} allocatable (double free)")
+                    f"+ {len(self._refs)} referenced of {self.total_blocks} "
+                    f"allocatable (double free)")
         self._publish()
+
+    def register_reclaimer(self, fn) -> None:
+        """Install the eviction seam ``fn(n_short) -> int`` consulted
+        (outside the pool lock) when ``alloc`` finds the free list
+        short — the prefix cache registers itself here so its
+        cached-but-unreferenced blocks are reclaimable memory."""
+        self._reclaimer = fn
+
+    def shared_count(self) -> int:
+        """Blocks currently held by more than one reference (live
+        prefix sharing — what ``dl4j_prefixcache_shared_blocks``
+        reports)."""
+        with self._lock:
+            return sum(1 for r in self._refs.values() if r > 1)
 
     def occupancy(self) -> float:
         with self._lock:
@@ -179,11 +277,19 @@ class PagedKVCachePool:
         with self._lock:
             free = len(self._free)
             failures = self._alloc_failures
+            shared = sum(1 for r in self._refs.values() if r > 1)
         return {"blocks_total": self.total_blocks, "blocks_free": free,
                 "block_size": self.block_size,
                 "occupancy": ((self.total_blocks - free) / self.total_blocks
                               if self.total_blocks else 0.0),
+                "shared_blocks": shared,
                 "alloc_failures": failures}
+
+    def block_bytes(self) -> int:
+        """Device bytes one logical block occupies across every layer's
+        K and V pools — what cache-occupancy summaries report."""
+        return int(2 * self.num_layers * self.block_size * self.num_heads
+                   * self.head_dim * self.dtype.itemsize)
 
     # ----------------------------------------------------- device arrays
 

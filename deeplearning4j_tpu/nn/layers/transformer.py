@@ -188,6 +188,54 @@ class TransformerBlockImpl(LayerImpl):
                            capacity_factor=float(max(1, c.num_experts)))
         return x + mlp.reshape(b, t, d), {"k": ck, "v": cv}
 
+    def prefill_paged(self, params, x, pool, table, pos, write_ok):
+        """Chunked (tail) prefill straight through the paged pool — the
+        prefix-cache admission path: the prompt's cached prefix already
+        lives in pool blocks, so only the TAIL runs here. ``x`` is
+        [b, t, d] tail activations, ``pos`` [b, t] each tail token's
+        ABSOLUTE cache position (per-row ``start + j`` — the cached
+        prefix length enters traced, so one compiled program serves any
+        match-length mix), ``write_ok`` [b, t] masks padding positions
+        (their writes redirect to trash block 0, the ``decode_step``
+        discipline). Tail K/V scatters into the row's table blocks
+        FIRST, then attention gathers the whole table back — so tail
+        self-attention sees its own fresh K/V and the cached prefix in
+        one causal pass. Gathered positions past each query's ``pos``
+        (stale partial-block content, trash padding) are causally
+        masked, numerically inert exactly like the dense path's padded
+        tail. Returns ([b, t, d] out, new pool {"k", "v"})."""
+        c = self.conf
+        b, t, d = x.shape
+        h_count, hd = c.num_heads, c.n_out // c.num_heads
+        h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
+        qkv = h @ params["Wqkv"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = lambda z: z.reshape(b, t, h_count, hd)
+        q, k, v = shape(q), shape(k), shape(v)
+        kp, vp = pool["k"], pool["v"]        # [NB, bs, h, hd] shared pool
+        bs = kp.shape[1]
+        mb = table.shape[1]
+        blk = jnp.take_along_axis(table, pos // bs, axis=1)     # [b, t]
+        off = pos % bs
+        blk = jnp.where(write_ok, blk, 0)    # padding → trash block
+        off = jnp.where(write_ok, off, 0)
+        kp = kp.at[blk, off].set(k.astype(kp.dtype))
+        vp = vp.at[blk, off].set(v.astype(vp.dtype))
+        kg = jnp.take(kp, table, axis=0).reshape(b, mb * bs, *kp.shape[2:])
+        vg = jnp.take(vp, table, axis=0).reshape(b, mb * bs, *vp.shape[2:])
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, q.dtype))
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kg.astype(q.dtype)) * scale
+        live = jnp.arange(mb * bs)[None, None, :] <= pos[:, :, None]
+        s = jnp.where(live[:, None], s,
+                      jnp.asarray(jnp.finfo(s.dtype).min, s.dtype))
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", w, vg.astype(q.dtype))
+        x = x + o.reshape(b, t, d) @ params["Wo"].astype(x.dtype)
+        h2 = _layer_norm(x, params["ln2_g"], params["ln2_b"])
+        mlp, _ = self._ffn(params, h2.reshape(-1, d), {},
+                           capacity_factor=float(max(1, c.num_experts)))
+        return x + mlp.reshape(b, t, d), {"k": kp, "v": vp}
+
     def decode_step(self, params, x_t, cache, pos, write_mask=None):
         """One-token forward [b, d] with cached keys/values; ``pos`` is
         the (traced) current position — a scalar (whole-batch position)

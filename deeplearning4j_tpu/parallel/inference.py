@@ -331,7 +331,9 @@ class ParallelInference:
                  decode_burst: int = 8,
                  kv_block_size: int = 16,
                  kv_blocks: Optional[int] = None,
-                 decode_burst_hook=None):
+                 decode_burst_hook=None,
+                 prefix_cache: bool = False,
+                 prefix_cache_blocks: Optional[int] = None):
         if net is None and registry is None:
             raise ValueError("ParallelInference needs a net or a registry")
         if net is not None and registry is not None:
@@ -426,6 +428,15 @@ class ParallelInference:
         self.kv_block_size = int(kv_block_size)
         self.kv_blocks = kv_blocks
         self._decode_burst_hook = decode_burst_hook
+        # cross-request prefix cache (serving/prefixcache.py): cache-hit
+        # admissions clone their matched prefix's block table and
+        # prefill only the tail; requires continuous=True
+        self.prefix_cache = bool(prefix_cache)
+        self.prefix_cache_blocks = prefix_cache_blocks
+        if self.prefix_cache and not self.continuous:
+            raise ValueError(
+                "prefix_cache=True rides the paged-pool scheduler: "
+                "build the engine with continuous=True")
         self._scheduler = None
         if start:
             self.start()
@@ -571,6 +582,8 @@ class ParallelInference:
                 queue_capacity=self._rq.maxsize,
                 burst_hook=self._decode_burst_hook,
                 on_resolve=self._note_resolved,
+                prefix_cache=self.prefix_cache,
+                prefix_cache_blocks=self.prefix_cache_blocks,
                 start=self._started)
         return sched
 
@@ -680,7 +693,8 @@ class ParallelInference:
                         top_k: int = 0, top_p: float = 0.0,
                         eos_token: Optional[int] = None,
                         model: Optional[str] = None,
-                        version: Optional[int] = None) -> int:
+                        version: Optional[int] = None,
+                        tail_lengths=None) -> int:
         """AOT-compile the decode program set: for every prompt-length
         bucket covering ``prompt_lengths``, run a zero-prompt batch of
         every row-bucket size on every replica (prefill + decode).
@@ -697,7 +711,8 @@ class ParallelInference:
             if model is not None:
                 v = self._registry.resolve(model, version)
             return self._continuous_scheduler().warmup(
-                prompt_lengths, int(max_new_tokens), model=model, version=v)
+                prompt_lengths, int(max_new_tokens), model=model, version=v,
+                tail_lengths=tail_lengths)
         mv = None
         if model is not None:
             v = self._registry.resolve(model, version)

@@ -21,6 +21,15 @@ wire-v2 token chunks, the router journals them per stream, and an
 engine death mid-generation migrates the stream (re-pin + resume from
 prompt + journaled prefix) with append-only delivery — no lost, no
 duplicated token, output equal to an uninterrupted run.
+
+Prompts are CACHED across requests: with ``prefix_cache=True`` the
+continuous scheduler indexes retired sequences' KV blocks in a
+:class:`~deeplearning4j_tpu.serving.prefixcache.PrefixCache` radix
+tree (per model-version lane, copy-on-write shared blocks,
+deterministic LRU eviction unified with the pool free list), so an
+admitted prompt clones its longest matched prefix's block table and
+prefills only the tail — bitwise-identical output at a fraction of
+the prefill FLOPs, and warm-cache migrations degrade to a table clone.
 """
 
 from deeplearning4j_tpu.serving.continuous import (  # noqa: F401
@@ -46,6 +55,7 @@ from deeplearning4j_tpu.serving.policy import (  # noqa: F401
     ScaleDecision,
     ScalePolicy,
 )
+from deeplearning4j_tpu.serving.prefixcache import PrefixCache  # noqa: F401
 from deeplearning4j_tpu.serving.router import (  # noqa: F401
     InferenceRouter,
     RetryAfter,

@@ -37,7 +37,21 @@ is the fix:
   own batch slots (a dispatched burst runs one params pytree), but
   lanes whose nets share a KV layout share ONE pool — a sequence's
   blocks and version stay pinned across bursts through a PR-7 canary
-  cutover, while stable and canary recycle the same block budget.
+  cutover, while stable and canary recycle the same block budget;
+- **cross-request prefix cache** (``prefix_cache=True``; off by
+  default — it deliberately retains blocks past drain): retiring and
+  preempted sequences insert their written token runs into a
+  :class:`~deeplearning4j_tpu.serving.prefixcache.PrefixCache` radix
+  index per (model, version) lane; an admitted prompt CLONES the block
+  table of its longest matched prefix (pool refcounts — the sharer
+  frees only its private tail) and prefills ONLY the uncached tail
+  through ``tail_prefill_program`` (matched partial tail blocks are
+  copy-on-written before the scatter lands), and ``prefix=`` resume
+  rows probe the index like any admission, so a migration against a
+  warm cache degrades to a table clone plus the journaled suffix.
+  Output stays bitwise identical to the uncached run — the cache only
+  ever substitutes K/V a prefill of the same tokens at the same
+  positions would have written.
 
 ``ParallelInference(continuous=True)`` routes ``submit_generate``
 through a scheduler; the scheduler is also usable standalone (and
@@ -71,7 +85,7 @@ from deeplearning4j_tpu.monitor import (
     record_fault,
     span,
 )
-from deeplearning4j_tpu.datasets.iterators import bucket_for
+from deeplearning4j_tpu.datasets.iterators import bucket_for, bucket_sizes
 from deeplearning4j_tpu.nn.generate import (
     TransformerGenerator,
     build_generator,
@@ -179,6 +193,25 @@ class _Seq:
         return self.req.max_new - self.n_gen
 
 
+class _AdmitPlan:
+    """One admission's claimed resources: ``blocks`` in table order
+    (shared prefix blocks first — the cloned table — then the COW'd
+    partial, then fresh tail blocks), ``start`` cached tokens the tail
+    prefill skips, the pending ``cow_src`` shared-partial reference
+    (released once the device copy lands), and the group ``sig`` that
+    decides which admissions coalesce into one prefill dispatch."""
+
+    __slots__ = ("seq", "blocks", "start", "cow_src", "sig")
+
+    def __init__(self, seq: _Seq, blocks: List[int], start: int,
+                 cow_src: Optional[int], sig: Tuple):
+        self.seq = seq
+        self.blocks = blocks
+        self.start = start
+        self.cow_src = cow_src
+        self.sig = sig
+
+
 class _Lane:
     """The per-(model, version) slot batch: one params pytree per
     dispatched burst, host-mirrored slot state vectors, and a shared
@@ -241,6 +274,16 @@ class ContinuousDecodeScheduler:
     preemption unless oversubscribed), ``queue_capacity`` bounded
     admission (full queue sheds with ``InferenceBackpressure``).
 
+    ``prefix_cache=True`` turns on the cross-request prefix cache
+    (``serving/prefixcache.py``): retiring/preempted sequences index
+    their KV blocks per (model, version) lane, admissions clone their
+    longest matched prefix's table and prefill only the tail, and
+    ``prefix_cache_blocks`` optionally caps the cached-block budget
+    (pool pressure evicts regardless, deterministically). Off by
+    default — the cache retains blocks past drain by design, so the
+    drained-pool audit becomes ``free + cached == total`` (use
+    :meth:`prefix_caches` + ``clear()`` for the strict check).
+
     ``start=False`` skips the scheduler thread; tests drive
     :meth:`step` directly for fully deterministic schedules. The
     ``burst_hook(lane_key, burst_index)`` seam lets the faultinject
@@ -252,7 +295,9 @@ class ContinuousDecodeScheduler:
                  burst_tokens: int = 8, block_size: int = 16,
                  num_blocks: Optional[int] = None,
                  queue_capacity: int = 256, admit_rows: int = 4,
-                 start: bool = True, burst_hook=None, on_resolve=None):
+                 start: bool = True, burst_hook=None, on_resolve=None,
+                 prefix_cache: bool = False,
+                 prefix_cache_blocks: Optional[int] = None):
         if net is None and registry is None:
             raise ValueError(
                 "ContinuousDecodeScheduler needs a net or a registry")
@@ -291,6 +336,15 @@ class ContinuousDecodeScheduler:
         self._slot_ladder = pow2_ladder(self.slots)
         self._burst_hook = burst_hook
         self._on_resolve = on_resolve
+        # cross-request prefix caching: one PrefixCache per pool spec,
+        # lane-keyed radix roots inside (a canary never matches the
+        # stable's cache). Off by default: the cache RETAINS blocks
+        # past drain by design, which changes the free==total audit.
+        self.prefix_cache = bool(prefix_cache)
+        self._prefix_cache_blocks = prefix_cache_blocks
+        self._caches: Dict[Tuple, Any] = {}
+        self._prefill_computed_tokens = 0
+        self._resume_reprefill_tokens = 0
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: Deque[_Seq] = deque()
@@ -427,15 +481,37 @@ class ContinuousDecodeScheduler:
                 "preemptions": self._preemptions,
                 "bursts": self._bursts,
                 "warmed": self._warmed,
+                "prefill_tokens_computed": self._prefill_computed_tokens,
+                "resume_reprefill_tokens": self._resume_reprefill_tokens,
             }
+            caches = [c for _, c in sorted(self._caches.items(),
+                                           key=lambda kv: repr(kv[0]))]
         agg = {"blocks_total": sum(p["blocks_total"] for p in pools),
                "blocks_free": sum(p["blocks_free"] for p in pools),
+               "shared_blocks": sum(p.get("shared_blocks", 0)
+                                    for p in pools),
                "alloc_failures": sum(p["alloc_failures"] for p in pools)}
         agg["occupancy"] = (
             (agg["blocks_total"] - agg["blocks_free"]) / agg["blocks_total"]
             if agg["blocks_total"] else 0.0)
         out["pool"] = agg
         out["pools"] = pools
+        if self.prefix_cache:
+            cs = [c.stats() for c in caches]
+            hits = sum(c["hits"] for c in cs)
+            misses = sum(c["misses"] for c in cs)
+            out["prefix_cache"] = {
+                "cached_blocks": sum(c["cached_blocks"] for c in cs),
+                "cached_bytes": sum(c["cached_bytes"] for c in cs),
+                "shared_blocks": sum(c["shared_blocks"] for c in cs),
+                "hits": hits, "misses": misses,
+                "hit_rate": hits / (hits + misses) if hits + misses
+                else 0.0,
+                "evictions": sum(c["evictions"] for c in cs),
+                "cow_copies": sum(c["cow_copies"] for c in cs),
+                "saved_prefill_tokens": sum(c["saved_prefill_tokens"]
+                                            for c in cs),
+            }
         return out
 
     def drain(self, timeout: Optional[float] = None,
@@ -480,7 +556,8 @@ class ContinuousDecodeScheduler:
 
     def warmup(self, prompt_lengths, max_new_tokens: int = 1,
                model: Optional[str] = None,
-               version: Optional[int] = None) -> int:
+               version: Optional[int] = None,
+               tail_lengths=None) -> int:
         """AOT-compile the continuous-decode program set for one lane:
         the rowwise sampler, every covering prompt bucket's prefill +
         pool scatter, and THE burst program (its (slots × K ×
@@ -549,6 +626,48 @@ class ContinuousDecodeScheduler:
                     for sampling in (False, True):
                         self._dispatch_burst(lane, params, tier=tier,
                                              sampling=sampling, rows=rows)
+            if self.prefix_cache:
+                # cache-hit admissions dispatch the COW block copy and
+                # the tail-prefill ladder: every (admit rows × tail
+                # bucket × block tier) shape a warmed prompt mix can
+                # produce. All-trash tables: accounting untouched.
+                # ``tail_lengths`` narrows the ladder to the tails the
+                # caller's workload actually yields (a bench's shared
+                # preamble pins the match length, so the full product
+                # would mostly warm programs that never dispatch).
+                cp = lane.gen.block_copy_program(1, pool.num_blocks,
+                                                 self.block_size)
+                note_dispatch(lane.net, ("gen_block_copy", "sched", 1))
+                pool.set_layers(cp(pool.layers, np.zeros(1, np.int32),
+                                   np.zeros(1, np.int32)))
+                sizes = bucket_sizes(gen.max_context())
+                if tail_lengths is None:
+                    max_tail = max(int(t) for t in prompt_lengths)
+                    tail_buckets = sorted({
+                        bucket_for(t, sizes)
+                        for t in range(1, max_tail + 1)})
+                    tiers = list(self._burst_tiers(lane))
+                else:
+                    tail_buckets = sorted({
+                        bucket_for(int(t), sizes) for t in tail_lengths})
+                    tiers = sorted({
+                        self._tier_cover(lane, pool.blocks_for(int(t)))
+                        for t in prompt_lengths})
+                for t_tail in tail_buckets:
+                    for tier in tiers:
+                        for rows in self._admit_ladder:
+                            tp = gen.tail_prefill_program(
+                                rows, t_tail, tier, pool.num_blocks,
+                                self.block_size)
+                            note_dispatch(
+                                lane.net, ("gen_tail_prefill", "sched",
+                                           rows, t_tail, tier))
+                            pool.set_layers(tp(
+                                params, pool.layers,
+                                np.zeros((rows, t_tail), np.int32),
+                                np.zeros(rows, np.int32),
+                                np.full(rows, t_tail, np.int32),
+                                np.zeros((rows, tier), np.int32))[0])
         self._warmed = True
         return int(reg.family_total(JIT_CACHE_MISS_COUNTER) - before)
 
@@ -618,11 +737,29 @@ class ContinuousDecodeScheduler:
                     dtype, device=self.device,
                     name=model if model is not None else "decode")
                 self._pools[spec] = pool
+                if self.prefix_cache:
+                    from deeplearning4j_tpu.serving.prefixcache import \
+                        PrefixCache
+                    self._caches[spec] = PrefixCache(
+                        pool, capacity_blocks=self._prefix_cache_blocks)
             lane = self._lanes.get(key)
             if lane is None:
                 lane = _Lane(key, net, gen, pool, self.slots)
                 self._lanes[key] = lane
         return lane
+
+    def _cache_of(self, lane: _Lane):
+        """The lane's PrefixCache (None when prefix caching is off)."""
+        if not self.prefix_cache:
+            return None
+        return self._caches.get(lane.pool.spec)
+
+    def prefix_caches(self):
+        """Every live PrefixCache (spec-sorted) — drain-time audits
+        ``clear()`` them to prove free==total with zero double-frees."""
+        with self._lock:
+            return [c for _, c in sorted(self._caches.items(),
+                                         key=lambda kv: repr(kv[0]))]
 
     def _params(self, lane: _Lane):
         model, version = lane.key
@@ -644,44 +781,108 @@ class ContinuousDecodeScheduler:
 
     def _admit(self) -> bool:
         """Admit queued sequences FIFO (preempted resumes ride at the
-        front): same-(lane, bucket) neighbors coalesce into ONE
-        row-bucketed prefill + pool scatter (padding rows carry length
-        0 and all-trash tables), so a traffic spike pays one dispatch
-        chain, not one per sequence. A sequence whose lane has no free
-        slot or whose blocks do not fit is skipped this round —
-        running sequences retiring is what unblocks it; admission
-        never preempts."""
+        front): same-signature neighbors coalesce into ONE row-bucketed
+        prefill + pool scatter (padding rows carry length 0 and
+        all-trash tables), so a traffic spike pays one dispatch chain,
+        not one per sequence. With the prefix cache on, an admission's
+        signature also carries its matched-prefix shape: cache hits
+        clone their matched block tables and batch through the TAIL
+        prefill instead. A sequence whose lane has no free slot or
+        whose blocks do not fit is skipped this round — running
+        sequences retiring is what unblocks it; admission never
+        preempts."""
         admitted = False
         while True:
             group = self._pick_admissions()
             if not group:
                 return admitted
-            lane, t_pad, entries = group
+            lane, kind, entries = group
             try:
-                self._prefill_batch(lane, t_pad, entries)
+                if kind[0] == "tail":
+                    self._prefill_tail_batch(lane, kind[1], kind[2],
+                                             entries)
+                else:
+                    self._prefill_batch(lane, kind[1],
+                                        [(p.seq, p.blocks)
+                                         for p in entries])
             except BaseException as e:
                 record_fault("serving")
-                for seq, blocks in entries:
-                    lane.pool.free_blocks(blocks)
-                    seq.blocks = []
-                    self._fail_seq(seq, self._typed(e, seq))
+                for p in entries:
+                    self._rollback_plan(lane, p)
+                    self._fail_seq(p.seq, self._typed(e, p.seq))
                 continue
             admitted = True
 
     def _lane_key(self, seq: _Seq) -> Tuple:
         return (seq.req.model, seq.req.version)
 
+    def _tier_cover(self, lane: _Lane, need: int) -> int:
+        for t in self._burst_tiers(lane):
+            if need <= t:
+                return t
+        return lane.mb
+
+    def _plan_blocks(self, lane: _Lane, seq: _Seq):
+        """Probe the prefix cache and claim every block this admission
+        needs. Returns an ``_AdmitPlan`` (blocks in table order,
+        matched ``start``, the pending COW source ref, and the group
+        signature), or None when the pool cannot cover it right now
+        (everything claimed was released — blocks return as running
+        rows retire)."""
+        pool = lane.pool
+        t_full = len(seq.fed)
+        need_total = pool.blocks_for(t_full)
+        cache = self._cache_of(lane)
+        m, shared, partial = (0, [], None)
+        if cache is not None:
+            m, shared, partial = cache.match(lane.key, seq.fed)
+        if m <= 0:
+            got = pool.alloc(need_total)
+            if got is None:
+                return None
+            t_pad = lane.gen.prompt_bucket(t_full, max(1, seq.remaining))
+            return _AdmitPlan(seq, got, 0, None, ("dense", t_pad))
+        t_tail = t_full - m
+        have = len(shared) + (1 if partial is not None else 0)
+        fresh_need = (need_total - have) + (1 if partial is not None else 0)
+        got = pool.alloc(fresh_need)
+        if got is None:
+            pool.free_blocks(shared
+                             + ([partial] if partial is not None else []))
+            return None
+        if partial is not None:
+            # the matched partial tail block will be WRITTEN (the tail
+            # starts inside it): copy-on-write — a fresh block takes
+            # its place in the table, the device copy lands before the
+            # tail scatter, and the shared ref releases after the copy
+            blocks = shared + [got[0]] + got[1:]
+        else:
+            blocks = shared + got
+        t_tail_pad = bucket_for(t_tail,
+                                bucket_sizes(lane.gen.max_context()))
+        tier = self._tier_cover(lane, len(blocks))
+        return _AdmitPlan(seq, blocks, m, partial,
+                          ("tail", t_tail_pad, tier))
+
+    def _rollback_plan(self, lane: _Lane, plan: "_AdmitPlan") -> None:
+        lane.pool.free_blocks(plan.blocks)
+        if plan.cow_src is not None:
+            lane.pool.free_blocks([plan.cow_src])
+            plan.cow_src = None
+        plan.seq.blocks = []
+
     def _pick_admissions(self):
         """Claim the next admissible FIFO group: the first sequence
-        with a free slot + allocable blocks anchors the (lane, prompt
-        bucket); later queue entries with the same signature ride the
-        same prefill while slots, blocks and the admit ladder allow.
-        Each picked sequence's blocks are claimed HERE (rolled back by
-        the caller on prefill failure)."""
+        with a free slot + allocable blocks anchors the (lane,
+        signature); later queue entries with the same signature ride
+        the same prefill while slots, blocks and the admit ladder
+        allow (a signature mismatch is rolled back and left queued —
+        it anchors a later round). Each picked sequence's blocks are
+        claimed HERE (rolled back by the caller on prefill failure)."""
         with self._lock:
             pending = list(self._queue)
         anchor = None
-        entries: List[Tuple[_Seq, List[int]]] = []
+        entries: List[_AdmitPlan] = []
         free_slots = 0
         for seq in pending:
             if seq.req.future.done():
@@ -690,11 +891,10 @@ class ContinuousDecodeScheduler:
                 continue
             lane = self._lane_for(*self._lane_key(seq))
             t_full = len(seq.fed)
-            t_pad = lane.gen.prompt_bucket(t_full, max(1, seq.remaining))
+            need = lane.pool.blocks_for(t_full)
             if anchor is None:
                 if lane.free_slot() is None:
                     continue
-                need = lane.pool.blocks_for(t_full)
                 if need > lane.pool.total_blocks or need > lane.mb:
                     with self._lock:
                         self._queue.remove(seq)
@@ -703,22 +903,31 @@ class ContinuousDecodeScheduler:
                         f"{lane.pool.total_blocks} (max {lane.mb}"
                         f"/sequence)"))
                     continue
-                got = lane.pool.alloc(need)
-                if got is None:
+                # validates prompt-length/max_new against the context
+                lane.gen.prompt_bucket(t_full, max(1, seq.remaining))
+                plan = self._plan_blocks(lane, seq)
+                if plan is None:
                     continue  # blocks return as running rows retire
-                anchor = (lane, t_pad)
+                anchor = (lane, plan.sig)
                 free_slots = sum(1 for s in lane.seqs if s is None)
             else:
-                if (lane, t_pad) != anchor:
+                if lane is not anchor[0]:
                     continue
                 if len(entries) >= min(free_slots, self._admit_ladder[-1]):
                     break
-                got = lane.pool.alloc(lane.pool.blocks_for(t_full))
-                if got is None:
+                if need > lane.pool.total_blocks or need > lane.mb:
+                    continue  # it fails typed when it anchors
+                plan = self._plan_blocks(lane, seq)
+                if plan is None:
                     break
+                if plan.sig != anchor[1]:
+                    # different program shape: not this group's ride —
+                    # release its claim, leave it queued to anchor later
+                    self._rollback_plan(lane, plan)
+                    continue
             with self._lock:
                 self._queue.remove(seq)
-            entries.append((seq, got))
+            entries.append(plan)
             if anchor is not None and \
                     len(entries) >= min(free_slots, self._admit_ladder[-1]):
                 break
@@ -770,7 +979,108 @@ class ContinuousDecodeScheduler:
         note_dispatch(lane.net, ("gen_row_sample", "sched", rows))
         toks = np.asarray(rs(logits, keys, folds, temp, top_k, top_p))
         for i, (seq, blocks) in enumerate(entries):
+            self._note_prefilled(seq, len(seq.fed))
+            cache = self._cache_of(lane)
+            if cache is not None:
+                cache.note_admitted(0)
             self._install(lane, seq, blocks, int(toks[i]))
+
+    def _prefill_tail_batch(self, lane: _Lane, t_tail_pad: int, tier: int,
+                            entries: List[_AdmitPlan]) -> None:
+        """One row-bucketed TAIL prefill of a cache-hit admission group:
+        copy-on-write any matched partial tail blocks (device clone
+        lands BEFORE the tail scatter; the shared ref releases after),
+        then one ``tail_prefill_program`` dispatch writes every row's
+        uncached tail K/V into its fresh blocks while attention reads
+        the cloned table (cached prefix + fresh tail) causally, then
+        each row's tok0 samples on its own PRNG clock exactly like the
+        dense path. ``dl4j_sched_admitted_rows_total`` semantics are
+        unchanged — a cached admission is still one admitted row."""
+        gen, pool = lane.gen, lane.pool
+        cache = self._cache_of(lane)
+        # (src, dst) pairs: dst is the fresh block standing in at the
+        # partial's table index — start // block_size by construction
+        copies = [(p.cow_src, p.blocks[p.start // self.block_size])
+                  for p in entries if p.cow_src is not None]
+        if copies:
+            cp = gen.block_copy_program(1, pool.num_blocks,
+                                        self.block_size)
+            for src, dst in copies:
+                note_dispatch(lane.net, ("gen_block_copy", "sched", 1))
+                pool.set_layers(cp(pool.layers,
+                                   np.asarray([src], np.int32),
+                                   np.asarray([dst], np.int32)))
+            for p in entries:
+                if p.cow_src is not None:
+                    pool.free_blocks([p.cow_src])
+                    p.cow_src = None
+            if cache is not None:
+                cache.note_cow(len(copies))
+        n = len(entries)
+        rows = bucket_for(n, self._admit_ladder)
+        ids = np.zeros((rows, t_tail_pad), np.int32)
+        starts = np.zeros(rows, np.int32)
+        lens = np.zeros(rows, np.int32)
+        tables = np.zeros((rows, tier), np.int32)
+        keys = np.zeros((rows, 2), lane.keys.dtype)
+        folds = np.zeros(rows, np.int32)
+        temp = np.zeros(rows, np.float32)
+        top_k = np.zeros(rows, np.int32)
+        top_p = np.zeros(rows, np.float32)
+        for i, p in enumerate(entries):
+            seq = p.seq
+            tail = seq.fed[p.start:]
+            ids[i, :len(tail)] = tail
+            starts[i] = p.start
+            lens[i] = len(tail)
+            tables[i, :len(p.blocks)] = p.blocks
+            keys[i] = seq.key
+            folds[i] = seq.n_gen
+            temp[i] = seq.req.temperature
+            top_k[i] = seq.req.top_k
+            top_p[i] = seq.req.top_p
+        params = self._params(lane)
+        tp = gen.tail_prefill_program(rows, t_tail_pad, tier,
+                                      pool.num_blocks, self.block_size)
+        fresh = note_dispatch(
+            lane.net,
+            ("gen_tail_prefill", "sched", rows, t_tail_pad, tier))
+        with span("compile" if fresh else "inference",
+                  path="continuous_tail_prefill", bucket=t_tail_pad,
+                  rows=n, tier=tier):
+            pools_out, logits = tp(params, pool.layers, ids, starts,
+                                   lens, tables)
+        pool.set_layers(pools_out)
+        rs = gen.row_sample_program()
+        note_dispatch(lane.net, ("gen_row_sample", "sched", rows))
+        toks = np.asarray(rs(logits, keys, folds, temp, top_k, top_p))
+        for i, p in enumerate(entries):
+            self._note_prefilled(p.seq, len(p.seq.fed) - p.start)
+            if cache is not None:
+                cache.note_admitted(p.start)
+            self._install(lane, p.seq, p.blocks, int(toks[i]))
+
+    def _note_prefilled(self, seq: _Seq, computed: int) -> None:
+        """Account the prompt tokens this admission actually COMPUTED
+        (the tail; cache hits skip the matched prefix) — what the
+        prefill-FLOP-reduction and warm-migration benches read."""
+        self._prefill_computed_tokens += int(computed)
+        if seq.req.prefix is not None:
+            self._resume_reprefill_tokens += int(computed)
+
+    def _cache_insert(self, lane: _Lane, seq: _Seq) -> None:
+        """Insert-on-retire (and on preempt — the victim's own resume
+        then matches its cached prefix): pin the sequence's written
+        token run into the lane's radix index BEFORE its blocks free,
+        so the cache's references carry the full interior blocks over
+        while the private tail returns to the free list."""
+        cache = self._cache_of(lane)
+        if cache is None or not seq.blocks or seq.pos <= 0:
+            return
+        tokens = np.concatenate(
+            [seq.req.prompt[seq.row].astype(np.int64),
+             np.asarray(seq.generated, np.int64)])[:seq.pos]
+        cache.insert(lane.key, tokens, seq.blocks)
 
     def _emit_tokens(self, seq: _Seq) -> None:
         """Deliver the row's not-yet-delivered tokens through the
@@ -816,6 +1126,7 @@ class ContinuousDecodeScheduler:
         if done0:
             # the prefill's first token already finished the row:
             # retire without ever occupying the slot
+            self._cache_insert(lane, seq)
             lane.pool.free_blocks(seq.blocks)
             seq.blocks = []
             self._retire_seq(lane, seq)
@@ -892,6 +1203,10 @@ class ContinuousDecodeScheduler:
         along, so the resumed tokens equal an uninterrupted run's."""
         lane = self._lane_for(*self._lane_key(seq))
         slot = seq.slot
+        # insert-before-free: with the prefix cache on, the victim's
+        # interior blocks survive as cached prefix — its resume then
+        # degrades to a table clone plus a short tail prefill
+        self._cache_insert(lane, seq)
         lane.pool.free_blocks(seq.blocks)
         seq.blocks = []
         seq.fed = np.concatenate(
@@ -1053,6 +1368,7 @@ class ContinuousDecodeScheduler:
             lane.pos[slot] = pos[slot]
             lane.n_gen[slot] = n_gen[slot]
             if bool(done[slot]):
+                self._cache_insert(lane, seq)
                 lane.pool.free_blocks(seq.blocks)
                 seq.blocks = []
                 lane.clear_slot(slot)

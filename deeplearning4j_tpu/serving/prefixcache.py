@@ -1,0 +1,382 @@
+"""Cross-request prefix cache: a radix index over block-aligned token
+runs mapped onto the refcounted paged KV pool.
+
+Millions of requests share system prompts, few-shot preambles and
+multi-turn histories, yet before this module every admitted prompt
+re-prefilled from token 0. The paged pool (nn/kvpool.py) makes sharing
+natural — KV state is already block-granular and position-local — so
+this is the vLLM automatic-prefix-caching / SGLang RadixAttention
+discipline on the existing machinery:
+
+- **index**: a radix tree over BLOCK-ALIGNED token runs, one tree of
+  full-block nodes per ``(model, version)`` lane (lanes share a pool
+  when their KV spec matches, but cached K/V is computed by one
+  version's params — a canary must never match the stable's cache).
+  Each full node owns one pool block (the cache holds a reference);
+  a node may also carry *partial* children: the inserting sequence's
+  last, partially-filled block together with its token content;
+- **insert-on-retire**: when a sequence retires (or is preempted) the
+  scheduler offers its written token run + block table; the cache
+  walks/extends the radix chain, taking a pool reference on each block
+  it newly pins (a chain that already exists is just touched — no
+  duplicate caching, the sequence's own blocks free normally);
+- **longest-prefix match at admission**: an admitted prompt walks the
+  chain, shares every matched full block (pool refcount + 1 per block,
+  on the sequence's behalf) and optionally one partial tail block,
+  then prefills ONLY the remaining tail. Matching is capped at
+  ``len(prompt) - 1`` — the last prompt token is always recomputed,
+  because its logits seed the first sampled token;
+- **copy-on-write**: full interior blocks are immutable once written
+  (decode only ever writes at the growing tail), so the ONLY block a
+  sharer can collide on is a matched *partial* tail block — the
+  scheduler copies it to a fresh block before its first scatter lands
+  (``dl4j_prefixcache_cow_copies_total``) and drops the shared
+  reference, which is why "preempt a sharer" frees only its private
+  tail;
+- **deterministic eviction, unified with the free list**: the cache
+  registers itself as the pool's reclaimer — when ``alloc`` finds the
+  free list short it evicts cached-but-UNREFERENCED leaf blocks in
+  LRU order (a logical clock that only ticks on cache operations, so
+  replayed schedules evict identically; ties break on node id) and the
+  freed ids rejoin the sorted lowest-id-first free list. A block some
+  live sequence still references is never an eviction candidate — the
+  ``ModelRegistry`` memory-budget discipline applied to KV. An
+  optional ``capacity_blocks`` budget bounds the cache independently
+  of pool pressure.
+
+Everything here is host-side accounting; the device-side halves (the
+tail prefill that gathers cached blocks, the COW block copy) live in
+``nn/generate.py`` and are driven by the scheduler.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.monitor import (
+    PREFIXCACHE_CACHED_BLOCKS_GAUGE,
+    PREFIXCACHE_COW_COPIES_COUNTER,
+    PREFIXCACHE_EVICTIONS_COUNTER,
+    PREFIXCACHE_HITS_COUNTER,
+    PREFIXCACHE_MISSES_COUNTER,
+    PREFIXCACHE_SAVED_TOKENS_COUNTER,
+    PREFIXCACHE_SHARED_BLOCKS_GAUGE,
+    get_registry,
+)
+from deeplearning4j_tpu.nn.kvpool import PagedKVCachePool
+
+
+class _Node:
+    """One cached block: a full block-run radix node (``fill ==
+    block_size``) or a partial tail (``fill < block_size``, kept under
+    its parent's ``partials``). The cache holds exactly ONE pool
+    reference per node."""
+
+    __slots__ = ("nid", "lane", "block", "tokens", "fill", "parent",
+                 "pkey", "partial", "children", "partials", "last_used")
+
+    def __init__(self, nid: int, lane, block: Optional[int],
+                 tokens: Tuple[int, ...], fill: int,
+                 parent: Optional["_Node"], partial: bool):
+        self.nid = nid
+        self.lane = lane
+        self.block = block          # None only for per-lane roots
+        self.tokens = tokens
+        self.fill = fill
+        self.parent = parent
+        self.pkey = tokens          # key in the parent's child dict
+        self.partial = partial
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.partials: Dict[Tuple[int, ...], "_Node"] = {}
+        self.last_used = 0
+
+    def leaf(self) -> bool:
+        return not self.children and not self.partials
+
+
+class PrefixCache:
+    """Token-prefix → KV-block index over one :class:`PagedKVCachePool`
+    (one cache per pool; lanes sharing the pool get separate radix
+    roots keyed by their ``(model, version)``)."""
+
+    def __init__(self, pool: PagedKVCachePool,
+                 capacity_blocks: Optional[int] = None,
+                 register: bool = True):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self.capacity_blocks = (None if capacity_blocks is None
+                                else max(0, int(capacity_blocks)))
+        self._roots: Dict[Tuple, _Node] = {}
+        self._nodes = 0             # live node count (cached blocks)
+        self._nid = 0               # node id allotter (eviction ties)
+        self._clock = 0             # logical LRU clock: cache ops only
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        self._cow_copies = 0
+        self._saved_tokens = 0
+        self._inserted_runs = 0
+        self._lock = threading.RLock()
+        if register:
+            pool.register_reclaimer(self.reclaim)
+
+    # ------------------------------------------------------------ probe
+
+    def match(self, lane: Tuple, tokens) -> Tuple[int, List[int],
+                                                  Optional[int]]:
+        """Longest cached prefix of ``tokens`` for ``lane``: returns
+        ``(matched_tokens, full_block_ids, partial_block_id)``. The
+        cache takes one pool reference per returned block ON THE
+        CALLER'S BEHALF — the sequence frees them like its own blocks
+        (refcounted, so "free" just drops its hold). Matching walks
+        whole blocks, then the best (longest, oldest-id tie-break)
+        partial child; it is capped at ``len(tokens) - 1`` so the last
+        prompt token is always recomputed for its logits."""
+        toks = [int(t) for t in tokens]
+        bs = self.block_size
+        with self._lock:
+            self._clock += 1
+            root = self._roots.get(lane)
+            usable = toks[:max(0, len(toks) - 1)]
+            full_ids: List[int] = []
+            partial_id: Optional[int] = None
+            m = 0
+            if root is not None:
+                cur = root
+                i = 0
+                while i + bs <= len(usable):
+                    child = cur.children.get(tuple(usable[i:i + bs]))
+                    if child is None:
+                        break
+                    child.last_used = self._clock
+                    full_ids.append(child.block)
+                    cur = child
+                    i += bs
+                best: Optional[_Node] = None
+                best_len = 0
+                rest = usable[i:]
+                for ptoks, pnode in cur.partials.items():
+                    cl = 0
+                    for a, b in zip(ptoks, rest):
+                        if a != b:
+                            break
+                        cl += 1
+                    if cl >= 1 and (cl > best_len or
+                                    (cl == best_len and best is not None
+                                     and pnode.nid < best.nid)):
+                        best, best_len = pnode, cl
+                if best is not None:
+                    best.last_used = self._clock
+                    partial_id = best.block
+                    m = i + best_len
+                else:
+                    m = i
+            if m > 0:
+                shared = full_ids + ([partial_id]
+                                     if partial_id is not None else [])
+                self.pool.share_blocks(shared)
+            else:
+                full_ids, partial_id = [], None
+        self._publish()
+        return m, full_ids, partial_id
+
+    def note_admitted(self, matched_tokens: int) -> None:
+        """Record one COMMITTED admission probe (hit/miss + saved
+        prefill tokens). Separate from :meth:`match` because the
+        scheduler may probe and roll a candidate back (group-signature
+        mismatch) — only admissions that actually clone the table
+        count."""
+        m = int(matched_tokens)
+        reg = get_registry()
+        with self._lock:
+            if m > 0:
+                self._hits += 1
+                self._saved_tokens += m
+            else:
+                self._misses += 1
+        if m > 0:
+            reg.counter(PREFIXCACHE_HITS_COUNTER,
+                        "Admissions that matched a cached prefix",
+                        pool=self.pool.name).inc()
+            reg.counter(PREFIXCACHE_SAVED_TOKENS_COUNTER,
+                        "Prompt tokens whose prefill was skipped because "
+                        "their KV blocks were already cached",
+                        pool=self.pool.name).inc(m)
+        else:
+            reg.counter(PREFIXCACHE_MISSES_COUNTER,
+                        "Admissions that matched nothing",
+                        pool=self.pool.name).inc()
+
+    # ----------------------------------------------------------- insert
+
+    def insert(self, lane: Tuple, tokens, blocks: List[int]) -> int:
+        """Insert-on-retire: pin the retiring sequence's written token
+        run (``tokens`` = every position its blocks actually hold) into
+        the lane's radix chain. Full blocks extend the chain; a
+        trailing partial block becomes a partial child carrying its
+        fill. Chains that already exist are touched, not re-pinned —
+        the sequence's own duplicate blocks then free normally. Returns
+        the number of blocks newly pinned."""
+        toks = [int(t) for t in tokens]
+        bs = self.block_size
+        pinned = 0
+        with self._lock:
+            self._clock += 1
+            self._inserted_runs += 1
+            root = self._roots.get(lane)
+            if root is None:
+                self._nid += 1
+                root = self._roots[lane] = _Node(
+                    self._nid, lane, None, (), 0, None, False)
+            cur = root
+            full = len(toks) // bs
+            for i in range(min(full, len(blocks))):
+                bt = tuple(toks[i * bs:(i + 1) * bs])
+                child = cur.children.get(bt)
+                if child is None:
+                    self.pool.share_blocks([blocks[i]])
+                    self._nid += 1
+                    child = _Node(self._nid, lane, int(blocks[i]), bt,
+                                  bs, cur, False)
+                    cur.children[bt] = child
+                    self._nodes += 1
+                    pinned += 1
+                child.last_used = self._clock
+                cur = child
+            fill = len(toks) % bs
+            if fill and full < len(blocks):
+                pt = tuple(toks[full * bs:])
+                pnode = cur.partials.get(pt)
+                if pnode is None:
+                    self.pool.share_blocks([blocks[full]])
+                    self._nid += 1
+                    pnode = _Node(self._nid, lane, int(blocks[full]), pt,
+                                  fill, cur, True)
+                    cur.partials[pt] = pnode
+                    self._nodes += 1
+                    pinned += 1
+                pnode.last_used = self._clock
+            if self.capacity_blocks is not None \
+                    and self._nodes > self.capacity_blocks:
+                self._evict_locked(self._nodes - self.capacity_blocks)
+        self._publish()
+        return pinned
+
+    def note_cow(self, n: int = 1) -> None:
+        """Account ``n`` copy-on-write block duplications (the
+        scheduler performs the device copy; the cache owns the
+        metric)."""
+        with self._lock:
+            self._cow_copies += int(n)
+        get_registry().counter(
+            PREFIXCACHE_COW_COPIES_COUNTER,
+            "Copy-on-write KV block duplications (a writer's shared "
+            "partial tail block copied before its scatter landed)",
+            pool=self.pool.name).inc(int(n))
+        self._publish()
+
+    # --------------------------------------------------------- eviction
+
+    def reclaim(self, n: int) -> int:
+        """The pool's reclaimer seam: evict up to ``n`` cached blocks
+        whose ONLY reference is the cache's (deterministic LRU —
+        logical clock, node-id tie-break, leaves first so the radix
+        chain never dangles). Returns how many blocks were freed."""
+        with self._lock:
+            freed = self._evict_locked(int(n))
+        self._publish()
+        return freed
+
+    def _evict_locked(self, n: int) -> int:
+        freed = 0
+        while freed < n:
+            victim: Optional[_Node] = None
+            for root in self._roots.values():
+                stack = list(root.children.values()) \
+                    + list(root.partials.values())
+                while stack:
+                    node = stack.pop()
+                    if node.leaf():
+                        if self.pool.ref_count(node.block) == 1 and (
+                                victim is None
+                                or (node.last_used, node.nid)
+                                < (victim.last_used, victim.nid)):
+                            victim = node
+                    else:
+                        stack.extend(node.children.values())
+                        stack.extend(node.partials.values())
+            if victim is None:
+                break  # everything left is referenced or interior
+            parent = victim.parent
+            if victim.partial:
+                parent.partials.pop(victim.pkey, None)
+            else:
+                parent.children.pop(victim.pkey, None)
+            self._nodes -= 1
+            self._evictions += 1
+            self.pool.free_blocks([victim.block])
+            freed += 1
+        if freed:
+            get_registry().counter(
+                PREFIXCACHE_EVICTIONS_COUNTER,
+                "Cached-but-unreferenced KV blocks evicted back to the "
+                "pool free list", pool=self.pool.name).inc(freed)
+        return freed
+
+    def clear(self) -> int:
+        """Release every cache-held block reference (drain-time
+        accounting audits call this: after ``clear()`` a quiesced
+        pool's free count must equal its total). Returns the number of
+        blocks released."""
+        with self._lock:
+            released = 0
+            for root in self._roots.values():
+                stack = list(root.children.values()) \
+                    + list(root.partials.values())
+                while stack:
+                    node = stack.pop()
+                    stack.extend(node.children.values())
+                    stack.extend(node.partials.values())
+                    self.pool.free_blocks([node.block])
+                    released += 1
+            self._roots.clear()
+            self._nodes = 0
+        self._publish()
+        return released
+
+    # ------------------------------------------------------------ state
+
+    def cached_blocks(self) -> int:
+        with self._lock:
+            return self._nodes
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            hits, misses = self._hits, self._misses
+            out = {
+                "cached_blocks": self._nodes,
+                "cached_bytes": self._nodes * self.pool.block_bytes(),
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+                "evictions": self._evictions,
+                "cow_copies": self._cow_copies,
+                "saved_prefill_tokens": self._saved_tokens,
+                "inserted_runs": self._inserted_runs,
+                "capacity_blocks": self.capacity_blocks,
+            }
+        out["shared_blocks"] = self.pool.shared_count()
+        return out
+
+    def _publish(self) -> None:
+        reg = get_registry()
+        with self._lock:
+            nodes = self._nodes
+        reg.gauge(PREFIXCACHE_CACHED_BLOCKS_GAUGE,
+                  "KV blocks currently pinned by the prefix cache",
+                  pool=self.pool.name).set(nodes)
+        reg.gauge(PREFIXCACHE_SHARED_BLOCKS_GAUGE,
+                  "KV blocks currently referenced by more than one "
+                  "holder (live prefix sharing)",
+                  pool=self.pool.name).set(self.pool.shared_count())

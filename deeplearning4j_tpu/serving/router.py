@@ -32,6 +32,15 @@ the engine cannot see from inside one process:
 - **Session affinity**: ``session=`` pins a multi-burst decode stream
   to the endpoint holding its KV state; the pin survives until that
   endpoint leaves the pool, then the session re-pins on first use.
+- **Cache-aware affinity tiebreak**: endpoints running the prefix
+  cache expose its summary (cached-prefix count + bytes) through the
+  ``stats()`` snapshots riding their heartbeats, and the router
+  remembers which endpoint last served each prompt-prefix key
+  (the first ``prefix_affinity_tokens`` ids). When two endpoints tie
+  on the admission estimate, the one already holding the prompt's
+  prefix wins — a warm cache beats a cold one at zero health cost.
+  Health, deadline shedding and session re-pin-after-death keep their
+  existing behavior; the tiebreak only orders EXACT estimate ties.
 - **Durable decode streams**: ``submit_generate(on_tokens=...)``
   streams incremental token deltas (wire-v2 chunks) while the router
   journals every received token per stream. When the serving endpoint
@@ -58,6 +67,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -152,7 +162,7 @@ class _Routed:
                  "attempts", "outstanding", "lock", "hedged", "session",
                  "priority", "timer", "per_try_timeout", "model", "version",
                  "on_tokens", "received", "epoch", "dups", "gaps", "late",
-                 "journal_dropped", "migrations")
+                 "journal_dropped", "migrations", "prefix_key")
 
     def __init__(self, kind: str, x, gen, deadline: Optional[float],
                  priority: str, session: Optional[str],
@@ -185,6 +195,7 @@ class _Routed:
         self.late = 0
         self.journal_dropped = False    # over budget: restart, not resume
         self.migrations = 0
+        self.prefix_key: Optional[Tuple] = None
 
 
 class InferenceRouter:
@@ -209,7 +220,8 @@ class InferenceRouter:
                  default_deadline_ms: Optional[Dict[str, float]] = None,
                  ewma_alpha: float = 0.2,
                  wedge_timeout_s: Optional[float] = None,
-                 journal_limit_tokens: int = 4096):
+                 journal_limit_tokens: int = 4096,
+                 prefix_affinity_tokens: int = 32):
         self._eps: Dict[str, _EndpointState] = {}
         self._lock = threading.Lock()
         self._affinity: Dict[str, str] = {}
@@ -231,6 +243,13 @@ class InferenceRouter:
         # RESTART instead of prefix-resume (the journal stays usable as
         # the dedupe ledger; it just stops being shipped as a prefix)
         self.journal_limit = max(1, int(journal_limit_tokens))
+        # cache-aware affinity: prompt-prefix key (the first N token
+        # ids) -> the endpoint that last served it. Consulted only to
+        # break EXACT estimate ties — a warm prefix cache beats a cold
+        # one, but never outranks health or deadline. 0 disables.
+        self.prefix_affinity_tokens = max(0, int(prefix_affinity_tokens))
+        self._prefix_owners: "OrderedDict[Tuple, str]" = OrderedDict()
+        self._prefix_owners_cap = 4096
         self._streams: set = set()      # in-flight streaming _Routed
         self._closed = False
         for ep in endpoints or []:
@@ -419,9 +438,40 @@ class InferenceRouter:
         wait = (backlog / replicas) * svc
         return wait, wait + svc
 
+    def _prefix_key(self, prompt, model: Optional[str]) -> Optional[Tuple]:
+        """Affinity key for a decode prompt: its first
+        ``prefix_affinity_tokens`` ids (+ the model) — the head shared
+        system prompts share. None when disabled or unkeyable."""
+        if self.prefix_affinity_tokens <= 0:
+            return None
+        try:
+            row = np.asarray(prompt).reshape(-1)
+        except Exception:
+            return None
+        if row.size == 0:
+            return None
+        head = tuple(int(t) for t in row[:self.prefix_affinity_tokens])
+        return (model, head)
+
+    def _prefix_owner(self, key: Optional[Tuple]) -> Optional[str]:
+        if key is None:
+            return None
+        with self._lock:
+            return self._prefix_owners.get(key)
+
+    def _note_prefix_owner(self, key: Optional[Tuple], name: str) -> None:
+        if key is None:
+            return
+        with self._lock:
+            self._prefix_owners.pop(key, None)
+            self._prefix_owners[key] = name
+            while len(self._prefix_owners) > self._prefix_owners_cap:
+                self._prefix_owners.popitem(last=False)
+
     def _admit(self, deadline_ms: Optional[float], priority: str,
                session: Optional[str],
-               model: Optional[str] = None) -> _EndpointState:
+               model: Optional[str] = None,
+               prefix_key: Optional[Tuple] = None) -> _EndpointState:
         """Pick the endpoint AND make the shed decision against it.
         Raises :class:`RetryAfter` when nothing can serve in time."""
         now = time.monotonic()
@@ -462,9 +512,14 @@ class InferenceRouter:
             with self._lock:
                 trial.in_trial = True
         if pick is None:
-            # least estimated wait; stable name tie-break
-            pick = min(pool, key=lambda st: (self._estimate_ms(st, model)[0],
-                                             st.endpoint.name))
+            # least estimated wait; a warm prefix cache breaks EXACT
+            # estimate ties (the cache-aware affinity satellite);
+            # stable name tie-break last
+            owner = self._prefix_owner(prefix_key)
+            pick = min(pool, key=lambda st: (
+                self._estimate_ms(st, model)[0],
+                0 if st.endpoint.name == owner else 1,
+                st.endpoint.name))
         wait_ms, total_ms = self._estimate_ms(pick, model)
         self._reg().histogram(
             ROUTER_QUEUE_WAIT_HISTOGRAM,
@@ -608,12 +663,15 @@ class InferenceRouter:
             labels["model"] = model
         self._reg().counter(
             ROUTER_REQUESTS_COUNTER, "Requests routed", **labels).inc()
-        st = self._admit(deadline_ms, priority, session, model)
+        prefix_key = (self._prefix_key(x, model) if kind == "generate"
+                      else None)
+        st = self._admit(deadline_ms, priority, session, model, prefix_key)
         rf = _Routed(kind, x, gen,
                      None if deadline_ms is None
                      else time.monotonic() + deadline_ms / 1e3,
                      priority, session, self.per_try_timeout,
                      model, version, on_tokens)
+        rf.prefix_key = prefix_key
         if on_tokens is not None:
             with self._lock:
                 self._streams.add(rf)
@@ -663,6 +721,11 @@ class InferenceRouter:
         with self._lock:
             st.requests += 1
             st.inflight += 1
+        if rf.kind == "generate":
+            # this endpoint is about to hold the prompt's prefix (its
+            # scheduler caches it on retire) — remember it for the
+            # cache-aware tiebreak on the next same-prefix admission
+            self._note_prefix_owner(rf.prefix_key, st.endpoint.name)
         if resume_prefix is not None:
             self._reg().counter(
                 ROUTER_RESUME_PREFIX_COUNTER,
@@ -869,7 +932,20 @@ class InferenceRouter:
             stats = st.endpoint.stats()
             queue_depth += float(stats.get("queue_depth", 0) or 0)
             last = st.endpoint.last_seen
+            # prefix-cache summary riding the endpoint's stats snapshot
+            # (heartbeat-carried for remote workers): cached-prefix
+            # count + bytes + hit rate — the cache-aware affinity view
+            pc = (stats.get("scheduler") or {}).get("prefix_cache") \
+                if isinstance(stats.get("scheduler"), dict) else None
+            prefix_cache = None
+            if isinstance(pc, dict):
+                prefix_cache = {
+                    "cached_blocks": pc.get("cached_blocks", 0),
+                    "cached_bytes": pc.get("cached_bytes", 0),
+                    "hit_rate": pc.get("hit_rate", 0.0),
+                }
             eps[name] = {
+                "prefix_cache": prefix_cache,
                 "alive": alive,
                 "ejected": ejected,
                 "in_pool": in_pool,

@@ -204,6 +204,18 @@ KNOWN_DL4J_METRICS = {
     "dl4j_sched_burst_latency_ms",
     "dl4j_sched_active_sequences",
     "dl4j_sched_queued_prefills",
+    # cross-request prefix cache (serving/prefixcache.py PrefixCache
+    # over the refcounted paged pool): admission hit/miss volume,
+    # deterministic LRU evictions, copy-on-write block duplications,
+    # cached/shared block gauges, and the prompt tokens whose prefill
+    # was skipped because their KV blocks were already cached
+    "dl4j_prefixcache_hits_total",
+    "dl4j_prefixcache_misses_total",
+    "dl4j_prefixcache_evictions_total",
+    "dl4j_prefixcache_cow_copies_total",
+    "dl4j_prefixcache_cached_blocks",
+    "dl4j_prefixcache_shared_blocks",
+    "dl4j_prefixcache_saved_prefill_tokens_total",
     # horizontal serving tier (serving/router.py InferenceRouter)
     "dl4j_router_requests_total",
     "dl4j_router_shed_total",

@@ -23,7 +23,8 @@ failure — also exit 1, but reported as such)::
 replays the in-process deterministic injector battery (seeded NaN/raise
 schedules, flaky-broker schedules, torn-write counting, replica/model
 poison sequences, burst-kill windows, mesh-shrink drills, and the
-composed ChaosSchedule event clock — sections 1–7) twice per seed
+composed ChaosSchedule event clock, and the prefix-cache
+refcount/COW/eviction accounting drill — sections 1–8) twice per seed
 across rotating seeds and compares the full event logs bit-for-bit.
 It runs in milliseconds with no subprocess and no jax compute, so the
 tier-1 sweep carries it on every run; the full mode is the pre-merge /
@@ -193,6 +194,69 @@ def _scenario_log(seed: int) -> str:
     for n_events, n_eps in ((4, 3), (seed % 5 + 2, 3)):
         cs = ChaosSchedule(seed, n_events=n_events, n_endpoints=n_eps)
         events.append(f"chaos[{n_events}x{n_eps}]={cs.signature()}")
+
+    # 8) prefix-cache refcount/COW/eviction accounting (serving/
+    # prefixcache.py over the refcounted paged pool): seeded
+    # interleavings of admit (match + share + alloc, COW-releasing a
+    # matched partial), retire (insert-then-free), kill (free without
+    # insert — the burst-kill shape) and eviction pressure on a tiny
+    # pool — the free-list order, refcounts and cached-node counts
+    # must replay bit-identically, the drill must drain to fully-free
+    # with ZERO leaked blocks, and a double free must raise (caught
+    # here, logged as part of the pinned schedule)
+    from deeplearning4j_tpu.nn.kvpool import PagedKVCachePool
+    from deeplearning4j_tpu.serving.prefixcache import PrefixCache
+    rng8 = np.random.default_rng(seed * 31 + 5)
+    pool = PagedKVCachePool(17, 2, num_layers=1, num_heads=1, head_dim=2,
+                            name=f"qc{seed}")
+    cache = PrefixCache(pool)
+    lane = ("m", 1)
+    live: List[tuple] = []
+    for i in range(28):
+        op = int(rng8.integers(0, 4))
+        if op == 0:
+            t = int(rng8.integers(3, 9))
+            toks = [int(x) for x in rng8.integers(0, 4, t)]
+            m, full, part = cache.match(lane, toks)
+            got = pool.alloc(pool.blocks_for(t) - len(full))
+            if got is None:
+                pool.free_blocks(full
+                                 + ([part] if part is not None else []))
+                events.append(f"pc {i} admit-short m={m}")
+                continue
+            if part is not None:
+                # COW: the fresh block stands in, the shared ref drops
+                blocks = full + got
+                pool.free_blocks([part])
+            else:
+                blocks = full + got
+            live.append((blocks, toks))
+            events.append(f"pc {i} admit m={m} blocks={blocks}")
+        elif op == 1 and live:
+            blocks, toks = live.pop(int(rng8.integers(0, len(live))))
+            pinned = cache.insert(lane, toks, blocks)
+            pool.free_blocks(blocks)
+            events.append(f"pc {i} retire pinned={pinned} "
+                          f"free={pool.free_count}")
+        elif op == 2 and live:
+            blocks, _ = live.pop(int(rng8.integers(0, len(live))))
+            pool.free_blocks(blocks)
+            events.append(f"pc {i} kill free={pool.free_count}")
+        else:
+            freed = cache.reclaim(int(rng8.integers(1, 4)))
+            events.append(f"pc {i} evict freed={freed} "
+                          f"cached={cache.cached_blocks()}")
+    for blocks, _ in live:
+        pool.free_blocks(blocks)
+    cache.clear()
+    try:
+        pool.free_blocks([1])
+        events.append("pc double-free MISSED")
+    except RuntimeError:
+        events.append("pc double-free caught")
+    events.append(f"pc final free={pool.free_count}/{pool.total_blocks} "
+                  f"shared={pool.shared_count()} "
+                  f"leaked={pool.total_blocks - pool.free_count}")
     return "\n".join(events)
 
 
@@ -248,7 +312,7 @@ def run_chaos(runs: int, seed_base: int, n_requests: int = 14,
     """The `chaos` section: run the composed drill TWICE per seed in
     fresh subprocesses across rotating seeds; fail on any invariant
     violation OR any outcome drift between the two replays of one
-    seed — the same determinism contract sections 1–7 pin for the
+    seed — the same determinism contract sections 1–8 pin for the
     injectors, applied to the whole composed drill."""
     bad = 0
     for i in range(runs):

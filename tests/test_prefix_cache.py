@@ -1,0 +1,516 @@
+"""Cross-request prefix cache tests (serving/prefixcache.py +
+nn/kvpool.py refcounts/COW + the scheduler's cached-admission path).
+
+The ISSUE-11 battery: pool refcount semantics (share, last-drop frees,
+double-free raises); share/COW admission output BITWISE equal to the
+uncached run (greedy and seeded sampling, vs ``generate_eager`` — the
+house bar); copy-on-write triggering only on a matched partial tail
+block while the originator's outputs stay intact; preempt-a-sharer
+freeing only its private tail; deterministic eviction that never
+evicts a referenced block; canary-cutover lanes never cross-matching
+versions; ``prefix=`` resumes probing the index (warm migration
+degrades to a table clone); zero steady-state XLA compiles with the
+cache on; seeded kill/preempt/evict interleavings draining to zero
+leaked and zero double-freed blocks (plus ``stress_faultinject``
+quick_check section 8); the router's cache-aware affinity tiebreak;
+and the ``dl4j_prefixcache_*`` schema pinning.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.faultinject import BurstKill
+from deeplearning4j_tpu.models.zoo.transformer import gpt
+from deeplearning4j_tpu.nn.generate import generate_eager
+from deeplearning4j_tpu.nn.kvpool import PagedKVCachePool
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.serving.continuous import ContinuousDecodeScheduler
+from deeplearning4j_tpu.serving.prefixcache import PrefixCache
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.router import InferenceRouter
+
+VOCAB = 11
+
+
+def _tiny_gpt(seed=0, **kw):
+    return gpt(vocab_size=VOCAB, d_model=16, n_layers=2, num_heads=2,
+               max_len=32, compute_dtype="float32", learning_rate=0.01,
+               seed=seed, **kw).init()
+
+
+@pytest.fixture
+def fresh_registry():
+    prev = monitor.set_registry(monitor.MetricsRegistry())
+    yield monitor.get_registry()
+    monitor.set_registry(prev)
+
+
+def _sched(net, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("burst_tokens", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("start", False)
+    kw.setdefault("prefix_cache", True)
+    return ContinuousDecodeScheduler(net=net, **kw)
+
+
+def _drive(sched, futures, max_steps=400):
+    for _ in range(max_steps):
+        if all(f.done() for f in futures):
+            return
+        sched.step()
+    raise AssertionError(
+        f"schedule did not converge in {max_steps} steps; "
+        f"events={list(sched.events)}")
+
+
+def _assert_drained_clean(s):
+    """Conservation after drain: free + cache-held == total, and
+    clearing the cache returns the pool to fully free (zero leaked,
+    zero double-freed — clear() raises on a double free)."""
+    st = s.stats()
+    cached = sum(c.cached_blocks() for c in s.prefix_caches())
+    assert st["pool"]["blocks_free"] + cached == st["pool"]["blocks_total"], \
+        (st["pool"], cached)
+    for c in s.prefix_caches():
+        c.clear()
+    st = s.stats()
+    assert st["pool"]["blocks_free"] == st["pool"]["blocks_total"]
+
+
+# ------------------------------------------------- pool refcounts / COW
+
+def test_pool_refcount_share_and_double_free():
+    pool = PagedKVCachePool(9, 4, num_layers=1, num_heads=1, head_dim=2)
+    a = pool.alloc(3)
+    assert a == [1, 2, 3] and pool.free_count == 5
+    pool.share_blocks(a[:2])            # a second holder on 1, 2
+    assert pool.ref_count(1) == 2 and pool.ref_count(3) == 1
+    # "preempt a sharer frees only its private tail": the seq's free
+    # drops one ref everywhere — only block 3 returns to the free list
+    pool.free_blocks(a)
+    assert pool.free_count == 6
+    assert pool.ref_count(1) == 1 and pool.ref_count(2) == 1
+    assert pool.ref_count(3) == 0
+    assert pool.shared_count() == 0
+    # the cache's later release frees them for real
+    pool.free_blocks([1, 2])
+    assert pool.free_count == 8
+    with pytest.raises(RuntimeError, match="double free"):
+        pool.free_blocks([1])
+    with pytest.raises(ValueError):
+        pool.share_blocks([4])          # free block: nobody owns it
+    with pytest.raises(ValueError):
+        pool.share_blocks([0])          # the trash block, never
+
+
+def test_pool_reclaimer_unifies_eviction_with_free_list():
+    pool = PagedKVCachePool(5, 4, num_layers=1, num_heads=1, head_dim=2)
+    cache = PrefixCache(pool)
+    a = pool.alloc(4)
+    cache.insert(("m", 1), list(range(16)), a)   # 4 full blocks cached
+    pool.free_blocks(a)                          # seq gone; cache holds 4
+    assert pool.free_count == 0
+    # exhausted pool: alloc reclaims cached-but-unreferenced blocks —
+    # LEAVES first (evicting a chain root would orphan its children),
+    # so the deepest blocks (4, then 3) rejoin the sorted free list
+    got = pool.alloc(2)
+    assert got == [3, 4]
+    assert cache.cached_blocks() == 2
+    assert cache.stats()["evictions"] == 2
+    # the surviving chain head still matches
+    m, full, _ = cache.match(("m", 1), list(range(16)))
+    assert m == 8 and full == [1, 2]
+    pool.free_blocks(full)
+
+
+# ----------------------------------------------------- bitwise parity
+
+def test_shared_prefix_output_bitwise_vs_unshared(rng):
+    """Cache-hit admissions (table clone + tail prefill) must produce
+    BITWISE the tokens of the uncached run — greedy AND seeded
+    sampling, pinned against generate_eager."""
+    net = _tiny_gpt()
+    pre = rng.integers(0, VOCAB, (1, 12))
+    for sampler in ({}, {"temperature": 0.8, "top_k": 5, "seed": 7}):
+        s = _sched(net)
+        want = generate_eager(net, pre, 8, **sampler)
+        f0 = s.submit(pre, 8, **sampler)
+        _drive(s, [f0])
+        assert np.array_equal(f0.result(0), want), ("cold", sampler)
+        # warm: the same prompt matches its cached prefix
+        f1 = s.submit(pre, 8, **sampler)
+        _drive(s, [f1])
+        assert np.array_equal(f1.result(0), want), ("warm", sampler)
+        st = s.stats()
+        assert st["prefix_cache"]["hits"] >= 1
+        assert st["prefix_cache"]["saved_prefill_tokens"] > 0
+        # the warm admission computed fewer prefill tokens
+        assert st["prefill_tokens_computed"] < 2 * pre.shape[1]
+        _assert_drained_clean(s)
+
+
+def test_distinct_tails_share_one_preamble(rng):
+    """The shared-system-prompt shape: N users, one preamble, distinct
+    tails — every request after the first hits, all outputs bitwise."""
+    net = _tiny_gpt()
+    s = _sched(net)
+    preamble = rng.integers(0, VOCAB, (1, 8))
+    prompts = [np.concatenate(
+        [preamble, rng.integers(0, VOCAB, (1, 4))], axis=1)
+        for _ in range(4)]
+    f0 = s.submit(prompts[0], 6)
+    _drive(s, [f0])
+    assert np.array_equal(f0.result(0), generate_eager(net, prompts[0], 6))
+    futs = [s.submit(p, 6) for p in prompts[1:]]
+    _drive(s, futs)
+    for f, p in zip(futs, prompts[1:]):
+        assert np.array_equal(f.result(0), generate_eager(net, p, 6))
+    st = s.stats()["prefix_cache"]
+    assert st["hits"] >= len(prompts) - 1
+    _assert_drained_clean(s)
+
+
+def test_cow_partial_tail_block(rng):
+    """A match reaching INTO a cached partial tail block triggers
+    copy-on-write (the only block a sharer ever writes), the sharer's
+    output is bitwise-correct, and the originator's cached content
+    survives untouched."""
+    net = _tiny_gpt()
+    s = _sched(net)
+    # A: 10-token prompt, 2 generated -> 11 written positions =
+    # 2 full blocks + a partial with fill 3
+    pA = rng.integers(0, VOCAB, (1, 10))
+    wantA = generate_eager(net, pA, 2)
+    fA = s.submit(pA, 2)
+    _drive(s, [fA])
+    assert np.array_equal(fA.result(0), wantA)
+    # B: A's prompt + its first generated token (11 tokens) — the match
+    # covers both full blocks and 2 tokens of the partial
+    pB = np.concatenate([pA, wantA[:, 10:11]], axis=1)
+    wantB = generate_eager(net, pB, 6)
+    fB = s.submit(pB, 6)
+    _drive(s, [fB])
+    assert np.array_equal(fB.result(0), wantB)
+    st = s.stats()["prefix_cache"]
+    assert st["cow_copies"] >= 1
+    # the originator's cached prefix still serves bit-identically
+    fA2 = s.submit(pA, 2)
+    _drive(s, [fA2])
+    assert np.array_equal(fA2.result(0), wantA)
+    _assert_drained_clean(s)
+
+
+# ------------------------------------------------ eviction / isolation
+
+def test_eviction_never_evicts_referenced_block():
+    pool = PagedKVCachePool(9, 4, num_layers=1, num_heads=1, head_dim=2)
+    cache = PrefixCache(pool)
+    a = pool.alloc(3)
+    tokens = list(range(12))            # 3 full blocks
+    cache.insert(("m", 1), tokens, a)
+    pool.free_blocks(a)                 # only the cache holds them now
+    m, full, part = cache.match(("m", 1), tokens)  # usable 11 -> 2 full
+    assert m == 8 and len(full) == 2 and part is None
+    # a "sequence" now references blocks 1,2 (refcount 2); block 3 is
+    # cached-but-unreferenced — the ONLY legal eviction victim
+    freed = cache.reclaim(10)
+    assert freed == 1
+    assert cache.cached_blocks() == 2
+    assert pool.ref_count(full[0]) == 2 and pool.ref_count(full[1]) == 2
+    pool.free_blocks(full)              # the sequence retires its hold
+    assert cache.reclaim(10) == 2       # now they may go
+    assert pool.free_count == pool.total_blocks
+
+
+def test_deterministic_lru_eviction_order():
+    pool = PagedKVCachePool(9, 4, num_layers=1, num_heads=1, head_dim=2)
+    cache = PrefixCache(pool)
+    a = pool.alloc(2)
+    cache.insert(("m", 1), list(range(8)), a)          # older chain
+    pool.free_blocks(a)
+    b = pool.alloc(2)
+    cache.insert(("m", 1), [9, 9, 9, 9, 8, 8, 8, 8], b)  # newer chain
+    pool.free_blocks(b)
+    # LRU (logical clock), leaves first: the OLDER chain's leaf goes
+    # first, then its root; the newer chain survives a 2-block reclaim
+    assert cache.reclaim(2) == 2
+    m, full, _ = cache.match(("m", 1), [9, 9, 9, 9, 8, 8, 8, 8, 1])
+    assert m == 8 and len(full) == 2
+    pool.free_blocks(full)
+
+
+def test_canary_lanes_never_cross_match_versions(rng, fresh_registry):
+    """Two versions sharing one pool (same KV spec) must keep separate
+    radix roots: the canary's probe never matches the stable's cached
+    blocks — its K/V came from different params."""
+    net1, net2 = _tiny_gpt(seed=1), _tiny_gpt(seed=9)
+    reg = ModelRegistry()
+    reg.register("lm", net=net1)
+    eng = ParallelInference(registry=reg, replicas=1, continuous=True,
+                            decode_slots=4, decode_burst=4,
+                            kv_block_size=4, prefix_cache=True)
+    try:
+        p = rng.integers(0, VOCAB, (1, 9))
+        assert np.array_equal(
+            eng.submit_generate(p, 8, model="lm", session="s1").result(30),
+            generate_eager(net1, p, 8))
+        reg.deploy("lm", net=net2)      # cutover: new sessions get v2
+        sched = eng._continuous_scheduler()
+        hits_before = sched.stats()["prefix_cache"]["hits"]
+        # same prompt, new version: MUST miss (and be correct for v2)
+        assert np.array_equal(
+            eng.submit_generate(p, 8, model="lm", session="s2").result(30),
+            generate_eager(net2, p, 8))
+        st = sched.stats()
+        assert st["prefix_cache"]["hits"] == hits_before
+        assert st["lanes"] == 2 and len(st["pools"]) == 1
+        # v1's cache still serves v1 (session pin) bit-identically
+        assert np.array_equal(
+            eng.submit_generate(p, 8, model="lm", session="s1").result(30),
+            generate_eager(net1, p, 8))
+        assert sched.stats()["prefix_cache"]["hits"] == hits_before + 1
+    finally:
+        eng.shutdown()
+
+
+# ----------------------------------------------------- preempt / resume
+
+def test_preempt_sharer_keeps_cache_and_stays_bitwise(rng):
+    """A preempted sharer drops only its own references (the cache's
+    interior pins survive — its resume re-matches them), and every
+    output still equals the uninterrupted eager run."""
+    net = _tiny_gpt()
+    prompts = [rng.integers(0, VOCAB, (1, 5)) for _ in range(3)]
+
+    def run():
+        s = _sched(net, num_blocks=12)
+        futs = [s.submit(p, 10) for p in prompts]
+        _drive(s, futs)
+        return s, futs
+
+    s1, futs1 = run()
+    assert s1.stats()["preemptions"] > 0
+    for f, p in zip(futs1, prompts):
+        assert np.array_equal(f.result(0), generate_eager(net, p, 10))
+    # the whole schedule (admits, COWs, preempts, evictions) replays
+    # bit-identically — cache clocks are logical, never wall time
+    s2, futs2 = run()
+    assert list(s1.events) == list(s2.events)
+    for a, b in zip(futs1, futs2):
+        assert np.array_equal(a.result(0), b.result(0))
+    _assert_drained_clean(s1)
+    _assert_drained_clean(s2)
+
+
+def test_prefix_resume_probes_index_warm(rng):
+    """The migration contract with a warm cache: a prefix= resume
+    matches the cached run and re-prefills only the unmatched tail —
+    the token-gap shrinks toward a table clone."""
+    net = _tiny_gpt()
+    s = _sched(net)
+    p = rng.integers(0, VOCAB, (1, 8))
+    want = generate_eager(net, p, 12)
+    f0 = s.submit(p, 12)                 # seeds the cache on retire
+    _drive(s, [f0])
+    assert np.array_equal(f0.result(0), want)
+    prefix = np.asarray([int(t) for t in want[0, 8:14]])
+    f1 = s.submit(p, 12, prefix=prefix)
+    _drive(s, [f1])
+    assert np.array_equal(f1.result(0), want)
+    st = s.stats()
+    cold_cost = p.shape[1] + len(prefix)
+    assert st["resume_reprefill_tokens"] < cold_cost, st
+    assert st["prefix_cache"]["hits"] >= 1
+    _assert_drained_clean(s)
+
+
+# ------------------------------------------------- faults / accounting
+
+@pytest.mark.faultinject
+def test_kill_preempt_evict_interleaving_zero_leaks(rng, fresh_registry):
+    """Seeded kill/preempt/evict interleavings (BurstKill mid-drill, a
+    pool small enough to preempt and reclaim) drain to ZERO leaked and
+    ZERO double-freed blocks, deterministically across replays."""
+    net = _tiny_gpt()
+    prompts = [rng.integers(0, VOCAB, (1, 5)) for _ in range(4)]
+
+    def run():
+        kill = BurstKill(after=2, failures=1)
+        s = _sched(net, num_blocks=12, burst_hook=kill)
+        futs = [s.submit(p, 10, seed=i) for i, p in enumerate(prompts)]
+        for _ in range(400):
+            if all(f.done() for f in futs):
+                break
+            s.step()
+        outcomes = []
+        for f in futs:
+            try:
+                outcomes.append(f.result(0).tolist())
+            except BaseException as e:
+                outcomes.append(type(e).__name__)
+        return s, outcomes
+
+    s1, out1 = run()
+    assert any(isinstance(o, str) for o in out1), "kill never landed"
+    assert any(not isinstance(o, str) for o in out1), "nothing survived"
+    _assert_drained_clean(s1)
+    s2, out2 = run()
+    assert out1 == out2
+    assert list(s1.events) == list(s2.events)
+    _assert_drained_clean(s2)
+    assert fresh_registry.family_total(monitor.FAULT_EVENTS_COUNTER) >= 1
+
+
+def test_quick_check_section8_deterministic():
+    """stress_faultinject quick_check carries the prefix-cache
+    accounting drill (section 8) and stays deterministic."""
+    sys.path.insert(0, "scripts")
+    try:
+        from stress_faultinject import _scenario_log, quick_check
+    finally:
+        sys.path.pop(0)
+    log = _scenario_log(0)
+    assert "pc " in log and "pc double-free caught" in log
+    assert "leaked=0" in log
+    assert quick_check(seeds=(0, 1), runs_per_seed=2) == []
+
+
+# -------------------------------------------------- zero compiles / router
+
+def test_zero_steady_state_compiles_with_cache(rng, fresh_registry):
+    """Warmup covers the tail-prefill and COW-copy ladders too: cached
+    admissions perform zero steady-state XLA compiles."""
+    net = _tiny_gpt()
+    eng = ParallelInference(net, replicas=1, continuous=True,
+                            decode_slots=4, decode_burst=4,
+                            kv_block_size=4, prefix_cache=True)
+    try:
+        assert eng.warmup_generate([4, 8], 8) > 0
+        miss0 = fresh_registry.family_total(monitor.JIT_CACHE_MISS_COUNTER)
+        shared = rng.integers(0, VOCAB, (1, 5))
+        # the first request RETIRES before the rest submit — insert is
+        # on-retire, so concurrent same-batch admissions cannot hit
+        p0 = np.concatenate([shared, rng.integers(0, VOCAB, (1, 3))],
+                            axis=1)
+        eng.submit_generate(p0, 6, seed=0).result(60)
+        futs = []
+        for i in range(1, 4):
+            p = np.concatenate(
+                [shared, rng.integers(0, VOCAB, (1, 3))], axis=1)
+            futs.append(eng.submit_generate(p, 6, seed=i))
+        for f in futs:
+            f.result(60)
+        assert fresh_registry.family_total(
+            monitor.JIT_CACHE_MISS_COUNTER) == miss0
+        assert eng.stats()["scheduler"]["prefix_cache"]["hits"] >= 1
+    finally:
+        eng.shutdown()
+
+
+class _StubEp:
+    """Minimal alive endpoint for router-admission unit tests."""
+
+    def __init__(self, name):
+        self.name = name
+        self.last_seen = 0.0
+
+    def alive(self):
+        return True
+
+    def stats(self):
+        return {"queue_depth": 0}
+
+
+def test_router_prefix_affinity_tiebreak(rng):
+    """When admission estimates tie exactly, the endpoint that last
+    served the prompt's prefix wins; otherwise name order — and
+    health/deadline behavior is untouched."""
+    router = InferenceRouter(endpoints=[_StubEp("b"), _StubEp("a")])
+    prompt = rng.integers(0, VOCAB, (1, 6))
+    key = router._prefix_key(prompt, None)
+    assert key is not None
+    # cold tie: stable name order
+    assert router._admit(None, "interactive", None, None,
+                         key).endpoint.name == "a"
+    # b holds the prefix now: the tie breaks toward the warm cache
+    router._note_prefix_owner(key, "b")
+    assert router._admit(None, "interactive", None, None,
+                         key).endpoint.name == "b"
+    # a different prompt: no owner, back to name order
+    other = router._prefix_key(rng.integers(0, VOCAB, (1, 6)) + 100, None)
+    assert router._admit(None, "interactive", None, None,
+                         other).endpoint.name == "a"
+    router.close()
+
+
+def test_endpoint_stats_and_snapshot_surface_cache(rng):
+    """stats()/fleet_snapshot expose the prefix-cache summary (count +
+    bytes + hit rate) — the heartbeat-carried affinity view."""
+    from deeplearning4j_tpu.serving.endpoint import LocalEndpoint
+    net = _tiny_gpt()
+    eng = ParallelInference(net, replicas=1, continuous=True,
+                            decode_slots=4, decode_burst=4,
+                            kv_block_size=4, prefix_cache=True)
+    router = InferenceRouter()
+    try:
+        router.add_endpoint(LocalEndpoint(eng, name="e0"))
+        p = rng.integers(0, VOCAB, (1, 9))
+        want = generate_eager(net, p, 6)
+        assert np.array_equal(
+            router.submit_generate(p, 6).result(30), want)
+        assert np.array_equal(
+            router.submit_generate(p, 6).result(30), want)
+        pc = eng.stats()["scheduler"]["prefix_cache"]
+        assert pc["hits"] >= 1 and pc["cached_bytes"] > 0
+        assert 0.0 < pc["hit_rate"] <= 1.0
+        snap = router.fleet_snapshot()
+        ep = snap["endpoints"]["e0"]["prefix_cache"]
+        assert ep is not None
+        assert ep["cached_blocks"] > 0 and ep["cached_bytes"] > 0
+    finally:
+        router.close()
+        eng.shutdown()
+
+
+def test_metric_schema_pinned(rng, fresh_registry):
+    """The dl4j_prefixcache_* family validates as Prometheus exposition
+    and is pinned in KNOWN_DL4J_METRICS."""
+    sys.path.insert(0, "scripts")
+    try:
+        from check_telemetry_schema import (KNOWN_DL4J_METRICS,
+                                            validate_known_metrics,
+                                            validate_prometheus_text)
+    finally:
+        sys.path.pop(0)
+    for name in ("dl4j_prefixcache_hits_total",
+                 "dl4j_prefixcache_misses_total",
+                 "dl4j_prefixcache_evictions_total",
+                 "dl4j_prefixcache_cow_copies_total",
+                 "dl4j_prefixcache_cached_blocks",
+                 "dl4j_prefixcache_shared_blocks",
+                 "dl4j_prefixcache_saved_prefill_tokens_total"):
+        assert name in KNOWN_DL4J_METRICS, name
+    net = _tiny_gpt()
+    s = _sched(net, num_blocks=12)
+    p = rng.integers(0, VOCAB, (1, 10))
+    futs = [s.submit(p, 8)]
+    _drive(s, futs)
+    futs = [s.submit(p, 8)]              # a hit
+    _drive(s, futs)
+    futs = [s.submit(rng.integers(0, VOCAB, (1, 12)), 10)
+            for _ in range(3)]           # pressure: evictions
+    _drive(s, futs)
+    text = fresh_registry.prometheus_text()
+    assert validate_prometheus_text(text) == []
+    assert validate_known_metrics(text) == []
+    for family in ("dl4j_prefixcache_hits_total",
+                   "dl4j_prefixcache_misses_total",
+                   "dl4j_prefixcache_cached_blocks",
+                   "dl4j_prefixcache_shared_blocks",
+                   "dl4j_prefixcache_saved_prefill_tokens_total"):
+        assert f"# TYPE {family}" in text, family
+    _assert_drained_clean(s)
