@@ -44,6 +44,16 @@ Sub-benchmarks (each reported under "sub_benchmarks"):
     vs the single-device step, steady-state jit-miss counts, and
     checkpoint save + restore-with-relayout (8→4, 8→1) latency — the
     MULTICHIP_r*.json trajectory feed
+  - mesh_serving — mesh-sharded serving slices (ISSUE 12): tp=4 slice
+    endpoints serving streams through the router on a forced-8-device
+    mesh with one chip KILLED mid-run — zero lost requests/tokens
+    (every stream token-for-token vs eager), elastic rebuild at half
+    width, recovery time — plus the disaggregated prefill/decode
+    phase: decode inter-token p99 under 1x/2x prefill-heavy load with
+    and without a prefill endpoint, and the pinned offload semantics
+    (the decode endpoint computes ZERO heavy-prompt tokens — on one
+    physical core the p99s are semantics+overhead numbers, the
+    mesh_train caveat; on real chips the offload IS the p99-flatness)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 The headline metric is ResNet-50 MFU when available (the heaviest
@@ -1814,6 +1824,330 @@ def bench_mesh_train():
     }
 
 
+def _mesh_serving_worker():
+    """Worker half of ``bench_mesh_serving`` — fresh interpreter, 8
+    forced CPU devices. Prints ONE JSON line with the kill-a-chip and
+    disaggregation phase results."""
+    import os
+    import tempfile
+    import threading
+    import time as _t
+
+    import jax
+
+    from deeplearning4j_tpu.models.zoo.transformer import gpt
+    from deeplearning4j_tpu.nn.generate import generate_eager
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving import (InferenceRouter, LocalEndpoint,
+                                            LocalFleet, RetryAfter)
+    from deeplearning4j_tpu.util.model_serializer import (restore_model,
+                                                          write_model)
+
+    assert len(jax.devices()) == 8, jax.devices()
+    vocab = 31
+    lm = gpt(vocab_size=vocab, d_model=32, n_layers=2, num_heads=4,
+             max_len=64, compute_dtype="float32", learning_rate=0.01,
+             seed=0).init()
+    td = tempfile.mkdtemp(prefix="dl4j-mesh-serving-")
+    art = os.path.join(td, "lm.zip")
+    write_model(lm, art)
+    rng = np.random.default_rng(0)
+
+    class Collector:
+        def __init__(self):
+            self.tokens = []
+            self.at = []
+            self.dups = 0
+            self.gaps = 0
+
+        def __call__(self, off, toks):
+            now = _t.perf_counter()
+            for i, t in enumerate(np.asarray(toks).reshape(-1).tolist()):
+                idx = int(off) + i
+                if idx < len(self.tokens):
+                    self.dups += 1
+                elif idx == len(self.tokens):
+                    self.tokens.append(int(t))
+                    self.at.append(now)
+                else:
+                    self.gaps += 1
+
+    # ---- phase A: tp=4 slices, kill a chip mid-run ---------------------
+    engines = []
+
+    def slice_factory(plane):
+        eng = ParallelInference(net=restore_model(art), slice_plane=plane,
+                                continuous=True, decode_slots=4,
+                                decode_burst=4, kv_block_size=8,
+                                max_latency_ms=1.0)
+        # warm the slice's program ladders BEFORE it takes traffic
+        # (recovery_s therefore includes the rebuilt slice's warmup —
+        # the honest restore-to-serving number)
+        eng.warmup_generate([8], 12)
+        engines.append(eng)
+        return eng
+
+    router = InferenceRouter(per_try_timeout_s=10.0, eject_backoff_s=0.1,
+                             max_attempts=6, wedge_timeout_s=2.0)
+    fleet = LocalFleet(slice_factory, router=router, heartbeat_s=0.05,
+                       request_timeout_s=5.0, heartbeat_timeout_s=0.5,
+                       slice_width=4, slice_devices=jax.devices())
+    fleet.add_endpoint()
+    fleet.add_endpoint()
+    assert fleet.wait_ready(60)
+
+    n_sessions, max_new = 24, 12
+    kill_at = 8
+    sessions = []
+    t_kill = t_degraded = t_recovered = None
+    killed_name = None
+    t0 = _t.perf_counter()
+    for i in range(n_sessions):
+        t_in = int(rng.integers(3, 8))
+        prompt = rng.integers(1, vocab, (1, t_in))
+        temp = 0.6 if i % 3 == 0 else 0.0
+        oracle = generate_eager(lm, prompt, max_new, temperature=temp,
+                                seed=i)
+        coll = Collector()
+        fut = None
+        for _ in range(400):
+            try:
+                fut = router.submit_generate(
+                    prompt, max_new, temperature=temp, seed=i,
+                    session=f"bench-{i}", on_tokens=coll)
+                break
+            except RetryAfter:
+                _t.sleep(0.02)
+        sessions.append((fut, oracle, coll))
+        if i == kill_at:
+            killed_name = fleet.names()[0]
+            fleet.kill_chip(killed_name, seed=1)
+            t_kill = _t.perf_counter()
+
+            def _watch():
+                nonlocal t_degraded, t_recovered
+                while t_recovered is None:
+                    snap = router.fleet_snapshot()
+                    info = snap["endpoints"][killed_name]
+                    sl = info.get("slice") or {}
+                    if t_degraded is None and sl.get("degraded"):
+                        t_degraded = _t.perf_counter()
+                        fleet.rebuild_slice(killed_name)
+                    elif t_degraded is not None and info["in_pool"]:
+                        t_recovered = _t.perf_counter()
+                        return
+                    _t.sleep(0.02)
+            threading.Thread(target=_watch, daemon=True).start()
+        _t.sleep(0.03)
+
+    lost = mismatches = dups = gaps = 0
+    for fut, oracle, coll in sessions:
+        try:
+            out = fut.result(timeout=120)
+        except BaseException:
+            lost += 1
+            continue
+        if not np.array_equal(out, oracle):
+            mismatches += 1
+        if coll.tokens != [int(t) for t in oracle[0, -max_new:]]:
+            mismatches += 1
+        dups += coll.dups
+        gaps += coll.gaps
+    dt = _t.perf_counter() - t0
+    deadline = _t.perf_counter() + 60
+    while t_recovered is None and _t.perf_counter() < deadline:
+        _t.sleep(0.05)
+    # fleet convergence: collapse ejection backoffs and let probe
+    # traffic reinstate half-open endpoints
+    snap = router.fleet_snapshot()
+    conv_deadline = _t.perf_counter() + 30
+    while _t.perf_counter() < conv_deadline:
+        router.probe_now()
+        try:
+            router.generate(rng.integers(1, vocab, (1, 4)), 1, timeout=30)
+        except BaseException:
+            pass
+        snap = router.fleet_snapshot()
+        if snap["healthy_endpoints"] >= 2:
+            break
+        _t.sleep(0.05)
+    leaked = 0
+    for eng in engines:
+        sched = eng._scheduler
+        if sched is None:
+            continue
+        pool = sched.stats()["pool"]
+        leaked += int(pool["blocks_total"] - pool["blocks_free"])
+    kill_phase = {
+        "sessions": n_sessions,
+        "lost_requests": lost,
+        "token_mismatches": mismatches,
+        "dup_offsets": dups,
+        "gap_events": gaps,
+        "leaked_blocks": leaked,
+        "tokens_per_sec": round(n_sessions * max_new / dt, 1),
+        "migrations": snap["migrations"],
+        "rebuilt_width": fleet._members[killed_name].plane.axis_size("tp"),
+        "recovery_s": (None if t_recovered is None or t_kill is None
+                       else round(t_recovered - t_kill, 3)),
+        "healthy_endpoints": snap["healthy_endpoints"],
+    }
+    fleet.shutdown(drain=False)
+    router.close()
+
+    # ---- phase B: disaggregated prefill/decode -------------------------
+    dec_eng = ParallelInference(net=restore_model(art), continuous=True,
+                                decode_slots=4, decode_burst=4,
+                                kv_block_size=8, max_latency_ms=1.0)
+    pre_eng = ParallelInference(net=restore_model(art), max_latency_ms=1.0)
+    dec_eng.warmup_generate([4], 56)       # the steady decode streams
+    dec_eng.warmup_generate([40], 1)       # the prefill-heavy requests
+    pre_eng.warmup_prefill([4, 40])
+
+    def run_phase(disagg: bool, n_heavy: int, rounds: int = 3):
+        r = InferenceRouter(per_try_timeout_s=30.0)
+        r.add_endpoint(LocalEndpoint(dec_eng, "dec"), role="decode")
+        if disagg:
+            r.add_endpoint(LocalEndpoint(pre_eng, "pre"), role="prefill")
+        gaps_ms = []
+        heavy_total = 0
+        sched0 = dec_eng.stats()["scheduler"]
+        prefill_tokens0 = sched0["prefill_tokens_computed"]
+        handoffs0 = sched0["kv_handoffs"]
+        for rnd in range(rounds):
+            streams = []
+            for i in range(3):
+                prompt = rng.integers(1, vocab, (1, 4))
+                coll = Collector()
+                fut = r.submit_generate(prompt, 56, seed=100 + i,
+                                        session=f"d-{disagg}-{rnd}-{i}",
+                                        on_tokens=coll)
+                streams.append((fut, coll))
+            # prefill-heavy wave while the streams decode: each heavy
+            # request's long prompt forward is the head-of-line block
+            # the fused path pays between decode bursts; the disagg
+            # path runs it on the prefill endpoint instead
+            heavy = []
+            for _ in range(n_heavy):
+                prompt = rng.integers(1, vocab, (1, 40))
+                try:
+                    heavy.append(r.submit_generate(prompt, 1, seed=7))
+                except RetryAfter:
+                    pass
+                _t.sleep(0.005)
+            for f, _ in streams:
+                f.result(timeout=120)
+            for f in heavy:
+                try:
+                    f.result(timeout=120)
+                except BaseException:
+                    pass
+            heavy_total += len(heavy)
+            for _f, coll in streams:
+                gaps_ms.extend((b - a) * 1e3
+                               for a, b in zip(coll.at, coll.at[1:]))
+        r.close()
+        p99 = float(np.percentile(gaps_ms, 99)) if gaps_ms else 0.0
+        sched1 = dec_eng.stats()["scheduler"]
+        return {"heavy_per_round": n_heavy,
+                "heavy_requests": heavy_total,
+                "gap_samples": len(gaps_ms),
+                "inter_token_p99_ms": round(p99, 2),
+                # the offload semantics: prompt tokens the DECODE
+                # endpoint computed itself (disagg: streams only —
+                # every heavy prompt arrives as shipped KV)
+                "decode_prefill_tokens":
+                    sched1["prefill_tokens_computed"] - prefill_tokens0,
+                "kv_handoffs": sched1["kv_handoffs"] - handoffs0}
+
+    base_load = 6  # heavy prefills per round; 2x doubles the wave
+    disagg_1x = run_phase(True, base_load)
+    disagg_2x = run_phase(True, base_load * 2)
+    fused_1x = run_phase(False, base_load)
+    fused_2x = run_phase(False, base_load * 2)
+    handoffs = dec_eng.stats()["scheduler"]["kv_handoffs"]
+    dec_eng.shutdown()
+    pre_eng.shutdown()
+
+    def ratio(a, b):
+        return round(b["inter_token_p99_ms"]
+                     / max(a["inter_token_p99_ms"], 1e-9), 3)
+
+    disagg_phase = {
+        "kv_handoffs": handoffs,
+        "disagg_1x": disagg_1x, "disagg_2x": disagg_2x,
+        "fused_1x": fused_1x, "fused_2x": fused_2x,
+        # the claim: decode p99 flat while prefill load doubles. NOTE
+        # on this box every endpoint timeshares ONE physical core, so
+        # wall-clock p99 is a semantics+overhead number (the mesh_train
+        # caveat); the structural win the harness PINS is the offload —
+        # the decode endpoint computes ZERO heavy-prompt tokens under
+        # disaggregation (decode_prefill_tokens covers the streams
+        # only), which on real chips is exactly the head-of-line work
+        # that moves off the decode plane.
+        "disagg_p99_ratio_2x_vs_1x": ratio(disagg_1x, disagg_2x),
+        "fused_p99_ratio_2x_vs_1x": ratio(fused_1x, fused_2x),
+        "heavy_prompt_tokens_offloaded_2x":
+            fused_2x["decode_prefill_tokens"]
+            - disagg_2x["decode_prefill_tokens"],
+    }
+    print(json.dumps({"kill_a_chip": kill_phase,
+                      "disaggregation": disagg_phase}))
+
+
+def bench_mesh_serving():
+    """Mesh-sharded serving slices (ISSUE 12): two tp=4 slice endpoints
+    on a forced-8-device mesh serving 24 decode streams through the
+    router while one CHIP is killed mid-run — the poisoned slice
+    declares itself degraded, its streams migrate token-for-token, the
+    fleet rebuilds the slice at half width from the survivors; zero
+    lost requests/tokens is the acceptance bar and recovery time is
+    reported. Then the disaggregated prefill/decode phase: steady
+    decode streams' inter-token p99 under 1x vs 2x prefill-heavy load,
+    with and without a prefill-specialized endpoint, plus the PINNED
+    offload semantics — under disaggregation the decode endpoint
+    computes ZERO heavy-prompt tokens (every heavy prompt arrives as
+    shipped KV). On this box every endpoint timeshares ONE physical
+    core, so the wall-clock p99s are semantics+overhead numbers (the
+    ``mesh_train`` caveat); on real chips the offloaded prompt forward
+    is exactly the head-of-line block that keeps decode p99 flat while
+    prefill load doubles."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU plugin in the worker
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["DL4J_TPU_DISABLE_DEVICE_TRACE"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "_mesh_serving_worker"],
+        env=env, capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"mesh_serving worker failed:\n{proc.stderr[-3000:]}")
+    results = json.loads(proc.stdout.strip().splitlines()[-1])
+    kill = results["kill_a_chip"]
+    dis = results["disaggregation"]
+    ok = (kill["lost_requests"] == 0 and kill["token_mismatches"] == 0
+          and kill["dup_offsets"] == 0 and kill["gap_events"] == 0
+          and kill["leaked_blocks"] == 0
+          # disaggregation offload semantics: under 2x prefill load the
+          # decode endpoint recomputed NO heavy-prompt tokens (only the
+          # streams' own short prompts) — the DistServe claim, pinned
+          and dis["disagg_2x"]["decode_prefill_tokens"]
+          < dis["fused_2x"]["decode_prefill_tokens"]
+          and dis["disagg_2x"]["kv_handoffs"] > 0)
+    return {
+        "metric": "mesh_serving_kill_a_chip_completion",
+        "value": kill["sessions"] - kill["lost_requests"],
+        "unit": "sessions",
+        "vs_baseline": 1.0 if ok else 0.0,
+        **results,
+    }
+
+
 def bench_word2vec():
     """Word2Vec skip-gram (BASELINE config #5): the all-epochs-on-device
     SGNS scan engine (device pairgen + table negatives + capped MXU
@@ -1911,6 +2245,7 @@ def main():
                      ("router_slo", bench_router_slo),
                      ("multi_model", bench_multi_model),
                      ("mesh_train", bench_mesh_train),
+                     ("mesh_serving", bench_mesh_serving),
                      ("word2vec", bench_word2vec)]:
         # fresh registry per sub-bench: the monitor spans inside the
         # fit/stage paths give each result its own per-phase attribution
@@ -1960,5 +2295,7 @@ if __name__ == "__main__":
 
     if len(_sys.argv) > 1 and _sys.argv[1] == "_mesh_train_worker":
         _mesh_train_worker()
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "_mesh_serving_worker":
+        _mesh_serving_worker()
     else:
         main()

@@ -364,6 +364,59 @@ class MeshShrink:
         return idx
 
 
+class SliceKill:
+    """Kill-a-chip injector for a live SERVING SLICE (the ISSUE-12
+    drill): from ``fail_at`` (0-based count of engine dispatches —
+    classify batches, decode bursts and probes all tick the same
+    clock), every dispatch raises :class:`ChipFailure` naming the
+    slice's SURVIVORS — the seeded ``victim`` chip chosen from the
+    slice's devices is gone for good, which is why the schedule never
+    heals: a dead chip's dispatches stay dead until the fleet rebuilds
+    the slice from the survivors (``LocalFleet.rebuild_slice``).
+
+    Installable as BOTH engine seams at once: the ``poison_hook``
+    (classify dispatches; ``wants_model`` so multi-model engines work)
+    and the continuous scheduler's ``burst_hook`` (decode bursts) —
+    ``LocalFleet.kill_chip`` arms both. Same ``(devices, seed,
+    fail_at)`` ⇒ same victim, same survivor set, same failure tick:
+    the drill replays bit-identically."""
+
+    wants_model = True
+
+    def __init__(self, plane_or_devices, victim: Optional[int] = None,
+                 seed: int = 0, fail_at: int = 0):
+        mesh = getattr(plane_or_devices, "mesh", None)
+        if mesh is not None:
+            devices = sorted(int(d.id) for d in mesh.devices.flat)
+        else:
+            devices = sorted(int(i) for i in plane_or_devices)
+        if not devices:
+            raise ValueError("SliceKill needs the slice's devices")
+        self.devices = tuple(devices)
+        if victim is not None:
+            victim = int(victim)
+            if victim not in self.devices:
+                raise ValueError(
+                    f"victim chip {victim} not in slice {devices}")
+        else:
+            victim = devices[random.Random(seed).randrange(len(devices))]
+        self.victim = victim
+        self.survivors = tuple(i for i in self.devices if i != victim)
+        self.fail_at = int(fail_at)
+        self.calls = 0
+        self.hits = 0
+
+    def __call__(self, *args) -> None:
+        idx = self.calls
+        self.calls += 1
+        if idx >= self.fail_at:
+            self.hits += 1
+            raise ChipFailure(
+                f"injected chip {self.victim} failure in slice "
+                f"{list(self.devices)} at dispatch {idx} "
+                f"(survivors {list(self.survivors)})", self.survivors)
+
+
 # -------------------------------------------------------------- routing
 
 class BurstKill:
@@ -516,7 +569,9 @@ class NetworkPartition(MessageBroker):
 
 from deeplearning4j_tpu.faultinject.chaos import (  # noqa: E402,F401
     ACTIONS as CHAOS_ACTIONS,
+    SLICE_ACTIONS,
     ChaosEvent,
     ChaosSchedule,
     run_chaos_drill,
+    run_slice_drill,
 )

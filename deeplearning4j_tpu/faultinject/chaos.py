@@ -54,6 +54,12 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 ACTIONS: Tuple[str, ...] = ("kill", "partition_hb", "wedge", "burst_kill",
                             "replica_poison", "poison_model", "torn_write")
 
+#: the slice-drill action set (run_slice_drill): chip death inside a
+#: live mesh slice composes with the transport/progress faults — the
+#: heal of a slice_kill is an ELASTIC REBUILD (narrower slice restored
+#: from the mesh-portable checkpoint), never a restart of the dead chip
+SLICE_ACTIONS: Tuple[str, ...] = ("slice_kill", "partition_hb", "wedge")
+
 
 class ChaosEvent:
     """One scheduled fault: fire at request-count ``tick`` against
@@ -458,4 +464,281 @@ def run_chaos_drill(seed: int = 0, n_requests: int = 16, n_events: int = 4,
         "leaked_blocks": leaked,
         "healthy_endpoints": healthy,
         "ckpt_fallback_ok": ckpt_fallback_ok,
+    }
+
+
+def run_slice_drill(seed: int = 0, n_requests: int = 12, n_events: int = 2,
+                    max_new: int = 6, slice_width: int = 2,
+                    n_slices: int = 2, timeout_s: float = 120.0,
+                    per_try_timeout_s: float = 4.0,
+                    wedge_timeout_s: float = 1.0,
+                    pace_s: float = 0.02) -> Dict[str, Any]:
+    """The MESH-SLICE composed drill (ISSUE 12): ``n_slices`` serving
+    endpoints, each a ``slice_width``-chip mesh slice restored from ONE
+    mesh-portable model artifact, under mixed decode-stream + classify
+    load while the seeded clock composes ``slice_kill`` (a chip dies
+    INSIDE a slice → the engine poisons itself with typed
+    ``SliceDegraded`` → streams migrate via the journal/resume path →
+    the heal tick REBUILDS the slice at half width from the survivors),
+    heartbeat partitions and wedges. Invariants after drain: every
+    request resolves with the exact single-device output (bitwise
+    classify, token-for-token greedy/sampled streams — the house bar
+    holds THROUGH chip death), append-only delivery (dup=0, gap=0),
+    zero leaked KV blocks across every engine ever alive (dead slices
+    included), and the fleet converges with every endpoint back in the
+    pool."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.faultinject import NetworkPartition
+    from deeplearning4j_tpu.models.zoo.transformer import gpt
+    from deeplearning4j_tpu.nn.generate import generate_eager
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving import (InferenceRouter, LocalFleet,
+                                            RetryAfter)
+    from deeplearning4j_tpu.util.model_serializer import (restore_model,
+                                                          write_model)
+
+    need = slice_width * n_slices
+    if len(jax.devices()) < need:
+        raise RuntimeError(
+            f"slice drill needs {need} devices, have {len(jax.devices())}")
+
+    vocab = 11
+
+    def make_lm():
+        return gpt(vocab_size=vocab, d_model=16, n_layers=2, num_heads=2,
+                   max_len=32, compute_dtype="float32", learning_rate=0.01,
+                   seed=0).init()
+
+    lm = make_lm()  # the single-device oracle
+    art_dir = tempfile.mkdtemp(prefix="dl4j-slice-drill-")
+    art = os.path.join(art_dir, "lm.zip")
+    write_model(lm, art)
+
+    engines: List[ParallelInference] = []
+
+    def engine_factory(plane):
+        # ONE saved artifact deploys onto ANY slice width — the
+        # mesh-portable contract; apply_serving_slice re-lowers it
+        net = restore_model(art)
+        eng = ParallelInference(net=net, slice_plane=plane,
+                                max_batch_size=4, max_latency_ms=1.0,
+                                queue_capacity=256, continuous=True,
+                                decode_slots=2, decode_burst=4,
+                                kv_block_size=4)
+        engines.append(eng)
+        return eng
+
+    router = InferenceRouter(per_try_timeout_s=per_try_timeout_s,
+                             eject_backoff_s=0.1, max_attempts=6,
+                             wedge_timeout_s=wedge_timeout_s)
+    fleet = LocalFleet(engine_factory, router=router, heartbeat_s=0.05,
+                       request_timeout_s=per_try_timeout_s,
+                       heartbeat_timeout_s=0.5,
+                       slice_width=slice_width,
+                       slice_devices=jax.devices()[:need])
+    for _ in range(n_slices):
+        fleet.add_endpoint()
+    fleet.wait_ready(30)
+    names = fleet.names()
+    schedule = ChaosSchedule(seed, n_events=n_events,
+                             n_endpoints=n_slices, actions=SLICE_ACTIONS)
+    rng = np.random.default_rng(int(seed) * 104729 + 7)
+    partitions = {}
+    for name in names:
+        part = NetworkPartition(fleet._broker,
+                                topic_substr=name + ".hb", silent=True)
+        fleet.endpoint(name)._hb_broker = part
+        partitions[name] = part
+
+    rebuilt_widths: List[int] = []
+    dead: Dict[str, bool] = {}
+
+    def apply(ev: ChaosEvent) -> Callable[[], None]:
+        name = names[ev.target % len(names)]
+        if ev.action == "slice_kill":
+            if dead.get(name):
+                return lambda: None
+            fleet.kill_chip(name, seed=seed * 31 + ev.tick)
+            dead[name] = True
+            # trip the armed injector deterministically: the poisoned
+            # chip fails the very next dispatch, and the engine
+            # declares the slice degraded in its heartbeats
+            eng = fleet._members[name].worker.engine
+            try:
+                eng.output(np.zeros((1, 4), np.float32), timeout=10)
+            except BaseException:
+                pass
+
+            def heal():
+                # ELASTIC REBUILD: half width from the survivors —
+                # never a restart of the dead chip
+                rebuilt_widths.append(fleet.rebuild_slice(name))
+                dead[name] = False
+            return heal
+        if ev.action == "partition_hb":
+            part = partitions[name].partition()
+            return part.heal
+        if ev.action == "wedge":
+            if dead.get(name):
+                return lambda: None
+            fleet.wedge(name)
+            return lambda: fleet.unwedge(name)
+        raise ValueError(f"unknown slice action {ev.action!r}")
+
+    pending_events = list(schedule.events)
+    pending_heals: List[Tuple[int, Callable[[], None]]] = []
+    futs: List[list] = []
+    submitted = 0
+
+    def _fire(r: Dict[str, Any], attempt: int = 0):
+        if r["kind"] == "decode":
+            coll = _StreamCollector()
+            fut = router.submit_generate(
+                r["x"], max_new, temperature=r["temp"], seed=r["seed"],
+                session=f"slice-{r['seed']}-{attempt}", on_tokens=coll)
+            return fut, coll
+        return router.submit(r["x"]), None
+
+    try:
+        for tick in range(n_requests):
+            for _, heal in [h for h in pending_heals if h[0] <= tick]:
+                heal()
+            pending_heals = [h for h in pending_heals if h[0] > tick]
+            for ev in [e for e in pending_events if e.tick <= tick]:
+                pending_heals.append((ev.heal_tick, apply(ev)))
+            pending_events = [e for e in pending_events if e.tick > tick]
+
+            if tick % 2 == 0:
+                t0 = int(rng.integers(3, 6))
+                prompt = rng.integers(1, vocab, (1, t0))
+                temp = 0.7 if tick % 4 == 0 else 0.0
+                oracle = generate_eager(lm, prompt, max_new,
+                                        temperature=temp, seed=tick)
+                req = {"kind": "decode", "x": prompt, "temp": temp,
+                       "seed": tick, "oracle": oracle}
+            else:
+                ids = rng.integers(1, vocab, (1, 6))
+                req = {"kind": "classify", "x": ids,
+                       "oracle": np.asarray(lm.output(ids))}
+            for _ in range(200):
+                try:
+                    fut, coll = _fire(req)
+                    futs.append([req["kind"], fut, req["oracle"], coll,
+                                 req])
+                    submitted += 1
+                    break
+                except RetryAfter:
+                    time.sleep(0.05)
+            time.sleep(pace_s)
+
+        for _, heal in pending_heals:
+            heal()
+        for name in names:
+            partitions[name].heal()
+            try:
+                fleet.unwedge(name)
+            except BaseException:
+                pass
+            if dead.get(name):
+                rebuilt_widths.append(fleet.rebuild_slice(name))
+                dead[name] = False
+        router.probe_now()
+
+        deadline = time.monotonic() + timeout_s
+        for entry in futs:
+            try:
+                entry[1].result(
+                    timeout=max(0.1, deadline - time.monotonic()))
+            except BaseException:
+                pass
+        # typed failures during the all-bad window get bounded
+        # resubmission against the healed fleet, exactly like the main
+        # drill — the exactness audit applies to each delivered stream
+        for retry_round in range(1, 4):
+            pending = [e for e in futs
+                       if e[1].done() and e[1].exception() is not None]
+            if not pending:
+                break
+            for entry in pending:
+                for _ in range(100):
+                    try:
+                        entry[1], entry[3] = _fire(entry[4], retry_round)
+                        break
+                    except RetryAfter:
+                        time.sleep(0.05)
+            for entry in pending:
+                try:
+                    entry[1].result(
+                        timeout=max(0.1, deadline - time.monotonic()))
+                except BaseException:
+                    pass
+        failed = sum(1 for _, f, _, _, _ in futs
+                     if f.done() and f.exception() is not None)
+        stranded = sum(1 for _, f, _, _, _ in futs if not f.done())
+
+        mismatches = dup_offsets = gap_events = 0
+        for kind, fut, oracle, coll, _r in futs:
+            if not fut.done() or fut.exception() is not None:
+                continue
+            got = np.asarray(fut.result())
+            if not np.array_equal(got, oracle):
+                mismatches += 1
+            if coll is not None:
+                dup_offsets += coll.dups
+                gap_events += coll.gaps
+                if coll.tokens != [int(t) for t in oracle[0, -max_new:]]:
+                    mismatches += 1
+
+        healthy = 0
+        conv_deadline = time.monotonic() + 30
+        probe = rng.integers(1, vocab, (1, 4))
+        while time.monotonic() < conv_deadline:
+            router.probe_now()
+            try:
+                router.output(probe, timeout=10)
+            except BaseException:
+                pass
+            healthy = router.fleet_snapshot()["healthy_endpoints"]
+            if healthy >= n_slices:
+                break
+            time.sleep(0.05)
+
+        leaked = 0
+        for eng in engines:
+            if not eng._closed and eng._slice_dead is None:
+                eng.drain(timeout=30)
+            sched = eng._scheduler
+            if sched is None:
+                continue
+            free_deadline = time.monotonic() + 10
+            while time.monotonic() < free_deadline:
+                pool = sched.stats()["pool"]
+                if pool["blocks_free"] >= pool["blocks_total"]:
+                    break
+                time.sleep(0.02)
+            pool = sched.stats()["pool"]
+            leaked += int(pool["blocks_total"] - pool["blocks_free"])
+    finally:
+        try:
+            fleet.shutdown(drain=False)
+        except BaseException:
+            pass
+        router.close()
+
+    return {
+        "seed": int(seed),
+        "schedule": schedule.signature(),
+        "submitted": submitted,
+        "completed": submitted - failed - stranded,
+        "failed": failed,
+        "stranded_futures": stranded,
+        "token_mismatches": mismatches,
+        "dup_offsets": dup_offsets,
+        "gap_events": gap_events,
+        "leaked_blocks": leaked,
+        "healthy_endpoints": healthy,
+        "slice_rebuilds": len(rebuilt_widths),
+        "rebuilt_widths": sorted(rebuilt_widths),
     }

@@ -230,6 +230,19 @@ MESH_DEVICES_GAUGE = "dl4j_mesh_devices"
 MESH_AXIS_SIZE_GAUGE = "dl4j_mesh_axis_size"
 MESH_RESTORE_RELAYOUT_COUNTER = "dl4j_mesh_restore_relayouts_total"
 
+# Mesh-sharded serving slices (parallel/inference.py slice_plane= +
+# serving/fleet.py elastic rebuild): per-slice device count and
+# degraded flag (``slice=`` label: the slice's sorted device ids), the
+# count of elastic slice rebuilds (``width=`` label: the NARROWER width
+# the mesh-portable checkpoint was restored onto after a chip died),
+# and the count of disaggregated prefill→decode KV handoffs (sessions
+# admitted on a decode endpoint from a prefill endpoint's shipped KV,
+# zero prompt tokens recomputed).
+SLICE_DEVICES_GAUGE = "dl4j_slice_devices"
+SLICE_DEGRADED_GAUGE = "dl4j_slice_degraded"
+SLICE_REBUILDS_COUNTER = "dl4j_slice_rebuilds_total"
+DISAGG_KV_HANDOFFS_COUNTER = "dl4j_disagg_kv_handoffs_total"
+
 # Fault-tolerance plane (detect → isolate → recover): every recovery
 # path in the stack reports through these five families so an operator
 # can tell a self-healed fault from a healthy run. ``domain`` label on

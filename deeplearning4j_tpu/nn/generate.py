@@ -261,16 +261,18 @@ class TransformerGenerator(_GeneratorBase):
 
     def _embed_token(self, p_emb, tok, pos):
         """[b] ids at per-row positions [b] → [b, d]."""
-        return jnp.take(p_emb["W"], tok, axis=0) \
-            + jnp.take(p_emb["P"], pos, axis=0)
+        return self.emb._slice_replicate(
+            jnp.take(p_emb["W"], tok, axis=0)
+            + jnp.take(p_emb["P"], pos, axis=0))
 
     def _get_prefill(self, cache_len: int):
         def builder():
             def prefill(params, ids, lengths):
                 b, t_pad = ids.shape
                 p_emb = self._cast(params[self.emb.name])
-                x = jnp.take(p_emb["W"], ids, axis=0) \
-                    + p_emb["P"][:t_pad][None]
+                x = self.emb._slice_replicate(
+                    jnp.take(p_emb["W"], ids, axis=0)
+                    + p_emb["P"][:t_pad][None])
                 cache_dtype = self.cd if self.cd is not None else jnp.float32
                 caches = []
                 for blk in self.blocks:
@@ -389,6 +391,51 @@ class TransformerGenerator(_GeneratorBase):
         return (len(self.blocks), c.num_heads, c.n_out // c.num_heads,
                 dtype)
 
+    def slice_plane(self):
+        """The net's serving slice plane (``apply_serving_slice``), or
+        None for a single-device net."""
+        return getattr(self.net, "slice_plane", None)
+
+    def kv_sharding(self):
+        """The paged pool's block-array sharding on a sliced net: heads
+        partitioned over ``tp`` (``[num_blocks, block_size, HEADS,
+        head_dim]`` — per-head attention is embarrassingly parallel, so
+        a sharded pool changes no arithmetic), replicated None when the
+        net is not slice-served. num_heads must divide the tp width."""
+        plane = self.slice_plane()
+        if plane is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+        tp = plane.axis_size("tp")
+        heads = self.blocks[0].conf.num_heads
+        if heads % max(1, tp) != 0:
+            raise ValueError(
+                f"KV pool shards heads over tp: {heads} heads not "
+                f"divisible by slice width {tp}")
+        return NamedSharding(plane.mesh,
+                             PartitionSpec(None, None, "tp", None))
+
+    def export_prefill(self, params, ids: np.ndarray, lengths: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """Disaggregated-prefill export: run the bucketed prompt prefill
+        and hand back host copies of (kv [L, 2, b, t_pad, h, hd],
+        last-token logits [b, V]) — the state a DECODE endpoint needs to
+        admit this prompt without recomputing it. The kv tensor is what
+        a local prefill of the same tokens would have written (same
+        program, same params), so a handoff-admitted sequence's tokens
+        are exactly a local run's."""
+        b, t_pad = ids.shape
+        pre = self.prefill_program(t_pad)
+        fresh = note_dispatch(self.net,
+                              ("gen_prefill", "export", b, t_pad, t_pad))
+        with span("compile" if fresh else "inference",
+                  path="prefill_export", bucket=t_pad, rows=b):
+            caches, logits = pre(params, jnp.asarray(ids, jnp.int32),
+                                 jnp.asarray(lengths, jnp.int32))
+        kv = np.stack([np.stack([np.asarray(c["k"]), np.asarray(c["v"])])
+                       for c in caches])
+        return kv, np.asarray(logits)
+
     def max_context(self) -> int:
         return int(self.emb.conf.max_len)
 
@@ -442,8 +489,9 @@ class TransformerGenerator(_GeneratorBase):
             def tail_prefill(params, pools, ids, starts, lens, tables):
                 p_emb = self._cast(params[self.emb.name])
                 pos = starts[:, None] + jnp.arange(t_tail)[None, :]
-                x = jnp.take(p_emb["W"], ids, axis=0) \
-                    + jnp.take(p_emb["P"], pos, axis=0)
+                x = self.emb._slice_replicate(
+                    jnp.take(p_emb["W"], ids, axis=0)
+                    + jnp.take(p_emb["P"], pos, axis=0))
                 write_ok = jnp.arange(t_tail)[None, :] < lens[:, None]
                 new_pools = []
                 for blk, pool in zip(self.blocks, pools):

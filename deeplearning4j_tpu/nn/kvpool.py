@@ -99,7 +99,7 @@ class PagedKVCachePool:
 
     def __init__(self, num_blocks: int, block_size: int, num_layers: int,
                  num_heads: int, head_dim: int, dtype=jnp.float32,
-                 device=None, name: str = "default"):
+                 device=None, name: str = "default", sharding=None):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is the reserved trash "
@@ -117,8 +117,14 @@ class PagedKVCachePool:
                                         block_size, dtype)
         shape = (self.num_blocks, self.block_size, self.num_heads,
                  self.head_dim)
-        put = (lambda a: jax.device_put(a, device)) if device is not None \
-            else (lambda a: a)
+        if sharding is not None and device is not None:
+            raise ValueError("device= and sharding= are exclusive")
+        # sharding: a mesh-slice pool — block arrays partitioned on the
+        # heads axis over the slice's tp axis (per-head attention is
+        # shard-independent, so accounting and arithmetic are unchanged)
+        placement = sharding if sharding is not None else device
+        put = (lambda a: jax.device_put(a, placement)) \
+            if placement is not None else (lambda a: a)
         self.layers: List[Dict[str, jnp.ndarray]] = [
             {"k": put(jnp.zeros(shape, self.dtype)),
              "v": put(jnp.zeros(shape, self.dtype))}

@@ -74,6 +74,27 @@ class LayerImpl:
     # sets this.
     batch_statistics = False
 
+    # Serving-slice seam (parallel/mesh.py apply_serving_slice): when a
+    # net is placed on a mesh SLICE with the column-only tensor-parallel
+    # layout, every impl gets its slice mesh pinned here, and the impl's
+    # traced code calls :meth:`_slice_replicate` right before any
+    # reduction that would otherwise cross shards (a LayerNorm mean over
+    # a sharded feature dim, a matmul contracting a sharded activation).
+    # The constraint lowers to an all-gather — pure data movement — so
+    # sliced output stays BITWISE equal to the single-device program.
+    # None (the default) keeps every existing path byte-identical.
+    _slice_mesh = None
+
+    def _slice_replicate(self, x):
+        """Constrain ``x`` to replicated over the slice mesh (identity
+        when the net is not slice-served)."""
+        mesh = self._slice_mesh
+        if mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, PartitionSpec()))
+
     def __init__(self, global_conf: NeuralNetConfiguration, conf: L.Layer, name: str):
         self.gc = global_conf
         self.conf = conf

@@ -48,6 +48,10 @@ class BaseDenseImpl(LayerImpl):
         return {"W": W, "b": b}
 
     def preout(self, params, x):
+        # serving-slice seam: a previous column-sharded dense layer left
+        # x sharded on its feature dim — all-gather before W contracts
+        # over it, so the contraction never reduces across shards
+        x = self._slice_replicate(x)
         z = x @ params["W"]
         return z + params["b"] if "b" in params else z
 
@@ -78,6 +82,7 @@ class OutputImpl(BaseDenseImpl):
         # all loss math keeps the documented always-f32 guarantee.
         # Higher-precision models (incl. the f64 gradcheck oracle) keep
         # their native matmul — forcing f32 there would DOWNcast.
+        x = self._slice_replicate(x)
         W = params["W"]
         if jnp.promote_types(x.dtype, W.dtype) in (jnp.bfloat16,
                                                    jnp.float16):

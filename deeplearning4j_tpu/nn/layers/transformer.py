@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf import layers as L
-from deeplearning4j_tpu.nn.layers.attention import dispatch_attention
+from deeplearning4j_tpu.nn.layers.attention import (dispatch_attention,
+                                                    xla_attention)
 from deeplearning4j_tpu.nn.layers.base import (
     LayerImpl, apply_dropout, register_impl)
 from deeplearning4j_tpu.nn.layers.moe import (
@@ -60,7 +61,7 @@ class SequenceEmbeddingImpl(LayerImpl):
         if t > self.conf.max_len:
             raise ValueError(f"sequence length {t} > max_len {self.conf.max_len}")
         z = jnp.take(params["W"], idx, axis=0) + params["P"][:t][None]
-        return z, state
+        return self._slice_replicate(z), state
 
 
 @register_impl(L.TransformerBlock)
@@ -115,12 +116,22 @@ class TransformerBlockImpl(LayerImpl):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = lambda z: z.reshape(b, t, h_count, hd)
         q, k, v = shape(q), shape(k), shape(v)
-        o = dispatch_attention(q, k, v, causal=c.causal, mask=mask)
-        attn = o.reshape(b, t, d) @ params["Wo"].astype(x.dtype)
+        if self._slice_mesh is not None:
+            # sliced serving: heads are sharded over tp — the Pallas
+            # flash kernel cannot see the mesh, so stay on the XLA
+            # formulation GSPMD partitions per-head
+            with xla_attention():
+                o = dispatch_attention(q, k, v, causal=c.causal, mask=mask)
+        else:
+            o = dispatch_attention(q, k, v, causal=c.causal, mask=mask)
+        attn = self._slice_replicate(o.reshape(b, t, d)) \
+            @ params["Wo"].astype(x.dtype)
         if train and self.dropout_rate > 0.0 and rng is not None:
             attn = apply_dropout(attn, self.dropout_rate,
                                  jax.random.fold_in(rng, 1))
-        x = x + attn
+        # replicate BEFORE ln2: its mean/var reduce over the feature dim
+        # the attn matmul left sharded
+        x = self._slice_replicate(x + attn)
 
         h2 = _layer_norm(x, params["ln2_g"], params["ln2_b"])
         mlp, new_state = self._ffn(params, h2.reshape(-1, d), state,
@@ -130,7 +141,7 @@ class TransformerBlockImpl(LayerImpl):
         if train and self.dropout_rate > 0.0 and rng is not None:
             mlp = apply_dropout(mlp, self.dropout_rate,
                                 jax.random.fold_in(rng, 2))
-        out = x + mlp
+        out = self._slice_replicate(x + mlp)
         if mask is not None:
             out = out * mask[:, :, None].astype(out.dtype)
         return out, new_state
@@ -145,6 +156,10 @@ class TransformerBlockImpl(LayerImpl):
                                c.aux_loss_weight, mask=mask)
         mlp = jax.nn.gelu(h2 @ params["W1"].astype(h2.dtype)
                           + params["b1"].astype(h2.dtype))
+        # sliced: W1 is column-sharded so mlp is sharded on its hidden
+        # dim — all-gather it before W2 contracts over that dim, so the
+        # contraction never reduces across shards (bitwise seam)
+        mlp = self._slice_replicate(mlp)
         mlp = mlp @ params["W2"].astype(h2.dtype) \
             + params["b2"].astype(h2.dtype)
         return mlp, state
@@ -181,12 +196,19 @@ class TransformerBlockImpl(LayerImpl):
             cache["k"], k.astype(cache["k"].dtype), 0, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(
             cache["v"], v.astype(cache["v"].dtype), 0, axis=1)
-        o = dispatch_attention(q, k, v, causal=c.causal, mask=None)
-        x = x + o.reshape(b, t, d) @ params["Wo"].astype(x.dtype)
+        if self._slice_mesh is not None:
+            with xla_attention():
+                o = dispatch_attention(q, k, v, causal=c.causal, mask=None)
+        else:
+            o = dispatch_attention(q, k, v, causal=c.causal, mask=None)
+        x = self._slice_replicate(
+            x + self._slice_replicate(o.reshape(b, t, d))
+            @ params["Wo"].astype(x.dtype))
         h2 = _layer_norm(x, params["ln2_g"], params["ln2_b"])
         mlp, _ = self._ffn(params, h2.reshape(-1, d), {},
                            capacity_factor=float(max(1, c.num_experts)))
-        return x + mlp.reshape(b, t, d), {"k": ck, "v": cv}
+        return self._slice_replicate(x + mlp.reshape(b, t, d)), \
+            {"k": ck, "v": cv}
 
     def prefill_paged(self, params, x, pool, table, pos, write_ok):
         """Chunked (tail) prefill straight through the paged pool — the
@@ -230,11 +252,14 @@ class TransformerBlockImpl(LayerImpl):
                       jnp.asarray(jnp.finfo(s.dtype).min, s.dtype))
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bkhd->bqhd", w, vg.astype(q.dtype))
-        x = x + o.reshape(b, t, d) @ params["Wo"].astype(x.dtype)
+        x = self._slice_replicate(
+            x + self._slice_replicate(o.reshape(b, t, d))
+            @ params["Wo"].astype(x.dtype))
         h2 = _layer_norm(x, params["ln2_g"], params["ln2_b"])
         mlp, _ = self._ffn(params, h2.reshape(-1, d), {},
                            capacity_factor=float(max(1, c.num_experts)))
-        return x + mlp.reshape(b, t, d), {"k": kp, "v": vp}
+        return self._slice_replicate(x + mlp.reshape(b, t, d)), \
+            {"k": kp, "v": vp}
 
     def decode_step(self, params, x_t, cache, pos, write_mask=None):
         """One-token forward [b, d] with cached keys/values; ``pos`` is
@@ -290,13 +315,15 @@ class TransformerBlockImpl(LayerImpl):
                       jnp.asarray(jnp.finfo(s.dtype).min, s.dtype))
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhk,bkhd->bhd", w, cv.astype(q.dtype))
-        x_t = x_t + o.reshape(b, d) @ params["Wo"].astype(x_t.dtype)
+        x_t = self._slice_replicate(
+            x_t + self._slice_replicate(o.reshape(b, d))
+            @ params["Wo"].astype(x_t.dtype))
 
         h2 = _layer_norm(x_t, params["ln2_g"], params["ln2_b"])
         # no-drop capacity: capacity = ceil(cf*b/E) >= b when cf = E
         mlp, _ = self._ffn(params, h2, {},
                            capacity_factor=float(max(1, c.num_experts)))
-        return x_t + mlp, {"k": ck, "v": cv}
+        return self._slice_replicate(x_t + mlp), {"k": ck, "v": cv}
 
     def _decode_step_paged(self, params, x_t, cache, pos, q, k, v,
                            write_mask):
@@ -334,9 +361,12 @@ class TransformerBlockImpl(LayerImpl):
                       jnp.asarray(jnp.finfo(s.dtype).min, s.dtype))
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhk,bkhd->bhd", w, vg.astype(q.dtype))
-        x_t = x_t + o.reshape(b, d) @ params["Wo"].astype(x_t.dtype)
+        x_t = self._slice_replicate(
+            x_t + self._slice_replicate(o.reshape(b, d))
+            @ params["Wo"].astype(x_t.dtype))
 
         h2 = _layer_norm(x_t, params["ln2_g"], params["ln2_b"])
         mlp, _ = self._ffn(params, h2, {},
                            capacity_factor=float(max(1, c.num_experts)))
-        return x_t + mlp, {"k": kp, "v": vp, "table": table}
+        return self._slice_replicate(x_t + mlp), \
+            {"k": kp, "v": vp, "table": table}

@@ -131,6 +131,17 @@ class InferenceBackpressure(RuntimeError):
     engine was built with ``reject_when_full=True``."""
 
 
+class SliceDegraded(RuntimeError):
+    """A chip inside this engine's mesh slice died: the whole slice is
+    one failure domain (its params and KV pools are sharded across
+    every chip), so the engine poisons itself — in-flight and queued
+    work fails with this typed error, new submits reject at admission,
+    and heartbeats carry the degraded slice topology so the router
+    POSITIVELY knows (no silence, no timeout inference). Recovery is
+    fleet-level: restore the mesh-portable checkpoint onto a narrower
+    slice of the survivors (``LocalFleet.rebuild_slice``)."""
+
+
 class _Request:
     __slots__ = ("x", "n", "future", "t_submit", "model", "version",
                  "coalescible")
@@ -333,7 +344,8 @@ class ParallelInference:
                  kv_blocks: Optional[int] = None,
                  decode_burst_hook=None,
                  prefix_cache: bool = False,
-                 prefix_cache_blocks: Optional[int] = None):
+                 prefix_cache_blocks: Optional[int] = None,
+                 slice_plane=None):
         if net is None and registry is None:
             raise ValueError("ParallelInference needs a net or a registry")
         if net is not None and registry is not None:
@@ -342,6 +354,24 @@ class ParallelInference:
                 "model in the registry instead")
         if net is not None and net.params is None:
             net.init()
+        # mesh-sharded serving: the engine's ONE replica is a mesh SLICE
+        # (params column-sharded per the model's pinned SpecLayout, the
+        # KV pool heads-sharded over tp, programs jitted-with-shardings
+        # on the slice mesh) — and the slice is a first-class FAILURE
+        # DOMAIN: a ChipFailure inside it poisons the whole engine
+        # (typed SliceDegraded, never silence)
+        self.slice_plane = slice_plane
+        self._slice_dead: Optional[BaseException] = None
+        if slice_plane is not None:
+            if net is None:
+                raise ValueError(
+                    "slice_plane= serves one net per slice: build the "
+                    "engine with net= (restore the mesh-portable "
+                    "checkpoint onto the slice)")
+            if getattr(net, "slice_plane", None) is not slice_plane:
+                from deeplearning4j_tpu.parallel.mesh import \
+                    apply_serving_slice
+                self.slice_plane = apply_serving_slice(net, slice_plane)
         self.net = net
         self._registry = registry
         self.max_batch_size = int(max_batch_size)
@@ -361,7 +391,14 @@ class ParallelInference:
             devs = devs[:max(1, int(replicas))]
         if not devs:
             raise ValueError("no devices to place replicas on")
-        if net is not None:
+        if net is not None and self.slice_plane is not None:
+            # ONE slice replica: params/states already placed (sharded)
+            # by apply_serving_slice — device None means "dispatch on
+            # the slice mesh, inputs replicated onto it"
+            self._fn = net.infer_output_fn()
+            self._np_dtype = np.dtype(net._dtype)
+            self._replicas = [(None, net.params, net.states)]
+        elif net is not None:
             self._fn = net.infer_output_fn()
             self._np_dtype = np.dtype(net._dtype)
             with span("stage", path="infer_replicas", replicas=len(devs)):
@@ -438,8 +475,98 @@ class ParallelInference:
                 "prefix_cache=True rides the paged-pool scheduler: "
                 "build the engine with continuous=True")
         self._scheduler = None
+        if self.slice_plane is not None:
+            self._publish_slice_gauges()
         if start:
             self.start()
+
+    # ----------------------------------------------------------- slices
+
+    def _slice_name(self) -> str:
+        return "-".join(str(i) for i in
+                        sorted(d.id for d in self.slice_plane.mesh
+                               .devices.flat))
+
+    def _slice_info(self) -> Dict:
+        """The slice topology heartbeats carry: (width, devices,
+        degraded) — what fleet_snapshot()/healthz show per endpoint
+        instead of a bare healthy bit."""
+        plane = self.slice_plane
+        return {
+            "width": int(plane.axis_size("tp")),
+            "devices": sorted(int(d.id) for d in plane.mesh.devices.flat),
+            "degraded": self._slice_dead is not None,
+        }
+
+    def _publish_slice_gauges(self) -> None:
+        from deeplearning4j_tpu.monitor import (SLICE_DEGRADED_GAUGE,
+                                                SLICE_DEVICES_GAUGE)
+        reg = self._reg()
+        name = self._slice_name()
+        reg.gauge(SLICE_DEVICES_GAUGE,
+                  "Devices in this engine's serving mesh slice",
+                  slice=name).set(self.slice_plane.devices)
+        reg.gauge(SLICE_DEGRADED_GAUGE,
+                  "Serving slice poisoned by a chip failure (1) or "
+                  "healthy (0)", slice=name).set(
+            1.0 if self._slice_dead is not None else 0.0)
+
+    def _slice_put(self, x):
+        """Place one host batch for a dispatch on the slice mesh
+        (replicated — activations stay whole; the PARAMS carry the
+        sharding and GSPMD partitions the program around them)."""
+        return jax.device_put(x, self.slice_plane.replicated())
+
+    def _slice_error(self) -> SliceDegraded:
+        err = SliceDegraded(
+            f"slice {self._slice_name()} degraded: "
+            f"{type(self._slice_dead).__name__}: {self._slice_dead}")
+        err.__cause__ = self._slice_dead
+        return err
+
+    def _slice_fail(self, err: BaseException) -> None:
+        """Poison the whole slice: a chip inside it died, so every chip
+        in it is unusable (params and pools are sharded across all of
+        them). Idempotent; queued work fails typed, the scheduler's
+        sequences fail typed, and submits reject from here on. The
+        engine stays ALIVE — heartbeats keep flowing with
+        ``slice.degraded`` set, which is what lets the router declare
+        the endpoint dead positively instead of waiting out timeouts."""
+        if self.slice_plane is None:
+            return
+        with self._lock:
+            if self._slice_dead is not None:
+                return
+            self._slice_dead = err
+        record_fault("serving")
+        mark("slice_degraded", slice=self._slice_name(),
+             error=type(err).__name__)
+        self._publish_slice_gauges()
+        typed = self._slice_error()
+        if self._scheduler is not None:
+            self._scheduler.poison(typed)
+        self._drain_cancel_with(typed)
+
+    def _drain_cancel_with(self, err: BaseException) -> None:
+        while True:
+            try:
+                item = self._rq.get_nowait()
+            except queue.Empty:
+                return
+            if isinstance(item, _Request):
+                item.future.set_exception(err)
+                self._note_resolved(1)
+
+    @staticmethod
+    def _is_chip_failure(err: BaseException) -> bool:
+        from deeplearning4j_tpu.faultinject import ChipFailure
+        seen = 0
+        while err is not None and seen < 8:
+            if isinstance(err, ChipFailure):
+                return True
+            err = err.__cause__
+            seen += 1
+        return False
 
     # ------------------------------------------------------------ metrics
 
@@ -526,6 +653,8 @@ class ParallelInference:
         respect to deploys."""
         if self._closed:
             raise RuntimeError("ParallelInference is shut down")
+        if self._slice_dead is not None:
+            raise self._slice_error()
         model, v, mv, coalescible = self._resolve_model(model, version, session)
         x = np.asarray(x, dtype=self._np_dtype if mv is None else mv.np_dtype)
         if x.ndim < 2:
@@ -584,6 +713,7 @@ class ParallelInference:
                 on_resolve=self._note_resolved,
                 prefix_cache=self.prefix_cache,
                 prefix_cache_blocks=self.prefix_cache_blocks,
+                on_fatal=self._slice_fail,
                 start=self._started)
         return sched
 
@@ -595,7 +725,8 @@ class ParallelInference:
                         session: Optional[str] = None,
                         priority: int = 0,
                         on_tokens=None,
-                        prefix: Optional[np.ndarray] = None
+                        prefix: Optional[np.ndarray] = None,
+                        kv_state=None
                         ) -> "Future[np.ndarray]":
         """Enqueue one decode request (``prompt_ids``: [n, t0] int
         tokens); the Future resolves to the [n, t0 + max_new_tokens]
@@ -619,6 +750,8 @@ class ParallelInference:
         therefore requires ``continuous=True``."""
         if self._closed:
             raise RuntimeError("ParallelInference is shut down")
+        if self._slice_dead is not None:
+            raise self._slice_error()
         from deeplearning4j_tpu.nn.generate import row_keys, sampler_sig
         model, v, mv, coalescible = self._resolve_model(model, version, session)
         if self.continuous:
@@ -635,11 +768,15 @@ class ParallelInference:
                 prompt_ids, max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_token=eos_token, seed=seed,
                 priority=priority, model=model, version=v, session=session,
-                on_tokens=on_tokens, prefix=prefix)
+                on_tokens=on_tokens, prefix=prefix, kv_state=kv_state)
         if prefix is not None:
             raise ValueError(
                 "prefix resume rides the iteration-level preempt/resume "
                 "machinery: build the engine with continuous=True")
+        if kv_state is not None:
+            raise ValueError(
+                "kv_state handoff rides the paged-pool scheduler: build "
+                "the engine with continuous=True")
         gen = self._generator() if mv is None else mv.generator()
         prompt = np.asarray(prompt_ids)
         if prompt.ndim != 2:
@@ -687,6 +824,70 @@ class ParallelInference:
         """Blocking facade over :meth:`submit_generate`."""
         return self.submit_generate(prompt_ids, max_new_tokens,
                                     **kwargs).result(timeout=timeout)
+
+    # --------------------------------------- disaggregated prefill
+
+    def prefill_export(self, prompt_ids: np.ndarray) -> Dict:
+        """The PREFILL half of disaggregated serving (the DistServe /
+        Splitwise split): run ONLY the prompt forward and export the KV
+        it wrote plus the last-token logits — the state a DECODE
+        endpoint needs to admit the session without recomputing the
+        prompt (``submit_generate(kv_state=...)``). Returns
+        ``{"kv": [L, 2, 1, t_pad, h, hd], "logits": [1, V],
+        "t_in": int}``. The export is exactly what a local prefill of
+        the same tokens computes (same program, same params), so the
+        handed-off stream's tokens equal an undisaggregated run's."""
+        if self._closed:
+            raise RuntimeError("ParallelInference is shut down")
+        if self._slice_dead is not None:
+            raise self._slice_error()
+        if self.net is None:
+            raise ValueError(
+                "prefill_export serves one pinned net: build the "
+                "prefill endpoint's engine with net=")
+        from deeplearning4j_tpu.nn.generate import TransformerGenerator
+        gen = self._generator()
+        if not isinstance(gen, TransformerGenerator):
+            raise ValueError(
+                "disaggregated prefill ships KV caches; "
+                f"{type(gen).__name__} nets have none")
+        prompt = np.asarray(prompt_ids)
+        if prompt.ndim != 2 or prompt.shape[0] != 1:
+            raise ValueError(
+                f"prefill_export is per-session: prompt must be "
+                f"[1, t0], got {prompt.shape}")
+        n, t_in = prompt.shape
+        t_pad = gen.prompt_bucket(t_in, 1)
+        ids = np.zeros((n, t_pad), np.int32)
+        ids[:, :t_in] = prompt
+        lengths = np.full((n,), t_in, np.int32)
+        dev, params, _ = self._replicas[0]
+        kv, logits = gen.export_prefill(params, ids, lengths)
+        with self._lock:
+            self._requests += 1
+            self._resolved += 1
+        return {"kv": kv, "logits": logits, "t_in": int(t_in)}
+
+    def warmup_prefill(self, prompt_lengths: Sequence[int]) -> int:
+        """AOT-compile the prefill-export program ladder (one program
+        per covering prompt bucket) — what a prefill-specialized
+        endpoint warms instead of the decode set."""
+        from deeplearning4j_tpu.monitor import JIT_CACHE_MISS_COUNTER
+        from deeplearning4j_tpu.nn.generate import row_keys  # noqa: F401
+        gen = self._generator()
+        reg = self._reg()
+        before = reg.family_total(JIT_CACHE_MISS_COUNTER)
+        done = set()
+        for t_in in prompt_lengths:
+            t_pad = gen.prompt_bucket(int(t_in), 1)
+            if t_pad in done:
+                continue
+            done.add(t_pad)
+            ids = np.zeros((1, t_pad), np.int32)
+            lens = np.full((1,), min(int(t_in), t_pad), np.int32)
+            gen.export_prefill(self._replicas[0][1], ids, lens)
+        self._warmed = True
+        return int(reg.family_total(JIT_CACHE_MISS_COUNTER) - before)
 
     def warmup_generate(self, prompt_lengths: Sequence[int],
                         max_new_tokens: int, temperature: float = 0.0,
@@ -772,7 +973,9 @@ class ParallelInference:
             for b in sizes:
                 zeros = np.zeros((b,) + tuple(shape), self._np_dtype)
                 for i, (dev, params, states) in enumerate(self._replicas):
-                    x = jax.device_put(zeros, dev)
+                    x = (self._slice_put(zeros)
+                         if self.slice_plane is not None
+                         else jax.device_put(zeros, dev))
                     fresh = note_dispatch(
                         self.net, self._dispatch_sig(i, zeros.shape))
                     with span("compile" if fresh else "inference",
@@ -859,6 +1062,12 @@ class ParallelInference:
                 "warmed": self._warmed,
                 "faults": len(self._fault_log),
             }
+        if self.slice_plane is not None:
+            # heartbeats carry the slice topology: fleet_snapshot() and
+            # /healthz show per-endpoint (width, devices, degraded)
+            # instead of a bare healthy bit
+            out["slice"] = self._slice_info()
+            out["degraded"] = out["degraded"] or out["slice"]["degraded"]
         if self.continuous:
             # decode-scheduler state (active sequences, queued
             # prefills, pool occupancy) — /healthz/ready gates on its
@@ -1155,6 +1364,15 @@ class ParallelInference:
         fault that just rolled the canary back fails the batch without
         touching either; anything else follows the PR-4 replica
         quarantine/redispatch path."""
+        if self.slice_plane is not None and (
+                self._is_chip_failure(err) or self._slice_dead is not None):
+            # a chip died INSIDE the slice: the whole slice is the
+            # failure domain — poison it and fail the batch typed
+            # (replica quarantine makes no sense: there is no sibling
+            # replica holding a whole copy of the params)
+            self._slice_fail(err)
+            self._fail_batch(b, self._slice_error())
+            return
         verdict = "retry"
         if b.model is not None:
             verdict = self._registry.note_error(b.model, b.version)
@@ -1193,6 +1411,11 @@ class ParallelInference:
         batches resolve (fn, params, states) through the registry's
         per-device pins; canary batches additionally pay a host-side
         NaN scan so the canary watch sees poisoned outputs."""
+        if self._slice_dead is not None:
+            # the slice is already poisoned: fail fast and typed — a
+            # dead chip's dispatch outcome is undefined, never retried
+            self._fail_batch(b, self._slice_error())
+            return None
         fn, gen, net, nan_check = self._fn, None, self.net, False
         if b.model is not None:
             try:
@@ -1223,7 +1446,9 @@ class ParallelInference:
                         replica=idx, device=dev)
                 else:
                     with span("stage", path="infer_feed", replica=idx):
-                        x = jax.device_put(b.x, dev)
+                        x = (self._slice_put(b.x)
+                             if self.slice_plane is not None
+                             else jax.device_put(b.x, dev))
                     fresh = note_dispatch(
                         net, self._dispatch_sig(idx, b.x.shape,
                                                 b.model, b.version))
@@ -1337,7 +1562,8 @@ class ParallelInference:
             fn, p, s, shape, dtype, net, m, v = probe
             try:
                 zeros = np.zeros((1,) + tuple(shape), dtype)
-                x = jax.device_put(zeros, dev)
+                x = (self._slice_put(zeros) if self.slice_plane is not None
+                     else jax.device_put(zeros, dev))
                 note_dispatch(net, self._dispatch_sig(idx, zeros.shape, m, v))
                 with span("inference", path="quarantine_probe", replica=idx):
                     np.asarray(self._dispatch(idx, p, s, x, fn=fn, model=m))

@@ -358,6 +358,122 @@ class MeshPlane:
 MeshContext = MeshPlane
 
 
+# ------------------------------------------------------- serving slices
+
+def slice_planes(width: int, devices: Optional[Sequence] = None,
+                 axis: str = "tp") -> list:
+    """Partition ``devices`` (default: all) into serving SLICES of
+    ``width`` chips — one :class:`MeshPlane` with a single ``tp`` axis
+    per slice, in device order. The unit a mesh-sharded serving
+    endpoint runs on: a fleet trades ``len(slices)`` replicas against
+    ``width`` chips per replica out of the same chip budget."""
+    devices = list(devices if devices is not None else jax.devices())
+    width = max(1, int(width))
+    if len(devices) < width:
+        raise ValueError(
+            f"slice width {width} needs {width} devices, have "
+            f"{len(devices)}")
+    return [MeshPlane.build({axis: width}, devices[i:i + width])
+            for i in range(0, len(devices) - width + 1, width)]
+
+
+def serving_slice_layout(net, axis: str = "tp") -> SpecLayout:
+    """The COLUMN-ONLY tensor-parallel SpecLayout for a serving slice.
+
+    Every sharded weight is partitioned on a NON-contracting (output)
+    dim — Megatron's column half without the row half — so no matmul
+    ever reduces across shards: each output element is computed with
+    the full contraction in single-device order, and the activation
+    all-gathers the seam inserts (``LayerImpl._slice_replicate``) are
+    pure data movement. That is what makes sliced serving output
+    BITWISE equal to the single-device engine (the house bar), where
+    training-style row/column pairing is only ever allclose.
+
+    Covered params: SequenceEmbedding ``W`` (d columns), TransformerBlock
+    ``Wqkv``/``Wo``/``W1``/``W2`` (+ paired biases), hidden Dense
+    ``W``/``b``. The output head (``impls[-1]``) and all LayerNorm
+    params stay replicated — logits must be whole on every chip for
+    on-device sampling. MoE blocks are rejected (no serving-slice seam
+    for routed experts yet)."""
+    from deeplearning4j_tpu.nn.layers.feedforward import BaseDenseImpl
+    from deeplearning4j_tpu.nn.layers.transformer import (
+        SequenceEmbeddingImpl, TransformerBlockImpl)
+    impls = net.impls
+    if not isinstance(impls, list):
+        impls = [impls[name] for name in net.order
+                 if net.defs[name].kind == "layer"]
+    layout = SpecLayout()
+    for impl in impls[:-1]:  # the head stays replicated
+        if isinstance(impl, SequenceEmbeddingImpl):
+            layout.set(impl.name, "W", P(None, axis))
+        elif isinstance(impl, TransformerBlockImpl):
+            if impl.conf.num_experts > 0:
+                raise ValueError(
+                    "serving_slice_layout has no seam for MoE blocks; "
+                    "serve routed-expert nets on single-device replicas")
+            layout.set(impl.name, "Wqkv", P(None, axis))
+            layout.set(impl.name, "Wo", P(None, axis))
+            layout.set(impl.name, "W1", P(None, axis))
+            layout.set(impl.name, "b1", P(axis))
+            layout.set(impl.name, "W2", P(None, axis))
+            layout.set(impl.name, "b2", P(axis))
+        elif isinstance(impl, BaseDenseImpl):
+            layout.set(impl.name, "W", P(None, axis))
+            layout.set(impl.name, "b", P(axis))
+    return layout
+
+
+def apply_serving_slice(net, plane: MeshPlane,
+                        layout: Optional[SpecLayout] = None) -> MeshPlane:
+    """Turn ``net`` into a SLICE-served model: place its params per the
+    (column-only) serving layout over ``plane``'s mesh, pin the plane
+    (``net.mesh_plane`` — the PR-9 seam checkpoints read — plus
+    ``net.slice_plane`` for the serving engine), and arm the
+    bitwise-exactness seam on every layer impl (``_slice_mesh``: the
+    impls constrain activations back to replicated before each
+    cross-shard reduction, and attention stays on the XLA formulation —
+    a Pallas kernel cannot see the mesh). Existing jit caches are
+    dropped: programs traced before the placement baked no constraints.
+
+    The net must be dedicated to this slice (restore the mesh-portable
+    checkpoint per slice, or deep-copy): program caches live on the net
+    and a slice trace is wrong for an unsliced dispatch."""
+    axis = "tp"
+    tp = plane.axis_size(axis)
+    if tp < 1:
+        raise ValueError(f"slice plane needs a {axis!r} axis")
+    impls_seq = net.impls
+    if not isinstance(impls_seq, list):
+        impls_seq = list(impls_seq.values())
+    from deeplearning4j_tpu.nn.layers.transformer import \
+        TransformerBlockImpl
+    for impl in impls_seq:
+        if isinstance(impl, TransformerBlockImpl) \
+                and impl.conf.num_heads % max(1, tp) != 0:
+            # the bitwise seam keeps attention sharded on the HEADS
+            # axis; a width that does not divide the heads would make
+            # GSPMD re-shard head_dim — whose contraction then reduces
+            # across shards. Refuse loudly instead of serving un-exact.
+            raise ValueError(
+                f"slice width {tp} does not divide num_heads "
+                f"{impl.conf.num_heads} ({impl.name}): per-head "
+                f"attention must shard whole heads")
+    if layout is None:
+        layout = serving_slice_layout(net, axis=axis)
+    from deeplearning4j_tpu.parallel.tensor_parallel import apply_shardings
+    apply_shardings(net, plane.mesh, layout.specs,
+                    plane=MeshPlane(plane.mesh, layout))
+    impls = net.impls
+    if not isinstance(impls, list):
+        impls = list(impls.values())
+    for impl in impls:
+        impl._slice_mesh = net.mesh_plane.mesh
+    net.slice_plane = net.mesh_plane
+    net._jits.clear()
+    net.__dict__.pop("_generator", None)
+    return net.mesh_plane
+
+
 # ---------------------------------------------------------- seq-parallel ctx
 
 _SEQ_MESH: list = []  # stack of (mesh, axis)

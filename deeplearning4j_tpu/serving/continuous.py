@@ -121,12 +121,13 @@ class _DecodeRequest:
     __slots__ = ("prompt", "n", "t_in", "max_new", "temperature", "top_k",
                  "top_p", "eos", "seed", "priority", "model", "version",
                  "session", "future", "rows_done", "t_submit", "t_first",
-                 "rows", "on_tokens", "prefix")
+                 "rows", "on_tokens", "prefix", "kv_state")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float,
                  top_k: int, top_p: float, eos: Optional[int], seed: int,
                  priority: int, model, version, session,
-                 on_tokens=None, prefix: Optional[np.ndarray] = None):
+                 on_tokens=None, prefix: Optional[np.ndarray] = None,
+                 kv_state=None):
         self.prompt = np.asarray(prompt, np.int64)
         self.n, self.t_in = self.prompt.shape
         self.max_new = int(max_new)
@@ -141,6 +142,11 @@ class _DecodeRequest:
         self.session = session
         self.on_tokens = on_tokens
         self.prefix = prefix  # [p] int64 generated-so-far (row 0)
+        # disaggregated-prefill handoff: {"kv", "logits", "t_in"} from a
+        # prefill endpoint's export — admission scatters the shipped KV
+        # into pool blocks and samples tok0 off the shipped logits
+        # instead of running the prompt forward here
+        self.kv_state = kv_state
         self.future: "Future[np.ndarray]" = Future()
         self.rows_done = 0
         self.t_submit = time.perf_counter()
@@ -297,7 +303,8 @@ class ContinuousDecodeScheduler:
                  queue_capacity: int = 256, admit_rows: int = 4,
                  start: bool = True, burst_hook=None, on_resolve=None,
                  prefix_cache: bool = False,
-                 prefix_cache_blocks: Optional[int] = None):
+                 prefix_cache_blocks: Optional[int] = None,
+                 on_fatal=None):
         if net is None and registry is None:
             raise ValueError(
                 "ContinuousDecodeScheduler needs a net or a registry")
@@ -329,6 +336,12 @@ class ContinuousDecodeScheduler:
             return tuple(out)
 
         self._admit_ladder = pow2_ladder(self.admit_rows)
+        # slice fault domain: a ChipFailure surfacing under any dispatch
+        # is reported here (the engine poisons the whole slice); the
+        # scheduler itself is then poisoned via :meth:`poison`
+        self._on_fatal = on_fatal
+        self._fatal: Optional[BaseException] = None
+        self._kv_handoffs = 0
         # burst row-bucket ladder: a burst dispatches the smallest slot
         # bucket covering the ACTIVE rows (compacted), so a half-empty
         # batch never pays full-slot compute — same doctrine as the
@@ -391,7 +404,8 @@ class ContinuousDecodeScheduler:
                version: Optional[int] = None,
                session: Optional[str] = None,
                on_tokens=None,
-               prefix: Optional[np.ndarray] = None) -> "Future[np.ndarray]":
+               prefix: Optional[np.ndarray] = None,
+               kv_state=None) -> "Future[np.ndarray]":
         """Enqueue one decode request; the Future resolves to the
         [n, t0 + max_new_tokens] ids a solo ``net.generate`` of the
         same rows would return (greedy: token-for-token; sampled: the
@@ -411,6 +425,8 @@ class ContinuousDecodeScheduler:
         run's, with the delivered prefix never re-emitted."""
         if self._closed:
             raise RuntimeError("ContinuousDecodeScheduler is shut down")
+        if self._fatal is not None:
+            raise self._fatal
         prompt = np.asarray(prompt_ids)
         if prompt.ndim != 2:
             raise ValueError(
@@ -421,10 +437,15 @@ class ContinuousDecodeScheduler:
         pre = None
         if prefix is not None:
             pre = np.asarray(prefix, np.int64).reshape(-1)
-        if (on_tokens is not None or pre is not None) and prompt.shape[0] != 1:
+        if (on_tokens is not None or pre is not None
+                or kv_state is not None) and prompt.shape[0] != 1:
             raise ValueError(
-                "token streaming / prefix resume are per-stream: "
-                f"prompt must be [1, t0], got {prompt.shape}")
+                "token streaming / prefix resume / kv handoff are "
+                f"per-stream: prompt must be [1, t0], got {prompt.shape}")
+        if kv_state is not None and pre is not None:
+            raise ValueError(
+                "kv_state ships the PROMPT's cache; a resume prefix "
+                "re-prefills — the two paths are exclusive")
         if pre is not None and len(pre) >= max_new:
             # every token was already generated before the migration —
             # only the terminal frame was lost; synthesize it
@@ -445,7 +466,7 @@ class ContinuousDecodeScheduler:
             max(1, max_new - (0 if pre is None else len(pre))))
         req = _DecodeRequest(prompt, max_new, temperature, top_k, top_p,
                              eos_token, seed, priority, model, version,
-                             session, on_tokens, pre)
+                             session, on_tokens, pre, kv_state)
         keys = np.asarray(row_keys(req.seed, req.n))
         with self._cv:
             if len(self._queue) + req.n > self.queue_capacity:
@@ -483,6 +504,7 @@ class ContinuousDecodeScheduler:
                 "warmed": self._warmed,
                 "prefill_tokens_computed": self._prefill_computed_tokens,
                 "resume_reprefill_tokens": self._resume_reprefill_tokens,
+                "kv_handoffs": self._kv_handoffs,
             }
             caches = [c for _, c in sorted(self._caches.items(),
                                            key=lambda kv: repr(kv[0]))]
@@ -722,6 +744,10 @@ class ContinuousDecodeScheduler:
                 "the whole-burst submit_generate path")
         n_layers, heads, hd, dtype = gen.kv_layout()
         spec = pool_spec(n_layers, heads, hd, self.block_size, dtype)
+        # sliced net: the pool's block arrays shard their HEADS axis
+        # over the slice's tp axis (per-head attention is
+        # shard-independent — accounting and arithmetic unchanged)
+        kv_sharding = gen.kv_sharding()
         with self._lock:
             pool = self._pools.get(spec)
             if pool is None:
@@ -734,7 +760,9 @@ class ContinuousDecodeScheduler:
                     blocks = self.slots * mb + 1
                 pool = PagedKVCachePool(
                     int(blocks), self.block_size, n_layers, heads, hd,
-                    dtype, device=self.device,
+                    dtype, device=None if kv_sharding is not None
+                    else self.device,
+                    sharding=kv_sharding,
                     name=model if model is not None else "decode")
                 self._pools[spec] = pool
                 if self.prefix_cache:
@@ -801,6 +829,8 @@ class ContinuousDecodeScheduler:
                 if kind[0] == "tail":
                     self._prefill_tail_batch(lane, kind[1], kind[2],
                                              entries)
+                elif kind[0] == "ship":
+                    self._prefill_shipped_batch(lane, kind[1], entries)
                 else:
                     self._prefill_batch(lane, kind[1],
                                         [(p.seq, p.blocks)
@@ -810,6 +840,7 @@ class ContinuousDecodeScheduler:
                 for p in entries:
                     self._rollback_plan(lane, p)
                     self._fail_seq(p.seq, self._typed(e, p.seq))
+                self._note_fatal(e)
                 continue
             admitted = True
 
@@ -832,6 +863,16 @@ class ContinuousDecodeScheduler:
         pool = lane.pool
         t_full = len(seq.fed)
         need_total = pool.blocks_for(t_full)
+        if seq.req.kv_state is not None and seq.n_gen == 0:
+            # disaggregated handoff: the prompt's KV arrives shipped —
+            # claim the blocks, no prefill forward, no cache probe (a
+            # preempted handoff row falls back to a plain re-prefill)
+            got = pool.alloc(need_total)
+            if got is None:
+                return None
+            t_pad = lane.gen.prompt_bucket(t_full, max(1, seq.remaining))
+            return _AdmitPlan(seq, got, 0, None,
+                              ("ship", self._round_blocks(t_pad)))
         cache = self._cache_of(lane)
         m, shared, partial = (0, [], None)
         if cache is not None:
@@ -1059,6 +1100,98 @@ class ContinuousDecodeScheduler:
             if cache is not None:
                 cache.note_admitted(p.start)
             self._install(lane, p.seq, p.blocks, int(toks[i]))
+
+    def _prefill_shipped_batch(self, lane: _Lane, t_blk: int,
+                               entries: List["_AdmitPlan"]) -> None:
+        """Admit a disaggregated-handoff group WITHOUT a prefill
+        forward: rebuild each row's dense caches from the shipped KV
+        (padded/cut to this scheduler's block-rounded length — shipped
+        positions past the true prompt are garbage-inert exactly like a
+        local prefill's bucket padding), page them into the claimed
+        blocks through the SAME scatter program a local admission uses,
+        and sample tok0 off the SHIPPED last-token logits on the row's
+        own PRNG clock. Zero prompt tokens are computed here — that is
+        the disaggregation win the ``dl4j_disagg_kv_handoffs_total``
+        counter and the decode-p99 bench measure."""
+        gen, pool = lane.gen, lane.pool
+        n = len(entries)
+        rows = bucket_for(n, self._admit_ladder)
+        nb = t_blk // self.block_size
+        n_layers, heads, hd, dtype = gen.kv_layout()
+        vocab = int(gen.emb.conf.n_in)
+        caches = [{"k": np.zeros((rows, t_blk, heads, hd),
+                                 np.dtype(dtype)),
+                   "v": np.zeros((rows, t_blk, heads, hd),
+                                 np.dtype(dtype))}
+                  for _ in range(n_layers)]
+        tnb = np.zeros((rows, nb), np.int32)
+        logits = np.zeros((rows, vocab), np.float32)
+        keys = np.zeros((rows, 2), lane.keys.dtype)
+        folds = np.zeros(rows, np.int32)
+        temp = np.zeros(rows, np.float32)
+        top_k = np.zeros(rows, np.int32)
+        top_p = np.zeros(rows, np.float32)
+        for i, p in enumerate(entries):
+            seq = p.seq
+            ship = seq.req.kv_state
+            kv = np.asarray(ship["kv"])  # [L, 2, 1, t_ship, h, hd]
+            t_cp = min(int(kv.shape[3]), t_blk)
+            for layer in range(n_layers):
+                caches[layer]["k"][i, :t_cp] = kv[layer, 0, 0, :t_cp]
+                caches[layer]["v"][i, :t_cp] = kv[layer, 1, 0, :t_cp]
+            tnb[i, :len(p.blocks)] = p.blocks
+            logits[i] = np.asarray(ship["logits"])[0]
+            keys[i] = seq.key
+            folds[i] = seq.n_gen
+            temp[i] = seq.req.temperature
+            top_k[i] = seq.req.top_k
+            top_p[i] = seq.req.top_p
+        scat = gen.scatter_program(rows, t_blk, self.block_size)
+        note_dispatch(lane.net, ("gen_pool_scatter", "sched", rows, t_blk))
+        with span("inference", path="continuous_kv_handoff", rows=n,
+                  bucket=t_blk):
+            pool.set_layers(scat(pool.layers, caches, tnb))
+        rs = gen.row_sample_program()
+        note_dispatch(lane.net, ("gen_row_sample", "sched", rows))
+        toks = np.asarray(rs(logits, keys, folds, temp, top_k, top_p))
+        from deeplearning4j_tpu.monitor import DISAGG_KV_HANDOFFS_COUNTER
+        get_registry().counter(
+            DISAGG_KV_HANDOFFS_COUNTER,
+            "Disaggregated prefill→decode sessions admitted from "
+            "shipped KV (zero prompt tokens recomputed)").inc(n)
+        with self._lock:
+            self._kv_handoffs += n
+        for i, p in enumerate(entries):
+            self._note_prefilled(p.seq, 0)
+            p.seq.req.kv_state = None  # one-shot: a preempt re-prefills
+            self.events.append(
+                f"kv_handoff seq={p.seq.seq_id} t={len(p.seq.fed)} "
+                f"blocks={len(p.blocks)}")
+            self._install(lane, p.seq, p.blocks, int(toks[i]))
+
+    def poison(self, err: BaseException) -> None:
+        """Slice death: fail everything queued and in flight with the
+        typed error and reject new submits — the engine calls this when
+        a ChipFailure poisons its slice. The scheduler object stays
+        constructed (stats/pools readable) but never serves again."""
+        with self._lock:
+            if self._fatal is not None:
+                return
+            self._fatal = err
+        self._fail_everything(err)
+
+    def _note_fatal(self, err: BaseException) -> None:
+        """Route a ChipFailure seen under any dispatch to the engine's
+        slice-poison seam (no-op for every other error class)."""
+        if self._on_fatal is None:
+            return
+        seen, e = 0, err
+        while e is not None and seen < 8:
+            if type(e).__name__ == "ChipFailure":
+                self._on_fatal(err)
+                return
+            e = e.__cause__
+            seen += 1
 
     def _note_prefilled(self, seq: _Seq, computed: int) -> None:
         """Account the prompt tokens this admission actually COMPUTED
@@ -1394,6 +1527,7 @@ class ContinuousDecodeScheduler:
             lane.clear_slot(slot)
             seq.slot = None
             self._fail_seq(seq, self._typed(err, seq))
+        self._note_fatal(err)
 
     def _typed(self, err: BaseException, seq: _Seq) -> DecodeBurstError:
         e = DecodeBurstError(

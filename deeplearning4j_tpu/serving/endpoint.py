@@ -77,6 +77,15 @@ class EngineEndpoint:
         endpoint serves whole replies."""
         raise NotImplementedError
 
+    def submit_prefill(self, prompt_ids: np.ndarray,
+                       timeout_s: Optional[float] = None
+                       ) -> "Future[Dict[str, Any]]":
+        """Disaggregated prefill (wire v3): compute the prompt's KV and
+        last-token logits on THIS endpoint and resolve to the
+        ``{"kv", "logits", "t_in"}`` state a decode endpoint admits the
+        session from (``submit_generate(kv_state=...)``)."""
+        raise NotImplementedError
+
     def stats(self) -> Dict[str, Any]:
         """Latest known engine ``stats()`` snapshot (may be stale for a
         remote endpoint — ``last_seen`` dates it)."""
@@ -110,13 +119,22 @@ class LocalEndpoint(EngineEndpoint):
     def submit_generate(self, prompt_ids, max_new_tokens,
                         timeout_s=None, model=None, version=None,
                         session=None, on_tokens=None, prefix=None,
-                        **kwargs):
+                        kv_state=None, **kwargs):
         kw = {k: v for k, v in (("model", model), ("version", version),
                                 ("session", session),
                                 ("on_tokens", on_tokens),
-                                ("prefix", prefix)) if v is not None}
+                                ("prefix", prefix),
+                                ("kv_state", kv_state)) if v is not None}
         return self.engine.submit_generate(prompt_ids, max_new_tokens,
                                            **kw, **kwargs)
+
+    def submit_prefill(self, prompt_ids, timeout_s=None):
+        fut: "Future[Dict[str, Any]]" = Future()
+        try:
+            fut.set_result(self.engine.prefill_export(prompt_ids))
+        except BaseException as e:
+            fut.set_exception(e)
+        return fut
 
     def stats(self):
         return self.engine.stats()
@@ -133,14 +151,17 @@ class LocalEndpoint(EngineEndpoint):
 
 
 class _Pending:
-    __slots__ = ("future", "deadline", "timeout", "on_tokens")
+    __slots__ = ("future", "deadline", "timeout", "on_tokens", "tensors")
 
     def __init__(self, future: Future, deadline: float, timeout: float,
-                 on_tokens=None):
+                 on_tokens=None, tensors=None):
         self.future = future
         self.deadline = deadline
         self.timeout = timeout   # per-chunk silence budget (streams)
         self.on_tokens = on_tokens
+        # tagged tensor chunks assembled so far (wire v3 prefill: the
+        # "kv" chunk lands here, the terminal reply completes the dict)
+        self.tensors = tensors
 
 
 class RemoteEndpoint(EngineEndpoint):
@@ -196,7 +217,8 @@ class RemoteEndpoint(EngineEndpoint):
                       model: Optional[str] = None,
                       version: Optional[int] = None,
                       session: Optional[str] = None,
-                      on_tokens=None) -> "Future[np.ndarray]":
+                      on_tokens=None,
+                      tensors=None) -> "Future[np.ndarray]":
         if self._closed:
             raise EndpointError(f"endpoint {self.name} is closed")
         corr = f"{self.name}-{next(self._ids)}"
@@ -205,7 +227,8 @@ class RemoteEndpoint(EngineEndpoint):
                    else self.request_timeout)
         deadline = time.monotonic() + timeout
         with self._lock:
-            self._pending[corr] = _Pending(fut, deadline, timeout, on_tokens)
+            self._pending[corr] = _Pending(fut, deadline, timeout, on_tokens,
+                                           tensors)
         try:
             self._broker.publish(
                 self.service + wire.REQ_SUFFIX,
@@ -228,7 +251,8 @@ class RemoteEndpoint(EngineEndpoint):
                         temperature: float = 0.0, top_k: int = 0,
                         top_p: float = 0.0, eos_token: Optional[int] = None,
                         seed: int = 0, model=None, version=None,
-                        session=None, on_tokens=None, prefix=None):
+                        session=None, on_tokens=None, prefix=None,
+                        kv_state=None):
         gen = {"max_new": int(max_new_tokens), "temperature": temperature,
                "top_k": top_k, "top_p": top_p, "eos_token": eos_token,
                "seed": seed}
@@ -242,9 +266,31 @@ class RemoteEndpoint(EngineEndpoint):
             # and continues the stream's PRNG clock (no re-generation
             # of delivered tokens, no re-emission of their offsets)
             gen["prefix"] = [int(t) for t in np.asarray(prefix).reshape(-1)]
+        body = np.asarray(prompt_ids)
+        if kv_state is not None:
+            # v3 handoff: the shipped KV tensor IS the frame body; the
+            # (small) prompt ids and last-token logits ride the header
+            # (json floats round-trip f32 exactly — the handoff stays
+            # bit-exact across the wire)
+            gen["kv"] = True
+            gen["prompt"] = [int(t) for t in
+                             np.asarray(prompt_ids).reshape(-1)]
+            gen["logits"] = [float(v) for v in
+                             np.asarray(kv_state["logits"]).reshape(-1)]
+            body = np.asarray(kv_state["kv"])
         return self._submit_frame(wire.KIND_GENERATE,
-                                  np.asarray(prompt_ids), gen, timeout_s,
+                                  body, gen, timeout_s,
                                   model, version, session, on_tokens)
+
+    def submit_prefill(self, prompt_ids, timeout_s=None):
+        """Wire-v3 disaggregated prefill: the worker replies with one
+        tagged ``kv`` tensor chunk then the terminal logits frame; the
+        future resolves to the assembled ``{"kv", "logits", "t_in"}``
+        handoff state."""
+        prompt = np.asarray(prompt_ids)
+        return self._submit_frame(
+            wire.KIND_PREFILL, prompt, None, timeout_s,
+            tensors={"t_in": int(prompt.shape[-1])})
 
     # ----------------------------------------------------------- health
 
@@ -297,11 +343,19 @@ class RemoteEndpoint(EngineEndpoint):
                     # stream is alive, so only a stalled stream can
                     # time out. A chunk for an already-swept request is
                     # dropped here (the caller migrated past it).
+                    tag = wire.chunk_tag(header)
                     with self._lock:
                         p = self._pending.get(header.get("id"))
                         if p is not None:
                             self._hb_at = time.monotonic()
                             p.deadline = time.monotonic() + p.timeout
+                            if tag is not None and p.tensors is not None \
+                                    and result is not None:
+                                # tagged tensor chunk (v3 prefill kv)
+                                p.tensors[tag] = result
+                    if tag is not None:
+                        self._sweep_expired()
+                        continue
                     if p is not None and p.on_tokens is not None \
                             and result is not None:
                         try:
@@ -318,7 +372,13 @@ class RemoteEndpoint(EngineEndpoint):
                         self._hb_at = time.monotonic()  # proof of life
                 if p is not None and not p.future.done():
                     if header.get("ok"):
-                        p.future.set_result(result)
+                        if p.tensors is not None:
+                            # v3 prefill reply: terminal logits complete
+                            # the assembled handoff state
+                            p.future.set_result(
+                                dict(p.tensors, logits=result))
+                        else:
+                            p.future.set_result(result)
                     elif header.get("etype"):
                         # typed engine error: reconstruct the SAME
                         # exception class a LocalEndpoint would raise

@@ -51,11 +51,15 @@ class _Member:
 
     def __init__(self, name: str, endpoint: RemoteEndpoint,
                  worker: Optional[EngineWorker] = None,
-                 proc: Optional[subprocess.Popen] = None):
+                 proc: Optional[subprocess.Popen] = None,
+                 plane=None):
         self.name = name
         self.endpoint = endpoint
         self.worker = worker
         self.proc = proc
+        # mesh-slice backing (slice_width mode): the MeshPlane this
+        # member's engine is sharded over — rebuild_slice narrows it
+        self.plane = plane
 
 
 class LocalFleet:
@@ -74,15 +78,32 @@ class LocalFleet:
                  request_timeout_s: float = 5.0,
                  heartbeat_timeout_s: float = 1.0,
                  model_path: Optional[str] = None,
-                 procworker_args: Optional[List[str]] = None):
+                 procworker_args: Optional[List[str]] = None,
+                 slice_width: Optional[int] = None,
+                 slice_devices: Optional[List] = None):
         if mode not in ("thread", "process"):
             raise ValueError(f"mode must be thread|process, got {mode!r}")
         if mode == "thread" and engine_factory is None:
             raise ValueError("thread mode needs engine_factory")
         if mode == "process" and model_path is None:
             raise ValueError("process mode needs model_path")
+        if slice_width is not None and mode != "thread":
+            raise ValueError("slice_width is a thread-mode feature")
         self.mode = mode
         self.engine_factory = engine_factory
+        # mesh-sharded slices: each endpoint's engine runs on a
+        # slice_width-chip MeshPlane carved from slice_devices (default:
+        # every local device); engine_factory is then called WITH the
+        # plane — restore the mesh-portable checkpoint onto it. The
+        # device budget is explicit: killing a chip shrinks a member's
+        # slice (rebuild_slice), trading width for replica count.
+        self.slice_width = None if slice_width is None else int(slice_width)
+        self._slice_free: List = []
+        if self.slice_width is not None:
+            import jax
+            self._slice_free = list(
+                slice_devices if slice_devices is not None
+                else jax.devices())
         self.service_prefix = service_prefix
         self.router = router
         self.heartbeat_s = float(heartbeat_s)
@@ -108,11 +129,29 @@ class LocalFleet:
 
     # --------------------------------------------------------- members
 
+    def _carve_slice(self, width: int):
+        """Claim ``width`` devices from the free budget and build the
+        slice's MeshPlane (via the sanctioned parallel.mesh factory —
+        serving code never constructs a raw Mesh)."""
+        from deeplearning4j_tpu.parallel.mesh import MeshPlane
+        if len(self._slice_free) < width:
+            raise RuntimeError(
+                f"no device budget for a {width}-chip slice "
+                f"({len(self._slice_free)} free)")
+        devs, self._slice_free = (self._slice_free[:width],
+                                  self._slice_free[width:])
+        return MeshPlane.build({"tp": width}, devices=devs)
+
     def add_endpoint(self, name: Optional[str] = None) -> RemoteEndpoint:
         name = name or f"{self.service_prefix}-{next(self._ids)}"
         service = name
+        plane = None
         if self.mode == "thread":
-            engine = self.engine_factory()
+            if self.slice_width is not None:
+                plane = self._carve_slice(self.slice_width)
+                engine = self.engine_factory(plane)
+            else:
+                engine = self.engine_factory()
             worker = EngineWorker(engine, self._broker, service, name=name,
                                   heartbeat_s=self.heartbeat_s)
             proc = None
@@ -132,7 +171,8 @@ class LocalFleet:
             request_timeout_s=self.request_timeout_s,
             heartbeat_timeout_s=self.heartbeat_timeout_s)
         with self._lock:
-            self._members[name] = _Member(name, endpoint, worker, proc)
+            self._members[name] = _Member(name, endpoint, worker, proc,
+                                          plane)
         if self.router is not None:
             self.router.add_endpoint(endpoint)
         return endpoint
@@ -196,6 +236,95 @@ class LocalFleet:
             m.worker.unwedge()
         logger.info("fleet: unwedged %s", name)
 
+    def kill_chip(self, name: str, victim: Optional[int] = None,
+                  seed: int = 0):
+        """Faultinject seam (thread + slice mode): arm a seeded
+        :class:`~deeplearning4j_tpu.faultinject.SliceKill` on the
+        member's engine — its next dispatch (classify batch or decode
+        burst) raises a ``ChipFailure`` naming the slice's survivors,
+        the engine poisons the whole slice (typed ``SliceDegraded`` in
+        heartbeats, never silence), and the router migrates its
+        streams. Returns the injector so the drill can read the victim
+        chip it chose."""
+        from deeplearning4j_tpu.faultinject import SliceKill
+        with self._lock:
+            m = self._members[name]
+        if m.worker is None or m.plane is None:
+            raise RuntimeError("kill_chip() is a thread+slice-mode seam")
+        eng = m.worker.engine
+        inj = SliceKill(m.plane, victim=victim, seed=seed, fail_at=0)
+        eng._poison_hook = inj
+        if eng._scheduler is not None:
+            eng._scheduler._burst_hook = inj
+        else:
+            eng._decode_burst_hook = inj
+        logger.info("fleet: armed chip kill on %s (victim chip %d)",
+                    name, inj.victim)
+        return inj
+
+    def rebuild_slice(self, name: str, width: Optional[int] = None) -> int:
+        """Elastic recovery: the member's slice died (a chip inside it
+        failed) — stop the poisoned worker, rebuild a NARROWER slice
+        from the survivors (default: half the old width, the 8→4→1
+        mesh-portable-checkpoint ladder), hand the new plane to
+        ``engine_factory`` (which restores the checkpoint onto it), and
+        bring the worker back on the SAME service topics. Unused
+        survivor devices return to the free budget — capacity lost as
+        width comes back as replica count through the normal ``add``
+        path. Returns the new width."""
+        from deeplearning4j_tpu.faultinject import ChipFailure
+        from deeplearning4j_tpu.monitor import (SLICE_REBUILDS_COUNTER,
+                                                get_registry)
+        with self._lock:
+            m = self._members[name]
+        if m.worker is None or m.plane is None:
+            raise RuntimeError("rebuild_slice() is a thread+slice-mode "
+                               "seam")
+        old_devs = list(m.plane.mesh.devices.flat)
+        # the dead chip: named by the engine's ChipFailure when it
+        # carries survivor ids, else assume the first chip died
+        dead_ids = None
+        err = getattr(m.worker.engine, "_slice_dead", None)
+        seen = 0
+        while err is not None and seen < 8:
+            if isinstance(err, ChipFailure):
+                dead_ids = {d.id for d in old_devs} \
+                    - set(err.survivor_ids)
+                break
+            err = err.__cause__
+            seen += 1
+        if dead_ids is None:
+            dead_ids = {old_devs[0].id}
+        survivors = [d for d in old_devs if d.id not in dead_ids]
+        new_width = int(width) if width is not None \
+            else max(1, len(old_devs) // 2)
+        new_width = min(new_width, max(1, len(survivors)))
+        if m.worker is not None and not m.worker._killed.is_set():
+            m.worker.kill()
+        try:
+            m.worker.engine.shutdown(drain=False)
+        except BaseException:
+            pass
+        from deeplearning4j_tpu.parallel.mesh import MeshPlane
+        plane = MeshPlane.build({"tp": new_width},
+                                devices=survivors[:new_width])
+        engine = self.engine_factory(plane)
+        with self._lock:
+            m.plane = plane
+            m.worker = EngineWorker(engine, self._broker, name, name=name,
+                                    heartbeat_s=self.heartbeat_s)
+            # leftover survivors go back to the budget: width traded
+            # for replica count under the ScalePolicy's add path
+            self._slice_free.extend(survivors[new_width:])
+        get_registry().counter(
+            SLICE_REBUILDS_COUNTER,
+            "Serving slices rebuilt at a narrower width after a chip "
+            "death (mesh-portable checkpoint restored onto survivors)",
+            width=str(new_width)).inc()
+        logger.info("fleet: rebuilt %s as a %d-chip slice (%d survivors)",
+                    name, new_width, len(survivors))
+        return new_width
+
     def restart(self, name: str) -> None:
         """Bring a killed member back on the SAME service topics (the
         endpoint reconnects through its existing consumer threads)."""
@@ -204,7 +333,8 @@ class LocalFleet:
         if self.mode == "thread":
             if m.worker is not None and not m.worker._killed.is_set():
                 m.worker.kill()
-            engine = self.engine_factory()
+            engine = (self.engine_factory(m.plane) if m.plane is not None
+                      else self.engine_factory())
             m.worker = EngineWorker(engine, self._broker, name, name=name,
                                     heartbeat_s=self.heartbeat_s)
         else:
@@ -249,6 +379,9 @@ class LocalFleet:
             elif d.action == "remove" and d.endpoint in self._members:
                 self.remove_endpoint(d.endpoint)
                 log.append(f"remove {d.endpoint}: {d.reason}")
+            elif d.action == "rebuild" and d.endpoint in self._members:
+                w = self.rebuild_slice(d.endpoint)
+                log.append(f"rebuild {d.endpoint} width={w}: {d.reason}")
         return log
 
     def autoscale(self, policy: ScalePolicy,

@@ -50,6 +50,20 @@ class ScalePolicy:
         if self._last_decision_at is not None and \
                 now - self._last_decision_at < self.cooldown_s:
             return []
+        # slice fault domain first: an endpoint whose heartbeats carry
+        # a DEGRADED slice (a chip inside it died) is rebuilt at a
+        # narrower width from the survivors — the mesh-portable-
+        # checkpoint 8→4→1 ladder — before any add/remove sizing. Same
+        # cooldown discipline: one rebuild decision per window.
+        for name in sorted(snapshot.get("endpoints") or {}):
+            info = (snapshot.get("endpoints") or {})[name]
+            sl = info.get("slice") or (info.get("stats") or {}).get("slice")
+            if isinstance(sl, dict) and sl.get("degraded"):
+                self._last_decision_at = now
+                return [ScaleDecision(
+                    "rebuild", name,
+                    f"slice degraded (width {sl.get('width')}, devices "
+                    f"{sl.get('devices')}) — rebuild from survivors")]
         healthy = max(0, int(snapshot.get("healthy_endpoints", 0)))
         total = int(snapshot.get("total_endpoints", 0))
         backlog = float(snapshot.get("queue_depth", 0.0))

@@ -122,10 +122,14 @@ class _EndpointState:
     __slots__ = ("endpoint", "consecutive_failures", "ejections",
                  "ejected_until", "in_trial", "ewma_ms", "inflight",
                  "requests", "failures", "model_ewma_ms",
-                 "progress_sig", "progress_at", "wedged")
+                 "progress_sig", "progress_at", "wedged", "role")
 
-    def __init__(self, endpoint: EngineEndpoint):
+    def __init__(self, endpoint: EngineEndpoint, role: str = "mixed"):
         self.endpoint = endpoint
+        # disaggregated serving role: "prefill" endpoints serve ONLY
+        # prefill handoff hops (they never enter the classify/decode
+        # pool); "decode"/"mixed" serve everything else
+        self.role = role
         self.consecutive_failures = 0
         self.ejections = 0
         self.ejected_until = 0.0  # monotonic; 0 = not ejected
@@ -162,7 +166,7 @@ class _Routed:
                  "attempts", "outstanding", "lock", "hedged", "session",
                  "priority", "timer", "per_try_timeout", "model", "version",
                  "on_tokens", "received", "epoch", "dups", "gaps", "late",
-                 "journal_dropped", "migrations", "prefix_key")
+                 "journal_dropped", "migrations", "prefix_key", "kv_state")
 
     def __init__(self, kind: str, x, gen, deadline: Optional[float],
                  priority: str, session: Optional[str],
@@ -196,6 +200,10 @@ class _Routed:
         self.journal_dropped = False    # over budget: restart, not resume
         self.migrations = 0
         self.prefix_key: Optional[Tuple] = None
+        # disaggregated prefill: the shipped {"kv","logits","t_in"}
+        # handoff state (rides every dispatch until a journaled prefix
+        # supersedes it — both paths yield exact tokens)
+        self.kv_state = None
 
 
 class InferenceRouter:
@@ -257,13 +265,23 @@ class InferenceRouter:
 
     # -------------------------------------------------------- membership
 
-    def add_endpoint(self, endpoint: EngineEndpoint) -> None:
+    def add_endpoint(self, endpoint: EngineEndpoint,
+                     role: str = "mixed") -> None:
+        """``role="prefill"`` registers a PREFILL-specialized endpoint
+        (the DistServe/Splitwise split): it never serves classify or
+        decode traffic — the router routes generate admissions' prompt
+        prefill to it and hands the session to a decode endpoint with
+        the shipped KV (``dl4j_disagg_kv_handoffs_total``), which
+        removes prefill head-of-line blocking from decode bursts."""
+        if role not in ("mixed", "decode", "prefill"):
+            raise ValueError(f"role must be mixed|decode|prefill, "
+                             f"got {role!r}")
         with self._lock:
             if endpoint.name in self._eps:
                 raise ValueError(f"duplicate endpoint {endpoint.name!r}")
-            self._eps[endpoint.name] = _EndpointState(endpoint)
+            self._eps[endpoint.name] = _EndpointState(endpoint, role)
         self._health_gauge(endpoint.name).set(1.0)
-        mark("router_endpoint_added", endpoint=endpoint.name)
+        mark("router_endpoint_added", endpoint=endpoint.name, role=role)
 
     def remove_endpoint(self, name: str) -> Optional[EngineEndpoint]:
         with self._lock:
@@ -293,24 +311,47 @@ class InferenceRouter:
 
     # ------------------------------------------------------------ health
 
-    def _pool(self, now: float) -> List[_EndpointState]:
-        """Dispatchable endpoints: alive, not draining/stopped, and
-        either not ejected or half-open (backoff elapsed, no trial
-        outstanding yet). The wedge watchdog runs here — liveness
-        alone does not keep a non-progressing endpoint in the pool."""
+    def _pool(self, now: float, role: str = "serve"
+              ) -> List[_EndpointState]:
+        """Dispatchable endpoints: alive, not draining/stopped, slice
+        not degraded, and either not ejected or half-open (backoff
+        elapsed, no trial outstanding yet). The wedge watchdog runs
+        here — liveness alone does not keep a non-progressing endpoint
+        in the pool. ``role="serve"`` (classify/decode traffic)
+        excludes prefill-specialized endpoints; ``role="prefill"``
+        selects ONLY them (the disaggregation hop)."""
         out = []
         for st in self._eps.values():
+            if role == "prefill":
+                if st.role != "prefill":
+                    continue
+            elif st.role == "prefill":
+                continue
             if not st.endpoint.alive():
                 continue
             if self._endpoint_state(st) in (wire.STATE_DRAINING,
                                             wire.STATE_STOPPED):
                 continue  # scale-down hand-off: finish there, pin here
+            if self._slice_degraded(st):
+                continue  # the slice positively declared itself dead
             if self.wedge_timeout is not None:
                 self._check_wedge(st, now)
             if st.ejected_until > now and st.consecutive_failures:
                 continue  # still serving out its ejection backoff
             out.append(st)
         return out
+
+    @staticmethod
+    def _slice_degraded(st: _EndpointState) -> bool:
+        """A slice endpoint whose heartbeats carry ``slice.degraded``
+        declared itself DEAD (a chip inside the slice failed): no
+        timeout inference needed — it leaves the pool immediately and
+        its pinned streams migrate."""
+        try:
+            sl = st.endpoint.stats().get("slice")
+        except BaseException:
+            return False
+        return bool(isinstance(sl, dict) and sl.get("degraded"))
 
     @staticmethod
     def _endpoint_state(st: _EndpointState) -> Optional[str]:
@@ -500,6 +541,8 @@ class InferenceRouter:
                     elif self._endpoint_state(st0) in (
                             wire.STATE_DRAINING, wire.STATE_STOPPED):
                         reason = "drain"
+                    elif self._slice_degraded(st0):
+                        reason = "slice_degraded"
                     elif st0.wedged:
                         reason = "wedged"
                     else:
@@ -550,6 +593,8 @@ class InferenceRouter:
     def _migration_reason(self, st: _EndpointState,
                           err: BaseException) -> str:
         from deeplearning4j_tpu.serving.endpoint import EndpointTimeout
+        if type(err).__name__ == "SliceDegraded":
+            return "slice_degraded"
         if st.wedged:
             return "wedged"
         if isinstance(err, EndpointTimeout):
@@ -675,7 +720,17 @@ class InferenceRouter:
         if on_tokens is not None:
             with self._lock:
                 self._streams.add(rf)
-        self._dispatch(rf, st)
+        pf = None
+        if kind == "generate":
+            # disaggregated prefill/decode: when a prefill-specialized
+            # endpoint is up, the prompt's KV is computed THERE and the
+            # session hands to the decode endpoint like a resume —
+            # decode bursts never stall behind a long prompt forward
+            pf = self._pick_prefill()
+        if pf is not None:
+            self._dispatch_disagg(rf, st, pf)
+        else:
+            self._dispatch(rf, st)
         if self.hedge_after > 0 and session is None and \
                 on_tokens is None and self.max_attempts > 1:
             # candidate availability is checked when the timer FIRES —
@@ -687,6 +742,47 @@ class InferenceRouter:
         return rf.future
 
     # --------------------------------------------------------- dispatch
+
+    def _pick_prefill(self) -> Optional[_EndpointState]:
+        """The least-loaded healthy PREFILL-role endpoint, or None when
+        disaggregation is not configured (no prefill endpoint alive) —
+        the caller then runs the classic fused path."""
+        pool = self._pool(time.monotonic(), role="prefill")
+        if not pool:
+            return None
+        return min(pool, key=lambda st: (self._estimate_ms(st)[0],
+                                         st.endpoint.name))
+
+    def _dispatch_disagg(self, rf: _Routed, st: _EndpointState,
+                         pf: _EndpointState) -> None:
+        """The disaggregation hop: prefill on ``pf``, then dispatch the
+        decode half to ``st`` with the shipped KV. A prefill failure is
+        NOT a request failure — the decode endpoint just prefills
+        locally (same tokens, classic path)."""
+        with self._lock:
+            pf.requests += 1
+            pf.inflight += 1
+        t0 = time.perf_counter()
+        try:
+            inner = pf.endpoint.submit_prefill(
+                rf.x, timeout_s=rf.per_try_timeout)
+        except BaseException:
+            self._note_failure(pf)
+            self._dispatch(rf, st)
+            return
+
+        def _after(f: Future) -> None:
+            err = f.exception()
+            if err is None:
+                self._note_success(pf, (time.perf_counter() - t0) * 1e3)
+                with rf.lock:
+                    rf.kv_state = f.result()
+                mark("router_disagg_handoff", prefill=pf.endpoint.name,
+                     decode=st.endpoint.name)
+            else:
+                self._note_failure(pf)
+            self._dispatch(rf, st)
+        inner.add_done_callback(_after)
 
     @staticmethod
     def _typed_engine_error(e: BaseException) -> bool:
@@ -748,6 +844,12 @@ class InferenceRouter:
                         self._on_chunk(rf, e, off, toks))
                 if resume_prefix is not None:
                     g["prefix"] = resume_prefix
+                elif rf.kv_state is not None:
+                    # shipped prompt KV: the decode endpoint admits the
+                    # session without recomputing the prompt (a
+                    # journaled-prefix resume supersedes it — both are
+                    # exact)
+                    g["kv_state"] = rf.kv_state
                 inner = st.endpoint.submit_generate(
                     rf.x, g.pop("max_new_tokens"),
                     timeout_s=rf.per_try_timeout, **route, **g)
@@ -944,11 +1046,19 @@ class InferenceRouter:
                     "cached_bytes": pc.get("cached_bytes", 0),
                     "hit_rate": pc.get("hit_rate", 0.0),
                 }
+            sl = stats.get("slice")
+            if isinstance(sl, dict) and sl.get("degraded"):
+                # positively-declared slice death: out of the pool even
+                # while its heartbeats keep arriving
+                in_pool = False
+                healthy -= 1 if alive and not ejected else 0
             eps[name] = {
                 "prefix_cache": prefix_cache,
                 "alive": alive,
                 "ejected": ejected,
                 "in_pool": in_pool,
+                "role": st.role,
+                "slice": sl if isinstance(sl, dict) else None,
                 "wedged": st.wedged,
                 "state": self._endpoint_state(st),
                 "consecutive_failures": st.consecutive_failures,

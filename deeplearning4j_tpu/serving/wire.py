@@ -64,6 +64,7 @@ HB_SUFFIX = ".hb"
 
 KIND_CLASSIFY = "classify"
 KIND_GENERATE = "generate"
+KIND_PREFILL = "prefill"
 
 STATE_SERVING = "serving"
 STATE_DRAINING = "draining"
@@ -74,10 +75,15 @@ STATE_STOPPED = "stopped"
 #: token deltas tagged with sequence offsets; the terminal frame still
 #: carries the final payload) and resume requests (``gen.prefix`` — the
 #: already-generated tokens a migrated stream re-prefills instead of
-#: re-generating). A worker receiving a frame NEWER than it speaks
+#: re-generating). v3: disaggregated prefill/decode — ``prefill``
+#: request kind (the reply ships the prompt's KV as a TAGGED tensor
+#: chunk, :func:`pack_tensor_chunk`, then the terminal frame carries
+#: the last-token logits), and ``generate`` requests whose body is a
+#: shipped KV tensor (``gen.kv`` set; the prompt ids ride the header as
+#: ``gen.prompt``). A worker receiving a frame NEWER than it speaks
 #: rejects it with a typed :class:`WireVersionError` rather than
 #: serving it garbled.
-WIRE_VERSION = 2
+WIRE_VERSION = 3
 
 
 class WireVersionError(RuntimeError):
@@ -173,10 +179,28 @@ def is_chunk(header: Dict[str, Any]) -> bool:
     return bool(header.get("chunk"))
 
 
+def pack_tensor_chunk(corr_id: str, tag: str, tensor: np.ndarray) -> bytes:
+    """A v3 TAGGED tensor chunk: a non-terminal frame carrying a named
+    tensor payload (``tag`` — e.g. ``"kv"`` for a prefill reply's
+    shipped cache). Like token chunks, tensor chunks never resolve the
+    request — the terminal :func:`pack_reply` still does — and a
+    consumer that cannot use the tag drops the chunk and stays
+    correct."""
+    return pack_frame(
+        {"id": corr_id, "ok": True, "chunk": True, "tag": str(tag),
+         "v": WIRE_VERSION},
+        ndarray_to_bytes(np.asarray(tensor)))
+
+
+def chunk_tag(header: Dict[str, Any]) -> Optional[str]:
+    return header.get("tag")
+
+
 def _typed_error_registry() -> Dict[str, Any]:
     """The engine-error family that crosses the wire typed. Imported
     lazily — wire.py sits below router/registry in the import graph."""
-    from deeplearning4j_tpu.parallel.inference import InferenceBackpressure
+    from deeplearning4j_tpu.parallel.inference import (InferenceBackpressure,
+                                                       SliceDegraded)
     from deeplearning4j_tpu.serving.continuous import (DecodeBurstError,
                                                        KVPoolExhausted)
     from deeplearning4j_tpu.serving.registry import (ModelQuarantined,
@@ -193,6 +217,7 @@ def _typed_error_registry() -> Dict[str, Any]:
         "DecodeBurstError": DecodeBurstError,
         "KVPoolExhausted": KVPoolExhausted,
         "WireVersionError": WireVersionError,
+        "SliceDegraded": SliceDegraded,
     }
 
 

@@ -128,9 +128,29 @@ class EngineWorker:
             route = {k: header[k] for k in ("model", "version", "session")
                      if header.get(k) is not None}
             try:
+                if header.get("kind") == wire.KIND_PREFILL:
+                    # disaggregated prefill: compute prompt KV + logits
+                    # and ship them back — one tagged tensor chunk (kv)
+                    # then the terminal reply (logits); the decode
+                    # endpoint admits the session from the shipped state
+                    out = self.engine.prefill_export(
+                        x.astype(np.int32, copy=False))
+                    self._reply(reply_topic, wire.pack_tensor_chunk(
+                        corr, "kv", out["kv"]))
+                    self._reply(reply_topic,
+                                wire.pack_reply(corr, out["logits"]))
+                    continue
                 if header.get("kind") == wire.KIND_GENERATE:
                     g = header.get("gen") or {}
                     kwargs = dict(route)
+                    if g.get("kv"):
+                        # v3 handoff frame: the BODY is the shipped KV
+                        # tensor; the (small) prompt rides the header
+                        prompt = np.asarray(g["prompt"], np.int32)[None]
+                        kwargs["kv_state"] = {
+                            "kv": x, "t_in": prompt.shape[1],
+                            "logits": np.asarray(g["logits"], np.float32)[None]}
+                        x = prompt
                     if g.get("prefix") is not None:
                         kwargs["prefix"] = np.asarray(g["prefix"], np.int64)
                     if g.get("stream"):

@@ -20,6 +20,14 @@ disciplines this lint enforces STATICALLY, the way
    is an error — topology decisions live on the MeshPlane, where the
    lint, the checkpoint layout recorder and /healthz can see them.
 
+3. **Serving goes through the plane** (ISSUE 12, mesh-sharded serving
+   slices): inside ``deeplearning4j_tpu/serving/`` even the sanctioned
+   low-level factories (``make_mesh`` / ``mesh_from_grid``) and ``Mesh``
+   imports are banned — a serving component is HANDED a ``MeshPlane``
+   (or builds one via ``MeshPlane.build``, which records it on the
+   active-plane seam /healthz reads); it never assembles raw mesh
+   topology itself.
+
 Importable (a tier-1 test runs :func:`check_repo`) and a CLI::
 
     python scripts/check_mesh_api.py [root]
@@ -36,6 +44,16 @@ from typing import List, Tuple
 
 #: the one file allowed to import/construct the raw primitives.
 ALLOWED_FILES = ("parallel/mesh.py",)
+
+#: directories where even the sanctioned low-level mesh factories are
+#: banned: serving code takes a MeshPlane, it never builds topology.
+SERVING_DIRS = ("deeplearning4j_tpu/serving/",)
+SERVING_BANNED_CALLS = ("make_mesh", "mesh_from_grid")
+
+
+def _in_serving(rel: str) -> bool:
+    rel = rel.replace(os.sep, "/")
+    return any(d in rel for d in SERVING_DIRS)
 
 
 def _attr_chain(node) -> str:
@@ -95,12 +113,29 @@ def check_file(path: str, rel: str = "") -> List[str]:
                     f"{rel}:{node.lineno}: shard_map import outside "
                     "parallel/mesh.py — per-device programs go through "
                     "parallel.mesh.device_collective")
+            if _in_serving(rel) and (
+                    any(n == "Mesh" or n.endswith(".Mesh") for n in names)
+                    or any(n in SERVING_BANNED_CALLS for n in names)):
+                problems.append(
+                    f"{rel}:{node.lineno}: mesh-topology import inside "
+                    "serving/ — serving components take a MeshPlane "
+                    "(MeshPlane.build), they never assemble raw meshes")
         elif isinstance(node, ast.Call) and _is_mesh_ctor(node) \
                 and not allowed:
             problems.append(
                 f"{rel}:{node.lineno}: raw Mesh(...) construction outside "
                 "parallel/mesh.py — build meshes via parallel.mesh "
                 "(make_mesh / mesh_from_grid / MeshPlane)")
+        elif isinstance(node, ast.Call) and _in_serving(rel):
+            f = node.func
+            callee = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if callee in SERVING_BANNED_CALLS:
+                problems.append(
+                    f"{rel}:{node.lineno}: {callee}() inside serving/ — "
+                    "the sharded-serving code goes through MeshPlane "
+                    "(MeshPlane.build / a plane handed in), never the "
+                    "low-level mesh factories")
     return problems
 
 

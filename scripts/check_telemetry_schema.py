@@ -238,6 +238,14 @@ KNOWN_DL4J_METRICS = {
     "dl4j_mesh_devices",
     "dl4j_mesh_axis_size",
     "dl4j_mesh_restore_relayouts_total",
+    # mesh-sharded serving slices (parallel/inference.py slice_plane= +
+    # serving/fleet.py): per-slice topology/degraded state, elastic
+    # narrower-width rebuilds, and disaggregated prefill→decode KV
+    # handoffs (zero prompt tokens recomputed on the decode side)
+    "dl4j_slice_devices",
+    "dl4j_slice_degraded",
+    "dl4j_slice_rebuilds_total",
+    "dl4j_disagg_kv_handoffs_total",
     # fault-tolerance plane (supervisor / quarantine / dead-letter /
     # checkpoint integrity — see monitor/__init__.py FAULT_* names)
     "dl4j_fault_events_total",

@@ -23,8 +23,9 @@ failure — also exit 1, but reported as such)::
 replays the in-process deterministic injector battery (seeded NaN/raise
 schedules, flaky-broker schedules, torn-write counting, replica/model
 poison sequences, burst-kill windows, mesh-shrink drills, and the
-composed ChaosSchedule event clock, and the prefix-cache
-refcount/COW/eviction accounting drill — sections 1–8) twice per seed
+composed ChaosSchedule event clock, the prefix-cache
+refcount/COW/eviction accounting drill, and the slice-kill /
+slice-drill schedules — sections 1–9) twice per seed
 across rotating seeds and compares the full event logs bit-for-bit.
 It runs in milliseconds with no subprocess and no jax compute, so the
 tier-1 sweep carries it on every run; the full mode is the pre-merge /
@@ -257,6 +258,31 @@ def _scenario_log(seed: int) -> str:
     events.append(f"pc final free={pool.free_count}/{pool.total_blocks} "
                   f"shared={pool.shared_count()} "
                   f"leaked={pool.total_blocks - pool.free_count}")
+
+    # 9) slice-kill schedule determinism (faultinject.SliceKill — the
+    # kill-a-chip-inside-a-live-slice injector LocalFleet.kill_chip
+    # arms): the seeded victim chip, the survivor set and the failure
+    # tick must replay bit-identically, and a dead chip NEVER heals —
+    # every dispatch from the tick on fails (the reason recovery is an
+    # elastic rebuild, not a retry). The slice-drill ChaosSchedule
+    # (slice_kill/partition_hb/wedge action set) is pinned alongside,
+    # the same way section 7 pins the main drill's clock.
+    from deeplearning4j_tpu.faultinject import SliceKill
+    from deeplearning4j_tpu.faultinject.chaos import SLICE_ACTIONS
+    sk = SliceKill([0, 1, 2, 3], seed=seed, fail_at=seed % 3 + 1)
+    for i in range(6):
+        try:
+            sk(("lane", None), i)
+            events.append(f"sk {i} ok")
+        except ChipFailure as e:
+            events.append(f"sk {i} chipfail "
+                          f"survivors={list(e.survivor_ids)}")
+    events.append(f"sk victim={sk.victim} hits={sk.hits} "
+                  f"devices={list(sk.devices)}")
+    for n_events in (3, seed % 4 + 2):
+        cs = ChaosSchedule(seed, n_events=n_events, n_endpoints=2,
+                           actions=SLICE_ACTIONS)
+        events.append(f"slice_chaos[{n_events}]={cs.signature()}")
     return "\n".join(events)
 
 
@@ -312,7 +338,7 @@ def run_chaos(runs: int, seed_base: int, n_requests: int = 14,
     """The `chaos` section: run the composed drill TWICE per seed in
     fresh subprocesses across rotating seeds; fail on any invariant
     violation OR any outcome drift between the two replays of one
-    seed — the same determinism contract sections 1–8 pin for the
+    seed — the same determinism contract sections 1–9 pin for the
     injectors, applied to the whole composed drill."""
     bad = 0
     for i in range(runs):

@@ -12,11 +12,14 @@ tier-1 the schedule half of that contract is carried by
 ``quick_check`` section 7.
 """
 
+import jax
 import pytest
 
 from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.faultinject import ChaosSchedule
-from deeplearning4j_tpu.faultinject.chaos import ACTIONS, run_chaos_drill
+from deeplearning4j_tpu.faultinject.chaos import (ACTIONS, SLICE_ACTIONS,
+                                                  run_chaos_drill,
+                                                  run_slice_drill)
 
 pytestmark = pytest.mark.faultinject
 
@@ -60,3 +63,27 @@ def test_composed_chaos_drill_invariants(fresh_registry):
     # the schedule recorded in the summary is the seeded one
     assert out["schedule"] == ChaosSchedule(0, n_events=3,
                                             n_endpoints=3).signature()
+
+
+def test_composed_slice_drill_invariants(fresh_registry):
+    """The MESH-SLICE composed drill (ISSUE 12): chip death inside a
+    live 2-chip slice composes with heartbeat partitions and wedges —
+    every request resolves with the exact single-device output
+    (bitwise classify, token-for-token streams THROUGH the chip
+    death), append-only delivery, zero leaked KV blocks across every
+    engine ever alive (dead slices included), elastic rebuilds land at
+    the narrower width, and the fleet converges."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    out = run_slice_drill(seed=0, n_requests=10, n_events=2)
+    assert out["submitted"] == 10
+    assert out["completed"] == out["submitted"], out
+    assert out["failed"] == 0 and out["stranded_futures"] == 0, out
+    assert out["token_mismatches"] == 0, out
+    assert out["dup_offsets"] == 0 and out["gap_events"] == 0, out
+    assert out["leaked_blocks"] == 0, out
+    assert out["healthy_endpoints"] == 2, out
+    assert out["schedule"] == ChaosSchedule(
+        0, n_events=2, n_endpoints=2, actions=SLICE_ACTIONS).signature()
+    # every rebuild narrowed the slice (2 → 1 on this drill's width)
+    assert all(w == 1 for w in out["rebuilt_widths"]), out

@@ -1,0 +1,508 @@
+"""Mesh-sharded serving slices (ISSUE 12 tentpole).
+
+The contracts under test:
+
+1. **Sharded serving exactness** — a ``ParallelInference`` whose one
+   replica is a tp≥2 mesh slice (params column-sharded per the
+   serving SpecLayout, KV pool heads-sharded, programs
+   jitted-with-shardings) produces output BITWISE equal to the
+   single-device engine: classify logits byte-for-byte, greedy and
+   seeded-sampled generate token-for-token — and steady state performs
+   zero XLA compiles on warmed ladders.
+2. **Slice as a failure domain** — a ``ChipFailure`` inside the slice
+   poisons the WHOLE engine: typed ``SliceDegraded`` (in submits, in
+   heartbeat-carried stats, in ``fleet_snapshot``), in-flight streams
+   migrate through the PR-10 journal/resume path token-for-token, and
+   ``ScalePolicy``/``LocalFleet`` rebuild the slice at a NARROWER
+   width from the survivors (the 8→4→1 mesh-portable ladder) —
+   deterministically across drill reruns.
+3. **Disaggregated prefill/decode** — a prefill-role endpoint computes
+   the prompt KV, ships it (wire v3 tensor chunks), and the decode
+   endpoint admits the session from the shipped state with ZERO prompt
+   tokens recomputed — tokens exactly equal the fused path.
+
+Plus the satellite guards: the check_mesh_api lint now bans mesh
+construction inside serving/ (and catches crafted violations), and the
+dl4j_slice_* / dl4j_disagg_* metric family is schema-pinned.
+"""
+
+import importlib.util
+import os
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.faultinject import ChipFailure, SliceKill
+from deeplearning4j_tpu.models.zoo.transformer import gpt
+from deeplearning4j_tpu.nn.generate import generate_eager
+from deeplearning4j_tpu.parallel.inference import (ParallelInference,
+                                                   SliceDegraded)
+from deeplearning4j_tpu.parallel.mesh import (MeshPlane,
+                                              apply_serving_slice,
+                                              serving_slice_layout,
+                                              slice_planes)
+from deeplearning4j_tpu.serving import (InferenceRouter, LocalEndpoint,
+                                        LocalFleet, ScalePolicy)
+from deeplearning4j_tpu.util.model_serializer import (restore_model,
+                                                      write_model)
+
+VOCAB = 13
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_SCRIPTS, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _need(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+
+
+def _tiny_gpt(seed=3):
+    return gpt(vocab_size=VOCAB, d_model=16, n_layers=2, num_heads=2,
+               max_len=32, compute_dtype="float32", learning_rate=0.01,
+               seed=seed).init()
+
+
+@pytest.fixture(scope="module")
+def artifact():
+    """One saved model artifact + a single-device oracle net — every
+    slice in the module restores the SAME weights from it (the
+    mesh-portable deploy story) so cross-width comparisons are
+    bitwise-meaningful."""
+    lm = _tiny_gpt()
+    td = tempfile.mkdtemp(prefix="dl4j-slice-test-")
+    path = os.path.join(td, "lm.zip")
+    write_model(lm, path)
+    return lm, path
+
+
+@pytest.fixture
+def fresh_registry():
+    prev = monitor.set_registry(monitor.MetricsRegistry())
+    yield monitor.get_registry()
+    monitor.set_registry(prev)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _slice_engine(path, devices, width=2, **kw):
+    plane = MeshPlane.build({"tp": width}, devices=devices[:width])
+    kw.setdefault("continuous", True)
+    kw.setdefault("decode_slots", 2)
+    kw.setdefault("decode_burst", 4)
+    kw.setdefault("kv_block_size", 4)
+    kw.setdefault("max_latency_ms", 1.0)
+    return ParallelInference(net=restore_model(path), slice_plane=plane,
+                             **kw)
+
+
+# ------------------------------------------------- sharded serving
+
+
+def test_sliced_engine_bitwise_parity(artifact, rng, fresh_registry):
+    """tp=2 slice vs single device: classify logits BITWISE, greedy and
+    seeded-sampled generate token-for-token, zero leaked blocks after
+    drain — the house bar holds across the mesh."""
+    _need(2)
+    lm, path = artifact
+    ids = rng.integers(1, VOCAB, (2, 6))
+    prompt = ids[:1]
+    y_ref = np.asarray(lm.output(ids))
+    g_ref = generate_eager(lm, prompt, 8, seed=5)
+    s_ref = generate_eager(lm, prompt, 8, temperature=0.8, top_k=4, seed=5)
+    eng = _slice_engine(path, jax.devices(), width=2)
+    try:
+        assert eng.stats()["slice"] == {
+            "width": 2,
+            "devices": sorted(d.id for d in jax.devices()[:2]),
+            "degraded": False}
+        y = np.asarray(eng.output(ids, timeout=60))
+        assert y.tobytes() == y_ref.tobytes()  # bitwise, not allclose
+        g = eng.generate(prompt, 8, seed=5, timeout=60)
+        assert np.array_equal(g, g_ref)
+        s = eng.generate(prompt, 8, temperature=0.8, top_k=4, seed=5,
+                         timeout=60)
+        assert np.array_equal(s, s_ref)
+        assert eng.drain(timeout=30)
+        pool = eng.stats()["scheduler"]["pool"]
+        assert pool["blocks_free"] == pool["blocks_total"]
+    finally:
+        eng.shutdown()
+
+
+def test_sliced_zero_steady_state_compiles(artifact, rng, fresh_registry):
+    """Warmed ladders on the slice mesh serve any request mix with zero
+    XLA compiles — the GSPMD jit-with-shardings programs ladder exactly
+    like the single-device ones."""
+    _need(2)
+    lm, path = artifact
+    eng = _slice_engine(path, jax.devices(), width=2)
+    try:
+        compiled = eng.warmup_generate([2, 4, 8], 8)
+        assert compiled > 0
+        assert eng.stats()["scheduler"]["warmed"]
+        miss0 = fresh_registry.family_total(monitor.JIT_CACHE_MISS_COUNTER)
+        futs = [eng.submit_generate(rng.integers(1, VOCAB, (1, t)), mn,
+                                    temperature=temp, seed=i)
+                for i, (t, mn, temp) in enumerate(
+                    [(3, 8, 0.0), (5, 4, 0.5), (8, 6, 0.0)])]
+        for f in futs:
+            f.result(60)
+        assert fresh_registry.family_total(
+            monitor.JIT_CACHE_MISS_COUNTER) == miss0
+    finally:
+        eng.shutdown()
+
+
+def test_serving_slice_layout_and_planes():
+    """The column-only layout shards every big matrix on a
+    NON-contracting dim (the bitwise precondition), leaves the head
+    replicated, and slice_planes carves the device budget in order."""
+    _need(4)
+    lm = _tiny_gpt()
+    layout = serving_slice_layout(lm)
+    blk = lm.impls[1].name
+    from jax.sharding import PartitionSpec as P
+    assert layout.get(blk, "Wqkv") == P(None, "tp")
+    assert layout.get(blk, "W2") == P(None, "tp")
+    assert layout.get(lm.impls[0].name, "W") == P(None, "tp")
+    head = lm.impls[-1].name
+    assert layout.get(head, "W") is None  # logits whole on every chip
+    planes = slice_planes(2, jax.devices()[:4])
+    assert len(planes) == 2
+    assert [p.axis_size("tp") for p in planes] == [2, 2]
+    ids = sorted(d.id for p in planes for d in p.mesh.devices.flat)
+    assert ids == sorted(d.id for d in jax.devices()[:4])
+    # a width that does not divide num_heads is refused loudly — the
+    # bitwise seam shards WHOLE heads, never head_dim
+    from deeplearning4j_tpu.parallel.mesh import apply_serving_slice
+    with pytest.raises(ValueError, match="num_heads"):
+        apply_serving_slice(
+            _tiny_gpt(),  # 2 heads
+            MeshPlane.build({"tp": 4}, devices=jax.devices()[:4]))
+
+
+# ------------------------------------------- slice failure domain
+
+
+def _slice_fleet(path, engines, n_endpoints=2, width=2,
+                 wedge_timeout_s=1.0):
+    def factory(plane):
+        eng = ParallelInference(net=restore_model(path), slice_plane=plane,
+                                continuous=True, decode_slots=2,
+                                decode_burst=2, kv_block_size=4,
+                                max_latency_ms=1.0)
+        engines.append(eng)
+        return eng
+
+    router = InferenceRouter(per_try_timeout_s=4.0, eject_backoff_s=0.1,
+                             max_attempts=6,
+                             wedge_timeout_s=wedge_timeout_s)
+    fleet = LocalFleet(factory, router=router, heartbeat_s=0.05,
+                       request_timeout_s=4.0, heartbeat_timeout_s=0.5,
+                       slice_width=width,
+                       slice_devices=jax.devices()[:width * n_endpoints])
+    for _ in range(n_endpoints):
+        fleet.add_endpoint()
+    assert fleet.wait_ready(30)
+    return router, fleet
+
+
+@pytest.mark.faultinject
+def test_kill_chip_slice_dead_stream_resumes(artifact, fresh_registry):
+    """Kill a chip inside the pinned slice mid-stream: the engine
+    poisons itself typed (SliceDegraded rides the heartbeats — the
+    fleet snapshot shows the degraded topology, not a bare unhealthy
+    bit), the stream migrates with its journaled prefix, and the
+    delivered tokens equal an uninterrupted run — no dup, no gap."""
+    import time
+    _need(4)
+    lm, path = artifact
+    engines = []
+    router, fleet = _slice_fleet(path, engines)
+    try:
+        prompt = np.array([[3, 5, 7, 2]])
+        max_new = 12
+        oracle = generate_eager(lm, prompt, max_new, seed=9)
+        toks, dups, gaps = [], [0], [0]
+
+        def on_tokens(off, ts):
+            for i, t in enumerate(np.asarray(ts).reshape(-1).tolist()):
+                idx = int(off) + i
+                if idx < len(toks):
+                    dups[0] += 1
+                elif idx == len(toks):
+                    toks.append(int(t))
+                else:
+                    gaps[0] += 1
+
+        fut = router.submit_generate(prompt, max_new, seed=9,
+                                     session="s1", on_tokens=on_tokens)
+        deadline = time.monotonic() + 30
+        while len(toks) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(toks) >= 3, "stream never started"
+        pin = router.session_endpoint("s1")
+        inj = fleet.kill_chip(pin, seed=1)
+        assert inj.victim in inj.devices
+        out = fut.result(timeout=60)
+        assert np.array_equal(out, oracle)
+        assert toks == [int(t) for t in oracle[0, -max_new:]]
+        assert dups[0] == 0 and gaps[0] == 0
+        # the dead slice POSITIVELY declared itself: degraded topology
+        # in the snapshot, engine submits reject typed
+        snap = router.fleet_snapshot()
+        assert snap["endpoints"][pin]["slice"]["degraded"] is True
+        assert snap["endpoints"][pin]["in_pool"] is False
+        dead_eng = next(e for e in engines if e._slice_dead is not None)
+        with pytest.raises(SliceDegraded):
+            dead_eng.submit(np.zeros((1, 4), np.float32))
+        # zero leaked blocks across every engine ever alive
+        for eng in engines:
+            sched = eng._scheduler
+            if sched is None:
+                continue
+            pool = sched.stats()["pool"]
+            assert pool["blocks_free"] == pool["blocks_total"]
+    finally:
+        fleet.shutdown(drain=False)
+        router.close()
+
+
+@pytest.mark.faultinject
+def test_elastic_rebuild_policy_and_determinism(fresh_registry):
+    """The 8→4 elastic rebuild drill: ScalePolicy sees the degraded
+    slice in the snapshot and emits a REBUILD decision (before any
+    add/remove sizing, under the cooldown discipline); LocalFleet
+    restores the artifact onto a slice of HALF the width from the
+    survivors (8 chips → a chip dies → 4); the drill replays
+    deterministically — same seed ⇒ same victim chip, same rebuilt
+    width, same tokens."""
+    _need(8)
+    # an 8-wide slice needs heads divisible by 8: dedicated artifact
+    lm = gpt(vocab_size=VOCAB, d_model=16, n_layers=2, num_heads=8,
+             max_len=32, compute_dtype="float32", learning_rate=0.01,
+             seed=3).init()
+    td = tempfile.mkdtemp(prefix="dl4j-slice8-")
+    path = os.path.join(td, "lm8.zip")
+    write_model(lm, path)
+    prompt = np.array([[4, 2, 9]])
+    oracle = generate_eager(lm, prompt, 6, seed=11)
+
+    def one_run():
+        engines = []
+        router, fleet = _slice_fleet(path, engines, n_endpoints=1,
+                                     width=8)
+        try:
+            name = fleet.names()[0]
+            inj = fleet.kill_chip(name, seed=2)
+            eng = fleet._members[name].worker.engine
+            with pytest.raises(BaseException):
+                eng.output(np.zeros((1, 4), np.float32), timeout=30)
+            assert eng._slice_dead is not None
+            # wait for a heartbeat to carry the degraded topology out
+            import time
+            deadline = time.monotonic() + 10
+            snap = router.fleet_snapshot()
+            while time.monotonic() < deadline:
+                snap = router.fleet_snapshot()
+                sl = snap["endpoints"][name].get("slice")
+                if sl and sl.get("degraded"):
+                    break
+                time.sleep(0.02)
+            pol = ScalePolicy(min_endpoints=1, max_endpoints=4,
+                              cooldown_s=5.0)
+            dec = pol.decide(snap, now=100.0)
+            assert [d.action for d in dec] == ["rebuild"]
+            assert dec[0].endpoint == name
+            # cooldown: an immediate second decision is suppressed
+            assert pol.decide(router.fleet_snapshot(), now=101.0) == []
+            log = fleet.apply(dec)
+            assert log and log[0].startswith("rebuild")
+            new_width = fleet._members[name].plane.axis_size("tp")
+            assert new_width == 4  # 8 → 4: the narrower-slice ladder
+            # the rebuilt worker re-enters the pool on its first
+            # healthy heartbeat
+            from deeplearning4j_tpu.serving import RetryAfter
+            out = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    out = router.generate(prompt, 6, seed=11, timeout=60)
+                    break
+                except RetryAfter:
+                    time.sleep(0.05)
+            assert out is not None, "rebuilt slice never rejoined"
+            return inj.victim, new_width, np.asarray(out)
+        finally:
+            fleet.shutdown(drain=False)
+            router.close()
+
+    v1, w1, out1 = one_run()
+    v2, w2, out2 = one_run()
+    assert (v1, w1) == (v2, w2)
+    assert np.array_equal(out1, oracle) and np.array_equal(out2, oracle)
+
+
+# ------------------------------------------- disaggregated serving
+
+
+def test_disaggregated_handoff_exact_tokens(artifact, rng, fresh_registry):
+    """A prefill-role endpoint computes the prompt KV; the decode
+    endpoint admits the session from the shipped state — ZERO prompt
+    tokens recomputed (the scheduler's prefill accounting pins it),
+    streams emit from offset 0, tokens exactly equal the fused path,
+    and the handoff counter ticks."""
+    lm, path = artifact
+    dec_eng = ParallelInference(net=restore_model(path), continuous=True,
+                                decode_slots=2, decode_burst=4,
+                                kv_block_size=4, max_latency_ms=1.0)
+    pre_eng = ParallelInference(net=restore_model(path),
+                                max_latency_ms=1.0)
+    router = InferenceRouter()
+    router.add_endpoint(LocalEndpoint(dec_eng, "dec"), role="decode")
+    router.add_endpoint(LocalEndpoint(pre_eng, "pre"), role="prefill")
+    try:
+        prompt = rng.integers(1, VOCAB, (1, 5))
+        g_ref = generate_eager(lm, prompt, 8, seed=4)
+        s_ref = generate_eager(lm, prompt, 8, temperature=0.7, seed=4)
+        toks = []
+        fut = router.submit_generate(
+            prompt, 8, seed=4,
+            on_tokens=lambda off, ts: toks.extend(
+                np.asarray(ts).reshape(-1).tolist()))
+        assert np.array_equal(fut.result(timeout=60), g_ref)
+        assert toks == [int(t) for t in g_ref[0, -8:]]
+        assert np.array_equal(
+            router.generate(prompt, 8, temperature=0.7, seed=4,
+                            timeout=60), s_ref)
+        sched = dec_eng.stats()["scheduler"]
+        assert sched["kv_handoffs"] == 2
+        assert sched["prefill_tokens_computed"] == 0  # the disagg win
+        assert fresh_registry.family_total(
+            monitor.DISAGG_KV_HANDOFFS_COUNTER) == 2
+        # prefill endpoints never serve classify/decode traffic
+        snap = router.fleet_snapshot()
+        assert snap["endpoints"]["pre"]["role"] == "prefill"
+    finally:
+        dec_eng.shutdown()
+        pre_eng.shutdown()
+
+
+def test_disagg_remote_wire_v3(artifact, fresh_registry):
+    """The handoff crosses the broker wire: prefill reply = one tagged
+    kv tensor chunk + terminal logits frame (wire v3), the generate
+    frame carries the shipped KV as its body — tokens stay exact."""
+    import time
+
+    from deeplearning4j_tpu.serving import EngineWorker, RemoteEndpoint
+    from deeplearning4j_tpu.streaming.broker import InMemoryBroker
+    lm, path = artifact
+    broker = InMemoryBroker()
+    dec_eng = ParallelInference(net=restore_model(path), continuous=True,
+                                decode_slots=2, decode_burst=4,
+                                kv_block_size=4, max_latency_ms=1.0)
+    pre_eng = ParallelInference(net=restore_model(path),
+                                max_latency_ms=1.0)
+    w1 = EngineWorker(dec_eng, broker, "rdec", heartbeat_s=0.05)
+    w2 = EngineWorker(pre_eng, broker, "rpre", heartbeat_s=0.05)
+    router = InferenceRouter()
+    router.add_endpoint(RemoteEndpoint(broker, "rdec",
+                                       heartbeat_timeout_s=1.0),
+                        role="decode")
+    router.add_endpoint(RemoteEndpoint(broker, "rpre",
+                                       heartbeat_timeout_s=1.0),
+                        role="prefill")
+    try:
+        time.sleep(0.2)
+        prompt = np.array([[3, 5, 7, 2, 9]])
+        g_ref = generate_eager(lm, prompt, 8, seed=4)
+        out = router.generate(prompt, 8, seed=4, timeout=60)
+        assert np.array_equal(out, g_ref)
+        assert dec_eng.stats()["scheduler"]["kv_handoffs"] == 1
+    finally:
+        w1.kill()
+        w2.kill()
+        dec_eng.shutdown()
+        pre_eng.shutdown()
+        router.close()
+
+
+# ------------------------------------------------------- satellites
+
+
+def test_slicekill_schedule_deterministic():
+    """Same (devices, seed, fail_at) ⇒ same victim, same survivors,
+    same failure tick — and a dead chip NEVER heals (every later
+    dispatch still raises)."""
+    a = SliceKill([0, 1, 2, 3], seed=5, fail_at=2)
+    b = SliceKill([0, 1, 2, 3], seed=5, fail_at=2)
+    assert (a.victim, a.survivors) == (b.victim, b.survivors)
+    assert a.victim in (0, 1, 2, 3)
+    assert len(a.survivors) == 3 and a.victim not in a.survivors
+    hits = []
+    for i in range(5):
+        try:
+            a(("lane", None), i)
+            hits.append(0)
+        except ChipFailure as e:
+            hits.append(1)
+            assert tuple(e.survivor_ids) == a.survivors
+    assert hits == [0, 0, 1, 1, 1]  # fires at the tick, stays dead
+
+
+def test_mesh_lint_covers_serving(tmp_path):
+    """The check_mesh_api lint is clean on the repo and CATCHES mesh
+    construction smuggled into serving/ — the sharded-serving code must
+    go through MeshPlane."""
+    lint = _load_script("check_mesh_api")
+    root = os.path.dirname(_SCRIPTS)
+    assert lint.check_repo(root) == []
+    bad_dir = tmp_path / "deeplearning4j_tpu" / "serving"
+    bad_dir.mkdir(parents=True)
+    bad = bad_dir / "rogue.py"
+    bad.write_text("from deeplearning4j_tpu.parallel.mesh import "
+                   "make_mesh\nm = make_mesh({'tp': 2})\n")
+    problems = lint.check_file(
+        str(bad), rel="deeplearning4j_tpu/serving/rogue.py")
+    assert len(problems) == 2  # the import AND the call
+    assert all("serving" in p for p in problems)
+    ok = bad_dir / "fine.py"
+    ok.write_text("from deeplearning4j_tpu.parallel.mesh import "
+                  "MeshPlane\np = MeshPlane.build({'tp': 2})\n")
+    assert lint.check_file(
+        str(ok), rel="deeplearning4j_tpu/serving/fine.py") == []
+
+
+def test_slice_metrics_schema_pinned(artifact, fresh_registry):
+    """dl4j_slice_* / dl4j_disagg_* are registered names the telemetry
+    schema knows, and a sliced engine publishes them."""
+    _need(2)
+    schema = _load_script("check_telemetry_schema")
+    for name in ("dl4j_slice_devices", "dl4j_slice_degraded",
+                 "dl4j_slice_rebuilds_total",
+                 "dl4j_disagg_kv_handoffs_total"):
+        assert name in schema.KNOWN_DL4J_METRICS
+    lm, path = artifact
+    eng = _slice_engine(path, jax.devices(), width=2, continuous=False)
+    try:
+        text = fresh_registry.prometheus_text()
+        assert "dl4j_slice_devices" in text
+        assert "dl4j_slice_degraded" in text
+        assert schema.validate_prometheus_text(text) == []
+    finally:
+        eng.shutdown()
