@@ -40,15 +40,87 @@ summary contains only schedule- and invariant-valued fields, so a
 passing drill is bitwise-deterministic across reruns — the contract
 ``scripts/stress_faultinject.py --chaos`` enforces in fresh
 subprocesses with rotating seeds.
+
+The drill also runs END-TO-END REQUEST TRACING (monitor/reqtrace.py)
+over its own traffic: every delivered decode stream's merged trace
+must be parent-complete, and any stream that migrated with a journaled
+prefix must have its migration gap fully attributed (silence_wait /
+repin / resume dispatch / resume re-prefill / first resumed burst —
+``check_telemetry_schema.validate_migration_coverage``).
+``trace_violations`` in the summary is the count (0 on a passing
+drill, so determinism holds); any invariant failure fires a
+flight-recorder trigger so the evidence rings dump when armed.
 """
 
 from __future__ import annotations
 
+import importlib.util
 import os
 import random
 import tempfile
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+def _load_schema_checker():
+    """scripts/check_telemetry_schema.py loaded by path (the repo
+    layout keeps scripts/ beside the package); None when the tree is
+    installed without it — trace validation then degrades to the
+    inline parent-completeness check."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "scripts", "check_telemetry_schema.py")
+    if not os.path.exists(path):
+        return None
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema_chaos", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _audit_stream_traces(tracer, futs) -> List[str]:
+    """Per-delivered-stream trace invariants: parent-complete merged
+    span tree; for streams that migrated with a journaled prefix, the
+    migration gap fully attributed (the extended schema checker's
+    coverage rule). Returns the violation list (empty on a passing
+    drill — keeps the summary deterministic)."""
+    if tracer is None:
+        return []
+    schema = _load_schema_checker()
+    violations: List[str] = []
+    for kind, fut, _oracle, _coll, _r in futs:
+        if kind != "decode" or not fut.done() \
+                or fut.exception() is not None:
+            continue
+        tid = getattr(fut, "trace_id", None)
+        if tid is None:
+            violations.append("delivered stream has no trace id")
+            continue
+        entry = tracer.completed_trace(tid)
+        if entry is None:
+            violations.append(f"{tid}: no completed trace")
+            continue
+        spans = entry["spans"]
+        if schema is not None:
+            violations.extend(schema.validate_trace_spans(spans, tid))
+        else:
+            ids = {s["span"] for s in spans}
+            violations.extend(
+                f"{tid}: orphan span {s['span']}" for s in spans
+                if s["parent"] is not None and s["parent"] not in ids)
+        resumed = any(s["name"] == "dispatch"
+                      and (s.get("attrs") or {}).get("resume_prefix")
+                      for s in spans)
+        migrated = any(s["name"] == "silence_wait" for s in spans)
+        if resumed and schema is not None:
+            violations.extend(
+                schema.validate_migration_coverage(spans, tid))
+        elif migrated and not any(s["name"] == "repin" for s in spans):
+            violations.append(f"{tid}: migrated stream without a "
+                              f"repin span")
+    return violations
 
 #: the composable action set, index-addressed by the seeded schedule
 ACTIONS: Tuple[str, ...] = ("kill", "partition_hb", "wedge", "burst_kill",
@@ -163,6 +235,8 @@ def run_chaos_drill(seed: int = 0, n_requests: int = 16, n_events: int = 4,
     from deeplearning4j_tpu.util.model_serializer import (restore_model,
                                                           write_model)
 
+    from deeplearning4j_tpu.monitor import reqtrace
+
     vocab, n_in, n_cls = 11, 6, 3
     lm = gpt(vocab_size=vocab, d_model=16, n_layers=2, num_heads=2,
              max_len=32, compute_dtype="float32", learning_rate=0.01,
@@ -170,6 +244,11 @@ def run_chaos_drill(seed: int = 0, n_requests: int = 16, n_events: int = 4,
     clf = _clf_net(n_in, n_cls)
     schedule = ChaosSchedule(seed, n_events=n_events, n_endpoints=3)
     rng = np.random.default_rng(int(seed) * 104729 + 7)
+    # the drill runs under request tracing: the per-stream merged
+    # traces are themselves drill invariants (parent-complete; a
+    # resumed migration's gap fully attributed)
+    prev_tracer = reqtrace.request_tracer()
+    tracer = reqtrace.enable_request_tracing(completed_capacity=4096)
 
     engines: List[ParallelInference] = []
 
@@ -444,12 +523,26 @@ def run_chaos_drill(seed: int = 0, n_requests: int = 16, n_events: int = 4,
                 time.sleep(0.02)
             pool = sched.stats()["pool"]
             leaked += int(pool["blocks_total"] - pool["blocks_free"])
+
+        # ---- per-stream trace invariants (monitor/reqtrace.py) ----------
+        trace_violations = _audit_stream_traces(tracer, futs)
     finally:
         try:
             fleet.shutdown(drain=False)
         except BaseException:
             pass
         router.close()
+        reqtrace.set_request_tracer(prev_tracer)
+
+    if (failed or stranded or mismatches or dup_offsets or gap_events
+            or leaked or trace_violations):
+        # invariant failure is a flight-recorder trigger: the recent
+        # traces + structured events dump as JSONL when armed — the
+        # post-mortem evidence for the failing rerun
+        reqtrace.flight_trigger(
+            "invariant", drill="chaos", seed=int(seed), failed=failed,
+            stranded=stranded, mismatches=mismatches,
+            leaked=leaked, trace_violations=len(trace_violations))
 
     return {
         "seed": int(seed),
@@ -464,6 +557,7 @@ def run_chaos_drill(seed: int = 0, n_requests: int = 16, n_events: int = 4,
         "leaked_blocks": leaked,
         "healthy_endpoints": healthy,
         "ckpt_fallback_ok": ckpt_fallback_ok,
+        "trace_violations": len(trace_violations),
     }
 
 

@@ -243,6 +243,26 @@ SLICE_DEGRADED_GAUGE = "dl4j_slice_degraded"
 SLICE_REBUILDS_COUNTER = "dl4j_slice_rebuilds_total"
 DISAGG_KV_HANDOFFS_COUNTER = "dl4j_disagg_kv_handoffs_total"
 
+# End-to-end request tracing + SLO attribution (monitor/reqtrace.py —
+# the serving plane's Dapper layer): per-request phase durations from
+# the merged traces (``phase=`` label: admission / dispatch /
+# queue_wait / prefill / decode_burst / chunk_deliver / silence_wait /
+# repin / engine_queue / engine_dispatch / wire_ingress — the
+# TTFT/TPOT decomposition), TTFT and time-per-output-token histograms
+# per model, the per-model SLO burn counter (``outcome=`` met / missed
+# / shed — missed+shed burn the error budget), span volume / bounded-
+# buffer drops / open-trace gauge, and flight-recorder triggers
+# (``reason=`` ejection / wedge / invariant / …; each dumps the
+# trace+event rings as JSONL when a dump dir is armed).
+REQ_PHASE_HISTOGRAM = "dl4j_req_phase_ms"
+REQ_TTFT_HISTOGRAM = "dl4j_req_ttft_ms"
+REQ_TPOT_HISTOGRAM = "dl4j_req_tpot_ms"
+REQ_SLO_BURN_COUNTER = "dl4j_req_slo_burn_total"
+TRACE_SPANS_COUNTER = "dl4j_trace_spans_total"
+TRACE_DROPPED_COUNTER = "dl4j_trace_dropped_total"
+TRACE_ACTIVE_GAUGE = "dl4j_trace_active"
+TRACE_FLIGHT_DUMPS_COUNTER = "dl4j_trace_flight_dumps_total"
+
 # Fault-tolerance plane (detect → isolate → recover): every recovery
 # path in the stack reports through these five families so an operator
 # can tell a self-healed fault from a healthy run. ``domain`` label on
@@ -290,16 +310,39 @@ from deeplearning4j_tpu.monitor.tracing import (  # noqa: F401
     mark,
     now_us,
     span,
+    to_origin_us,
+)
+from deeplearning4j_tpu.monitor.reqtrace import (  # noqa: F401
+    FlightRecorder,
+    RequestTracer,
+    TraceContext,
+    begin_trace,
+    configure_flight_recorder,
+    current_trace,
+    disable_request_tracing,
+    enable_request_tracing,
+    finish_trace,
+    flight_event,
+    flight_recorder,
+    flight_trigger,
+    record_span,
+    request_tracer,
+    start_span,
+    trace_event,
+    use_trace,
 )
 
 
-def phase_breakdown(registry=None) -> dict:
-    """Per-phase timing summary from ``dl4j_phase_duration_ms`` —
-    the attribution BENCH rounds attach next to end-to-end numbers:
+def phase_breakdown(registry=None, name: str = PHASE_HISTOGRAM) -> dict:
+    """Per-phase timing summary from a ``{phase=...}``-labeled duration
+    histogram family (default: the training-plane
+    ``dl4j_phase_duration_ms``; pass ``REQ_PHASE_HISTOGRAM`` for the
+    serving plane's per-request decomposition) — the attribution BENCH
+    rounds attach next to end-to-end numbers:
     ``{phase: {count, total_ms, mean_ms, p50_ms, p99_ms}}``."""
     reg = registry if registry is not None else get_registry()
     out = {}
-    for labels, hist in sorted(reg.family(PHASE_HISTOGRAM).items()):
+    for labels, hist in sorted(reg.family(name).items()):
         phase = dict(labels).get("phase", "?")
         s = hist.summary()
         out[phase] = {"count": int(s["count"]),

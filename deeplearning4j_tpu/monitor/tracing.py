@@ -41,6 +41,14 @@ def now_us() -> float:
     return (time.perf_counter() - _ORIGIN) * 1e6
 
 
+def to_origin_us(perf_t: float) -> float:
+    """Convert a raw ``time.perf_counter()`` reading to microseconds on
+    the process clock origin — lets callers that already hold host-side
+    timestamps (request submit times, dispatch starts) emit spans
+    post-hoc without re-reading the clock."""
+    return (perf_t - _ORIGIN) * 1e6
+
+
 class _Span:
     """Context manager for one phase occurrence. Reusable via ``span()``;
     cheap: two perf_counter reads + one histogram observe, plus a JSONL

@@ -123,6 +123,8 @@ from deeplearning4j_tpu.monitor import (
     record_fault,
     span,
 )
+from deeplearning4j_tpu.monitor import reqtrace
+from deeplearning4j_tpu.monitor.tracing import to_origin_us
 from deeplearning4j_tpu.optimize.deferred import note_dispatch
 
 
@@ -144,7 +146,7 @@ class SliceDegraded(RuntimeError):
 
 class _Request:
     __slots__ = ("x", "n", "future", "t_submit", "model", "version",
-                 "coalescible")
+                 "coalescible", "trace")
 
     def __init__(self, x: np.ndarray, model: Optional[str] = None,
                  version: Optional[int] = None, coalescible: bool = True):
@@ -155,6 +157,10 @@ class _Request:
         self.model = model
         self.version = version
         self.coalescible = coalescible
+        # request-trace context, captured AT SUBMIT on the caller's
+        # thread (where the router/worker installed it); None when
+        # tracing is off — every span record below then no-ops
+        self.trace = reqtrace.current_trace()
 
     def sig(self) -> Tuple:
         """Coalescing signature: only same-sig requests may share a
@@ -541,6 +547,8 @@ class ParallelInference:
         record_fault("serving")
         mark("slice_degraded", slice=self._slice_name(),
              error=type(err).__name__)
+        reqtrace.flight_trigger("slice_death", slice=self._slice_name(),
+                                error=type(err).__name__)
         self._publish_slice_gauges()
         typed = self._slice_error()
         if self._scheduler is not None:
@@ -1477,6 +1485,23 @@ class ParallelInference:
             off = 0
             now = time.perf_counter()
             for r in b.requests:
+                if r.trace is not None:
+                    # per-request engine attribution from timestamps the
+                    # path already takes: admission-queue wait, then the
+                    # device dispatch this batch rode (spans recorded
+                    # BEFORE the future resolves so the trace owner sees
+                    # them at completion)
+                    reqtrace.record_span(
+                        r.trace, "engine_queue",
+                        to_origin_us(r.t_submit),
+                        (t_disp - r.t_submit) * 1e6, replica=idx)
+                    reqtrace.record_span(
+                        r.trace, "engine_dispatch",
+                        to_origin_us(t_disp), (now - t_disp) * 1e6,
+                        replica=idx, rows=b.rows,
+                        batch=int(b.x.shape[0]),
+                        kind="generate" if b.payload is not None
+                        else "classify")
                 r.future.set_result(r.finish(y[off:off + r.n]))
                 off += r.n
                 lat.observe((now - r.t_submit) * 1e3)
@@ -1510,6 +1535,8 @@ class ParallelInference:
                          if i not in self._quarantined and i not in b.tried]
         self._quarantined_gauge().set(n_quarantined)
         mark("replica_quarantined", replica=idx, error=type(err).__name__)
+        reqtrace.flight_event("quarantine", replica=idx,
+                              error=type(err).__name__)
         b.tried.add(idx)
         if survivors and not self._stopping:
             self._bq.put(b)  # a surviving worker picks it up

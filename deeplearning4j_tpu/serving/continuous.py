@@ -85,6 +85,8 @@ from deeplearning4j_tpu.monitor import (
     record_fault,
     span,
 )
+from deeplearning4j_tpu.monitor import reqtrace
+from deeplearning4j_tpu.monitor.tracing import to_origin_us
 from deeplearning4j_tpu.datasets.iterators import bucket_for, bucket_sizes
 from deeplearning4j_tpu.nn.generate import (
     TransformerGenerator,
@@ -121,7 +123,7 @@ class _DecodeRequest:
     __slots__ = ("prompt", "n", "t_in", "max_new", "temperature", "top_k",
                  "top_p", "eos", "seed", "priority", "model", "version",
                  "session", "future", "rows_done", "t_submit", "t_first",
-                 "rows", "on_tokens", "prefix", "kv_state")
+                 "rows", "on_tokens", "prefix", "kv_state", "trace", "root")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float,
                  top_k: int, top_p: float, eos: Optional[int], seed: int,
@@ -152,6 +154,12 @@ class _DecodeRequest:
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
         self.rows: List["_Seq"] = []
+        # request-trace context captured at submit (router/worker
+        # installs it thread-locally); when absent AND tracing is on,
+        # the scheduler self-roots a trace so engine-level callers get
+        # the same TTFT decomposition the router-owned path does
+        self.trace = reqtrace.current_trace()
+        self.root = None
 
 
 class _Seq:
@@ -163,12 +171,14 @@ class _Seq:
     draws identical to an uninterrupted run."""
 
     __slots__ = ("req", "row", "fed", "generated", "key", "n_gen", "slot",
-                 "blocks", "pos", "seq_id", "preemptions", "emitted")
+                 "blocks", "pos", "seq_id", "preemptions", "emitted",
+                 "t_queued")
 
     def __init__(self, req: _DecodeRequest, row: int, key: np.ndarray,
                  seq_id: int):
         self.req = req
         self.row = row
+        self.t_queued = time.perf_counter()
         self.fed = req.prompt[row].astype(np.int32)
         self.generated: List[int] = []
         self.key = key
@@ -342,6 +352,9 @@ class ContinuousDecodeScheduler:
         self._on_fatal = on_fatal
         self._fatal: Optional[BaseException] = None
         self._kv_handoffs = 0
+        # (t0, dt_ms, slot bucket, tier, active rows) of the last
+        # accounted burst — consumed by _trace_burst right after
+        self._last_burst: Optional[Tuple] = None
         # burst row-bucket ladder: a burst dispatches the smallest slot
         # bucket covering the ACTIVE rows (compacted), so a half-empty
         # batch never pays full-slot compute — same doctrine as the
@@ -454,8 +467,11 @@ class ContinuousDecodeScheduler:
             req = _DecodeRequest(prompt, max_new, temperature, top_k, top_p,
                                  eos_token, seed, priority, model, version,
                                  session, on_tokens, pre)
+            self._trace_begin(req)
             with self._cv:
                 self._accepted += 1
+            reqtrace.finish_trace(req.root, outcome="short_circuit",
+                                  tokens=max_new)
             req.future.set_result(out)
             self._count_resolved()
             return req.future
@@ -467,6 +483,7 @@ class ContinuousDecodeScheduler:
         req = _DecodeRequest(prompt, max_new, temperature, top_k, top_p,
                              eos_token, seed, priority, model, version,
                              session, on_tokens, pre, kv_state)
+        self._trace_begin(req)
         keys = np.asarray(row_keys(req.seed, req.n))
         with self._cv:
             if len(self._queue) + req.n > self.queue_capacity:
@@ -716,6 +733,7 @@ class ContinuousDecodeScheduler:
                 self._burst_failed(lane, e)
                 progressed = True
                 continue
+            self._trace_burst(lane)
             self._retire(lane, outs)
             progressed = True
         self._gauges()
@@ -1008,6 +1026,7 @@ class ContinuousDecodeScheduler:
             top_p[i] = seq.req.top_p
         params = self._params(lane)
         pre = gen.prefill_program(t_blk)
+        t0p = time.perf_counter()
         fresh = note_dispatch(lane.net,
                               ("gen_prefill", "sched", rows, t_pad, t_blk))
         with span("compile" if fresh else "inference",
@@ -1019,6 +1038,10 @@ class ContinuousDecodeScheduler:
         rs = gen.row_sample_program()
         note_dispatch(lane.net, ("gen_row_sample", "sched", rows))
         toks = np.asarray(rs(logits, keys, folds, temp, top_k, top_p))
+        t1p = time.perf_counter()
+        self._trace_admitted(
+            [(seq, {"bucket": t_pad, "rows": n, "computed": len(seq.fed)})
+             for seq, _ in entries], t0p, t1p, "dense")
         for i, (seq, blocks) in enumerate(entries):
             self._note_prefilled(seq, len(seq.fed))
             cache = self._cache_of(lane)
@@ -1039,6 +1062,7 @@ class ContinuousDecodeScheduler:
         unchanged — a cached admission is still one admitted row."""
         gen, pool = lane.gen, lane.pool
         cache = self._cache_of(lane)
+        t0p = time.perf_counter()
         # (src, dst) pairs: dst is the fresh block standing in at the
         # partial's table index — start // block_size by construction
         copies = [(p.cow_src, p.blocks[p.start // self.block_size])
@@ -1095,6 +1119,12 @@ class ContinuousDecodeScheduler:
         rs = gen.row_sample_program()
         note_dispatch(lane.net, ("gen_row_sample", "sched", rows))
         toks = np.asarray(rs(logits, keys, folds, temp, top_k, top_p))
+        t1p = time.perf_counter()
+        self._trace_admitted(
+            [(p.seq, {"bucket": t_tail_pad, "tier": tier, "rows": n,
+                      "computed": len(p.seq.fed) - p.start,
+                      "cached": p.start}) for p in entries],
+            t0p, t1p, "tail")
         for i, p in enumerate(entries):
             self._note_prefilled(p.seq, len(p.seq.fed) - p.start)
             if cache is not None:
@@ -1114,6 +1144,7 @@ class ContinuousDecodeScheduler:
         the disaggregation win the ``dl4j_disagg_kv_handoffs_total``
         counter and the decode-p99 bench measure."""
         gen, pool = lane.gen, lane.pool
+        t0p = time.perf_counter()
         n = len(entries)
         rows = bucket_for(n, self._admit_ladder)
         nb = t_blk // self.block_size
@@ -1161,6 +1192,10 @@ class ContinuousDecodeScheduler:
             "shipped KV (zero prompt tokens recomputed)").inc(n)
         with self._lock:
             self._kv_handoffs += n
+        t1p = time.perf_counter()
+        self._trace_admitted(
+            [(p.seq, {"bucket": t_blk, "rows": n, "computed": 0})
+             for p in entries], t0p, t1p, "shipped")
         for i, p in enumerate(entries):
             self._note_prefilled(p.seq, 0)
             p.seq.req.kv_state = None  # one-shot: a preempt re-prefills
@@ -1201,6 +1236,62 @@ class ContinuousDecodeScheduler:
         if seq.req.prefix is not None:
             self._resume_reprefill_tokens += int(computed)
 
+    # ------------------------------------------------- request tracing
+
+    def _trace_begin(self, req: _DecodeRequest) -> None:
+        """Self-root a trace for engine-level callers (no ambient
+        context) so the TTFT decomposition exists with or without a
+        router in front; either way the owning trace id is surfaced on
+        the request's Future as ``trace_id``."""
+        if req.trace is None and reqtrace.request_tracer() is not None:
+            req.root = reqtrace.begin_trace(
+                "decode_request", rows=req.n, t_in=req.t_in,
+                max_new=req.max_new, resume=req.prefix is not None)
+            if req.root is not None:
+                req.trace = req.root.ctx
+        if req.trace is not None:
+            req.future.trace_id = req.trace.trace_id
+
+    def _trace_admitted(self, entries, t0: float, t1: float,
+                        kind: str) -> None:
+        """Record an admission group's queue-wait + prefill spans from
+        the batch dispatch's timestamps (no extra clock reads per row).
+        ``entries`` is ``[(seq, extra_attrs), ...]``; the group records
+        in TWO passes (all queue_waits, then all prefills) so a
+        multi-row request's spans stay close-order monotonic within
+        its own trace. A migration resume's re-prefill is the
+        distinctly-attributed span the durable-decode acceptance reads
+        (``resume=True``)."""
+        if reqtrace.request_tracer() is None:
+            return
+        for seq, _extra in entries:
+            reqtrace.record_span(
+                seq.req.trace, "queue_wait", to_origin_us(seq.t_queued),
+                (t0 - seq.t_queued) * 1e6, row=seq.row,
+                requeued=seq.preemptions)
+        for seq, extra in entries:
+            reqtrace.record_span(
+                seq.req.trace, "prefill", to_origin_us(t0),
+                (t1 - t0) * 1e6, kind=kind,
+                resume=seq.req.prefix is not None,
+                preemptions=seq.preemptions, **extra)
+
+    def _trace_burst(self, lane: _Lane) -> None:
+        """Attribute the just-dispatched burst to every traced rider:
+        one ``decode_burst`` span per active traced sequence carrying
+        the slot bucket / block tier the dispatch compiled against and
+        the live row count the cost was shared across."""
+        info = self._last_burst
+        self._last_burst = None
+        if info is None or reqtrace.request_tracer() is None:
+            return
+        t0, dt_ms, rows, tier, n_active = info
+        for seq in lane.active():
+            reqtrace.record_span(
+                seq.req.trace, "decode_burst", to_origin_us(t0),
+                dt_ms * 1e3, slot_bucket=rows, tier=tier,
+                k=self.burst_tokens, rows=n_active, seq=seq.seq_id)
+
     def _cache_insert(self, lane: _Lane, seq: _Seq) -> None:
         """Insert-on-retire (and on preempt — the victim's own resume
         then matches its cached prefix): pin the sequence's written
@@ -1232,10 +1323,18 @@ class ContinuousDecodeScheduler:
             STREAM_CHUNKS_COUNTER,
             "Incremental decode-token chunks emitted through the "
             "on_tokens streaming seam").inc()
+        traced = req.trace is not None and \
+            reqtrace.request_tracer() is not None
+        t0c = time.perf_counter() if traced else 0.0
         try:
             req.on_tokens(off, np.asarray(new, np.int64))
         except BaseException as e:
             mark("stream_callback_error", error=type(e).__name__)
+        if traced:
+            reqtrace.record_span(
+                req.trace, "chunk_deliver", to_origin_us(t0c),
+                (time.perf_counter() - t0c) * 1e6, offset=off,
+                n=len(new))
 
     def _install(self, lane: _Lane, seq: _Seq, blocks: List[int],
                  tok0: int) -> None:
@@ -1347,6 +1446,9 @@ class ContinuousDecodeScheduler:
              np.asarray(seq.generated, np.int32)])
         seq.slot = None
         seq.preemptions += 1
+        seq.t_queued = time.perf_counter()
+        reqtrace.trace_event(seq.req.trace, "preempt", seq=seq.seq_id,
+                             n_gen=seq.n_gen, priority=seq.priority)
         if slot is not None:
             lane.clear_slot(slot)
         with self._lock:
@@ -1469,6 +1571,9 @@ class ContinuousDecodeScheduler:
             reg.histogram(SCHED_BURST_LATENCY_HISTOGRAM,
                           "Decode burst dispatch latency (K steps, one "
                           "scan)").observe(dt_ms)
+            # host timestamps already taken — the per-rider trace spans
+            # are recorded post-hoc by _trace_burst, zero device syncs
+            self._last_burst = (t0, dt_ms, rows, tier, n)
             with self._lock:
                 self._bursts += 1
         # scatter the compact outputs back onto full-slot views
@@ -1568,10 +1673,15 @@ class ContinuousDecodeScheduler:
             padded[:len(row)] = row[:req.max_new]
             out[seq.row, req.t_in:] = padded
         t_done = time.perf_counter()
+        t_first = req.t_first if req.t_first is not None else t_done
         self.completed.append({
-            "t_submit": req.t_submit,
-            "t_first": req.t_first if req.t_first is not None else t_done,
+            "t_submit": req.t_submit, "t_first": t_first,
             "t_done": t_done, "rows": req.n, "tokens": tokens})
+        # engine-owned root: seal BEFORE resolving so a caller reading
+        # the completed trace on future completion always finds it
+        reqtrace.finish_trace(
+            req.root, outcome="ok", tokens=tokens,
+            ttft_ms=round((t_first - req.t_submit) * 1e3, 3))
         req.future.set_result(out)
         self._count_resolved()
 
@@ -1582,6 +1692,8 @@ class ContinuousDecodeScheduler:
         req = seq.req
         self.events.append(f"fail seq={seq.seq_id} err={type(err).__name__}")
         if not req.future.done():
+            reqtrace.finish_trace(req.root, outcome="error",
+                                  error=type(err).__name__)
             req.future.set_exception(err)
             self._count_resolved()
         # drop the request's other queued rows: the future already failed
@@ -1610,6 +1722,8 @@ class ContinuousDecodeScheduler:
         failed = set()
         for seq in queued:
             if seq.req not in failed and not seq.req.future.done():
+                reqtrace.finish_trace(seq.req.root, outcome="error",
+                                      error=type(err).__name__)
                 seq.req.future.set_exception(err)
                 failed.add(seq.req)
                 self._count_resolved()
@@ -1623,6 +1737,8 @@ class ContinuousDecodeScheduler:
                 lane.clear_slot(slot)
                 seq.slot = None
                 if seq.req not in failed and not seq.req.future.done():
+                    reqtrace.finish_trace(seq.req.root, outcome="error",
+                                          error=type(err).__name__)
                     seq.req.future.set_exception(err)
                     failed.add(seq.req)
                     self._count_resolved()

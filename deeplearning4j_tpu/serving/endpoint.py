@@ -33,6 +33,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.monitor import reqtrace
 from deeplearning4j_tpu.serving import wire
 from deeplearning4j_tpu.streaming.broker import MessageBroker
 
@@ -229,12 +230,17 @@ class RemoteEndpoint(EngineEndpoint):
         with self._lock:
             self._pending[corr] = _Pending(fut, deadline, timeout, on_tokens,
                                            tensors)
+        # propagate the caller's request-trace context across the wire
+        # (thread-local → optional header field; older workers ignore it)
+        tctx = reqtrace.current_trace()
         try:
             self._broker.publish(
                 self.service + wire.REQ_SUFFIX,
                 wire.pack_request(corr, self.reply_topic, kind, x, gen,
                                   model=model, version=version,
-                                  session=session))
+                                  session=session,
+                                  trace=None if tctx is None
+                                  else tctx.wire()))
         except BaseException as e:
             with self._lock:
                 self._pending.pop(corr, None)

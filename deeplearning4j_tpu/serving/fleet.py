@@ -321,6 +321,9 @@ class LocalFleet:
             "Serving slices rebuilt at a narrower width after a chip "
             "death (mesh-portable checkpoint restored onto survivors)",
             width=str(new_width)).inc()
+        from deeplearning4j_tpu.monitor.reqtrace import flight_event
+        flight_event("slice_rebuild", endpoint=name, width=new_width,
+                     survivors=len(survivors))
         logger.info("fleet: rebuilt %s as a %d-chip slice (%d survivors)",
                     name, new_width, len(survivors))
         return new_width

@@ -300,6 +300,8 @@ class ModelRegistry:
             MODEL_ROLLBACKS_COUNTER,
             "Model version rollbacks by reason",
             model=name, reason=reason).inc()
+        from deeplearning4j_tpu.monitor.reqtrace import flight_event
+        flight_event("rollback", model=name, reason=reason)
 
     # -------------------------------------------------------- membership
 

@@ -74,6 +74,10 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.monitor import (
+    REQ_PHASE_HISTOGRAM,
+    REQ_SLO_BURN_COUNTER,
+    REQ_TPOT_HISTOGRAM,
+    REQ_TTFT_HISTOGRAM,
     ROUTER_ENDPOINT_HEALTHY_GAUGE,
     ROUTER_FAILOVERS_COUNTER,
     ROUTER_HEDGES_COUNTER,
@@ -86,8 +90,11 @@ from deeplearning4j_tpu.monitor import (
     SESSION_MIGRATIONS_COUNTER,
     get_registry,
     mark,
+    phase_breakdown,
     record_fault,
+    reqtrace,
 )
+from deeplearning4j_tpu.monitor.tracing import to_origin_us
 from deeplearning4j_tpu.serving import wire
 from deeplearning4j_tpu.serving.endpoint import EndpointError, EngineEndpoint
 
@@ -166,7 +173,9 @@ class _Routed:
                  "attempts", "outstanding", "lock", "hedged", "session",
                  "priority", "timer", "per_try_timeout", "model", "version",
                  "on_tokens", "received", "epoch", "dups", "gaps", "late",
-                 "journal_dropped", "migrations", "prefix_key", "kv_state")
+                 "journal_dropped", "migrations", "prefix_key", "kv_state",
+                 "troot", "tctx", "deadline_ms", "t_first_chunk",
+                 "t_last_activity")
 
     def __init__(self, kind: str, x, gen, deadline: Optional[float],
                  priority: str, session: Optional[str],
@@ -204,6 +213,14 @@ class _Routed:
         # handoff state (rides every dispatch until a journaled prefix
         # supersedes it — both paths yield exact tokens)
         self.kv_state = None
+        # request trace: the root span minted at admission (this router
+        # owns its lifecycle) + per-stream progress timestamps for the
+        # TTFT/TPOT and silence-gap attribution
+        self.troot = None
+        self.tctx = None
+        self.deadline_ms: Optional[float] = None  # set by _route
+        self.t_first_chunk: Optional[float] = None
+        self.t_last_activity: Optional[float] = None
 
 
 class InferenceRouter:
@@ -402,6 +419,8 @@ class InferenceRouter:
             self._health_gauge(st.endpoint.name).set(0.0)
             mark("router_endpoint_wedged", endpoint=st.endpoint.name,
                  inflight=inflight)
+            reqtrace.flight_trigger("wedge", endpoint=st.endpoint.name,
+                                    inflight=inflight)
 
     def _note_success(self, st: _EndpointState, latency_ms: float,
                       model: Optional[str] = None) -> None:
@@ -444,6 +463,12 @@ class InferenceRouter:
             self._health_gauge(st.endpoint.name).set(0.0)
             mark("router_endpoint_ejected", endpoint=st.endpoint.name,
                  failures=st.consecutive_failures)
+            # ejection is a flight-recorder trigger: the ring of recent
+            # traces + events dumps as JSONL when a dump_dir is armed —
+            # the evidence an operator reads AFTER the endpoint is gone
+            reqtrace.flight_trigger("ejection",
+                                    endpoint=st.endpoint.name,
+                                    failures=st.consecutive_failures)
 
     def probe_now(self) -> None:
         """Collapse every ejection backoff: each ejected endpoint turns
@@ -512,9 +537,13 @@ class InferenceRouter:
     def _admit(self, deadline_ms: Optional[float], priority: str,
                session: Optional[str],
                model: Optional[str] = None,
-               prefix_key: Optional[Tuple] = None) -> _EndpointState:
-        """Pick the endpoint AND make the shed decision against it.
-        Raises :class:`RetryAfter` when nothing can serve in time."""
+               prefix_key: Optional[Tuple] = None
+               ) -> Tuple[_EndpointState, float, float]:
+        """Pick the endpoint AND make the shed decision against it;
+        returns ``(endpoint, est_wait_ms, est_total_ms)`` so the
+        admission span can record the decision WITH its estimate
+        inputs. Raises :class:`RetryAfter` when nothing can serve in
+        time."""
         now = time.monotonic()
         pool = self._pool(now)
         if not pool:
@@ -580,7 +609,7 @@ class InferenceRouter:
             # endpoint, and the version pin rides engine-side on the
             # same session key
             self._affinity[session] = (pick.endpoint.name, model)
-        return pick
+        return pick, wait_ms, total_ms
 
     def _note_migration(self, reason: str) -> None:
         self._reg().counter(
@@ -589,6 +618,7 @@ class InferenceRouter:
             "(or drained/wedged) and the router re-pinned it, resuming "
             "from the journaled prefix where possible",
             reason=reason).inc()
+        reqtrace.flight_event("migration", reason=reason)
 
     def _migration_reason(self, st: _EndpointState,
                           err: BaseException) -> str:
@@ -710,13 +740,49 @@ class InferenceRouter:
             ROUTER_REQUESTS_COUNTER, "Requests routed", **labels).inc()
         prefix_key = (self._prefix_key(x, model) if kind == "generate"
                       else None)
-        st = self._admit(deadline_ms, priority, session, model, prefix_key)
+        # the request trace is MINTED HERE, at router admission — the
+        # root span every hop's child spans (dispatch, wire, engine,
+        # scheduler) resolve back to, and the unit the flight recorder
+        # retains. Sampling decides once; an unsampled request carries
+        # a None context and every downstream record no-ops.
+        troot = reqtrace.begin_trace(
+            "request", kind=kind, priority=priority,
+            **{k: v for k, v in (("model", model), ("session", session))
+               if v is not None})
+        tctx = None if troot is None else troot.ctx
+        t_adm = time.perf_counter()
+        try:
+            st, est_wait, est_total = self._admit(
+                deadline_ms, priority, session, model, prefix_key)
+        except RetryAfter as e:
+            # the admission decision is recorded WITH its estimate
+            # inputs — a shed trace completes right here, attributing
+            # the rejection instead of silently vanishing
+            reqtrace.record_span(
+                tctx, "admission", to_origin_us(t_adm),
+                (time.perf_counter() - t_adm) * 1e6, decision="shed",
+                deadline_ms=deadline_ms,
+                retry_after_s=round(e.retry_after_s, 6))
+            self._slo_burn(model, "shed")
+            reqtrace.finish_trace(troot, outcome="shed")
+            raise
+        reqtrace.record_span(
+            tctx, "admission", to_origin_us(t_adm),
+            (time.perf_counter() - t_adm) * 1e6, decision="admitted",
+            endpoint=st.endpoint.name, est_wait_ms=round(est_wait, 3),
+            est_total_ms=round(est_total, 3), deadline_ms=deadline_ms,
+            headroom=PRIORITY_HEADROOM.get(priority, 1.0))
         rf = _Routed(kind, x, gen,
                      None if deadline_ms is None
                      else time.monotonic() + deadline_ms / 1e3,
                      priority, session, self.per_try_timeout,
                      model, version, on_tokens)
         rf.prefix_key = prefix_key
+        rf.troot, rf.tctx = troot, tctx
+        rf.deadline_ms = deadline_ms
+        if tctx is not None:
+            # surface the trace id to the caller (bench/debug lookup)
+            rf.future.trace_id = tctx.trace_id
         if on_tokens is not None:
             with self._lock:
                 self._streams.add(rf)
@@ -763,10 +829,15 @@ class InferenceRouter:
             pf.requests += 1
             pf.inflight += 1
         t0 = time.perf_counter()
+        hspan = reqtrace.start_span("prefill_hop", rf.tctx,
+                                    endpoint=pf.endpoint.name)
         try:
-            inner = pf.endpoint.submit_prefill(
-                rf.x, timeout_s=rf.per_try_timeout)
-        except BaseException:
+            with reqtrace.use_trace(None if hspan is None else hspan.ctx):
+                inner = pf.endpoint.submit_prefill(
+                    rf.x, timeout_s=rf.per_try_timeout)
+        except BaseException as e:
+            if hspan is not None:
+                hspan.close(outcome="error", error=type(e).__name__)
             self._note_failure(pf)
             self._dispatch(rf, st)
             return
@@ -774,12 +845,16 @@ class InferenceRouter:
         def _after(f: Future) -> None:
             err = f.exception()
             if err is None:
+                if hspan is not None:
+                    hspan.close(outcome="ok")
                 self._note_success(pf, (time.perf_counter() - t0) * 1e3)
                 with rf.lock:
                     rf.kv_state = f.result()
                 mark("router_disagg_handoff", prefill=pf.endpoint.name,
                      decode=st.endpoint.name)
             else:
+                if hspan is not None:
+                    hspan.close(outcome="error", error=type(err).__name__)
                 self._note_failure(pf)
             self._dispatch(rf, st)
         inner.add_done_callback(_after)
@@ -799,6 +874,7 @@ class InferenceRouter:
         resume_prefix = None
         with rf.lock:
             rf.attempts += 1
+            attempt = rf.attempts
             rf.outstanding += 1
             rf.tried.add(st.endpoint.name)
             if rf.on_tokens is not None:
@@ -829,6 +905,15 @@ class InferenceRouter:
                 "migrations (re-prefilled, not re-generated)"
             ).inc(len(resume_prefix))
         t_disp = time.perf_counter()
+        rf.t_last_activity = t_disp
+        # the dispatch span opens NOW (its id must exist before the
+        # endpoint call so engine/worker child spans can parent to it)
+        # and closes when this attempt's future resolves
+        dspan = reqtrace.start_span(
+            "dispatch", rf.tctx, endpoint=st.endpoint.name,
+            attempt=attempt, kind=rf.kind,
+            **({"resume_prefix": int(len(resume_prefix))}
+               if resume_prefix is not None else {}))
         # routing fields travel only when set, so single-model
         # endpoints (and minimal EngineEndpoint stubs) keep working
         route = {k: v for k, v in (("model", rf.model),
@@ -836,27 +921,27 @@ class InferenceRouter:
                                    ("session", rf.session))
                  if v is not None}
         try:
-            if rf.kind == "generate":
-                g = dict(rf.gen)
-                if rf.on_tokens is not None:
-                    g["on_tokens"] = (
-                        lambda off, toks, e=epoch:
-                        self._on_chunk(rf, e, off, toks))
-                if resume_prefix is not None:
-                    g["prefix"] = resume_prefix
-                elif rf.kv_state is not None:
-                    # shipped prompt KV: the decode endpoint admits the
-                    # session without recomputing the prompt (a
-                    # journaled-prefix resume supersedes it — both are
-                    # exact)
-                    g["kv_state"] = rf.kv_state
-                inner = st.endpoint.submit_generate(
-                    rf.x, g.pop("max_new_tokens"),
-                    timeout_s=rf.per_try_timeout, **route, **g)
-            else:
-                inner = st.endpoint.submit(rf.x,
-                                           timeout_s=rf.per_try_timeout,
-                                           **route)
+            with reqtrace.use_trace(None if dspan is None else dspan.ctx):
+                if rf.kind == "generate":
+                    g = dict(rf.gen)
+                    if rf.on_tokens is not None:
+                        g["on_tokens"] = (
+                            lambda off, toks, e=epoch:
+                            self._on_chunk(rf, e, off, toks))
+                    if resume_prefix is not None:
+                        g["prefix"] = resume_prefix
+                    elif rf.kv_state is not None:
+                        # shipped prompt KV: the decode endpoint admits
+                        # the session without recomputing the prompt (a
+                        # journaled-prefix resume supersedes it — both
+                        # are exact)
+                        g["kv_state"] = rf.kv_state
+                    inner = st.endpoint.submit_generate(
+                        rf.x, g.pop("max_new_tokens"),
+                        timeout_s=rf.per_try_timeout, **route, **g)
+                else:
+                    inner = st.endpoint.submit(
+                        rf.x, timeout_s=rf.per_try_timeout, **route)
         except BaseException as e:
             # submit itself failed (endpoint closed / backpressure /
             # model quarantine): resolve through the same failure path
@@ -867,7 +952,7 @@ class InferenceRouter:
                 e if isinstance(e, (EndpointError, RetryAfter))
                 or self._typed_engine_error(e) else EndpointError(str(e)))
         inner.add_done_callback(
-            lambda f: self._on_done(rf, st, f, t_disp))
+            lambda f: self._on_done(rf, st, f, t_disp, dspan))
 
     def _on_chunk(self, rf: _Routed, epoch: int, off: int, toks) -> None:
         """Journal + dedupe one incremental chunk, then deliver ONLY
@@ -881,6 +966,10 @@ class InferenceRouter:
             if epoch != rf.epoch or rf.future.done():
                 rf.late += len(toks)
                 return
+            now = time.perf_counter()
+            rf.t_last_activity = now
+            if rf.t_first_chunk is None:
+                rf.t_first_chunk = now  # TTFT as the caller saw it
             start = len(rf.received)
             for i, t in enumerate(toks.tolist()):
                 idx = int(off) + i
@@ -924,6 +1013,7 @@ class InferenceRouter:
             ROUTER_HEDGES_COUNTER,
             "Hedged duplicate dispatches (tail-latency)").inc()
         mark("router_hedge", endpoint=st.endpoint.name)
+        reqtrace.trace_event(rf.tctx, "hedge", endpoint=st.endpoint.name)
         self._dispatch(rf, st)
 
     def _pick_excluding(self, tried: set,
@@ -938,10 +1028,12 @@ class InferenceRouter:
                                          st.endpoint.name))
 
     def _on_done(self, rf: _Routed, st: _EndpointState, inner: Future,
-                 t_disp: float):
+                 t_disp: float, dspan=None):
         err = inner.exception()
         if err is None:
             now = time.perf_counter()
+            if dspan is not None:
+                dspan.close(outcome="ok")
             # the endpoint's EWMA tracks ITS dispatch→reply time only;
             # attributing the full request latency would pollute a
             # healthy endpoint's estimate with the timeout a dead
@@ -959,9 +1051,12 @@ class InferenceRouter:
                     ROUTER_LATENCY_HISTOGRAM,
                     "End-to-end submit→result latency through the "
                     "router").observe((now - rf.t0) * 1e3)
+                self._finish_request(rf, now)
                 self._stream_done(rf)
             return
         # failure: endpoint bookkeeping, then failover if budget allows
+        if dspan is not None:
+            dspan.close(outcome="error", error=type(err).__name__)
         self._note_failure(st)
         retry_to: Optional[_EndpointState] = None
         give_up = False
@@ -976,32 +1071,117 @@ class InferenceRouter:
             if retry_to is None and rf.outstanding == 0:
                 give_up = True
         if retry_to is not None:
+            t_detect = time.perf_counter()
+            is_stream = rf.on_tokens is not None or rf.session is not None
+            reason = None
+            if is_stream:
+                # this failover moves a decode stream: account the
+                # migration (the resume prefix rides in _dispatch), and
+                # attribute the SILENCE the stream just sat through —
+                # last delivered chunk (or the dispatch) → detection.
+                # This span is most of the migration token-gap.
+                reason = self._migration_reason(st, err)
+                rf.migrations += 1
+                self._note_migration(reason)
+                t_quiet = rf.t_last_activity if rf.t_last_activity \
+                    is not None else t_disp
+                reqtrace.record_span(
+                    rf.tctx, "silence_wait", to_origin_us(t_quiet),
+                    (t_detect - t_quiet) * 1e6, reason=reason,
+                    endpoint=st.endpoint.name,
+                    error=type(err).__name__)
+                mark("router_stream_migrated", frm=st.endpoint.name,
+                     to=retry_to.endpoint.name, reason=reason,
+                     prefix=len(rf.received))
             if rf.session is not None:
                 # the pinned endpoint failed: re-pin the session
                 self._affinity[rf.session] = (retry_to.endpoint.name,
                                               rf.model)
-            if rf.on_tokens is not None or rf.session is not None:
-                # this failover moves a decode stream: account the
-                # migration (the resume prefix rides in _dispatch)
-                reason = self._migration_reason(st, err)
-                rf.migrations += 1
-                self._note_migration(reason)
-                mark("router_stream_migrated", frm=st.endpoint.name,
-                     to=retry_to.endpoint.name, reason=reason,
-                     prefix=len(rf.received))
             self._reg().counter(
                 ROUTER_FAILOVERS_COUNTER,
                 "Requests re-dispatched to another endpoint after an "
                 "endpoint failure").inc()
             mark("router_failover", frm=st.endpoint.name,
                  to=retry_to.endpoint.name)
+            t_repin = time.perf_counter()
             self._dispatch(rf, retry_to)
+            if is_stream:
+                # the re-pin decision + resume re-submit, distinct from
+                # the silence it ends and the resume prefill that
+                # follows engine-side
+                reqtrace.record_span(
+                    rf.tctx, "repin", to_origin_us(t_repin),
+                    (time.perf_counter() - t_repin) * 1e6,
+                    frm=st.endpoint.name, to=retry_to.endpoint.name,
+                    reason=reason, prefix=len(rf.received))
+            else:
+                reqtrace.trace_event(rf.tctx, "failover",
+                                     frm=st.endpoint.name,
+                                     to=retry_to.endpoint.name)
         elif give_up:
             if rf.timer is not None:
                 rf.timer.cancel()
             if not rf.future.done():
+                self._finish_request(rf, time.perf_counter(), err)
                 rf.future.set_exception(err)
             self._stream_done(rf)
+
+    def _slo_burn(self, model: Optional[str], outcome: str) -> None:
+        """Tick the per-model SLO burn counter: ``missed`` + ``shed`` +
+        ``failed`` outcomes burn the error budget, ``met`` is the
+        denominator — burn rate = burned / total."""
+        self._reg().counter(
+            REQ_SLO_BURN_COUNTER,
+            "Per-model SLO outcomes (met / missed deadline / shed at "
+            "admission / failed) — missed+shed+failed burn the budget",
+            model=model if model is not None else "default",
+            outcome=outcome).inc()
+
+    def _finish_request(self, rf: _Routed, now: float,
+                        err: Optional[BaseException] = None) -> None:
+        """Request-level SLO attribution + trace completion: TTFT as
+        the CALLER observed it (first delivered chunk; terminal reply
+        for non-streams), TPOT across the delivered tokens, the
+        deadline verdict, and the sealed trace handed to the flight
+        recorder."""
+        total_ms = (now - rf.t0) * 1e3
+        with rf.lock:
+            t_first = rf.t_first_chunk
+            tokens = len(rf.received)
+        ttft_ms = ((t_first - rf.t0) * 1e3 if t_first is not None
+                   else total_ms)
+        reg = self._reg()
+        model = rf.model if rf.model is not None else "default"
+        reg.histogram(
+            REQ_TTFT_HISTOGRAM,
+            "Time to first token as the caller observed it (terminal "
+            "reply for non-streaming requests)",
+            model=model).observe(ttft_ms)
+        tpot_ms = None
+        if t_first is not None and tokens > 1:
+            tpot_ms = (now - t_first) * 1e3 / (tokens - 1)
+            reg.histogram(
+                REQ_TPOT_HISTOGRAM,
+                "Time per output token after the first (streamed "
+                "decode requests)", model=model).observe(tpot_ms)
+        if err is not None:
+            self._slo_burn(rf.model, "failed")
+        elif rf.deadline_ms is not None:
+            self._slo_burn(rf.model,
+                           "met" if total_ms <= rf.deadline_ms
+                           else "missed")
+        attrs = {"outcome": "error" if err is not None else "ok",
+                 "total_ms": round(total_ms, 3),
+                 "ttft_ms": round(ttft_ms, 3),
+                 "migrations": rf.migrations, "hedged": rf.hedged,
+                 "attempts": rf.attempts}
+        if tokens:
+            attrs["tokens"] = tokens
+        if tpot_ms is not None:
+            attrs["tpot_ms"] = round(tpot_ms, 3)
+        if err is not None:
+            attrs["error"] = type(err).__name__
+        reqtrace.finish_trace(rf.troot, **attrs)
 
     def _stream_done(self, rf: _Routed) -> None:
         if rf.on_tokens is None:
@@ -1079,6 +1259,29 @@ class InferenceRouter:
         with self._lock:
             active_streams = len(self._streams)
             journal_tokens = sum(len(rf.received) for rf in self._streams)
+        # SLO attribution derived from the request traces: burn
+        # outcomes per model, caller-observed TTFT tails, and the
+        # per-phase decomposition (what /healthz surfaces so "which
+        # phase ate the budget" is one HTTP GET away)
+        burn: Dict[str, Dict[str, int]] = {}
+        for labels, c in reg.family(REQ_SLO_BURN_COUNTER).items():
+            d = dict(labels)
+            burn.setdefault(d.get("model", "default"), {})[
+                d.get("outcome", "?")] = int(c.value)
+        ttft = {}
+        for labels, h in reg.family(REQ_TTFT_HISTOGRAM).items():
+            if h.count:
+                ttft[dict(labels).get("model", "default")] = {
+                    "count": int(h.count),
+                    "p50_ms": round(h.percentile(0.5), 3),
+                    "p99_ms": round(h.percentile(0.99), 3)}
+        slo = {
+            "burn": burn,
+            "burned": sum(v for d in burn.values()
+                          for o, v in d.items() if o != "met"),
+            "ttft_ms": ttft,
+            "phases": phase_breakdown(reg, name=REQ_PHASE_HISTOGRAM),
+        }
         return {
             "endpoints": eps,
             "healthy_endpoints": healthy,
@@ -1093,6 +1296,7 @@ class InferenceRouter:
                 reg.family_total(ROUTER_RESUME_PREFIX_COUNTER)),
             "p99_ms": (None if lat is None or lat.count == 0
                        else round(lat.percentile(0.99), 3)),
+            "slo": slo,
             "shed": int(reg.family_total(ROUTER_SHED_COUNTER)),
             "hedges": int(reg.family_total(ROUTER_HEDGES_COUNTER)),
             "failovers": int(reg.family_total(ROUTER_FAILOVERS_COUNTER)),

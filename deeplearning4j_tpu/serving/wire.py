@@ -8,9 +8,13 @@ router ↔ engine-worker channel is framed *inside* broker payloads:
   body (npz via ``streaming/serde.py`` — self-describing dtype+shape).
   The header carries the correlation id (``id``), the caller's private
   reply topic (``reply``), the request kind (``classify`` /
-  ``generate`` with its sampler params), and the multi-model routing
+  ``generate`` with its sampler params), the multi-model routing
   fields (``model`` / ``version`` / ``session`` — absent for a
-  single-model engine). Correlation ids make the channel safe for
+  single-model engine), and an optional propagated request-trace
+  context (``trace`` — ``monitor/reqtrace.py``; ignored by consumers
+  that predate it, no version bump needed: version-skew safe by the
+  same discipline as wire v2/v3). Correlation ids make the channel
+  safe for
   pipelining: replies may arrive out of order and the endpoint matches
   them back to futures by id, never by position.
 
@@ -118,7 +122,15 @@ def pack_request(corr_id: str, reply_topic: str, kind: str, x: np.ndarray,
                  gen: Optional[Dict[str, Any]] = None,
                  model: Optional[str] = None,
                  version: Optional[int] = None,
-                 session: Optional[str] = None) -> bytes:
+                 session: Optional[str] = None,
+                 trace: Optional[Dict[str, str]] = None) -> bytes:
+    """``trace`` is the OPTIONAL propagated request-trace context
+    (``monitor/reqtrace.py`` ``TraceContext.wire()``: ``{"id", "span"}``
+    strings). It rides the header WITHOUT a wire-version bump — the
+    same discipline as every other optional header field: a consumer
+    that predates it never reads the key, so a newer router tracing
+    against an older worker serves correctly (the merged trace is
+    merely gappy on that hop, never corrupt)."""
     header = {"id": corr_id, "reply": reply_topic, "kind": kind,
               "v": WIRE_VERSION}
     if gen is not None:
@@ -129,6 +141,8 @@ def pack_request(corr_id: str, reply_topic: str, kind: str, x: np.ndarray,
         header["version"] = int(version)
     if session is not None:
         header["session"] = session
+    if trace is not None:
+        header["trace"] = trace
     return pack_frame(header, ndarray_to_bytes(x))
 
 

@@ -31,6 +31,8 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.monitor import reqtrace
+from deeplearning4j_tpu.monitor.tracing import now_us
 from deeplearning4j_tpu.serving import wire
 from deeplearning4j_tpu.streaming.broker import MessageBroker
 
@@ -127,14 +129,25 @@ class EngineWorker:
             # single-model engine (whose submit() takes no model=)
             route = {k: header[k] for k in ("model", "version", "session")
                      if header.get(k) is not None}
+            # propagated request-trace context (optional header field —
+            # a worker that predates it never reads the key): installed
+            # thread-locally so the engine's submit path picks it up,
+            # plus one wire_ingress span marking the hop boundary
+            tctx = reqtrace.from_wire(header.get("trace"))
+            t_ingress = now_us()
             try:
                 if header.get("kind") == wire.KIND_PREFILL:
                     # disaggregated prefill: compute prompt KV + logits
                     # and ship them back — one tagged tensor chunk (kv)
                     # then the terminal reply (logits); the decode
                     # endpoint admits the session from the shipped state
-                    out = self.engine.prefill_export(
-                        x.astype(np.int32, copy=False))
+                    with reqtrace.use_trace(tctx):
+                        out = self.engine.prefill_export(
+                            x.astype(np.int32, copy=False))
+                    reqtrace.record_span(
+                        tctx, "wire_ingress", t_ingress,
+                        now_us() - t_ingress, kind=wire.KIND_PREFILL,
+                        worker=self.name)
                     self._reply(reply_topic, wire.pack_tensor_chunk(
                         corr, "kv", out["kv"]))
                     self._reply(reply_topic,
@@ -160,19 +173,26 @@ class EngineWorker:
                         kwargs["on_tokens"] = (
                             lambda off, toks, c=corr, rt=reply_topic:
                             self._reply(rt, wire.pack_chunk(c, off, toks)))
-                    fut = self.engine.submit_generate(
-                        x.astype(np.int32, copy=False), g.get("max_new", 1),
-                        temperature=g.get("temperature", 0.0),
-                        top_k=g.get("top_k", 0), top_p=g.get("top_p", 0.0),
-                        eos_token=g.get("eos_token"),
-                        seed=g.get("seed", 0), **kwargs)
+                    with reqtrace.use_trace(tctx):
+                        fut = self.engine.submit_generate(
+                            x.astype(np.int32, copy=False),
+                            g.get("max_new", 1),
+                            temperature=g.get("temperature", 0.0),
+                            top_k=g.get("top_k", 0),
+                            top_p=g.get("top_p", 0.0),
+                            eos_token=g.get("eos_token"),
+                            seed=g.get("seed", 0), **kwargs)
                 else:
-                    fut = self.engine.submit(x, **route)
+                    with reqtrace.use_trace(tctx):
+                        fut = self.engine.submit(x, **route)
             except BaseException as e:
                 # typed: the caller's endpoint reconstructs the same
                 # exception class (shed/quarantine isolation contract)
                 self._reply(reply_topic, wire.pack_reply(corr, error=e))
                 continue
+            reqtrace.record_span(
+                tctx, "wire_ingress", t_ingress, now_us() - t_ingress,
+                kind=header.get("kind"), worker=self.name)
             fut.add_done_callback(
                 lambda f, c=corr, rt=reply_topic: self._deliver(c, rt, f))
 

@@ -95,6 +95,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._healthz_live()
             if parts == ["healthz", "ready"]:
                 return self._healthz_ready()
+            if parts == ["debug", "traces"]:
+                return self._debug_traces()
             if parts[0] == "train" and len(parts) == 2:
                 return self._html(render_html(self.storage, parts[1], worker))
             if parts[0] == "api":
@@ -224,6 +226,17 @@ class _Handler(BaseHTTPRequestHandler):
         body["live"] = True
         body["ready"] = not degraded and not unwarmed
         return body, degraded, unwarmed
+
+    def _debug_traces(self):
+        """The flight recorder's rings as JSONL (one record per line:
+        flight events first, then recent completed request traces) —
+        the live seam behind the on-ejection dump files, served so an
+        operator can pull the evidence WITHOUT shelling into the box.
+        ``scripts/check_telemetry_schema.py`` validates the format."""
+        from deeplearning4j_tpu.monitor.reqtrace import flight_recorder
+        lines = [json.dumps(rec) for rec in flight_recorder().records()]
+        return self._send(200, ("\n".join(lines) + "\n").encode(),
+                          "application/x-ndjson")
 
     def _healthz(self):
         body, degraded, _ = self._health_body()

@@ -6,6 +6,11 @@ silently breaks every downstream consumer — Perfetto, Prometheus
 scrapers, BENCH attribution):
 
 - JSONL event streams (``monitor.enable_tracing(jsonl_path=...)``)
+- request-trace JSONL (``monitor/reqtrace.py`` flight-recorder dumps /
+  ``UiServer /debug/traces``): span records whose parent edges must
+  resolve, one root per trace, per-process monotonic timestamps —
+  plus :func:`validate_migration_coverage`, the durable-decode bar
+  that a migrated stream's token-gap is fully attributed by spans
 - Chrome ``trace_event`` JSON exports (``PhaseTracer.chrome_trace``)
 - Prometheus text exposition (``MetricsRegistry.prometheus_text`` /
   ``UiServer /metrics``)
@@ -89,6 +94,239 @@ def validate_events_lines(lines: Iterable[str],
 def validate_events_file(path: str) -> List[str]:
     with open(path) as f:
         return validate_events_lines(f, path)
+
+
+# ------------------------------------------- request traces (reqtrace)
+
+# monitor/reqtrace.py span records: the cross-process request-trace
+# JSONL (flight-recorder dumps, UiServer /debug/traces). One record
+# per span; parent edges must RESOLVE inside the merged trace.
+REQSPAN_KEYS = {"type": str, "trace": str, "span": str, "name": str,
+                "ts_us": (int, float), "dur_us": (int, float),
+                "pid": int, "tid": int}
+REQSPAN_OPTIONAL = {"attrs": dict}
+FLIGHT_EVENT_KEYS = {"type": str, "kind": str, "ts_us": (int, float),
+                     "pid": int}
+
+
+def validate_reqspan(obj: Any, where: str = "reqspan") -> List[str]:
+    errors: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: not a JSON object"]
+    if obj.get("type") != "reqspan":
+        return [f"{where}: type {obj.get('type')!r} != 'reqspan'"]
+    for key, types in REQSPAN_KEYS.items():
+        if key not in obj:
+            errors.append(f"{where}: missing required key {key!r}")
+        elif not isinstance(obj[key], types):
+            errors.append(f"{where}: key {key!r} has type "
+                          f"{type(obj[key]).__name__}")
+    if "parent" not in obj:
+        errors.append(f"{where}: missing required key 'parent'")
+    elif obj["parent"] is not None and not isinstance(obj["parent"], str):
+        errors.append(f"{where}: parent must be a span id or null")
+    for key in obj:
+        if key not in REQSPAN_KEYS and key != "parent" \
+                and key not in REQSPAN_OPTIONAL:
+            errors.append(f"{where}: unknown key {key!r}")
+    if not errors:
+        if not obj["name"]:
+            errors.append(f"{where}: empty name")
+        if obj["ts_us"] < 0:
+            errors.append(f"{where}: negative ts_us")
+        if obj["dur_us"] < 0:
+            errors.append(f"{where}: negative dur_us")
+    return errors
+
+
+def validate_trace_spans(spans: List[Any], where: str = "trace",
+                         require_single_root: bool = True) -> List[str]:
+    """Structural validity of ONE merged request trace: every span
+    record well-formed, span ids unique, every parent edge resolves
+    (no orphan spans), exactly one root, and per-(pid, tid) record
+    order monotonic in span END time — a process whose clock ran
+    backwards (or a buggy producer recording out of order) fails here,
+    while cross-process clock skew (different origins) does not."""
+    errors: List[str] = []
+    for i, s in enumerate(spans):
+        errors.extend(validate_reqspan(s, f"{where}[{i}]"))
+    if errors:
+        return errors
+    if not spans:
+        return [f"{where}: empty trace (no spans)"]
+    traces = {s["trace"] for s in spans}
+    if len(traces) != 1:
+        errors.append(f"{where}: spans from {len(traces)} trace ids "
+                      f"in one trace")
+    ids = [s["span"] for s in spans]
+    if len(set(ids)) != len(ids):
+        errors.append(f"{where}: duplicate span ids")
+    known = set(ids)
+    roots = 0
+    for i, s in enumerate(spans):
+        if s["parent"] is None:
+            roots += 1
+        elif s["parent"] not in known:
+            errors.append(f"{where}[{i}]: orphan span {s['span']!r} "
+                          f"({s['name']}): parent {s['parent']!r} does "
+                          f"not resolve")
+    if require_single_root and roots != 1:
+        errors.append(f"{where}: {roots} root spans (want exactly 1)")
+    # per-process monotonicity: records land in close order, so within
+    # one (pid, tid) the END timestamps must be non-decreasing in list
+    # order (1us slack for the 3-decimal rounding)
+    last_end: Dict[tuple, float] = {}
+    for i, s in enumerate(spans):
+        key = (s["pid"], s["tid"])
+        end = s["ts_us"] + s["dur_us"]
+        prev = last_end.get(key)
+        if prev is not None and end < prev - 1.0:
+            errors.append(
+                f"{where}[{i}]: non-monotonic timestamps in pid "
+                f"{s['pid']}/tid {s['tid']}: span {s['name']} ends at "
+                f"{end:.1f}us after a record ending {prev:.1f}us")
+        last_end[key] = max(prev or 0.0, end)
+    return errors
+
+
+def validate_migration_coverage(spans: List[Dict[str, Any]],
+                                where: str = "trace",
+                                tol_us: float = 5e3) -> List[str]:
+    """The durable-decode acceptance bar, checked on ONE migrated
+    stream's merged trace: the migration token-gap must be fully
+    attributed — a ``silence_wait`` span (last chunk → failure
+    detection), a ``repin`` span (re-pin + resume re-submit), a resume
+    ``dispatch`` carrying the journaled prefix, the resume re-prefill
+    (``prefill`` span with ``resume: true``), and a first post-resume
+    ``decode_burst`` — and those spans must TILE the interval from
+    silence start to the end of the resume prefill with no hole larger
+    than ``tol_us``."""
+    errors: List[str] = []
+    by = lambda n: [s for s in spans if s["name"] == n]
+    sw, rp = by("silence_wait"), by("repin")
+    resume_pre = [s for s in by("prefill")
+                  if (s.get("attrs") or {}).get("resume")]
+    disp = by("dispatch")
+    resume_disp = [s for s in disp
+                   if (s.get("attrs") or {}).get("resume_prefix")]
+    if not sw:
+        errors.append(f"{where}: migrated stream has no silence_wait span")
+    if not rp:
+        errors.append(f"{where}: no repin span")
+    if len(disp) < 2:
+        errors.append(f"{where}: fewer than 2 dispatch spans for a "
+                      f"migrated stream")
+    if not resume_disp:
+        errors.append(f"{where}: no dispatch carrying a resume prefix")
+    if not resume_pre:
+        errors.append(f"{where}: resume re-prefill not attributed "
+                      f"(no prefill span with resume=true)")
+    if not errors:
+        t_rp = max(s["ts_us"] for s in rp)
+        bursts_after = [s for s in by("decode_burst")
+                        if s["ts_us"] >= t_rp - 1.0]
+        if not bursts_after:
+            errors.append(f"{where}: no decode_burst span after the "
+                          f"resume (first resumed burst unattributed)")
+    if errors:
+        return errors
+    # gap coverage (one merged clock): from silence start to the end of
+    # the resume re-prefill, the migration machinery's spans must tile
+    # the interval — any hole is unattributed token-gap time
+    t0 = min(s["ts_us"] for s in sw)
+    t1 = max(s["ts_us"] + s["dur_us"] for s in resume_pre)
+    segs = sorted(
+        (s["ts_us"], s["ts_us"] + s["dur_us"]) for s in spans
+        if s["name"] in ("silence_wait", "repin", "dispatch",
+                         "queue_wait", "prefill", "decode_burst"))
+    cover = t0
+    for a, b in segs:
+        if b <= cover:
+            continue
+        if a > cover + tol_us:
+            errors.append(
+                f"{where}: migration gap hole "
+                f"{cover:.0f}..{a:.0f}us uncovered by spans")
+            return errors
+        cover = max(cover, b)
+        if cover >= t1:
+            break
+    if cover < t1 - tol_us:
+        errors.append(f"{where}: migration gap uncovered after "
+                      f"{cover:.0f}us (resume prefill ends {t1:.0f}us)")
+    return errors
+
+
+def validate_flight_lines(lines: Iterable[str],
+                          where: str = "flight") -> List[str]:
+    """Validate a flight-recorder JSONL dump (or UiServer
+    /debug/traces body): ``flight_event`` records, ``trace`` records
+    (each embedded span list fully validated), and bare ``reqspan``
+    streams."""
+    errors: List[str] = []
+    n = 0
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        n += 1
+        w = f"{where}:{i}"
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            errors.append(f"{w}: invalid JSON: {e}")
+            continue
+        if not isinstance(obj, dict):
+            errors.append(f"{w}: not a JSON object")
+            continue
+        t = obj.get("type")
+        if t == "flight_event":
+            for key, types in FLIGHT_EVENT_KEYS.items():
+                if key not in obj:
+                    errors.append(f"{w}: missing required key {key!r}")
+                elif not isinstance(obj[key], types):
+                    errors.append(f"{w}: key {key!r} has type "
+                                  f"{type(obj[key]).__name__}")
+        elif t == "trace":
+            for key in ("trace", "root", "name", "spans"):
+                if key not in obj:
+                    errors.append(f"{w}: missing required key {key!r}")
+            if isinstance(obj.get("spans"), list):
+                errors.extend(validate_trace_spans(obj["spans"], w))
+            else:
+                errors.append(f"{w}: spans is not an array")
+        elif t == "reqspan":
+            errors.extend(validate_reqspan(obj, w))
+        else:
+            errors.append(f"{w}: unknown record type {t!r}")
+    if n == 0:
+        errors.append(f"{where}: no records (empty stream)")
+    return errors
+
+
+def validate_flight_file(path: str) -> List[str]:
+    with open(path) as f:
+        return validate_flight_lines(f, path)
+
+
+def validate_jsonl_file(path: str) -> List[str]:
+    """Sniff a .jsonl file: flight-recorder / reqtrace records get the
+    request-trace validation, everything else the PhaseTracer event
+    schema."""
+    with open(path) as f:
+        lines = f.readlines()
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            t = json.loads(line).get("type")
+        except Exception:
+            break
+        if t in ("reqspan", "flight_event", "trace"):
+            return validate_flight_lines(lines, path)
+        break
+    return validate_events_lines(lines, path)
 
 
 # ------------------------------------------------------ Chrome trace JSON
@@ -224,6 +462,20 @@ KNOWN_DL4J_METRICS = {
     "dl4j_router_queue_wait_ms",
     "dl4j_router_latency_ms",
     "dl4j_router_endpoint_healthy",
+    # end-to-end request tracing + SLO attribution
+    # (monitor/reqtrace.py): per-request phase decomposition, TTFT /
+    # TPOT as the caller observed them, per-model SLO burn outcomes,
+    # span volume / bounded-buffer drops / open-trace gauge, and
+    # flight-recorder triggers (each dumps the trace+event rings as
+    # JSONL when a dump dir is armed)
+    "dl4j_req_phase_ms",
+    "dl4j_req_ttft_ms",
+    "dl4j_req_tpot_ms",
+    "dl4j_req_slo_burn_total",
+    "dl4j_trace_spans_total",
+    "dl4j_trace_dropped_total",
+    "dl4j_trace_active",
+    "dl4j_trace_flight_dumps_total",
     # durable decode streams (chunked token deltas, session journals,
     # cross-engine migration resume): chunks emitted by the decode
     # plane, migrations by reason, live journal bytes, and the resume
@@ -389,7 +641,7 @@ def main(argv=None) -> int:
     errors: List[str] = []
     for path in args.paths:
         if path.endswith(".jsonl"):
-            errors.extend(validate_events_file(path))
+            errors.extend(validate_jsonl_file(path))
         else:
             errors.extend(validate_chrome_trace_file(path))
     for path in args.metrics:

@@ -60,6 +60,12 @@ def test_composed_chaos_drill_invariants(fresh_registry):
     assert out["dup_offsets"] == 0 and out["gap_events"] == 0, out
     assert out["leaked_blocks"] == 0, out
     assert out["healthy_endpoints"] == 3, out
+    # request-trace invariants (ISSUE 13): every delivered stream's
+    # merged trace is parent-complete, and a resumed migration's gap
+    # is fully attributed (silence_wait / repin / resume prefill /
+    # first resumed burst) — violations counted by the extended
+    # schema checker inside the drill
+    assert out["trace_violations"] == 0, out
     # the schedule recorded in the summary is the seeded one
     assert out["schedule"] == ChaosSchedule(0, n_events=3,
                                             n_endpoints=3).signature()

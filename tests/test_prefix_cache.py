@@ -433,17 +433,19 @@ def test_router_prefix_affinity_tiebreak(rng):
     prompt = rng.integers(0, VOCAB, (1, 6))
     key = router._prefix_key(prompt, None)
     assert key is not None
+    # _admit returns (endpoint, est_wait_ms, est_total_ms) so the
+    # admission span can record its estimate inputs (ISSUE 13)
     # cold tie: stable name order
     assert router._admit(None, "interactive", None, None,
-                         key).endpoint.name == "a"
+                         key)[0].endpoint.name == "a"
     # b holds the prefix now: the tie breaks toward the warm cache
     router._note_prefix_owner(key, "b")
     assert router._admit(None, "interactive", None, None,
-                         key).endpoint.name == "b"
+                         key)[0].endpoint.name == "b"
     # a different prompt: no owner, back to name order
     other = router._prefix_key(rng.integers(0, VOCAB, (1, 6)) + 100, None)
     assert router._admit(None, "interactive", None, None,
-                         other).endpoint.name == "a"
+                         other)[0].endpoint.name == "a"
     router.close()
 
 

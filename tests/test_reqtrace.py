@@ -1,0 +1,547 @@
+"""End-to-end request tracing + SLO attribution (ISSUE 13).
+
+The battery: the tracer primitives (bounded buffers, sampling, flight
+recorder), the schema checker's new trace validation (parent
+completeness, per-process monotonicity, migration-gap coverage), trace
+propagation through every serving layer — local engine, continuous
+scheduler, the full router → wire → EngineWorker → scheduler path —
+plus the two skew contracts (an untraced/older hop ignores the
+``trace`` header and serves correctly; the merged trace degrades to
+gappy, never corrupt), the flight-recorder dump firing on endpoint
+ejection, the SLO burn counters riding ``fleet_snapshot()``, and the
+metric-name AST lint (every in-tree ``dl4j_*`` literal pinned).
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.request
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import reqtrace
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+if not os.path.isdir(_SCRIPTS):  # package layout: repo root on path
+    _SCRIPTS = os.path.join(os.getcwd(), "scripts")
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name + "_reqtrace_test", os.path.join(_SCRIPTS, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+schema = _load_script("check_telemetry_schema")
+
+
+@pytest.fixture
+def fresh_registry():
+    prev = monitor.set_registry(monitor.MetricsRegistry())
+    yield monitor.get_registry()
+    monitor.set_registry(prev)
+
+
+@pytest.fixture
+def tracer(fresh_registry):
+    prev = reqtrace.request_tracer()
+    t = reqtrace.enable_request_tracing()
+    yield t
+    reqtrace.set_request_tracer(prev)
+
+
+def _tiny_gpt(vocab=16):
+    from deeplearning4j_tpu.models.zoo.transformer import gpt
+    return gpt(vocab_size=vocab, d_model=16, n_layers=2, num_heads=2,
+               max_len=32, compute_dtype="float32", learning_rate=0.01,
+               seed=0).init()
+
+
+def _clf_net():
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.05)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ------------------------------------------------------ tracer primitives
+
+def test_tracer_spans_parents_and_completion(tracer):
+    root = tracer.begin_trace("request", kind="test")
+    assert root is not None
+    child = tracer.start_span("dispatch", root.ctx, endpoint="e0")
+    grand = tracer.record_span(child.ctx, "engine_queue", 10.0, 5.0)
+    assert grand.trace_id == root.ctx.trace_id
+    child.close(outcome="ok")
+    tracer.event(root.ctx, "hedge")
+    spans = tracer.finish_trace(root, outcome="ok")
+    entry = tracer.completed_trace(root.ctx.trace_id)
+    assert entry is not None and entry["spans"] == spans
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["engine_queue"]["parent"] == child.ctx.span_id
+    assert by_name["dispatch"]["parent"] == root.ctx.span_id
+    assert by_name["request"]["parent"] is None
+    assert by_name["hedge"]["dur_us"] == 0.0
+    assert schema.validate_trace_spans(spans) == []
+    # every span also fed the phase histogram (the SLO half)
+    reg = monitor.get_registry()
+    assert reg.get(monitor.REQ_PHASE_HISTOGRAM, phase="dispatch").count == 1
+
+
+def test_tracer_sampling_and_bounds(fresh_registry):
+    t = reqtrace.RequestTracer(sample=0.0)
+    assert t.begin_trace() is None
+    t = reqtrace.RequestTracer(sample=0.5)
+    kept = sum(t.begin_trace() is not None for _ in range(200))
+    assert 80 <= kept <= 120  # low-discrepancy ≈ the rate
+    # span cap: the buffer never outgrows max_spans_per_trace
+    t = reqtrace.RequestTracer(max_spans_per_trace=8)
+    root = t.begin_trace()
+    for _ in range(50):
+        t.record_span(root.ctx, "x", 0.0, 1.0)
+    spans = t.finish_trace(root)
+    assert len(spans) == 8
+    assert t.dropped >= 42
+
+
+def test_wire_context_roundtrip():
+    ctx = reqtrace.TraceContext("t1", "s1")
+    assert reqtrace.from_wire(ctx.wire()).trace_id == "t1"
+    assert reqtrace.from_wire(None) is None
+    assert reqtrace.from_wire({"id": 3}) is None  # malformed: ignored
+
+
+def test_use_trace_thread_local(tracer):
+    ctx = reqtrace.TraceContext("t1", "s1")
+    assert reqtrace.current_trace() is None
+    with reqtrace.use_trace(ctx):
+        assert reqtrace.current_trace() is ctx
+        seen = []
+        th = threading.Thread(
+            target=lambda: seen.append(reqtrace.current_trace()))
+        th.start()
+        th.join()
+        assert seen == [None]  # contexts do not leak across threads
+    assert reqtrace.current_trace() is None
+
+
+# ------------------------------------------------- schema checker rules
+
+def _span(trace="t1", span="1-1", parent=None, name="request", ts=0.0,
+          dur=10.0, pid=1, tid=1, **attrs):
+    rec = {"type": "reqspan", "trace": trace, "span": span,
+           "parent": parent, "name": name, "ts_us": ts, "dur_us": dur,
+           "pid": pid, "tid": tid}
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+def test_schema_checker_catches_corrupt_traces():
+    ok = [_span(), _span(span="1-2", parent="1-1", name="dispatch")]
+    assert schema.validate_trace_spans(ok) == []
+    orphan = [_span(), _span(span="1-2", parent="1-99", name="dispatch")]
+    assert any("orphan" in e for e in schema.validate_trace_spans(orphan))
+    two_roots = [_span(), _span(span="1-2", name="dispatch")]
+    assert any("root" in e for e in schema.validate_trace_spans(two_roots))
+    backwards = [_span(ts=100.0, dur=50.0),
+                 _span(span="1-2", parent="1-1", name="dispatch",
+                       ts=10.0, dur=5.0)]
+    assert any("non-monotonic" in e
+               for e in schema.validate_trace_spans(backwards))
+    # cross-process skew is NOT an error (different clock origins)
+    cross = [_span(ts=100.0, dur=50.0),
+             _span(span="2-1", parent="1-1", name="wire_ingress",
+                   ts=10.0, dur=5.0, pid=2)]
+    assert schema.validate_trace_spans(cross) == []
+
+
+def test_schema_checker_migration_coverage():
+    t0 = 1000.0
+    good = [
+        _span(ts=0.0, dur=9000.0),
+        _span(span="1-2", parent="1-1", name="dispatch", ts=10.0,
+              dur=900.0),
+        _span(span="1-3", parent="1-1", name="silence_wait", ts=t0,
+              dur=2000.0, reason="timeout"),
+        _span(span="1-4", parent="1-1", name="repin", ts=t0 + 2000.0,
+              dur=500.0),
+        _span(span="1-5", parent="1-1", name="dispatch",
+              ts=t0 + 2100.0, dur=5000.0, resume_prefix=7),
+        _span(span="1-6", parent="1-5", name="prefill",
+              ts=t0 + 3000.0, dur=800.0, resume=True),
+        _span(span="1-7", parent="1-5", name="decode_burst",
+              ts=t0 + 4000.0, dur=400.0),
+    ]
+    assert schema.validate_migration_coverage(good) == []
+    no_silence = [s for s in good if s["name"] != "silence_wait"]
+    assert any("silence_wait" in e
+               for e in schema.validate_migration_coverage(no_silence))
+    # a HOLE between silence end and the resume machinery is flagged:
+    # the silence span ends 1.9ms before the repin starts and nothing
+    # covers the interval
+    holey = [dict(s) for s in good]
+    holey[2] = dict(holey[2], dur_us=100.0)  # silence ends early
+    assert any("hole" in e
+               for e in schema.validate_migration_coverage(
+                   holey, tol_us=500.0))
+
+
+def test_flight_dump_jsonl_schema(tmp_path, tracer):
+    fr = reqtrace.configure_flight_recorder(dump_dir=str(tmp_path))
+    root = tracer.begin_trace("request", kind="test")
+    tracer.record_span(root.ctx, "dispatch", 0.0, 5.0, endpoint="e0")
+    tracer.finish_trace(root, outcome="ok")
+    fr.note_event("ejection", endpoint="e0")
+    path = fr.trigger("ejection", endpoint="e0")
+    assert path is not None and os.path.exists(path)
+    assert schema.validate_flight_file(path) == []
+    # the sniffing entry point routes .jsonl flight dumps correctly
+    assert schema.validate_jsonl_file(path) == []
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    kinds = {r.get("kind") for r in lines if r["type"] == "flight_event"}
+    assert "ejection" in kinds and "trigger" in kinds
+    assert any(r["type"] == "trace" for r in lines)
+
+
+# -------------------------------------------------- engine-level traces
+
+def test_engine_classify_spans_under_ambient_context(tracer):
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    net = _clf_net()
+    eng = ParallelInference(net, replicas=1)
+    try:
+        root = tracer.begin_trace("request", kind="classify")
+        with reqtrace.use_trace(root.ctx):
+            fut = eng.submit(np.zeros((1, 4), np.float32))
+        fut.result(30)
+        spans = tracer.finish_trace(root)
+        names = [s["name"] for s in spans]
+        assert "engine_queue" in names and "engine_dispatch" in names
+        assert schema.validate_trace_spans(spans) == []
+    finally:
+        eng.shutdown()
+
+
+def test_continuous_scheduler_self_roots_and_decomposes(tracer):
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    eng = ParallelInference(_tiny_gpt(), replicas=1, continuous=True,
+                            decode_slots=4, decode_burst=4,
+                            kv_block_size=4)
+    try:
+        chunks = []
+        fut = eng.submit_generate(
+            np.arange(1, 6)[None], 8,
+            on_tokens=lambda off, t: chunks.append((off, list(t))))
+        fut.result(60)
+        tid = fut.trace_id
+        entry = tracer.completed_trace(tid)
+        assert entry is not None
+        names = [s["name"] for s in entry["spans"]]
+        for want in ("queue_wait", "prefill", "decode_burst",
+                     "chunk_deliver", "decode_request"):
+            assert want in names, names
+        assert schema.validate_trace_spans(entry["spans"]) == []
+        assert entry["attrs"]["outcome"] == "ok"
+        assert entry["attrs"]["ttft_ms"] > 0
+        # burst spans carry the ladder attributes the issue pins
+        burst = next(s for s in entry["spans"]
+                     if s["name"] == "decode_burst")
+        assert "slot_bucket" in burst["attrs"] and "tier" in burst["attrs"]
+    finally:
+        eng.shutdown()
+
+
+def test_multi_row_request_trace_stays_monotonic(tracer):
+    """Both rows of one request share one trace; the scheduler's
+    two-pass admission recording keeps the span stream close-order
+    monotonic (the per-process rule the schema checker enforces)."""
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    eng = ParallelInference(_tiny_gpt(), replicas=1, continuous=True,
+                            decode_slots=4, decode_burst=4,
+                            kv_block_size=4)
+    try:
+        prompt = np.tile(np.arange(1, 6)[None], (2, 1))
+        fut = eng.submit_generate(prompt, 6)
+        fut.result(60)
+        entry = tracer.completed_trace(fut.trace_id)
+        assert entry is not None
+        assert schema.validate_trace_spans(entry["spans"]) == []
+        rows = {(s.get("attrs") or {}).get("row")
+                for s in entry["spans"] if s["name"] == "queue_wait"}
+        assert rows == {0, 1}
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------- router + wire, end to end
+
+def _fleet(engine_factory, **router_kw):
+    from deeplearning4j_tpu.serving import InferenceRouter, LocalFleet
+    router = InferenceRouter(per_try_timeout_s=5.0, eject_backoff_s=0.2,
+                             **router_kw)
+    fleet = LocalFleet(engine_factory, router=router, heartbeat_s=0.05,
+                       request_timeout_s=5.0, heartbeat_timeout_s=0.5)
+    return router, fleet
+
+
+def test_router_wire_trace_merges_across_hops(tracer):
+    """The full path — router admission → wire header → EngineWorker →
+    continuous scheduler — yields ONE merged parent-complete trace with
+    the admission decision, the dispatch, the wire hop and the
+    engine-side decomposition all present."""
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    def factory():
+        return ParallelInference(_tiny_gpt(), replicas=1,
+                                 continuous=True, decode_slots=4,
+                                 decode_burst=4, kv_block_size=4)
+
+    router, fleet = _fleet(factory)
+    try:
+        fleet.add_endpoint()
+        assert fleet.wait_ready(30)
+        toks = []
+        fut = router.submit_generate(
+            np.arange(1, 6)[None], 6, session="s0",
+            on_tokens=lambda off, t: toks.append(list(t)))
+        fut.result(60)
+        entry = tracer.completed_trace(fut.trace_id)
+        assert entry is not None
+        spans = entry["spans"]
+        names = [s["name"] for s in spans]
+        for want in ("admission", "dispatch", "wire_ingress",
+                     "queue_wait", "prefill", "decode_burst"):
+            assert want in names, names
+        assert schema.validate_trace_spans(spans) == []
+        adm = next(s for s in spans if s["name"] == "admission")
+        assert adm["attrs"]["decision"] == "admitted"
+        assert "est_wait_ms" in adm["attrs"]
+        # the wire hop's span parents to the router's dispatch span
+        wire_span = next(s for s in spans if s["name"] == "wire_ingress")
+        disp = next(s for s in spans if s["name"] == "dispatch")
+        assert wire_span["parent"] == disp["span"]
+    finally:
+        fleet.shutdown(drain=False)
+        router.close()
+
+
+class _StubEngine:
+    """A minimal engine with NO tracing awareness — stands in for a
+    worker built before the trace header existed."""
+
+    def __init__(self):
+        self._closed = False
+
+    def submit(self, x, **kw):
+        fut = Future()
+        fut.set_result(np.asarray(x) * 2.0)
+        return fut
+
+    def stats(self):
+        return {"queue_depth": 0, "resolved": 1}
+
+    def drain(self, timeout=None):
+        return True
+
+    def shutdown(self, **kw):
+        self._closed = True
+
+
+def test_wire_skew_traced_request_to_untraced_worker(fresh_registry):
+    """A traced request frame (trace field in the header) reaching a
+    worker whose engine predates tracing is served correctly — the
+    field is ignored, never fatal (same discipline as every other
+    optional header field)."""
+    from deeplearning4j_tpu.serving import wire
+    from deeplearning4j_tpu.serving.worker import EngineWorker
+    from deeplearning4j_tpu.streaming.broker import InMemoryBroker
+
+    assert reqtrace.request_tracer() is None  # worker side: tracing OFF
+    broker = InMemoryBroker()
+    worker = EngineWorker(_StubEngine(), broker, "svc", poll_s=0.01)
+    try:
+        frame = wire.pack_request(
+            "c1", "svc.rsp.test", wire.KIND_CLASSIFY,
+            np.ones((1, 3), np.float32),
+            trace={"id": "t-newer-router", "span": "1-7"})
+        broker.publish("svc.req", frame)
+        deadline = time.monotonic() + 10
+        msg = None
+        while msg is None and time.monotonic() < deadline:
+            msg = broker.consume("svc.rsp.test", timeout=0.05)
+        assert msg is not None, "worker never replied to a traced frame"
+        header, result = wire.unpack_reply(msg)
+        assert header["ok"] and np.allclose(result, 2.0)
+    finally:
+        worker.kill()
+
+
+def test_untraced_hop_yields_gappy_not_corrupt_trace(tracer):
+    """A traced request through an endpoint that propagates nothing
+    (older hop) still completes a VALID trace — router spans only,
+    parent-complete, just without engine-side decomposition."""
+    from deeplearning4j_tpu.serving import InferenceRouter
+    from deeplearning4j_tpu.serving.endpoint import EngineEndpoint
+
+    class _PlainEndpoint(EngineEndpoint):
+        name = "plain"
+
+        def submit(self, x, timeout_s=None, **kw):
+            fut = Future()
+            fut.set_result(np.asarray(x) + 1.0)
+            return fut
+
+        def stats(self):
+            return {"queue_depth": 0}
+
+        def alive(self):
+            return True
+
+        @property
+        def last_seen(self):
+            return time.monotonic()
+
+    router = InferenceRouter([_PlainEndpoint()])
+    try:
+        fut = router.submit(np.zeros((1, 2), np.float32))
+        fut.result(10)
+        entry = tracer.completed_trace(fut.trace_id)
+        names = [s["name"] for s in entry["spans"]]
+        assert "admission" in names and "dispatch" in names
+        assert "engine_queue" not in names  # the hop is gappy...
+        assert schema.validate_trace_spans(entry["spans"]) == []  # ...not corrupt
+    finally:
+        router.close()
+
+
+def test_flight_dump_fires_on_ejection(tmp_path, tracer):
+    """Endpoint ejection is a flight-recorder trigger: with a dump_dir
+    armed, the rings land as schema-valid JSONL naming the ejected
+    endpoint."""
+    from deeplearning4j_tpu.serving import InferenceRouter
+    from deeplearning4j_tpu.serving.endpoint import (EndpointError,
+                                                     EngineEndpoint)
+
+    reqtrace.configure_flight_recorder(dump_dir=str(tmp_path))
+
+    class _FailingEndpoint(EngineEndpoint):
+        name = "bad"
+
+        def submit(self, x, timeout_s=None, **kw):
+            raise EndpointError("injected")
+
+        def stats(self):
+            return {}
+
+        def alive(self):
+            return True
+
+        @property
+        def last_seen(self):
+            return time.monotonic()
+
+    router = InferenceRouter([_FailingEndpoint()], eject_threshold=2,
+                             max_attempts=1)
+    try:
+        for _ in range(2):
+            with pytest.raises(BaseException):
+                router.submit(np.zeros((1, 2), np.float32)).result(5)
+        dumps = sorted(tmp_path.glob("flight-*.jsonl"))
+        assert dumps, "ejection did not dump the flight recorder"
+        assert schema.validate_flight_file(str(dumps[-1])) == []
+        recs = [json.loads(l) for l in open(dumps[-1]) if l.strip()]
+        trig = [r for r in recs if r["type"] == "flight_event"
+                and r.get("kind") == "trigger"]
+        assert any(t["attrs"]["reason"] == "ejection"
+                   and t["attrs"]["endpoint"] == "bad" for t in trig)
+        reg = monitor.get_registry()
+        assert reg.family_total(monitor.TRACE_FLIGHT_DUMPS_COUNTER) >= 1
+    finally:
+        router.close()
+        reqtrace.configure_flight_recorder()  # drop the tmp dump_dir
+
+
+def test_slo_burn_and_fleet_snapshot(tracer):
+    """Deadline verdicts and admission sheds feed the per-model SLO
+    burn counter; ``fleet_snapshot()['slo']`` surfaces burn, TTFT and
+    the phase decomposition."""
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving import InferenceRouter, RetryAfter
+    from deeplearning4j_tpu.serving.endpoint import LocalEndpoint
+
+    eng = ParallelInference(_clf_net(), replicas=1)
+    router = InferenceRouter([LocalEndpoint(eng, "e0")])
+    try:
+        router.submit(np.zeros((1, 4), np.float32),
+                      deadline_ms=60_000).result(30)
+        snap = router.fleet_snapshot()
+        assert snap["slo"]["burn"]["default"].get("met", 0) == 1
+        assert "admission" in snap["slo"]["phases"]
+        assert snap["slo"]["ttft_ms"]["default"]["count"] >= 1
+    finally:
+        eng.shutdown()
+        router.close()
+    empty = InferenceRouter([])
+    try:
+        with pytest.raises(RetryAfter):
+            empty.submit(np.zeros((1, 4), np.float32))
+        snap = empty.fleet_snapshot()
+        assert snap["slo"]["burn"]["default"].get("shed", 0) == 1
+        assert snap["slo"]["burned"] >= 1
+    finally:
+        empty.close()
+
+
+def test_debug_traces_endpoint(tracer):
+    """UiServer /debug/traces serves the flight recorder rings as
+    schema-valid JSONL."""
+    from deeplearning4j_tpu.ui import InMemoryStatsStorage, UiServer
+
+    reqtrace.configure_flight_recorder()
+    root = tracer.begin_trace("request", kind="debug")
+    tracer.record_span(root.ctx, "dispatch", 0.0, 1.0)
+    tracer.finish_trace(root, outcome="ok")
+    reqtrace.flight_event("quarantine", replica=0)
+    srv = UiServer(InMemoryStatsStorage(), port=0).start()
+    try:
+        with urllib.request.urlopen(srv.url + "/debug/traces",
+                                    timeout=5) as r:
+            body = r.read().decode()
+        assert schema.validate_flight_lines(body.splitlines()) == []
+        assert '"quarantine"' in body and '"trace"' in body
+    finally:
+        srv.stop()
+
+
+# -------------------------------------------------- metric-name lint
+
+def test_metric_name_lint_repo_clean_and_catches(tmp_path):
+    lint = _load_script("check_metric_names")
+    root = os.path.dirname(_SCRIPTS)
+    assert lint.check_repo(root) == []
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from deeplearning4j_tpu.monitor import get_registry\n"
+        "get_registry().counter('dl4j_totally_new_total', 'x').inc()\n")
+    errs = lint.check_file(str(bad), "bad.py")
+    assert len(errs) == 1 and "dl4j_totally_new_total" in errs[0]
+    # allowlisted non-metric literals and dash-named topics pass
+    ok = tmp_path / "ok.py"
+    ok.write_text("MAGIC = 'dl4j_tpu_dataset_export_v1'\n"
+                  "TOPIC = 'dl4j-tpu-worker'\n")
+    assert lint.check_file(str(ok), "ok.py") == []
