@@ -39,6 +39,19 @@ Sub-benchmarks (each reported under "sub_benchmarks"):
     trace — TTFT p50/p99 (the ≥3x bar), prefill-token/FLOP reduction,
     hit rate, bitwise cached-vs-uncached token identity, zero
     steady-state compiles, zero leaked/double-freed blocks
+  - quantized_serving — post-training quantized serving
+    (nn/quantize.py int8/fp8 weights with fused on-the-fly dequant +
+    the nn/kvpool.py quantized paged KV pool): fp32 vs int8-weights vs
+    int8-weights+int8-KV on the continuous_decode open-loop workload
+    at ONE fixed KV device-byte budget — sustained tokens/sec,
+    concurrent decode rows (the pool-admission ceiling the quantized
+    pool lifts 2-4x), TTFT p50/p99, the accuracy-gate numbers the perf
+    claim ships with (teacher-forced greedy match rate, logit MSE,
+    eval-metric delta vs fp32), zero steady-state compiles, zero
+    leaked blocks — plus a chaos phase: a weights-quantized lane
+    cohabiting the fp32 lane on ONE shared pool through a registry
+    quality-gated deploy and kill-mid-burst faults (typed failures,
+    exact survivors, pool drains clean)
   - mesh_train — the rebuilt mesh plane (parallel/mesh.py MeshPlane):
     dp/fsdp/tp one-step fit throughput on a forced-8-device CPU mesh
     vs the single-device step, steady-state jit-miss counts, and
@@ -926,6 +939,234 @@ def bench_continuous_decode():
             "spans_dropped": int(tracer.dropped),
             "ttft_phase_ms": ttft_phases,
         },
+    }
+
+
+def bench_quantized_serving():
+    """Quantized serving end to end (ISSUE 14): the same model served
+    fp32, int8-weights, and int8-weights + int8-KV under the SAME
+    seeded open-loop trace and ONE fixed KV device-byte budget. The
+    claims measured here, each with its gate:
+
+    - **rows**: the paged pool is the admission ceiling (PR 8 preempts
+      on exhaustion); int8 KV blocks cost ~3.6x fewer bytes, so the
+      same budget holds ~3x the blocks → more CONCURRENT decode rows
+      and fewer preemptions (peak active_sequences, polled live);
+    - **tokens/sec**: sustained useful-token throughput per arm (on one
+      CPU core the dequant adds compute, so the honest win here is the
+      row/preemption headroom; on bandwidth-bound chips the byte
+      reduction IS throughput);
+    - **quality**: the nn/quantize.py accuracy gate (teacher-forced
+      greedy match rate ≥99.5%, eval-metric delta <0.5% vs fp32 on the
+      fixed seeded workload) — measured on a briefly-trained net, the
+      regime quantization is specified for (random-init logits are
+      near-ties everywhere and gate argmax flips meaninglessly);
+    - **determinism**: zero steady-state XLA compiles on the warmed
+      quantized ladders, zero leaked blocks after drain, and a chaos
+      phase where a weights-quantized lane cohabits the fp32 lane on
+      ONE shared pool (same KV spec — fp32 cache, int8 weights)
+      through a quality-gated registry deploy and kill-mid-burst
+      faults: killed bursts fail typed, survivors are exact, the pool
+      drains back to fully free."""
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.zoo.transformer import gpt
+    from deeplearning4j_tpu.nn.generate import generate_eager
+    from deeplearning4j_tpu.nn.kvpool import PagedKVCachePool
+    from deeplearning4j_tpu.nn.quantize import (accuracy_gate,
+                                                make_quality_gate, quantize,
+                                                quantized_param_bytes)
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving.registry import ModelRegistry
+
+    vocab, d, layers, heads, max_len = 32, 128, 4, 4, 256
+    eos, max_new, temp = 0, 160, 2.0
+    bs_kv = 16
+    net = gpt(vocab_size=vocab, d_model=d, n_layers=layers,
+              num_heads=heads, max_len=max_len,
+              compute_dtype="float32", learning_rate=0.01).init()
+    # sharpen the logits with a short deterministic fit (the gate's
+    # specified regime — post-TRAINING quantization): a simple modular
+    # next-token structure, fixed seed
+    rng_t = np.random.default_rng(7)
+    T = 32
+
+    def train_batch(n):
+        starts = rng_t.integers(0, vocab, n)
+        seq = (starts[:, None] + np.arange(T + 1)[None, :] * 3) % vocab
+        x = seq[:, :T].astype(np.float32)
+        y = np.zeros((n, T, vocab), np.float32)
+        y[np.arange(n)[:, None], np.arange(T)[None, :], seq[:, 1:]] = 1.0
+        return DataSet(x, y)
+
+    for _ in range(30):
+        net.fit(train_batch(16))
+    qnet = quantize(net, "int8")
+    gate = accuracy_gate(net, qnet, rows=8, length=24, seed=0)
+    gate_fp8 = accuracy_gate(net, quantize(net, "fp8"), rows=8,
+                             length=24, seed=0)
+
+    # ONE fixed KV byte budget for every arm: sized so the fp32 pool is
+    # the admission ceiling (the production shape — pool exhaustion is
+    # what sheds/preempts), while the int8 pool fits ~3.6x the blocks
+    hd = d // heads
+    fp32_blocks = 17
+    budget = fp32_blocks * PagedKVCachePool.bytes_per_block(
+        layers, bs_kv, heads, hd, np.float32)
+
+    rng = np.random.default_rng(0)
+    n_req = 64
+    arrivals = np.cumsum(rng.exponential(0.0035, n_req))
+    plens = rng.choice([6, 14, 30], n_req)
+    prompts = [rng.integers(1, vocab, (1, int(t))) for t in plens]
+    reg = monitor.get_registry()
+
+    def useful(row, t_in):
+        gen = row[t_in:]
+        idx = np.where(gen == eos)[0]
+        return int(idx[0]) + 1 if len(idx) else len(gen)
+
+    def drive(engine, scheduler):
+        done_t = {}
+
+        def cb(i):
+            return lambda f: done_t.__setitem__(i, time.perf_counter())
+
+        t0 = time.perf_counter()
+        subs, futs = [], []
+        row_samples = []
+        for i in range(n_req):
+            target = t0 + arrivals[i]
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            subs.append(time.perf_counter())
+            f = engine.submit_generate(prompts[i], max_new,
+                                       temperature=temp, eos_token=eos,
+                                       seed=i)
+            f.add_done_callback(cb(i))
+            futs.append(f)
+            row_samples.append(scheduler.stats()["active_sequences"])
+        while len(done_t) < n_req:
+            row_samples.append(scheduler.stats()["active_sequences"])
+            time.sleep(5e-3)
+        tokens = [useful(f.result(0)[0], int(plens[i]))
+                  for i, f in enumerate(futs)]
+        t_end = max(done_t.values())
+        ttfts = sorted((c["t_first"] - c["t_submit"]) * 1e3
+                       for c in scheduler.completed)
+        q = lambda xs, p: xs[min(len(xs) - 1, int(len(xs) * p))]
+        return {
+            "tokens": int(np.sum(tokens)),
+            "tokens_per_sec": float(np.sum(tokens)) / (t_end - t0),
+            "ttft_p50_ms": q(ttfts, 0.5), "ttft_p99_ms": q(ttfts, 0.99),
+            # sustained concurrency: mean active rows across the whole
+            # drive (every 5ms poll) — the pool-admission ceiling as
+            # the workload actually experienced it; peak is the
+            # transient high-water mark
+            "mean_rows": float(np.mean(row_samples)),
+            "peak_rows": int(np.max(row_samples)),
+            "preemptions": int(scheduler.stats()["preemptions"]),
+        }
+
+    warm_lens = [6, 14, 30]
+    arms = {}
+    jit_misses = {}
+    leaked = {}
+    for arm, (model, kv_quant) in (
+            ("fp32", (net, None)),
+            ("int8_weights", (qnet, None)),
+            ("int8_weights_int8_kv", (qnet, "int8"))):
+        eng = ParallelInference(model, replicas=1, continuous=True,
+                                decode_slots=24, decode_burst=8,
+                                kv_block_size=bs_kv, kv_quant=kv_quant,
+                                kv_bytes_budget=budget)
+        eng.warmup_generate(warm_lens, max_new)
+        miss0 = reg.family_total(monitor.JIT_CACHE_MISS_COUNTER)
+        sched = eng._continuous_scheduler()
+        arms[arm] = drive(eng, sched)
+        arms[arm]["kv_blocks"] = int(sched.stats()["pool"]["blocks_total"])
+        jit_misses[arm] = float(
+            reg.family_total(monitor.JIT_CACHE_MISS_COUNTER) - miss0)
+        eng.drain(60)
+        pool = sched.stats()["pool"]
+        leaked[arm] = int(pool["blocks_total"] - pool["blocks_free"])
+        eng.shutdown()
+
+    # --- chaos phase: quantized lane cohabiting the fp32 lane on ONE
+    # shared pool. int8 WEIGHTS + fp32 KV shares the fp32 net's pool
+    # spec, so stable (fp32) and the quality-gated quantized deploy
+    # recycle one block budget; kill-mid-burst faults hit whichever
+    # lane is dispatching — typed failures, exact survivors, clean pool
+    from deeplearning4j_tpu.faultinject import BurstKill
+    from deeplearning4j_tpu.serving.continuous import DecodeBurstError
+    registry = ModelRegistry()
+    registry.register("m", net=net, warm_shapes=[(8,)])
+    bk = BurstKill(after=6, failures=2)
+    ceng = ParallelInference(registry=registry, continuous=True,
+                             decode_slots=8, decode_burst=8,
+                             kv_block_size=bs_kv, kv_blocks=fp32_blocks,
+                             decode_burst_hook=bk)
+    v2 = registry.deploy("m", net=qnet,
+                         quality_gate=make_quality_gate(seed=0))
+    ceng.warmup_generate(warm_lens, 24, model="m", version=1)
+    ceng.warmup_generate(warm_lens, 24, model="m", version=v2)
+    csched = ceng._continuous_scheduler()
+    futs = []
+    for i in range(16):
+        ver = 1 if i % 2 == 0 else v2
+        futs.append((ver, i, ceng.submit_generate(
+            prompts[i], 12, temperature=0.0, eos_token=None, seed=i,
+            model="m", version=ver)))
+    ceng.drain(120)
+    killed = exact = 0
+    for ver, i, f in futs:
+        try:
+            out = f.result(0)
+        except DecodeBurstError:
+            killed += 1
+            continue
+        ref = generate_eager(net if ver == 1 else qnet, prompts[i], 12,
+                             seed=i)
+        exact += int(np.array_equal(out, ref))
+    cpool = csched.stats()["pool"]
+    chaos = {
+        "lanes": int(csched.stats()["lanes"]),
+        "shared_pools": len(csched.stats()["pools"]),
+        "killed_typed": killed,
+        "survivors_exact": exact,
+        "survivors": len(futs) - killed,
+        "leaked_blocks": int(cpool["blocks_total"] - cpool["blocks_free"]),
+        "quality_gated_deploy_version": int(v2),
+    }
+    ceng.shutdown()
+
+    base, q8, qkv = (arms["fp32"], arms["int8_weights"],
+                     arms["int8_weights_int8_kv"])
+    rows_ratio = qkv["mean_rows"] / max(1e-9, base["mean_rows"])
+    tps_ratio = qkv["tokens_per_sec"] / max(1e-9, base["tokens_per_sec"])
+    return {
+        "metric": "quantized_serving_concurrent_rows_vs_fp32",
+        "value": round(rows_ratio, 3), "unit": "x",
+        # acceptance composite: >=1.5x tokens/sec OR >=2x concurrent
+        # rows at the fixed KV byte budget — rows is the pool-ceiling
+        # claim and holds on any backend; report both ratios
+        "vs_baseline": round(max(rows_ratio, tps_ratio), 3),
+        "tokens_per_sec_ratio": round(tps_ratio, 3),
+        "kv_bytes_budget": int(budget),
+        "weight_bytes_fp32": quantized_param_bytes(net.params),
+        "weight_bytes_int8": quantized_param_bytes(qnet.params),
+        "arms": {k: {kk: (round(vv, 3) if isinstance(vv, float) else vv)
+                     for kk, vv in v.items()} for k, v in arms.items()},
+        "steady_state_jit_misses": jit_misses,
+        "leaked_blocks": leaked,
+        "accuracy_gate": gate,
+        "accuracy_gate_fp8": {k: gate_fp8[k] for k in
+                              ("passed", "greedy_match_rate",
+                               "eval_metric_delta")},
+        "chaos_cohabit": chaos,
+        "requests": n_req,
+        "max_new_cap": max_new,
     }
 
 
@@ -2332,6 +2573,7 @@ def main():
                      ("serving_inference", bench_serving_inference),
                      ("fault_recovery", bench_fault_recovery),
                      ("continuous_decode", bench_continuous_decode),
+                     ("quantized_serving", bench_quantized_serving),
                      ("prefix_cache", bench_prefix_cache),
                      ("durable_decode", bench_durable_decode),
                      ("router_slo", bench_router_slo),

@@ -243,6 +243,22 @@ SLICE_DEGRADED_GAUGE = "dl4j_slice_degraded"
 SLICE_REBUILDS_COUNTER = "dl4j_slice_rebuilds_total"
 DISAGG_KV_HANDOFFS_COUNTER = "dl4j_disagg_kv_handoffs_total"
 
+# Quantized serving plane (nn/quantize.py post-training weight
+# quantization + the nn/kvpool.py quantized paged KV pool): count of
+# quantized nets produced by quantize() (``dtype=`` int8/fp8), the
+# allocatable block count of every QUANTIZED paged pool (``pool=`` —
+# alongside dl4j_kvpool_blocks_total, so "how much of the KV budget is
+# 1-byte storage" is a division of two gauges), the largest
+# per-output-channel dequant scale of every quantized weight matrix
+# (``layer=``/``param=`` — a scale that jumps between deploys means
+# an outlier channel is eating the int8 range), and the accuracy-gate
+# verdict counter (``outcome=`` pass/fail — the quality bound every
+# quantized deploy/bench claim ships with).
+QUANT_MODELS_GAUGE = "dl4j_quant_models"
+QUANT_KV_BLOCKS_GAUGE = "dl4j_quant_kv_blocks"
+QUANT_SCALE_ABSMAX_GAUGE = "dl4j_quant_scale_absmax"
+QUANT_GATE_OUTCOME_COUNTER = "dl4j_quant_accuracy_gate_outcome_total"
+
 # End-to-end request tracing + SLO attribution (monitor/reqtrace.py —
 # the serving plane's Dapper layer): per-request phase durations from
 # the merged traces (``phase=`` label: admission / dispatch /

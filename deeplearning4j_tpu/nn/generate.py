@@ -57,6 +57,7 @@ from deeplearning4j_tpu.nn.layers.transformer import (
     SequenceEmbeddingImpl,
     TransformerBlockImpl,
 )
+from deeplearning4j_tpu.nn.quantize import kv_quantize, qtake
 from deeplearning4j_tpu.optimize.deferred import note_dispatch
 from deeplearning4j_tpu.util.dtypes import cast_floats
 
@@ -260,9 +261,12 @@ class TransformerGenerator(_GeneratorBase):
     # ----------------------------------------------------- programs
 
     def _embed_token(self, p_emb, tok, pos):
-        """[b] ids at per-row positions [b] → [b, d]."""
+        """[b] ids at per-row positions [b] → [b, d]. ``qtake`` is the
+        quantized-embedding seam: int8/fp8 rows gather at 1 byte per
+        element and dequant per-channel (identical to the plain take on
+        an unquantized table)."""
         return self.emb._slice_replicate(
-            jnp.take(p_emb["W"], tok, axis=0)
+            qtake(p_emb, "W", tok)
             + jnp.take(p_emb["P"], pos, axis=0))
 
     def _get_prefill(self, cache_len: int):
@@ -271,7 +275,7 @@ class TransformerGenerator(_GeneratorBase):
                 b, t_pad = ids.shape
                 p_emb = self._cast(params[self.emb.name])
                 x = self.emb._slice_replicate(
-                    jnp.take(p_emb["W"], ids, axis=0)
+                    qtake(p_emb, "W", ids)
                     + p_emb["P"][:t_pad][None])
                 cache_dtype = self.cd if self.cd is not None else jnp.float32
                 caches = []
@@ -450,7 +454,11 @@ class TransformerGenerator(_GeneratorBase):
         """Pages a prefill's dense caches into the shared pool: every
         layer's [rows, t_blk, h, hd] K/V reshapes into t_blk/block_size
         block-sized chunks and scatters to the rows' block-table ids
-        (unallocated tail entries are 0 — the trash block)."""
+        (unallocated tail entries are 0 — the trash block). A QUANTIZED
+        pool quantizes each position per head on the way in — the SAME
+        per-token granularity the burst's incremental writes use, so a
+        resume's re-prefill stores bit-identical blocks to the original
+        decode (the replay contract on a quantized pool)."""
         if t_blk % block_size != 0:
             raise ValueError(
                 f"t_blk {t_blk} not a multiple of block_size {block_size}")
@@ -463,6 +471,15 @@ class TransformerGenerator(_GeneratorBase):
                     tail = cache["k"].shape[2:]
                     kr = cache["k"].reshape(rows, nb, block_size, *tail)
                     vr = cache["v"].reshape(rows, nb, block_size, *tail)
+                    if "k_scale" in pool:
+                        kq, ksc = kv_quantize(kr, pool["k"].dtype)
+                        vq, vsc = kv_quantize(vr, pool["v"].dtype)
+                        out.append({
+                            "k": pool["k"].at[tables].set(kq),
+                            "v": pool["v"].at[tables].set(vq),
+                            "k_scale": pool["k_scale"].at[tables].set(ksc),
+                            "v_scale": pool["v_scale"].at[tables].set(vsc)})
+                        continue
                     out.append({
                         "k": pool["k"].at[tables].set(
                             kr.astype(pool["k"].dtype)),
@@ -490,7 +507,7 @@ class TransformerGenerator(_GeneratorBase):
                 p_emb = self._cast(params[self.emb.name])
                 pos = starts[:, None] + jnp.arange(t_tail)[None, :]
                 x = self.emb._slice_replicate(
-                    jnp.take(p_emb["W"], ids, axis=0)
+                    qtake(p_emb, "W", ids)
                     + jnp.take(p_emb["P"], pos, axis=0))
                 write_ok = jnp.arange(t_tail)[None, :] < lens[:, None]
                 new_pools = []
@@ -513,12 +530,12 @@ class TransformerGenerator(_GeneratorBase):
         never written, so this is the ONLY mutation sharing needs."""
         def builder():
             def copy(pools, src, dst):
-                out = []
-                for pool in pools:
-                    out.append({
-                        "k": pool["k"].at[dst].set(pool["k"][src]),
-                        "v": pool["v"].at[dst].set(pool["v"][src])})
-                return out
+                # generic over the pool entry set: a quantized pool's
+                # k_scale/v_scale arrays clone with their blocks, so a
+                # COW'd block dequantizes identically to its source
+                return [{name: arr.at[dst].set(arr[src])
+                         for name, arr in pool.items()}
+                        for pool in pools]
             return copy
         return self._jit(("gen_block_copy", n, num_blocks, block_size),
                          builder, donate=(0,))
@@ -561,13 +578,16 @@ class TransformerGenerator(_GeneratorBase):
                     x = self._embed_token(p_emb, tok, pos)
                     new_pools = []
                     for blk, pool in zip(self.blocks, pools):
-                        cache = {"k": pool["k"], "v": pool["v"],
-                                 "table": tables}
+                        # the whole pool entry set rides the cache dict
+                        # (a quantized pool's scale arrays scatter and
+                        # gather inside decode_step's paged branch)
+                        cache = dict(pool)
+                        cache["table"] = tables
                         x, cache = blk.decode_step(
                             self._cast(params[blk.name]), x, cache, pos,
                             write_mask=active)
-                        new_pools.append({"k": cache["k"],
-                                          "v": cache["v"]})
+                        new_pools.append({name: cache[name]
+                                          for name in pool})
                     logits = self._head_logits(params, x)
                     if sampling:
                         nxt = sample_tokens_rowwise(logits, keys, n_gen,

@@ -475,6 +475,11 @@ class ComputationGraph:
         pipeline on (default) batches are shape-bucketed and staged on
         device by a background thread and scores resolve in deferred
         batches (see MultiLayerNetwork.fit)."""
+        if getattr(self, "quantized", None) is not None:
+            raise ValueError(
+                f"this net holds {self.quantized}-quantized serving "
+                "weights (nn/quantize.py) — the round() in them has no "
+                "useful gradient; train the fp32 original and re-quantize")
         if self.params is None:
             self.init()
         pipeline = feed_pipeline_enabled(feed_pipeline)

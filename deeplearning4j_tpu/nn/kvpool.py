@@ -52,6 +52,18 @@ The pool publishes ``dl4j_kvpool_blocks_total`` /
 ``dl4j_kvpool_alloc_failures_total`` so occupancy and exhaustion are
 first-class signals (the scheduler preempts on exactly the condition
 the failure counter counts).
+
+**Quantized pools** (``quant="int8"``/``"fp8"``, nn/quantize.py): K/V
+values are stored at 1 byte/element with float32 per-(position, head)
+scale arrays (``k_scale``/``v_scale`` ``[num_blocks, block_size,
+heads]``) riding beside the value arrays — same block ids, same
+refcount/COW/trash discipline (the scale arrays share the values'
+(block, offset) addressing, so every sharing path carries them for
+free), ~2-4x the decode rows per device byte
+(:meth:`PagedKVCachePool.bytes_per_block` does the budget math). A
+quantized pool's spec NEVER matches a full-precision pool's, so lanes
+can only share a pool within one storage mode; quantized pools also
+publish ``dl4j_quant_kv_blocks``.
 """
 
 from __future__ import annotations
@@ -67,20 +79,26 @@ from deeplearning4j_tpu.monitor import (
     KVPOOL_ALLOC_FAILURES_COUNTER,
     KVPOOL_BLOCKS_FREE_GAUGE,
     KVPOOL_BLOCKS_TOTAL_GAUGE,
+    QUANT_KV_BLOCKS_GAUGE,
     get_registry,
 )
+from deeplearning4j_tpu.nn.quantize import kv_qparams
 
 #: Hashable KV layout a pool serves: (num_layers, heads, head_dim,
-#: block_size, dtype name). Lanes (model versions) whose nets share a
-#: spec share one pool — a canary and its stable version recycle the
-#: same block budget across a cutover.
-PoolSpec = Tuple[int, int, int, int, str]
+#: block_size, dtype name, quant mode or ""). Lanes (model versions)
+#: whose nets share a spec share one pool — a canary and its stable
+#: version recycle the same block budget across a cutover. A quantized
+#: pool NEVER shares a spec with a full-precision one: the stored
+#: bytes mean different things.
+PoolSpec = Tuple[int, int, int, int, str, str]
 
 
 def pool_spec(num_layers: int, num_heads: int, head_dim: int,
-              block_size: int, dtype) -> PoolSpec:
+              block_size: int, dtype, quant: Optional[str] = None
+              ) -> PoolSpec:
     return (int(num_layers), int(num_heads), int(head_dim),
-            int(block_size), str(jnp.dtype(dtype)))
+            int(block_size), str(jnp.dtype(dtype)),
+            "" if quant is None else str(quant))
 
 
 class PagedKVCachePool:
@@ -99,7 +117,8 @@ class PagedKVCachePool:
 
     def __init__(self, num_blocks: int, block_size: int, num_layers: int,
                  num_heads: int, head_dim: int, dtype=jnp.float32,
-                 device=None, name: str = "default", sharding=None):
+                 device=None, name: str = "default", sharding=None,
+                 quant: Optional[str] = None):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is the reserved trash "
@@ -112,9 +131,18 @@ class PagedKVCachePool:
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.dtype = jnp.dtype(dtype)
+        # quantized pool (nn/quantize.py): K/V values stored int8/fp8
+        # (1 byte/element) with float32 per-(position, head) scale
+        # arrays riding alongside — same block ids, same refcount/COW/
+        # trash-block discipline, ~2-4x the decode rows per device byte
+        self.quant = quant
+        if quant is not None:
+            self.storage_dtype = jnp.dtype(kv_qparams(quant)[0])
+        else:
+            self.storage_dtype = self.dtype
         self.name = name
         self.spec: PoolSpec = pool_spec(num_layers, num_heads, head_dim,
-                                        block_size, dtype)
+                                        block_size, dtype, quant)
         shape = (self.num_blocks, self.block_size, self.num_heads,
                  self.head_dim)
         if sharding is not None and device is not None:
@@ -125,10 +153,24 @@ class PagedKVCachePool:
         placement = sharding if sharding is not None else device
         put = (lambda a: jax.device_put(a, placement)) \
             if placement is not None else (lambda a: a)
-        self.layers: List[Dict[str, jnp.ndarray]] = [
-            {"k": put(jnp.zeros(shape, self.dtype)),
-             "v": put(jnp.zeros(shape, self.dtype))}
-            for _ in range(self.num_layers)]
+        scale_put = put
+        if quant is not None and sharding is not None:
+            # the [NB, bs, h] scale arrays shard their heads axis like
+            # the value arrays (drop the head_dim entry of the spec)
+            from jax.sharding import NamedSharding, PartitionSpec
+            scale_sharding = NamedSharding(
+                sharding.mesh, PartitionSpec(*sharding.spec[:3]))
+            scale_put = lambda a: jax.device_put(a, scale_sharding)
+        self.layers: List[Dict[str, jnp.ndarray]] = []
+        for _ in range(self.num_layers):
+            entry = {"k": put(jnp.zeros(shape, self.storage_dtype)),
+                     "v": put(jnp.zeros(shape, self.storage_dtype))}
+            if quant is not None:
+                entry["k_scale"] = scale_put(jnp.zeros(shape[:3],
+                                                       jnp.float32))
+                entry["v_scale"] = scale_put(jnp.zeros(shape[:3],
+                                                       jnp.float32))
+            self.layers.append(entry)
         # block 0 = trash: masked/padding writes land there, reads past
         # a causal mask may see it — never owned by a sequence
         self._free: List[int] = list(range(1, self.num_blocks))
@@ -286,6 +328,8 @@ class PagedKVCachePool:
             shared = sum(1 for r in self._refs.values() if r > 1)
         return {"blocks_total": self.total_blocks, "blocks_free": free,
                 "block_size": self.block_size,
+                "quant": self.quant,
+                "block_bytes": self.block_bytes(),
                 "occupancy": ((self.total_blocks - free) / self.total_blocks
                               if self.total_blocks else 0.0),
                 "shared_blocks": shared,
@@ -293,9 +337,26 @@ class PagedKVCachePool:
 
     def block_bytes(self) -> int:
         """Device bytes one logical block occupies across every layer's
-        K and V pools — what cache-occupancy summaries report."""
-        return int(2 * self.num_layers * self.block_size * self.num_heads
-                   * self.head_dim * self.dtype.itemsize)
+        K and V pools (scale arrays included on a quantized pool) —
+        what cache-occupancy summaries and byte-budget sizing report."""
+        return self.bytes_per_block(self.num_layers, self.block_size,
+                                    self.num_heads, self.head_dim,
+                                    self.dtype, self.quant)
+
+    @staticmethod
+    def bytes_per_block(num_layers: int, block_size: int, num_heads: int,
+                        head_dim: int, dtype=jnp.float32,
+                        quant: Optional[str] = None) -> int:
+        """Per-block device bytes for a pool layout WITHOUT building
+        the pool — how a byte budget (``kv_bytes_budget``) converts to
+        ``num_blocks`` per storage mode. Quantized: 1-byte values plus
+        a float32 scale per (position, head) for K and V."""
+        per_val = (jnp.dtype(kv_qparams(quant)[0]).itemsize
+                   if quant is not None else jnp.dtype(dtype).itemsize)
+        val = 2 * num_layers * block_size * num_heads * head_dim * per_val
+        if quant is None:
+            return int(val)
+        return int(val + 2 * num_layers * block_size * num_heads * 4)
 
     # ----------------------------------------------------- device arrays
 
@@ -319,3 +380,7 @@ class PagedKVCachePool:
         reg.gauge(KVPOOL_BLOCKS_FREE_GAUGE,
                   "KV cache blocks currently free in the paged pool",
                   pool=self.name).set(free)
+        if self.quant is not None:
+            reg.gauge(QUANT_KV_BLOCKS_GAUGE,
+                      "Allocatable blocks held in QUANTIZED (int8/fp8) "
+                      "paged pools", pool=self.name).set(self.total_blocks)

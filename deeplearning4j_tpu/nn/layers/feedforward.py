@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.layers.base import LayerImpl, register_impl, apply_dropout
+from deeplearning4j_tpu.nn.quantize import is_quantized, qmatmul, qtake
 from deeplearning4j_tpu.nn.weights import init_weights
 from deeplearning4j_tpu.ops.activations import Activation, activate
 from deeplearning4j_tpu.ops.losses import LossFunction, compute_loss
@@ -52,6 +53,11 @@ class BaseDenseImpl(LayerImpl):
         # x sharded on its feature dim — all-gather before W contracts
         # over it, so the contraction never reduces across shards
         x = self._slice_replicate(x)
+        if is_quantized(params, "W"):
+            # int8/fp8 weights: dequant fused into the matmul
+            # (nn/quantize.py) — bias added in the scaled dtype
+            z = qmatmul(x, params, "W")
+            return z + params["b"].astype(z.dtype) if "b" in params else z
         z = x @ params["W"]
         return z + params["b"] if "b" in params else z
 
@@ -84,8 +90,14 @@ class OutputImpl(BaseDenseImpl):
         # their native matmul — forcing f32 there would DOWNcast.
         x = self._slice_replicate(x)
         W = params["W"]
-        if jnp.promote_types(x.dtype, W.dtype) in (jnp.bfloat16,
-                                                   jnp.float16):
+        if is_quantized(params, "W"):
+            # quantized head: int8/fp8 matmul operand, scale fused
+            # after; logits land in f32 downstream (the generate-path
+            # _head_logits / loss casts), matching the always-f32 rule
+            # within the quantized numeric contract
+            z = qmatmul(x, params, "W")
+        elif jnp.promote_types(x.dtype, W.dtype) in (jnp.bfloat16,
+                                                     jnp.float16):
             z = jnp.matmul(x, W, preferred_element_type=jnp.float32)
         else:
             z = x @ W
@@ -152,7 +164,7 @@ class EmbeddingImpl(LayerImpl):
         idx = x.astype(jnp.int32)
         if idx.ndim == 2:
             idx = idx[:, 0]
-        z = jnp.take(params["W"], idx, axis=0) + params["b"]
+        z = qtake(params, "W", idx) + params["b"]
         return activate(self.activation, z), state
 
 

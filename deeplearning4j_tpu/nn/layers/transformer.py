@@ -27,6 +27,8 @@ from deeplearning4j_tpu.nn.layers.base import (
     LayerImpl, apply_dropout, register_impl)
 from deeplearning4j_tpu.nn.layers.moe import (
     AUX_LOSS_KEY, init_moe_params, run_moe_ffn)
+from deeplearning4j_tpu.nn.quantize import (kv_dequantize, kv_quantize,
+                                            qmatmul, qtake)
 from deeplearning4j_tpu.nn.weights import init_weights
 
 
@@ -60,7 +62,7 @@ class SequenceEmbeddingImpl(LayerImpl):
         t = idx.shape[1]
         if t > self.conf.max_len:
             raise ValueError(f"sequence length {t} > max_len {self.conf.max_len}")
-        z = jnp.take(params["W"], idx, axis=0) + params["P"][:t][None]
+        z = qtake(params, "W", idx) + params["P"][:t][None]
         return self._slice_replicate(z), state
 
 
@@ -112,7 +114,7 @@ class TransformerBlockImpl(LayerImpl):
         b, t, d = x.shape
         h_count, hd = c.num_heads, c.n_out // c.num_heads
         h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
-        qkv = h @ params["Wqkv"].astype(h.dtype)
+        qkv = qmatmul(h, params, "Wqkv")
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = lambda z: z.reshape(b, t, h_count, hd)
         q, k, v = shape(q), shape(k), shape(v)
@@ -124,8 +126,8 @@ class TransformerBlockImpl(LayerImpl):
                 o = dispatch_attention(q, k, v, causal=c.causal, mask=mask)
         else:
             o = dispatch_attention(q, k, v, causal=c.causal, mask=mask)
-        attn = self._slice_replicate(o.reshape(b, t, d)) \
-            @ params["Wo"].astype(x.dtype)
+        attn = qmatmul(self._slice_replicate(o.reshape(b, t, d)),
+                       params, "Wo")
         if train and self.dropout_rate > 0.0 and rng is not None:
             attn = apply_dropout(attn, self.dropout_rate,
                                  jax.random.fold_in(rng, 1))
@@ -154,13 +156,13 @@ class TransformerBlockImpl(LayerImpl):
         if c.num_experts > 0:
             return run_moe_ffn(params, h2, capacity_factor,
                                c.aux_loss_weight, mask=mask)
-        mlp = jax.nn.gelu(h2 @ params["W1"].astype(h2.dtype)
+        mlp = jax.nn.gelu(qmatmul(h2, params, "W1")
                           + params["b1"].astype(h2.dtype))
         # sliced: W1 is column-sharded so mlp is sharded on its hidden
         # dim — all-gather it before W2 contracts over that dim, so the
         # contraction never reduces across shards (bitwise seam)
         mlp = self._slice_replicate(mlp)
-        mlp = mlp @ params["W2"].astype(h2.dtype) \
+        mlp = qmatmul(mlp, params, "W2") \
             + params["b2"].astype(h2.dtype)
         return mlp, state
 
@@ -188,7 +190,7 @@ class TransformerBlockImpl(LayerImpl):
         b, t, d = x.shape
         h_count, hd = c.num_heads, c.n_out // c.num_heads
         h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
-        qkv = h @ params["Wqkv"].astype(h.dtype)
+        qkv = qmatmul(h, params, "Wqkv")
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = lambda z: z.reshape(b, t, h_count, hd)
         q, k, v = shape(q), shape(k), shape(v)
@@ -202,8 +204,8 @@ class TransformerBlockImpl(LayerImpl):
         else:
             o = dispatch_attention(q, k, v, causal=c.causal, mask=None)
         x = self._slice_replicate(
-            x + self._slice_replicate(o.reshape(b, t, d))
-            @ params["Wo"].astype(x.dtype))
+            x + qmatmul(self._slice_replicate(o.reshape(b, t, d)),
+                        params, "Wo"))
         h2 = _layer_norm(x, params["ln2_g"], params["ln2_b"])
         mlp, _ = self._ffn(params, h2.reshape(-1, d), {},
                            capacity_factor=float(max(1, c.num_experts)))
@@ -230,7 +232,7 @@ class TransformerBlockImpl(LayerImpl):
         b, t, d = x.shape
         h_count, hd = c.num_heads, c.n_out // c.num_heads
         h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
-        qkv = h @ params["Wqkv"].astype(h.dtype)
+        qkv = qmatmul(h, params, "Wqkv")
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = lambda z: z.reshape(b, t, h_count, hd)
         q, k, v = shape(q), shape(k), shape(v)
@@ -241,10 +243,30 @@ class TransformerBlockImpl(LayerImpl):
         off = pos % bs
         blk = jnp.where(write_ok, blk, 0)    # padding → trash block
         off = jnp.where(write_ok, off, 0)
-        kp = kp.at[blk, off].set(k.astype(kp.dtype))
-        vp = vp.at[blk, off].set(v.astype(vp.dtype))
+        new_pool = dict(pool)
+        if "k_scale" in pool:
+            # quantized pool (nn/quantize.py): per-(position, head)
+            # scales over head_dim — quantize on scatter here, dequant
+            # on gather below, attention math unchanged
+            kq, ksc = kv_quantize(k, kp.dtype)
+            vq, vsc = kv_quantize(v, vp.dtype)
+            kp = kp.at[blk, off].set(kq)
+            vp = vp.at[blk, off].set(vq)
+            new_pool["k_scale"] = pool["k_scale"].at[blk, off].set(ksc)
+            new_pool["v_scale"] = pool["v_scale"].at[blk, off].set(vsc)
+        else:
+            kp = kp.at[blk, off].set(k.astype(kp.dtype))
+            vp = vp.at[blk, off].set(v.astype(vp.dtype))
+        new_pool["k"], new_pool["v"] = kp, vp
         kg = jnp.take(kp, table, axis=0).reshape(b, mb * bs, *kp.shape[2:])
         vg = jnp.take(vp, table, axis=0).reshape(b, mb * bs, *vp.shape[2:])
+        if "k_scale" in pool:
+            ksg = jnp.take(new_pool["k_scale"], table, axis=0).reshape(
+                b, mb * bs, h_count)
+            vsg = jnp.take(new_pool["v_scale"], table, axis=0).reshape(
+                b, mb * bs, h_count)
+            kg = kv_dequantize(kg, ksg, q.dtype)
+            vg = kv_dequantize(vg, vsg, q.dtype)
         scale = 1.0 / jnp.sqrt(jnp.asarray(hd, q.dtype))
         s = jnp.einsum("bqhd,bkhd->bhqk", q, kg.astype(q.dtype)) * scale
         live = jnp.arange(mb * bs)[None, None, :] <= pos[:, :, None]
@@ -253,13 +275,12 @@ class TransformerBlockImpl(LayerImpl):
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bkhd->bqhd", w, vg.astype(q.dtype))
         x = self._slice_replicate(
-            x + self._slice_replicate(o.reshape(b, t, d))
-            @ params["Wo"].astype(x.dtype))
+            x + qmatmul(self._slice_replicate(o.reshape(b, t, d)),
+                        params, "Wo"))
         h2 = _layer_norm(x, params["ln2_g"], params["ln2_b"])
         mlp, _ = self._ffn(params, h2.reshape(-1, d), {},
                            capacity_factor=float(max(1, c.num_experts)))
-        return self._slice_replicate(x + mlp.reshape(b, t, d)), \
-            {"k": kp, "v": vp}
+        return self._slice_replicate(x + mlp.reshape(b, t, d)), new_pool
 
     def decode_step(self, params, x_t, cache, pos, write_mask=None):
         """One-token forward [b, d] with cached keys/values; ``pos`` is
@@ -287,7 +308,7 @@ class TransformerBlockImpl(LayerImpl):
         b, d = x_t.shape
         h_count, hd = c.num_heads, c.n_out // c.num_heads
         h = _layer_norm(x_t, params["ln1_g"], params["ln1_b"])
-        qkv = h @ params["Wqkv"].astype(h.dtype)
+        qkv = qmatmul(h, params, "Wqkv")
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = lambda z: z.reshape(b, h_count, hd)
         q, k, v = shape(q), shape(k), shape(v)
@@ -316,8 +337,8 @@ class TransformerBlockImpl(LayerImpl):
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhk,bkhd->bhd", w, cv.astype(q.dtype))
         x_t = self._slice_replicate(
-            x_t + self._slice_replicate(o.reshape(b, d))
-            @ params["Wo"].astype(x_t.dtype))
+            x_t + qmatmul(self._slice_replicate(o.reshape(b, d)),
+                          params, "Wo"))
 
         h2 = _layer_norm(x_t, params["ln2_g"], params["ln2_b"])
         # no-drop capacity: capacity = ceil(cf*b/E) >= b when cf = E
@@ -334,7 +355,14 @@ class TransformerBlockImpl(LayerImpl):
         the same masked softmax attention as the dense branch. Gathered
         positions past ``pos`` (including every trash/garbage block the
         table pads with) are causally masked, so pool garbage is
-        numerically inert exactly like the dense path's padded tail."""
+        numerically inert exactly like the dense path's padded tail.
+
+        A QUANTIZED pool (``"k_scale"``/``"v_scale"`` entries — the
+        nn/kvpool.py int8/fp8 variant) quantizes the incoming token's
+        K/V per head on the scatter and dequantizes the gathered view
+        before the softmax; everything else — table discipline, trash
+        redirect, causal mask — is identical, and the scale arrays ride
+        the same (block, offset) addressing as the values."""
         c = self.conf
         b, d = x_t.shape
         kp, vp = cache["k"], cache["v"]      # [NB, bs, h, hd] shared pool
@@ -348,11 +376,29 @@ class TransformerBlockImpl(LayerImpl):
             # masked rows write the trash block — never a live sequence
             blk = jnp.where(write_mask, blk, 0)
             off = jnp.where(write_mask, off, 0)
-        kp = kp.at[blk, off].set(k.astype(kp.dtype))
-        vp = vp.at[blk, off].set(v.astype(vp.dtype))
+        new_cache = dict(cache)
+        if "k_scale" in cache:
+            kq, ksc = kv_quantize(k, kp.dtype)
+            vq, vsc = kv_quantize(v, vp.dtype)
+            kp = kp.at[blk, off].set(kq)
+            vp = vp.at[blk, off].set(vq)
+            new_cache["k_scale"] = cache["k_scale"].at[blk, off].set(ksc)
+            new_cache["v_scale"] = cache["v_scale"].at[blk, off].set(vsc)
+        else:
+            kp = kp.at[blk, off].set(k.astype(kp.dtype))
+            vp = vp.at[blk, off].set(v.astype(vp.dtype))
+        new_cache["k"], new_cache["v"] = kp, vp
         # gather the row's cache back into causal order: [b, MB*bs, h, hd]
         kg = jnp.take(kp, table, axis=0).reshape(b, mb * bs, *kp.shape[2:])
         vg = jnp.take(vp, table, axis=0).reshape(b, mb * bs, *vp.shape[2:])
+        if "k_scale" in cache:
+            h_count = c.num_heads
+            ksg = jnp.take(new_cache["k_scale"], table, axis=0).reshape(
+                b, mb * bs, h_count)
+            vsg = jnp.take(new_cache["v_scale"], table, axis=0).reshape(
+                b, mb * bs, h_count)
+            kg = kv_dequantize(kg, ksg, q.dtype)
+            vg = kv_dequantize(vg, vsg, q.dtype)
         hd = c.n_out // c.num_heads
         scale = 1.0 / jnp.sqrt(jnp.asarray(hd, q.dtype))
         s = jnp.einsum("bhd,bkhd->bhk", q, kg.astype(q.dtype)) * scale
@@ -362,11 +408,10 @@ class TransformerBlockImpl(LayerImpl):
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhk,bkhd->bhd", w, vg.astype(q.dtype))
         x_t = self._slice_replicate(
-            x_t + self._slice_replicate(o.reshape(b, d))
-            @ params["Wo"].astype(x_t.dtype))
+            x_t + qmatmul(self._slice_replicate(o.reshape(b, d)),
+                          params, "Wo"))
 
         h2 = _layer_norm(x_t, params["ln2_g"], params["ln2_b"])
         mlp, _ = self._ffn(params, h2, {},
                            capacity_factor=float(max(1, c.num_experts)))
-        return self._slice_replicate(x_t + mlp), \
-            {"k": kp, "v": vp, "table": table}
+        return self._slice_replicate(x_t + mlp), new_cache

@@ -319,6 +319,11 @@ class MultiLayerNetwork:
         and device-staged by a background thread, and per-step scores
         stay on device until a listener needs them (one batched fetch)
         — the host loop never blocks the chip."""
+        if getattr(self, "quantized", None) is not None:
+            raise ValueError(
+                f"this net holds {self.quantized}-quantized serving "
+                "weights (nn/quantize.py) — the round() in them has no "
+                "useful gradient; train the fp32 original and re-quantize")
         if self.params is None:
             self.init()
         if isinstance(data, np.ndarray) or isinstance(data, jnp.ndarray):

@@ -348,6 +348,8 @@ class ParallelInference:
                  decode_burst: int = 8,
                  kv_block_size: int = 16,
                  kv_blocks: Optional[int] = None,
+                 kv_quant: Optional[str] = None,
+                 kv_bytes_budget: Optional[int] = None,
                  decode_burst_hook=None,
                  prefix_cache: bool = False,
                  prefix_cache_blocks: Optional[int] = None,
@@ -470,6 +472,16 @@ class ParallelInference:
         self.decode_burst = int(decode_burst)
         self.kv_block_size = int(kv_block_size)
         self.kv_blocks = kv_blocks
+        # quantized paged KV (nn/quantize.py): "int8"/"fp8" pool
+        # storage; kv_bytes_budget sizes the pool from device bytes so
+        # a quantized engine holds 2-4x the decode rows per byte
+        self.kv_quant = kv_quant
+        self.kv_bytes_budget = kv_bytes_budget
+        if (kv_quant is not None or kv_bytes_budget is not None) \
+                and not self.continuous:
+            raise ValueError(
+                "kv_quant=/kv_bytes_budget= size the paged-pool "
+                "scheduler: build the engine with continuous=True")
         self._decode_burst_hook = decode_burst_hook
         # cross-request prefix cache (serving/prefixcache.py): cache-hit
         # admissions clone their matched prefix's block table and
@@ -716,6 +728,8 @@ class ParallelInference:
                 net=self.net, registry=self._registry, device=dev,
                 slots=self.decode_slots, burst_tokens=self.decode_burst,
                 block_size=self.kv_block_size, num_blocks=self.kv_blocks,
+                kv_quant=self.kv_quant,
+                kv_bytes_budget=self.kv_bytes_budget,
                 queue_capacity=self._rq.maxsize,
                 burst_hook=self._decode_burst_hook,
                 on_resolve=self._note_resolved,

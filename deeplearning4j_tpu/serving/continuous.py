@@ -314,7 +314,8 @@ class ContinuousDecodeScheduler:
                  start: bool = True, burst_hook=None, on_resolve=None,
                  prefix_cache: bool = False,
                  prefix_cache_blocks: Optional[int] = None,
-                 on_fatal=None):
+                 on_fatal=None, kv_quant: Optional[str] = None,
+                 kv_bytes_budget: Optional[int] = None):
         if net is None and registry is None:
             raise ValueError(
                 "ContinuousDecodeScheduler needs a net or a registry")
@@ -332,6 +333,24 @@ class ContinuousDecodeScheduler:
         self.burst_tokens = max(1, int(burst_tokens))
         self.block_size = max(1, int(block_size))
         self._num_blocks = num_blocks
+        # quantized KV (nn/quantize.py + the kvpool quant variant):
+        # "int8"/"fp8" stores pool values at 1 byte/element with
+        # per-(position, head) scales — same block accounting, same
+        # program ladder, ~2-4x the decode rows per device byte.
+        # kv_bytes_budget sizes num_blocks FROM a device-byte budget
+        # (per pool), so "same bytes, more rows" is a config, not math
+        # the caller repeats.
+        if kv_quant is not None:
+            from deeplearning4j_tpu.nn.quantize import quant_modes
+            if kv_quant not in quant_modes():
+                raise ValueError(
+                    f"unknown kv_quant {kv_quant!r}; pick from "
+                    f"{quant_modes()}")
+        self.kv_quant = kv_quant
+        if kv_bytes_budget is not None and num_blocks is not None:
+            raise ValueError("kv_bytes_budget= and num_blocks= are "
+                             "exclusive — the budget derives num_blocks")
+        self._kv_bytes_budget = kv_bytes_budget
         self.queue_capacity = max(1, int(queue_capacity))
         # same-(lane, bucket) admissions coalesce into one prefill up
         # the row ladder (a spike pays one dispatch chain, not N)
@@ -509,6 +528,7 @@ class ContinuousDecodeScheduler:
                 "slots": self.slots,
                 "burst_tokens": self.burst_tokens,
                 "block_size": self.block_size,
+                "kv_quant": self.kv_quant,
                 "lanes": len(self._lanes),
                 "active_sequences": active,
                 "queued_prefills": queued,
@@ -761,7 +781,8 @@ class ContinuousDecodeScheduler:
                 f"{type(gen).__name__} nets have none — serve them through "
                 "the whole-burst submit_generate path")
         n_layers, heads, hd, dtype = gen.kv_layout()
-        spec = pool_spec(n_layers, heads, hd, self.block_size, dtype)
+        spec = pool_spec(n_layers, heads, hd, self.block_size, dtype,
+                         self.kv_quant)
         # sliced net: the pool's block arrays shard their HEADS axis
         # over the slice's tp axis (per-head attention is
         # shard-independent — accounting and arithmetic unchanged)
@@ -770,6 +791,14 @@ class ContinuousDecodeScheduler:
             pool = self._pools.get(spec)
             if pool is None:
                 blocks = self._num_blocks
+                if blocks is None and self._kv_bytes_budget is not None:
+                    # byte-budget sizing: a quantized pool's smaller
+                    # block_bytes buys MORE blocks from the same budget
+                    # — the "same bytes, 2-4x the rows" knob
+                    bb = PagedKVCachePool.bytes_per_block(
+                        n_layers, self.block_size, heads, hd, dtype,
+                        self.kv_quant)
+                    blocks = max(2, int(self._kv_bytes_budget) // bb + 1)
                 if blocks is None:
                     # default: every slot can reach full context — the
                     # no-preemption budget; size DOWN to exercise
@@ -781,7 +810,8 @@ class ContinuousDecodeScheduler:
                     dtype, device=None if kv_sharding is not None
                     else self.device,
                     sharding=kv_sharding,
-                    name=model if model is not None else "decode")
+                    name=model if model is not None else "decode",
+                    quant=self.kv_quant)
                 self._pools[spec] = pool
                 if self.prefix_cache:
                     from deeplearning4j_tpu.serving.prefixcache import \

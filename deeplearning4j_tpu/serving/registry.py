@@ -84,6 +84,20 @@ class ModelQuarantined(ModelUnavailable):
     (cotenant models keep serving) until a probe heals it."""
 
 
+class QualityGateFailed(RuntimeError):
+    """A deploy's ``quality_gate`` (the nn/quantize.py accuracy-delta
+    harness, or any ``(stable_net, new_net) -> verdict`` callable)
+    measured the candidate outside its quality bound: the deploy is
+    rejected BEFORE any traffic shifts — the stable version never
+    stopped serving (the canary auto-rollback discipline, applied at
+    deploy time with a measured verdict). ``verdict`` carries the
+    harness numbers."""
+
+    def __init__(self, msg: str, verdict=None):
+        super().__init__(msg)
+        self.verdict = verdict
+
+
 # version lifecycle states
 STATE_STAGED = "staged"      # loaded + warmed, not yet taking traffic
 STATE_ACTIVE = "active"      # the version new requests resolve to
@@ -121,6 +135,10 @@ class ModelVersion:
         self._nbytes: Optional[int] = None
         # devkey -> (params, states); managed under the REGISTRY lock
         self.pins: Dict[str, Tuple[Any, Any]] = {}
+        # devkey -> bytes actually charged for that pin (the REAL
+        # nbytes of the pinned params+states pytree — an int8-quantized
+        # version charges its int8 footprint, not an assumed-fp32 one)
+        self.pin_bytes: Dict[str, int] = {}
         self.last_used = 0.0  # registry LRU tick
         # serving stats (under the registry lock)
         self.requests = 0
@@ -453,11 +471,16 @@ class ModelRegistry:
         net = ver.net()
         params = jax.device_put(net.params, device)
         states = jax.device_put(net.states, device)
-        size = ver.nbytes()
+        # charge the ACTUAL nbytes of the pinned pytree (params AND
+        # states): the serialized/fp32-shaped estimate overcharged
+        # quantized versions — an int8 model now admits ~4x the
+        # cotenants its fp32 twin would under the same budget
+        size = _tree_nbytes(params) + _tree_nbytes(states)
         with self._lock:
             if key not in ver.pins:
                 self._evict_for(size, exclude=ver)
                 ver.pins[key] = (params, states)
+                ver.pin_bytes[key] = size
                 self._pinned_bytes += size
         self._gauge_pinned()
         return ver.fn(), params, states
@@ -492,8 +515,11 @@ class ModelRegistry:
         # model the budget cannot fit is better served than refused
 
     def _unpin_all(self, ver: ModelVersion) -> int:
-        freed = len(ver.pins) * ver.nbytes() if ver.pins else 0
+        # release exactly what each pin was charged (pin_bytes — the
+        # actual pinned-pytree sizes, not a per-version estimate)
+        freed = sum(ver.pin_bytes.get(k, 0) for k in ver.pins)
         ver.pins.clear()
+        ver.pin_bytes.clear()
         self._pinned_bytes = max(0, self._pinned_bytes - freed)
         self._gauge_pinned()
         return freed
@@ -512,17 +538,24 @@ class ModelRegistry:
                warm: bool = True,
                canary_min_requests: Optional[int] = None,
                canary_max_error_rate: Optional[float] = None,
-               canary_p99_factor: Optional[float] = None) -> int:
+               canary_p99_factor: Optional[float] = None,
+               quality_gate=None) -> int:
         """Zero-downtime deploy of a new version.
 
         Order of operations is the whole contract: (1) integrity-check
         — a corrupt checkpoint raises :class:`CheckpointCorruptError`
         HERE and the old version never stops serving; (2) load + AOT-
         warm the staged version on every attached engine's replicas,
-        off the hot path; (3) atomically cut over (or enter canary —
-        ``canary_fraction > 0`` keeps the old version active and routes
-        the fraction to the new one until :meth:`promote` or the watch
-        rolls it back). Returns the new version number."""
+        off the hot path; (2b) run ``quality_gate(stable_net, new_net)``
+        when given (the nn/quantize.py accuracy-delta harness via
+        ``make_quality_gate`` is the canonical one — a quantized canary
+        ships with a measured quality bound): a failing verdict rejects
+        the deploy typed :class:`QualityGateFailed` before ANY traffic
+        shifts, counted as a ``quality_gate`` rollback; (3) atomically
+        cut over (or enter canary — ``canary_fraction > 0`` keeps the
+        old version active and routes the fraction to the new one until
+        :meth:`promote` or the watch rolls it back). Returns the new
+        version number."""
         entry = self.entry(name)
         if net is None and path is None:
             raise ValueError("deploy needs a net or a checkpoint path")
@@ -551,6 +584,8 @@ class ModelRegistry:
             record_fault("deploy")
             mark("model_deploy_rejected", model=name, version=new_v)
             raise
+        if quality_gate is not None:
+            self._run_quality_gate(entry, ver, quality_gate)
         with self._lock:
             if canary_fraction > 0.0:
                 entry.canary = new_v
@@ -574,6 +609,38 @@ class ModelRegistry:
         self._gauge_breaker(name, breaker_now)
         mark("model_deployed", model=name, version=new_v, outcome=outcome)
         return new_v
+
+    def _run_quality_gate(self, entry: _ModelEntry,
+                          ver: ModelVersion, quality_gate) -> None:
+        """Arbitrate a staged version by measured quality: the gate
+        sees (stable net or None, candidate net) and returns either an
+        accuracy-harness verdict dict (``{"passed": bool, ...}``) or a
+        bare bool. Fail → the candidate is removed (it never served),
+        the outcome is counted like a canary auto-rollback, and
+        :class:`QualityGateFailed` carries the numbers."""
+        with self._lock:
+            stable_ver = (entry.versions.get(entry.active)
+                          if entry.active is not None else None)
+        stable_net = stable_ver.net() if stable_ver is not None else None
+        verdict = quality_gate(stable_net, ver.net())
+        passed = (bool(verdict.get("passed", False))
+                  if isinstance(verdict, dict) else bool(verdict))
+        if passed:
+            return
+        with self._lock:
+            ver.state = STATE_REJECTED
+            entry.versions.pop(ver.version, None)
+            self._unpin_all(ver)
+        self._count_deploy(entry.name, "rejected_quality")
+        self._count_rollback(entry.name, "quality_gate")
+        record_fault("deploy")
+        mark("model_deploy_rejected", model=entry.name,
+             version=ver.version, reason="quality_gate")
+        detail = verdict if isinstance(verdict, dict) else "gate False"
+        raise QualityGateFailed(
+            f"model {entry.name!r} v{ver.version} failed its quality "
+            f"gate: {detail} — the stable version never stopped serving",
+            verdict=verdict)
 
     def _warm(self, entry: _ModelEntry, ver: ModelVersion) -> None:
         """AOT-compile the staged version's program set on every

@@ -498,6 +498,14 @@ KNOWN_DL4J_METRICS = {
     "dl4j_slice_degraded",
     "dl4j_slice_rebuilds_total",
     "dl4j_disagg_kv_handoffs_total",
+    # quantized serving plane (nn/quantize.py weight quantization +
+    # the nn/kvpool.py quantized paged KV pool): quantized-net count
+    # by dtype, quantized-pool block gauge, per-matrix dequant scale
+    # stats, and the accuracy-gate pass/fail verdict counter
+    "dl4j_quant_models",
+    "dl4j_quant_kv_blocks",
+    "dl4j_quant_scale_absmax",
+    "dl4j_quant_accuracy_gate_outcome_total",
     # fault-tolerance plane (supervisor / quarantine / dead-letter /
     # checkpoint integrity — see monitor/__init__.py FAULT_* names)
     "dl4j_fault_events_total",

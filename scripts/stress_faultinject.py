@@ -25,7 +25,8 @@ schedules, flaky-broker schedules, torn-write counting, replica/model
 poison sequences, burst-kill windows, mesh-shrink drills, and the
 composed ChaosSchedule event clock, the prefix-cache
 refcount/COW/eviction accounting drill, and the slice-kill /
-slice-drill schedules — sections 1–9) twice per seed
+slice-drill schedules, and the quantized-pool × prefix-cache
+accounting drill — sections 1–10) twice per seed
 across rotating seeds and compares the full event logs bit-for-bit.
 It runs in milliseconds with no subprocess and no jax compute, so the
 tier-1 sweep carries it on every run; the full mode is the pre-merge /
@@ -283,6 +284,76 @@ def _scenario_log(seed: int) -> str:
         cs = ChaosSchedule(seed, n_events=n_events, n_endpoints=2,
                            actions=SLICE_ACTIONS)
         events.append(f"slice_chaos[{n_events}]={cs.signature()}")
+
+    # 10) quantized-KV × prefix-cache interop (nn/quantize.py + the
+    # kvpool quant variant): the section-8 admit/retire/kill/evict
+    # battery replayed on a TINY INT8 pool — block ids, refcounts,
+    # shared/COW accounting and the free list must replay
+    # bit-identically (scale arrays ride the same block addressing, so
+    # accounting is the whole sharing contract), the pool must drain
+    # to fully-free with zero leaks, a double free must raise, and the
+    # quantized layout facts are pinned: a quantized spec NEVER
+    # matches the fp32 spec (a quantized lane cannot silently share an
+    # fp32 pool) and its per-block bytes land in the 2-4x compression
+    # band that buys the extra decode rows.
+    qpool = PagedKVCachePool(17, 2, num_layers=1, num_heads=1, head_dim=8,
+                             name=f"qq{seed}", quant="int8")
+    fpool = PagedKVCachePool(3, 2, num_layers=1, num_heads=1, head_dim=8,
+                             name=f"qf{seed}")
+    events.append(f"qkv spec_differs={qpool.spec != fpool.spec} "
+                  f"ratio={fpool.block_bytes() / qpool.block_bytes():.3f} "
+                  f"scales={sorted(qpool.layers[0])}")
+    qcache = PrefixCache(qpool)
+    rngA = np.random.default_rng(seed * 131 + 7)
+    qlive: List[tuple] = []
+    for i in range(24):
+        op = int(rngA.integers(0, 4))
+        if op == 0:
+            t = int(rngA.integers(3, 9))
+            toks = [int(x) for x in rngA.integers(0, 4, t)]
+            m, full, part = qcache.match(lane, toks)
+            got = qpool.alloc(qpool.blocks_for(t) - len(full))
+            if got is None:
+                qpool.free_blocks(full
+                                  + ([part] if part is not None else []))
+                events.append(f"qkv {i} admit-short m={m}")
+                continue
+            if part is not None:
+                # COW on a quantized pool: the fresh block stands in
+                # (its scale rows clone with it on device), the shared
+                # reference drops — accounting identical to fp32
+                blocks = full + got
+                qpool.free_blocks([part])
+                events.append(f"qkv {i} cow m={m}")
+            else:
+                blocks = full + got
+            qlive.append((blocks, toks))
+            events.append(f"qkv {i} admit m={m} blocks={blocks}")
+        elif op == 1 and qlive:
+            blocks, toks = qlive.pop(int(rngA.integers(0, len(qlive))))
+            pinned = qcache.insert(lane, toks, blocks)
+            qpool.free_blocks(blocks)
+            events.append(f"qkv {i} retire pinned={pinned} "
+                          f"free={qpool.free_count}")
+        elif op == 2 and qlive:
+            blocks, _ = qlive.pop(int(rngA.integers(0, len(qlive))))
+            qpool.free_blocks(blocks)
+            events.append(f"qkv {i} kill free={qpool.free_count}")
+        else:
+            freed = qcache.reclaim(int(rngA.integers(1, 4)))
+            events.append(f"qkv {i} evict freed={freed} "
+                          f"cached={qcache.cached_blocks()}")
+    for blocks, _ in qlive:
+        qpool.free_blocks(blocks)
+    qcache.clear()
+    try:
+        qpool.free_blocks([1])
+        events.append("qkv double-free MISSED")
+    except RuntimeError:
+        events.append("qkv double-free caught")
+    events.append(f"qkv final free={qpool.free_count}/{qpool.total_blocks} "
+                  f"shared={qpool.shared_count()} "
+                  f"leaked={qpool.total_blocks - qpool.free_count}")
     return "\n".join(events)
 
 
@@ -338,7 +409,7 @@ def run_chaos(runs: int, seed_base: int, n_requests: int = 14,
     """The `chaos` section: run the composed drill TWICE per seed in
     fresh subprocesses across rotating seeds; fail on any invariant
     violation OR any outcome drift between the two replays of one
-    seed — the same determinism contract sections 1–9 pin for the
+    seed — the same determinism contract sections 1–10 pin for the
     injectors, applied to the whole composed drill."""
     bad = 0
     for i in range(runs):
