@@ -1,0 +1,32 @@
+"""dl4j-analyze — unified static analysis pinning the serving plane's
+invariants.
+
+Public surface::
+
+    from deeplearning4j_tpu.analysis import analyze, all_rules
+    report = analyze()          # whole repo, every rule, baseline
+    report.ok                   # True iff zero NEW findings
+
+``scripts/analyze.py`` is the CLI; the legacy ``scripts/check_*.py``
+entrypoints are thin shims over the ported rules;
+``stress_faultinject.quick_check`` runs ``analyze()`` as section 0.
+See MIGRATION.md "Static analysis" for the rule catalog, the
+suppression syntax and the baseline workflow.
+"""
+
+from deeplearning4j_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    Project,
+    Report,
+    Rule,
+    analyze,
+    load_baseline,
+    render_json,
+    render_text,
+    repo_root,
+    write_baseline,
+)
+from deeplearning4j_tpu.analysis.rules import (  # noqa: F401
+    all_rules,
+    rule_by_name,
+)

@@ -1,0 +1,608 @@
+"""dl4j-analyze — the shared AST engine every lint rule runs on.
+
+The serving plane rests on invariants no runtime test can pin forever:
+zero steady-state compiles, zero added device syncs on the decode hot
+path, typed errors across version-skewed wire peers, a thread-per-
+connection plane where a dozen modules each hold their own lock with no
+global ordering, and a per-row PRNG clock whose determinism is the
+whole preempt/resume contract. ``check_mesh_api.py`` proved the shape
+that works here: encode the invariant as a machine-checked AST rule and
+the bug class dies permanently. This module is that shape factored out
+— one walker, one suppression/baseline mechanism, one reporter pair —
+so a rule is ~a page of logic instead of a script.
+
+Pieces:
+
+- :class:`ModuleInfo` — one parsed file: source, AST, per-line
+  suppressions, functions (with qualnames + call sites), classes, and
+  the lock/assignment facts rules ask for lazily.
+- :class:`Project` — the analyzed file set (repo walk or an explicit
+  path list) plus the **intra-package call graph**: call sites resolve
+  ``self.m()`` through the caller's class, ``obj.m()`` through the
+  receiver's statically-known class (annotations and local
+  ``x = ClassName(...)`` bindings), and fall back to every in-scope
+  function of that name — a deliberate over-approximation: reachability
+  rules would rather traverse too much than miss a path.
+- **Suppressions** — ``# dl4j-lint: disable=<rule>[,<rule>...]`` on the
+  flagged line (or on a comment-only line directly above it) marks the
+  finding suppressed; ``disable=all`` silences every rule. A
+  suppression is the documented form of "this site is sanctioned" —
+  the comment around it says why.
+- **Baseline** — a committed JSON file of grandfathered findings keyed
+  by (rule, path, message) — line-number-free so unrelated edits don't
+  churn it. ``analyze()`` marks baselined findings; only NEW findings
+  fail the run. ``--write-baseline`` regenerates it.
+- **Reporters** — ``render_text`` / ``render_json`` for the CLI and
+  the quick_check wiring.
+
+Rules implement :class:`Rule` and register in
+``deeplearning4j_tpu.analysis.rules``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+#: directories never walked (fixture corpora carry DELIBERATE seeded
+#: violations for tests/test_lint.py — they are analyzed explicitly,
+#: never as part of the repo sweep)
+EXCLUDED_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules",
+                 "lint_fixtures"}
+
+#: the in-repo package the package-scoped rules (metric names, lock
+#: order, typed raises, PRNG, hot paths) restrict themselves to
+PACKAGE_DIR = "deeplearning4j_tpu"
+
+_SUPPRESS_RE = re.compile(r"#\s*dl4j-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+_MARKER_RE = re.compile(r"#\s*dl4j-lint:\s*([a-z\-]+)\b")
+
+DEFAULT_BASELINE = os.path.join("scripts", "analyze_baseline.json")
+
+
+class Finding:
+    """One rule violation at one site. The baseline identity is
+    (rule, path, message) — deliberately line-free, so a finding
+    survives unrelated edits above it; keep messages stable and free
+    of line numbers."""
+
+    __slots__ = ("rule", "path", "line", "message", "suppressed",
+                 "baselined")
+
+    def __init__(self, rule: str, path: str, line: int, message: str):
+        self.rule = rule
+        self.path = path.replace(os.sep, "/")
+        self.line = int(line)
+        self.message = message
+        self.suppressed = False
+        self.baselined = False
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.message}"
+
+    @property
+    def new(self) -> bool:
+        return not (self.suppressed or self.baselined)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "suppressed": self.suppressed,
+                "baselined": self.baselined}
+
+    def __repr__(self) -> str:  # debugging ergonomics
+        return f"<Finding {self.render()}>"
+
+
+class Rule:
+    """SPI one lint rule implements. ``check`` returns every violation
+    it sees — the ENGINE applies suppressions and the baseline, so a
+    rule never needs to know about either."""
+
+    #: rule id — what suppressions and the CLI name (kebab-case)
+    name: str = ""
+    #: one-line invariant statement for ``--list-rules`` / MIGRATION.md
+    description: str = ""
+
+    def check(self, project: "Project") -> List[Finding]:
+        raise NotImplementedError
+
+
+def attr_chain(node: ast.AST) -> str:
+    """Dotted name of an attribute chain ('jax.random.split'), '' when
+    the base is not a plain name (calls, subscripts)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    """The callee's last-component name ('submit' for ``a.b.submit(x)``,
+    'len' for ``len(x)``), '' when dynamic."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+class FunctionInfo:
+    """One function/method: its AST, owning class (or None), and every
+    call site in its body (nested defs excluded — they get their own
+    FunctionInfo and are only reachable if called)."""
+
+    __slots__ = ("module", "qualname", "name", "cls", "node", "_calls")
+
+    def __init__(self, module: "ModuleInfo", qualname: str, name: str,
+                 cls: Optional[str], node: ast.AST):
+        self.module = module
+        self.qualname = qualname      # e.g. "EngineWorker._serve_loop"
+        self.name = name
+        self.cls = cls
+        self.node = node
+        self._calls: Optional[List[ast.Call]] = None
+
+    @property
+    def calls(self) -> List[ast.Call]:
+        if self._calls is None:
+            out = []
+            for n in walk_body(self.node):
+                if isinstance(n, ast.Call):
+                    out.append(n)
+            self._calls = out
+        return self._calls
+
+    def markers(self) -> Set[str]:
+        """dl4j-lint markers on the ``def`` line (e.g. ``hot-path``,
+        ``wire-handler``) — how fixture corpora opt single functions
+        into path-scoped rules without touching the rule config."""
+        line = self.module.lines[self.node.lineno - 1] \
+            if self.node.lineno - 1 < len(self.module.lines) else ""
+        return set(_MARKER_RE.findall(line)) - {"disable"}
+
+    def local_classes(self) -> Dict[str, str]:
+        """var name → class name, from parameter annotations
+        (``rf: _Routed``) and local ``x = ClassName(...)`` bindings —
+        the receiver-type facts the call graph and the lock-order rule
+        resolve non-self attribute access through."""
+        out: Dict[str, str] = {}
+        args = getattr(self.node, "args", None)
+        if args is not None:
+            for a in (list(args.posonlyargs) + list(args.args)
+                      + list(args.kwonlyargs)):
+                ann = a.annotation
+                if isinstance(ann, ast.Constant) and \
+                        isinstance(ann.value, str):
+                    out[a.arg] = ann.value.strip().strip('"\'').split(".")[-1]
+                elif ann is not None:
+                    chain = attr_chain(ann)
+                    if chain:
+                        out[a.arg] = chain.split(".")[-1]
+        for n in walk_body(self.node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    isinstance(n.value, ast.Call):
+                cn = call_name(n.value)
+                if cn and cn[:1].isupper():
+                    out[n.targets[0].id] = cn
+        return out
+
+
+def walk_body(fn_node: ast.AST) -> Iterable[ast.AST]:
+    """Every node in a function body EXCLUDING nested function/class
+    definitions' bodies (lambdas included — a lambda's body only runs
+    when called, but in this codebase lambdas are overwhelmingly
+    immediate callbacks, so they stay in: excluding them would blind
+    the host-sync rule to ``lambda: np.asarray(...)`` callbacks)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue  # nested scope: analyzed as its own function
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class ModuleInfo:
+    """One parsed source file plus the per-line facts the engine owns:
+    suppressions and the function/class index."""
+
+    def __init__(self, path: str, rel: str, in_package: bool = False):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            self.src = f.read()
+        self.lines = self.src.splitlines()
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(self.src,
+                                                     filename=self.rel)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = str(e)
+        self.in_package = in_package or self.rel.startswith(
+            PACKAGE_DIR + "/")
+        self._suppressions: Optional[Dict[int, Set[str]]] = None
+        self._functions: Optional[Dict[str, FunctionInfo]] = None
+        self._lock_attrs: Optional[Dict[Tuple[str, str], str]] = None
+
+    # ------------------------------------------------------ suppressions
+
+    @property
+    def suppressions(self) -> Dict[int, Set[str]]:
+        """1-based line → suppressed rule names. A pragma applies to
+        its own line, and — when the line is comment-only — to the next
+        code line below it (the two shapes real suppressions take)."""
+        if self._suppressions is None:
+            sup: Dict[int, Set[str]] = {}
+            for i, line in enumerate(self.lines, 1):
+                m = _SUPPRESS_RE.search(line)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                sup.setdefault(i, set()).update(rules)
+                if line.lstrip().startswith("#"):
+                    # comment-only pragma: covers the statement below
+                    j = i + 1
+                    while j <= len(self.lines) and (
+                            not self.lines[j - 1].strip()
+                            or self.lines[j - 1].lstrip().startswith("#")):
+                        j += 1
+                    if j <= len(self.lines):
+                        sup.setdefault(j, set()).update(rules)
+            self._suppressions = sup
+        return self._suppressions
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(int(line))
+        return bool(rules) and (rule in rules or "all" in rules)
+
+    # -------------------------------------------------------- functions
+
+    @property
+    def functions(self) -> Dict[str, FunctionInfo]:
+        """qualname → FunctionInfo for every def in the module
+        (methods as ``Class.name``, nested defs as
+        ``outer.<locals>.inner``)."""
+        if self._functions is None:
+            self._functions = {}
+            if self.tree is not None:
+                self._index(self.tree, prefix="", cls=None)
+        return self._functions
+
+    def _index(self, node: ast.AST, prefix: str, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._index(child, prefix=child.name + ".", cls=child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = prefix + child.name
+                self._functions[qn] = FunctionInfo(
+                    self, qn, child.name, cls, child)
+                self._index(child, prefix=qn + ".<locals>.", cls=cls)
+
+    @property
+    def classes(self) -> List[str]:
+        if self.tree is None:
+            return []
+        return [n.name for n in ast.iter_child_nodes(self.tree)
+                if isinstance(n, ast.ClassDef)]
+
+    # ------------------------------------------------------------ locks
+
+    @property
+    def lock_attrs(self) -> Dict[Tuple[str, str], str]:
+        """(class, attr) → lock id for every ``self.X = threading.
+        Lock()/RLock()/Condition(...)`` in the module, plus
+        ('', name) entries for module-level locks. A Condition built on
+        an existing lock ALIASES that lock's id (acquiring the
+        condition acquires the lock)."""
+        if self._lock_attrs is not None:
+            return self._lock_attrs
+        out: Dict[Tuple[str, str], str] = {}
+        if self.tree is None:
+            self._lock_attrs = out
+            return out
+
+        def lock_ctor(v: ast.AST) -> Optional[str]:
+            if not isinstance(v, ast.Call):
+                return None
+            chain = attr_chain(v.func)
+            if chain in ("threading.Lock", "threading.RLock",
+                         "Lock", "RLock"):
+                return "lock"
+            if chain in ("threading.Condition", "Condition"):
+                return "condition"
+            return None
+
+        for cls_node in ast.iter_child_nodes(self.tree):
+            if isinstance(cls_node, ast.ClassDef):
+                cname = cls_node.name
+                for n in ast.walk(cls_node):
+                    if not (isinstance(n, ast.Assign)
+                            and len(n.targets) == 1):
+                        continue
+                    t = n.targets[0]
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    kind = lock_ctor(n.value)
+                    if kind is None:
+                        continue
+                    lock_id = f"{cname}.{t.attr}"
+                    if kind == "condition" and n.value.args:
+                        base = n.value.args[0]
+                        if isinstance(base, ast.Attribute) and \
+                                isinstance(base.value, ast.Name) and \
+                                base.value.id == "self":
+                            lock_id = f"{cname}.{base.attr}"  # alias
+                    out[(cname, t.attr)] = lock_id
+            elif isinstance(cls_node, ast.Assign) and \
+                    len(cls_node.targets) == 1 and \
+                    isinstance(cls_node.targets[0], ast.Name):
+                if lock_ctor(cls_node.value) is not None:
+                    name = cls_node.targets[0].id
+                    out[("", name)] = f"{self.rel}:{name}"
+        self._lock_attrs = out
+        return out
+
+
+class Project:
+    """The analyzed file set + the cross-module indexes rules share."""
+
+    def __init__(self, root: str, paths: Optional[List[str]] = None,
+                 rels: Optional[List[str]] = None):
+        """``paths`` analyzes an explicit file list (fixture corpora;
+        every listed file is treated as in-package so package-scoped
+        rules see it); default walks ``root``."""
+        self.root = root
+        self.modules: List[ModuleInfo] = []
+        if paths is not None:
+            for i, p in enumerate(paths):
+                rel = (rels[i] if rels is not None
+                       else os.path.basename(p))
+                self.modules.append(ModuleInfo(p, rel, in_package=True))
+        else:
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in EXCLUDED_DIRS)
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        p = os.path.join(dirpath, name)
+                        self.modules.append(
+                            ModuleInfo(p, os.path.relpath(p, root)))
+        self.by_rel: Dict[str, ModuleInfo] = {m.rel: m
+                                              for m in self.modules}
+        self._fn_by_name: Optional[Dict[str, List[FunctionInfo]]] = None
+        self._class_module: Optional[Dict[str, List[ModuleInfo]]] = None
+
+    # ----------------------------------------------------------- scopes
+
+    @property
+    def package_modules(self) -> List[ModuleInfo]:
+        return [m for m in self.modules if m.in_package]
+
+    def module(self, rel_suffix: str) -> Optional[ModuleInfo]:
+        for m in self.modules:
+            if m.rel.endswith(rel_suffix):
+                return m
+        return None
+
+    # ---------------------------------------------------------- indexes
+
+    @property
+    def functions_by_name(self) -> Dict[str, List[FunctionInfo]]:
+        if self._fn_by_name is None:
+            idx: Dict[str, List[FunctionInfo]] = {}
+            for m in self.package_modules:
+                for fi in m.functions.values():
+                    idx.setdefault(fi.name, []).append(fi)
+            self._fn_by_name = idx
+        return self._fn_by_name
+
+    @property
+    def classes_by_name(self) -> Dict[str, List[ModuleInfo]]:
+        if self._class_module is None:
+            idx: Dict[str, List[ModuleInfo]] = {}
+            for m in self.package_modules:
+                for c in m.classes:
+                    idx.setdefault(c, []).append(m)
+            self._class_module = idx
+        return self._class_module
+
+    def methods_of(self, cls: str, name: str) -> List[FunctionInfo]:
+        out = []
+        for m in self.classes_by_name.get(cls, []):
+            fi = m.functions.get(f"{cls}.{name}")
+            if fi is not None:
+                out.append(fi)
+        return out
+
+    # ------------------------------------------------------- call graph
+
+    def resolve_call(self, caller: FunctionInfo, call: ast.Call,
+                     module_filter: Optional[Callable[[ModuleInfo], bool]]
+                     = None) -> List[FunctionInfo]:
+        """Candidate callees for one call site. Resolution ladder:
+        ``self.m()`` → the caller's class's own ``m`` when it defines
+        one; ``obj.m()`` with a statically-known receiver class → that
+        class's ``m``; otherwise every in-package function named ``m``
+        (the over-approximation reachability rules want). ``f()`` →
+        same-module ``f`` first. ``module_filter`` restricts candidates
+        (e.g. the typed-raise rule's serve-side cone)."""
+        name = call_name(call)
+        if not name:
+            return []
+        f = call.func
+        cands: List[FunctionInfo] = []
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            recv = f.value.id
+            if recv == "self" and caller.cls:
+                cands = self.methods_of(caller.cls, name)
+            else:
+                rc = caller.local_classes().get(recv)
+                if rc:
+                    cands = self.methods_of(rc, name)
+        elif isinstance(f, ast.Name):
+            own = caller.module.functions.get(name)
+            if own is not None:
+                cands = [own]
+        if not cands:
+            cands = self.functions_by_name.get(name, [])
+        if module_filter is not None:
+            cands = [c for c in cands if module_filter(c.module)]
+        return cands
+
+    def reachable(self, roots: List[FunctionInfo],
+                  module_filter: Optional[Callable[[ModuleInfo], bool]]
+                  = None) -> List[FunctionInfo]:
+        """Transitive closure over the call graph from ``roots``
+        (roots included)."""
+        seen: Dict[Tuple[str, str], FunctionInfo] = {}
+        stack = list(roots)
+        while stack:
+            fi = stack.pop()
+            key = (fi.module.rel, fi.qualname)
+            if key in seen:
+                continue
+            seen[key] = fi
+            for call in fi.calls:
+                for callee in self.resolve_call(fi, call, module_filter):
+                    if (callee.module.rel, callee.qualname) not in seen:
+                        stack.append(callee)
+        return list(seen.values())
+
+
+# ---------------------------------------------------------------- runs
+
+
+class Report:
+    """One analyze() run: every finding, already marked suppressed /
+    baselined; ``ok`` iff nothing NEW."""
+
+    def __init__(self, findings: List[Finding], rules: List[str],
+                 files: int):
+        self.findings = findings
+        self.rules = rules
+        self.files = files
+
+    @property
+    def new(self) -> List[Finding]:
+        return [f for f in self.findings if f.new]
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+    def counts(self) -> Dict[str, int]:
+        return {
+            "new": sum(1 for f in self.findings if f.new),
+            "suppressed": sum(1 for f in self.findings if f.suppressed),
+            "baselined": sum(1 for f in self.findings if f.baselined),
+        }
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"ok": self.ok, "files": self.files, "rules": self.rules,
+                "counts": self.counts(),
+                "findings": [f.as_dict() for f in self.findings]}
+
+
+def load_baseline(path: str) -> Set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {f'{e["rule"]}::{e["path"]}::{e["message"]}'
+            for e in data.get("findings", [])}
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    """Grandfather every given finding. Each entry carries a ``note``
+    slot the committer fills in with WHY it is accepted — an empty
+    baseline is the healthy steady state."""
+    entries = [{"rule": f.rule, "path": f.path, "message": f.message,
+                "note": ""}
+               for f in findings]
+    entries.sort(key=lambda e: (e["rule"], e["path"], e["message"]))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"findings": entries}, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def analyze(root: Optional[str] = None,
+            rules: Optional[List[Rule]] = None,
+            paths: Optional[List[str]] = None,
+            rels: Optional[List[str]] = None,
+            baseline: Optional[str] = None) -> Report:
+    """Run ``rules`` (default: every registered rule) over ``root``
+    (default: the repo root) or an explicit ``paths`` list, apply
+    suppressions + the committed baseline, and return the
+    :class:`Report`. This is what ``scripts/analyze.py``, the legacy
+    ``check_*`` shims, quick_check section 0 and tier-1 all call."""
+    if root is None:
+        root = repo_root()
+    if rules is None:
+        from deeplearning4j_tpu.analysis.rules import all_rules
+        rules = all_rules()
+    project = Project(root, paths=paths, rels=rels)
+    if baseline is None:
+        baseline = os.path.join(root, DEFAULT_BASELINE)
+    known = load_baseline(baseline) if paths is None else set()
+    findings: List[Finding] = []
+    for m in project.modules:
+        if m.parse_error is not None:
+            findings.append(Finding("parse", m.rel, 1,
+                                    f"unparseable ({m.parse_error})"))
+    for rule in rules:
+        for f in rule.check(project):
+            m = project.by_rel.get(f.path)
+            if m is not None and m.suppressed(f.rule, f.line):
+                f.suppressed = True
+            elif f.key in known:
+                f.baselined = True
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return Report(findings, [r.name for r in rules], len(project.modules))
+
+
+def repo_root() -> str:
+    """The directory containing the ``deeplearning4j_tpu`` package."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+# ------------------------------------------------------------ reporters
+
+
+def render_text(report: Report, verbose: bool = False) -> str:
+    lines = []
+    for f in report.findings:
+        if f.new:
+            lines.append(f.render())
+        elif verbose:
+            tag = "suppressed" if f.suppressed else "baselined"
+            lines.append(f"{f.render()}  ({tag})")
+    c = report.counts()
+    lines.append(
+        f"{'ok' if report.ok else 'FAIL'}: {report.files} files, "
+        f"{len(report.rules)} rules — {c['new']} new, "
+        f"{c['suppressed']} suppressed, {c['baselined']} baselined")
+    return "\n".join(lines)
+
+
+def render_json(report: Report) -> str:
+    return json.dumps(report.as_dict(), indent=1, sort_keys=True)

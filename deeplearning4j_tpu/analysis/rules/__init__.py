@@ -1,0 +1,44 @@
+"""Rule registry for dl4j-analyze.
+
+Three rules are PORTS of the pre-engine ``scripts/check_*.py`` lints
+(their CLIs remain as thin shims over these); five are new, each
+pinning one load-bearing serving-plane invariant. Order is stable —
+reports and the baseline sort by it.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from deeplearning4j_tpu.analysis.engine import Rule
+from deeplearning4j_tpu.analysis.rules.donation_gate import DonationGateRule
+from deeplearning4j_tpu.analysis.rules.host_sync import HostSyncRule
+from deeplearning4j_tpu.analysis.rules.lock_order import LockOrderRule
+from deeplearning4j_tpu.analysis.rules.mesh_api import MeshApiRule
+from deeplearning4j_tpu.analysis.rules.metric_names import MetricNameRule
+from deeplearning4j_tpu.analysis.rules.prng_reuse import PrngReuseRule
+from deeplearning4j_tpu.analysis.rules.recompile import RecompileHazardRule
+from deeplearning4j_tpu.analysis.rules.typed_raise import TypedWireRaiseRule
+
+_RULES = (
+    DonationGateRule,
+    MeshApiRule,
+    MetricNameRule,
+    LockOrderRule,
+    HostSyncRule,
+    RecompileHazardRule,
+    TypedWireRaiseRule,
+    PrngReuseRule,
+)
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in _RULES]
+
+
+def rule_by_name(name: str) -> Rule:
+    for cls in _RULES:
+        if cls.name == name:
+            return cls()
+    raise KeyError(f"unknown rule {name!r}; known: "
+                   f"{[c.name for c in _RULES]}")

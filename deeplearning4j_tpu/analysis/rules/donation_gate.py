@@ -1,0 +1,72 @@
+"""donation-gate — every ``jax.jit(..., donate_argnums=...)`` call
+site must be CPU-gated (engine port of ``scripts/check_donation_gates.
+py``; see that shim's docstring for the full hazard history: on this
+jaxlib's CPU backend donated-buffer aliasing corrupts the process
+heap)."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from deeplearning4j_tpu.analysis.engine import Finding, Project, Rule
+
+#: files allowed to call jax.jit(donate_argnums=...) ungated — the gate
+#: implementation itself.
+ALLOWED_FILES = ("util/jit.py",)
+
+#: how many lines around the call may carry the inline gate.
+GATE_WINDOW_BEFORE = 12
+GATE_WINDOW_AFTER = 2
+
+GATE_TOKEN = "default_backend()"
+CPU_TOKENS = ('"cpu"', "'cpu'")
+
+MESSAGE = ("jax.jit(donate_argnums=...) without a CPU gate — route "
+           "through util/jit.py cpu_safe_jit or condition donation on "
+           'jax.default_backend() != "cpu" at the call site '
+           "(CPU donation aliasing corrupts the heap)")
+
+
+def _is_jax_jit(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "jit"
+            and isinstance(f.value, ast.Name) and f.value.id == "jax")
+
+
+def _donates(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "donate_argnums":
+            # a literal empty tuple donates nothing — not a hazard
+            if isinstance(kw.value, ast.Tuple) and not kw.value.elts:
+                return False
+            return True
+    return False
+
+
+def _gated(lines, lineno: int) -> bool:
+    lo = max(0, lineno - 1 - GATE_WINDOW_BEFORE)
+    hi = min(len(lines), lineno + GATE_WINDOW_AFTER)
+    window = "\n".join(lines[lo:hi])
+    return GATE_TOKEN in window and any(t in window for t in CPU_TOKENS)
+
+
+class DonationGateRule(Rule):
+    name = "donation-gate"
+    description = ("every jax.jit donation site is CPU-gated (donated "
+                   "buffers alias and corrupt the heap on this CPU "
+                   "backend)")
+
+    def check(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for m in project.modules:
+            if m.tree is None or \
+                    any(m.rel.endswith(a) for a in ALLOWED_FILES):
+                continue
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Call) and _is_jax_jit(node) \
+                        and _donates(node) \
+                        and not _gated(m.lines, node.lineno):
+                    out.append(Finding(self.name, m.rel, node.lineno,
+                                       MESSAGE))
+        return out

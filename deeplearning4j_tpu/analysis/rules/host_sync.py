@@ -1,0 +1,169 @@
+"""hot-path-host-sync — no silent device→host syncs on the decode hot
+path.
+
+"Zero added device syncs" is a PR-8/10/13 contract: the burst loop
+dispatches programs and touches host state, and the ONLY sanctioned
+syncs are the per-admission token fetch and the per-burst slot-state
+fetch — each carries an inline ``# dl4j-lint: disable=hot-path-
+host-sync`` suppression whose comment says exactly that, so every
+sanctioned sync in the tree is enumerable by grepping the pragma. Any
+NEW ``.item()`` / ``float()/int()`` on a dispatch result /
+``np.asarray`` of a device value / ``jax.device_get`` /
+``block_until_ready`` inside the hot set fails tier-1 instead of
+landing as a silent per-burst stall.
+
+Hot set (configured below + any function whose ``def`` line carries a
+``# dl4j-lint: hot-path`` marker — how fixtures opt in):
+
+- the decode scheduler's steady-state loop
+  (``serving/continuous.py`` ``ContinuousDecodeScheduler.*`` minus the
+  admission/control surface that is allowed to sync),
+- the generator program set + fused dispatch paths
+  (``nn/generate.py`` generator classes, minus the ``run_eager``
+  reference oracles),
+- the tracer emit paths (``monitor/reqtrace.py`` — tracing is host
+  bookkeeping by contract: ZERO device syncs anywhere in it).
+
+Detection is taint-shaped, not blanket: ``np.asarray``/``np.array``/
+``float()``/``int()`` are flagged only when their argument is a CALL
+result or a local whose value came from a call — the shape a program
+dispatch's output has — so host-list bookkeeping (``np.asarray(
+seq.generated)``) stays quiet. ``.item()``, ``jax.device_get`` and
+``block_until_ready`` always flag.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from deeplearning4j_tpu.analysis.engine import (Finding, FunctionInfo,
+                                                ModuleInfo, Project, Rule,
+                                                attr_chain, call_name,
+                                                walk_body)
+
+#: (module rel suffix, class prefix or None for whole module,
+#:  excluded function names)
+HOT_SPECS = (
+    ("deeplearning4j_tpu/serving/continuous.py",
+     "ContinuousDecodeScheduler",
+     # the admission/control surface MAY sync: submit copies the host
+     # prompt, warmup deliberately blocks on compiles, shutdown/drain
+     # are not steady state
+     {"__init__", "submit", "warmup", "shutdown", "drain", "stats",
+      "start", "poison", "prefix_caches"}),
+    ("deeplearning4j_tpu/nn/generate.py", "TransformerGenerator",
+     {"run_eager"}),
+    ("deeplearning4j_tpu/nn/generate.py", "RecurrentGenerator",
+     {"run_eager"}),
+    ("deeplearning4j_tpu/nn/generate.py", "_GeneratorBase", set()),
+    ("deeplearning4j_tpu/monitor/reqtrace.py", None, set()),
+)
+
+#: numpy module aliases whose asarray/array force a device→host copy
+#: when fed a device value
+_NP_NAMES = {"np", "numpy", "onp"}
+
+
+def _is_hot(fn: FunctionInfo) -> bool:
+    if "hot-path" in fn.markers():
+        return True
+    for suffix, cls, excluded in HOT_SPECS:
+        if not fn.module.rel.endswith(suffix):
+            continue
+        if fn.name in excluded:
+            continue
+        if cls is None or fn.qualname.startswith(cls + "."):
+            return True
+    return False
+
+
+#: call producers that can only yield HOST values — assignments from
+#: these never taint
+_HOST_PRODUCERS = {"int", "float", "len", "max", "min", "abs", "round",
+                   "sum", "sorted", "list", "tuple", "dict", "set",
+                   "str", "range", "enumerate", "zip", "bool"}
+
+
+def _call_taints(fn: FunctionInfo) -> Set[str]:
+    """Locals assigned (possibly via tuple unpack) from a call result —
+    the values that may live on device."""
+    tainted: Set[str] = set()
+    for n in walk_body(fn.node):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            if call_name(n.value) in _HOST_PRODUCERS:
+                continue
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for e in t.elts:
+                        if isinstance(e, ast.Name):
+                            tainted.add(e.id)
+    return tainted
+
+
+class HostSyncRule(Rule):
+    name = "hot-path-host-sync"
+    description = ("no device→host syncs (.item(), float()/int() on "
+                   "dispatch results, np.asarray of device values, "
+                   "device_get, block_until_ready) inside the decode "
+                   "scheduler burst loop, generator programs, or "
+                   "tracer emit paths")
+
+    def check(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for m in project.package_modules:
+            if m.tree is None:
+                continue
+            for fn in m.functions.values():
+                if not _is_hot(fn):
+                    continue
+                out.extend(self._check_fn(m, fn))
+        return out
+
+    def _check_fn(self, m: ModuleInfo,
+                  fn: FunctionInfo) -> List[Finding]:
+        tainted = _call_taints(fn)
+        out: List[Finding] = []
+
+        def flag(node: ast.AST, what: str):
+            out.append(Finding(
+                self.name, m.rel, node.lineno,
+                f"{what} in hot-path function {fn.qualname} forces a "
+                "device→host sync — keep the burst loop dispatch-only, "
+                "or mark the ONE sanctioned sync with an inline "
+                "suppression explaining why"))
+
+        def synclike_arg(call: ast.Call) -> Optional[str]:
+            if not call.args:
+                return None
+            a = call.args[0]
+            if isinstance(a, ast.Call):
+                return "a dispatch result"
+            if isinstance(a, ast.Name) and a.id in tainted:
+                return f"call-result local {a.id!r}"
+            return None
+
+        for n in walk_body(fn.node):
+            if not isinstance(n, ast.Call):
+                continue
+            cname = call_name(n)
+            chain = attr_chain(n.func)
+            if cname == "item" and isinstance(n.func, ast.Attribute):
+                flag(n, ".item()")
+            elif chain == "jax.device_get":
+                flag(n, "jax.device_get")
+            elif cname == "block_until_ready":
+                flag(n, "block_until_ready")
+            elif chain.split(".")[0] in _NP_NAMES and \
+                    cname in ("asarray", "array"):
+                why = synclike_arg(n)
+                if why is not None:
+                    flag(n, f"np.{cname} of {why}")
+            elif isinstance(n.func, ast.Name) and \
+                    n.func.id in ("float", "int"):
+                why = synclike_arg(n)
+                if why is not None:
+                    flag(n, f"{n.func.id}() of {why}")
+        return out

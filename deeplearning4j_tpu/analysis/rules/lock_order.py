@@ -1,0 +1,320 @@
+"""lock-order — the serving plane's cross-module lock-acquisition
+graph must be acyclic.
+
+Ten-plus modules (router, endpoints, fleet, scheduler, engine, KV
+pool, prefix cache, registry, monitor) each hold their own
+``threading.Lock``/``RLock``/``Condition`` with no global ordering
+document. The deadlock discipline that actually holds today is
+IMPLICIT: inner components (pool, cache, metrics registry) never call
+back out into the components that call them while holding their lock,
+and the router releases its per-request lock before touching the
+per-router lock's critical sections that re-enter request state. This
+rule makes that discipline EXPLICIT and machine-checked:
+
+- **lock identity** is ``Class.attr`` for every ``self.X =
+  threading.Lock()/RLock()`` (a ``Condition(self.Y)`` ALIASES ``Y`` —
+  acquiring the condition acquires the lock), or ``module.py:NAME``
+  for module-level locks;
+- **acquisitions** are ``with <lock>:`` bodies and ``<lock>.acquire()``
+  (held to the matching ``release()`` or end of block). Receivers
+  resolve through ``self``, annotated parameters (``rf: _Routed``) and
+  local ``x = Class(...)`` bindings; an UNRESOLVED receiver adds no
+  edge — the rule prefers a provable subgraph over invented cycles;
+- **edges**: holding L and directly acquiring M is an edge L→M;
+  holding L and calling a function that (transitively, via the
+  intra-package call graph with STRICT receiver resolution) acquires M
+  is an edge L→M with the call chain as the witness;
+- **any cycle is a potential deadlock** and a finding. The committed
+  expectation for this repo: the serving-plane graph is ACYCLIC —
+  ``tests/test_lint.py`` asserts the reconstructed graph is non-trivial
+  (it sees the real locks) and cycle-free, and that a seeded inversion
+  fixture is caught.
+
+``build_lock_graph`` is exposed for tests and for operators who want
+the graph itself (``scripts/analyze.py --lock-graph``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from deeplearning4j_tpu.analysis.engine import (Finding, FunctionInfo,
+                                                Project, Rule, call_name)
+
+#: call-graph traversal depth cap for transitive lock collection — the
+#: serving plane's real chains are 3-4 deep; the cap only bounds
+#: pathological recursion through the name-resolution fallback.
+MAX_DEPTH = 8
+
+
+class LockGraph:
+    """Nodes = lock ids, edges = ordered acquisitions with witnesses."""
+
+    def __init__(self):
+        self.nodes: Set[str] = set()
+        # (src, dst) -> list of "file:line (via ...)" witness strings
+        self.edges: Dict[Tuple[str, str], List[str]] = {}
+
+    def add_edge(self, src: str, dst: str, witness: str) -> None:
+        if src == dst:
+            return  # re-entry of the same lock id (RLock / condition)
+        self.nodes.update((src, dst))
+        self.edges.setdefault((src, dst), []).append(witness)
+
+    def successors(self, n: str) -> List[str]:
+        return sorted({d for (s, d) in self.edges if s == n})
+
+    def cycles(self) -> List[List[str]]:
+        """Every elementary cycle's canonical form (rotation starting
+        at the smallest node), deduplicated, sorted."""
+        out: Set[Tuple[str, ...]] = set()
+        nodes = sorted(self.nodes)
+
+        def dfs(start: str, node: str, path: List[str],
+                on_path: Set[str]) -> None:
+            for nxt in self.successors(node):
+                if nxt == start:
+                    i = path.index(min(path))
+                    out.add(tuple(path[i:] + path[:i]))
+                elif nxt not in on_path and nxt >= start:
+                    # nxt >= start: each cycle found exactly once, from
+                    # its smallest node
+                    dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+        for n in nodes:
+            dfs(n, n, [n], {n})
+        return [list(c) for c in sorted(out)]
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "nodes": sorted(self.nodes),
+            "edges": [{"from": s, "to": d, "witnesses": sorted(w)}
+                      for (s, d), w in sorted(self.edges.items())],
+            "cycles": self.cycles(),
+        }
+
+
+class _Analyzer:
+    def __init__(self, project: Project):
+        self.project = project
+        # (class, attr) -> lock id, merged across the package; attr
+        # names defined by MULTIPLE classes stay per-class keyed, and
+        # unknown receivers resolve through attr-name uniqueness only
+        self.lock_table: Dict[Tuple[str, str], str] = {}
+        self.attr_owners: Dict[str, Set[str]] = {}
+        for m in project.package_modules:
+            for (cls, attr), lock_id in m.lock_attrs.items():
+                self.lock_table[(cls, attr)] = lock_id
+                if cls:
+                    self.attr_owners.setdefault(attr, set()).add(cls)
+        self._trans: Dict[Tuple[str, str], Set[str]] = {}
+        self._visiting: Set[Tuple[str, str]] = set()
+
+    # ------------------------------------------------------- resolution
+
+    def resolve_lock(self, fn: FunctionInfo,
+                     expr: ast.AST) -> Optional[str]:
+        """Lock id for an acquisition expression, None when unknown."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            attr = expr.attr
+            recv = expr.value.id
+            if recv == "self" and fn.cls:
+                hit = self.lock_table.get((fn.cls, attr))
+                if hit is not None:
+                    return hit
+            else:
+                rc = fn.local_classes().get(recv)
+                if rc is not None:
+                    hit = self.lock_table.get((rc, attr))
+                    if hit is not None:
+                        return hit
+            owners = self.attr_owners.get(attr, set())
+            if len(owners) == 1:
+                return self.lock_table[(next(iter(owners)), attr)]
+            return None
+        if isinstance(expr, ast.Name):
+            # module-level lock referenced by bare name — only its own
+            # module's definition applies
+            lid = fn.module.lock_attrs.get(("", expr.id))
+            return lid
+        return None
+
+    def _resolve_call(self, fn: FunctionInfo,
+                      call: ast.Call) -> List[FunctionInfo]:
+        """STRICT call resolution for lock edges: self-calls, typed
+        receivers, same-module functions, class constructors
+        (``Pool(...)`` → ``Pool.__init__``), and the name fallback only
+        when it is UNAMBIGUOUS (one candidate package-wide) — an
+        over-approximate fallback here would invent cycles."""
+        name = call_name(call)
+        if not name:
+            return []
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            recv = f.value.id
+            if recv == "self" and fn.cls:
+                own = self.project.methods_of(fn.cls, name)
+                if own:
+                    return own
+            else:
+                rc = fn.local_classes().get(recv)
+                if rc:
+                    hit = self.project.methods_of(rc, name)
+                    if hit:
+                        return hit
+        elif isinstance(f, ast.Name):
+            own = fn.module.functions.get(name)
+            if own is not None:
+                return [own]
+            if name[:1].isupper():
+                ctor = self.project.methods_of(name, "__init__")
+                if ctor:
+                    return ctor
+        cands = self.project.functions_by_name.get(name, [])
+        return cands if len(cands) == 1 else []
+
+    # ------------------------------------------------- transitive locks
+
+    def trans_locks(self, fn: FunctionInfo, depth: int = 0) -> Set[str]:
+        """Every lock id ``fn`` may acquire, directly or via callees."""
+        key = (fn.module.rel, fn.qualname)
+        if key in self._trans:
+            return self._trans[key]
+        if key in self._visiting or depth > MAX_DEPTH:
+            return set()
+        self._visiting.add(key)
+        acquired: Set[str] = set()
+        for stmt_locks, _, _ in self._acquisitions(fn):
+            acquired.add(stmt_locks)
+        for call in fn.calls:
+            for callee in self._resolve_call(fn, call):
+                acquired |= self.trans_locks(callee, depth + 1)
+        self._visiting.discard(key)
+        self._trans[key] = acquired
+        return acquired
+
+    def _acquisitions(self, fn: FunctionInfo):
+        """Direct acquisitions in ``fn``: (lock_id, line, body_stmts)
+        for ``with`` blocks; ``.acquire()`` yields the remainder of its
+        statement block as the body (until a matching ``release()``)."""
+        out = []
+
+        def scan(stmts: List[ast.stmt]):
+            for i, st in enumerate(stmts):
+                if isinstance(st, ast.With):
+                    body_locks = []
+                    for item in st.items:
+                        lid = self.resolve_lock(fn, item.context_expr)
+                        if lid is not None:
+                            body_locks.append(lid)
+                    for lid in body_locks:
+                        out.append((lid, st.lineno, st.body))
+                    scan(st.body)
+                elif isinstance(st, ast.Expr) and \
+                        isinstance(st.value, ast.Call) and \
+                        call_name(st.value) == "acquire" and \
+                        isinstance(st.value.func, ast.Attribute):
+                    lid = self.resolve_lock(fn, st.value.func.value)
+                    if lid is not None:
+                        rest = []
+                        for later in stmts[i + 1:]:
+                            if isinstance(later, ast.Expr) and \
+                                    isinstance(later.value, ast.Call) and \
+                                    call_name(later.value) == "release":
+                                rel = self.resolve_lock(
+                                    fn, later.value.func.value) \
+                                    if isinstance(later.value.func,
+                                                  ast.Attribute) else None
+                                if rel == lid:
+                                    break
+                            rest.append(later)
+                        out.append((lid, st.lineno, rest))
+                else:
+                    for attr in ("body", "orelse", "finalbody",
+                                 "handlers"):
+                        sub = getattr(st, attr, None)
+                        if isinstance(sub, list):
+                            flat = []
+                            for x in sub:
+                                if isinstance(x, ast.ExceptHandler):
+                                    flat.extend(x.body)
+                                elif isinstance(x, ast.stmt):
+                                    flat.append(x)
+                            if flat:
+                                scan(flat)
+
+        node = fn.node
+        if hasattr(node, "body"):
+            scan(node.body)
+        return out
+
+    # ------------------------------------------------------------ edges
+
+    def build(self) -> LockGraph:
+        g = LockGraph()
+        for lock_id in self.lock_table.values():
+            g.nodes.add(lock_id)
+        for m in self.project.package_modules:
+            for fn in m.functions.values():
+                for held, line, body in self._acquisitions(fn):
+                    self._edges_from_body(g, fn, held, line, body)
+        return g
+
+    def _edges_from_body(self, g: LockGraph, fn: FunctionInfo,
+                         held: str, line: int, body: List[ast.stmt]):
+        where = f"{fn.module.rel}:{line}"
+        for st in body:
+            for n in ast.walk(st):
+                if isinstance(n, ast.With):
+                    for item in n.items:
+                        lid = self.resolve_lock(fn, item.context_expr)
+                        if lid is not None:
+                            g.add_edge(held, lid,
+                                       f"{where} {fn.qualname} nests "
+                                       f"{lid}")
+                elif isinstance(n, ast.Call):
+                    cname = call_name(n)
+                    if cname == "acquire" and \
+                            isinstance(n.func, ast.Attribute):
+                        lid = self.resolve_lock(fn, n.func.value)
+                        if lid is not None:
+                            g.add_edge(held, lid,
+                                       f"{where} {fn.qualname} "
+                                       f"acquires {lid}")
+                        continue
+                    for callee in self._resolve_call(fn, n):
+                        for lid in self.trans_locks(callee):
+                            g.add_edge(
+                                held, lid,
+                                f"{where} {fn.qualname} -> "
+                                f"{callee.qualname} ~ {lid}")
+
+
+def build_lock_graph(project: Project) -> LockGraph:
+    return _Analyzer(project).build()
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+    description = ("the cross-module lock-acquisition graph (with-"
+                   "blocks + acquire() nesting through the call graph) "
+                   "is acyclic — any cycle is a potential deadlock")
+
+    def check(self, project: Project) -> List[Finding]:
+        g = build_lock_graph(project)
+        out: List[Finding] = []
+        for cycle in g.cycles():
+            pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+            witness = g.edges.get(pairs[0], ["?"])[0]
+            path = witness.split(" ", 1)[0]
+            rel, _, line = path.partition(":")
+            out.append(Finding(
+                self.name, rel, int(line or 1),
+                "lock-order cycle (potential deadlock): "
+                + " -> ".join(cycle + [cycle[0]])
+                + " — witnesses: "
+                + "; ".join(g.edges[p][0] for p in pairs
+                            if p in g.edges)))
+        return out

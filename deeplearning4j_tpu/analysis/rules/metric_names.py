@@ -1,0 +1,75 @@
+"""metric-name — every ``dl4j_*`` metric-name literal under the
+package must be pinned in ``KNOWN_DL4J_METRICS`` (engine port of
+``scripts/check_metric_names.py``: "new counter, forgot the schema" is
+a tier-1 failure, not a latent dashboard break)."""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import re
+from typing import List, Optional, Set
+
+from deeplearning4j_tpu.analysis.engine import (Finding, Project, Rule,
+                                                repo_root)
+
+#: a string literal is treated as a metric family name iff it matches
+#: this shape exactly (whole string): dl4j_ + snake_case words. Label
+#: values, topic names (dl4j-tpu-… use dashes) and docstrings never
+#: match whole.
+METRIC_RE = re.compile(r"^dl4j_[a-z0-9]+(?:_[a-z0-9]+)*$")
+
+#: dl4j_-prefixed literals that are NOT metric names (and why):
+#: - dl4j_tpu_dataset_export_v1: the datasets/export.py file-format
+#:   magic string; versioned data artifact, not telemetry.
+NON_METRIC_LITERALS = {
+    "dl4j_tpu_dataset_export_v1",
+}
+
+_known_cache: Optional[Set[str]] = None
+
+
+def known_metrics() -> Set[str]:
+    """The pinned registry, loaded from the telemetry schema checker by
+    file path (scripts/ is not an installed package)."""
+    global _known_cache
+    if _known_cache is None:
+        path = os.path.join(repo_root(), "scripts",
+                            "check_telemetry_schema.py")
+        spec = importlib.util.spec_from_file_location(
+            "_dl4j_check_telemetry_schema", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _known_cache = set(mod.KNOWN_DL4J_METRICS)
+    return _known_cache
+
+
+class MetricNameRule(Rule):
+    name = "metric-name"
+    description = ("every dl4j_* metric-name literal in the package is "
+                   "pinned in KNOWN_DL4J_METRICS (schema drift guard "
+                   "coverage by construction)")
+
+    def check(self, project: Project) -> List[Finding]:
+        known = known_metrics()
+        out: List[Finding] = []
+        for m in project.package_modules:
+            if m.tree is None:
+                continue
+            for node in ast.walk(m.tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    continue
+                s = node.value
+                if not METRIC_RE.match(s) or s in NON_METRIC_LITERALS:
+                    continue
+                if s not in known:
+                    out.append(Finding(
+                        self.name, m.rel, node.lineno,
+                        f"dl4j_ metric name {s!r} is not pinned in "
+                        "KNOWN_DL4J_METRICS "
+                        "(scripts/check_telemetry_schema.py) — add it "
+                        "there in the same change, or allowlist it in "
+                        "NON_METRIC_LITERALS if it is not a metric"))
+        return out

@@ -1,0 +1,220 @@
+"""prng-reuse — a PRNG key consumed twice without an interleaving
+``split``/``fold_in`` is a determinism bug.
+
+The per-row PRNG clock is what makes preempt/resume, migration resume
+and chaos replay BIT-IDENTICAL: every draw is keyed by
+``fold_in(row_key, token_index)``, so a row's samples depend only on
+its own key and clock, never on scheduling. Reusing a key — passing
+the same key variable to two sampler calls — silently correlates the
+two draws (identical gumbels → identical "random" choices), which
+presents as subtly-wrong sampling, not a crash, and survives every
+greedy test. The JAX discipline is mechanical: a key is CONSUMED by
+exactly one sampler; more draws mean ``split``/``fold_in`` first.
+
+This rule runs the mechanical check per function:
+
+- a variable becomes a KEY when assigned from ``PRNGKey``/``key``/
+  ``split``/``fold_in`` (or a subscript of a ``split`` result);
+- a SAMPLER call (``jax.random.normal/uniform/bernoulli/gumbel/
+  categorical/...``) CONSUMES the key it is passed (first positional
+  arg);
+- consuming a key a second time — sequentially, across either arm of
+  a conditional (branches analyzed separately, then merged
+  max-consumed), or across loop iterations without a rebind inside
+  the loop body — is a finding. Rebinding (``key = fold_in(key, i)``
+  / ``k, sub = split(k)``) resets the count.
+
+Scope: the whole package. Keys forwarded to OTHER functions are not
+treated as consumed (callees own their discipline — generate.py's
+samplers fold internally by design).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from deeplearning4j_tpu.analysis.engine import (Finding, FunctionInfo,
+                                                ModuleInfo, Project, Rule,
+                                                attr_chain, call_name)
+
+#: jax.random functions that DERIVE keys (never consume)
+DERIVERS = {"PRNGKey", "key", "split", "fold_in", "clone", "wrap_key_data",
+            "key_data"}
+
+#: jax.random functions that CONSUME their key argument
+SAMPLERS = {
+    "normal", "uniform", "bernoulli", "binomial", "categorical",
+    "gumbel", "truncated_normal", "choice", "permutation", "randint",
+    "exponential", "laplace", "gamma", "beta", "poisson", "dirichlet",
+    "multivariate_normal", "shuffle", "bits", "t", "cauchy", "logistic",
+    "rademacher",
+}
+
+
+def _random_member(call: ast.Call) -> str:
+    """'split' for ``jax.random.split`` / ``random.split`` /
+    ``jrandom.split``; '' when not a jax.random member."""
+    chain = attr_chain(call.func)
+    parts = chain.split(".")
+    if len(parts) >= 2 and parts[-2] in ("random", "jrandom"):
+        return parts[-1]
+    return ""
+
+
+class _State:
+    """Per-variable consumption counts since the last rebind."""
+
+    def __init__(self):
+        self.counts: Dict[str, int] = {}
+
+    def copy(self) -> "_State":
+        s = _State()
+        s.counts = dict(self.counts)
+        return s
+
+    def merge(self, other: "_State") -> None:
+        for k, v in other.counts.items():
+            self.counts[k] = max(self.counts.get(k, 0), v)
+
+
+class PrngReuseRule(Rule):
+    name = "prng-reuse"
+    description = ("no PRNG key is consumed by two sampler calls "
+                   "without an interleaving split/fold_in — key reuse "
+                   "correlates draws and breaks the bit-identical "
+                   "replay contract")
+
+    def check(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for m in project.package_modules:
+            if m.tree is None:
+                continue
+            for fn in m.functions.values():
+                out.extend(self._check_fn(m, fn))
+        return out
+
+    def _check_fn(self, m: ModuleInfo,
+                  fn: FunctionInfo) -> List[Finding]:
+        findings: List[Tuple[int, str]] = []
+        keys: Set[str] = set()
+
+        def note_use(name: str, node: ast.AST, st: _State):
+            n = st.counts.get(name, 0) + 1
+            st.counts[name] = n
+            if n == 2:  # report once per reuse site, not per extra use
+                findings.append((node.lineno, name))
+
+        def scan_expr(expr: ast.AST, st: _State):
+            """Post-order over an expression: record sampler
+            consumptions and key derivations."""
+            for child in ast.iter_child_nodes(expr):
+                scan_expr(child, st)
+            if isinstance(expr, ast.Call):
+                member = _random_member(expr)
+                if member in SAMPLERS and expr.args:
+                    a = expr.args[0]
+                    if isinstance(a, ast.Name) and a.id in keys:
+                        note_use(a.id, expr, st)
+
+        def bind(target: ast.AST, value: ast.AST, st: _State):
+            member = _random_member(value) if isinstance(value, ast.Call) \
+                else ""
+            derives = member in DERIVERS
+            if not derives and isinstance(value, ast.Subscript) and \
+                    isinstance(value.value, ast.Call):
+                derives = _random_member(value.value) in DERIVERS
+            names: List[str] = []
+            if isinstance(target, ast.Name):
+                names = [target.id]
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                names = [e.id for e in target.elts
+                         if isinstance(e, ast.Name)]
+            for nm in names:
+                if derives:
+                    keys.add(nm)
+                    st.counts[nm] = 0
+                elif nm in st.counts:
+                    st.counts[nm] = 0  # rebound to something else
+
+        def scan_stmts(stmts: List[ast.stmt], st: _State) -> bool:
+            """Returns True when the block TERMINATES (return/raise/
+            break/continue) — a terminating conditional arm's draws
+            never flow into the fall-through path, so its state is not
+            merged back."""
+            for s in stmts:
+                if isinstance(s, ast.Assign):
+                    scan_expr(s.value, st)
+                    for t in s.targets:
+                        bind(t, s.value, st)
+                elif isinstance(s, ast.AugAssign):
+                    scan_expr(s.value, st)
+                elif isinstance(s, ast.If):
+                    scan_expr(s.test, st)
+                    a, b = st.copy(), st.copy()
+                    term_a = scan_stmts(s.body, a)
+                    term_b = scan_stmts(s.orelse, b)
+                    st.counts = {}
+                    if not term_a:
+                        st.merge(a)
+                    if not term_b:
+                        st.merge(b)
+                    if term_a and term_b:
+                        return True
+                elif isinstance(s, (ast.For, ast.While)):
+                    if isinstance(s, ast.For):
+                        scan_expr(s.iter, st)
+                    else:
+                        scan_expr(s.test, st)
+                    # two passes: the second catches a key consumed
+                    # each iteration without a rebind in the body
+                    scan_stmts(s.body, st)
+                    scan_stmts(s.body, st)
+                    scan_stmts(s.orelse, st)
+                elif isinstance(s, ast.Try):
+                    scan_stmts(s.body, st)
+                    for h in s.handlers:
+                        scan_stmts(h.body, st)
+                    scan_stmts(s.orelse, st)
+                    scan_stmts(s.finalbody, st)
+                elif isinstance(s, ast.With):
+                    for item in s.items:
+                        scan_expr(item.context_expr, st)
+                    scan_stmts(s.body, st)
+                elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.ClassDef)):
+                    continue  # nested scope: analyzed separately
+                elif isinstance(s, (ast.Return, ast.Expr)):
+                    if s.value is not None:
+                        scan_expr(s.value, st)
+                    if isinstance(s, ast.Return):
+                        return True
+                elif isinstance(s, ast.Raise):
+                    if s.exc is not None:
+                        scan_expr(s.exc, st)
+                    return True
+                elif isinstance(s, (ast.Break, ast.Continue)):
+                    return True
+                else:
+                    for child in ast.iter_child_nodes(s):
+                        if isinstance(child, ast.expr):
+                            scan_expr(child, st)
+            return False
+
+        body = getattr(fn.node, "body", None)
+        if not body:
+            return []
+        scan_stmts(body, _State())
+        seen = set()
+        out = []
+        for line, name in findings:
+            if (line, name) in seen:
+                continue
+            seen.add((line, name))
+            out.append(Finding(
+                self.name, m.rel, line,
+                f"PRNG key {name!r} consumed more than once in "
+                f"{fn.qualname} without an interleaving split/fold_in "
+                "— reused keys produce correlated draws and break the "
+                "bit-identical replay contract"))
+        return out

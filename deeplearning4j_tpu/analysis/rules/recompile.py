@@ -1,0 +1,156 @@
+"""recompile-hazard — data-dependent Python shapes must not reach a
+compiled-program getter unrouted through the pinned ladders.
+
+"Zero steady-state compiles" holds because every program shape in the
+serving plane is drawn from a SMALL PRE-COMPILED LADDER: prompt
+lengths go through ``prompt_bucket``/``bucket_for``, admission row
+counts through the pow2 admit ladder, cache lengths through
+``_round_blocks``/``blocks_for``, burst tiers through ``_tier_cover``.
+A raw ``len(prompt)`` or ``x.shape[1]`` flowing into
+``*_program(...)`` (the in-tree convention for jit-program getters) —
+or an inline ``jax.jit(f)(x)`` that builds a fresh program per call —
+reintroduces per-request XLA compiles that no unit test notices until
+a latency bench regresses. This rule pins the discipline statically:
+
+- **flagged**: a ``*_program(...)`` call (or immediate
+  ``jax.jit(...)(...)`` invocation) whose argument carries a RAW
+  data-dependent value — ``len(...)``, ``.shape``/``.size``/``.ndim``,
+  or a local assigned from one — not wrapped (directly or via the
+  local's defining expression) in a sanctioned ladder call;
+- **sanctioned pins** (:data:`PIN_FUNCS`): the bucket/ladder helpers.
+  ``min``/``max`` and arithmetic propagate taint; wrapping a tainted
+  value in a pin call cleans it.
+
+Function parameters are treated as already-pinned — the rule checks
+each function's OWN discipline; callers' raw values are flagged at the
+caller's call site where they originate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from deeplearning4j_tpu.analysis.engine import (Finding, FunctionInfo,
+                                                ModuleInfo, Project, Rule,
+                                                attr_chain, call_name,
+                                                walk_body)
+
+#: the sanctioned shape-pinning helpers: values produced by these are
+#: ladder-quantized by construction
+PIN_FUNCS = {
+    "bucket_for", "bucket_sizes", "prompt_bucket", "blocks_for",
+    "_round_blocks", "_tier_cover", "pow2_ladder", "_pow2_bucket",
+    "max_context", "pad_rows",
+}
+
+#: raw data-dependent attribute reads
+RAW_ATTRS = {"shape", "size", "ndim", "nbytes"}
+
+
+def _program_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name.endswith("_program"):
+        return True
+    # inline jax.jit(f)(...): a fresh program object per call — every
+    # invocation retraces
+    if isinstance(node.func, ast.Call) and \
+            attr_chain(node.func.func) == "jax.jit":
+        return True
+    return False
+
+
+def _tainted_locals(fn: FunctionInfo) -> Set[str]:
+    """Locals whose defining expression carries an UNPINNED raw value.
+    One linear pass in source order: taint propagates through
+    arithmetic/min/max, a pin call cleans."""
+    tainted: Set[str] = set()
+    assigns = [n for n in walk_body(fn.node) if isinstance(n, ast.Assign)]
+    assigns.sort(key=lambda n: (n.lineno, n.col_offset))
+    for n in assigns:
+        t = _expr_tainted(n.value, tainted)
+        for tgt in n.targets:
+            if isinstance(tgt, ast.Name):
+                if t:
+                    tainted.add(tgt.id)
+                else:
+                    tainted.discard(tgt.id)
+    return tainted
+
+
+def _expr_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    """Does ``expr`` carry a raw data-dependent value that no pin call
+    wraps? Pin calls clean their whole subtree."""
+    if isinstance(expr, ast.Call):
+        if call_name(expr) in PIN_FUNCS:
+            return False
+        if call_name(expr) == "len":
+            return True
+        if isinstance(expr.func, ast.Name) and \
+                expr.func.id in ("min", "max", "int", "abs"):
+            return any(_expr_tainted(a, tainted) for a in expr.args)
+        return False  # other calls: unknown producer, assumed pinned
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in RAW_ATTRS:
+            return True
+        return False
+    if isinstance(expr, ast.Subscript):
+        return _expr_tainted(expr.value, tainted)
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, ast.BinOp):
+        return _expr_tainted(expr.left, tainted) or \
+            _expr_tainted(expr.right, tainted)
+    if isinstance(expr, ast.UnaryOp):
+        return _expr_tainted(expr.operand, tainted)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return any(_expr_tainted(e, tainted) for e in expr.elts)
+    if isinstance(expr, ast.IfExp):
+        return _expr_tainted(expr.body, tainted) or \
+            _expr_tainted(expr.orelse, tainted)
+    return False
+
+
+class RecompileHazardRule(Rule):
+    name = "recompile-hazard"
+    description = ("no raw len()/.shape value reaches a *_program() "
+                   "jit getter un-laddered, and no inline "
+                   "jax.jit(f)(x) builds a fresh program per call — "
+                   "the zero-steady-state-compiles contract")
+
+    def check(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for m in project.package_modules:
+            if m.tree is None:
+                continue
+            for fn in m.functions.values():
+                out.extend(self._check_fn(m, fn))
+        return out
+
+    def _check_fn(self, m: ModuleInfo,
+                  fn: FunctionInfo) -> List[Finding]:
+        out: List[Finding] = []
+        tainted = _tainted_locals(fn)
+        for n in walk_body(fn.node):
+            if not (isinstance(n, ast.Call) and _program_call(n)):
+                continue
+            if isinstance(n.func, ast.Call):
+                out.append(Finding(
+                    self.name, m.rel, n.lineno,
+                    f"inline jax.jit(...)(...) in {fn.qualname} builds "
+                    "a fresh program object per call (retrace every "
+                    "invocation) — cache the jitted callable"))
+                continue
+            args = list(n.args) + [kw.value for kw in n.keywords]
+            for a in args:
+                if _expr_tainted(a, tainted):
+                    out.append(Finding(
+                        self.name, m.rel, n.lineno,
+                        f"data-dependent shape reaches program getter "
+                        f"{call_name(n)}() in {fn.qualname} without a "
+                        "pinned ladder (bucket_for / prompt_bucket / "
+                        "_round_blocks / blocks_for / _tier_cover) — "
+                        "every unpinned value is a fresh XLA compile "
+                        "in steady state"))
+                    break
+        return out

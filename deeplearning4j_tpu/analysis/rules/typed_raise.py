@@ -1,0 +1,118 @@
+"""typed-wire-raise — no bare ``Exception``/``RuntimeError`` raises on
+a path reachable from the wire frame handlers.
+
+The version-skew contract (PR 7/10): an error crossing
+``serving/wire.py`` ships TYPED (``etype`` + wire-safe payload) and
+the caller's endpoint reconstructs the SAME exception class, so a
+remote worker's shed/quarantine/shutdown is indistinguishable, by
+type, from a local engine's. A bare ``raise RuntimeError(...)``
+anywhere the worker's frame handlers can reach DEGRADES to a generic
+``EndpointError`` on the caller side — the router then cannot tell a
+sizing error from a transient, and typed-error tests pass locally
+while the remote path silently loses the type. This rule walks the
+intra-package call graph from the frame handlers
+(``EngineWorker._serve_loop`` / ``_deliver`` — plus any function whose
+``def`` line carries ``# dl4j-lint: wire-handler``, the fixture seam)
+through the SERVE-SIDE cone (worker → engine → scheduler → pool/
+generator/registry; the router/fleet are wire CLIENTS, not servers)
+and flags every reachable bare raise. Raising a SUBCLASS is fine —
+subclasses are registrable in ``wire._typed_error_registry`` and
+catchable by type.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from deeplearning4j_tpu.analysis.engine import (Finding, FunctionInfo,
+                                                ModuleInfo, Project, Rule,
+                                                walk_body)
+
+#: the wire frame handlers — reachability roots
+ROOTS = (
+    ("deeplearning4j_tpu/serving/worker.py", "EngineWorker._serve_loop"),
+    ("deeplearning4j_tpu/serving/worker.py", "EngineWorker._deliver"),
+)
+
+#: the serve-side cone the traversal stays inside: what a worker frame
+#: can actually execute. The router/endpoint/fleet modules are wire
+#: CLIENTS — their raises surface to their own caller, not across the
+#: wire — and the monitor plane never raises into the frame path.
+CONE_SUFFIXES = (
+    "deeplearning4j_tpu/serving/worker.py",
+    "deeplearning4j_tpu/serving/wire.py",
+    "deeplearning4j_tpu/serving/continuous.py",
+    "deeplearning4j_tpu/serving/prefixcache.py",
+    "deeplearning4j_tpu/serving/registry.py",
+    "deeplearning4j_tpu/parallel/inference.py",
+    "deeplearning4j_tpu/nn/kvpool.py",
+    "deeplearning4j_tpu/nn/generate.py",
+    "deeplearning4j_tpu/nn/quantize.py",
+)
+
+BARE = ("Exception", "RuntimeError")
+
+
+def _bare_raise(node: ast.Raise):
+    """The bare class name when this is ``raise Exception(...)`` /
+    ``raise RuntimeError`` (exactly those classes), else None."""
+    exc = node.exc
+    if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name) \
+            and exc.func.id in BARE:
+        return exc.func.id
+    if isinstance(exc, ast.Name) and exc.id in BARE:
+        return exc.id
+    return None
+
+
+class TypedWireRaiseRule(Rule):
+    name = "typed-wire-raise"
+    description = ("no bare Exception/RuntimeError raise is reachable "
+                   "from the serving/wire.py frame handlers — errors "
+                   "crossing the wire must be typed "
+                   "(wire._typed_error_registry) so remote == local by "
+                   "type under version skew")
+
+    def check(self, project: Project) -> List[Finding]:
+        roots: List[FunctionInfo] = []
+        for suffix, qualname in ROOTS:
+            m = project.module(suffix)
+            if m is not None and qualname in m.functions:
+                roots.append(m.functions[qualname])
+        for m in project.modules:
+            for fn in m.functions.values():
+                if "wire-handler" in fn.markers():
+                    roots.append(fn)
+        if not roots:
+            return []
+        cone_extra = {fn.module.rel for fn in roots}
+
+        def in_cone(mod: ModuleInfo) -> bool:
+            return mod.rel in cone_extra or \
+                any(mod.rel.endswith(s) for s in CONE_SUFFIXES)
+
+        out: List[Finding] = []
+        seen = set()
+        for fn in project.reachable(roots, module_filter=in_cone):
+            if not in_cone(fn.module):
+                continue
+            for n in walk_body(fn.node):
+                if isinstance(n, ast.Raise):
+                    cls = _bare_raise(n)
+                    if cls is None:
+                        continue
+                    key = (fn.module.rel, n.lineno)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(Finding(
+                        self.name, fn.module.rel, n.lineno,
+                        f"bare {cls} raised in {fn.qualname}, which is "
+                        "reachable from the wire frame handlers — it "
+                        "crosses the wire untyped and degrades to "
+                        "EndpointError on the caller; raise a typed "
+                        "subclass registered in "
+                        "serving/wire.py _typed_error_registry"))
+        out.sort(key=lambda f: (f.path, f.line))
+        return out

@@ -48,7 +48,8 @@ def check_gradients_fn(
     """
     flat_params = jnp.asarray(flat_params, jnp.float64)
     loss_jit = jax.jit(loss_fn)
-    analytic = np.asarray(jax.jit(jax.grad(loss_fn))(flat_params))
+    grad_jit = jax.jit(jax.grad(loss_fn))
+    analytic = np.asarray(grad_jit(flat_params))
     n = flat_params.shape[0]
     idxs = np.arange(n)
     if subset is not None and subset < n:

@@ -133,6 +133,16 @@ class InferenceBackpressure(RuntimeError):
     engine was built with ``reject_when_full=True``."""
 
 
+class EngineShutdown(RuntimeError):
+    """Submit/prefill rejected because the engine (or its decode
+    scheduler) is shut down. TYPED — registered in
+    ``serving/wire.py _typed_error_registry`` — so a remote caller
+    racing a worker's drain sees the same exception class an
+    in-process caller would, not an anonymous ``EndpointError``
+    (the typed-wire-raise contract: bare RuntimeError must never
+    cross a frame handler)."""
+
+
 class SliceDegraded(RuntimeError):
     """A chip inside this engine's mesh slice died: the whole slice is
     one failure domain (its params and KV pools are sharded across
@@ -672,7 +682,7 @@ class ParallelInference:
         ``version=``); the version is resolved here, atomically with
         respect to deploys."""
         if self._closed:
-            raise RuntimeError("ParallelInference is shut down")
+            raise EngineShutdown("ParallelInference is shut down")
         if self._slice_dead is not None:
             raise self._slice_error()
         model, v, mv, coalescible = self._resolve_model(model, version, session)
@@ -771,7 +781,7 @@ class ParallelInference:
         rides the continuous scheduler's preempt/resume machinery and
         therefore requires ``continuous=True``."""
         if self._closed:
-            raise RuntimeError("ParallelInference is shut down")
+            raise EngineShutdown("ParallelInference is shut down")
         if self._slice_dead is not None:
             raise self._slice_error()
         from deeplearning4j_tpu.nn.generate import row_keys, sampler_sig
@@ -860,7 +870,7 @@ class ParallelInference:
         the same tokens computes (same program, same params), so the
         handed-off stream's tokens equal an undisaggregated run's."""
         if self._closed:
-            raise RuntimeError("ParallelInference is shut down")
+            raise EngineShutdown("ParallelInference is shut down")
         if self._slice_dead is not None:
             raise self._slice_error()
         if self.net is None:

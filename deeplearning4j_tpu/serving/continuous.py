@@ -95,7 +95,8 @@ from deeplearning4j_tpu.nn.generate import (
 )
 from deeplearning4j_tpu.nn.kvpool import PagedKVCachePool, pool_spec
 from deeplearning4j_tpu.optimize.deferred import note_dispatch
-from deeplearning4j_tpu.parallel.inference import InferenceBackpressure
+from deeplearning4j_tpu.parallel.inference import (EngineShutdown,
+                                                   InferenceBackpressure)
 
 
 class DecodeBurstError(RuntimeError):
@@ -456,7 +457,9 @@ class ContinuousDecodeScheduler:
         contract: a resumed stream's tokens equal an uninterrupted
         run's, with the delivered prefix never re-emitted."""
         if self._closed:
-            raise RuntimeError("ContinuousDecodeScheduler is shut down")
+            # typed (wire-registered): a remote caller racing a drain
+            # sees the same class a local one does
+            raise EngineShutdown("ContinuousDecodeScheduler is shut down")
         if self._fatal is not None:
             raise self._fatal
         prompt = np.asarray(prompt_ids)
@@ -611,7 +614,7 @@ class ContinuousDecodeScheduler:
                 self.drain(timeout)
             else:
                 self._fail_everything(
-                    RuntimeError("scheduler shut down before dispatch"))
+                    EngineShutdown("scheduler shut down before dispatch"))
 
     def warmup(self, prompt_lengths, max_new_tokens: int = 1,
                model: Optional[str] = None,
@@ -1067,6 +1070,10 @@ class ContinuousDecodeScheduler:
         pool.set_layers(scat(pool.layers, caches, tnb))
         rs = gen.row_sample_program()
         note_dispatch(lane.net, ("gen_row_sample", "sched", rows))
+        # SANCTIONED SYNC (one per admission group): tok0 must reach the
+        # host to seed the slot state and the retire-at-step-0 check —
+        # one small [rows] fetch, off the burst loop's critical K steps
+        # dl4j-lint: disable=hot-path-host-sync
         toks = np.asarray(rs(logits, keys, folds, temp, top_k, top_p))
         t1p = time.perf_counter()
         self._trace_admitted(
@@ -1148,6 +1155,9 @@ class ContinuousDecodeScheduler:
         pool.set_layers(pools_out)
         rs = gen.row_sample_program()
         note_dispatch(lane.net, ("gen_row_sample", "sched", rows))
+        # SANCTIONED SYNC: the tail-prefill group's tok0 fetch — same
+        # contract as the dense admission path above
+        # dl4j-lint: disable=hot-path-host-sync
         toks = np.asarray(rs(logits, keys, folds, temp, top_k, top_p))
         t1p = time.perf_counter()
         self._trace_admitted(
@@ -1214,6 +1224,9 @@ class ContinuousDecodeScheduler:
             pool.set_layers(scat(pool.layers, caches, tnb))
         rs = gen.row_sample_program()
         note_dispatch(lane.net, ("gen_row_sample", "sched", rows))
+        # SANCTIONED SYNC: the shipped-KV handoff group's tok0 fetch —
+        # sampled off the SHIPPED logits, same admission contract
+        # dl4j-lint: disable=hot-path-host-sync
         toks = np.asarray(rs(logits, keys, folds, temp, top_k, top_p))
         from deeplearning4j_tpu.monitor import DISAGG_KV_HANDOFFS_COUNTER
         get_registry().counter(
@@ -1591,6 +1604,10 @@ class ContinuousDecodeScheduler:
             pools, ys, tok2, pos2, ng2, done2 = bp(
                 params, pool.layers, tables, pos, tok, n_gen, done, keys,
                 temp, top_k, top_p, eos, max_new_v)
+            # SANCTIONED SYNC (one per K-token burst): the burst's
+            # tokens must reach the host to retire rows / emit chunks —
+            # ONE [rows, K] fetch per dispatch, the design minimum
+            # dl4j-lint: disable=hot-path-host-sync
             ys = np.asarray(ys)
         pool.set_layers(pools)
         dt_ms = (time.perf_counter() - t0) * 1e3
@@ -1613,10 +1630,14 @@ class ContinuousDecodeScheduler:
         ng_f = lane.n_gen.copy()
         done_f = lane.done.copy()
         ys_f[sel] = ys[:n]
+        # SANCTIONED SYNC: the burst's compact slot-state vectors
+        # (tok/pos/n_gen/done, [rows] each) ride home with the tokens —
+        # part of the same one-fetch-per-burst budget as ys above
+        # dl4j-lint: disable=hot-path-host-sync
         tok_f[sel] = np.asarray(tok2)[:n]
-        pos_f[sel] = np.asarray(pos2)[:n]
-        ng_f[sel] = np.asarray(ng2)[:n]
-        done_f[sel] = np.asarray(done2)[:n]
+        pos_f[sel] = np.asarray(pos2)[:n]  # dl4j-lint: disable=hot-path-host-sync
+        ng_f[sel] = np.asarray(ng2)[:n]  # dl4j-lint: disable=hot-path-host-sync
+        done_f[sel] = np.asarray(done2)[:n]  # dl4j-lint: disable=hot-path-host-sync
         return ys_f, tok_f, pos_f, ng_f, done_f
 
     def _retire(self, lane: _Lane, outs) -> None:

@@ -213,7 +213,8 @@ def chunk_tag(header: Dict[str, Any]) -> Optional[str]:
 def _typed_error_registry() -> Dict[str, Any]:
     """The engine-error family that crosses the wire typed. Imported
     lazily — wire.py sits below router/registry in the import graph."""
-    from deeplearning4j_tpu.parallel.inference import (InferenceBackpressure,
+    from deeplearning4j_tpu.parallel.inference import (EngineShutdown,
+                                                       InferenceBackpressure,
                                                        SliceDegraded)
     from deeplearning4j_tpu.serving.continuous import (DecodeBurstError,
                                                        KVPoolExhausted)
@@ -232,6 +233,7 @@ def _typed_error_registry() -> Dict[str, Any]:
         "KVPoolExhausted": KVPoolExhausted,
         "WireVersionError": WireVersionError,
         "SliceDegraded": SliceDegraded,
+        "EngineShutdown": EngineShutdown,
     }
 
 

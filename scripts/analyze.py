@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""dl4j-analyze CLI — run the unified static-analysis engine repo-wide.
+
+::
+
+    python scripts/analyze.py                 # text report, exit != 0 on
+                                              # any NEW finding
+    python scripts/analyze.py --json          # machine-readable report
+                                              # (what quick_check section
+                                              # 0 consumes)
+    python scripts/analyze.py --rules lock-order,prng-reuse
+    python scripts/analyze.py --list-rules    # rule catalog
+    python scripts/analyze.py --lock-graph    # the reconstructed lock
+                                              # graph as JSON
+    python scripts/analyze.py --write-baseline  # grandfather every
+                                              # current NEW finding
+
+Suppression: ``# dl4j-lint: disable=<rule>[,<rule>]`` on the flagged
+line (or a comment-only line directly above). Baseline:
+``scripts/analyze_baseline.json`` — (rule, path, message) keys,
+line-free; entries carry a ``note`` saying why they are accepted.
+
+Exit 0 iff zero unsuppressed, unbaselined findings. The legacy
+``check_donation_gates.py`` / ``check_mesh_api.py`` /
+``check_metric_names.py`` CLIs remain as shims over single rules.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from deeplearning4j_tpu.analysis import (  # noqa: E402
+    all_rules,
+    analyze,
+    render_json,
+    render_text,
+    rule_by_name,
+    write_baseline,
+)
+from deeplearning4j_tpu.analysis.engine import DEFAULT_BASELINE  # noqa: E402
+from deeplearning4j_tpu.analysis.rules.lock_order import \
+    build_lock_graph  # noqa: E402
+from deeplearning4j_tpu.analysis.engine import Project  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("root", nargs="?", default=_ROOT)
+    ap.add_argument("--json", action="store_true",
+                    help="JSON report on stdout")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule names (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: "
+                         f"<root>/{DEFAULT_BASELINE})")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather every current NEW finding into "
+                         "the baseline file and exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="print the reconstructed lock-acquisition "
+                         "graph (nodes/edges/cycles) as JSON and exit "
+                         "0 iff acyclic")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also list suppressed/baselined findings")
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = [rule_by_name(r.strip())
+                 for r in args.rules.split(",") if r.strip()]
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.name}: {r.description}")
+        return 0
+
+    if args.lock_graph:
+        g = build_lock_graph(Project(args.root))
+        print(json.dumps(g.as_dict(), indent=1, sort_keys=True))
+        return 1 if g.cycles() else 0
+
+    baseline = args.baseline or os.path.join(args.root, DEFAULT_BASELINE)
+    report = analyze(args.root, rules=rules, baseline=baseline)
+
+    if args.write_baseline:
+        write_baseline(baseline, report.new)
+        print(f"baseline: {len(report.new)} findings grandfathered "
+              f"into {baseline} — fill in each entry's 'note' with why")
+        return 0
+
+    if args.json:
+        print(render_json(report))
+    else:
+        print(render_text(report, verbose=args.verbose))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

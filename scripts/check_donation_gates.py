@@ -1,27 +1,20 @@
 #!/usr/bin/env python
-"""Donation-gate lint: every ``jax.jit(..., donate_argnums=...)`` call
-site must be CPU-gated.
+"""Donation-gate lint — THIN SHIM over the ``donation-gate`` rule of
+the unified static-analysis engine (``deeplearning4j_tpu/analysis/``;
+run everything via ``scripts/analyze.py``).
 
-On this jaxlib's CPU backend, donated-buffer aliasing corrupts the
-process heap (the PR-1/2/6 hazard family: garbage rows in converged
-tables, double-free aborts at interpreter exit, nondeterministic
-corruption in whatever compiles NEXT — see ``util/jit.py``). The fix
-discipline is one of:
+The invariant, unchanged since PR 7: every ``jax.jit(...,
+donate_argnums=...)`` call site must be CPU-gated, because on this
+jaxlib's CPU backend donated-buffer aliasing corrupts the process heap
+(the PR-1/2/6 hazard family: garbage rows in converged tables,
+double-free aborts at interpreter exit, nondeterministic corruption in
+whatever compiles NEXT — see ``util/jit.py``). The accepted forms:
 
-- route the jit through ``util/jit.py cpu_safe_jit`` (module-level
-  decorators — donation dropped lazily when the backend is CPU), or
-- an inline gate at the call site: the ``donate_argnums`` value is
-  conditioned on ``jax.default_backend() != "cpu"`` within a few lines
-  of the ``jax.jit`` call (the pattern every nn/parallel site uses).
+- route the jit through ``util/jit.py cpu_safe_jit``, or
+- an inline gate: the ``donate_argnums`` value conditioned on
+  ``jax.default_backend() != "cpu"`` within a few lines of the call.
 
-This lint enforces the discipline STATICALLY so the w2v heap-corruption
-class cannot recur: it AST-walks every tracked ``.py`` file for
-``jax.jit`` calls carrying ``donate_argnums`` and fails unless the
-surrounding window contains a backend gate. ``cpu_safe_jit`` sites
-don't match (they are not ``jax.jit`` calls) and ``util/jit.py`` itself
-is the one allowed raw site.
-
-Importable (a tier-1 test runs :func:`check_repo`) and a CLI::
+Importable (tier-1 runs :func:`check_repo`) and a CLI::
 
     python scripts/check_donation_gates.py [root]
 
@@ -31,106 +24,50 @@ violation.
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import List, Tuple
+from typing import List
 
-#: files allowed to call jax.jit(donate_argnums=...) ungated — the gate
-#: implementation itself.
-ALLOWED_FILES = ("util/jit.py",)
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-#: how many lines around the call may carry the inline gate. The gate
-#: conventionally sits on the ``donate = ... if backend != "cpu"`` line
-#: directly above the jit call (or in the same statement).
-GATE_WINDOW_BEFORE = 12
-GATE_WINDOW_AFTER = 2
+from deeplearning4j_tpu.analysis.engine import Project  # noqa: E402
+from deeplearning4j_tpu.analysis.rules.donation_gate import \
+    DonationGateRule  # noqa: E402
 
-GATE_TOKEN = "default_backend()"
-CPU_TOKEN = '"cpu"'
-CPU_TOKEN_SQ = "'cpu'"
-
-
-def _is_jax_jit(node: ast.Call) -> bool:
-    """Match ``jax.jit(...)`` (the module-qualified spelling every
-    in-tree site uses; a bare ``jit`` import would rename the hazard,
-    which reviewers catch — the lint pins the dominant form)."""
-    f = node.func
-    return (isinstance(f, ast.Attribute) and f.attr == "jit"
-            and isinstance(f.value, ast.Name) and f.value.id == "jax")
-
-
-def _donates(node: ast.Call) -> bool:
-    for kw in node.keywords:
-        if kw.arg == "donate_argnums":
-            # a literal empty tuple donates nothing — not a hazard
-            if isinstance(kw.value, ast.Tuple) and not kw.value.elts:
-                return False
-            return True
-    return False
-
-
-def _gated(lines: List[str], lineno: int) -> bool:
-    """True when the inline CPU gate appears in the window around the
-    1-based ``lineno``."""
-    lo = max(0, lineno - 1 - GATE_WINDOW_BEFORE)
-    hi = min(len(lines), lineno + GATE_WINDOW_AFTER)
-    window = "\n".join(lines[lo:hi])
-    return GATE_TOKEN in window and (CPU_TOKEN in window
-                                     or CPU_TOKEN_SQ in window)
+_RULE = DonationGateRule()
 
 
 def check_file(path: str, rel: str = "") -> List[str]:
     """Violations ([] = clean) for one file."""
     rel = rel or path
-    if any(rel.endswith(a) for a in ALLOWED_FILES):
-        return []
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{rel}: unparseable ({e})"]
-    lines = src.splitlines()
-    problems = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and _is_jax_jit(node) \
-                and _donates(node):
-            if not _gated(lines, node.lineno):
-                problems.append(
-                    f"{rel}:{node.lineno}: jax.jit(donate_argnums=...) "
-                    "without a CPU gate — route through util/jit.py "
-                    "cpu_safe_jit or condition donation on "
-                    'jax.default_backend() != "cpu" at the call site '
-                    "(CPU donation aliasing corrupts the heap)")
-    return problems
-
-
-def _tracked_py_files(root: str) -> List[Tuple[str, str]]:
-    out = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames
-                       if d not in (".git", "__pycache__", ".pytest_cache",
-                                    "node_modules")]
-        for name in filenames:
-            if name.endswith(".py"):
-                path = os.path.join(dirpath, name)
-                out.append((path, os.path.relpath(path, root)))
-    return sorted(out)
+    project = Project(os.path.dirname(path) or ".", paths=[path],
+                      rels=[rel])
+    m = project.modules[0]
+    if m.parse_error is not None:
+        return [f"{rel}: unparseable ({m.parse_error})"]
+    return [f"{f.path}:{f.line}: {f.message}"
+            for f in _RULE.check(project)
+            if not m.suppressed(_RULE.name, f.line)]
 
 
 def check_repo(root: str) -> List[str]:
     """Violations across every ``.py`` file under ``root``."""
-    problems: List[str] = []
-    for path, rel in _tracked_py_files(root):
-        problems.extend(check_file(path, rel))
-    return problems
+    project = Project(root)
+    out = []
+    for f in sorted(_RULE.check(project),
+                    key=lambda f: (f.path, f.line)):
+        m = project.by_rel.get(f.path)
+        if m is not None and m.suppressed(_RULE.name, f.line):
+            continue
+        out.append(f"{f.path}:{f.line}: {f.message}")
+    return out
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    root = args[0] if args else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
+    root = args[0] if args else _ROOT
     problems = check_repo(root)
     for p in problems:
         print(p, file=sys.stderr)

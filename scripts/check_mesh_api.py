@@ -1,34 +1,23 @@
 #!/usr/bin/env python
-"""Mesh-API lint: the dead ``jax.shard_map`` attribute can never come
-back, and every mesh is built by ``parallel/mesh.py``.
+"""Mesh-API lint — THIN SHIM over the ``mesh-api`` rule of the unified
+static-analysis engine (``deeplearning4j_tpu/analysis/``; run
+everything via ``scripts/analyze.py``).
 
-The multi-chip plane was dead code for eight PRs because call sites
-used ``jax.shard_map`` — an attribute that simply does not exist on
-this jax (0.4.x); every ring-attention / pipeline / multihost /
-seq-mesh test failed identically with AttributeError since the seed.
-The rebuilt plane (``parallel/mesh.py`` MeshPlane/SpecLayout) holds two
-disciplines this lint enforces STATICALLY, the way
-``check_donation_gates.py`` pins the donation hazard:
+The invariants, unchanged since PR 9/12 (the ``jax.shard_map``
+AttributeError family was dead code for eight PRs before this lint):
 
-1. **No dead API**: any ``jax.shard_map`` attribute access is an error,
-   and the working ``jax.experimental.shard_map`` may be imported or
-   referenced ONLY by ``parallel/mesh.py`` — everything per-device goes
-   through its one sanctioned ``device_collective`` wrapper, so a jax
-   upgrade/rename breaks exactly one file.
-2. **One mesh factory**: ``Mesh(...)`` construction (bare or via
-   ``jax.sharding.Mesh`` / ``sharding.Mesh``) outside ``parallel/mesh.py``
-   is an error — topology decisions live on the MeshPlane, where the
-   lint, the checkpoint layout recorder and /healthz can see them.
+1. **No dead API**: any ``jax.shard_map`` attribute access is an
+   error, and ``jax.experimental.shard_map`` may be imported or
+   referenced ONLY by ``parallel/mesh.py`` — per-device programs go
+   through its one sanctioned ``device_collective`` wrapper.
+2. **One mesh factory**: ``Mesh(...)`` construction outside
+   ``parallel/mesh.py`` is an error — topology lives on the MeshPlane.
+3. **Serving goes through the plane**: inside
+   ``deeplearning4j_tpu/serving/`` even ``make_mesh`` /
+   ``mesh_from_grid`` calls and ``Mesh`` imports are banned — a
+   serving component is HANDED a ``MeshPlane``.
 
-3. **Serving goes through the plane** (ISSUE 12, mesh-sharded serving
-   slices): inside ``deeplearning4j_tpu/serving/`` even the sanctioned
-   low-level factories (``make_mesh`` / ``mesh_from_grid``) and ``Mesh``
-   imports are banned — a serving component is HANDED a ``MeshPlane``
-   (or builds one via ``MeshPlane.build``, which records it on the
-   active-plane seam /healthz reads); it never assembles raw mesh
-   topology itself.
-
-Importable (a tier-1 test runs :func:`check_repo`) and a CLI::
+Importable (tier-1 runs :func:`check_repo`) and a CLI::
 
     python scripts/check_mesh_api.py [root]
 
@@ -37,133 +26,50 @@ Exit 0 when the repo is clean; 1 with one line per violation.
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import List, Tuple
+from typing import List
 
-#: the one file allowed to import/construct the raw primitives.
-ALLOWED_FILES = ("parallel/mesh.py",)
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-#: directories where even the sanctioned low-level mesh factories are
-#: banned: serving code takes a MeshPlane, it never builds topology.
-SERVING_DIRS = ("deeplearning4j_tpu/serving/",)
-SERVING_BANNED_CALLS = ("make_mesh", "mesh_from_grid")
+from deeplearning4j_tpu.analysis.engine import Project  # noqa: E402
+from deeplearning4j_tpu.analysis.rules.mesh_api import \
+    MeshApiRule  # noqa: E402
 
-
-def _in_serving(rel: str) -> bool:
-    rel = rel.replace(os.sep, "/")
-    return any(d in rel for d in SERVING_DIRS)
-
-
-def _attr_chain(node) -> str:
-    """Dotted name of an attribute chain ('jax.experimental.shard_map'),
-    '' when the base is not a plain name."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
-
-
-def _is_mesh_ctor(node: ast.Call) -> bool:
-    """Match ``Mesh(...)`` / ``jax.sharding.Mesh(...)`` /
-    ``sharding.Mesh(...)`` — raw mesh construction."""
-    f = node.func
-    if isinstance(f, ast.Name):
-        return f.id == "Mesh"
-    if isinstance(f, ast.Attribute):
-        return f.attr == "Mesh"
-    return False
+_RULE = MeshApiRule()
 
 
 def check_file(path: str, rel: str = "") -> List[str]:
     """Violations ([] = clean) for one file."""
     rel = rel or path
-    allowed = any(rel.endswith(a) for a in ALLOWED_FILES)
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{rel}: unparseable ({e})"]
-    problems: List[str] = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute):
-            chain = _attr_chain(node)
-            if chain == "jax.shard_map":
-                problems.append(
-                    f"{rel}:{node.lineno}: jax.shard_map does not exist on "
-                    "this jax (the dead API that killed the multi-chip "
-                    "plane) — use parallel.mesh.device_collective, or "
-                    "jax.jit with shardings")
-            elif "shard_map" in chain.split(".") and not allowed:
-                problems.append(
-                    f"{rel}:{node.lineno}: shard_map reference outside "
-                    "parallel/mesh.py — per-device programs go through "
-                    "parallel.mesh.device_collective")
-        elif isinstance(node, (ast.Import, ast.ImportFrom)) and not allowed:
-            mod = getattr(node, "module", "") or ""
-            names = [a.name for a in node.names]
-            if "shard_map" in mod or any("shard_map" in n for n in names):
-                problems.append(
-                    f"{rel}:{node.lineno}: shard_map import outside "
-                    "parallel/mesh.py — per-device programs go through "
-                    "parallel.mesh.device_collective")
-            if _in_serving(rel) and (
-                    any(n == "Mesh" or n.endswith(".Mesh") for n in names)
-                    or any(n in SERVING_BANNED_CALLS for n in names)):
-                problems.append(
-                    f"{rel}:{node.lineno}: mesh-topology import inside "
-                    "serving/ — serving components take a MeshPlane "
-                    "(MeshPlane.build), they never assemble raw meshes")
-        elif isinstance(node, ast.Call) and _is_mesh_ctor(node) \
-                and not allowed:
-            problems.append(
-                f"{rel}:{node.lineno}: raw Mesh(...) construction outside "
-                "parallel/mesh.py — build meshes via parallel.mesh "
-                "(make_mesh / mesh_from_grid / MeshPlane)")
-        elif isinstance(node, ast.Call) and _in_serving(rel):
-            f = node.func
-            callee = f.id if isinstance(f, ast.Name) else (
-                f.attr if isinstance(f, ast.Attribute) else "")
-            if callee in SERVING_BANNED_CALLS:
-                problems.append(
-                    f"{rel}:{node.lineno}: {callee}() inside serving/ — "
-                    "the sharded-serving code goes through MeshPlane "
-                    "(MeshPlane.build / a plane handed in), never the "
-                    "low-level mesh factories")
-    return problems
-
-
-def _tracked_py_files(root: str) -> List[Tuple[str, str]]:
-    out = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames
-                       if d not in (".git", "__pycache__", ".pytest_cache",
-                                    "node_modules")]
-        for name in filenames:
-            if name.endswith(".py"):
-                path = os.path.join(dirpath, name)
-                out.append((path, os.path.relpath(path, root)))
-    return sorted(out)
+    project = Project(os.path.dirname(path) or ".", paths=[path],
+                      rels=[rel])
+    m = project.modules[0]
+    if m.parse_error is not None:
+        return [f"{rel}: unparseable ({m.parse_error})"]
+    return [f"{f.path}:{f.line}: {f.message}"
+            for f in _RULE.check(project)
+            if not m.suppressed(_RULE.name, f.line)]
 
 
 def check_repo(root: str) -> List[str]:
     """Violations across every ``.py`` file under ``root``."""
-    problems: List[str] = []
-    for path, rel in _tracked_py_files(root):
-        problems.extend(check_file(path, rel))
-    return problems
+    project = Project(root)
+    out = []
+    for f in sorted(_RULE.check(project),
+                    key=lambda f: (f.path, f.line)):
+        m = project.by_rel.get(f.path)
+        if m is not None and m.suppressed(_RULE.name, f.line):
+            continue
+        out.append(f"{f.path}:{f.line}: {f.message}")
+    return out
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    root = args[0] if args else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
+    root = args[0] if args else _ROOT
     problems = check_repo(root)
     for p in problems:
         print(p, file=sys.stderr)

@@ -1,23 +1,17 @@
 #!/usr/bin/env python
-"""Metric-name lint: every ``dl4j_*`` metric-name literal in the tree
-must be pinned in ``KNOWN_DL4J_METRICS``.
+"""Metric-name lint — THIN SHIM over the ``metric-name`` rule of the
+unified static-analysis engine (``deeplearning4j_tpu/analysis/``; run
+everything via ``scripts/analyze.py``).
 
-The telemetry schema is only as strong as its coverage: PR after PR
-the failure mode has been "new counter, forgot the schema" — a metric
-family ships, works, and silently never gets pinned, so the drift
-guard (``check_telemetry_schema.validate_known_metrics``) cannot
-protect it and a later rename breaks dashboards without a test
-failing. This lint closes the gap BY CONSTRUCTION: it walks every
-``.py`` under ``deeplearning4j_tpu/`` and flags any string literal
-shaped like a metric family name (``dl4j_`` + snake_case) that is not
-in the pinned registry. Adding a metric without adding its name to
-``KNOWN_DL4J_METRICS`` is now a tier-1 failure, not a latent hazard.
-
+The invariant, unchanged since PR 13: every ``dl4j_*`` metric-name
+literal under ``deeplearning4j_tpu/`` must be pinned in
+``KNOWN_DL4J_METRICS`` (``scripts/check_telemetry_schema.py``) so the
+schema drift guard covers it BY CONSTRUCTION — "new counter, forgot
+the schema" is a tier-1 failure, not a latent dashboard break.
 Non-metric ``dl4j_``-prefixed literals (file-format magics) are
-explicitly allowlisted — the list is the documentation of why they are
-not metrics.
+allowlisted in the rule's ``NON_METRIC_LITERALS``.
 
-Importable (a tier-1 test runs :func:`check_repo`) and a CLI::
+Importable (tier-1 runs :func:`check_repo`) and a CLI::
 
     python scripts/check_metric_names.py [package_root]
 
@@ -26,73 +20,50 @@ Exit 0 when the tree is clean; 1 with one line per violation.
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 from typing import List
 
-_HERE = os.path.dirname(os.path.abspath(__file__))
-sys.path.insert(0, _HERE)
-from check_telemetry_schema import KNOWN_DL4J_METRICS  # noqa: E402
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-#: a string literal is treated as a metric family name iff it matches
-#: this shape exactly (whole string): dl4j_ + snake_case words. Label
-#: values, topic names (dl4j-tpu-… use dashes) and docstrings never
-#: match whole.
-METRIC_RE = re.compile(r"^dl4j_[a-z0-9]+(?:_[a-z0-9]+)*$")
+from deeplearning4j_tpu.analysis.engine import Project  # noqa: E402
+from deeplearning4j_tpu.analysis.rules.metric_names import \
+    MetricNameRule  # noqa: E402
 
-#: dl4j_-prefixed literals that are NOT metric names (and why):
-#: - dl4j_tpu_dataset_export_v1: the datasets/export.py file-format
-#:   magic string; versioned data artifact, not telemetry.
-NON_METRIC_LITERALS = {
-    "dl4j_tpu_dataset_export_v1",
-}
+_RULE = MetricNameRule()
 
 
 def check_file(path: str, rel: str) -> List[str]:
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=rel)
-    except SyntaxError as e:
-        return [f"{rel}: syntax error: {e}"]
-    errors: List[str] = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Constant)
-                and isinstance(node.value, str)):
-            continue
-        s = node.value
-        if not METRIC_RE.match(s) or s in NON_METRIC_LITERALS:
-            continue
-        if s not in KNOWN_DL4J_METRICS:
-            errors.append(
-                f"{rel}:{node.lineno}: dl4j_ metric name {s!r} is not "
-                "pinned in KNOWN_DL4J_METRICS "
-                "(scripts/check_telemetry_schema.py) — add it there in "
-                "the same change, or allowlist it in "
-                "NON_METRIC_LITERALS if it is not a metric")
-    return errors
+    """Violations ([] = clean) for one file."""
+    project = Project(os.path.dirname(path) or ".", paths=[path],
+                      rels=[rel])
+    m = project.modules[0]
+    if m.parse_error is not None:
+        return [f"{rel}: syntax error: {m.parse_error}"]
+    return [f"{f.path}:{f.line}: {f.message}"
+            for f in _RULE.check(project)
+            if not m.suppressed(_RULE.name, f.line)]
 
 
 def check_repo(root: str) -> List[str]:
     """Lint every ``.py`` under ``<root>/deeplearning4j_tpu``. ``root``
     is the repo root (the directory containing the package)."""
-    pkg = os.path.join(root, "deeplearning4j_tpu")
-    errors: List[str] = []
-    for dirpath, dirnames, filenames in os.walk(pkg):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            errors.extend(check_file(path, os.path.relpath(path, root)))
-    return errors
+    project = Project(root)
+    out = []
+    for f in sorted(_RULE.check(project),
+                    key=lambda f: (f.path, f.line)):
+        m = project.by_rel.get(f.path)
+        if m is not None and m.suppressed(_RULE.name, f.line):
+            continue
+        out.append(f"{f.path}:{f.line}: {f.message}")
+    return out
 
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    root = args[0] if args else os.path.dirname(_HERE)
+    root = args[0] if args else _ROOT
     errors = check_repo(root)
     for e in errors:
         print(e, file=sys.stderr)

@@ -20,13 +20,18 @@ failure — also exit 1, but reported as such)::
         [-m faultinject] [--pytest-args ...]
 
 **Quick mode (importable — wired into tier-1)** — :func:`quick_check`
-replays the in-process deterministic injector battery (seeded NaN/raise
-schedules, flaky-broker schedules, torn-write counting, replica/model
-poison sequences, burst-kill windows, mesh-shrink drills, and the
-composed ChaosSchedule event clock, the prefix-cache
-refcount/COW/eviction accounting drill, and the slice-kill /
-slice-drill schedules, and the quantized-pool × prefix-cache
-accounting drill — sections 1–10) twice per seed
+first runs SECTION 0: the unified static-analysis engine
+(``scripts/analyze.py --json`` semantics — every rule, repo-wide,
+suppressions + baseline applied) and FAILS FAST on any new finding
+before a single chaos phase spends time — a lock-order inversion or an
+untyped wire raise is cheaper to report from the AST than to hunt in a
+drill log. Then it replays the in-process deterministic injector
+battery (seeded NaN/raise schedules, flaky-broker schedules,
+torn-write counting, replica/model poison sequences, burst-kill
+windows, mesh-shrink drills, and the composed ChaosSchedule event
+clock, the prefix-cache refcount/COW/eviction accounting drill, and
+the slice-kill / slice-drill schedules, and the quantized-pool ×
+prefix-cache accounting drill — sections 1–10) twice per seed
 across rotating seeds and compares the full event logs bit-for-bit.
 It runs in milliseconds with no subprocess and no jax compute, so the
 tier-1 sweep carries it on every run; the full mode is the pre-merge /
@@ -357,10 +362,24 @@ def _scenario_log(seed: int) -> str:
     return "\n".join(events)
 
 
+def analysis_section() -> List[str]:
+    """SECTION 0 — static analysis, fail fast: run the unified engine
+    (``deeplearning4j_tpu/analysis``, same report ``scripts/analyze.py
+    --json`` emits) repo-wide and surface every NEW finding
+    (suppressions and the committed baseline already applied). A
+    finding here aborts quick_check before any chaos phase runs."""
+    from deeplearning4j_tpu.analysis import analyze
+    report = analyze(_ROOT)
+    return [f"analysis: {f.render()}" for f in report.new]
+
+
 def quick_check(seeds=(0, 1, 2), runs_per_seed: int = 2) -> List[str]:
-    """Replay the injector battery ``runs_per_seed`` times per seed;
-    returns violations ([] = deterministic). Tier-1 runs this."""
-    problems: List[str] = []
+    """Section 0 (static analysis, fail fast), then replay the injector
+    battery ``runs_per_seed`` times per seed; returns violations
+    ([] = clean + deterministic). Tier-1 runs this."""
+    problems: List[str] = list(analysis_section())
+    if problems:
+        return problems  # fail fast: no chaos phase on a dirty tree
     for seed in seeds:
         logs = [_scenario_log(int(seed)) for _ in range(runs_per_seed)]
         for i, log in enumerate(logs[1:], 2):

@@ -1,0 +1,9 @@
+"""Fixture corpora for tests/test_lint.py — one CLEAN and one
+SEEDED-VIOLATION file per rule.
+
+These files are parsed by the analysis engine, never imported or
+executed. The directory is excluded from every repo-wide walk
+(``engine.EXCLUDED_DIRS``) precisely because the ``*_bad.py`` files
+carry deliberate violations; tests analyze them via explicit-path
+``Project``\\ s.
+"""

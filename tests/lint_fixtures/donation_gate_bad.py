@@ -1,0 +1,5 @@
+"""SEEDED VIOLATION: an ungated donation site (the w2v heap-corruption
+shape)."""
+import jax
+
+f = jax.jit(lambda x: x, donate_argnums=(0,))

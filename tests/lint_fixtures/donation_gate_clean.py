@@ -1,0 +1,12 @@
+"""CLEAN: gated and non-donating jit sites."""
+import jax
+
+# inline gate: donation conditioned on the backend
+donate = (0,) if jax.default_backend() != "cpu" else ()
+f = jax.jit(lambda x: x, donate_argnums=donate)
+
+# literal empty tuple donates nothing
+g = jax.jit(lambda x: x, donate_argnums=())
+
+# no donation at all
+h = jax.jit(lambda x: x + 1)

@@ -1,0 +1,15 @@
+"""SEEDED VIOLATIONS: device→host syncs inside a hot-path function —
+.item(), np.asarray of a dispatch result, int() of a call-result
+local, device_get and block_until_ready."""
+import jax
+import numpy as np
+
+
+def hot_burst(program, params, table):  # dl4j-lint: hot-path
+    out = program(params, table)
+    score = out[0].item()               # sync 1: .item()
+    toks = np.asarray(program(params, table))   # sync 2: fetch dispatch
+    n = int(out)                        # sync 3: int() of call result
+    host = jax.device_get(out)          # sync 4: device_get
+    jax.block_until_ready(out)          # sync 5: fence
+    return score, toks, n, host

@@ -1,0 +1,14 @@
+"""CLEAN: a hot-path function that only dispatches and does host-list
+bookkeeping — no device→host sync."""
+import numpy as np
+
+
+def hot_dispatch(program, params, table, generated):  # dl4j-lint: hot-path
+    out = program(params, table)      # dispatch only; no fetch
+    host_ids = np.asarray(generated)  # host list → host array: no sync
+    return out, host_ids
+
+
+def cold_fetch(program, params):
+    # NOT marked hot: syncing here is legal (e.g. a warmup/test path)
+    return np.asarray(program(params))

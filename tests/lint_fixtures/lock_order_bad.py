@@ -1,0 +1,33 @@
+"""SEEDED VIOLATION: an artificial lock-order INVERSION — A takes its
+lock then calls into B (which takes B's lock), while B takes its lock
+then calls back into A (which takes A's lock): A->B and B->A, the
+classic two-thread deadlock."""
+import threading
+
+
+class PeerA:
+    def __init__(self, b: "PeerB"):
+        self._lock = threading.Lock()
+        self.b = b
+
+    def forward(self, b: "PeerB"):
+        with self._lock:
+            b.poke()                # holds A, acquires B
+
+    def poke(self):
+        with self._lock:
+            pass
+
+
+class PeerB:
+    def __init__(self, a: "PeerA"):
+        self._lock = threading.Lock()
+        self.a = a
+
+    def backward(self, a: "PeerA"):
+        with self._lock:
+            a.poke()                # holds B, acquires A — inversion
+
+    def poke(self):
+        with self._lock:
+            pass

@@ -1,0 +1,28 @@
+"""CLEAN: two locks acquired in ONE consistent order (outer → inner),
+including through a call — no cycle."""
+import threading
+
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+
+class Outer:
+    def __init__(self, inner: "Inner"):
+        self._lock = threading.Lock()
+        self.inner = inner
+
+    def direct(self, inner: "Inner"):
+        with self._lock:
+            with inner._lock:       # Outer -> Inner, consistently
+                pass
+
+    def via_call(self, inner: "Inner"):
+        with self._lock:
+            inner.bump()            # Outer -> Inner again: same order

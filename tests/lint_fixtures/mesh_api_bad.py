@@ -1,0 +1,8 @@
+"""SEEDED VIOLATIONS: the dead jax.shard_map attribute, a rogue
+shard_map import, and raw Mesh construction outside parallel/mesh.py."""
+import jax
+from jax.sharding import Mesh
+from jax.experimental.shard_map import shard_map
+
+f = jax.shard_map(lambda x: x, mesh=None, in_specs=None, out_specs=None)
+m = Mesh([], ("data",))

@@ -1,0 +1,5 @@
+"""CLEAN: mesh use through the sanctioned plane API."""
+from deeplearning4j_tpu.parallel.mesh import MeshPlane, device_collective
+
+plane = MeshPlane.build({"data": 2})
+out = device_collective(lambda x: x, plane, None, None)

@@ -1,0 +1,5 @@
+"""SEEDED VIOLATION: a dl4j_ metric family not pinned in
+KNOWN_DL4J_METRICS."""
+from deeplearning4j_tpu.monitor import get_registry
+
+get_registry().counter("dl4j_totally_unpinned_total", "oops").inc()

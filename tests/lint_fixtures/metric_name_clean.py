@@ -1,0 +1,6 @@
+"""CLEAN: pinned metric names, dash-named topics, allowlisted magic."""
+from deeplearning4j_tpu.monitor import get_registry
+
+get_registry().counter("dl4j_router_requests_total", "pinned").inc()
+TOPIC = "dl4j-tpu-worker"           # dashes: topic, not a metric
+MAGIC = "dl4j_tpu_dataset_export_v1"  # allowlisted file-format magic

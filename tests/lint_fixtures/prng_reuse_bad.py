@@ -1,0 +1,18 @@
+"""SEEDED VIOLATIONS: a key consumed twice without an interleaving
+split/fold_in — sequentially, and across loop iterations."""
+import jax
+
+
+def double_draw(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, (4,))
+    b = jax.random.gumbel(key, (4,))     # REUSE: correlated draws
+    return a, b
+
+
+def loop_reuse(seed, n):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for _ in range(n):
+        out.append(jax.random.uniform(key, ()))   # REUSE each iteration
+    return out

@@ -1,0 +1,27 @@
+"""CLEAN: every extra draw derives a fresh key first (split/fold_in);
+loop draws fold by index."""
+import jax
+
+
+def sample_pair(seed):
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.gumbel(k2, (4,))
+    return a, b
+
+
+def sample_loop(seed, n):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for i in range(n):
+        k = jax.random.fold_in(key, i)   # fresh key per iteration
+        out.append(jax.random.uniform(k, ()))
+    return out
+
+
+def branch_draws(seed, flag):
+    key = jax.random.PRNGKey(seed)
+    if flag:
+        return jax.random.normal(key, ())
+    return jax.random.gumbel(key, ())    # exclusive arms: one draw

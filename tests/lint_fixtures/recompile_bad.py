@@ -1,0 +1,19 @@
+"""SEEDED VIOLATIONS: raw data-dependent shapes reaching program
+getters (fresh XLA compile per distinct request shape), plus an inline
+jax.jit invocation (retrace per call)."""
+import jax
+
+
+class Sched:
+    def __init__(self, gen):
+        self.gen = gen
+
+    def admit(self, prompt, x):
+        pre = self.gen.prefill_program(len(prompt))       # raw len()
+        scat = self.gen.scatter_program(x.shape[0])       # raw .shape
+        t = len(prompt) + 1
+        tail = self.gen.tail_prefill_program(t)           # tainted local
+        return pre, scat, tail
+
+    def fresh_jit(self, f, x):
+        return jax.jit(f)(x)                              # inline jit

@@ -1,0 +1,18 @@
+"""CLEAN: every data-dependent shape reaches the program getters
+through the pinned ladders."""
+from deeplearning4j_tpu.datasets.iterators import bucket_for, bucket_sizes
+
+
+class Sched:
+    def __init__(self, gen, pool, block_size):
+        self.gen = gen
+        self.pool = pool
+        self.block_size = block_size
+
+    def admit(self, prompt, entries):
+        t_pad = self.gen.prompt_bucket(len(prompt), 1)   # pinned
+        rows = bucket_for(len(entries), (1, 2, 4))        # pinned
+        need = self.pool.blocks_for(len(prompt))          # pinned
+        pre = self.gen.prefill_program(t_pad)
+        scat = self.gen.scatter_program(rows, need, self.block_size)
+        return pre, scat, bucket_sizes(64)

@@ -1,0 +1,16 @@
+"""SEEDED VIOLATIONS: bare RuntimeError/Exception raises reachable
+from a wire frame handler — they cross the wire untyped and degrade
+to EndpointError on the caller."""
+
+
+class Handler:
+    def handle_frame(self, payload):  # dl4j-lint: wire-handler
+        return self.do_submit(payload)
+
+    def do_submit(self, payload):
+        if payload is None:
+            raise RuntimeError("engine is shut down")   # bare: violation
+        return self.deeper(payload)
+
+    def deeper(self, payload):
+        raise Exception("boom")                         # bare: violation

@@ -1,0 +1,18 @@
+"""CLEAN: a wire frame handler whose error paths all raise TYPED
+subclasses (registrable in wire._typed_error_registry)."""
+
+
+class FixtureShutdown(RuntimeError):
+    """Typed: a subclass, never the bare class."""
+
+
+class Handler:
+    def handle_frame(self, payload):  # dl4j-lint: wire-handler
+        return self.do_submit(payload)
+
+    def do_submit(self, payload):
+        if payload is None:
+            raise FixtureShutdown("engine is shut down")
+        if not isinstance(payload, bytes):
+            raise ValueError("not a frame")  # ValueError is not bare
+        return payload

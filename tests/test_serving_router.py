@@ -809,6 +809,26 @@ def _warm_endpoint(fleet, name, prompt, max_new):
     fleet.endpoint(name).submit_generate(prompt, max_new).result(60)
 
 
+def _scale_timeouts(router, fleet, name, prompt, max_new,
+                    floor_s, cap_s):
+    """Deflake (PR-10 wall-clock-timeout family on 1-core boxes):
+    tier-1 runs this file under heavy parallel load, where a WARM
+    healthy dispatch alone can approach a fixed 1.5-3s reply budget —
+    the timeout then fires on a healthy engine and the test flakes.
+    Time one warmed dispatch on this box RIGHT NOW and scale every
+    reply/silence deadline off it (floor = the original tight budget,
+    so an idle box keeps the original timing; cap keeps the failure
+    path inside the test's own result() budget). Returns the budget."""
+    t0 = time.perf_counter()
+    _warm_endpoint(fleet, name, prompt, max_new)  # warmed: measures load
+    warm_s = time.perf_counter() - t0
+    budget = min(cap_s, max(floor_s, 10.0 * warm_s))
+    router.per_try_timeout = budget
+    for n in fleet.names():
+        fleet.endpoint(n).request_timeout = budget
+    return budget
+
+
 def test_stream_migrates_on_burst_kill_resumed_not_restarted(rng,
                                                              fresh_registry):
     """THE acceptance scenario, deterministic: the pinned engine's
@@ -897,6 +917,11 @@ def test_stream_survives_stalled_endpoint_timeout(rng, fresh_registry):
         _warm_endpoint(fleet, "engine-1", prompt, 16)
         _warm_endpoint(fleet, "engine-1",
                        rng.integers(0, 11, (1, 10)), 11)
+        # then scale the silence/reply budget off this box's measured
+        # warm-dispatch cost (the stalled engine holds its burst for
+        # 60s, so any finite budget still fires the migration)
+        _scale_timeouts(router, fleet, "engine-1", prompt, 16,
+                        floor_s=3.0, cap_s=20.0)
         coll = _Chunks()
         fut = router.submit_generate(prompt, 16, session="stall",
                                      on_tokens=coll)
@@ -944,6 +969,11 @@ def test_mid_generation_kill_restarted_stream_matches_eager(rng,
         prompt = rng.integers(0, 11, (1, 5))
         want = generate_eager(g, prompt, 16)
         _warm_endpoint(fleet, "engine-1", prompt, 16)
+        # scale the reply budget off measured load (the kill is
+        # detected by reply timeout — a fixed 1.5s budget also fires
+        # on a HEALTHY loaded engine and flakes the restart count)
+        _scale_timeouts(router, fleet, "engine-1", prompt, 16,
+                        floor_s=1.5, cap_s=15.0)
         fut = router.submit_generate(prompt, 16, session="res")
         assert _spin_until(lambda: gate.calls >= 2, timeout=30)
         kill_endpoint(fleet, "engine-0")  # mid-generation engine death
