@@ -93,6 +93,13 @@ def _enable_compile_cache():
 PEAK_BF16 = 197e12
 PEAK_F32 = 98.5e12
 
+# Output-payload schema the trend gate (scripts/bench_trend.py) diffs
+# against history: top-level {metric, value, unit, vs_baseline,
+# schema_version, sub_benchmarks: {name: {metric, value, unit, ...}}}.
+# Bump ONLY on breaking shape changes (renamed/retyped required keys);
+# adding optional keys is compatible and needs no bump.
+BENCH_SCHEMA_VERSION = 1
+
 
 def _timeit(fn, warmup=1, iters=3):
     """Time a jitted fn that RETURNS A SCALAR; synchronization is by
@@ -895,6 +902,19 @@ def bench_continuous_decode():
             "p99_ms": round(float(np.percentile(v, 99)), 3)}
         for k, v in sorted(phase_ms.items())}
 
+    # --- capacity observatory overhead (this PR): the SAME drive with
+    # the windowed time-series layer DISABLED — the A/B behind the ≤2%
+    # acceptance bar. Enabled is the default, so ``cont`` above IS the
+    # enabled arm; every observatory sample is a host-side float
+    # append, so the jit-miss window spanning all these runs also
+    # proves it compiles nothing.
+    prev_ts = monitor.set_timeseries_enabled(False)
+    try:
+        obs_off = drive(cont_eng, scheduler=sched)
+    finally:
+        monitor.set_timeseries_enabled(prev_ts)
+    active_q = monitor.ts_query(monitor.TS_SCHED_ACTIVE, 60.0)
+
     steady_misses = reg.family_total(monitor.JIT_CACHE_MISS_COUNTER) - miss0
     cont_eng.drain(60)
     pool = sched.stats()["pool"]
@@ -938,6 +958,20 @@ def bench_continuous_decode():
                                   for e in tracer.completed_traces()),
             "spans_dropped": int(tracer.dropped),
             "ttft_phase_ms": ttft_phases,
+        },
+        # capacity observatory cost: enabled (default) vs disabled on
+        # the same engine/trace, plus one live window query as proof
+        # the series actually populated during the enabled run
+        "observatory": {
+            "tokens_per_sec_enabled": round(cont["tokens_per_sec"], 1),
+            "tokens_per_sec_disabled": round(obs_off["tokens_per_sec"], 1),
+            "overhead_frac": round(
+                max(0.0, 1.0 - cont["tokens_per_sec"]
+                    / max(1e-9, obs_off["tokens_per_sec"])), 4),
+            "active_rows_60s": (None if active_q is None else {
+                "count": active_q["count"],
+                "mean": round(active_q["mean"], 3),
+                "p99": round(active_q["p99"], 3)}),
         },
     }
 
@@ -2620,6 +2654,9 @@ def main():
         headline = next(iter(subs.values()), {"metric": "none", "value": 0,
                                               "unit": "", "vs_baseline": 0})
     out = dict(headline)
+    # machine-readable schema contract for scripts/bench_trend.py: the
+    # trend gate refuses to diff payloads whose shape it doesn't know
+    out["schema_version"] = BENCH_SCHEMA_VERSION
     out["sub_benchmarks"] = subs
     print(json.dumps(out))
 

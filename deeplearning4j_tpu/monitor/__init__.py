@@ -279,6 +279,20 @@ TRACE_DROPPED_COUNTER = "dl4j_trace_dropped_total"
 TRACE_ACTIVE_GAUGE = "dl4j_trace_active"
 TRACE_FLIGHT_DUMPS_COUNTER = "dl4j_trace_flight_dumps_total"
 
+# Capacity observatory (monitor/timeseries.py TimeSeriesStore behind
+# the registry): windowed time-series of the serving plane's sampled
+# gauges — the ``dl4j_ts_*`` series names live in monitor/timeseries.py
+# (TS_SCHED_*, TS_ROUTER_*, TS_ENGINE_*, TS_SLO_BURN, TS_WORKER_SERVED,
+# re-exported below) and answer ``query(name, window)`` with
+# rate/mean/p50/p99 over aligned 1s/10s/60s tiers — served at
+# ``UiServer /timeseries`` and carried per-endpoint in ``stats()``
+# payloads so ``fleet_snapshot()`` merges fleet-wide window answers.
+# The per-model/per-owner resource-attribution families ride alongside:
+ATTR_KV_BYTE_SECONDS_GAUGE = "dl4j_attr_kv_byte_seconds"
+ATTR_PREFILL_TOKENS_COUNTER = "dl4j_attr_prefill_tokens_total"
+ATTR_DECODE_TOKENS_COUNTER = "dl4j_attr_decode_tokens_total"
+ATTR_QUEUE_MS_COUNTER = "dl4j_attr_queue_ms_total"
+
 # Fault-tolerance plane (detect → isolate → recover): every recovery
 # path in the stack reports through these five families so an operator
 # can tell a self-healed fault from a healthy run. ``domain`` label on
@@ -327,6 +341,25 @@ from deeplearning4j_tpu.monitor.tracing import (  # noqa: F401
     now_us,
     span,
     to_origin_us,
+)
+from deeplearning4j_tpu.monitor.timeseries import (  # noqa: F401
+    TS_ENGINE_FILL_RATIO,
+    TS_ENGINE_JIT_MISS,
+    TS_ROUTER_ADMIT_ERROR,
+    TS_ROUTER_QUEUE_DEPTH,
+    TS_ROUTER_SHED,
+    TS_SCHED_ACTIVE,
+    TS_SCHED_POOL_OCCUPANCY,
+    TS_SCHED_PREFIX_HIT_RATE,
+    TS_SCHED_QUEUED,
+    TS_SLO_BURN,
+    TS_WORKER_SERVED,
+    TimeSeriesStore,
+    merge_summaries,
+    set_timeseries_enabled,
+    timeseries_enabled,
+    ts_query,
+    ts_record,
 )
 from deeplearning4j_tpu.monitor.reqtrace import (  # noqa: F401
     FlightRecorder,

@@ -37,6 +37,12 @@ def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def _escape_help(v: str) -> str:
+    """HELP-text escaping per the text-format spec: only backslash and
+    newline (quotes stay literal in HELP lines, unlike label values)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt(v: float) -> str:
     if math.isnan(v):
         return "NaN"
@@ -183,6 +189,23 @@ class MetricsRegistry:
     def __init__(self):
         self._families: Dict[str, _Family] = {}
         self._lock = threading.Lock()
+        self._timeseries = None  # lazy TimeSeriesStore (see property)
+
+    @property
+    def timeseries(self):
+        """The registry's windowed time-series store (lazy — the local
+        import keeps monitor.registry importable before
+        monitor.timeseries at package-init time). A fresh registry
+        (``set_registry(MetricsRegistry())``) means a fresh store, so
+        bench/test isolation covers the series too."""
+        store = self._timeseries
+        if store is None:
+            from deeplearning4j_tpu.monitor.timeseries import TimeSeriesStore
+            with self._lock:
+                if self._timeseries is None:
+                    self._timeseries = TimeSeriesStore()
+                store = self._timeseries
+        return store
 
     # ------------------------------------------------------------ create
 
@@ -242,7 +265,7 @@ class MetricsRegistry:
             fams = [(n, self._families[n]) for n in sorted(self._families)]
         for name, fam in fams:
             if fam.help:
-                out.append(f"# HELP {name} {fam.help}")
+                out.append(f"# HELP {name} {_escape_help(fam.help)}")
             out.append(f"# TYPE {name} {fam.kind}")
             for key, metric in sorted(fam.metrics.items()):
                 base = dict(key)

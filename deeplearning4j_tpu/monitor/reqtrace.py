@@ -419,6 +419,11 @@ _active: Optional[RequestTracer] = None
 _flight: Optional[FlightRecorder] = None
 _state_lock = threading.Lock()
 _tls = threading.local()
+# sustained-SLO-burn auto-trigger: (threshold, window_s, cooldown_s)
+# when armed via configure_flight_recorder(burn_threshold=...), plus
+# the monotonic timestamp of the last slo_burn trigger (the cooldown)
+_burn_cfg: Optional[Tuple[int, float, float]] = None
+_burn_last: Optional[float] = None
 
 
 def enable_request_tracing(sample: float = 1.0, max_traces: int = 1024,
@@ -469,14 +474,33 @@ def flight_recorder() -> FlightRecorder:
 
 def configure_flight_recorder(dump_dir: Optional[str] = None,
                               capacity_traces: int = 256,
-                              capacity_events: int = 2048
+                              capacity_events: int = 2048,
+                              burn_threshold: Optional[int] = None,
+                              burn_window_s: float = 60.0,
+                              burn_cooldown_s: float = 60.0
                               ) -> FlightRecorder:
     """Replace the process-wide flight recorder (arming ``dump_dir``
-    makes every :func:`flight_trigger` dump JSONL there)."""
-    global _flight
+    makes every :func:`flight_trigger` dump JSONL there).
+
+    ``burn_threshold`` arms the sustained-SLO-burn auto-trigger: when
+    :func:`note_slo_burn` sees at least that many burned requests
+    (missed/shed/failed) inside the trailing ``burn_window_s`` of the
+    ``dl4j_ts_slo_burn`` time series, the recorder fires a
+    ``slo_burn`` trigger (dumping the rings when ``dump_dir`` is
+    armed), then holds for ``burn_cooldown_s`` so a sustained incident
+    yields one dump per cooldown, not one per miss. ``None`` (the
+    default) disables the auto-trigger."""
+    global _flight, _burn_cfg, _burn_last
     with _state_lock:
         _flight = FlightRecorder(capacity_traces, capacity_events,
                                  dump_dir)
+        if burn_threshold is None:
+            _burn_cfg = None
+        else:
+            _burn_cfg = (max(1, int(burn_threshold)),
+                         max(1e-9, float(burn_window_s)),
+                         max(0.0, float(burn_cooldown_s)))
+        _burn_last = None
         return _flight
 
 
@@ -486,6 +510,34 @@ def flight_event(kind: str, **attrs) -> None:
 
 def flight_trigger(reason: str, **attrs) -> Optional[str]:
     return flight_recorder().trigger(reason, **attrs)
+
+
+def note_slo_burn(outcome: str, model: Optional[str] = None
+                  ) -> Optional[str]:
+    """One SLO-burning request outcome happened (the router calls this
+    AFTER recording the ``dl4j_ts_slo_burn`` sample). When the burn
+    auto-trigger is armed and the trailing-window burn count crosses
+    the threshold outside the cooldown, fire the ``slo_burn`` flight
+    trigger; returns the dump path when one was written."""
+    cfg = _burn_cfg
+    if cfg is None:
+        return None
+    threshold, window_s, cooldown_s = cfg
+    from deeplearning4j_tpu.monitor.timeseries import TS_SLO_BURN, ts_query
+    q = ts_query(TS_SLO_BURN, window_s)
+    burned = int(q["count"]) if q else 0
+    if burned < threshold:
+        return None
+    global _burn_last
+    now = time.monotonic()
+    with _state_lock:
+        if _burn_last is not None and now - _burn_last < cooldown_s:
+            return None
+        _burn_last = now
+    return flight_trigger(
+        "slo_burn", outcome=str(outcome),
+        model=model if model is not None else "default",
+        burned=burned, window_s=window_s, threshold=threshold)
 
 
 # ------------------------------------------------- context propagation

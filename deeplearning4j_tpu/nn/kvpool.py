@@ -70,12 +70,14 @@ from __future__ import annotations
 
 import math
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.monitor import (
+    ATTR_KV_BYTE_SECONDS_GAUGE,
     KVPOOL_ALLOC_FAILURES_COUNTER,
     KVPOOL_BLOCKS_FREE_GAUGE,
     KVPOOL_BLOCKS_TOTAL_GAUGE,
@@ -101,6 +103,13 @@ def pool_spec(num_layers: int, num_heads: int, head_dim: int,
             "" if quant is None else str(quant))
 
 
+#: Attribution bucket for references acquired without an owner tag
+#: (internal sharing — e.g. the prefix cache pinning retired blocks).
+#: Reported like any other owner, so cache-held capacity is visible
+#: rather than vanishing from the conservation sum.
+UNTAGGED_OWNER = "_untagged"
+
+
 class PagedKVCachePool:
     """Fixed-size token-block KV pool shared by every sequence of a
     matching layout, with deterministic host-side alloc/free accounting.
@@ -118,7 +127,8 @@ class PagedKVCachePool:
     def __init__(self, num_blocks: int, block_size: int, num_layers: int,
                  num_heads: int, head_dim: int, dtype=jnp.float32,
                  device=None, name: str = "default", sharding=None,
-                 quant: Optional[str] = None):
+                 quant: Optional[str] = None,
+                 clock: Optional[Callable[[], float]] = None):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is the reserved trash "
@@ -185,7 +195,39 @@ class PagedKVCachePool:
         # alloc finds the free list short; returns blocks to the free
         # list (via free_blocks) so the retry below can claim them
         self._reclaimer = None
+        # ------- per-owner byte-second attribution (Autopilot-style) --
+        # Each REFERENCE carries an owner tag (lane key, cache, …);
+        # byte-seconds integrate lazily: every ref-changing op (and
+        # every attribution() read) first bills the elapsed interval at
+        # the rates in force since the last tick. A shared block bills
+        # EVERY holder — capacity consumed = references held, so the
+        # conservation law reads: sum over owners of byte-seconds ==
+        # the pool's independently integrated reference-byte-seconds
+        # (exact under an integer logical clock; float-rounding-close
+        # under the wall clock).
+        self._clock = clock if clock is not None else time.monotonic
+        self._block_bytes = self.block_bytes()
+        self._ref_owners: Dict[int, List[str]] = {}  # block -> tags (1/ref)
+        self._owner_refs: Dict[str, int] = {}        # owner -> live refs
+        self._owner_bs: Dict[str, float] = {}        # owner -> byte-seconds
+        self._pool_bs = 0.0                          # Σrefs integral
+        self._attr_t = self._clock()
         self._publish()
+
+    def _tick_attr_locked(self) -> None:
+        """Bill the interval since the last tick (callers hold _lock)."""
+        now = self._clock()
+        dt = now - self._attr_t
+        if dt > 0:
+            bb = self._block_bytes
+            total_refs = 0
+            for owner, refs in self._owner_refs.items():
+                if refs:
+                    self._owner_bs[owner] = (
+                        self._owner_bs.get(owner, 0.0) + dt * refs * bb)
+                    total_refs += refs
+            self._pool_bs += dt * total_refs * bb
+        self._attr_t = now
 
     # ------------------------------------------------------- accounting
 
@@ -203,18 +245,21 @@ class PagedKVCachePool:
         """Logical blocks covering ``tokens`` cache positions."""
         return max(0, math.ceil(int(tokens) / self.block_size))
 
-    def alloc(self, n: int) -> Optional[List[int]]:
+    def alloc(self, n: int, owner: Optional[str] = None
+              ) -> Optional[List[int]]:
         """Claim ``n`` blocks at refcount 1 (lowest free ids first —
         deterministic), or None when the pool cannot cover them
         (nothing is claimed; the failure counter ticks — the
         scheduler's preempt signal). When a reclaimer is registered
         (the prefix cache), a short free list first asks it to evict
         cached-but-unreferenced blocks — cache memory yields to live
-        demand before preemption ever runs."""
+        demand before preemption ever runs. ``owner`` tags the new
+        references for byte-second attribution (lane key, session id);
+        untagged references bill the ``_untagged`` bucket."""
         n = int(n)
         if n <= 0:
             return []
-        got = self._try_alloc(n)
+        got = self._try_alloc(n, owner)
         if got is None and self._reclaimer is not None:
             with self._lock:
                 short = n - len(self._free)
@@ -222,7 +267,7 @@ class PagedKVCachePool:
                 self._reclaimer(short)
             except BaseException:  # a broken evictor must not kill alloc
                 pass
-            got = self._try_alloc(n)
+            got = self._try_alloc(n, owner)
         if got is None:
             with self._lock:
                 self._alloc_failures += 1
@@ -233,22 +278,31 @@ class PagedKVCachePool:
         self._publish()
         return got
 
-    def _try_alloc(self, n: int) -> Optional[List[int]]:
+    def _try_alloc(self, n: int, owner: Optional[str] = None
+                   ) -> Optional[List[int]]:
+        tag = owner if owner is not None else UNTAGGED_OWNER
         with self._lock:
             if n > len(self._free):
                 return None
+            self._tick_attr_locked()
             got = self._free[:n]
             del self._free[:n]
             for b in got:
                 self._refs[b] = 1
+                self._ref_owners[b] = [tag]
+            self._owner_refs[tag] = self._owner_refs.get(tag, 0) + n
         return got
 
-    def share_blocks(self, ids: List[int]) -> List[int]:
+    def share_blocks(self, ids: List[int],
+                     owner: Optional[str] = None) -> List[int]:
         """Take one extra reference on each (allocated) block — the
         sharing half of copy-on-write: a prefix cache pinning a retired
         sequence's blocks, or an admitted sequence cloning the block
         table of its matched prefix. Sharing a free (or trash) block is
-        an accounting bug and raises. Returns ``ids`` for chaining."""
+        an accounting bug and raises. Returns ``ids`` for chaining.
+        ``owner`` tags the NEW references: a shared block bills every
+        holder — each reference is capacity someone is consuming."""
+        tag = owner if owner is not None else UNTAGGED_OWNER
         with self._lock:
             for b in ids:
                 b = int(b)
@@ -258,8 +312,11 @@ class PagedKVCachePool:
                     raise ValueError(
                         f"block {b} is free — cannot share an unowned "
                         f"block (pool {self.name!r})")
+            self._tick_attr_locked()
             for b in ids:
                 self._refs[int(b)] += 1
+                self._ref_owners[int(b)].append(tag)
+            self._owner_refs[tag] = self._owner_refs.get(tag, 0) + len(ids)
         return list(ids)
 
     def ref_count(self, block: int) -> int:
@@ -268,13 +325,19 @@ class PagedKVCachePool:
         with self._lock:
             return self._refs.get(int(block), 0)
 
-    def free_blocks(self, ids: List[int]) -> None:
+    def free_blocks(self, ids: List[int],
+                    owner: Optional[str] = None) -> None:
         """Drop ONE reference per listed block; blocks whose last
         reference drops return to the free list (kept sorted so
         replayed schedules re-allocate identically). Dropping a
-        reference on a free block is a double free and raises."""
+        reference on a free block is a double free and raises.
+        ``owner`` names whose reference is released for attribution;
+        a tag the block does not carry falls back to the untagged tag,
+        then to the newest tag — releasing never fails on a mismatched
+        owner (billing is best-effort, refcounts are the law)."""
         if not ids:
             return
+        tag = owner if owner is not None else UNTAGGED_OWNER
         with self._lock:
             for b in ids:
                 b = int(b)
@@ -284,12 +347,30 @@ class PagedKVCachePool:
                     raise RuntimeError(
                         f"pool {self.name!r}: double free of block {b} "
                         f"(refcount already 0)")
+            self._tick_attr_locked()
             released = []
             for b in ids:
                 b = int(b)
                 r = self._refs[b] - 1
+                owners = self._ref_owners.get(b, [])
+                if tag in owners:
+                    owners.remove(tag)
+                    billed = tag
+                elif UNTAGGED_OWNER in owners:
+                    owners.remove(UNTAGGED_OWNER)
+                    billed = UNTAGGED_OWNER
+                elif owners:
+                    billed = owners.pop()
+                else:  # untracked reference (defensive) — bill default
+                    billed = UNTAGGED_OWNER
+                held = self._owner_refs.get(billed, 0)
+                if held > 1:
+                    self._owner_refs[billed] = held - 1
+                else:
+                    self._owner_refs.pop(billed, None)
                 if r == 0:
                     del self._refs[b]
+                    self._ref_owners.pop(b, None)
                     released.append(b)
                 else:
                     self._refs[b] = r
@@ -334,6 +415,27 @@ class PagedKVCachePool:
                               if self.total_blocks else 0.0),
                 "shared_blocks": shared,
                 "alloc_failures": failures}
+
+    def attribution(self) -> Dict[str, object]:
+        """Per-owner capacity bill: byte-seconds of pool references
+        each owner has held (interval billed up to now), live held
+        references, and the pool's independently integrated total —
+        the conservation law is ``sum(byte_seconds.values()) ==
+        total_byte_seconds`` (exact under an integer logical clock).
+        Publishes the ``dl4j_attr_kv_byte_seconds`` gauge per owner."""
+        with self._lock:
+            self._tick_attr_locked()
+            owners = dict(self._owner_bs)
+            held = dict(self._owner_refs)
+            total = self._pool_bs
+        reg = get_registry()
+        for owner, bs in owners.items():
+            reg.gauge(ATTR_KV_BYTE_SECONDS_GAUGE,
+                      "Cumulative KV-block byte-seconds held, per owner",
+                      pool=self.name, owner=owner).set(bs)
+        return {"pool": self.name, "block_bytes": self._block_bytes,
+                "byte_seconds": owners, "held_refs": held,
+                "total_byte_seconds": total}
 
     def block_bytes(self) -> int:
         """Device bytes one logical block occupies across every layer's

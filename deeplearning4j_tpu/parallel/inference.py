@@ -118,10 +118,14 @@ from deeplearning4j_tpu.monitor import (
     INFER_PADDED_RATIO_GAUGE,
     INFER_QUEUE_DEPTH_GAUGE,
     INFER_REQUESTS_COUNTER,
+    TS_ENGINE_FILL_RATIO,
+    TS_ENGINE_JIT_MISS,
+    TimeSeriesStore,
     get_registry,
     mark,
     record_fault,
     span,
+    timeseries_enabled,
 )
 from deeplearning4j_tpu.monitor import reqtrace
 from deeplearning4j_tpu.monitor.tracing import to_origin_us
@@ -468,6 +472,12 @@ class ParallelInference:
         self._fault_log: List[str] = []
         self._rows_dispatched = 0
         self._rows_padded = 0
+        # engine-PRIVATE windowed series (batch fill ratio, jit-miss
+        # rate): a LocalFleet runs several engines in one process, so
+        # the process-global store would blur them together — each
+        # engine keeps its own and ships a compact summary in stats()
+        # (heartbeat-carried for remote workers)
+        self._ts = TimeSeriesStore()
         self._batches = 0
         self._requests = 0
         self._resolved = 0  # futures delivered (result or error)
@@ -1071,6 +1081,14 @@ class ParallelInference:
         mv.warmed = True
         return compiled
 
+    @property
+    def timeseries(self) -> TimeSeriesStore:
+        """This engine's private windowed-series store (fill ratio,
+        jit-miss rate; the fleet worker adds its served-delta series).
+        Private per engine so LocalFleet's in-process endpoints don't
+        blur into one store."""
+        return self._ts
+
     def stats(self) -> Dict[str, float]:
         with self._lock:
             rows, padded = self._rows_dispatched, self._rows_padded
@@ -1094,6 +1112,11 @@ class ParallelInference:
                 "warmed": self._warmed,
                 "faults": len(self._fault_log),
             }
+        # compact windowed summary riding the stats snapshot (and so
+        # every fleet heartbeat): fleet_snapshot() merges these into
+        # the fleet-wide window view
+        if timeseries_enabled():
+            out["timeseries"] = self._ts.summary()
         if self.slice_plane is not None:
             # heartbeats carry the slice topology: fleet_snapshot() and
             # /healthz show per-endpoint (width, devices, degraded)
@@ -1342,6 +1365,11 @@ class ParallelInference:
         reg.gauge(INFER_PADDED_RATIO_GAUGE,
                   "Cumulative fraction of dispatched rows that were bucket "
                   "padding").set(ratio)
+        if timeseries_enabled():
+            # per-batch fill ratio (real rows / padded batch rows):
+            # the windowed view of how much bucket padding costs NOW,
+            # vs the cumulative gauge above
+            self._ts.record(TS_ENGINE_FILL_RATIO, rows / x.shape[0])
         return _Batch(reqs, x, rows, payload,
                       model=reqs[0].model, version=reqs[0].version)
 
@@ -1484,6 +1512,12 @@ class ParallelInference:
                     fresh = note_dispatch(
                         net, self._dispatch_sig(idx, b.x.shape,
                                                 b.model, b.version))
+                    if timeseries_enabled():
+                        # jit-miss rate on the SERVE path: mean over a
+                        # window is the fraction of dispatches that ate
+                        # an XLA compile (steady state: 0.0)
+                        self._ts.record(TS_ENGINE_JIT_MISS,
+                                        1.0 if fresh else 0.0)
                     with span("compile" if fresh else "inference",
                               path="parallel_inference", replica=idx,
                               rows=b.rows, batch=int(b.x.shape[0])):

@@ -72,6 +72,9 @@ import jax
 import numpy as np
 
 from deeplearning4j_tpu.monitor import (
+    ATTR_DECODE_TOKENS_COUNTER,
+    ATTR_PREFILL_TOKENS_COUNTER,
+    ATTR_QUEUE_MS_COUNTER,
     SCHED_ACTIVE_GAUGE,
     SCHED_ADMITTED_COUNTER,
     SCHED_BURST_LATENCY_HISTOGRAM,
@@ -80,10 +83,16 @@ from deeplearning4j_tpu.monitor import (
     SCHED_QUEUED_GAUGE,
     SCHED_RETIRED_COUNTER,
     STREAM_CHUNKS_COUNTER,
+    TS_SCHED_ACTIVE,
+    TS_SCHED_POOL_OCCUPANCY,
+    TS_SCHED_PREFIX_HIT_RATE,
+    TS_SCHED_QUEUED,
     get_registry,
     mark,
     record_fault,
     span,
+    timeseries_enabled,
+    ts_record,
 )
 from deeplearning4j_tpu.monitor import reqtrace
 from deeplearning4j_tpu.monitor.tracing import to_origin_us
@@ -109,6 +118,16 @@ class KVPoolExhausted(RuntimeError):
     """A sequence needs more KV blocks than the pool can EVER provide
     (even with every other sequence preempted) — a sizing error, not a
     transient: fail fast instead of deadlocking the admission queue."""
+
+
+def _owner_key(lane_key: Tuple) -> str:
+    """Attribution owner tag for a lane: the model name, with the
+    version pinned when one is (a canary and its stable version meter
+    SEPARATELY — attribution exactness under a cutover is the point).
+    Net-mode lanes (no registry) bill ``default``."""
+    model, version = lane_key
+    base = model if model is not None else "default"
+    return base if version is None else f"{base}@v{version}"
 
 
 class _DecodeRequest:
@@ -398,6 +417,12 @@ class ContinuousDecodeScheduler:
         self._pools: Dict[Tuple, PagedKVCachePool] = {}
         self._params_cache: Dict[Tuple, Any] = {}
         self._seq_counter = 0
+        # per-owner (model[@vN]) resource attribution: prompt tokens
+        # actually computed at prefill, tokens decoded, milliseconds
+        # spent queued before admission — the host half of the capacity
+        # bill (the KV byte-seconds half lives in each pool)
+        self._attr: Dict[str, Dict[str, float]] = {}
+        self._attr_metrics: Dict[str, Tuple] = {}
         self._accepted = 0
         self._resolved = 0
         self._admitted_rows = 0
@@ -558,6 +583,7 @@ class ContinuousDecodeScheduler:
             if agg["blocks_total"] else 0.0)
         out["pool"] = agg
         out["pools"] = pools
+        out["attribution"] = self.attribution()
         if self.prefix_cache:
             cs = [c.stats() for c in caches]
             hits = sum(c["hits"] for c in cs)
@@ -912,13 +938,14 @@ class ContinuousDecodeScheduler:
         (everything claimed was released — blocks return as running
         rows retire)."""
         pool = lane.pool
+        owner = _owner_key(lane.key)
         t_full = len(seq.fed)
         need_total = pool.blocks_for(t_full)
         if seq.req.kv_state is not None and seq.n_gen == 0:
             # disaggregated handoff: the prompt's KV arrives shipped —
             # claim the blocks, no prefill forward, no cache probe (a
             # preempted handoff row falls back to a plain re-prefill)
-            got = pool.alloc(need_total)
+            got = pool.alloc(need_total, owner=owner)
             if got is None:
                 return None
             t_pad = lane.gen.prompt_bucket(t_full, max(1, seq.remaining))
@@ -929,7 +956,7 @@ class ContinuousDecodeScheduler:
         if cache is not None:
             m, shared, partial = cache.match(lane.key, seq.fed)
         if m <= 0:
-            got = pool.alloc(need_total)
+            got = pool.alloc(need_total, owner=owner)
             if got is None:
                 return None
             t_pad = lane.gen.prompt_bucket(t_full, max(1, seq.remaining))
@@ -937,7 +964,7 @@ class ContinuousDecodeScheduler:
         t_tail = t_full - m
         have = len(shared) + (1 if partial is not None else 0)
         fresh_need = (need_total - have) + (1 if partial is not None else 0)
-        got = pool.alloc(fresh_need)
+        got = pool.alloc(fresh_need, owner=owner)
         if got is None:
             pool.free_blocks(shared
                              + ([partial] if partial is not None else []))
@@ -957,9 +984,10 @@ class ContinuousDecodeScheduler:
                           ("tail", t_tail_pad, tier))
 
     def _rollback_plan(self, lane: _Lane, plan: "_AdmitPlan") -> None:
-        lane.pool.free_blocks(plan.blocks)
+        owner = _owner_key(lane.key)
+        lane.pool.free_blocks(plan.blocks, owner=owner)
         if plan.cow_src is not None:
-            lane.pool.free_blocks([plan.cow_src])
+            lane.pool.free_blocks([plan.cow_src], owner=owner)
             plan.cow_src = None
         plan.seq.blocks = []
 
@@ -1080,7 +1108,7 @@ class ContinuousDecodeScheduler:
             [(seq, {"bucket": t_pad, "rows": n, "computed": len(seq.fed)})
              for seq, _ in entries], t0p, t1p, "dense")
         for i, (seq, blocks) in enumerate(entries):
-            self._note_prefilled(seq, len(seq.fed))
+            self._note_prefilled(seq, len(seq.fed), t0p)
             cache = self._cache_of(lane)
             if cache is not None:
                 cache.note_admitted(0)
@@ -1166,7 +1194,7 @@ class ContinuousDecodeScheduler:
                       "cached": p.start}) for p in entries],
             t0p, t1p, "tail")
         for i, p in enumerate(entries):
-            self._note_prefilled(p.seq, len(p.seq.fed) - p.start)
+            self._note_prefilled(p.seq, len(p.seq.fed) - p.start, t0p)
             if cache is not None:
                 cache.note_admitted(p.start)
             self._install(lane, p.seq, p.blocks, int(toks[i]))
@@ -1240,7 +1268,7 @@ class ContinuousDecodeScheduler:
             [(p.seq, {"bucket": t_blk, "rows": n, "computed": 0})
              for p in entries], t0p, t1p, "shipped")
         for i, p in enumerate(entries):
-            self._note_prefilled(p.seq, 0)
+            self._note_prefilled(p.seq, 0, t0p)
             p.seq.req.kv_state = None  # one-shot: a preempt re-prefills
             self.events.append(
                 f"kv_handoff seq={p.seq.seq_id} t={len(p.seq.fed)} "
@@ -1271,13 +1299,70 @@ class ContinuousDecodeScheduler:
             e = e.__cause__
             seen += 1
 
-    def _note_prefilled(self, seq: _Seq, computed: int) -> None:
+    def _note_prefilled(self, seq: _Seq, computed: int,
+                        t0p: Optional[float] = None) -> None:
         """Account the prompt tokens this admission actually COMPUTED
         (the tail; cache hits skip the matched prefix) — what the
-        prefill-FLOP-reduction and warm-migration benches read."""
+        prefill-FLOP-reduction and warm-migration benches read — and
+        bill the owner: computed prefill tokens plus the queue time
+        from enqueue (or the last preemption's requeue) to the
+        admission dispatch."""
         self._prefill_computed_tokens += int(computed)
         if seq.req.prefix is not None:
             self._resume_reprefill_tokens += int(computed)
+        q_ms = 0.0
+        if t0p is not None:
+            q_ms = max(0.0, (t0p - seq.t_queued) * 1e3)
+        self._attr_note(_owner_key(self._lane_key(seq)),
+                        prefill=int(computed), queue_ms=q_ms)
+
+    def _attr_note(self, owner: str, prefill: int = 0, decode: int = 0,
+                   queue_ms: float = 0.0) -> None:
+        """Tick one owner's attribution accumulators (and the mirrored
+        ``dl4j_attr_*`` counter families, label ``model=owner`` —
+        metric objects cached per owner so the hot paths pay a dict
+        lookup, not a family registration)."""
+        with self._lock:
+            a = self._attr.get(owner)
+            if a is None:
+                a = self._attr[owner] = {
+                    "prefill_tokens": 0, "decode_tokens": 0,
+                    "queue_ms": 0.0}
+            a["prefill_tokens"] += prefill
+            a["decode_tokens"] += decode
+            a["queue_ms"] += queue_ms
+        m = self._attr_metrics.get(owner)
+        if m is None:
+            reg = get_registry()
+            m = self._attr_metrics[owner] = (
+                reg.counter(ATTR_PREFILL_TOKENS_COUNTER,
+                            "Prompt tokens actually computed at prefill, "
+                            "attributed per model[@version]", model=owner),
+                reg.counter(ATTR_DECODE_TOKENS_COUNTER,
+                            "Tokens decoded, attributed per "
+                            "model[@version]", model=owner),
+                reg.counter(ATTR_QUEUE_MS_COUNTER,
+                            "Milliseconds sequences spent queued before "
+                            "admission, attributed per model[@version]",
+                            model=owner))
+        if prefill:
+            m[0].inc(prefill)
+        if decode:
+            m[1].inc(decode)
+        if queue_ms > 0:
+            m[2].inc(queue_ms)
+
+    def attribution(self) -> Dict[str, Any]:
+        """The scheduler's capacity bill: per-owner prefill/decode
+        token counts and queue milliseconds, plus each pool's KV
+        byte-second attribution (conservation law inside) — what
+        ``stats()["attribution"]`` and the ``/healthz`` top-K
+        consumers view read."""
+        with self._lock:
+            models = {k: dict(v) for k, v in self._attr.items()}
+            pools = [p for _, p in sorted(self._pools.items())]
+        return {"models": models,
+                "kv_pools": [p.attribution() for p in pools]}
 
     # ------------------------------------------------- request tracing
 
@@ -1402,7 +1487,7 @@ class ContinuousDecodeScheduler:
             # the prefill's first token already finished the row:
             # retire without ever occupying the slot
             self._cache_insert(lane, seq)
-            lane.pool.free_blocks(seq.blocks)
+            lane.pool.free_blocks(seq.blocks, owner=_owner_key(lane.key))
             seq.blocks = []
             self._retire_seq(lane, seq)
             return
@@ -1438,7 +1523,7 @@ class ContinuousDecodeScheduler:
                 delta = lane.pool.blocks_for(horizon) - len(seq.blocks)
                 if delta <= 0:
                     break
-                got = lane.pool.alloc(delta)
+                got = lane.pool.alloc(delta, owner=_owner_key(lane.key))
                 if got is not None:
                     start = len(seq.blocks)
                     seq.blocks.extend(got)
@@ -1482,7 +1567,7 @@ class ContinuousDecodeScheduler:
         # interior blocks survive as cached prefix — its resume then
         # degrades to a table clone plus a short tail prefill
         self._cache_insert(lane, seq)
-        lane.pool.free_blocks(seq.blocks)
+        lane.pool.free_blocks(seq.blocks, owner=_owner_key(lane.key))
         seq.blocks = []
         seq.fed = np.concatenate(
             [seq.req.prompt[seq.row].astype(np.int32),
@@ -1508,7 +1593,7 @@ class ContinuousDecodeScheduler:
 
     def _evict_fail(self, lane: _Lane, seq: _Seq,
                     err: BaseException) -> None:
-        lane.pool.free_blocks(seq.blocks)
+        lane.pool.free_blocks(seq.blocks, owner=_owner_key(lane.key))
         seq.blocks = []
         if seq.slot is not None:
             lane.clear_slot(seq.slot)
@@ -1651,6 +1736,7 @@ class ContinuousDecodeScheduler:
                 seq.generated.extend(int(t) for t in ys[slot, :emitted])
                 seq.n_gen = int(n_gen[slot])
                 seq.pos = int(pos[slot])
+                self._attr_note(_owner_key(lane.key), decode=emitted)
                 self._note_first_token(seq.req)
                 self._emit_tokens(seq)
             lane.tok[slot] = tok[slot]
@@ -1658,7 +1744,8 @@ class ContinuousDecodeScheduler:
             lane.n_gen[slot] = n_gen[slot]
             if bool(done[slot]):
                 self._cache_insert(lane, seq)
-                lane.pool.free_blocks(seq.blocks)
+                lane.pool.free_blocks(seq.blocks,
+                                      owner=_owner_key(lane.key))
                 seq.blocks = []
                 lane.clear_slot(slot)
                 seq.slot = None
@@ -1678,7 +1765,7 @@ class ContinuousDecodeScheduler:
             seq = lane.seqs[slot]
             if seq is None:
                 continue
-            lane.pool.free_blocks(seq.blocks)
+            lane.pool.free_blocks(seq.blocks, owner=_owner_key(lane.key))
             seq.blocks = []
             lane.clear_slot(slot)
             seq.slot = None
@@ -1755,7 +1842,8 @@ class ContinuousDecodeScheduler:
             for slot in range(lane.slots):
                 s = lane.seqs[slot]
                 if s is not None and s.req is req and s is not seq:
-                    lane.pool.free_blocks(s.blocks)
+                    lane.pool.free_blocks(s.blocks,
+                                          owner=_owner_key(lane.key))
                     s.blocks = []
                     lane.clear_slot(slot)
                     s.slot = None
@@ -1783,7 +1871,8 @@ class ContinuousDecodeScheduler:
                 seq = lane.seqs[slot]
                 if seq is None:
                     continue
-                lane.pool.free_blocks(seq.blocks)
+                lane.pool.free_blocks(seq.blocks,
+                                      owner=_owner_key(lane.key))
                 seq.blocks = []
                 lane.clear_slot(slot)
                 seq.slot = None
@@ -1829,8 +1918,24 @@ class ContinuousDecodeScheduler:
         with self._lock:
             active = sum(len(lane.active()) for lane in self._lanes.values())
             queued = len(self._queue)
+            pools = list(self._pools.values())
+            caches = list(self._caches.values())
         reg.gauge(SCHED_ACTIVE_GAUGE,
                   "Decode sequences currently occupying batch slots"
                   ).set(active)
         reg.gauge(SCHED_QUEUED_GAUGE,
                   "Decode sequences queued awaiting admission").set(queued)
+        if not timeseries_enabled():
+            return
+        # burst-boundary samples into the windowed time-series layer:
+        # host ints/floats already in hand — zero device syncs
+        ts_record(TS_SCHED_ACTIVE, active)
+        ts_record(TS_SCHED_QUEUED, queued)
+        for pool in pools:
+            ts_record(TS_SCHED_POOL_OCCUPANCY, pool.occupancy())
+        hits = misses = 0
+        for c in caches:
+            hits += c._hits
+            misses += c._misses
+        if hits + misses:
+            ts_record(TS_SCHED_PREFIX_HIT_RATE, hits / (hits + misses))

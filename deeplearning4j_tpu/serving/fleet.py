@@ -34,7 +34,7 @@ import sys
 import tempfile
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from deeplearning4j_tpu.serving.endpoint import RemoteEndpoint
 from deeplearning4j_tpu.serving.policy import ScaleDecision, ScalePolicy
@@ -184,6 +184,26 @@ class LocalFleet:
     def endpoint(self, name: str) -> RemoteEndpoint:
         with self._lock:
             return self._members[name].endpoint
+
+    def timeseries_summary(self) -> Dict[str, Any]:
+        """Fleet-wide window answer from the heartbeat-carried
+        per-endpoint summaries (engine batch fill ratio, jit-miss
+        rate, worker served delta): counts and rates add across
+        members, means combine count-weighted, p99 takes the max —
+        the same merge :meth:`InferenceRouter.fleet_snapshot`
+        reports, available without a router."""
+        from deeplearning4j_tpu.monitor import merge_summaries
+        with self._lock:
+            members = list(self._members.values())
+        summaries = []
+        for m in members:
+            try:
+                ts = (m.endpoint.stats() or {}).get("timeseries")
+            except Exception:
+                continue  # a dead member answers no window queries
+            if isinstance(ts, dict):
+                summaries.append(ts)
+        return merge_summaries(summaries)
 
     def wait_ready(self, timeout: float = 10.0) -> bool:
         """Block until every member heartbeats alive (bounded)."""

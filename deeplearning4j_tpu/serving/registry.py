@@ -890,6 +890,34 @@ class ModelRegistry:
 
     # ------------------------------------------------------------ state
 
+    def attribution(self) -> Dict[str, Any]:
+        """Per-model resource attribution aggregated across every
+        attached engine's decode scheduler: the token/queue-time
+        accumulators merge per owner lane (``model[@vN]`` — a canary
+        version meters under its own key, so a cutover's cost split is
+        visible), and the per-pool KV byte-second meters concatenate
+        (each pool is its own conservation domain — merging them would
+        hide a meter that stopped adding up)."""
+        with self._lock:
+            engines = list(self._engines)
+        models: Dict[str, Dict[str, float]] = {}
+        pools: List[Dict[str, Any]] = []
+        for eng in engines:
+            sched = getattr(eng, "_scheduler", None)
+            attr_fn = getattr(sched, "attribution", None)
+            if attr_fn is None:
+                continue
+            attr = attr_fn()
+            for owner, d in (attr.get("models") or {}).items():
+                o = models.setdefault(
+                    owner, {"prefill_tokens": 0, "decode_tokens": 0,
+                            "queue_ms": 0.0})
+                o["prefill_tokens"] += int(d.get("prefill_tokens", 0))
+                o["decode_tokens"] += int(d.get("decode_tokens", 0))
+                o["queue_ms"] += float(d.get("queue_ms", 0.0))
+            pools.extend(attr.get("kv_pools") or [])
+        return {"models": models, "kv_pools": pools}
+
     def stats(self) -> Dict[str, Any]:
         """Per-model snapshot: what ``engine.stats()["models"]`` and
         ``/healthz`` serve."""

@@ -88,11 +88,17 @@ from deeplearning4j_tpu.monitor import (
     ROUTER_SHED_COUNTER,
     SESSION_JOURNAL_BYTES_GAUGE,
     SESSION_MIGRATIONS_COUNTER,
+    TS_ROUTER_ADMIT_ERROR,
+    TS_ROUTER_QUEUE_DEPTH,
+    TS_ROUTER_SHED,
+    TS_SLO_BURN,
     get_registry,
     mark,
+    merge_summaries,
     phase_breakdown,
     record_fault,
     reqtrace,
+    ts_record,
 )
 from deeplearning4j_tpu.monitor.tracing import to_origin_us
 from deeplearning4j_tpu.serving import wire
@@ -175,7 +181,7 @@ class _Routed:
                  "on_tokens", "received", "epoch", "dups", "gaps", "late",
                  "journal_dropped", "migrations", "prefix_key", "kv_state",
                  "troot", "tctx", "deadline_ms", "t_first_chunk",
-                 "t_last_activity")
+                 "t_last_activity", "est_wait_ms")
 
     def __init__(self, kind: str, x, gen, deadline: Optional[float],
                  priority: str, session: Optional[str],
@@ -221,6 +227,9 @@ class _Routed:
         self.deadline_ms: Optional[float] = None  # set by _route
         self.t_first_chunk: Optional[float] = None
         self.t_last_activity: Optional[float] = None
+        # admission estimate (queue-wait half): graded against observed
+        # TTFT at finish — the estimator's report card series
+        self.est_wait_ms: Optional[float] = None
 
 
 class InferenceRouter:
@@ -596,6 +605,12 @@ class InferenceRouter:
         self._reg().histogram(
             ROUTER_QUEUE_WAIT_HISTOGRAM,
             "Estimated queue wait at admission time").observe(wait_ms)
+        # backlog the admission decision saw on the picked endpoint
+        # (reported queue depth + router-dispatched inflight) — the
+        # pressure-over-time series behind window queries
+        ts_record(TS_ROUTER_QUEUE_DEPTH,
+                  float((pick.endpoint.stats() or {}).get("queue_depth", 0)
+                        or 0) + pick.inflight)
         if deadline_ms is not None:
             headroom = PRIORITY_HEADROOM.get(priority, 1.0)
             if total_ms > deadline_ms * headroom:
@@ -650,6 +665,7 @@ class InferenceRouter:
             ROUTER_SHED_COUNTER,
             "Requests rejected by deadline admission control",
             **labels).inc()
+        ts_record(TS_ROUTER_SHED, 1.0)
         mark("router_shed", priority=priority, reason=reason)
 
     # ------------------------------------------------------------ submit
@@ -780,6 +796,7 @@ class InferenceRouter:
         rf.prefix_key = prefix_key
         rf.troot, rf.tctx = troot, tctx
         rf.deadline_ms = deadline_ms
+        rf.est_wait_ms = est_wait
         if tctx is not None:
             # surface the trace id to the caller (bench/debug lookup)
             rf.future.trace_id = tctx.trace_id
@@ -1136,6 +1153,12 @@ class InferenceRouter:
             "admission / failed) — missed+shed+failed burn the budget",
             model=model if model is not None else "default",
             outcome=outcome).inc()
+        if outcome != "met":
+            # burn-event series: one sample per burned request, so a
+            # window query's COUNT is "misses over the window" — the
+            # signal the flight recorder's burn trigger reads
+            ts_record(TS_SLO_BURN, 1.0)
+            reqtrace.note_slo_burn(outcome, model=model)
 
     def _finish_request(self, rf: _Routed, now: float,
                         err: Optional[BaseException] = None) -> None:
@@ -1150,6 +1173,11 @@ class InferenceRouter:
             tokens = len(rf.received)
         ttft_ms = ((t_first - rf.t0) * 1e3 if t_first is not None
                    else total_ms)
+        if err is None and rf.est_wait_ms is not None:
+            # admission-estimate report card: how far off the queue-wait
+            # estimate was from the wait the caller actually observed
+            # (signed — positive means the estimator was optimistic)
+            ts_record(TS_ROUTER_ADMIT_ERROR, ttft_ms - rf.est_wait_ms)
         reg = self._reg()
         model = rf.model if rf.model is not None else "default"
         reg.histogram(
@@ -1201,6 +1229,7 @@ class InferenceRouter:
             items = list(self._eps.items())
         healthy = 0
         queue_depth = 0.0
+        ts_summaries: List[Dict[str, Any]] = []
         for name, st in items:
             if self.wedge_timeout is not None:
                 # the watchdog also runs on observation, so a wedged
@@ -1226,6 +1255,12 @@ class InferenceRouter:
                     "cached_bytes": pc.get("cached_bytes", 0),
                     "hit_rate": pc.get("hit_rate", 0.0),
                 }
+            # windowed telemetry summary riding the stats snapshot
+            # (heartbeat-carried for remote workers) — collected here so
+            # the fleet view below can answer window queries fleet-wide
+            ts = stats.get("timeseries")
+            if isinstance(ts, dict) and ts:
+                ts_summaries.append(ts)
             sl = stats.get("slice")
             if isinstance(sl, dict) and sl.get("degraded"):
                 # positively-declared slice death: out of the pool even
@@ -1297,6 +1332,10 @@ class InferenceRouter:
             "p99_ms": (None if lat is None or lat.count == 0
                        else round(lat.percentile(0.99), 3)),
             "slo": slo,
+            # fleet-wide windowed view: per-endpoint summaries merged
+            # (counts/rates add, means count-weight, p99 = max — an
+            # honest upper bound without shipping raw samples)
+            "timeseries": merge_summaries(ts_summaries),
             "shed": int(reg.family_total(ROUTER_SHED_COUNTER)),
             "hedges": int(reg.family_total(ROUTER_HEDGES_COUNTER)),
             "failovers": int(reg.family_total(ROUTER_FAILOVERS_COUNTER)),

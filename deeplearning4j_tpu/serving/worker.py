@@ -31,7 +31,8 @@ from typing import Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.monitor import reqtrace
+from deeplearning4j_tpu.monitor import (TS_WORKER_SERVED, reqtrace,
+                                        timeseries_enabled)
 from deeplearning4j_tpu.monitor.tracing import now_us
 from deeplearning4j_tpu.serving import wire
 from deeplearning4j_tpu.streaming.broker import MessageBroker
@@ -69,6 +70,7 @@ class EngineWorker:
         self._killed = threading.Event()    # abrupt: no replies either
         self._wedged = threading.Event()    # faultinject: alive, no work
         self._served = 0
+        self._hb_served_prev = 0  # served count at the previous beat
         self._wedge_dropped = 0
         self._threads = []
         if start:
@@ -228,8 +230,18 @@ class EngineWorker:
     def _beat(self, topic):
         self._seq += 1
         try:
+            served = self._served
+            if timeseries_enabled():
+                # per-beat served delta into the ENGINE's private
+                # store, so the summary riding this very heartbeat
+                # carries the worker's throughput series too
+                delta = served - self._hb_served_prev
+                ts = getattr(self.engine, "timeseries", None)
+                if ts is not None and delta >= 0:
+                    ts.record(TS_WORKER_SERVED, float(delta))
+            self._hb_served_prev = served
             stats = dict(self.engine.stats())
-            stats["served"] = self._served
+            stats["served"] = served
             self._hb_broker.publish(topic, wire.pack_heartbeat(
                 self.name, self._seq, self._state, stats))
         except BaseException as e:

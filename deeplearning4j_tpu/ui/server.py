@@ -24,6 +24,8 @@ Routes:
   GET  /healthz/live                      liveness — process up, always 200
   GET  /healthz/ready                     readiness — warmed + not degraded,
                                           503 otherwise (k8s probe split)
+  GET  /timeseries[?name=s&window=secs]   windowed telemetry (JSON): full
+                                          snapshot, or one series × window
   GET  /train/<session>[?worker=w]        dashboard (HTML, report.py)
   GET  /api/sessions                      ["s1", ...]
   GET  /api/sessions/<s>/workers          ["w0", ...]
@@ -52,6 +54,36 @@ from deeplearning4j_tpu.monitor.step_health import NAN_COUNTER, SLOW_COUNTER
 from deeplearning4j_tpu.ui.report import render_html
 from deeplearning4j_tpu.ui.stats import StatsReport
 from deeplearning4j_tpu.ui.storage import StatsStorage
+
+
+def _top_consumers(attr, k: int = 5):
+    """Rank owners (model[@vN] lanes + the untagged bucket) by KV
+    byte-seconds, then by total tokens — the ``/healthz`` answer to
+    "who is eating this serving plane". ``attr`` is a scheduler
+    ``attribution()`` block: per-model token/queue accumulators plus
+    per-pool owner-tagged byte-second meters."""
+    owners = {}
+    for owner, d in (attr.get("models") or {}).items():
+        o = owners.setdefault(owner, {"owner": owner, "kv_byte_seconds": 0.0,
+                                      "prefill_tokens": 0, "decode_tokens": 0,
+                                      "queue_ms": 0.0})
+        o["prefill_tokens"] = int(d.get("prefill_tokens", 0))
+        o["decode_tokens"] = int(d.get("decode_tokens", 0))
+        o["queue_ms"] = round(float(d.get("queue_ms", 0.0)), 3)
+    for pool in attr.get("kv_pools") or []:
+        for owner, bs in (pool.get("byte_seconds") or {}).items():
+            o = owners.setdefault(
+                owner, {"owner": owner, "kv_byte_seconds": 0.0,
+                        "prefill_tokens": 0, "decode_tokens": 0,
+                        "queue_ms": 0.0})
+            o["kv_byte_seconds"] = round(
+                o["kv_byte_seconds"] + float(bs), 3)
+    ranked = sorted(
+        owners.values(),
+        key=lambda o: (-o["kv_byte_seconds"],
+                       -(o["prefill_tokens"] + o["decode_tokens"]),
+                       o["owner"]))
+    return ranked[:max(1, int(k))]
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -97,6 +129,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._healthz_ready()
             if parts == ["debug", "traces"]:
                 return self._debug_traces()
+            if parts == ["timeseries"]:
+                return self._timeseries(query)
             if parts[0] == "train" and len(parts) == 2:
                 return self._html(render_html(self.storage, parts[1], worker))
             if parts[0] == "api":
@@ -209,6 +243,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # sequence would eat the prefill/burst XLA compiles
                 body["scheduler_ready"] = bool(sched.get("warmed"))
                 unwarmed = unwarmed or not sched.get("warmed", True)
+                attr = sched.get("attribution")
+                if isinstance(attr, dict):
+                    # capacity attribution: who is eating the serving
+                    # plane, ranked — KV byte-seconds first (the scarce
+                    # resource), then tokens
+                    body["top_consumers"] = _top_consumers(attr)
         router = getattr(self.server, "_router", None)
         if router is not None:
             # fleet aggregation: every endpoint's health/stats as the
@@ -226,6 +266,37 @@ class _Handler(BaseHTTPRequestHandler):
         body["live"] = True
         body["ready"] = not degraded and not unwarmed
         return body, degraded, unwarmed
+
+    def _timeseries(self, query):
+        """Windowed telemetry as JSON (the capacity observatory's read
+        path): ``?name=&window=`` answers one series × one window;
+        without ``name`` the full snapshot of every series × the
+        requested (or default) windows. Series live in two stores: the
+        process-global registry store (scheduler/router samples) and
+        the attached engine's private store (fill ratio, jit-miss,
+        worker served) — both are searched/served."""
+        store = self.registry.timeseries
+        engine = getattr(self.server, "_infer_engine", None)
+        estore = getattr(engine, "timeseries", None)
+        name = query.get("name", [None])[0]
+        try:
+            windows = [float(w) for w in query.get("window", [])]
+        except ValueError:
+            return self._json({"error": "?window= must be a number"}, 400)
+        if name is not None:
+            window = windows[0] if windows else 60.0
+            q = store.query(name, window)
+            if q is None and estore is not None:
+                q = estore.query(name, window)
+            if q is None:
+                return self._json(
+                    {"error": f"no series named {name!r}"}, 404)
+            return self._json({"name": name, **q})
+        kw = {"windows": tuple(windows)} if windows else {}
+        body = {"process": store.snapshot(**kw)}
+        if estore is not None:
+            body["engine"] = estore.snapshot(**kw)
+        return self._json(body)
 
     def _debug_traces(self):
         """The flight recorder's rings as JSONL (one record per line:

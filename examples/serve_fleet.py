@@ -99,6 +99,17 @@ def main(argv=None):
                       target_queue_per_endpoint=4.0, cooldown_s=0.0)
     print("autoscale:", fleet.autoscale(pol) or "steady")
 
+    # capacity observatory: a fleet-wide window query — per-endpoint
+    # summaries ride the heartbeats (engine fill ratio, jit-miss rate,
+    # worker served delta) and merge here; the same view serves at
+    # GET {server.url}/timeseries
+    ts = fleet.timeseries_summary()
+    print(f"fleet window ({ts.get('window_s') or 60.0:.0f}s):")
+    for name, agg in sorted((ts.get("series") or {}).items()):
+        print(f"  {name}: count={agg['count']} "
+              f"rate={agg['rate']:.2f}/s mean={agg['mean']} "
+              f"p99={agg['p99']}")
+
     if args.serve_seconds > 0:
         print(f"serving /healthz for {args.serve_seconds}s …")
         time.sleep(args.serve_seconds)

@@ -513,6 +513,30 @@ KNOWN_DL4J_METRICS = {
     "dl4j_fault_quarantined_replicas",
     "dl4j_fault_dead_letter_total",
     "dl4j_fault_checkpoint_integrity_failures_total",
+    # capacity observatory — windowed time-series (monitor/timeseries.py
+    # TimeSeriesStore behind the registry; the dl4j_ts_* names are
+    # SERIES keys answered by query(name, window), carried in stats()
+    # payloads and served at UiServer /timeseries rather than exposed
+    # as Prometheus families — pinned here all the same, one name one
+    # meaning):
+    "dl4j_ts_sched_active_rows",
+    "dl4j_ts_sched_queued_prefills",
+    "dl4j_ts_sched_pool_occupancy",
+    "dl4j_ts_sched_prefix_hit_rate",
+    "dl4j_ts_router_queue_depth",
+    "dl4j_ts_router_admit_error_ms",
+    "dl4j_ts_router_shed",
+    "dl4j_ts_engine_fill_ratio",
+    "dl4j_ts_engine_jit_miss",
+    "dl4j_ts_slo_burn",
+    "dl4j_ts_worker_served",
+    # capacity observatory — per-owner resource attribution
+    # (nn/kvpool.py byte-seconds + serving/continuous.py token/queue
+    # accounting, label model=/owner=):
+    "dl4j_attr_kv_byte_seconds",
+    "dl4j_attr_prefill_tokens_total",
+    "dl4j_attr_decode_tokens_total",
+    "dl4j_attr_queue_ms_total",
 }
 
 
@@ -540,7 +564,11 @@ _METRIC_RE = re.compile(
     r"(?P<labels>\{[^}]*\})?"
     r" (?P<value>-?[0-9.eE+]+|NaN|\+Inf|-Inf)"
     r"( -?[0-9]+)?$")
-_LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+# label values may escape ONLY backslash, double-quote and newline
+# (text-format spec 0.0.4) — any other backslash escape is malformed
+_LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\["\\n])*"$')
+# HELP text may escape ONLY backslash and newline (quotes stay literal)
+_HELP_TEXT_RE = re.compile(r"^(?:[^\\]|\\\\|\\n)*$")
 
 
 def _base_family(name: str, families: Dict[str, str]) -> str:
@@ -558,6 +586,7 @@ def validate_prometheus_text(text: str,
                              where: str = "metrics") -> List[str]:
     errors: List[str] = []
     families: Dict[str, str] = {}  # name -> kind
+    helps: Dict[str, int] = {}     # name -> HELP line number
     samples: Dict[str, List[Dict[str, str]]] = {}
     for i, line in enumerate(text.splitlines(), 1):
         w = f"{where}:{i}"
@@ -573,8 +602,23 @@ def validate_prometheus_text(text: str,
                 errors.append(f"{w}: duplicate TYPE for {parts[2]}")
             families[parts[2]] = parts[3]
             continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)  # "#", "HELP", name, text
+            if len(parts) < 3:
+                errors.append(f"{w}: malformed HELP line")
+                continue
+            hname = parts[2]
+            if hname in helps:
+                errors.append(f"{w}: duplicate HELP for {hname}")
+            helps[hname] = i
+            htext = parts[3] if len(parts) == 4 else ""
+            if not _HELP_TEXT_RE.match(htext):
+                errors.append(
+                    f"{w}: HELP text for {hname} has an invalid escape "
+                    "(only \\\\ and \\n are allowed)")
+            continue
         if line.startswith("#"):
-            continue  # HELP/comments
+            continue  # other comments
         m = _METRIC_RE.match(line)
         if m is None:
             errors.append(f"{w}: unparseable sample: {line!r}")
@@ -595,6 +639,11 @@ def validate_prometheus_text(text: str,
             continue
         samples.setdefault(fam, []).append(
             {"name": name, "labels": labels, "value": m.group("value")})
+    # every HELP line must name a family that a TYPE line declares
+    for hname, hline in helps.items():
+        if hname not in families:
+            errors.append(f"{where}:{hline}: HELP for {hname} has no "
+                          f"matching # TYPE declaration")
     # histogram families must ship the full bucket/sum/count triple with a
     # +Inf bucket whose count equals _count
     for fam, kind in families.items():

@@ -1,0 +1,254 @@
+"""Per-model resource attribution tests (ISSUE 16).
+
+The capacity observatory's billing half: owner-tagged KV block
+byte-seconds in ``PagedKVCachePool`` obey the conservation law (the
+per-owner sums equal the pool's independently integrated total —
+EXACTLY, under an integer logical clock) through seeded
+alloc/share/free interleavings including copy-on-write-style sharing;
+a shared block bills every holder; untagged references land in the
+visible ``_untagged`` bucket; mismatched-owner releases fall back
+without breaking refcounts. Above the pool, the scheduler's
+``attribution()`` block meters prefill/decode tokens and queue time
+per ``model[@vN]`` lane — a canary and its stable version bill
+SEPARATELY through a cutover — and ``ModelRegistry.attribution()``
+aggregates it across engines, with the ``/healthz`` top-K consumers
+ranking riding on top.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.models.zoo.transformer import gpt
+from deeplearning4j_tpu.nn.kvpool import UNTAGGED_OWNER, PagedKVCachePool
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.serving.continuous import (ContinuousDecodeScheduler,
+                                                   _owner_key)
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.ui.server import _top_consumers
+
+VOCAB = 11
+
+
+def _tiny_gpt(seed=0, **kw):
+    return gpt(vocab_size=VOCAB, d_model=16, n_layers=2, num_heads=2,
+               max_len=32, compute_dtype="float32", learning_rate=0.01,
+               seed=seed, **kw).init()
+
+
+@pytest.fixture
+def fresh_registry():
+    prev = monitor.set_registry(monitor.MetricsRegistry())
+    yield monitor.get_registry()
+    monitor.set_registry(prev)
+
+
+class LogicalClock:
+    def __init__(self, t=0):
+        self.t = t
+
+    def tick(self, dt=1):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+def _pool(clock, num_blocks=16):
+    return PagedKVCachePool(num_blocks, 4, num_layers=2, num_heads=2,
+                            head_dim=8, clock=clock)
+
+
+def _conserved(pool):
+    """The conservation law, exact under the integer logical clock."""
+    attr = pool.attribution()
+    assert sum(attr["byte_seconds"].values()) == attr["total_byte_seconds"]
+    return attr
+
+
+# ------------------------------------------------- conservation law
+
+def test_byte_seconds_conservation_seeded_interleaving(fresh_registry):
+    """Random owner-tagged alloc/share/free interleavings (the COW and
+    preempt shapes included): per-owner byte-seconds sum EXACTLY to
+    the pool's independently integrated total at every step, and the
+    meters survive a full drain."""
+    clock = LogicalClock()
+    pool = _pool(clock, num_blocks=16)
+    rng = np.random.default_rng(7)
+    owners = ["lm@v1", "lm@v2", "embed", None]  # None -> _untagged
+    # one entry per REFERENCE an owner holds: (owner_tag, block_id)
+    refs = {o: [] for o in owners}
+    for _ in range(300):
+        clock.tick(int(rng.integers(0, 4)))
+        o = owners[rng.integers(0, len(owners))]
+        op = rng.integers(0, 3)
+        if op == 0:  # alloc 1-3 blocks under this owner
+            got = pool.alloc(int(rng.integers(1, 4)), owner=o)
+            if got is not None:
+                refs[o].extend(got)
+        elif op == 1:  # share someone's live block (prefix-cache shape)
+            donors = [d for d in owners if refs[d]]
+            if donors:
+                d = donors[rng.integers(0, len(donors))]
+                b = refs[d][rng.integers(0, len(refs[d]))]
+                pool.share_blocks([b], owner=o)
+                refs[o].append(b)
+        else:  # free a random subset of this owner's references
+            if refs[o]:
+                k = int(rng.integers(1, len(refs[o]) + 1))
+                idx = rng.choice(len(refs[o]), size=k, replace=False)
+                drop = [refs[o][i] for i in idx]
+                pool.free_blocks(drop, owner=o)
+                refs[o] = [b for i, b in enumerate(refs[o])
+                           if i not in set(idx.tolist())]
+        attr = _conserved(pool)
+        held = {(t if t is not None else UNTAGGED_OWNER): len(r)
+                for t, r in refs.items() if r}
+        assert attr["held_refs"] == held
+    # drain: every reference released, blocks all return, the integral
+    # stops growing but never resets
+    clock.tick(5)
+    for o in owners:
+        if refs[o]:
+            pool.free_blocks(refs[o], owner=o)
+            refs[o] = []
+    assert pool.free_count == pool.total_blocks
+    attr = _conserved(pool)
+    assert attr["held_refs"] == {}
+    total = attr["total_byte_seconds"]
+    clock.tick(100)  # nobody holds anything: no further billing
+    assert _conserved(pool)["total_byte_seconds"] == total
+
+
+def test_shared_block_bills_every_holder(fresh_registry):
+    clock = LogicalClock()
+    pool = _pool(clock)
+    bb = pool.block_bytes()
+    a = pool.alloc(2, owner="stable")
+    clock.tick(10)
+    pool.share_blocks(a, owner="canary")  # COW share: +1 ref per block
+    clock.tick(5)
+    attr = _conserved(pool)
+    # stable held 2 refs for 15 s, canary 2 refs for 5 s — a shared
+    # block is capacity BOTH are consuming
+    assert attr["byte_seconds"]["stable"] == 15 * 2 * bb
+    assert attr["byte_seconds"]["canary"] == 5 * 2 * bb
+    assert attr["total_byte_seconds"] == (15 * 2 + 5 * 2) * bb
+    pool.free_blocks(a, owner="stable")
+    pool.free_blocks(a, owner="canary")
+    assert pool.free_count == pool.total_blocks
+
+
+def test_untagged_and_mismatched_owner_fallback(fresh_registry):
+    """Untagged references bill the visible ``_untagged`` bucket, and
+    a release naming an owner the block never carried still releases
+    (billing is best-effort, refcounts are the law)."""
+    clock = LogicalClock()
+    pool = _pool(clock)
+    got = pool.alloc(1)  # no owner tag
+    clock.tick(3)
+    attr = _conserved(pool)
+    assert attr["byte_seconds"] == {
+        UNTAGGED_OWNER: 3 * pool.block_bytes()}
+    pool.free_blocks(got)
+    tagged = pool.alloc(1, owner="lm")
+    clock.tick(2)
+    pool.free_blocks(tagged, owner="ghost")  # falls back to newest tag
+    assert pool.free_count == pool.total_blocks
+    attr = _conserved(pool)
+    assert attr["held_refs"] == {}
+    assert attr["byte_seconds"]["lm"] == 2 * pool.block_bytes()
+
+
+# ------------------------------------------ scheduler + canary lanes
+
+def test_owner_key_lane_naming():
+    assert _owner_key(("lm", None)) == "lm"
+    assert _owner_key(("lm", 3)) == "lm@v3"
+    assert _owner_key((None, None)) == "default"
+
+
+def test_scheduler_stats_attribution_block(rng, fresh_registry):
+    net = _tiny_gpt()
+    s = ContinuousDecodeScheduler(net=net, slots=4, burst_tokens=4,
+                                  block_size=4, start=False)
+    p = rng.integers(0, VOCAB, (1, 5))
+    f = s.submit(p, 6)
+    for _ in range(200):
+        if f.done():
+            break
+        s.step()
+    assert f.done()
+    attr = s.stats()["attribution"]
+    d = attr["models"]["default"]  # net-mode lane bills "default"
+    # prefill computes the prompt AND emits the first token; decode
+    # bills the remaining max_new - 1
+    assert d["prefill_tokens"] >= 5 and d["decode_tokens"] == 5
+    assert d["queue_ms"] >= 0.0
+    (pool_attr,) = attr["kv_pools"]
+    # wall clock here: conservation is float-rounding-close, not exact
+    assert sum(pool_attr["byte_seconds"].values()) == pytest.approx(
+        pool_attr["total_byte_seconds"], rel=1e-9, abs=1e-6)
+    assert pool_attr["held_refs"] == {}  # drained after retirement
+    assert pool_attr["byte_seconds"]["default"] > 0
+
+
+def test_attribution_exact_under_canary_cutover(rng, fresh_registry):
+    """A session pinned to v1 through a deploy keeps billing the v1
+    lane; fresh sessions bill v2 — the cutover's cost split is exact
+    per ``model@vN`` owner even though both lanes share ONE pool."""
+    net1, net2 = _tiny_gpt(seed=1), _tiny_gpt(seed=9)
+    reg = ModelRegistry()
+    reg.register("lm", net=net1)
+    eng = ParallelInference(registry=reg, replicas=1, continuous=True,
+                            decode_slots=4, decode_burst=4, kv_block_size=4)
+    try:
+        p = rng.integers(0, VOCAB, (1, 5))
+        eng.submit_generate(p, 8, model="lm", session="s1").result(30)
+        reg.deploy("lm", net=net2)  # canary cutover to v2
+        eng.submit_generate(p, 8, model="lm", session="s1").result(30)
+        eng.submit_generate(p, 8, model="lm", session="s2").result(30)
+        attr = eng.stats()["scheduler"]["attribution"]
+        v1, v2 = attr["models"]["lm@v1"], attr["models"]["lm@v2"]
+        # v1 served two 8-token generations, v2 one — exactly (the
+        # first token of each rides its prefill: 7 decodes per request)
+        assert v1["decode_tokens"] == 14 and v2["decode_tokens"] == 7
+        assert v1["prefill_tokens"] >= v2["prefill_tokens"] >= 5
+        (pool_attr,) = attr["kv_pools"]  # one SHARED pool, two lanes
+        assert {"lm@v1", "lm@v2"} <= set(pool_attr["byte_seconds"])
+        assert sum(pool_attr["byte_seconds"].values()) == pytest.approx(
+            pool_attr["total_byte_seconds"], rel=1e-9, abs=1e-6)
+        # the registry-level merge sees the same bill
+        reg_attr = reg.attribution()
+        assert reg_attr["models"] == attr["models"]
+        assert len(reg_attr["kv_pools"]) == 1
+    finally:
+        eng.shutdown()
+
+
+# --------------------------------------------- /healthz top consumers
+
+def test_top_consumers_ranking():
+    attr = {
+        "models": {
+            "lm@v1": {"prefill_tokens": 10, "decode_tokens": 40,
+                      "queue_ms": 1.5},
+            "lm@v2": {"prefill_tokens": 5, "decode_tokens": 8,
+                      "queue_ms": 0.5},
+            "idle": {"prefill_tokens": 99, "decode_tokens": 99,
+                     "queue_ms": 0.0},
+        },
+        "kv_pools": [
+            {"byte_seconds": {"lm@v1": 100.0, "lm@v2": 500.0}},
+            {"byte_seconds": {"lm@v1": 50.0, UNTAGGED_OWNER: 700.0}},
+        ],
+    }
+    ranked = _top_consumers(attr, k=3)
+    # byte-seconds rank first (summed across pools), tokens tie-break
+    assert [o["owner"] for o in ranked] == [UNTAGGED_OWNER, "lm@v2",
+                                            "lm@v1"]
+    assert ranked[2]["kv_byte_seconds"] == 150.0
+    assert ranked[2]["prefill_tokens"] == 10
+    # k truncates AFTER ranking: "idle" (no KV held) fell off
+    assert _top_consumers(attr, k=4)[-1]["owner"] == "idle"

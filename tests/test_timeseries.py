@@ -1,0 +1,317 @@
+"""Capacity-observatory time-series tests (monitor/timeseries.py).
+
+The ISSUE-16 determinism battery: aligned-bucket placement under a
+logical clock (bit-identical repeat queries), the downsample-agreement
+property (a coarse-tier query equals the direct aggregation of the
+fine buckets it covers, open bucket included), strictly-oldest-first
+ring eviction with fold-before-evict, the deterministic
+keep-the-earliest sample cap with visible ``dropped_samples``,
+nearest-rank percentiles, the bounded ``TimeSeriesStore`` (absence ->
+None, oldest-created eviction at ``max_series``), heartbeat
+``summary()`` / ``merge_summaries`` arithmetic, the
+``set_timeseries_enabled`` kill switch around ``ts_record``, the
+``UiServer /timeseries`` JSON endpoint, and the flight recorder's
+sustained-SLO-burn auto-trigger riding ``dl4j_ts_slo_burn``.
+"""
+
+import json
+import math
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import reqtrace
+from deeplearning4j_tpu.monitor.timeseries import (
+    DEFAULT_TIERS,
+    TS_SLO_BURN,
+    TimeSeries,
+    TimeSeriesStore,
+    merge_summaries,
+    set_timeseries_enabled,
+    timeseries_enabled,
+    ts_query,
+    ts_record,
+)
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, UiServer
+
+
+@pytest.fixture
+def fresh_registry():
+    prev = monitor.set_registry(monitor.MetricsRegistry())
+    yield monitor.get_registry()
+    monitor.set_registry(prev)
+
+
+class LogicalClock:
+    """Injectable deterministic clock: ``tick()`` advances, call reads."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def tick(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+# --------------------------------------------------- aligned buckets
+
+def test_aligned_bucket_placement_and_repeat_query_identity():
+    """A sample at time t lands in floor(t / width) of the finest tier,
+    and the same query against the same clock is bit-identical —
+    windows are aligned, never sliding."""
+    clock = LogicalClock()
+    store = TimeSeriesStore(clock=clock)
+    for t, v in [(0.2, 1.0), (0.7, 3.0), (1.1, 5.0), (2.9, 7.0)]:
+        clock.t = t
+        store.record("m", v)
+    view = store.series("m").tier_view(0)
+    assert [(b["index"], b["count"], b["total"]) for b in view] == [
+        (0, 2, 4.0), (1, 1, 5.0), (2, 1, 7.0)]
+    clock.t = 3.0
+    q1 = store.query("m", 10.0)
+    q2 = store.query("m", 10.0)
+    assert q1 == q2  # repeat query: bit-identical under a fixed clock
+    assert q1["count"] == 4 and q1["rate"] == 4 / 10.0
+    assert q1["mean"] == 4.0 and q1["min"] == 1.0 and q1["max"] == 7.0
+    # a window covering only the newest buckets excludes older ones:
+    # lo = floor(3.0) - 2 + 1 = 2 -> bucket 2 only
+    q = store.query("m", 2.0)
+    assert q["count"] == 1 and q["mean"] == 7.0
+
+
+def test_query_empty_window_is_nan_not_error():
+    clock = LogicalClock()
+    store = TimeSeriesStore(clock=clock)
+    store.record("m", 1.0)
+    clock.t = 500.0  # every 1s bucket long out of the 60s window
+    q = store.query("m", 60.0)
+    assert q["count"] == 0 and math.isnan(q["mean"])
+    assert math.isnan(q["min"]) and math.isnan(q["p99"])
+    with pytest.raises(ValueError):
+        store.series("m").query(0.0, clock.t)
+
+
+# ----------------------------------------------- downsample agreement
+
+def test_downsample_tier_agreement():
+    """A coarse-tier query equals the direct aggregation of the raw
+    values it covers: folds are eager on advance(), the open fine
+    bucket is folded in at read time, so nothing is double- or
+    under-counted across the tier boundary."""
+    clock = LogicalClock()
+    store = TimeSeriesStore(clock=clock)
+    values = []
+    for i in range(100):  # one sample per second over 100 s
+        clock.t = float(i)
+        v = float((i * 7) % 13)
+        store.record("m", v)
+        values.append(v)
+    clock.t = 99.5
+    # window 600 s > 1s-tier span (120 s handles it too, so force the
+    # coarse path with a long window served from the 10 s tier)
+    q = store.query("m", 600.0)
+    assert q["tier_s"] == 10.0
+    assert q["count"] == len(values)
+    assert q["mean"] == pytest.approx(sum(values) / len(values))
+    assert q["min"] == min(values) and q["max"] == max(values)
+    s = sorted(values)
+    assert q["p50"] == s[max(1, math.ceil(0.50 * len(s))) - 1]
+    assert q["p99"] == s[max(1, math.ceil(0.99 * len(s))) - 1]
+    # and the fine-tier answer over its own span agrees with the raw
+    # tail of the stream
+    qf = store.query("m", 50.0)
+    assert qf["tier_s"] == 1.0
+    tail = values[-50:]
+    assert qf["count"] == 50 and qf["mean"] == pytest.approx(
+        sum(tail) / 50)
+
+
+def test_fold_before_evict_keeps_downsampled_history():
+    """Fine buckets evicted from their ring have already folded into
+    every coarser tier — the ring never loses a bucket's downsampled
+    contribution (and eviction is strictly oldest-first)."""
+    clock = LogicalClock()
+    ts = TimeSeries("m", tiers=((1.0, 5), (10.0, 120)))
+    for i in range(10):
+        ts.record(float(i), float(i))
+    # fine ring: only the 5 newest buckets survive, oldest-first out
+    assert [b["index"] for b in ts.tier_view(0)] == [5, 6, 7, 8, 9]
+    # coarse bucket 0 carries the CLOSED fine buckets 0..8 (bucket 9
+    # is still open), including the five already evicted from the ring
+    (coarse,) = ts.tier_view(1)
+    assert coarse["index"] == 0
+    assert coarse["count"] == 9 and coarse["total"] == sum(range(9))
+    # a coarse query folds the open fine bucket back in: all 10 values
+    q = ts.query(600.0, now=9.0)
+    assert q["tier_s"] == 10.0
+    assert q["count"] == 10 and q["mean"] == pytest.approx(4.5)
+
+
+# ------------------------------------------- sample cap + percentiles
+
+def test_keep_earliest_sample_cap_counts_dropped():
+    clock = LogicalClock()
+    store = TimeSeriesStore(clock=clock, samples_per_bucket=4)
+    for v in range(1, 11):  # ten samples into one 1 s bucket
+        store.record("m", float(v))
+    q = store.query("m", 10.0)
+    assert q["count"] == 10        # aggregates never truncate
+    assert q["sampled"] == 4       # the earliest four survive
+    assert q["dropped_samples"] == 6
+    assert q["p50"] == 2.0 and q["p99"] == 4.0  # over [1, 2, 3, 4]
+    assert q["max"] == 10.0        # min/max track ALL values
+
+
+def test_nearest_rank_percentiles():
+    clock = LogicalClock()
+    store = TimeSeriesStore(clock=clock)
+    for v in range(1, 101):
+        store.record("m", float(v))
+    q = store.query("m", 10.0)
+    assert q["p50"] == 50.0 and q["p99"] == 99.0
+    store.record("single", 42.0)
+    q1 = store.query("single", 10.0)
+    assert q1["p50"] == 42.0 and q1["p99"] == 42.0
+
+
+# --------------------------------------------------------- the store
+
+def test_store_absent_series_and_bounded_eviction():
+    clock = LogicalClock()
+    store = TimeSeriesStore(clock=clock, max_series=2)
+    assert store.query("never", 60.0) is None  # absence is an answer
+    store.record("a", 1.0)
+    store.record("b", 2.0)
+    store.record("c", 3.0)  # evicts "a" — oldest-created first
+    assert store.names() == ["b", "c"]
+    assert store.query("a", 60.0) is None
+    assert store.query("c", 60.0)["count"] == 1
+
+
+def test_summary_and_merge_summaries():
+    clock = LogicalClock()
+    s1 = TimeSeriesStore(clock=clock)
+    s1.record("x", 2.0)
+    s1.record("x", 4.0)
+    s1.record("only1", 1.0)
+    s2 = TimeSeriesStore(clock=clock)
+    for _ in range(4):
+        s2.record("x", 6.0)
+    a, b = s1.summary(), s2.summary()
+    assert a["window_s"] == 60.0
+    assert a["series"]["x"] == {"count": 2, "rate": round(2 / 60.0, 6),
+                                "mean": 3.0, "p99": 4.0}
+    merged = merge_summaries([a, b, None, {"junk": 1}])  # junk skipped
+    mx = merged["series"]["x"]
+    assert mx["count"] == 6                        # counts add
+    assert mx["rate"] == pytest.approx(6 / 60.0)   # rates add
+    assert mx["mean"] == pytest.approx(5.0)        # count-weighted
+    assert mx["p99"] == 6.0                        # max: upper bound
+    assert merged["series"]["only1"]["count"] == 1
+    assert merge_summaries([]) == {"window_s": None, "series": {}}
+
+
+def test_summary_name_filter():
+    clock = LogicalClock()
+    store = TimeSeriesStore(clock=clock)
+    store.record("keep", 1.0)
+    store.record("drop", 1.0)
+    out = store.summary(names=["keep", "ghost"])
+    assert list(out["series"]) == ["keep"]
+
+
+# ------------------------------------- module hooks + the kill switch
+
+def test_ts_record_roundtrip_and_kill_switch(fresh_registry):
+    assert timeseries_enabled()
+    ts_record("dl4j_ts_sched_active_rows", 3.0)
+    q = ts_query("dl4j_ts_sched_active_rows", 60.0)
+    assert q is not None and q["count"] == 1 and q["mean"] == 3.0
+    prev = set_timeseries_enabled(False)
+    try:
+        assert prev is True and not timeseries_enabled()
+        ts_record("dl4j_ts_sched_active_rows", 9.0)  # dropped: disabled
+    finally:
+        set_timeseries_enabled(prev)
+    assert ts_query("dl4j_ts_sched_active_rows", 60.0)["count"] == 1
+    assert ts_query("dl4j_ts_never_recorded", 60.0) is None
+
+
+def test_registry_store_is_lazy_and_per_registry(fresh_registry):
+    reg2 = monitor.MetricsRegistry()
+    assert reg2._timeseries is None  # built on first touch only
+    reg2.timeseries.record("m", 1.0)
+    assert fresh_registry.timeseries.query("m", 60.0) is None
+    assert reg2.timeseries.query("m", 60.0)["count"] == 1
+
+
+# ------------------------------------------------ /timeseries endpoint
+
+def test_ui_timeseries_endpoint(fresh_registry):
+    fresh_registry.timeseries.record("dl4j_ts_router_shed", 1.0)
+    srv = UiServer(InMemoryStatsStorage(), registry=fresh_registry,
+                   port=0).start()
+    try:
+        one = json.loads(urllib.request.urlopen(
+            srv.url + "/timeseries?name=dl4j_ts_router_shed&window=60"
+        ).read())
+        assert one["name"] == "dl4j_ts_router_shed"
+        assert one["count"] == 1 and one["window_s"] == 60.0
+        snap = json.loads(urllib.request.urlopen(
+            srv.url + "/timeseries").read())
+        assert "dl4j_ts_router_shed" in snap["process"]
+        assert set(snap["process"]["dl4j_ts_router_shed"]) == {
+            "10.0", "60.0", "600.0"}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url + "/timeseries?name=ghost")
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                srv.url + "/timeseries?name=x&window=banana")
+        assert e.value.code == 400
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------- SLO-burn flight trigger
+
+def test_slo_burn_auto_trigger_threshold_and_cooldown(fresh_registry):
+    """The burn auto-trigger fires exactly when the trailing-window
+    burn count crosses the armed threshold, and the cooldown collapses
+    a sustained incident into one trigger."""
+    try:
+        rec = reqtrace.configure_flight_recorder(burn_threshold=3,
+                                                 burn_window_s=60.0,
+                                                 burn_cooldown_s=3600.0)
+        for i in range(5):
+            ts_record(TS_SLO_BURN, 1.0)
+            reqtrace.note_slo_burn("missed", model="lm")
+        triggers = [e for e in rec.records()
+                    if e.get("kind") == "trigger"]
+        assert len(triggers) == 1  # fired at 3, cooled down at 4 and 5
+        t = triggers[0]
+        assert t["attrs"]["reason"] == "slo_burn"
+        assert t["attrs"]["burned"] == 3 and t["attrs"]["threshold"] == 3
+        assert t["attrs"]["model"] == "lm"
+    finally:
+        reqtrace.configure_flight_recorder()  # disarm: threshold=None
+
+
+def test_slo_burn_trigger_disarmed_by_default(fresh_registry):
+    reqtrace.configure_flight_recorder()  # no burn_threshold
+    ts_record(TS_SLO_BURN, 1.0)
+    assert reqtrace.note_slo_burn("missed") is None
+
+
+# ---------------------------------------------------------- defaults
+
+def test_default_tiers_are_finest_first_and_bounded():
+    assert DEFAULT_TIERS == ((1.0, 120), (10.0, 120), (60.0, 120))
+    with pytest.raises(ValueError):
+        TimeSeries("bad", tiers=((10.0, 4), (1.0, 4)))
+    with pytest.raises(ValueError):
+        TimeSeries("bad", tiers=())
