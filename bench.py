@@ -976,6 +976,143 @@ def bench_continuous_decode():
     }
 
 
+def bench_speculative_decode():
+    """Speculative decoding (ISSUE 17): per-stream decode latency at
+    small batch, where the engine is latency-bound — one target
+    forward per token — and speculation is designed to win. A small
+    draft proposes K tokens on its own paged-KV lane (one scanned
+    program), the target verifies all K+1 positions in ONE forward,
+    and exact rejection sampling keeps greedy output token-for-token
+    equal to ``generate_eager``. Target and draft are both trained on
+    the same near-deterministic synthetic language — the honest
+    analogue of a production distilled draft: a draft only pays when
+    it AGREES with the target on the serving distribution, so the
+    bench earns its acceptance rate instead of staging one.
+    Acceptance: >= 2x per-stream tokens/sec at batch 1-4 vs the
+    non-speculative continuous path on the same net, NO regression at
+    saturation (the spec_max_rows fallback engages — speculation is a
+    latency tool, not a throughput tool), greedy parity vs the eager
+    oracle, zero steady-state XLA compiles across the accept ladder,
+    and zero leaked KV blocks on BOTH lanes."""
+    import jax
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.zoo.transformer import gpt
+    from deeplearning4j_tpu.nn.generate import generate_eager
+    from deeplearning4j_tpu.serving.continuous import \
+        ContinuousDecodeScheduler
+
+    # K deeper than the plain burst: with near-1.0 agreement each spec
+    # round yields K+1 tokens for ONE target verify, so the deeper K
+    # amortizes the per-round host syncs; the plain arm keeps its own
+    # tuned burst depth — the comparison is tuned-vs-tuned, not
+    # handicapped
+    vocab, max_new, k_spec, burst, slots = 32, 64, 12, 8, 8
+    target = gpt(vocab_size=vocab, d_model=128, n_layers=4, num_heads=4,
+                 max_len=128, compute_dtype="float32",
+                 learning_rate=0.01).init()
+    draft = gpt(vocab_size=vocab, d_model=32, n_layers=1, num_heads=2,
+                max_len=128, compute_dtype="float32",
+                learning_rate=0.01).init()
+    rng = np.random.default_rng(0)
+
+    def batch(b=16, t=33):
+        start = rng.integers(0, vocab, (b, 1))
+        ids = (start + np.arange(t)[None, :]) % vocab
+        x = ids[:, :-1].astype(np.float32)
+        y = np.eye(vocab, dtype=np.float32)[ids[:, 1:]]
+        return DataSet(x, y)
+
+    # cyclic counting: next = (prev + 1) % vocab — both nets learn it
+    # to ~perfect greedy agreement in a few hundred tiny steps
+    for _ in range(600):
+        ds = batch()
+        target.fit(ds)
+        draft.fit(ds)
+    reg = monitor.get_registry()
+    prompts = [((np.arange(8) + 3 * i) % vocab)[None, :].astype(np.int64)
+               for i in range(16)]
+
+    def run(speculative, b):
+        kw = ({"speculative": True, "spec_tokens": k_spec,
+               "spec_max_rows": 4, "draft_net": draft}
+              if speculative else {})
+        sched = ContinuousDecodeScheduler(
+            net=target, slots=slots, burst_tokens=burst, block_size=16,
+            start=False, **kw)
+        sched.warmup([8], max_new)
+        miss0 = reg.family_total(monitor.JIT_CACHE_MISS_COUNTER)
+        t0 = time.perf_counter()
+        futs = [sched.submit(p, max_new) for p in prompts[:b]]
+        steps = 0
+        while not all(f.done() for f in futs):
+            sched.step()
+            steps += 1
+            if steps > 20000:
+                raise RuntimeError("speculative bench did not converge")
+        dt = time.perf_counter() - t0
+        outs = [f.result(0) for f in futs]
+        st = sched.stats()
+        dpool = st.get("draft_pool", {"blocks_total": 0, "blocks_free": 0})
+        spec_st = st["speculative"]
+        return {
+            # every stream decodes max_new tokens over the same wall
+            "per_stream_tokens_per_sec": max_new / dt,
+            "steady_state_jit_misses": float(
+                reg.family_total(monitor.JIT_CACHE_MISS_COUNTER) - miss0),
+            "leaked_blocks_target": int(st["pool"]["blocks_total"]
+                                        - st["pool"]["blocks_free"]),
+            "leaked_blocks_draft": int(dpool["blocks_total"]
+                                       - dpool["blocks_free"]),
+            "accept_rate": spec_st["accept_rate"],
+            "rounds": spec_st["rounds"],
+            "fallbacks": spec_st["fallbacks"],
+        }, outs
+
+    results = {}
+    parity_ok = True
+    for b in (1, 4, 16):
+        plain, _ = run(False, b)
+        spec, outs = run(True, b)
+        if b <= 4:  # the greedy-parity oracle (eager is slow: spot-check)
+            for p, out in list(zip(prompts, outs))[:2]:
+                parity_ok &= bool(np.array_equal(
+                    out, generate_eager(target, p, max_new)))
+        results[b] = {
+            "plain_tokens_per_sec": round(
+                plain["per_stream_tokens_per_sec"], 1),
+            "spec_tokens_per_sec": round(
+                spec["per_stream_tokens_per_sec"], 1),
+            "speedup": round(spec["per_stream_tokens_per_sec"]
+                             / max(1e-9,
+                                   plain["per_stream_tokens_per_sec"]), 3),
+            "accept_rate": round(spec["accept_rate"], 4),
+            "spec_rounds": spec["rounds"],
+            "spec_fallbacks": spec["fallbacks"],
+            "steady_state_jit_misses": spec["steady_state_jit_misses"]
+            + plain["steady_state_jit_misses"],
+            "leaked_blocks": spec["leaked_blocks_target"]
+            + spec["leaked_blocks_draft"] + plain["leaked_blocks_target"],
+        }
+    # batch 16 over slots=8 with spec_max_rows=4: always saturated —
+    # the fallback must engage and throughput must not regress
+    sat = results[16]
+    return {
+        "metric": "speculative_decode_speedup_batch1",
+        "value": results[1]["speedup"], "unit": "x",
+        "batch1": results[1], "batch4": results[4], "saturated": sat,
+        "speedup_batch4": results[4]["speedup"],
+        "saturation_ratio": sat["speedup"],
+        "fallback_engaged_at_saturation": sat["spec_fallbacks"] > 0,
+        "greedy_matches_eager": parity_ok,
+        "k_spec": k_spec, "max_new": max_new,
+        "draft_params_frac": round(
+            sum(x.size for x in jax.tree_util.tree_leaves(draft.params))
+            / sum(x.size for x in jax.tree_util.tree_leaves(target.params)),
+            4),
+    }
+
+
 def bench_quantized_serving():
     """Quantized serving end to end (ISSUE 14): the same model served
     fp32, int8-weights, and int8-weights + int8-KV under the SAME
@@ -2607,6 +2744,7 @@ def main():
                      ("serving_inference", bench_serving_inference),
                      ("fault_recovery", bench_fault_recovery),
                      ("continuous_decode", bench_continuous_decode),
+                     ("speculative_decode", bench_speculative_decode),
                      ("quantized_serving", bench_quantized_serving),
                      ("prefix_cache", bench_prefix_cache),
                      ("durable_decode", bench_durable_decode),

@@ -259,6 +259,22 @@ QUANT_KV_BLOCKS_GAUGE = "dl4j_quant_kv_blocks"
 QUANT_SCALE_ABSMAX_GAUGE = "dl4j_quant_scale_absmax"
 QUANT_GATE_OUTCOME_COUNTER = "dl4j_quant_accuracy_gate_outcome_total"
 
+# Speculative decoding (serving/continuous.py spec rounds over the
+# nn/generate.py draft-burst + fused verify/reject programs): proposal
+# volume from the draft net, how many of those proposals the target's
+# exact rejection sampler accepted vs rejected (``model=`` label — the
+# realized acceptance ratio IS the speedup dial; accepted/(accepted+
+# rejected) should track the deploy-time accuracy-gate greedy-match
+# prior the registry surfaces), the live acceptance-rate gauge the
+# scheduler refreshes every spec round, and the draft-phase wall-time
+# histogram (the added latency speculation must amortize — a draft
+# burst slower than ~K/(1+aK) of a target burst is a net loss).
+SPEC_PROPOSED_TOKENS_COUNTER = "dl4j_spec_proposed_tokens_total"
+SPEC_ACCEPTED_TOKENS_COUNTER = "dl4j_spec_accepted_tokens_total"
+SPEC_REJECTED_TOKENS_COUNTER = "dl4j_spec_rejected_tokens_total"
+SPEC_ACCEPT_RATE_GAUGE = "dl4j_spec_accept_rate"
+SPEC_DRAFT_LATENCY_HISTOGRAM = "dl4j_spec_draft_latency_ms"
+
 # End-to-end request tracing + SLO attribution (monitor/reqtrace.py —
 # the serving plane's Dapper layer): per-request phase durations from
 # the merged traces (``phase=`` label: admission / dispatch /

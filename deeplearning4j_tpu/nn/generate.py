@@ -119,19 +119,16 @@ def sample_tokens(logits, keys, step, temperature: float, top_k: int,
     return jnp.argmax(lg + g, axis=-1).astype(jnp.int32)
 
 
-def sample_tokens_rowwise(logits, keys, folds, temp_v, top_k_v, top_p_v):
-    """Per-row sampler over [b, V] logits — the continuous-batching
-    variant of :func:`sample_tokens`: every sampler knob is a traced
-    [b] vector (temperature, top-k, top-p) and the PRNG fold index is
-    per row (``folds`` — each sequence's own generated-token counter),
-    so ONE compiled burst program serves any sampler mix and a
-    sequence's draws depend only on its own key and token index, never
-    on which batch slot or cotenants it shares a burst with.
-    ``temp_v <= 0`` rows are greedy. Same filter semantics as the
-    static sampler: top-k first, then the top-p nucleus over the
-    k-filtered logits."""
-    b, vocab = logits.shape
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _filter_logits(logits, temp_v, top_k_v, top_p_v):
+    """The rowwise sampler's temperature/top-k/top-p filter over [b, V]
+    logits with per-row traced knob vectors: scaled f32 logits with
+    every filtered entry at ``finfo.min`` (softmax → exactly the
+    sampler's support). Factored out of :func:`sample_tokens_rowwise`
+    so the speculative rejection sampler computes its target/draft
+    distributions p and q from PRECISELY the distribution the plain
+    sampler draws from — the exactness contract hinges on the filters
+    matching bit for bit."""
+    vocab = logits.shape[-1]
     lg = logits.astype(jnp.float32) / jnp.maximum(temp_v, 1e-6)[:, None]
     neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
     # top-k: the kth-largest value per row (k <= 0 or k >= V: no filter)
@@ -146,12 +143,53 @@ def sample_tokens_rowwise(logits, keys, folds, temp_v, top_k_v, top_p_v):
     keep = jnp.cumsum(probs, axis=-1) - probs < top_p_v[:, None]
     cutoff = jnp.min(jnp.where(keep, srt2, jnp.inf), axis=-1, keepdims=True)
     use_p = ((top_p_v > 0.0) & (top_p_v < 1.0))[:, None]
-    lg = jnp.where(use_p & (lg < cutoff), neg, lg)
+    return jnp.where(use_p & (lg < cutoff), neg, lg)
+
+
+def sample_tokens_rowwise(logits, keys, folds, temp_v, top_k_v, top_p_v):
+    """Per-row sampler over [b, V] logits — the continuous-batching
+    variant of :func:`sample_tokens`: every sampler knob is a traced
+    [b] vector (temperature, top-k, top-p) and the PRNG fold index is
+    per row (``folds`` — each sequence's own generated-token counter),
+    so ONE compiled burst program serves any sampler mix and a
+    sequence's draws depend only on its own key and token index, never
+    on which batch slot or cotenants it shares a burst with.
+    ``temp_v <= 0`` rows are greedy. Same filter semantics as the
+    static sampler: top-k first, then the top-p nucleus over the
+    k-filtered logits."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = _filter_logits(logits, temp_v, top_k_v, top_p_v)
     step_keys = jax.vmap(jax.random.fold_in)(keys, folds)
     g = jax.vmap(lambda k: jax.random.gumbel(k, (vocab,), jnp.float32))(
         step_keys)
     sampled = jnp.argmax(lg + g, axis=-1).astype(jnp.int32)
     return jnp.where(temp_v > 0.0, sampled, greedy)
+
+
+#: Disjoint PRNG fold lanes for speculative decoding (Leviathan et al.
+#: 2023; Chen et al. 2023). Every draw in a speculative round derives
+#: from ``fold_in(fold_in(row_key, SALT), token_index)`` — three salted
+#: lanes (draft proposal gumbels, accept-test uniforms, residual/bonus
+#: gumbels), all clocked by the row's generated-token index, NEVER by
+#: round or batch position. A round that accepts ``a`` proposals emits
+#: ``a + 1`` tokens and consumed nothing past index ``n_gen + a`` on
+#: any lane whose value reached the output (the first rejection is a
+#: stopping time over the index clock: discarded deeper proposals never
+#: enter the output σ-algebra), so the next round's draws at index
+#: ``n_gen + a + 1`` onward are fresh — the rejection sampler stays
+#: distribution-exact AND every draw is a pure function of (seed, row,
+#: token index): coalescing- and preemption-invariant like the plain
+#: sampler's unsalted clock, which stays an independent stream (its
+#: draws fold the row key once, the spec lanes twice).
+SPEC_DRAFT_SALT = 101
+SPEC_ACCEPT_SALT = 102
+SPEC_RESID_SALT = 103
+
+
+def spec_lane_keys(keys, salt: int):
+    """Fold every row key [b, 2] onto one speculative lane (traced)."""
+    return jax.vmap(jax.random.fold_in, (0, None))(keys, salt)
 
 
 def _ordered_impls(net) -> List[Any]:
@@ -631,6 +669,174 @@ class TransformerGenerator(_GeneratorBase):
         return self._jit(
             ("gen_burst", slots, k_burst, max_blocks, num_blocks,
              block_size, bool(sampling)), builder, donate=(1,))
+
+    # ------------------------------------------ speculative decoding
+    # (serving/continuous.py speculative=True rounds: this generator
+    # built on the DRAFT net runs spec_draft_program, the TARGET net's
+    # generator runs spec_verify_program — two dispatches per round)
+
+    def spec_draft_program(self, slots: int, k_spec: int, max_blocks: int,
+                           num_blocks: int, block_size: int):
+        """K chained draft proposals on this (draft) net's OWN paged
+        lane: feed the pending token at ``pos``, sample proposal
+        ``x_{s+1}`` from the filtered draft distribution on the DRAFT
+        fold lane at token index ``n_gen + s``, feed it back. Rows with
+        ``temp <= 0`` propose greedily (argmax of the raw logits — the
+        same greedy the plain sampler degenerates to). ``live`` masks
+        padding rows (their writes redirect to the trash block).
+        Returns (pools, proposals [slots, K], q [slots, K, V]) — q is
+        the filtered proposal distribution softmax the verify program's
+        rejection test divides by. No EOS/max-new gating in-program:
+        the scheduler truncates on the host, so accept length never
+        shapes a compiled program (the reason the accept "ladder" is
+        one fixed (slots × K) shape and steady state compiles
+        nothing).
+
+        The scan runs K+1 steps: the extra step feeds the LAST proposal
+        back so its own K/V lands in the draft pool (its sampled token
+        is discarded). Without it an all-accepted round would leave the
+        draft lane one position short of the target — the next round's
+        feed position would attend an unwritten slot. Discarded draws
+        are harmless per the stopping-time argument above."""
+        def builder():
+            def draft(params, pools, tables, pos, tok, n_gen, keys,
+                      temp_v, top_k_v, top_p_v, live):
+                p_emb = self._cast(params[self.emb.name])
+                dkeys = spec_lane_keys(keys, SPEC_DRAFT_SALT)
+
+                def step(carry, s):
+                    pools, tok, pos = carry
+                    x = self._embed_token(p_emb, tok, pos)
+                    new_pools = []
+                    for blk, pool in zip(self.blocks, pools):
+                        cache = dict(pool)
+                        cache["table"] = tables
+                        x, cache = blk.decode_step(
+                            self._cast(params[blk.name]), x, cache, pos,
+                            write_mask=live)
+                        new_pools.append({name: cache[name]
+                                          for name in pool})
+                    logits = self._head_logits(params, x)
+                    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    lgf = _filter_logits(logits, temp_v, top_k_v, top_p_v)
+                    step_keys = jax.vmap(jax.random.fold_in)(dkeys,
+                                                             n_gen + s)
+                    g = jax.vmap(lambda k: jax.random.gumbel(
+                        k, (lgf.shape[-1],), jnp.float32))(step_keys)
+                    sampled = jnp.argmax(lgf + g, axis=-1).astype(jnp.int32)
+                    nxt = jnp.where(temp_v > 0.0, sampled, greedy)
+                    nxt = jnp.where(live, nxt, tok)
+                    q = jax.nn.softmax(lgf, axis=-1)
+                    return ((new_pools, nxt,
+                             pos + live.astype(jnp.int32)), (nxt, q))
+
+                (pools, _, _), (ys, qs) = jax.lax.scan(
+                    step, (pools, tok, pos.astype(jnp.int32)),
+                    jnp.arange(k_spec + 1))
+                return (pools, jnp.swapaxes(ys, 0, 1)[:, :k_spec],
+                        jnp.swapaxes(qs, 0, 1)[:, :k_spec])
+            return draft
+        return self._jit(
+            ("gen_spec_draft", slots, k_spec, max_blocks, num_blocks,
+             block_size), builder, donate=(1,))
+
+    def spec_verify_program(self, slots: int, k_spec: int, max_blocks: int,
+                            num_blocks: int, block_size: int):
+        """ONE target forward over the pending token + K proposals
+        (``prefill_paged``'s per-row traced-positions machinery — the
+        tail-prefill body with logits taken at EVERY position) fused
+        with the exact rejection sampler. Position ``i`` accepts
+        proposal ``x_{i+1}`` with probability ``min(1, p_i[x]/q_i[x])``
+        (greedy rows: accept iff the target argmax equals it); the
+        first rejection draws the correction from the normalized
+        residual ``max(p_a − q_a, 0)``; a fully-accepted row draws the
+        bonus token straight from ``p_K`` through the same gather (q
+        pads with zeros at index K, making the residual p itself).
+        Accept uniforms ride the ACCEPT fold lane and residual/bonus
+        gumbels the RESID lane, both at the token's own index — see
+        the lane-salt doctrine above. Returns (pools, out_tokens
+        [slots, K+1] — accepted proposals with the correction/bonus
+        scattered at index ``a``; entries past ``a`` are dead, the host
+        truncates — and accept_len [slots])."""
+        t = k_spec + 1
+
+        def builder():
+            def verify(params, pools, tables, pos, tok, props, q, n_gen,
+                       keys, temp_v, top_k_v, top_p_v, live):
+                p_emb = self._cast(params[self.emb.name])
+                ids = jnp.concatenate([tok[:, None], props], axis=1)
+                posm = pos[:, None] + jnp.arange(t)[None, :]
+                x = self.emb._slice_replicate(
+                    qtake(p_emb, "W", ids)
+                    + jnp.take(p_emb["P"], posm, axis=0))
+                write_ok = jnp.broadcast_to(live[:, None], ids.shape)
+                new_pools = []
+                for blk, pool in zip(self.blocks, pools):
+                    x, pool = blk.prefill_paged(
+                        self._cast(params[blk.name]), x, pool, tables,
+                        posm, write_ok)
+                    new_pools.append(pool)
+                lg = self._head_logits(
+                    params, x.reshape(slots * t, x.shape[-1])
+                ).reshape(slots, t, -1)
+                vocab = lg.shape[-1]
+                g_tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                pf = _filter_logits(
+                    lg.reshape(slots * t, vocab), jnp.repeat(temp_v, t),
+                    jnp.repeat(top_k_v, t), jnp.repeat(top_p_v, t)
+                ).reshape(slots, t, vocab)
+                p = jax.nn.softmax(pf, axis=-1)
+                # accept test u_i * q_i[x] < p_i[x] (division-free) on
+                # the ACCEPT lane at the proposal's own token index
+                akeys = spec_lane_keys(keys, SPEC_ACCEPT_SALT)
+                folds = (n_gen[:, None]
+                         + jnp.arange(k_spec)[None, :]).reshape(-1)
+                ukeys = jax.vmap(jax.random.fold_in)(
+                    jnp.repeat(akeys, k_spec, axis=0), folds)
+                u = jax.vmap(lambda k: jax.random.uniform(
+                    k, (), jnp.float32))(ukeys).reshape(slots, k_spec)
+                px = jnp.take_along_axis(p[:, :k_spec], props[..., None],
+                                         axis=-1)[..., 0]
+                qx = jnp.take_along_axis(q, props[..., None],
+                                         axis=-1)[..., 0]
+                acc = jnp.where(temp_v[:, None] > 0.0, u * qx < px,
+                                g_tok[:, :k_spec] == props)
+                a = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1),
+                            axis=1)
+                # correction/bonus from the residual at the first
+                # rejected position (a == K: q_pad is zero, residual=p_K)
+                p_a = jnp.take_along_axis(p, a[:, None, None],
+                                          axis=1)[:, 0]
+                q_pad = jnp.concatenate(
+                    [q, jnp.zeros((slots, 1, vocab), q.dtype)], axis=1)
+                q_a = jnp.take_along_axis(q_pad, a[:, None, None],
+                                          axis=1)[:, 0]
+                r = jnp.maximum(p_a - q_a, 0.0)
+                # float-degenerate p ≈ q can zero the residual; a true
+                # rejection implies p < q somewhere, so falling back to
+                # p itself only fires inside rounding error of p == q
+                rr = jnp.where(jnp.sum(r, axis=-1, keepdims=True) > 0.0,
+                               r, p_a)
+                neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+                logr = jnp.where(rr > 0.0,
+                                 jnp.log(jnp.maximum(rr, 1e-38)), neg)
+                rkeys = jax.vmap(jax.random.fold_in)(
+                    spec_lane_keys(keys, SPEC_RESID_SALT), n_gen + a)
+                gr = jax.vmap(lambda k: jax.random.gumbel(
+                    k, (vocab,), jnp.float32))(rkeys)
+                corr_s = jnp.argmax(logr + gr, axis=-1).astype(jnp.int32)
+                corr_g = jnp.take_along_axis(g_tok, a[:, None],
+                                             axis=1)[:, 0]
+                corr = jnp.where(temp_v > 0.0, corr_s, corr_g)
+                padded = jnp.concatenate(
+                    [props, jnp.zeros((slots, 1), jnp.int32)], axis=1)
+                out = jnp.where(jnp.arange(t)[None, :] == a[:, None],
+                                corr[:, None], padded)
+                return new_pools, out, a
+            return verify
+        return self._jit(
+            ("gen_spec_verify", slots, k_spec, max_blocks, num_blocks,
+             block_size), builder, donate=(1,))
 
     def run_eager(self, params, ids, lengths, max_new, sampler, keys,
                   replica=None) -> np.ndarray:

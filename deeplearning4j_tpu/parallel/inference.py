@@ -367,6 +367,10 @@ class ParallelInference:
                  decode_burst_hook=None,
                  prefix_cache: bool = False,
                  prefix_cache_blocks: Optional[int] = None,
+                 speculative: bool = False,
+                 spec_tokens: int = 4,
+                 spec_max_rows: Optional[int] = None,
+                 draft_net=None,
                  slice_plane=None):
         if net is None and registry is None:
             raise ValueError("ParallelInference needs a net or a registry")
@@ -511,6 +515,19 @@ class ParallelInference:
         if self.prefix_cache and not self.continuous:
             raise ValueError(
                 "prefix_cache=True rides the paged-pool scheduler: "
+                "build the engine with continuous=True")
+        # speculative decoding (nn/generate.py spec programs): draft
+        # proposes spec_tokens, target verifies them in ONE forward,
+        # exact rejection sampling keeps the output distribution
+        # unchanged; draft_net overrides the int8 self-speculation
+        # default (registry mode pairs drafts via deploy(draft=...))
+        self.speculative = bool(speculative)
+        self.spec_tokens = int(spec_tokens)
+        self.spec_max_rows = spec_max_rows
+        self.draft_net = draft_net
+        if (speculative or draft_net is not None) and not self.continuous:
+            raise ValueError(
+                "speculative=/draft_net= ride the paged-pool scheduler: "
                 "build the engine with continuous=True")
         self._scheduler = None
         if self.slice_plane is not None:
@@ -755,6 +772,10 @@ class ParallelInference:
                 on_resolve=self._note_resolved,
                 prefix_cache=self.prefix_cache,
                 prefix_cache_blocks=self.prefix_cache_blocks,
+                speculative=self.speculative,
+                spec_tokens=self.spec_tokens,
+                spec_max_rows=self.spec_max_rows,
+                draft_net=self.draft_net,
                 on_fatal=self._slice_fail,
                 start=self._started)
         return sched

@@ -82,6 +82,11 @@ from deeplearning4j_tpu.monitor import (
     SCHED_PREEMPTIONS_COUNTER,
     SCHED_QUEUED_GAUGE,
     SCHED_RETIRED_COUNTER,
+    SPEC_ACCEPT_RATE_GAUGE,
+    SPEC_ACCEPTED_TOKENS_COUNTER,
+    SPEC_DRAFT_LATENCY_HISTOGRAM,
+    SPEC_PROPOSED_TOKENS_COUNTER,
+    SPEC_REJECTED_TOKENS_COUNTER,
     STREAM_CHUNKS_COUNTER,
     TS_SCHED_ACTIVE,
     TS_SCHED_POOL_OCCUPANCY,
@@ -191,8 +196,8 @@ class _Seq:
     draws identical to an uninterrupted run."""
 
     __slots__ = ("req", "row", "fed", "generated", "key", "n_gen", "slot",
-                 "blocks", "pos", "seq_id", "preemptions", "emitted",
-                 "t_queued")
+                 "blocks", "draft_blocks", "pos", "seq_id", "preemptions",
+                 "emitted", "t_queued", "carry")
 
     def __init__(self, req: _DecodeRequest, row: int, key: np.ndarray,
                  seq_id: int):
@@ -205,6 +210,15 @@ class _Seq:
         self.n_gen = 0
         self.slot: Optional[int] = None
         self.blocks: List[int] = []
+        # the sequence's block table on the DRAFT lane's pool (empty
+        # when the scheduler is not speculative)
+        self.draft_blocks: List[int] = []
+        # speculative pending-carry resume: a preempted spec-mode row's
+        # LAST generated token is the pending (KV-unwritten) token; on
+        # re-admission it is restored here instead of re-drawing tok0 —
+        # the unsalted admission draw would break sampled resume parity
+        # (the uninterrupted run draws that clock index on a spec lane)
+        self.carry: Optional[int] = None
         self.pos = 0
         self.seq_id = seq_id
         self.preemptions = 0
@@ -274,6 +288,23 @@ class _Lane:
         self.top_p = np.zeros(slots, np.float32)
         self.eos = np.full(slots, -1, np.int32)
         self.max_new_v = np.zeros(slots, np.int32)
+        # speculative draft pairing (attached by the scheduler when
+        # speculative=True): the draft net decodes on its OWN pool —
+        # separable accounting, so the dual-lane leak audit can name
+        # which lane leaked
+        self.draft_net = None
+        self.draft_gen: Optional[TransformerGenerator] = None
+        self.draft_pool: Optional[PagedKVCachePool] = None
+        self.draft_mb = 0
+        self.draft_tables: Optional[np.ndarray] = None
+
+    def attach_draft(self, net, gen: TransformerGenerator,
+                     pool: PagedKVCachePool) -> None:
+        self.draft_net = net
+        self.draft_gen = gen
+        self.draft_pool = pool
+        self.draft_mb = pool.blocks_for(gen.max_context())
+        self.draft_tables = np.zeros((self.slots, self.draft_mb), np.int32)
 
     def free_slot(self) -> Optional[int]:
         for i, s in enumerate(self.seqs):
@@ -287,6 +318,8 @@ class _Lane:
     def clear_slot(self, slot: int) -> None:
         self.seqs[slot] = None
         self.tables[slot] = 0
+        if self.draft_tables is not None:
+            self.draft_tables[slot] = 0
         self.pos[slot] = 0
         self.tok[slot] = 0
         self.n_gen[slot] = 0
@@ -335,7 +368,9 @@ class ContinuousDecodeScheduler:
                  prefix_cache: bool = False,
                  prefix_cache_blocks: Optional[int] = None,
                  on_fatal=None, kv_quant: Optional[str] = None,
-                 kv_bytes_budget: Optional[int] = None):
+                 kv_bytes_budget: Optional[int] = None,
+                 speculative: bool = False, spec_tokens: int = 4,
+                 spec_max_rows: Optional[int] = None, draft_net=None):
         if net is None and registry is None:
             raise ValueError(
                 "ContinuousDecodeScheduler needs a net or a registry")
@@ -372,6 +407,39 @@ class ContinuousDecodeScheduler:
                              "exclusive — the budget derives num_blocks")
         self._kv_bytes_budget = kv_bytes_budget
         self.queue_capacity = max(1, int(queue_capacity))
+        # speculative decoding (Leviathan/Chen 2023): a cheap DRAFT net
+        # proposes spec_tokens greedy/sampled tokens per round on its
+        # own paged lane, the target verifies all of them in ONE
+        # forward, and exact rejection sampling keeps the output
+        # distribution identical to plain decode (greedy:
+        # token-for-token). draft_net=None self-speculates through
+        # quantize(net, "int8") — PR 14's zero-training draft, whose
+        # accuracy-gate greedy-match rate is the acceptance prior.
+        # spec_max_rows caps the batch width speculation runs at:
+        # speculation is a LATENCY tool — past the cap the verify
+        # forward's extra K× token compute no longer rides free on an
+        # underutilized device, so saturated batches fall back to
+        # plain bursts (counted in stats()["speculative"]).
+        self.speculative = bool(speculative)
+        if draft_net is not None and not self.speculative:
+            raise ValueError("draft_net= needs speculative=True")
+        if draft_net is not None and net is None:
+            raise ValueError(
+                "draft_net= is the net-mode pairing knob; registry mode "
+                "pairs drafts per version via deploy(draft=...)")
+        self.spec_tokens = max(1, int(spec_tokens))
+        self.spec_max_rows = (max(1, self.slots // 2)
+                              if spec_max_rows is None
+                              else max(1, min(int(spec_max_rows),
+                                              self.slots)))
+        self._draft_net_knob = draft_net
+        self._draft_pools: Dict[Tuple, PagedKVCachePool] = {}
+        self._draft_params_cache: Dict[Tuple, Any] = {}
+        self._spec_rounds = 0
+        self._spec_fallbacks = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_rejected = 0
         # same-(lane, bucket) admissions coalesce into one prefill up
         # the row ladder (a spike pays one dispatch chain, not N)
         self.admit_rows = max(1, min(int(admit_rows), self.slots))
@@ -570,7 +638,21 @@ class ContinuousDecodeScheduler:
                 "prefill_tokens_computed": self._prefill_computed_tokens,
                 "resume_reprefill_tokens": self._resume_reprefill_tokens,
                 "kv_handoffs": self._kv_handoffs,
+                "speculative": {
+                    "enabled": self.speculative,
+                    "k": self.spec_tokens,
+                    "max_rows": self.spec_max_rows,
+                    "rounds": self._spec_rounds,
+                    "fallbacks": self._spec_fallbacks,
+                    "proposed_tokens": self._spec_proposed,
+                    "accepted_tokens": self._spec_accepted,
+                    "rejected_tokens": self._spec_rejected,
+                    "accept_rate": (self._spec_accepted
+                                    / max(1, self._spec_proposed)),
+                },
             }
+            dpools = [p.stats()
+                      for _, p in sorted(self._draft_pools.items())]
             caches = [c for _, c in sorted(self._caches.items(),
                                            key=lambda kv: repr(kv[0]))]
         agg = {"blocks_total": sum(p["blocks_total"] for p in pools),
@@ -583,6 +665,14 @@ class ContinuousDecodeScheduler:
             if agg["blocks_total"] else 0.0)
         out["pool"] = agg
         out["pools"] = pools
+        if dpools:
+            # the draft lane's pools stay OUT of the main aggregate so
+            # a dual-lane leak audit can name which lane leaked
+            out["draft_pools"] = dpools
+            out["draft_pool"] = {
+                "blocks_total": sum(p["blocks_total"] for p in dpools),
+                "blocks_free": sum(p["blocks_free"] for p in dpools),
+            }
         out["attribution"] = self.attribution()
         if self.prefix_cache:
             cs = [c.stats() for c in caches]
@@ -714,6 +804,99 @@ class ContinuousDecodeScheduler:
                     for sampling in (False, True):
                         self._dispatch_burst(lane, params, tier=tier,
                                              sampling=sampling, rows=rows)
+            if self.speculative and lane.draft_gen is not None:
+                # the speculative program set: the draft lane's dense
+                # prefill + scatter ladder (admissions write the prompt
+                # into the draft pool too — the draft net's own _jits
+                # cache, so its programs compile separately), then the
+                # spec draft/verify rounds over (spec row bucket ×
+                # block tier). ONE program per shape — the rejection
+                # sampler handles any greedy/sampled row mix with
+                # per-row where()s, and accept length never shapes a
+                # program (host truncation), so the accept "ladder"
+                # warms for free.
+                dgen, dpool = lane.draft_gen, lane.draft_pool
+                dparams = self._draft_params(lane)
+                k = self.spec_tokens
+                # catch-up prefills (_draft_catchup) replay a row's
+                # WRITTEN history, whose length can reach prompt +
+                # max_new — warm every DRAFT-ladder bucket from the
+                # smallest admitted prompt's bucket up to that horizon,
+                # not just the admission buckets, or the first
+                # post-saturation re-arm compiles mid-stream
+                d_sizes = bucket_sizes(dgen.max_context())
+                lo = min(bucket_for(int(t), d_sizes)
+                         for t in prompt_lengths)
+                hi = bucket_for(
+                    min(int(dgen.max_context()),
+                        max(int(t) for t in prompt_lengths)
+                        + int(max_new_tokens)), d_sizes)
+                spec_pre = sorted(
+                    {(t, self._round_blocks(t))
+                     for t in d_sizes if lo <= t <= hi}
+                    | set(done_buckets))
+                for (t_pad, t_blk) in spec_pre:
+                    for rows in self._admit_ladder:
+                        prd = dgen.prefill_program(t_blk)
+                        fresh = note_dispatch(
+                            lane.draft_net,
+                            ("gen_prefill", "sched", rows, t_pad, t_blk))
+                        with span("compile" if fresh else "inference",
+                                  path="warmup_spec_draft_prefill",
+                                  bucket=t_pad, rows=rows):
+                            caches, logits = prd(
+                                dparams,
+                                np.zeros((rows, t_pad), np.int32),
+                                np.ones(rows, np.int32))
+                            jax.block_until_ready(logits)
+                        scat = dgen.scatter_program(rows, t_blk,
+                                                    self.block_size)
+                        note_dispatch(
+                            lane.draft_net,
+                            ("gen_pool_scatter", "sched", rows, t_blk))
+                        dpool.set_layers(scat(
+                            dpool.layers, caches,
+                            np.zeros((rows, t_blk // self.block_size),
+                                     np.int32)))
+                vocab = int(gen.emb.conf.n_in)
+                for rows in self._spec_rows_ladder():
+                    z_pos = np.zeros(rows, np.int32)
+                    z_tok = np.zeros(rows, np.int32)
+                    z_ng = np.zeros(rows, np.int32)
+                    z_keys = np.zeros((rows, 2), lane.keys.dtype)
+                    z_t = np.zeros(rows, np.float32)
+                    z_k = np.zeros(rows, np.int32)
+                    z_p = np.zeros(rows, np.float32)
+                    z_live = np.zeros(rows, bool)
+                    for dtier in self._draft_tiers(lane):
+                        dp = dgen.spec_draft_program(
+                            rows, k, dtier, dpool.num_blocks,
+                            self.block_size)
+                        note_dispatch(
+                            lane.draft_net,
+                            ("gen_spec_draft", "sched", rows, k, dtier))
+                        dpools, props, q = dp(
+                            dparams, dpool.layers,
+                            np.zeros((rows, dtier), np.int32), z_pos,
+                            z_tok, z_ng, z_keys, z_t, z_k, z_p, z_live)
+                        dpool.set_layers(dpools)
+                        jax.block_until_ready(props)
+                    zp = np.zeros((rows, k), np.int32)
+                    zq = np.zeros((rows, k, vocab), np.float32)
+                    for tier in self._burst_tiers(lane):
+                        vp = gen.spec_verify_program(
+                            rows, k, tier, pool.num_blocks,
+                            self.block_size)
+                        note_dispatch(
+                            lane.net,
+                            ("gen_spec_verify", "sched", rows, k, tier))
+                        pools_o, out, acc = vp(
+                            params, pool.layers,
+                            np.zeros((rows, tier), np.int32), z_pos,
+                            z_tok, zp, zq, z_ng, z_keys, z_t, z_k, z_p,
+                            z_live)
+                        pool.set_layers(pools_o)
+                        jax.block_until_ready(acc)
             if self.prefix_cache:
                 # cache-hit admissions dispatch the COW block copy and
                 # the tail-prefill ladder: every (admit rows × tail
@@ -772,12 +955,20 @@ class ContinuousDecodeScheduler:
             lane = self._lanes[key]
             if not lane.active():
                 continue
+            self._draft_catchup(lane)
             self._ensure_blocks(lane)
             if not lane.active():
                 continue
             try:
                 params = self._params(lane)
-                outs = self._dispatch_burst(lane, params, accounted=True)
+                if self._spec_eligible(lane):
+                    outs = self._dispatch_spec_round(lane, params)
+                else:
+                    if self.speculative and lane.draft_gen is not None:
+                        with self._lock:
+                            self._spec_fallbacks += 1
+                    outs = self._dispatch_burst(lane, params,
+                                                accounted=True)
             except BaseException as e:
                 self._burst_failed(lane, e)
                 progressed = True
@@ -851,7 +1042,54 @@ class ContinuousDecodeScheduler:
             if lane is None:
                 lane = _Lane(key, net, gen, pool, self.slots)
                 self._lanes[key] = lane
+        if self.speculative and lane.draft_gen is None:
+            self._attach_draft(lane)
         return lane
+
+    def _attach_draft(self, lane: _Lane) -> None:
+        """Resolve and attach the lane's draft net + its dedicated
+        pool. Resolution order: the version record's deploy(draft=...)
+        pairing (registry mode) / the draft_net= knob (net mode), else
+        self-speculation via ``quantize(net, "int8")`` — the PR-14
+        zero-training draft. The draft decodes on its OWN pool so the
+        dual-lane leak audit stays separable; lanes whose drafts share
+        a KV layout share one draft pool, and a stream's lane (hence
+        its draft) is pinned for its lifetime — a canary cutover never
+        switches a running stream's draft."""
+        model, version = lane.key
+        dn = None
+        if model is not None:
+            ver = self._registry.version(model, version)
+            dn = ver.draft() if hasattr(ver, "draft") else None
+        elif self._draft_net_knob is not None:
+            dn = self._draft_net_knob
+        if dn is None:
+            from deeplearning4j_tpu.nn.quantize import quantize
+            dn = quantize(lane.net, "int8")
+        dgen = build_generator(dn)
+        if not isinstance(dgen, TransformerGenerator):
+            raise ValueError(
+                "speculative decoding drafts on a paged KV cache; "
+                f"{type(dgen).__name__} draft nets have none")
+        n_layers, heads, hd, dtype = dgen.kv_layout()
+        spec = pool_spec(n_layers, heads, hd, self.block_size, dtype,
+                         self.kv_quant)
+        kv_sharding = dgen.kv_sharding()
+        with self._lock:
+            dpool = self._draft_pools.get(spec)
+            if dpool is None:
+                mb = -(-dgen.max_context() // self.block_size)
+                dpool = PagedKVCachePool(
+                    self.slots * mb + 1, self.block_size, n_layers,
+                    heads, hd, dtype,
+                    device=None if kv_sharding is not None
+                    else self.device,
+                    sharding=kv_sharding,
+                    name=(f"{model if model is not None else 'decode'}"
+                          ":draft"),
+                    quant=self.kv_quant)
+                self._draft_pools[spec] = dpool
+        lane.attach_draft(dn, dgen, dpool)
 
     def _cache_of(self, lane: _Lane):
         """The lane's PrefixCache (None when prefix caching is off)."""
@@ -876,6 +1114,15 @@ class ContinuousDecodeScheduler:
             if self.device is not None:
                 p = jax.device_put(p, self.device)
             cached = self._params_cache[lane.key] = p
+        return cached
+
+    def _draft_params(self, lane: _Lane):
+        cached = self._draft_params_cache.get(lane.key)
+        if cached is None:
+            p = lane.draft_net.params
+            if self.device is not None:
+                p = jax.device_put(p, self.device)
+            cached = self._draft_params_cache[lane.key] = p
         return cached
 
     def _round_blocks(self, tokens: int) -> int:
@@ -990,6 +1237,16 @@ class ContinuousDecodeScheduler:
             lane.pool.free_blocks([plan.cow_src], owner=owner)
             plan.cow_src = None
         plan.seq.blocks = []
+        self._free_draft_blocks(lane, plan.seq)
+
+    def _free_draft_blocks(self, lane: _Lane, seq: _Seq) -> None:
+        """Return a sequence's DRAFT-lane blocks (no-op when the lane
+        is not speculative) — called everywhere the target blocks free
+        so the dual-pool drain audit holds on both lanes."""
+        if seq.draft_blocks and lane.draft_pool is not None:
+            lane.draft_pool.free_blocks(seq.draft_blocks,
+                                        owner=_owner_key(lane.key))
+        seq.draft_blocks = []
 
     def _pick_admissions(self):
         """Claim the next admissible FIFO group: the first sequence
@@ -1107,6 +1364,8 @@ class ContinuousDecodeScheduler:
         self._trace_admitted(
             [(seq, {"bucket": t_pad, "rows": n, "computed": len(seq.fed)})
              for seq, _ in entries], t0p, t1p, "dense")
+        if self._draft_admit_ok(lane, len(entries)):
+            self._draft_prefill(lane, [seq for seq, _ in entries])
         for i, (seq, blocks) in enumerate(entries):
             self._note_prefilled(seq, len(seq.fed), t0p)
             cache = self._cache_of(lane)
@@ -1193,6 +1452,8 @@ class ContinuousDecodeScheduler:
                       "computed": len(p.seq.fed) - p.start,
                       "cached": p.start}) for p in entries],
             t0p, t1p, "tail")
+        if self._draft_admit_ok(lane, len(entries)):
+            self._draft_prefill(lane, [p.seq for p in entries])
         for i, p in enumerate(entries):
             self._note_prefilled(p.seq, len(p.seq.fed) - p.start, t0p)
             if cache is not None:
@@ -1267,6 +1528,11 @@ class ContinuousDecodeScheduler:
         self._trace_admitted(
             [(p.seq, {"bucket": t_blk, "rows": n, "computed": 0})
              for p in entries], t0p, t1p, "shipped")
+        if self._draft_admit_ok(lane, len(entries)):
+            # the handoff ships only the TARGET's cache — the draft
+            # lane still prefills the prompt (its quantized forward is
+            # the cheap one; the disaggregation win is the target's)
+            self._draft_prefill(lane, [p.seq for p in entries])
         for i, p in enumerate(entries):
             self._note_prefilled(p.seq, 0, t0p)
             p.seq.req.kv_state = None  # one-shot: a preempt re-prefills
@@ -1274,6 +1540,121 @@ class ContinuousDecodeScheduler:
                 f"kv_handoff seq={p.seq.seq_id} t={len(p.seq.fed)} "
                 f"blocks={len(p.blocks)}")
             self._install(lane, p.seq, p.blocks, int(toks[i]))
+
+    def _draft_admit_ok(self, lane: _Lane, n: int) -> bool:
+        """Admission-time draft prefill only pays when the lane can
+        actually speculate soon: past ``spec_max_rows`` every round is
+        a plain-burst fallback anyway, so the draft prefill dispatches
+        would be pure overhead on the saturated path. Those rows admit
+        draft-less and :meth:`_draft_catchup` re-arms them once the
+        batch drains back under the cap."""
+        return (lane.draft_gen is not None
+                and len(lane.active()) + n <= self.spec_max_rows)
+
+    def _draft_prefill(self, lane: _Lane, seqs: List[_Seq],
+                       history=None) -> None:
+        """Write every admitted row's full fed history into the DRAFT
+        lane's pool: a dense draft-net prefill + scatter per prompt
+        bucket. The draft has no prefix cache and its quantized forward
+        is the cheap one, so tail/shipped TARGET admissions still
+        draft-prefill densely. A row the draft pool cannot cover right
+        now admits draft-less — the lane then serves it through plain
+        bursts (spec fallback) instead of failing the admission:
+        speculation is an accelerator, never a correctness dependency.
+        ``history`` (a seq_id → int32 token-array mapping) overrides
+        the fed tokens per row (the catch-up path feeds a mid-stream
+        row's full written history instead)."""
+        dgen, dpool = lane.draft_gen, lane.draft_pool
+        owner = _owner_key(lane.key)
+        dparams = self._draft_params(lane)
+        groups: Dict[int, List[Tuple[_Seq, np.ndarray]]] = {}
+        for seq in seqs:
+            hv = history[seq.seq_id] if history is not None \
+                else np.asarray(seq.fed, np.int32)
+            t_pad = dgen.prompt_bucket(len(hv), max(1, seq.remaining))
+            groups.setdefault(t_pad, []).append((seq, hv))
+        for t_pad in sorted(groups):
+            group = groups[t_pad]
+            t_blk = self._round_blocks(t_pad)
+            nb = t_blk // self.block_size
+            rows = bucket_for(len(group), self._admit_ladder)
+            ids = np.zeros((rows, t_pad), np.int32)
+            lens = np.zeros(rows, np.int32)
+            tnb = np.zeros((rows, nb), np.int32)
+            any_rows = False
+            for i, (seq, hv) in enumerate(group):
+                got = dpool.alloc(dpool.blocks_for(len(hv)),
+                                  owner=owner)
+                if got is None:
+                    seq.draft_blocks = []
+                    mark("spec_draft_admit_skipped", seq=seq.seq_id)
+                    continue
+                seq.draft_blocks = got
+                any_rows = True
+                ids[i, :len(hv)] = hv
+                lens[i] = len(hv)
+                tnb[i, :len(got)] = got
+            if not any_rows:
+                continue
+            pre = dgen.prefill_program(t_blk)
+            fresh = note_dispatch(
+                lane.draft_net,
+                ("gen_prefill", "sched", rows, t_pad, t_blk))
+            with span("compile" if fresh else "inference",
+                      path="continuous_spec_draft_prefill", bucket=t_pad,
+                      rows=len(group)):
+                caches, _logits = pre(dparams, ids, lens)
+            scat = dgen.scatter_program(rows, t_blk, self.block_size)
+            note_dispatch(lane.draft_net,
+                          ("gen_pool_scatter", "sched", rows, t_blk))
+            dpool.set_layers(scat(dpool.layers, caches, tnb))
+
+    def _draft_catchup(self, lane: _Lane) -> None:
+        """Re-arm speculation on rows that admitted draft-less — either
+        because the batch was over ``spec_max_rows`` (the admission
+        gate skipped their draft prefill) or because the draft pool
+        was exhausted at admit time. Once the lane drains back under
+        the cap, replay each row's full WRITTEN history (positions
+        0..pos-1 of prompt+generated; the pending token at index pos
+        stays the verify step's job) through one draft prefill so the
+        next round speculates again. Host-side pool math filters rows
+        the draft pool cannot cover to the full speculation horizon,
+        so a tight pool never thrashes failed allocs every step."""
+        if lane.draft_gen is None or not self.speculative:
+            return
+        act = lane.active()
+        if not (0 < len(act) <= self.spec_max_rows):
+            return
+        missing = [s for s in act if not s.draft_blocks]
+        if not missing:
+            return
+        dpool = lane.draft_pool
+        hist: Dict[int, np.ndarray] = {}
+        free, take = dpool.free_count, []
+        for seq in missing:
+            pos = int(lane.pos[seq.slot])
+            stream = np.concatenate(
+                [np.asarray(seq.req.prompt[seq.row], np.int32),
+                 np.asarray(seq.generated, np.int32)])
+            if len(stream) != pos + 1:  # invariant guard: never
+                continue                # speculate on a bad history
+            need = dpool.blocks_for(pos + self.spec_tokens + 1)
+            if need > free:
+                continue
+            free -= need
+            hist[seq.seq_id] = stream[:pos]
+            take.append(seq)
+        if not take:
+            return
+        self._draft_prefill(lane, take, history=hist)
+        for seq in take:
+            if not seq.draft_blocks:
+                continue
+            lane.draft_tables[seq.slot] = 0
+            lane.draft_tables[seq.slot, :len(seq.draft_blocks)] = \
+                np.asarray(seq.draft_blocks, np.int32)
+            mark("spec_draft_catchup", seq=seq.seq_id,
+                 pos=int(lane.pos[seq.slot]))
 
     def poison(self, err: BaseException) -> None:
         """Slice death: fail everything queued and in flight with the
@@ -1469,8 +1850,17 @@ class ContinuousDecodeScheduler:
         req = seq.req
         seq.blocks = blocks
         seq.pos = len(seq.fed)
-        seq.generated.append(tok0)
-        seq.n_gen += 1
+        if seq.carry is not None:
+            # speculative pending-carry resume: the pending token was
+            # drawn (on a spec PRNG lane) and counted BEFORE the
+            # preemption — restore it instead of consuming the
+            # admission draw, keeping the resumed stream's draws
+            # token-for-token with an uninterrupted run
+            tok0 = seq.carry
+            seq.carry = None
+        else:
+            seq.generated.append(tok0)
+            seq.n_gen += 1
         self._note_first_token(req)
         self._emit_tokens(seq)
         self._admitted_rows += 1
@@ -1489,11 +1879,16 @@ class ContinuousDecodeScheduler:
             self._cache_insert(lane, seq)
             lane.pool.free_blocks(seq.blocks, owner=_owner_key(lane.key))
             seq.blocks = []
+            self._free_draft_blocks(lane, seq)
             self._retire_seq(lane, seq)
             return
         lane.seqs[slot] = seq
         lane.tables[slot] = 0
         lane.tables[slot, :len(blocks)] = blocks
+        if lane.draft_tables is not None:
+            lane.draft_tables[slot] = 0
+            lane.draft_tables[slot, :len(seq.draft_blocks)] = \
+                seq.draft_blocks
         lane.pos[slot] = seq.pos
         lane.tok[slot] = tok0
         lane.n_gen[slot] = seq.n_gen
@@ -1517,8 +1912,14 @@ class ContinuousDecodeScheduler:
             seq = lane.seqs[slot]
             if seq is None:
                 continue
-            horizon = int(lane.pos[slot]) + min(self.burst_tokens,
-                                                max(1, seq.remaining))
+            grow = min(self.burst_tokens, max(1, seq.remaining))
+            if lane.draft_gen is not None:
+                # a spec round writes pos..pos+K on BOTH lanes no
+                # matter how much of it survives rejection (truncation
+                # and rollback are host bookkeeping), so the horizon
+                # covers K+1 positions even near the quota edge
+                grow = max(grow, self.spec_tokens + 1)
+            horizon = int(lane.pos[slot]) + grow
             while seq.slot is not None:
                 delta = lane.pool.blocks_for(horizon) - len(seq.blocks)
                 if delta <= 0:
@@ -1543,6 +1944,26 @@ class ContinuousDecodeScheduler:
                             f"holds {lane.pool.total_blocks}"))
                     break
                 self._preempt(victim)
+            if (lane.draft_pool is not None and seq.slot is not None
+                    and seq.draft_blocks):
+                dhorizon = int(lane.pos[slot]) + self.spec_tokens + 1
+                delta = (lane.draft_pool.blocks_for(dhorizon)
+                         - len(seq.draft_blocks))
+                if delta > 0:
+                    got = lane.draft_pool.alloc(
+                        delta, owner=_owner_key(lane.key))
+                    if got is None:
+                        # defensive (the draft pool is sized for every
+                        # slot at full context): drop draft coverage —
+                        # the lane serves this row through plain bursts
+                        self._free_draft_blocks(lane, seq)
+                        lane.draft_tables[slot] = 0
+                        mark("spec_draft_grow_failed", seq=seq.seq_id)
+                    else:
+                        start = len(seq.draft_blocks)
+                        seq.draft_blocks.extend(got)
+                        lane.draft_tables[slot,
+                                          start:start + len(got)] = got
 
     def _pick_victim(self, pool: PagedKVCachePool) -> Optional[_Seq]:
         """Deterministic preemption policy: among every active sequence
@@ -1569,9 +1990,23 @@ class ContinuousDecodeScheduler:
         self._cache_insert(lane, seq)
         lane.pool.free_blocks(seq.blocks, owner=_owner_key(lane.key))
         seq.blocks = []
-        seq.fed = np.concatenate(
-            [seq.req.prompt[seq.row].astype(np.int32),
-             np.asarray(seq.generated, np.int32)])
+        self._free_draft_blocks(lane, seq)
+        if lane.draft_gen is not None and seq.n_gen > 0:
+            # speculative pending-carry (see _Seq.carry): re-prefill
+            # everything EXCEPT the pending token and restore it at
+            # re-admission without a fresh draw. Safe under plain-burst
+            # fallback too: feeding the carry through a decode step
+            # draws the same fold on the same lane as the admission
+            # redraw would (prefill ≡ decode-chain equivalence), so the
+            # tokens agree either way.
+            seq.carry = int(seq.generated[-1])
+            seq.fed = np.concatenate(
+                [seq.req.prompt[seq.row].astype(np.int32),
+                 np.asarray(seq.generated[:-1], np.int32)])
+        else:
+            seq.fed = np.concatenate(
+                [seq.req.prompt[seq.row].astype(np.int32),
+                 np.asarray(seq.generated, np.int32)])
         seq.slot = None
         seq.preemptions += 1
         seq.t_queued = time.perf_counter()
@@ -1595,6 +2030,7 @@ class ContinuousDecodeScheduler:
                     err: BaseException) -> None:
         lane.pool.free_blocks(seq.blocks, owner=_owner_key(lane.key))
         seq.blocks = []
+        self._free_draft_blocks(lane, seq)
         if seq.slot is not None:
             lane.clear_slot(seq.slot)
             seq.slot = None
@@ -1725,6 +2161,218 @@ class ContinuousDecodeScheduler:
         done_f[sel] = np.asarray(done2)[:n]  # dl4j-lint: disable=hot-path-host-sync
         return ys_f, tok_f, pos_f, ng_f, done_f
 
+    # ----------------------------------------------- speculative rounds
+
+    def _draft_tiers(self, lane: _Lane) -> List[int]:
+        """The draft lane's pow2 block-tier ladder (mirror of
+        :meth:`_burst_tiers` over the draft pool's per-sequence max)."""
+        tiers, t = [], 1
+        while t < lane.draft_mb:
+            tiers.append(t)
+            t *= 2
+        tiers.append(lane.draft_mb)
+        return tiers
+
+    def _draft_tier_for(self, lane: _Lane) -> int:
+        need = 1
+        for seq in lane.active():
+            need = max(need, len(seq.draft_blocks))
+        for t in self._draft_tiers(lane):
+            if need <= t:
+                return t
+        return lane.draft_mb
+
+    def _spec_rows_ladder(self) -> List[int]:
+        """The slot buckets a speculative round can dispatch at: the
+        slot ladder truncated at the bucket covering spec_max_rows
+        (wider batches fall back to plain bursts, so warming wider spec
+        shapes would be wasted compiles)."""
+        cap = bucket_for(self.spec_max_rows, self._slot_ladder)
+        return [r for r in self._slot_ladder if r <= cap]
+
+    def _spec_eligible(self, lane: _Lane) -> bool:
+        """Run a speculative round iff the lane has a draft, the active
+        batch is narrow enough that the verify forward's K× extra token
+        compute rides free (past ``spec_max_rows`` speculation costs
+        throughput for no latency win — fall back), every active row
+        has draft-lane KV coverage, and no row is close enough to
+        max_context that the round's K+1 writes would run off the
+        table."""
+        if not self.speculative or lane.draft_gen is None:
+            return False
+        act = lane.active()
+        if not act or len(act) > self.spec_max_rows:
+            return False
+        k = self.spec_tokens
+        ctx = lane.gen.max_context()
+        for s in act:
+            if not s.draft_blocks:
+                return False
+            if int(lane.pos[s.slot]) + k + 1 > ctx:
+                return False
+        return True
+
+    def _dispatch_spec_round(self, lane: _Lane, params):
+        """One speculative round over the lane's active rows: the draft
+        program proposes K tokens on the DRAFT lane, the target
+        verifies all of them in ONE forward fused with the exact
+        rejection sampler, and the host truncates/retires — two device
+        round-trips total instead of K. Returns the same full-slot outs
+        tuple :meth:`_retire` consumes (ys is [slots, K+1]: up to K
+        accepted proposals plus the correction/bonus token). KV
+        "rollback" past rejected positions is host ``pos`` bookkeeping
+        only: both lanes' stale writes sit beyond the rolled-back pos
+        and the next round's writes cover them before any causal mask
+        can attend them — per-token quantized scales make that
+        re-scatter bit-identical (the PR-14 invariant), so no device
+        copy is ever needed."""
+        pool, dpool = lane.pool, lane.draft_pool
+        gen, dgen = lane.gen, lane.draft_gen
+        k = self.spec_tokens
+        active = [i for i, s in enumerate(lane.seqs) if s is not None]
+        tier = self._tier_for(lane)
+        dtier = self._draft_tier_for(lane)
+        rows = bucket_for(max(1, len(active)), self._slot_ladder)
+        if self._burst_hook is not None:
+            self._burst_hook(lane.key, self._bursts)
+        n = min(len(active), rows)
+        sel = active[:n]
+        tables = np.zeros((rows, tier), np.int32)
+        tables[:n] = lane.tables[sel, :tier]
+        dtables = np.zeros((rows, dtier), np.int32)
+        dtables[:n] = lane.draft_tables[sel, :dtier]
+        pos = np.zeros(rows, np.int32)
+        pos[:n] = lane.pos[sel]
+        tok = np.zeros(rows, np.int32)
+        tok[:n] = lane.tok[sel]
+        n_gen = np.zeros(rows, np.int32)
+        n_gen[:n] = lane.n_gen[sel]
+        keys = np.zeros((rows, 2), lane.keys.dtype)
+        keys[:n] = lane.keys[sel]
+        temp = np.zeros(rows, np.float32)
+        temp[:n] = lane.temp[sel]
+        top_k = np.zeros(rows, np.int32)
+        top_k[:n] = lane.top_k[sel]
+        top_p = np.zeros(rows, np.float32)
+        top_p[:n] = lane.top_p[sel]
+        live = np.zeros(rows, bool)
+        live[:n] = True
+        dparams = self._draft_params(lane)
+        dp = dgen.spec_draft_program(rows, k, dtier, dpool.num_blocks,
+                                     self.block_size)
+        fresh_d = note_dispatch(
+            lane.draft_net, ("gen_spec_draft", "sched", rows, k, dtier))
+        t0 = time.perf_counter()
+        with span("compile" if fresh_d else "inference",
+                  path="continuous_spec_draft", slots=rows, k=k,
+                  tier=dtier, rows=n):
+            dpools, props, q = dp(dparams, dpool.layers, dtables, pos,
+                                  tok, n_gen, keys, temp, top_k, top_p,
+                                  live)
+            # SANCTIONED SYNC (1 of 2 per spec round): wait out the
+            # draft burst so dl4j_spec_draft_latency_ms and the
+            # spec_draft span measure the draft alone — the
+            # amortization bound the accept-rate dial is read against
+            # dl4j-lint: disable=hot-path-host-sync
+            jax.block_until_ready(props)
+        dpool.set_layers(dpools)
+        t1 = time.perf_counter()
+        vp = gen.spec_verify_program(rows, k, tier, pool.num_blocks,
+                                     self.block_size)
+        fresh_v = note_dispatch(
+            lane.net, ("gen_spec_verify", "sched", rows, k, tier))
+        with span("compile" if fresh_v else "inference",
+                  path="continuous_spec_verify", slots=rows, k=k,
+                  tier=tier, rows=n):
+            pools, out_d, acc_d = vp(params, pool.layers, tables, pos,
+                                     tok, props, q, n_gen, keys, temp,
+                                     top_k, top_p, live)
+            # SANCTIONED SYNC (2 of 2): the round's output tokens and
+            # accept lengths must reach the host to retire rows / emit
+            # chunks — one [rows, K+1] + [rows] fetch per round
+            # dl4j-lint: disable=hot-path-host-sync
+            out = np.asarray(out_d)
+            acc = np.asarray(acc_d)  # dl4j-lint: disable=hot-path-host-sync
+        pool.set_layers(pools)
+        t2 = time.perf_counter()
+        # ---- host phase (the "rollback"): truncate each row's round
+        # at its EOS/max-new and advance the shared pos/tok/n_gen
+        # clocks by the surviving length only
+        ys_f = np.zeros((lane.slots, k + 1), np.int32)
+        tok_f = lane.tok.copy()
+        pos_f = lane.pos.copy()
+        ng_f = lane.n_gen.copy()
+        done_f = lane.done.copy()
+        accepted = 0
+        for j, slot in enumerate(sel):
+            seq = lane.seqs[slot]
+            a = int(acc[j])
+            toks = [int(t) for t in out[j, :a + 1]]
+            budget = seq.req.max_new - int(lane.n_gen[slot])
+            if len(toks) > budget:
+                toks = toks[:budget]
+            eos = seq.req.eos
+            if eos is not None and eos in toks:
+                toks = toks[:toks.index(eos) + 1]
+            e = len(toks)
+            accepted += min(a, e)
+            ys_f[slot, :e] = toks
+            tok_f[slot] = toks[-1]
+            pos_f[slot] = int(lane.pos[slot]) + e
+            ng_f[slot] = int(lane.n_gen[slot]) + e
+            done_f[slot] = (ng_f[slot] >= seq.req.max_new
+                            or (eos is not None and toks[-1] == eos))
+        t3 = time.perf_counter()
+        proposed = n * k
+        rejected = proposed - accepted
+        reg = get_registry()
+        owner = _owner_key(lane.key)
+        reg.counter(SPEC_PROPOSED_TOKENS_COUNTER,
+                    "Draft tokens proposed to speculative verify "
+                    "rounds", model=owner).inc(proposed)
+        reg.counter(SPEC_ACCEPTED_TOKENS_COUNTER,
+                    "Proposed draft tokens the target's rejection "
+                    "sampler accepted", model=owner).inc(accepted)
+        reg.counter(SPEC_REJECTED_TOKENS_COUNTER,
+                    "Proposed draft tokens rejected (the residual "
+                    "correction token replaces the first)",
+                    model=owner).inc(rejected)
+        reg.histogram(SPEC_DRAFT_LATENCY_HISTOGRAM,
+                      "Speculative draft-burst dispatch latency (K+1 "
+                      "chained draft steps, one scan)"
+                      ).observe((t1 - t0) * 1e3)
+        with self._lock:
+            self._spec_rounds += 1
+            self._spec_proposed += proposed
+            self._spec_accepted += accepted
+            self._spec_rejected += rejected
+            rate = self._spec_accepted / max(1, self._spec_proposed)
+            self._bursts += 1
+        reg.gauge(SPEC_ACCEPT_RATE_GAUGE,
+                  "Running speculative acceptance rate (accepted / "
+                  "proposed) — the speedup dial; its prior is the "
+                  "draft's quality-gate greedy-match rate",
+                  model=owner).set(rate)
+        reg.counter(SCHED_BURSTS_COUNTER,
+                    "Fixed-K decode bursts dispatched").inc()
+        reg.histogram(SCHED_BURST_LATENCY_HISTOGRAM,
+                      "Decode burst dispatch latency (K steps, one "
+                      "scan)").observe((t2 - t0) * 1e3)
+        self._last_burst = (t0, (t2 - t0) * 1e3, rows, tier, n)
+        if reqtrace.request_tracer() is not None:
+            for seq in lane.active():
+                tr = seq.req.trace
+                reqtrace.record_span(tr, "spec_draft", to_origin_us(t0),
+                                     (t1 - t0) * 1e6, k=k, rows=n,
+                                     seq=seq.seq_id)
+                reqtrace.record_span(tr, "spec_verify",
+                                     to_origin_us(t1), (t2 - t1) * 1e6,
+                                     k=k, rows=n, seq=seq.seq_id)
+                reqtrace.record_span(tr, "spec_rollback",
+                                     to_origin_us(t2), (t3 - t2) * 1e6,
+                                     seq=seq.seq_id)
+        return ys_f, tok_f, pos_f, ng_f, done_f
+
     def _retire(self, lane: _Lane, outs) -> None:
         ys, tok, pos, n_gen, done = outs
         for slot in range(lane.slots):
@@ -1747,6 +2395,7 @@ class ContinuousDecodeScheduler:
                 lane.pool.free_blocks(seq.blocks,
                                       owner=_owner_key(lane.key))
                 seq.blocks = []
+                self._free_draft_blocks(lane, seq)
                 lane.clear_slot(slot)
                 seq.slot = None
                 self._retire_seq(lane, seq)
@@ -1767,6 +2416,7 @@ class ContinuousDecodeScheduler:
                 continue
             lane.pool.free_blocks(seq.blocks, owner=_owner_key(lane.key))
             seq.blocks = []
+            self._free_draft_blocks(lane, seq)
             lane.clear_slot(slot)
             seq.slot = None
             self._fail_seq(seq, self._typed(err, seq))
@@ -1845,6 +2495,7 @@ class ContinuousDecodeScheduler:
                     lane.pool.free_blocks(s.blocks,
                                           owner=_owner_key(lane.key))
                     s.blocks = []
+                    self._free_draft_blocks(lane, s)
                     lane.clear_slot(slot)
                     s.slot = None
 
@@ -1874,6 +2525,7 @@ class ContinuousDecodeScheduler:
                 lane.pool.free_blocks(seq.blocks,
                                       owner=_owner_key(lane.key))
                 seq.blocks = []
+                self._free_draft_blocks(lane, seq)
                 lane.clear_slot(slot)
                 seq.slot = None
                 if seq.req not in failed and not seq.req.future.done():

@@ -122,7 +122,7 @@ class ModelVersion:
     consumes."""
 
     def __init__(self, name: str, version: int, net=None,
-                 path: Optional[str] = None):
+                 path: Optional[str] = None, draft=None):
         if net is None and path is None:
             raise ValueError("a version needs a net or a checkpoint path")
         self.name = name
@@ -131,6 +131,19 @@ class ModelVersion:
         self.state = STATE_STAGED
         self.warmed = False
         self._net = net
+        # draft/target pairing for speculative decoding: a net, or the
+        # "self" sentinel (int8 self-speculation — quantize(net) built
+        # lazily on first draft() call), or None (unpaired; a
+        # speculative scheduler then self-quantizes on its own).  The
+        # pairing is a VERSION attribute: session pins and canary
+        # routing resolve the version first, so a mid-stream cutover
+        # can never switch a stream's draft out from under it.
+        self._draft_src = draft
+        self._draft_net = None
+        # quality-gate verdict persisted at deploy time (satellite of
+        # PR 17): accuracy_gate's greedy_match_rate doubles as the
+        # speculation acceptance-rate prior surfaced in stats().
+        self.quality: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
         self._nbytes: Optional[int] = None
         # devkey -> (params, states); managed under the REGISTRY lock
@@ -206,6 +219,35 @@ class ModelVersion:
             from deeplearning4j_tpu.nn.generate import build_generator
             gen = net._registry_gen = build_generator(net)
         return gen
+
+    def draft(self):
+        """The paired draft net for speculative decoding, or None.
+
+        ``deploy(draft="self")`` (alias ``"quantize"``) resolves lazily
+        to ``quantize(self.net(), "int8")`` — the PR-14 zero-training
+        draft whose measured greedy-match rate IS the acceptance prior.
+        An explicit net is returned as-is. Built once and cached; the
+        scheduler holds the resolved net for the lane's lifetime."""
+        with self._lock:
+            if self._draft_net is not None:
+                return self._draft_net
+            src = self._draft_src
+            if src is None:
+                return None
+            if isinstance(src, str):
+                if src not in ("self", "quantize"):
+                    raise ValueError(
+                        f"unknown draft sentinel {src!r}: expected "
+                        "'self'/'quantize' or a net")
+                from deeplearning4j_tpu.nn.quantize import quantize
+                if self._net is None:
+                    self._net = self._load()
+                if self._net.params is None:
+                    self._net.init()
+                self._draft_net = quantize(self._net, "int8")
+            else:
+                self._draft_net = src
+            return self._draft_net
 
     def p99_ms(self) -> Optional[float]:
         if not self.latencies:
@@ -539,7 +581,7 @@ class ModelRegistry:
                canary_min_requests: Optional[int] = None,
                canary_max_error_rate: Optional[float] = None,
                canary_p99_factor: Optional[float] = None,
-               quality_gate=None) -> int:
+               quality_gate=None, draft=None) -> int:
         """Zero-downtime deploy of a new version.
 
         Order of operations is the whole contract: (1) integrity-check
@@ -555,10 +597,20 @@ class ModelRegistry:
         cut over (or enter canary — ``canary_fraction > 0`` keeps the
         old version active and routes the fraction to the new one until
         :meth:`promote` or the watch rolls it back). Returns the new
-        version number."""
+        version number.
+
+        ``draft=`` pairs a speculative-decoding draft with this version
+        — a net, or ``"self"``/``"quantize"`` for lazy int8
+        self-speculation. The pairing rides the version record through
+        canary, rollback, and session pinning: a stream keeps its
+        resolved draft for its whole life."""
         entry = self.entry(name)
         if net is None and path is None:
             raise ValueError("deploy needs a net or a checkpoint path")
+        if isinstance(draft, str) and draft not in ("self", "quantize"):
+            raise ValueError(
+                f"deploy draft={draft!r}: expected 'self'/'quantize' "
+                "or a net")
         if path is not None and not os.path.isdir(path):
             problems = verify_model_file(path)
             if problems:
@@ -571,7 +623,7 @@ class ModelRegistry:
             new_v = self._next_version(entry) if version is None else int(version)
             if new_v in entry.versions:
                 raise ValueError(f"model {name!r} already has version {new_v}")
-            ver = ModelVersion(name, new_v, net=net, path=path)
+            ver = ModelVersion(name, new_v, net=net, path=path, draft=draft)
             entry.versions[new_v] = ver
         try:
             ver.net()  # force the load (and its integrity check) now
@@ -617,7 +669,12 @@ class ModelRegistry:
         accuracy-harness verdict dict (``{"passed": bool, ...}``) or a
         bare bool. Fail → the candidate is removed (it never served),
         the outcome is counted like a canary auto-rollback, and
-        :class:`QualityGateFailed` carries the numbers."""
+        :class:`QualityGateFailed` carries the numbers. Pass or fail,
+        the verdict is persisted on the version record — the
+        ``greedy_match_rate`` a quantized candidate measured here is
+        exactly the speculative-decoding acceptance-rate prior, so
+        discarding it would throw away the one number capacity planning
+        for speculation needs (stats()/healthz surface it)."""
         with self._lock:
             stable_ver = (entry.versions.get(entry.active)
                           if entry.active is not None else None)
@@ -625,6 +682,9 @@ class ModelRegistry:
         verdict = quality_gate(stable_net, ver.net())
         passed = (bool(verdict.get("passed", False))
                   if isinstance(verdict, dict) else bool(verdict))
+        with self._lock:
+            ver.quality = (dict(verdict) if isinstance(verdict, dict)
+                           else {"passed": passed})
         if passed:
             return
         with self._lock:
@@ -926,6 +986,8 @@ class ModelRegistry:
             for name, entry in sorted(self._models.items()):
                 versions = {}
                 for v, ver in sorted(entry.versions.items()):
+                    gmr = (ver.quality.get("greedy_match_rate")
+                           if ver.quality else None)
                     versions[str(v)] = {
                         "state": ver.state,
                         "warmed": ver.warmed,
@@ -937,6 +999,12 @@ class ModelRegistry:
                         "p99_ms": (None if ver.p99_ms() is None
                                    else round(ver.p99_ms(), 3)),
                         "pinned_devices": len(ver.pins),
+                        "quality_gate": ver.quality,
+                        # accuracy_gate's greedy-match rate = the prior
+                        # on speculative-decoding acceptance rate
+                        "spec_accept_prior": (None if gmr is None
+                                              else round(float(gmr), 4)),
+                        "draft_paired": ver._draft_src is not None,
                     }
                 active = entry.versions.get(entry.active) \
                     if entry.active is not None else None

@@ -537,6 +537,17 @@ KNOWN_DL4J_METRICS = {
     "dl4j_attr_prefill_tokens_total",
     "dl4j_attr_decode_tokens_total",
     "dl4j_attr_queue_ms_total",
+    # speculative decoding (nn/generate.py spec programs +
+    # serving/continuous.py fused draft/verify rounds, label model=):
+    # proposed/accepted/rejected count draft tokens through the exact
+    # rejection sampler; accept_rate is the running acceptance rate
+    # (compare against the deploy-time quality-gate prior in
+    # registry stats); draft_latency_ms is the draft-phase wall time
+    "dl4j_spec_proposed_tokens_total",
+    "dl4j_spec_accepted_tokens_total",
+    "dl4j_spec_rejected_tokens_total",
+    "dl4j_spec_accept_rate",
+    "dl4j_spec_draft_latency_ms",
 }
 
 

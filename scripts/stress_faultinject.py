@@ -30,8 +30,9 @@ battery (seeded NaN/raise schedules, flaky-broker schedules,
 torn-write counting, replica/model poison sequences, burst-kill
 windows, mesh-shrink drills, and the composed ChaosSchedule event
 clock, the prefix-cache refcount/COW/eviction accounting drill, and
-the slice-kill / slice-drill schedules, and the quantized-pool ×
-prefix-cache accounting drill — sections 1–10) twice per seed
+the slice-kill / slice-drill schedules, the quantized-pool ×
+prefix-cache accounting drill, and the speculative-decoding dual-lane
+(draft + target) accounting drill — sections 1–11) twice per seed
 across rotating seeds and compares the full event logs bit-for-bit.
 It runs in milliseconds with no subprocess and no jax compute, so the
 tier-1 sweep carries it on every run; the full mode is the pre-merge /
@@ -359,6 +360,107 @@ def _scenario_log(seed: int) -> str:
     events.append(f"qkv final free={qpool.free_count}/{qpool.total_blocks} "
                   f"shared={qpool.shared_count()} "
                   f"leaked={qpool.total_blocks - qpool.free_count}")
+
+    # 11) speculative-decoding dual-lane accounting (the PR-17
+    # scheduler's contract): every stream holds blocks on TWO pools —
+    # the target lane and the draft lane — and every lifecycle edge
+    # (admit, spec-round growth, preempt, rollback, burst-kill, retire)
+    # must free or carry BOTH sides in lockstep. A draft-lane leak is
+    # invisible to the target pool's audit, which is why the draft pool
+    # is dedicated; this drill replays a seeded battery of those edges
+    # and pins that both pools drain to fully-free, that a draft-side
+    # double free raises, and that an admit whose draft alloc falls
+    # short degrades to a DRAFT-LESS row (spec fallback) instead of
+    # failing the admission — speculation is an accelerator, never a
+    # correctness dependency.
+    tpool = PagedKVCachePool(13, 4, num_layers=1, num_heads=1, head_dim=8,
+                             name=f"spec_t{seed}")
+    dpool = PagedKVCachePool(9, 4, num_layers=1, num_heads=1, head_dim=8,
+                             name=f"spec_d{seed}", quant="int8")
+    rngS = np.random.default_rng(seed * 157 + 11)
+    k_spec = int(rngS.integers(2, 5))
+    # live rows: (target_blocks, draft_blocks or [], pos)
+    slive: List[list] = []
+    for i in range(28):
+        op = int(rngS.integers(0, 5))
+        if op == 0:
+            t = int(rngS.integers(2, 10))
+            tb = tpool.alloc(tpool.blocks_for(t))
+            if tb is None:
+                events.append(f"spec {i} admit-short")
+                continue
+            db = dpool.alloc(dpool.blocks_for(t))
+            if db is None:
+                # draft-less admission: the row serves on plain bursts
+                events.append(f"spec {i} admit draftless pos={t}")
+                slive.append([tb, [], t])
+            else:
+                events.append(f"spec {i} admit tb={tb} db={db}")
+                slive.append([tb, db, t])
+        elif op == 1 and slive:
+            # spec round: grow BOTH lanes to pos + k_spec + 1, accept a
+            # seeded prefix, roll pos forward (rollback of rejected
+            # positions is pure pos bookkeeping — stale KV is
+            # overwritten by the next round's writes, never freed)
+            row = slive[int(rngS.integers(0, len(slive)))]
+            tb, db, pos = row
+            if not db:
+                events.append(f"spec {i} round skipped (draftless)")
+                continue
+            horizon = pos + k_spec + 1
+            ok = True
+            for pool_, blocks in ((tpool, tb), (dpool, db)):
+                delta = pool_.blocks_for(horizon) - len(blocks)
+                if delta > 0:
+                    got = pool_.alloc(delta)
+                    if got is None:
+                        ok = False
+                        break
+                    blocks.extend(got)
+            if not ok:
+                events.append(f"spec {i} grow-short pos={pos}")
+                continue
+            a = int(rngS.integers(0, k_spec + 1))
+            row[2] = pos + a + 1
+            events.append(f"spec {i} round a={a} pos={row[2]} "
+                          f"tb={len(tb)} db={len(db)}")
+        elif op == 2 and slive:
+            # preempt: target KV may ship or drop; the draft lane NEVER
+            # ships (it re-prefills on resume) — both freed here
+            tb, db, pos = slive.pop(int(rngS.integers(0, len(slive))))
+            tpool.free_blocks(tb)
+            if db:
+                dpool.free_blocks(db)
+            events.append(f"spec {i} preempt tfree={tpool.free_count} "
+                          f"dfree={dpool.free_count}")
+        elif op == 3 and slive:
+            # burst-kill: every row's BOTH lanes freed
+            for tb, db, _ in slive:
+                tpool.free_blocks(tb)
+                if db:
+                    dpool.free_blocks(db)
+            slive.clear()
+            events.append(f"spec {i} burstkill tfree={tpool.free_count} "
+                          f"dfree={dpool.free_count}")
+        elif slive:
+            tb, db, pos = slive.pop(int(rngS.integers(0, len(slive))))
+            tpool.free_blocks(tb)
+            if db:
+                dpool.free_blocks(db)
+            events.append(f"spec {i} retire pos={pos}")
+    for tb, db, _ in slive:
+        tpool.free_blocks(tb)
+        if db:
+            dpool.free_blocks(db)
+    try:
+        dpool.free_blocks([1])
+        events.append("spec draft double-free MISSED")
+    except RuntimeError:
+        events.append("spec draft double-free caught")
+    events.append(f"spec final t={tpool.free_count}/{tpool.total_blocks} "
+                  f"d={dpool.free_count}/{dpool.total_blocks} "
+                  f"tleak={tpool.total_blocks - tpool.free_count} "
+                  f"dleak={dpool.total_blocks - dpool.free_count}")
     return "\n".join(events)
 
 
@@ -454,7 +556,7 @@ def run_chaos(runs: int, seed_base: int, n_requests: int = 14,
     """The `chaos` section: run the composed drill TWICE per seed in
     fresh subprocesses across rotating seeds; fail on any invariant
     violation OR any outcome drift between the two replays of one
-    seed — the same determinism contract sections 1–10 pin for the
+    seed — the same determinism contract sections 1–11 pin for the
     injectors, applied to the whole composed drill."""
     bad = 0
     for i in range(runs):

@@ -16,3 +16,14 @@ def loop_reuse(seed, n):
     for _ in range(n):
         out.append(jax.random.uniform(key, ()))   # REUSE each iteration
     return out
+
+
+def spec_shared_lane(seed, n_gen):
+    # speculative decoding's dual clock done WRONG: the draft proposal
+    # draw and the accept-test uniforms ride the SAME lane key — the
+    # verifier's u is correlated with the proposal it judges
+    key = jax.random.PRNGKey(seed)
+    lane = jax.random.fold_in(key, n_gen)
+    props = jax.random.gumbel(lane, (4,))
+    u = jax.random.uniform(lane, (4,))       # REUSE: same lane as props
+    return props, u

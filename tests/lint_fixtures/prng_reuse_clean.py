@@ -25,3 +25,19 @@ def branch_draws(seed, flag):
     if flag:
         return jax.random.normal(key, ())
     return jax.random.gumbel(key, ())    # exclusive arms: one draw
+
+
+SPEC_DRAFT_SALT = 101
+SPEC_ACCEPT_SALT = 102
+
+
+def spec_disjoint_lanes(seed, n_gen):
+    # speculative decoding's dual clock done RIGHT: draft proposals and
+    # accept-test uniforms fold on DISJOINT salted lanes, each draw on
+    # a fresh fold of its own lane
+    key = jax.random.PRNGKey(seed)
+    dkey = jax.random.fold_in(key, SPEC_DRAFT_SALT)
+    akey = jax.random.fold_in(key, SPEC_ACCEPT_SALT)
+    props = jax.random.gumbel(jax.random.fold_in(dkey, n_gen), (4,))
+    u = jax.random.uniform(jax.random.fold_in(akey, n_gen), (4,))
+    return props, u

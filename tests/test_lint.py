@@ -59,7 +59,7 @@ _CORPUS = {
     "hot-path-host-sync": (5, "sync"),
     "recompile-hazard": (4, ""),
     "typed-wire-raise": (2, "typed"),
-    "prng-reuse": (2, "consumed more than once"),
+    "prng-reuse": (3, "consumed more than once"),
 }
 
 

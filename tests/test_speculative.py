@@ -1,0 +1,345 @@
+"""Speculative decoding tests (nn/generate.py spec programs +
+serving/continuous.py fused draft/verify rounds + registry pairing).
+
+The ISSUE-17 battery: greedy output token-for-token vs
+``generate_eager`` with int8 self-speculation; seeded-sampled replay
+determinism; preempt/resume mid-speculation parity (greedy AND
+sampled) with zero leaked blocks on BOTH the draft and target KV
+lanes; the BurstKill mid-speculation recovery contract; the
+zero-steady-state-compile assertion across the accept ladder via
+``dl4j_jit_cache_miss_total`` plus the spec_max_rows fallback; the
+``deploy(draft=...)`` pairing + persisted quality-gate verdict
+(the acceptance prior) in registry ``stats()``; and the
+``dl4j_spec_*`` schema pinning.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.faultinject import BurstKill
+from deeplearning4j_tpu.models.zoo.transformer import gpt
+from deeplearning4j_tpu.nn.generate import generate_eager
+from deeplearning4j_tpu.nn.quantize import make_quality_gate, quantize
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.serving.continuous import (
+    ContinuousDecodeScheduler,
+    DecodeBurstError,
+)
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+
+VOCAB = 11
+
+
+def _tiny_gpt(seed=0, **kw):
+    return gpt(vocab_size=VOCAB, d_model=16, n_layers=2, num_heads=2,
+               max_len=32, compute_dtype="float32", learning_rate=0.01,
+               seed=seed, **kw).init()
+
+
+@pytest.fixture
+def fresh_registry():
+    prev = monitor.set_registry(monitor.MetricsRegistry())
+    yield monitor.get_registry()
+    monitor.set_registry(prev)
+
+
+def _sched(net, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("burst_tokens", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("start", False)
+    kw.setdefault("speculative", True)
+    kw.setdefault("spec_tokens", 3)
+    kw.setdefault("spec_max_rows", 4)
+    return ContinuousDecodeScheduler(net=net, **kw)
+
+
+def _drive(sched, futures, max_steps=200):
+    for _ in range(max_steps):
+        if all(f.done() for f in futures):
+            return
+        sched.step()
+    raise AssertionError(
+        f"schedule did not converge in {max_steps} steps; "
+        f"events={list(sched.events)}")
+
+
+def _drain_audit(st):
+    """Both lanes fully free after drain — a draft-side leak must be
+    attributable to the draft pool, so it is audited separately."""
+    assert st["pool"]["blocks_free"] == st["pool"]["blocks_total"]
+    assert st["draft_pool"]["blocks_free"] == st["draft_pool"]["blocks_total"]
+
+
+# --------------------------------------------------------- exactness
+
+def test_spec_greedy_matches_eager(rng):
+    """Greedy speculative output is token-for-token equal to
+    ``generate_eager`` — the rejection sampler accepts exactly the
+    positions where the int8 draft's argmax agrees with the target's,
+    and the correction token IS the target argmax, so speculation can
+    only change latency, never a token."""
+    net = _tiny_gpt()
+    s = _sched(net)
+    prompts = [rng.integers(0, VOCAB, (1, t)) for t in (5, 3, 6)]
+    futs = [s.submit(p, 10) for p in prompts]
+    _drive(s, futs)
+    for f, p in zip(futs, prompts):
+        assert np.array_equal(f.result(0), generate_eager(net, p, 10))
+    st = s.stats()
+    spec = st["speculative"]
+    assert spec["enabled"] and spec["rounds"] > 0
+    assert spec["proposed_tokens"] > 0
+    assert spec["proposed_tokens"] == (spec["accepted_tokens"]
+                                       + spec["rejected_tokens"])
+    assert 0.0 <= spec["accept_rate"] <= 1.0
+    _drain_audit(st)
+
+
+def test_spec_greedy_eos_and_budget(rng):
+    """EOS inside an accepted run is honored at its first occurrence
+    (tokens past it in the same round are discarded) and the max_new
+    budget truncates an over-long accepted run — both identical to the
+    eager oracle's stopping behaviour."""
+    net = _tiny_gpt()
+    s = _sched(net)
+    prompts = [rng.integers(0, VOCAB, (2, 4)), rng.integers(0, VOCAB, (1, 5))]
+    futs = [s.submit(prompts[0], 12, eos_token=3),
+            s.submit(prompts[1], 7, eos_token=3)]
+    _drive(s, futs)
+    assert np.array_equal(futs[0].result(0),
+                          generate_eager(net, prompts[0], 12, eos_token=3))
+    assert np.array_equal(futs[1].result(0),
+                          generate_eager(net, prompts[1], 7, eos_token=3))
+    _drain_audit(s.stats())
+
+
+def test_spec_sampled_deterministic_replay(rng):
+    """Seeded sampled speculation replays token-for-token: every draw
+    rides a (row key, salted lane, fold index) clock, so the same
+    seeds yield the same accepted/corrected tokens run over run."""
+    net = _tiny_gpt()
+    prompts = [rng.integers(0, VOCAB, (1, t)) for t in (4, 6)]
+
+    def run():
+        s = _sched(net)
+        futs = [s.submit(p, 9, temperature=0.8, top_k=5, seed=11 + i)
+                for i, p in enumerate(prompts)]
+        _drive(s, futs)
+        st = s.stats()
+        _drain_audit(st)
+        return [f.result(0) for f in futs], st["speculative"]
+
+    outs1, spec1 = run()
+    outs2, spec2 = run()
+    for a, b in zip(outs1, outs2):
+        assert np.array_equal(a, b)
+    # the whole round schedule replays: same acceptance accounting
+    assert spec1["accepted_tokens"] == spec2["accepted_tokens"]
+    assert spec1["rejected_tokens"] == spec2["rejected_tokens"]
+
+
+# --------------------------------------------- preempt/resume parity
+
+@pytest.mark.parametrize("temperature", [0.0, 1.0])
+def test_spec_preempt_resume_matches_uninterrupted(rng, temperature):
+    """A sequence preempted MID-SPECULATION (tiny target pool) and
+    resumed must be token-for-token identical to an uninterrupted run
+    — greedy and seeded-sampled. The pending-carry resume keeps the
+    per-row fold clock aligned, and BOTH lanes drain leak-free."""
+    net = _tiny_gpt()
+    prompts = [rng.integers(0, VOCAB, (1, 5)) for _ in range(3)]
+
+    def run(num_blocks):
+        kw = {} if num_blocks is None else {"num_blocks": num_blocks}
+        s = _sched(net, **kw)
+        futs = [s.submit(p, 10, temperature=temperature, top_k=4,
+                         seed=21 + i)
+                for i, p in enumerate(prompts)]
+        _drive(s, futs)
+        st = s.stats()
+        _drain_audit(st)
+        return [f.result(0) for f in futs], st
+
+    outs_tiny, st_tiny = run(9)       # 8 usable blocks: must preempt
+    outs_big, _ = run(None)           # roomy pool: uninterrupted
+    assert st_tiny["preemptions"] > 0
+    for a, b in zip(outs_tiny, outs_big):
+        assert np.array_equal(a, b)
+    if temperature == 0.0:
+        for out, p in zip(outs_tiny, prompts):
+            assert np.array_equal(out, generate_eager(net, p, 10))
+
+
+# ------------------------------------------------------- fault domain
+
+@pytest.mark.faultinject
+def test_spec_burstkill_mid_speculation(rng, fresh_registry):
+    """BurstKill firing inside a speculative round: the riding futures
+    fail typed (DecodeBurstError), BOTH lanes free every block, and
+    the scheduler keeps serving — exact output — afterwards."""
+    net = _tiny_gpt()
+    kill = BurstKill(after=1, failures=1)  # 2nd dispatch dies: n_gen>0
+    s = _sched(net, burst_hook=kill)
+    p1 = rng.integers(0, VOCAB, (2, 5))
+    f1 = s.submit(p1, 10)
+    for _ in range(60):
+        if f1.done():
+            break
+        s.step()
+    with pytest.raises(DecodeBurstError):
+        f1.result(0)
+    st = s.stats()
+    _drain_audit(st)
+    # the lane recovers: a fresh request still decodes exactly
+    p2 = rng.integers(0, VOCAB, (1, 4))
+    f2 = s.submit(p2, 8)
+    _drive(s, [f2])
+    assert np.array_equal(f2.result(0), generate_eager(net, p2, 8))
+    _drain_audit(s.stats())
+    assert fresh_registry.family_total(monitor.FAULT_EVENTS_COUNTER) >= 1
+
+
+# ------------------------------------- compile discipline + fallback
+
+def test_spec_zero_steady_state_compiles_and_fallback(rng, fresh_registry):
+    """After ``warmup()`` a mixed greedy/sampled speculative workload
+    compiles NOTHING (accept lengths never shape a program — the
+    accept ladder is host truncation), and offered load past
+    spec_max_rows falls back to plain bursts instead of speculating."""
+    net = _tiny_gpt()
+    s = _sched(net, spec_max_rows=2)
+    s.warmup([3, 5], 8)
+    miss0 = fresh_registry.family_total(monitor.JIT_CACHE_MISS_COUNTER)
+    # the two short rows retire first: the opening 4-row phase is over
+    # the cap (fallback plain bursts), the 2-row tail speculates
+    futs = [s.submit(rng.integers(0, VOCAB, (1, t)), mn,
+                     temperature=temp, seed=i)
+            for i, (t, mn, temp) in enumerate(
+                [(3, 3, 0.0), (5, 8, 0.7), (3, 3, 0.0), (5, 8, 0.9)])]
+    _drive(s, futs)
+    assert fresh_registry.family_total(
+        monitor.JIT_CACHE_MISS_COUNTER) == miss0
+    st = s.stats()
+    spec = st["speculative"]
+    assert spec["rounds"] > 0
+    assert spec["fallbacks"] > 0  # 4 live rows > spec_max_rows=2
+    _drain_audit(st)
+
+
+# ------------------------------------------------- registry pairing
+
+def test_registry_draft_pairing_and_quality_prior(rng, fresh_registry):
+    """deploy(draft=...) is a version attribute: 'self' resolves
+    lazily to the int8 quantized net (cached), the persisted
+    quality-gate verdict surfaces greedy_match_rate in stats() as the
+    speculation acceptance prior, and a bogus sentinel is rejected."""
+    net1, net2 = _tiny_gpt(seed=1), _tiny_gpt(seed=1)
+    reg = ModelRegistry()
+    reg.register("lm", net=net1)
+    with pytest.raises(ValueError):
+        reg.deploy("lm", net=net2, draft="turbo")
+    v2 = reg.deploy("lm", net=net2, draft="self",
+                    quality_gate=make_quality_gate(min_greedy_match=0.0,
+                                                   max_eval_delta=1e9))
+    ver = reg.version("lm", v2)
+    dn = ver.draft()
+    assert dn is not None and dn is ver.draft()  # resolved once, cached
+    assert dn is not ver.net()  # a distinct (quantized) net
+    # satellite fix: the gate verdict is PERSISTED, not discarded
+    assert ver.quality is not None and "greedy_match_rate" in ver.quality
+    st = reg.stats()["lm"]["versions"][str(v2)]
+    assert st["spec_accept_prior"] == pytest.approx(
+        ver.quality["greedy_match_rate"], abs=1e-4)
+    assert st["draft_paired"] is True
+    assert st["quality_gate"]["passed"] is True
+    # v1 never ran a gate and paired no draft
+    st1 = reg.stats()["lm"]["versions"]["1"]
+    assert st1["spec_accept_prior"] is None
+    assert st1["draft_paired"] is False
+    assert reg.version("lm", 1).draft() is None
+
+
+def test_engine_speculative_registry_pairing_serves_exact(
+        rng, fresh_registry):
+    """End-to-end: a speculative engine over a registry whose active
+    version pairs draft='self' serves greedy output token-for-token
+    equal to the eager oracle, and a mid-stream deploy never switches
+    a session's draft (the lane pins the resolved version)."""
+    net1 = _tiny_gpt(seed=2)
+    reg = ModelRegistry()
+    reg.register("lm", net=net1)
+    v2 = reg.deploy("lm", net=_tiny_gpt(seed=2), draft="self")
+    assert reg.active_version("lm") == v2
+    eng = ParallelInference(registry=reg, replicas=1, continuous=True,
+                            decode_slots=4, decode_burst=4,
+                            kv_block_size=4, speculative=True,
+                            spec_tokens=3)
+    try:
+        p = rng.integers(0, VOCAB, (1, 5))
+        got = eng.submit_generate(p, 8, model="lm").result(30)
+        assert np.array_equal(
+            got, generate_eager(reg.version("lm", v2).net(), p, 8))
+        sched = eng._scheduler
+        st = sched.stats()
+        assert st["speculative"]["rounds"] > 0
+        _drain_audit(st)
+    finally:
+        eng.shutdown()
+
+
+def test_engine_speculative_net_mode_knobs(rng):
+    """Net-mode knob threading: speculative=/spec_tokens=/draft_net=
+    reach the scheduler, and an explicit draft net overrides the int8
+    self-speculation default. speculative= without continuous= is a
+    build-time error."""
+    net = _tiny_gpt(seed=3)
+    with pytest.raises(ValueError):
+        ParallelInference(net, replicas=1, speculative=True, start=False)
+    eng = ParallelInference(net, replicas=1, continuous=True,
+                            decode_slots=4, decode_burst=4,
+                            kv_block_size=4, speculative=True,
+                            spec_tokens=2, draft_net=quantize(net, "int8"))
+    try:
+        p = rng.integers(0, VOCAB, (1, 4))
+        assert np.array_equal(
+            eng.submit_generate(p, 8).result(30),
+            generate_eager(net, p, 8))
+        assert eng._scheduler.stats()["speculative"]["k"] == 2
+    finally:
+        eng.shutdown()
+
+
+# --------------------------------------------------------- telemetry
+
+def test_spec_metrics_schema_and_emission(rng, fresh_registry):
+    """The dl4j_spec_* family is pinned in monitor constants AND the
+    telemetry-schema gate, and a speculative run actually emits it
+    with conserving counts."""
+    import importlib.util
+    import os
+    spec_path = os.path.join(os.path.dirname(__file__), os.pardir,
+                             "scripts", "check_telemetry_schema.py")
+    spec = importlib.util.spec_from_file_location("cts", spec_path)
+    cts = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cts)
+    names = {monitor.SPEC_PROPOSED_TOKENS_COUNTER,
+             monitor.SPEC_ACCEPTED_TOKENS_COUNTER,
+             monitor.SPEC_REJECTED_TOKENS_COUNTER,
+             monitor.SPEC_ACCEPT_RATE_GAUGE,
+             monitor.SPEC_DRAFT_LATENCY_HISTOGRAM}
+    assert names <= cts.KNOWN_DL4J_METRICS
+    net = _tiny_gpt()
+    s = _sched(net)
+    f = s.submit(rng.integers(0, VOCAB, (1, 5)), 10)
+    _drive(s, [f])
+    reg = fresh_registry
+    proposed = reg.family_total(monitor.SPEC_PROPOSED_TOKENS_COUNTER)
+    accepted = reg.family_total(monitor.SPEC_ACCEPTED_TOKENS_COUNTER)
+    rejected = reg.family_total(monitor.SPEC_REJECTED_TOKENS_COUNTER)
+    assert proposed > 0 and proposed == accepted + rejected
+    text = reg.prometheus_text()
+    for name in names:
+        assert name in text
+    assert not cts.validate_known_metrics(text)
