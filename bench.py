@@ -1959,6 +1959,239 @@ def bench_router_slo():
     }
 
 
+def bench_router_saturation():
+    """The PR-18 data plane, measured at its three layers:
+
+    (a) FRAMING — v3 (u32+JSON+npz, one frame per stream delta) vs v4
+    (binary prologue + raw ``memoryview`` segments, one COALESCED frame
+    per retiring burst): bytes and pack+unpack CPU per token delta, and
+    MB/s through the shipped-KV tensor path;
+
+    (b) TRANSPORT — the same token-delta workload over real TCP:
+    thread-per-connection broker + per-stream legacy chunks vs the
+    selectors reactor + coalesced v4 burst frames. The deltas/sec ratio
+    is the headline (``vs_baseline``) — the whole point of the fleet's
+    new wire;
+
+    (c) ROUTER CORE — open-loop ramp against in-process echo endpoints
+    (zero engine time, so the dispatch plane itself is the limit): the
+    achieved-rps knee, submit-call admission p99 at the knee, and the
+    journal-gauge walk cost with 10k registered streams."""
+    import time
+    from concurrent.futures import Future
+
+    from deeplearning4j_tpu.serving import InferenceRouter
+    from deeplearning4j_tpu.serving import wire
+    from deeplearning4j_tpu.serving.endpoint import EngineEndpoint
+    from deeplearning4j_tpu.streaming.broker import (TcpBroker,
+                                                     TcpBrokerServer)
+
+    rng = np.random.default_rng(0)
+    burst = 32            # streams retiring per scheduler tick
+    corrs = [f"c{i:04d}" for i in range(burst)]
+    toks = [rng.integers(0, 32000, 2).astype(np.int64) for _ in corrs]
+
+    # ---- (a) framing micro-bench: CPU + bytes per token delta
+    def time_per_delta(fn, iters=400):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (time.perf_counter() - t0) / (iters * burst)
+
+    def legacy_burst():
+        for c, t, off in zip(corrs, toks, range(burst)):
+            hdr, body = wire.unpack_reply(wire.pack_chunk(c, off, t))
+            assert wire.is_chunk(hdr)
+
+    def v4_burst():
+        evs = wire.decode_reply_events(wire.pack_chunks_v4(
+            [(c, off, t) for c, t, off in zip(corrs, toks, range(burst))]))
+        assert len(evs) == burst
+
+    legacy_bytes = sum(len(wire.pack_chunk(c, 0, t))
+                       for c, t in zip(corrs, toks)) / burst
+    v4_bytes = len(wire.pack_chunks_v4(
+        [(c, 0, t) for c, t in zip(corrs, toks)])) / burst
+    legacy_us = time_per_delta(legacy_burst) * 1e6
+    v4_us = time_per_delta(v4_burst) * 1e6
+
+    kv = rng.standard_normal((2, 2, 4, 128, 64)).astype(np.float32)
+
+    def time_kv(pack, unpack, iters=30):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            unpack(pack("c", "kv", kv))
+        return kv.nbytes * iters / (time.perf_counter() - t0) / 2**20
+
+    kv_legacy_mbs = time_kv(wire.pack_tensor_chunk,
+                            lambda p: wire.unpack_reply(p))
+    kv_v4_mbs = time_kv(wire.pack_tensor_chunk_v4,
+                        lambda p: wire.unpack_frame_v4(p))
+
+    # ---- (b) transport chunk plane over real TCP
+    def transport_deltas_per_sec(reactor, coalesce, n_deltas=4096):
+        srv = TcpBrokerServer(reactor=reactor).start()
+        try:
+            host, port = srv.address
+            pub = TcpBroker(host, port, max_retries=1)
+            sub = TcpBroker(host, port, max_retries=1)
+            frames = []
+            if coalesce:
+                for i in range(0, n_deltas, burst):
+                    frames.append(wire.pack_chunks_v4(
+                        [(corrs[j], i, toks[j]) for j in range(burst)]))
+            else:
+                frames = [wire.pack_chunk(corrs[i % burst], i,
+                                          toks[i % burst])
+                          for i in range(n_deltas)]
+            got = 0
+            t0 = time.perf_counter()
+            for f in frames:
+                pub.publish("chunks", f)
+            while got < n_deltas:
+                msg = sub.consume("chunks", timeout=5.0)
+                if msg is None:
+                    break
+                for ev in wire.decode_reply_events(msg):
+                    got += 1
+            dt = time.perf_counter() - t0
+            pub.close()
+            sub.close()
+            return got / dt, got
+        finally:
+            srv.stop()
+
+    threaded_dps, threaded_got = transport_deltas_per_sec(
+        reactor=False, coalesce=False)
+    reactor_dps, reactor_got = transport_deltas_per_sec(
+        reactor=True, coalesce=True)
+
+    # ---- (c) router core: open-loop ramp on echo endpoints
+    class _EchoEndpoint(EngineEndpoint):
+        def __init__(self, name):
+            self.name = name
+            self.open = []
+
+        def submit(self, x, timeout_s=None, model=None, version=None,
+                   session=None):
+            fut = Future()
+            fut.set_result(x)
+            return fut
+
+        def submit_generate(self, prompt_ids, max_new_tokens,
+                            timeout_s=None, model=None, version=None,
+                            session=None, on_tokens=None, prefix=None,
+                            **kwargs):
+            fut = Future()
+            if on_tokens is not None:
+                on_tokens(0, np.arange(max_new_tokens, dtype=np.int64))
+            full = np.concatenate(
+                [np.asarray(prompt_ids, np.int64).reshape(1, -1),
+                 np.arange(max_new_tokens, dtype=np.int64).reshape(1, -1)],
+                axis=1)
+            self.open.append((fut, full))
+            return fut
+
+        def stats(self):
+            return {}
+
+        def alive(self):
+            return True
+
+        @property
+        def last_seen(self):
+            return time.monotonic()
+
+    router = InferenceRouter(per_try_timeout_s=5.0)
+    eps = [_EchoEndpoint(f"echo-{i}") for i in range(4)]
+    for ep in eps:
+        router.add_endpoint(ep)
+    x = np.zeros((1, 8), np.float32)
+    try:
+        for _ in range(200):                       # warm the hot path
+            router.submit(x).result(5)
+        knee = {"rps": 0.0, "p99_admit_us": None}
+        levels = []
+        rate = 2000.0
+        while rate <= 128000.0:
+            n = max(200, int(rate * 0.25))
+            admits = []
+            futs = []
+            t0 = time.perf_counter()
+            for _ in range(n):
+                ta = time.perf_counter()
+                futs.append(router.submit(x))
+                admits.append(time.perf_counter() - ta)
+            dt = time.perf_counter() - t0
+            for f in futs:
+                f.result(5)
+            achieved = n / dt
+            admits.sort()
+            p99_us = admits[min(n - 1, int(n * 0.99))] * 1e6
+            levels.append({"offered_rps": int(rate),
+                           "achieved_rps": round(achieved, 0),
+                           "p99_admit_us": round(p99_us, 1)})
+            if achieved > knee["rps"]:
+                knee = {"rps": round(achieved, 0),
+                        "p99_admit_us": round(p99_us, 1)}
+            if achieved < rate * 0.7:
+                break                              # past the knee
+            rate *= 2.0
+        # journal overhead with 10k live journaled streams
+        sfuts = []
+        for i in range(10000):
+            sfuts.append(router.submit_generate(
+                np.array([[1, 2, 3]]), 4, session=f"s{i}",
+                on_tokens=lambda off, t: None))
+        t0 = time.perf_counter()
+        router._journal_gauge()
+        journal_walk_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        snap = router.fleet_snapshot()
+        snapshot_ms = (time.perf_counter() - t0) * 1e3
+        n_streams = len(router._streams)
+        for ep in eps:
+            for fut, full in ep.open:
+                fut.set_result(full)
+            ep.open.clear()
+        for f in sfuts:
+            f.result(30)
+    finally:
+        router.close()
+
+    return {
+        "metric": "router_saturation_chunk_plane_speedup",
+        "value": round(reactor_dps / max(1e-9, threaded_dps), 2),
+        "unit": "x (reactor+v4 coalesced vs threaded+legacy, deltas/sec)",
+        "framing": {
+            "legacy_us_per_delta": round(legacy_us, 3),
+            "v4_us_per_delta": round(v4_us, 3),
+            "cpu_speedup": round(legacy_us / max(1e-9, v4_us), 2),
+            "legacy_bytes_per_delta": round(legacy_bytes, 1),
+            "v4_bytes_per_delta": round(v4_bytes, 1),
+            "kv_legacy_mb_s": round(kv_legacy_mbs, 1),
+            "kv_v4_mb_s": round(kv_v4_mbs, 1),
+            "kv_speedup": round(kv_v4_mbs / max(1e-9, kv_legacy_mbs), 2),
+        },
+        "transport": {
+            "threaded_legacy_deltas_per_sec": round(threaded_dps, 0),
+            "reactor_v4_deltas_per_sec": round(reactor_dps, 0),
+            "threaded_delivered": threaded_got,
+            "reactor_delivered": reactor_got,
+        },
+        "router_core": {
+            "knee_rps": knee["rps"],
+            "p99_admit_us_at_knee": knee["p99_admit_us"],
+            "levels": levels,
+            "journal_walk_ms_10k_streams": round(journal_walk_ms, 3),
+            "fleet_snapshot_ms_10k_streams": round(snapshot_ms, 3),
+            "journaled_streams": n_streams,
+            "loop_lag_ms": snap.get("loop_lag_ms"),
+        },
+        "vs_baseline": round(reactor_dps / max(1e-9, threaded_dps), 2),
+    }
+
+
 def bench_multi_model():
     """Multi-model serving from ONE chip (serving/registry.py +
     registry-mode ParallelInference): 8 models behind one engine.
@@ -2749,6 +2982,7 @@ def main():
                      ("prefix_cache", bench_prefix_cache),
                      ("durable_decode", bench_durable_decode),
                      ("router_slo", bench_router_slo),
+                     ("router_saturation", bench_router_saturation),
                      ("multi_model", bench_multi_model),
                      ("mesh_train", bench_mesh_train),
                      ("mesh_serving", bench_mesh_serving),

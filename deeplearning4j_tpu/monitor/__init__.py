@@ -185,6 +185,21 @@ ROUTER_QUEUE_WAIT_HISTOGRAM = "dl4j_router_queue_wait_ms"
 ROUTER_LATENCY_HISTOGRAM = "dl4j_router_latency_ms"
 ROUTER_ENDPOINT_HEALTHY_GAUGE = "dl4j_router_endpoint_healthy"
 
+# Wire/transport data plane (serving/wire.py + serving/router.py's
+# event-loop core): frames and payload bytes packed for the broker
+# channel labeled by framing (``transport="legacy"`` = u32+JSON+npz,
+# ``transport="v4"`` = binary prologue + raw zero-copy tensor
+# segments), per-stream token deltas that rode a COALESCED v4 burst
+# frame instead of a frame of their own (the one-frame-per-burst-
+# per-endpoint collapse), and the router reactor's timer-loop lag —
+# how late hedge timers / wedge ticks / journal refreshes fire behind
+# their shared single-thread clock (the event-loop backpressure
+# signal; surfaced in ``fleet_snapshot()``).
+WIRE_FRAMES_COUNTER = "dl4j_wire_frames_total"
+WIRE_BYTES_COUNTER = "dl4j_wire_bytes_total"
+WIRE_COALESCED_COUNTER = "dl4j_wire_coalesced_chunks_total"
+ROUTER_LOOP_LAG_HISTOGRAM = "dl4j_router_loop_lag_ms"
+
 # Durable decode streams (the stream/journal/migration plane):
 # incremental token chunks emitted by the decode path (the
 # ``on_tokens`` seam — scheduler bursts, whole-burst terminal deltas),

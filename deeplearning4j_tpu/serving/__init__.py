@@ -62,6 +62,7 @@ from deeplearning4j_tpu.serving.router import (  # noqa: F401
 )
 from deeplearning4j_tpu.serving.wire import (  # noqa: F401
     WIRE_VERSION,
+    WireFrameError,
     WireVersionError,
 )
 from deeplearning4j_tpu.serving.worker import EngineWorker  # noqa: F401

@@ -469,6 +469,11 @@ class ContinuousDecodeScheduler:
         self._slot_ladder = pow2_ladder(self.slots)
         self._burst_hook = burst_hook
         self._on_resolve = on_resolve
+        # burst-coalesced emit (wire v4): while a retire pass has the
+        # batch open, deltas for callbacks MARKED with a ``burst_sink``
+        # attribute accumulate here and flush as ONE call per sink —
+        # one frame per endpoint per retiring burst, not one per stream
+        self._emit_batch: Optional[List[Tuple[Any, int, np.ndarray]]] = None
         # cross-request prefix caching: one PrefixCache per pool spec,
         # lane-keyed radix roots inside (a canary never matches the
         # stable's cache). Off by default: the cache RETAINS blocks
@@ -1835,10 +1840,17 @@ class ContinuousDecodeScheduler:
         traced = req.trace is not None and \
             reqtrace.request_tracer() is not None
         t0c = time.perf_counter() if traced else 0.0
-        try:
-            req.on_tokens(off, np.asarray(new, np.int64))
-        except BaseException as e:
-            mark("stream_callback_error", error=type(e).__name__)
+        cb = req.on_tokens
+        if self._emit_batch is not None \
+                and getattr(cb, "burst_sink", None) is not None:
+            # coalescing-marked callback inside a retire pass: defer to
+            # the burst flush (one sink call per endpoint per burst)
+            self._emit_batch.append((cb, off, np.asarray(new, np.int64)))
+        else:
+            try:
+                cb(off, np.asarray(new, np.int64))
+            except BaseException as e:
+                mark("stream_callback_error", error=type(e).__name__)
         if traced:
             reqtrace.record_span(
                 req.trace, "chunk_deliver", to_origin_us(t0c),
@@ -2375,30 +2387,53 @@ class ContinuousDecodeScheduler:
 
     def _retire(self, lane: _Lane, outs) -> None:
         ys, tok, pos, n_gen, done = outs
-        for slot in range(lane.slots):
-            seq = lane.seqs[slot]
-            if seq is None:
-                continue
-            emitted = int(n_gen[slot]) - int(lane.n_gen[slot])
-            if emitted > 0:
-                seq.generated.extend(int(t) for t in ys[slot, :emitted])
-                seq.n_gen = int(n_gen[slot])
-                seq.pos = int(pos[slot])
-                self._attr_note(_owner_key(lane.key), decode=emitted)
-                self._note_first_token(seq.req)
-                self._emit_tokens(seq)
-            lane.tok[slot] = tok[slot]
-            lane.pos[slot] = pos[slot]
-            lane.n_gen[slot] = n_gen[slot]
-            if bool(done[slot]):
-                self._cache_insert(lane, seq)
-                lane.pool.free_blocks(seq.blocks,
-                                      owner=_owner_key(lane.key))
-                seq.blocks = []
-                self._free_draft_blocks(lane, seq)
-                lane.clear_slot(slot)
-                seq.slot = None
-                self._retire_seq(lane, seq)
+        finished: List[_Seq] = []
+        self._emit_batch = []
+        try:
+            for slot in range(lane.slots):
+                seq = lane.seqs[slot]
+                if seq is None:
+                    continue
+                emitted = int(n_gen[slot]) - int(lane.n_gen[slot])
+                if emitted > 0:
+                    seq.generated.extend(int(t) for t in ys[slot, :emitted])
+                    seq.n_gen = int(n_gen[slot])
+                    seq.pos = int(pos[slot])
+                    self._attr_note(_owner_key(lane.key), decode=emitted)
+                    self._note_first_token(seq.req)
+                    self._emit_tokens(seq)
+                lane.tok[slot] = tok[slot]
+                lane.pos[slot] = pos[slot]
+                lane.n_gen[slot] = n_gen[slot]
+                if bool(done[slot]):
+                    self._cache_insert(lane, seq)
+                    lane.pool.free_blocks(seq.blocks,
+                                          owner=_owner_key(lane.key))
+                    seq.blocks = []
+                    self._free_draft_blocks(lane, seq)
+                    lane.clear_slot(slot)
+                    seq.slot = None
+                    finished.append(seq)
+        finally:
+            # flush STRICTLY before any terminal resolution below: a
+            # coalesced last chunk must reach the endpoint before the
+            # terminal reply resolves (and un-registers) its stream
+            self._flush_emit_batch()
+        for seq in finished:
+            self._retire_seq(lane, seq)
+
+    def _flush_emit_batch(self) -> None:
+        batch, self._emit_batch = self._emit_batch, None
+        if not batch:
+            return
+        by_sink: Dict[Any, List[Tuple[Any, int, np.ndarray]]] = {}
+        for cb, off, toks in batch:
+            by_sink.setdefault(cb.burst_sink, []).append((cb, off, toks))
+        for sink, entries in by_sink.items():
+            try:
+                sink(entries)
+            except BaseException as e:
+                mark("stream_callback_error", error=type(e).__name__)
 
     def _burst_failed(self, lane: _Lane, err: BaseException) -> None:
         """A burst dispatch died: every sequence that was riding it

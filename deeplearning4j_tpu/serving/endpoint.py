@@ -185,11 +185,22 @@ class RemoteEndpoint(EngineEndpoint):
                  broker_factory=None,
                  request_timeout_s: float = 10.0,
                  heartbeat_timeout_s: float = 2.0,
-                 poll_s: float = 0.05):
+                 poll_s: float = 0.05,
+                 wire_version: int = wire.WIRE_VERSION):
+        """``wire_version`` pins the wire ceiling this endpoint SPEAKS
+        (the rolling-upgrade test seam: pin 3 and the endpoint encodes
+        every request exactly like a pre-v4 router build would). The
+        EFFECTIVE framing per request is ``min(ours, peer's)`` where the
+        peer's ceiling arrives on its heartbeats (``wire`` field; absent
+        = pre-v4 = 3) — before the first heartbeat the endpoint stays
+        conservatively legacy, so a rolling upgrade never sends a v4
+        frame to a worker that cannot serve it."""
         self.name = name or service
         self.service = service
         self.request_timeout = float(request_timeout_s)
         self.heartbeat_timeout = float(heartbeat_timeout_s)
+        self.wire_version = int(wire_version)
+        self._peer_wire: Optional[int] = None
         self._poll = float(poll_s)
         self._broker = broker
         self._reply_broker = broker_factory() if broker_factory else broker
@@ -212,6 +223,13 @@ class RemoteEndpoint(EngineEndpoint):
 
     # ------------------------------------------------------------ submit
 
+    def negotiated_wire(self) -> int:
+        """The wire version this endpoint may SEND: ``min`` of its own
+        ceiling and the peer's advertised one (3 until a heartbeat
+        proves better — conservative through a rolling upgrade)."""
+        peer = self._peer_wire if self._peer_wire is not None else 3
+        return min(self.wire_version, peer)
+
     def _submit_frame(self, kind: str, x: np.ndarray,
                       gen: Optional[Dict[str, Any]],
                       timeout_s: Optional[float],
@@ -219,7 +237,12 @@ class RemoteEndpoint(EngineEndpoint):
                       version: Optional[int] = None,
                       session: Optional[str] = None,
                       on_tokens=None,
-                      tensors=None) -> "Future[np.ndarray]":
+                      tensors=None,
+                      send_tensors=None,
+                      wire_v: Optional[int] = None) -> "Future[np.ndarray]":
+        """``tensors`` is the INBOUND assembly dict (tagged chunks land
+        there — prefill kv); ``send_tensors`` are OUTBOUND extra tensor
+        segments, only meaningful when the negotiated framing is v4."""
         if self._closed:
             raise EndpointError(f"endpoint {self.name} is closed")
         corr = f"{self.name}-{next(self._ids)}"
@@ -233,14 +256,20 @@ class RemoteEndpoint(EngineEndpoint):
         # propagate the caller's request-trace context across the wire
         # (thread-local → optional header field; older workers ignore it)
         tctx = reqtrace.current_trace()
+        neg = self.negotiated_wire() if wire_v is None else int(wire_v)
+        trace = None if tctx is None else tctx.wire()
+        if neg >= 4:
+            payload = wire.pack_request_v4(
+                corr, self.reply_topic, kind, x, gen, model=model,
+                version=version, session=session, trace=trace,
+                tensors=send_tensors)
+        else:
+            payload = wire.pack_request(
+                corr, self.reply_topic, kind, x, gen, model=model,
+                version=version, session=session, trace=trace,
+                wire_v=neg)
         try:
-            self._broker.publish(
-                self.service + wire.REQ_SUFFIX,
-                wire.pack_request(corr, self.reply_topic, kind, x, gen,
-                                  model=model, version=version,
-                                  session=session,
-                                  trace=None if tctx is None
-                                  else tctx.wire()))
+            self._broker.publish(self.service + wire.REQ_SUFFIX, payload)
         except BaseException as e:
             with self._lock:
                 self._pending.pop(corr, None)
@@ -267,26 +296,45 @@ class RemoteEndpoint(EngineEndpoint):
             # chunk also refreshes this request's silence deadline, so
             # a long stream never times out WHILE it is progressing
             gen["stream"] = True
-        if prefix is not None:
-            # resume request: the worker re-prefills prompt + prefix
-            # and continues the stream's PRNG clock (no re-generation
-            # of delivered tokens, no re-emission of their offsets)
-            gen["prefix"] = [int(t) for t in np.asarray(prefix).reshape(-1)]
+        neg = self.negotiated_wire()
+        send_tensors: Optional[Dict[str, np.ndarray]] = None
         body = np.asarray(prompt_ids)
+        if prefix is not None:
+            if neg >= 4:
+                # v4: the resume prefix is a raw binary segment
+                send_tensors = {"prefix": np.asarray(prefix, np.int64)}
+            else:
+                # resume request: the worker re-prefills prompt + prefix
+                # and continues the stream's PRNG clock (no
+                # re-generation of delivered tokens, no re-emission of
+                # their offsets)
+                gen["prefix"] = [int(t) for t in
+                                 np.asarray(prefix).reshape(-1)]
         if kv_state is not None:
-            # v3 handoff: the shipped KV tensor IS the frame body; the
-            # (small) prompt ids and last-token logits ride the header
-            # (json floats round-trip f32 exactly — the handoff stays
-            # bit-exact across the wire)
             gen["kv"] = True
-            gen["prompt"] = [int(t) for t in
-                             np.asarray(prompt_ids).reshape(-1)]
-            gen["logits"] = [float(v) for v in
-                             np.asarray(kv_state["logits"]).reshape(-1)]
-            body = np.asarray(kv_state["kv"])
+            if neg >= 4:
+                # v4 handoff: prompt stays the x segment; the shipped
+                # KV and logits ride raw segments — byte-exact by
+                # construction, no npz container, no JSON float lists
+                body = np.asarray(prompt_ids, np.int32).reshape(1, -1)
+                send_tensors = dict(send_tensors or {})
+                send_tensors["kv"] = np.asarray(kv_state["kv"])
+                send_tensors["logits"] = np.asarray(
+                    kv_state["logits"], np.float32).reshape(1, -1)
+            else:
+                # v3 handoff: the shipped KV tensor IS the frame body;
+                # the (small) prompt ids and last-token logits ride the
+                # header (json floats round-trip f32 exactly — the
+                # handoff stays bit-exact across the wire)
+                gen["prompt"] = [int(t) for t in
+                                 np.asarray(prompt_ids).reshape(-1)]
+                gen["logits"] = [float(v) for v in
+                                 np.asarray(kv_state["logits"]).reshape(-1)]
+                body = np.asarray(kv_state["kv"])
         return self._submit_frame(wire.KIND_GENERATE,
                                   body, gen, timeout_s,
-                                  model, version, session, on_tokens)
+                                  model, version, session, on_tokens,
+                                  send_tensors=send_tensors, wire_v=neg)
 
     def submit_prefill(self, prompt_ids, timeout_s=None):
         """Wire-v3 disaggregated prefill: the worker replies with one
@@ -337,64 +385,77 @@ class RemoteEndpoint(EngineEndpoint):
                 msg = None
             if msg is not None:
                 try:
-                    header, result = wire.unpack_reply(msg)
+                    # framing-agnostic: one legacy frame is one event; a
+                    # coalesced v4 burst frame fans out into several
+                    events = wire.decode_reply_events(msg)
+                except wire.WireFrameError as e:
+                    logger.warning(
+                        "endpoint %s: damaged v4 frame rejected "
+                        "(WireFrameError: %s)", self.name, e)
+                    continue
                 except Exception as e:
                     logger.warning("endpoint %s: undecodable reply (%s)",
                                    self.name, e)
                     continue
-                if wire.is_chunk(header):
-                    # incremental decode chunk: deliver WITHOUT
-                    # resolving the future, and refresh the request's
-                    # silence deadline — visible progress is proof the
-                    # stream is alive, so only a stalled stream can
-                    # time out. A chunk for an already-swept request is
-                    # dropped here (the caller migrated past it).
-                    tag = wire.chunk_tag(header)
-                    with self._lock:
-                        p = self._pending.get(header.get("id"))
-                        if p is not None:
-                            self._hb_at = time.monotonic()
-                            p.deadline = time.monotonic() + p.timeout
-                            if tag is not None and p.tensors is not None \
-                                    and result is not None:
-                                # tagged tensor chunk (v3 prefill kv)
-                                p.tensors[tag] = result
-                    if tag is not None:
-                        self._sweep_expired()
-                        continue
-                    if p is not None and p.on_tokens is not None \
-                            and result is not None:
-                        try:
-                            p.on_tokens(int(header.get("off", 0)), result)
-                        except BaseException as e:
-                            logger.warning(
-                                "endpoint %s: on_tokens callback failed "
-                                "(%s: %s)", self.name, type(e).__name__, e)
-                    self._sweep_expired()
-                    continue
-                with self._lock:
-                    p = self._pending.pop(header.get("id"), None)
-                    if p is not None:
-                        self._hb_at = time.monotonic()  # proof of life
-                if p is not None and not p.future.done():
-                    if header.get("ok"):
-                        if p.tensors is not None:
-                            # v3 prefill reply: terminal logits complete
-                            # the assembled handoff state
-                            p.future.set_result(
-                                dict(p.tensors, logits=result))
-                        else:
-                            p.future.set_result(result)
-                    elif header.get("etype"):
-                        # typed engine error: reconstruct the SAME
-                        # exception class a LocalEndpoint would raise
-                        # (shed / quarantine isolation contract)
-                        p.future.set_exception(wire.typed_error(
-                            header, fallback=EndpointError))
-                    else:
-                        p.future.set_exception(EndpointError(
-                            f"{self.name}: {header.get('error')}"))
+                for ev in events:
+                    self._handle_event(ev)
             self._sweep_expired()
+
+    def _handle_event(self, ev: Dict[str, Any]) -> None:
+        kind = ev["type"]
+        if kind == "tensor":
+            # tagged tensor chunk (prefill kv): assemble WITHOUT
+            # resolving, refresh the silence deadline
+            with self._lock:
+                p = self._pending.get(ev.get("id"))
+                if p is not None:
+                    self._hb_at = time.monotonic()
+                    p.deadline = time.monotonic() + p.timeout
+                    if p.tensors is not None and ev.get("tensor") is not None:
+                        p.tensors[ev["tag"]] = ev["tensor"]
+            return
+        if kind == "chunk":
+            # incremental decode chunk: deliver WITHOUT resolving the
+            # future, and refresh the request's silence deadline —
+            # visible progress is proof the stream is alive, so only a
+            # stalled stream can time out. A chunk for an already-swept
+            # request is dropped here (the caller migrated past it).
+            with self._lock:
+                p = self._pending.get(ev.get("id"))
+                if p is not None:
+                    self._hb_at = time.monotonic()
+                    p.deadline = time.monotonic() + p.timeout
+            if p is not None and p.on_tokens is not None \
+                    and ev.get("tokens") is not None:
+                try:
+                    p.on_tokens(int(ev.get("off", 0)), ev["tokens"])
+                except BaseException as e:
+                    logger.warning(
+                        "endpoint %s: on_tokens callback failed "
+                        "(%s: %s)", self.name, type(e).__name__, e)
+            return
+        header, result = ev["header"], ev["result"]
+        with self._lock:
+            p = self._pending.pop(ev.get("id"), None)
+            if p is not None:
+                self._hb_at = time.monotonic()  # proof of life
+        if p is not None and not p.future.done():
+            if header.get("ok"):
+                if p.tensors is not None:
+                    # prefill reply: terminal logits complete the
+                    # assembled handoff state
+                    p.future.set_result(dict(p.tensors, logits=result))
+                else:
+                    p.future.set_result(result)
+            elif header.get("etype"):
+                # typed engine error: reconstruct the SAME exception
+                # class a LocalEndpoint would raise (shed / quarantine
+                # isolation contract)
+                p.future.set_exception(wire.typed_error(
+                    header, fallback=EndpointError))
+            else:
+                p.future.set_exception(EndpointError(
+                    f"{self.name}: {header.get('error')}"))
 
     def _sweep_expired(self):
         now = time.monotonic()
@@ -430,6 +491,9 @@ class RemoteEndpoint(EngineEndpoint):
                 if (not self._hb or hb.get("seq", 0) >= self._hb.get("seq", 0)
                         or hb.get("state") == wire.STATE_SERVING):
                     self._hb = hb
+                    # negotiation: the peer's wire ceiling rides its
+                    # heartbeats (absent = a pre-v4 build = 3)
+                    self._peer_wire = int(hb.get("wire", 3))
                 self._hb_at = time.monotonic()
 
     def close(self):

@@ -64,6 +64,8 @@ the engine cannot see from inside one process:
 
 from __future__ import annotations
 
+import heapq
+import logging
 import queue
 import threading
 import time
@@ -82,6 +84,7 @@ from deeplearning4j_tpu.monitor import (
     ROUTER_FAILOVERS_COUNTER,
     ROUTER_HEDGES_COUNTER,
     ROUTER_LATENCY_HISTOGRAM,
+    ROUTER_LOOP_LAG_HISTOGRAM,
     ROUTER_QUEUE_WAIT_HISTOGRAM,
     ROUTER_REQUESTS_COUNTER,
     ROUTER_RESUME_PREFIX_COUNTER,
@@ -103,6 +106,8 @@ from deeplearning4j_tpu.monitor import (
 from deeplearning4j_tpu.monitor.tracing import to_origin_us
 from deeplearning4j_tpu.serving import wire
 from deeplearning4j_tpu.serving.endpoint import EndpointError, EngineEndpoint
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 #: priority class → fraction of the deadline the completion estimate
 #: may consume before the request is shed. Interactive requests use
@@ -202,7 +207,7 @@ class _Routed:
         self.hedged = False
         self.session = session
         self.priority = priority
-        self.timer: Optional[threading.Timer] = None
+        self.timer: Optional["_TimerHandle"] = None  # armed hedge
         self.per_try_timeout = per_try_timeout
         self.model = model
         self.version = version
@@ -230,6 +235,113 @@ class _Routed:
         # admission estimate (queue-wait half): graded against observed
         # TTFT at finish — the estimator's report card series
         self.est_wait_ms: Optional[float] = None
+
+
+class _TimerHandle:
+    """A cancellable deferred call on the router loop (the surface the
+    old per-request ``threading.Timer`` exposed: ``cancel()``)."""
+
+    __slots__ = ("when", "fn", "args", "interval", "cancelled")
+
+    def __init__(self, when: float, fn, args: tuple,
+                 interval: Optional[float] = None):
+        self.when = when
+        self.fn = fn
+        self.args = args
+        self.interval = interval    # recurring period (None = one-shot)
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class _RouterLoop:
+    """The router's event loop: ONE timer thread (heap + condition)
+    runs every deferred router action — hedge timers, the wedge /
+    journal-gauge tick — instead of one ``threading.Timer`` thread per
+    request. Callbacks execute OUTSIDE the condition (the loop's lock
+    orders before nothing — the PR-15 ``lock-order`` rule pins the
+    graph acyclic as the per-timer threads collapse into this clock),
+    and each executed callback's lag behind its deadline is reported
+    through ``on_lag`` — the loop-health signal
+    (``dl4j_router_loop_lag_ms``) a saturated dispatch plane shows
+    first. The thread starts lazily on the first scheduled call."""
+
+    def __init__(self, name: str = "dl4j-tpu-router-loop", on_lag=None):
+        self._cond = threading.Condition()
+        self._heap: List[Tuple[float, int, _TimerHandle]] = []
+        self._seq = 0
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._name = name
+        self._on_lag = on_lag
+
+    def call_later(self, delay: float, fn, *args) -> _TimerHandle:
+        return self._schedule(_TimerHandle(
+            time.monotonic() + max(0.0, float(delay)), fn, args))
+
+    def call_every(self, interval: float, fn, *args) -> _TimerHandle:
+        """Recurring fixed-delay call: re-armed AFTER each run, so a
+        slow callback never stacks overlapping invocations."""
+        interval = max(1e-3, float(interval))
+        return self._schedule(_TimerHandle(
+            time.monotonic() + interval, fn, args, interval=interval))
+
+    def _schedule(self, h: _TimerHandle) -> _TimerHandle:
+        with self._cond:
+            if self._closed:
+                h.cancelled = True
+                return h
+            self._seq += 1
+            heapq.heappush(self._heap, (h.when, self._seq, h))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name=self._name)
+                self._thread.start()
+            self._cond.notify()
+        return h
+
+    def _run(self) -> None:
+        while True:
+            fire: List[_TimerHandle] = []
+            with self._cond:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                while self._heap and self._heap[0][0] <= now:
+                    h = heapq.heappop(self._heap)[2]
+                    if not h.cancelled:
+                        fire.append(h)
+                if not fire:
+                    timeout = None if not self._heap \
+                        else max(0.0, self._heap[0][0] - now)
+                    self._cond.wait(timeout)
+                    continue
+            # callbacks run OUTSIDE the condition: they may take the
+            # router/registry locks freely without creating an edge
+            # under the loop's own lock
+            for h in fire:
+                lag_ms = (time.monotonic() - h.when) * 1e3
+                if self._on_lag is not None:
+                    try:
+                        self._on_lag(lag_ms)
+                    except BaseException:
+                        pass
+                try:
+                    h.fn(*h.args)
+                except BaseException as e:
+                    logger.warning("router loop: timer callback failed "
+                                   "(%s: %s)", type(e).__name__, e)
+                if h.interval is not None and not h.cancelled:
+                    h.when = time.monotonic() + h.interval
+                    self._schedule(h)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
 
 
 class InferenceRouter:
@@ -286,6 +398,19 @@ class InferenceRouter:
         self._prefix_owners_cap = 4096
         self._streams: set = set()      # in-flight streaming _Routed
         self._closed = False
+        # the router's ONE clock: hedge timers and the wedge/journal
+        # tick share a single loop thread instead of spawning a
+        # threading.Timer per request; its lag histogram is the
+        # dispatch plane's saturation signal
+        self._loop = _RouterLoop(on_lag=self._note_loop_lag)
+        self._loop_lag_last_ms = 0.0
+        self._loop_lag_max_ms = 0.0
+        if self.wedge_timeout is not None:
+            # the watchdog also runs on the clock (not only on submit /
+            # observation): a wedged endpoint is ejected and the
+            # journal gauge stays fresh even while the caller is idle
+            self._loop.call_every(
+                min(0.25, self.wedge_timeout / 2.0), self._wedge_tick)
         for ep in endpoints or []:
             self.add_endpoint(ep)
 
@@ -334,6 +459,31 @@ class InferenceRouter:
             ROUTER_ENDPOINT_HEALTHY_GAUGE,
             "Endpoint in the router dispatch pool (1) or ejected/dead (0)",
             endpoint=name)
+
+    def _note_loop_lag(self, lag_ms: float) -> None:
+        """Executed-callback lag behind its scheduled deadline — the
+        router loop's health signal (a saturated or blocked loop shows
+        here before anything times out)."""
+        self._loop_lag_last_ms = lag_ms
+        if lag_ms > self._loop_lag_max_ms:
+            self._loop_lag_max_ms = lag_ms
+        self._reg().histogram(
+            ROUTER_LOOP_LAG_HISTOGRAM,
+            "Router event-loop timer lag: how late each executed "
+            "deferred action (hedge, wedge/journal tick) ran behind "
+            "its scheduled time").observe(lag_ms)
+
+    def _wedge_tick(self) -> None:
+        """Recurring loop tick: run the progress watchdog over every
+        endpoint and refresh the journal gauge on the shared clock."""
+        if self._closed or self.wedge_timeout is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            items = list(self._eps.values())
+        for st in items:
+            self._check_wedge(st, now)
+        self._journal_gauge()
 
     # ------------------------------------------------------------ health
 
@@ -819,9 +969,10 @@ class InferenceRouter:
             # candidate availability is checked when the timer FIRES —
             # an endpoint added after dispatch is a valid hedge target.
             # Streams never hedge: a duplicate stream would double-emit.
-            rf.timer = threading.Timer(self.hedge_after, self._hedge, (rf,))
-            rf.timer.daemon = True
-            rf.timer.start()
+            # The hedge rides the router loop: one clock, no per-request
+            # threading.Timer thread.
+            rf.timer = self._loop.call_later(self.hedge_after,
+                                             self._hedge, rf)
         return rf.future
 
     # --------------------------------------------------------- dispatch
@@ -1339,6 +1490,12 @@ class InferenceRouter:
             "shed": int(reg.family_total(ROUTER_SHED_COUNTER)),
             "hedges": int(reg.family_total(ROUTER_HEDGES_COUNTER)),
             "failovers": int(reg.family_total(ROUTER_FAILOVERS_COUNTER)),
+            # router event-loop health: lag of the last executed
+            # deferred action and the worst seen (ms)
+            "loop_lag_ms": {
+                "last": round(self._loop_lag_last_ms, 3),
+                "max": round(self._loop_lag_max_ms, 3),
+            },
         }
 
     def session_endpoint(self, session: str) -> Optional[str]:
@@ -1352,3 +1509,4 @@ class InferenceRouter:
 
     def close(self) -> None:
         self._closed = True
+        self._loop.close()

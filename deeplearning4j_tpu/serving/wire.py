@@ -35,13 +35,34 @@ EndpointError` with the message preserved.
   the ``resolved`` / scheduler ``bursts`` counters riding in the stats
   double as PROGRESS proof — a heartbeat proves liveness, the counters
   prove the worker is actually advancing its queued work.
-- v2 (``WIRE_VERSION``): decode replies may be CHUNKED — per-burst
+- v2: decode replies may be CHUNKED — per-burst
   :func:`pack_chunk` frames carry token deltas tagged with sequence
   offsets, and the terminal :func:`pack_reply` still carries the full
   payload; ``gen.prefix`` on a request makes it a RESUME (the engine
   re-prefills prompt + prefix and continues the stream's PRNG clock).
   Version skew fails typed: :func:`check_version` raises
   :class:`WireVersionError` instead of serving a newer frame garbled.
+- v4 (``WIRE_VERSION``): ZERO-COPY BINARY framing for the hot path. A
+  v4 frame opens with a struct-packed fixed prologue (magic, version,
+  frame kind, meta length, segment count), then a small JSON meta
+  block (correlation id, reply topic, request kind, model / session /
+  trace routing fields — everything the legacy header carried except
+  tensors), then length-prefixed RAW tensor segments (tag + dtype +
+  shape + contiguous bytes) written into one preallocated buffer via
+  ``memoryview`` — no npz, no base64, no per-tensor allocation churn
+  on the hot path. ``np.frombuffer`` re-materializes each segment as
+  a zero-copy (read-only) view of the received payload. The first
+  customer is the disagg shipped-KV path (:func:`pack_tensor_chunk_v4`
+  — byte-exact, dtype-exact), plus COALESCED token-chunk frames
+  (:func:`pack_chunks_v4` — ONE frame per retiring burst per endpoint
+  carrying every cotenant stream's delta, not one frame per stream).
+  npz framing stays for cold control frames and for v3 peers:
+  negotiation rides the heartbeat (``wire`` field) and the rolling
+  upgrade downgrades framing per peer instead of failing — a typed
+  :class:`WireVersionError` is reserved for frames NEWER than the
+  receiver, and a structurally damaged binary frame (truncation,
+  garbage lengths) surfaces as a typed :class:`WireFrameError`, never
+  a garbled tensor.
 
 Topic layout for a worker serving ``service``::
 
@@ -56,10 +77,13 @@ from __future__ import annotations
 
 import json
 import struct
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.monitor import (WIRE_BYTES_COUNTER,
+                                        WIRE_COALESCED_COUNTER,
+                                        WIRE_FRAMES_COUNTER, get_registry)
 from deeplearning4j_tpu.streaming.serde import (ndarray_from_bytes,
                                                 ndarray_to_bytes)
 
@@ -84,10 +108,39 @@ STATE_STOPPED = "stopped"
 #: chunk, :func:`pack_tensor_chunk`, then the terminal frame carries
 #: the last-token logits), and ``generate`` requests whose body is a
 #: shipped KV tensor (``gen.kv`` set; the prompt ids ride the header as
-#: ``gen.prompt``). A worker receiving a frame NEWER than it speaks
+#: ``gen.prompt``). v4: zero-copy binary framing — struct-packed
+#: prologue + JSON meta + length-prefixed raw tensor segments (see the
+#: module docstring); negotiated per peer via the heartbeat ``wire``
+#: field, so v3 workers keep serving legacy npz frames through a
+#: rolling upgrade. A worker receiving a frame NEWER than it speaks
 #: rejects it with a typed :class:`WireVersionError` rather than
 #: serving it garbled.
-WIRE_VERSION = 3
+WIRE_VERSION = 4
+
+#: first two bytes of every v4+ binary frame. 0xD4 can never open a
+#: legacy frame (whose first byte is the high byte of a u32 JSON-header
+#: length — a ≥3.3 GB header would exceed the transport's frame cap),
+#: so :func:`is_binary_frame` sniffs the framing unambiguously.
+#: ``streaming/broker.py`` mirrors these values for its transport-level
+#: ping header (PING_MAGIC / PING_VERSION — it sits below serving in
+#: the import graph); the pairing is test-pinned.
+WIRE_MAGIC = b"\xd4\x0a"
+
+#: v4 frame kinds (the prologue's ``kind`` byte).
+FRAME_REQUEST = 1
+FRAME_REPLY = 2
+FRAME_CHUNKS = 3   # coalesced token-chunk frame (1..n streams)
+FRAME_TENSOR = 4   # tagged tensor chunk (disagg shipped KV)
+
+#: prologue: magic (2s) + version (B) + kind (B) + meta length (I) +
+#: segment count (B).
+_PROLOGUE = struct.Struct(">2sBBIB")
+#: per-segment fixed head: tag length (B) + dtype-str length (B) +
+#: ndim (B); the shape dims (u32 each) and the u64 payload length
+#: follow, then the raw contiguous bytes.
+_SEG_HEAD = struct.Struct(">BBB")
+_U32 = struct.Struct(">I")
+_U64 = struct.Struct(">Q")
 
 
 class WireVersionError(RuntimeError):
@@ -96,16 +149,45 @@ class WireVersionError(RuntimeError):
     or drop the client's feature set."""
 
 
-def check_version(header: Dict[str, Any]) -> None:
+class WireFrameError(RuntimeError):
+    """A binary frame is structurally damaged — truncated mid-segment,
+    impossible lengths, unparseable meta. The frame is rejected TYPED
+    and whole: no partially-parsed tensor ever reaches an engine (the
+    half-written-frame chaos drill pins this)."""
+
+
+def check_version(header: Dict[str, Any],
+                  cap: Optional[int] = None) -> None:
+    """``cap`` overrides the ceiling this receiver speaks (the
+    rolling-upgrade seam: a worker pinned to v3 rejects v4 frames the
+    same typed way a real v3 build would)."""
+    limit = WIRE_VERSION if cap is None else int(cap)
     v = int(header.get("v", 1))
-    if v > WIRE_VERSION:
+    if v > limit:
         raise WireVersionError(
-            f"frame speaks wire v{v}; this worker speaks v{WIRE_VERSION}")
+            f"frame speaks wire v{v}; this worker speaks v{limit}")
+
+
+def _note_frame(nbytes: int, transport: str) -> None:
+    reg = get_registry()
+    reg.counter(WIRE_FRAMES_COUNTER,
+                "Wire frames packed for the broker channel, by framing "
+                "(legacy = u32+JSON+npz, v4 = binary prologue + raw "
+                "tensor segments)", transport=transport).inc()
+    reg.counter(WIRE_BYTES_COUNTER,
+                "Wire payload bytes packed for the broker channel, by "
+                "framing", transport=transport).inc(float(nbytes))
+
+
+def is_binary_frame(payload: bytes) -> bool:
+    return bytes(payload[:2]) == WIRE_MAGIC
 
 
 def pack_frame(header: Dict[str, Any], body: bytes = b"") -> bytes:
     h = json.dumps(header, separators=(",", ":")).encode()
-    return struct.pack(">I", len(h)) + h + body
+    out = struct.pack(">I", len(h)) + h + body
+    _note_frame(len(out), "legacy")
+    return out
 
 
 def unpack_frame(payload: bytes) -> Tuple[Dict[str, Any], bytes]:
@@ -118,21 +200,268 @@ def unpack_frame(payload: bytes) -> Tuple[Dict[str, Any], bytes]:
     return header, payload[4 + hlen:]
 
 
+# --- v4 binary framing ------------------------------------------------------
+
+def _seg_header_size(tag: bytes, arr: np.ndarray) -> int:
+    return _SEG_HEAD.size + len(tag) + len(arr.dtype.str) \
+        + 4 * arr.ndim + 8
+
+
+def pack_frame_v4(meta: Dict[str, Any],
+                  segments: Sequence[Tuple[str, np.ndarray]] = (),
+                  kind: int = FRAME_REPLY) -> bytes:
+    """One v4 binary frame: the whole frame size is computed up front,
+    ONE buffer is allocated, and every piece — prologue, meta, segment
+    headers, raw tensor bytes — is written into it through a
+    ``memoryview`` (tensor bytes via the array's own buffer: zero
+    serialization, zero intermediate copies beyond the single
+    wire-buffer write)."""
+    m = json.dumps(meta, separators=(",", ":")).encode()
+    arrs: List[Tuple[bytes, np.ndarray]] = []
+    for tag, a in segments:
+        arr = np.ascontiguousarray(a)
+        arrs.append((str(tag).encode(), arr))
+    if len(arrs) > 255:
+        raise ValueError(f"too many segments ({len(arrs)})")
+    total = _PROLOGUE.size + len(m) + sum(
+        _seg_header_size(t, a) + a.nbytes for t, a in arrs)
+    buf = bytearray(total)
+    view = memoryview(buf)
+    _PROLOGUE.pack_into(buf, 0, WIRE_MAGIC, WIRE_VERSION, int(kind),
+                        len(m), len(arrs))
+    off = _PROLOGUE.size
+    view[off:off + len(m)] = m
+    off += len(m)
+    for tag, arr in arrs:
+        dt = arr.dtype.str.encode()
+        _SEG_HEAD.pack_into(buf, off, len(tag), len(dt), arr.ndim)
+        off += _SEG_HEAD.size
+        view[off:off + len(tag)] = tag
+        off += len(tag)
+        view[off:off + len(dt)] = dt
+        off += len(dt)
+        for dim in arr.shape:
+            _U32.pack_into(buf, off, int(dim))
+            off += 4
+        _U64.pack_into(buf, off, arr.nbytes)
+        off += 8
+        if arr.nbytes:
+            view[off:off + arr.nbytes] = \
+                memoryview(arr).cast("B")  # raw bytes, no npz
+            off += arr.nbytes
+    _note_frame(total, "v4")
+    return bytes(buf)
+
+
+def unpack_frame_v4(payload: bytes
+                    ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    """Decode one v4 binary frame into (meta, {tag: tensor}). Tensors
+    are ZERO-COPY read-only views over ``payload``
+    (``np.frombuffer``). Structural damage — truncation, lengths past
+    the frame end, unparseable meta — raises the typed
+    :class:`WireFrameError`; a version byte NEWER than this build is
+    surfaced through the meta (``v``) for :func:`check_version`, so the
+    receiver can still reply typed using the frame's correlation id."""
+    mv = memoryview(payload)
+    if len(mv) < _PROLOGUE.size:
+        raise WireFrameError(
+            f"short v4 frame ({len(mv)} bytes < prologue)")
+    magic, ver, kind, mlen, nseg = _PROLOGUE.unpack_from(payload, 0)
+    if magic != WIRE_MAGIC:
+        raise WireFrameError(f"bad v4 magic {magic!r}")
+    off = _PROLOGUE.size
+    if off + mlen > len(mv):
+        raise WireFrameError("v4 meta length exceeds frame")
+    try:
+        meta = json.loads(bytes(mv[off:off + mlen]))
+    except ValueError as e:
+        raise WireFrameError(f"undecodable v4 meta: {e}") from None
+    off += mlen
+    meta.setdefault("v", int(ver))
+    meta["_kind"] = int(kind)
+    segs: Dict[str, np.ndarray] = {}
+    for _ in range(nseg):
+        if off + _SEG_HEAD.size > len(mv):
+            raise WireFrameError("truncated v4 segment header")
+        tlen, dlen, ndim = _SEG_HEAD.unpack_from(payload, off)
+        off += _SEG_HEAD.size
+        need = tlen + dlen + 4 * ndim + 8
+        if off + need > len(mv):
+            raise WireFrameError("truncated v4 segment descriptor")
+        tag = bytes(mv[off:off + tlen]).decode()
+        off += tlen
+        dt = bytes(mv[off:off + dlen]).decode()
+        off += dlen
+        shape = []
+        for _ in range(ndim):
+            shape.append(_U32.unpack_from(payload, off)[0])
+            off += 4
+        (nbytes,) = _U64.unpack_from(payload, off)
+        off += 8
+        if off + nbytes > len(mv):
+            raise WireFrameError(
+                f"truncated v4 segment {tag!r} (payload cut mid-tensor)")
+        try:
+            arr = np.frombuffer(
+                mv[off:off + nbytes], dtype=np.dtype(dt)).reshape(shape)
+        except (TypeError, ValueError) as e:
+            raise WireFrameError(
+                f"v4 segment {tag!r} descriptor invalid: {e}") from None
+        segs[tag] = arr
+        off += nbytes
+    return meta, segs
+
+
+def pack_request_v4(corr_id: str, reply_topic: str, kind: str,
+                    x: np.ndarray,
+                    gen: Optional[Dict[str, Any]] = None,
+                    model: Optional[str] = None,
+                    version: Optional[int] = None,
+                    session: Optional[str] = None,
+                    trace: Optional[Dict[str, str]] = None,
+                    tensors: Optional[Dict[str, np.ndarray]] = None
+                    ) -> bytes:
+    """The v4 request frame: the meta block carries exactly the legacy
+    header's routing fields; ``x`` and any extra ``tensors`` (shipped
+    ``kv`` / ``logits``, resume ``prefix``) ride as raw binary
+    segments instead of npz bodies / JSON float lists."""
+    meta: Dict[str, Any] = {"id": corr_id, "reply": reply_topic,
+                            "kind": kind, "v": WIRE_VERSION}
+    if gen is not None:
+        meta["gen"] = gen
+    if model is not None:
+        meta["model"] = model
+    if version is not None:
+        meta["version"] = int(version)
+    if session is not None:
+        meta["session"] = session
+    if trace is not None:
+        meta["trace"] = trace
+    segments: List[Tuple[str, np.ndarray]] = [("x", np.asarray(x))]
+    for tag in sorted(tensors or ()):
+        segments.append((tag, np.asarray(tensors[tag])))
+    return pack_frame_v4(meta, segments, FRAME_REQUEST)
+
+
+def unpack_request_any(payload: bytes
+                       ) -> Tuple[Dict[str, Any], np.ndarray,
+                                  Dict[str, np.ndarray]]:
+    """Decode a request in EITHER framing: (header, x, extra tensor
+    segments). Legacy npz frames yield an empty segment dict — the
+    negotiation seam a rolling upgrade rides (a v4 worker keeps
+    serving v3 routers)."""
+    if is_binary_frame(payload):
+        meta, segs = unpack_frame_v4(payload)
+        x = segs.pop("x", None)
+        if x is None:
+            raise WireFrameError("v4 request frame without an x segment")
+        return meta, x, segs
+    header, body = unpack_frame(payload)
+    return header, ndarray_from_bytes(body), {}
+
+
+def pack_reply_v4(corr_id: str, result: Optional[np.ndarray] = None,
+                  error=None) -> bytes:
+    """v4 terminal reply. Errors stay meta-only (cold path, same typed
+    ``etype`` fields as legacy so :func:`typed_error` reconstructs the
+    exception class unchanged)."""
+    if error is not None:
+        meta = {"id": corr_id, "ok": False}
+        meta.update(_error_fields(error))
+        return pack_frame_v4(meta, (), FRAME_REPLY)
+    meta = {"id": corr_id, "ok": True}
+    segs = [] if result is None else [("r", np.asarray(result))]
+    return pack_frame_v4(meta, segs, FRAME_REPLY)
+
+
+def pack_tensor_chunk_v4(corr_id: str, tag: str,
+                         tensor: np.ndarray) -> bytes:
+    """The v3 tagged tensor chunk on v4 framing — the disagg shipped-KV
+    hot path's first zero-copy customer. Raw dtype+shape+bytes: the
+    handoff is byte-exact by construction (no npz container, no float
+    round-trip)."""
+    meta = {"id": corr_id, "ok": True, "chunk": True, "tag": str(tag),
+            "v": WIRE_VERSION}
+    return pack_frame_v4(meta, [("t", np.asarray(tensor))], FRAME_TENSOR)
+
+
+def pack_chunks_v4(entries: Sequence[Tuple[str, int, np.ndarray]]
+                   ) -> bytes:
+    """The COALESCED token-chunk frame: every (corr_id, offset,
+    tokens) delta a retiring burst produced for one endpoint rides ONE
+    frame — the per-stream frame fan-out (and its per-frame npz + JSON
+    + broker round-trip cost) collapses by the burst's cotenancy."""
+    meta = {"ok": True, "chunk": True, "v": WIRE_VERSION,
+            "streams": [[str(c), int(off)] for c, off, _ in entries]}
+    segs = [(str(i), np.asarray(toks, np.int64))
+            for i, (_, _, toks) in enumerate(entries)]
+    out = pack_frame_v4(meta, segs, FRAME_CHUNKS)
+    get_registry().counter(
+        WIRE_COALESCED_COUNTER,
+        "Per-stream token-chunk deltas that rode a coalesced v4 burst "
+        "frame instead of a frame of their own").inc(float(len(entries)))
+    return out
+
+
+def decode_reply_events(payload: bytes) -> List[Dict[str, Any]]:
+    """Uniform reply decoding over BOTH framings, as a list of events:
+
+    - ``{"type": "chunk", "id", "off", "tokens"}`` — one per stream
+      delta (a coalesced v4 frame yields several);
+    - ``{"type": "tensor", "id", "tag", "tensor"}`` — tagged tensor
+      chunk (disagg kv);
+    - ``{"type": "terminal", "id", "header", "result"}`` — resolves
+      the request (``header`` carries ok / typed-error fields).
+
+    The consumer loop stays framing-agnostic: a rolling upgrade mixes
+    v3 and v4 workers behind one endpoint pool."""
+    if is_binary_frame(payload):
+        meta, segs = unpack_frame_v4(payload)
+        if meta.get("chunk"):
+            tag = meta.get("tag")
+            if tag is not None:
+                return [{"type": "tensor", "id": meta.get("id"),
+                         "tag": tag, "tensor": segs.get("t")}]
+            out = []
+            for i, (corr, off) in enumerate(meta.get("streams") or ()):
+                out.append({"type": "chunk", "id": corr, "off": int(off),
+                            "tokens": segs.get(str(i))})
+            return out
+        return [{"type": "terminal", "id": meta.get("id"),
+                 "header": meta, "result": segs.get("r")}]
+    header, body = unpack_frame(payload)
+    result = ndarray_from_bytes(body) if header.get("ok") and body \
+        else None
+    if is_chunk(header):
+        tag = chunk_tag(header)
+        if tag is not None:
+            return [{"type": "tensor", "id": header.get("id"),
+                     "tag": tag, "tensor": result}]
+        return [{"type": "chunk", "id": header.get("id"),
+                 "off": int(header.get("off", 0)), "tokens": result}]
+    return [{"type": "terminal", "id": header.get("id"),
+             "header": header, "result": result}]
+
+
 def pack_request(corr_id: str, reply_topic: str, kind: str, x: np.ndarray,
                  gen: Optional[Dict[str, Any]] = None,
                  model: Optional[str] = None,
                  version: Optional[int] = None,
                  session: Optional[str] = None,
-                 trace: Optional[Dict[str, str]] = None) -> bytes:
+                 trace: Optional[Dict[str, str]] = None,
+                 wire_v: int = 3) -> bytes:
     """``trace`` is the OPTIONAL propagated request-trace context
     (``monitor/reqtrace.py`` ``TraceContext.wire()``: ``{"id", "span"}``
     strings). It rides the header WITHOUT a wire-version bump — the
     same discipline as every other optional header field: a consumer
     that predates it never reads the key, so a newer router tracing
     against an older worker serves correctly (the merged trace is
-    merely gappy on that hop, never corrupt)."""
+    merely gappy on that hop, never corrupt). ``wire_v`` stamps the
+    header's protocol version — a v4 endpoint that negotiated DOWN to
+    a v3 worker stamps 3, so the worker's skew check accepts the frame
+    it is in fact able to serve."""
     header = {"id": corr_id, "reply": reply_topic, "kind": kind,
-              "v": WIRE_VERSION}
+              "v": int(wire_v)}
     if gen is not None:
         header["gen"] = gen
     if model is not None:
@@ -232,6 +561,7 @@ def _typed_error_registry() -> Dict[str, Any]:
         "DecodeBurstError": DecodeBurstError,
         "KVPoolExhausted": KVPoolExhausted,
         "WireVersionError": WireVersionError,
+        "WireFrameError": WireFrameError,
         "SliceDegraded": SliceDegraded,
         "EngineShutdown": EngineShutdown,
     }
@@ -262,9 +592,17 @@ def unpack_reply(payload: bytes) -> Tuple[Dict[str, Any],
 
 
 def pack_heartbeat(name: str, seq: int, state: str,
-                   stats: Dict[str, Any]) -> bytes:
+                   stats: Dict[str, Any],
+                   wire_version: int = WIRE_VERSION) -> bytes:
+    """Heartbeats stay plain JSON (cold control plane). ``wire`` is the
+    worker's advertised wire-version ceiling — the NEGOTIATION signal:
+    an endpoint only sends v4 binary frames to a worker whose
+    heartbeats advertise ``wire >= 4`` (absent = a pre-v4 build = 3),
+    so a rolling upgrade downgrades framing per peer instead of
+    failing."""
     return json.dumps({"name": name, "seq": seq, "state": state,
-                       "stats": stats}, separators=(",", ":")).encode()
+                       "wire": int(wire_version), "stats": stats},
+                      separators=(",", ":")).encode()
 
 
 def unpack_heartbeat(payload: bytes) -> Dict[str, Any]:

@@ -48,6 +48,7 @@ class EngineWorker:
                  hb_broker: Optional[MessageBroker] = None,
                  reply_broker: Optional[MessageBroker] = None,
                  heartbeat_s: float = 0.25, poll_s: float = 0.05,
+                 wire_version: int = wire.WIRE_VERSION,
                  start: bool = True):
         """``broker`` carries the request consume loop. Over a
         ``TcpBroker`` pass SEPARATE connections as ``reply_broker`` and
@@ -55,10 +56,16 @@ class EngineWorker:
         lock for up to the server's poll window, and replies queued
         behind it would trickle out at the poll rate instead of
         resolving as the engine finishes (an ``InMemoryBroker`` has no
-        such contention — sharing is fine there)."""
+        such contention — sharing is fine there).
+
+        ``wire_version`` pins the wire ceiling this worker SPEAKS and
+        advertises in heartbeats (the rolling-upgrade test seam: pin 3
+        and the worker behaves exactly like a pre-v4 build — serves
+        legacy frames, rejects v4 frames typed)."""
         self.engine = engine
         self.service = service
         self.name = name or service
+        self.wire_version = int(wire_version)
         self._broker = broker
         self._reply_broker = reply_broker or broker
         self._hb_broker = hb_broker or broker
@@ -106,16 +113,27 @@ class EngineWorker:
             if msg is None:
                 continue
             try:
-                header, x = wire.unpack_request(msg)
+                header, x, segs = wire.unpack_request_any(msg)
+            except wire.WireFrameError as e:
+                # structurally damaged binary frame: rejected typed and
+                # WHOLE — no partially-parsed tensor reaches the engine
+                logger.warning("worker %s: damaged v4 frame rejected "
+                               "(WireFrameError: %s)", self.name, e)
+                continue
             except Exception as e:
                 logger.warning("worker %s: undecodable request (%s)",
                                self.name, e)
                 continue
             corr, reply_topic = header.get("id"), header.get("reply")
+            # reply in the framing the request arrived in (a v3 caller
+            # must never receive a v4 binary reply)
+            req_v4 = int(header.get("v", 1)) >= 4 \
+                and self.wire_version >= 4
             try:
                 # a frame from a NEWER protocol is rejected typed, not
-                # served garbled (the wire v2 skew contract)
-                wire.check_version(header)
+                # served garbled (the wire v2 skew contract; a worker
+                # pinned below v4 rejects binary frames the same way)
+                wire.check_version(header, cap=self.wire_version)
             except wire.WireVersionError as e:
                 self._reply(reply_topic, wire.pack_reply(corr, error=e))
                 continue
@@ -150,31 +168,48 @@ class EngineWorker:
                         tctx, "wire_ingress", t_ingress,
                         now_us() - t_ingress, kind=wire.KIND_PREFILL,
                         worker=self.name)
-                    self._reply(reply_topic, wire.pack_tensor_chunk(
-                        corr, "kv", out["kv"]))
-                    self._reply(reply_topic,
-                                wire.pack_reply(corr, out["logits"]))
+                    if req_v4:
+                        # shipped KV rides raw v4 segments: byte-exact,
+                        # no npz container on the disagg hot path
+                        self._reply(reply_topic, wire.pack_tensor_chunk_v4(
+                            corr, "kv", out["kv"]))
+                        self._reply(reply_topic,
+                                    wire.pack_reply_v4(corr, out["logits"]))
+                    else:
+                        self._reply(reply_topic, wire.pack_tensor_chunk(
+                            corr, "kv", out["kv"]))
+                        self._reply(reply_topic,
+                                    wire.pack_reply(corr, out["logits"]))
                     continue
                 if header.get("kind") == wire.KIND_GENERATE:
                     g = header.get("gen") or {}
                     kwargs = dict(route)
                     if g.get("kv"):
-                        # v3 handoff frame: the BODY is the shipped KV
-                        # tensor; the (small) prompt rides the header
-                        prompt = np.asarray(g["prompt"], np.int32)[None]
-                        kwargs["kv_state"] = {
-                            "kv": x, "t_in": prompt.shape[1],
-                            "logits": np.asarray(g["logits"], np.float32)[None]}
-                        x = prompt
-                    if g.get("prefix") is not None:
+                        if "kv" in segs:
+                            # v4 handoff: prompt is the x segment, the
+                            # shipped KV + logits ride raw segments
+                            kwargs["kv_state"] = {
+                                "kv": np.asarray(segs["kv"]),
+                                "t_in": x.shape[-1],
+                                "logits": np.asarray(segs["logits"])}
+                            x = np.asarray(x, np.int32)
+                        else:
+                            # v3 handoff frame: the BODY is the shipped
+                            # KV tensor; the (small) prompt rides the
+                            # header
+                            prompt = np.asarray(g["prompt"], np.int32)[None]
+                            kwargs["kv_state"] = {
+                                "kv": x, "t_in": prompt.shape[1],
+                                "logits": np.asarray(g["logits"], np.float32)[None]}
+                            x = prompt
+                    if "prefix" in segs:
+                        kwargs["prefix"] = np.asarray(segs["prefix"],
+                                                      np.int64)
+                    elif g.get("prefix") is not None:
                         kwargs["prefix"] = np.asarray(g["prefix"], np.int64)
                     if g.get("stream"):
-                        # chunked v2 reply: each burst's token delta is
-                        # published as it retires; the terminal reply
-                        # still carries the full payload
-                        kwargs["on_tokens"] = (
-                            lambda off, toks, c=corr, rt=reply_topic:
-                            self._reply(rt, wire.pack_chunk(c, off, toks)))
+                        kwargs["on_tokens"] = self._make_stream_cb(
+                            corr, reply_topic, req_v4)
                     with reqtrace.use_trace(tctx):
                         fut = self.engine.submit_generate(
                             x.astype(np.int32, copy=False),
@@ -190,22 +225,59 @@ class EngineWorker:
             except BaseException as e:
                 # typed: the caller's endpoint reconstructs the same
                 # exception class (shed/quarantine isolation contract)
-                self._reply(reply_topic, wire.pack_reply(corr, error=e))
+                pack = wire.pack_reply_v4 if req_v4 else wire.pack_reply
+                self._reply(reply_topic, pack(corr, error=e))
                 continue
             reqtrace.record_span(
                 tctx, "wire_ingress", t_ingress, now_us() - t_ingress,
                 kind=header.get("kind"), worker=self.name)
             fut.add_done_callback(
-                lambda f, c=corr, rt=reply_topic: self._deliver(c, rt, f))
+                lambda f, c=corr, rt=reply_topic, v4=req_v4:
+                self._deliver(c, rt, f, v4))
 
-    def _deliver(self, corr, reply_topic, fut):
+    def _make_stream_cb(self, corr, reply_topic, req_v4):
+        """Build the per-stream token-delta callback. For a v4 caller
+        the callback is MARKED for burst coalescing (``burst_sink`` /
+        ``corr`` / ``reply_topic`` attributes): a coalescing-aware
+        scheduler batches every cotenant stream's delta from one
+        retiring burst and hands them to :meth:`_publish_burst` — ONE
+        frame per endpoint per burst. Called outside a batch (or by a
+        scheduler that predates coalescing) it degrades to a
+        single-entry coalesced frame; v3 callers keep per-stream
+        :func:`wire.pack_chunk` frames."""
+        if not req_v4:
+            return (lambda off, toks, c=corr, rt=reply_topic:
+                    self._reply(rt, wire.pack_chunk(c, off, toks)))
+
+        def cb(off, toks, c=corr, rt=reply_topic):
+            self._reply(rt, wire.pack_chunks_v4([(c, off, toks)]))
+        cb.burst_sink = self._publish_burst
+        cb.corr = corr
+        cb.reply_topic = reply_topic
+        return cb
+
+    def _publish_burst(self, entries):
+        """Coalesced emit: ``entries`` is ``[(cb, off, tokens), ...]``
+        — every stream delta one retiring burst produced for callbacks
+        marked with this sink. Grouped by reply topic: each endpoint
+        receives ONE v4 chunks frame carrying all of its streams'
+        deltas."""
+        by_topic = {}
+        for cb, off, toks in entries:
+            by_topic.setdefault(cb.reply_topic, []).append(
+                (cb.corr, off, toks))
+        for topic, chunk_entries in by_topic.items():
+            self._reply(topic, wire.pack_chunks_v4(chunk_entries))
+
+    def _deliver(self, corr, reply_topic, fut, v4=False):
         if self._killed.is_set():
             return  # a killed worker answers nothing
+        pack = wire.pack_reply_v4 if v4 else wire.pack_reply
         err = fut.exception()
         if err is None:
-            payload = wire.pack_reply(corr, np.asarray(fut.result()))
+            payload = pack(corr, np.asarray(fut.result()))
         else:
-            payload = wire.pack_reply(corr, error=err)
+            payload = pack(corr, error=err)
         self._reply(reply_topic, payload)
 
     def _reply(self, reply_topic, payload):
@@ -243,7 +315,8 @@ class EngineWorker:
             stats = dict(self.engine.stats())
             stats["served"] = served
             self._hb_broker.publish(topic, wire.pack_heartbeat(
-                self.name, self._seq, self._state, stats))
+                self.name, self._seq, self._state, stats,
+                wire_version=self.wire_version))
         except BaseException as e:
             logger.warning("worker %s: heartbeat failed (%s: %s)",
                            self.name, type(e).__name__, e)

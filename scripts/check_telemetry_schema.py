@@ -462,6 +462,14 @@ KNOWN_DL4J_METRICS = {
     "dl4j_router_queue_wait_ms",
     "dl4j_router_latency_ms",
     "dl4j_router_endpoint_healthy",
+    # wire/transport data plane (serving/wire.py v4 binary framing +
+    # the router's event-loop core): frames/bytes packed by framing
+    # (legacy npz vs v4 zero-copy segments), stream deltas that rode a
+    # coalesced burst frame, and the router timer-loop's firing lag
+    "dl4j_wire_frames_total",
+    "dl4j_wire_bytes_total",
+    "dl4j_wire_coalesced_chunks_total",
+    "dl4j_router_loop_lag_ms",
     # end-to-end request tracing + SLO attribution
     # (monitor/reqtrace.py): per-request phase decomposition, TTFT /
     # TPOT as the caller observed them, per-model SLO burn outcomes,

@@ -31,8 +31,9 @@ torn-write counting, replica/model poison sequences, burst-kill
 windows, mesh-shrink drills, and the composed ChaosSchedule event
 clock, the prefix-cache refcount/COW/eviction accounting drill, and
 the slice-kill / slice-drill schedules, the quantized-pool ×
-prefix-cache accounting drill, and the speculative-decoding dual-lane
-(draft + target) accounting drill — sections 1–11) twice per seed
+prefix-cache accounting drill, the speculative-decoding dual-lane
+(draft + target) accounting drill, and the wire-v4 torn-frame /
+reassembly drill — sections 1–12) twice per seed
 across rotating seeds and compares the full event logs bit-for-bit.
 It runs in milliseconds with no subprocess and no jax compute, so the
 tier-1 sweep carries it on every run; the full mode is the pre-merge /
@@ -461,6 +462,52 @@ def _scenario_log(seed: int) -> str:
                   f"d={dpool.free_count}/{dpool.total_blocks} "
                   f"tleak={tpool.total_blocks - tpool.free_count} "
                   f"dleak={dpool.total_blocks - dpool.free_count}")
+
+    # 12) wire-v4 torn-frame drill (the PR-18 data plane's contract):
+    # the zero-copy binary framing must fail TYPED on ANY truncation —
+    # a half-written frame (torn write, worker killed mid-publish, cut
+    # connection) surfaces as WireFrameError, never a garbled tensor —
+    # while a fragmented-but-complete delivery reassembles byte-exact,
+    # including the shipped-KV disagg segments, and a coalesced
+    # token-chunk frame decodes back to every stream's exact delta.
+    from deeplearning4j_tpu.serving import wire
+    rngW = np.random.default_rng(seed * 211 + 5)
+    kv = rngW.standard_normal((2, 2, 4, 8)).astype(np.float32)
+    ids = rngW.integers(0, 997,
+                        (1, int(rngW.integers(3, 9)))).astype(np.int32)
+    frame = wire.pack_request_v4(f"w{seed}", "rsp", wire.KIND_GENERATE,
+                                 ids, gen={"kv": True}, tensors={"kv": kv})
+    events.append(f"wire frame len={len(frame)}")
+    for c in sorted(int(c) for c in rngW.integers(0, len(frame), 6)):
+        try:
+            wire.unpack_frame_v4(frame[:c])
+            events.append(f"wire cut {c} MISSED")
+        except wire.WireFrameError:
+            events.append(f"wire cut {c} typed")
+    try:
+        wire.unpack_frame_v4(b"\x00\x00" + frame[2:])
+        events.append("wire bad-magic MISSED")
+    except wire.WireFrameError:
+        events.append("wire bad-magic caught")
+    parts, off = [], 0
+    while off < len(frame):
+        n = int(rngW.integers(1, max(2, len(frame) // 3)))
+        parts.append(frame[off:off + n])
+        off += n
+    meta, x, segs = wire.unpack_request_any(b"".join(parts))
+    events.append(f"wire reassembled frags={len(parts)} "
+                  f"ids={bool(np.array_equal(x, ids))} "
+                  f"kv_byte_exact={segs['kv'].tobytes() == kv.tobytes()} "
+                  f"v={meta['v']}")
+    entries = [(f"s{j}", int(rngW.integers(0, 50)),
+                rngW.integers(0, 11,
+                              int(rngW.integers(1, 5))).astype(np.int64))
+               for j in range(3)]
+    evs = wire.decode_reply_events(wire.pack_chunks_v4(entries))
+    exact = all(ev["id"] == c and ev["off"] == o and
+                list(ev["tokens"]) == [int(t) for t in toks]
+                for ev, (c, o, toks) in zip(evs, entries))
+    events.append(f"wire coalesced n={len(evs)} exact={exact}")
     return "\n".join(events)
 
 

@@ -209,6 +209,20 @@ def test_serving_plane_lock_graph_reconstructed_and_acyclic():
             "PagedKVCachePool._lock") in edges
     assert ("PrefixCache._lock", "PagedKVCachePool._lock") in edges
     assert g.cycles() == []
+    # PR-18 event-loop collapse: broker client faults are DEFERRED out
+    # of the transport lock, so TcpBroker no longer orders ahead of the
+    # metrics locks, and the router's one clock never calls out while
+    # holding its condition (no outgoing edges from the loop)
+    assert "_RouterLoop._cond" in g.nodes
+    assert not any(src == "_RouterLoop._cond" for src, _ in edges)
+    assert ("TcpBroker._lock", "Counter._lock") not in edges
+    assert ("TcpBroker._lock", "MetricsRegistry._lock") not in edges
+    # the committed snapshot tracks the live reconstruction
+    with open(os.path.join(_ROOT, "scripts", "lock_graph.json")) as f:
+        snap = json.load(f)
+    assert set(snap["nodes"]) == set(g.nodes)
+    assert {(e["from"], e["to"]) for e in snap["edges"]} == edges
+    assert snap["cycles"] == []
 
 
 # ------------------------------------------------- shims + CLI + QC

@@ -1157,6 +1157,171 @@ def test_wire_version_skew_rejected_typed(net, rng, fresh_registry):
         eng.shutdown(drain=False)
 
 
+def test_wire_v4_binary_roundtrip_and_damage_typed(fresh_registry):
+    """The v4 binary framing contract: byte-exact zero-copy tensor
+    segments, coalesced chunk decode, and — the chaos half — EVERY
+    truncation point surfaces as a typed WireFrameError, never a
+    garbled tensor. The broker's ping header constants are pinned to
+    the wire's (they are mirrored across the import-graph boundary)."""
+    from deeplearning4j_tpu.serving import wire
+    from deeplearning4j_tpu.streaming import broker as broker_mod
+    # the transport-level ping rides the SAME v4 prologue
+    assert broker_mod.PING_MAGIC == wire.WIRE_MAGIC
+    assert broker_mod.PING_VERSION == wire.WIRE_VERSION
+    rng = np.random.default_rng(7)
+    kv = rng.standard_normal((2, 3, 4, 5)).astype(np.float32)
+    ids = rng.integers(0, 999, (1, 7)).astype(np.int32)
+    payload = wire.pack_request_v4(
+        "c1", "rsp", wire.KIND_GENERATE, ids,
+        gen={"max_new": 4, "kv": True}, model="m", session="s",
+        tensors={"kv": kv})
+    assert wire.is_binary_frame(payload)
+    meta, x, segs = wire.unpack_request_any(payload)
+    assert meta["id"] == "c1" and meta["v"] == wire.WIRE_VERSION
+    assert meta["model"] == "m" and meta["session"] == "s"
+    assert x.dtype == ids.dtype
+    np.testing.assert_array_equal(x, ids)
+    assert segs["kv"].dtype == kv.dtype
+    assert segs["kv"].tobytes() == kv.tobytes()  # byte-exact
+    # legacy frames pass through the same seam untouched
+    leg, lx, lsegs = wire.unpack_request_any(
+        wire.pack_request("c2", "rsp", wire.KIND_CLASSIFY, ids))
+    assert leg["id"] == "c2" and lsegs == {}
+    np.testing.assert_array_equal(lx, ids)
+    # coalesced chunk frame: one frame, every stream's delta
+    frame = wire.pack_chunks_v4([
+        ("a", 0, np.array([1, 2], np.int64)),
+        ("b", 5, np.array([9], np.int64))])
+    evs = wire.decode_reply_events(frame)
+    assert [(e["type"], e["id"], e["off"]) for e in evs] == \
+        [("chunk", "a", 0), ("chunk", "b", 5)]
+    assert list(evs[0]["tokens"]) == [1, 2] and list(evs[1]["tokens"]) == [9]
+    # truncation sweep: every cut of the binary frame fails TYPED
+    for cut in range(len(payload)):
+        with pytest.raises(wire.WireFrameError):
+            wire.unpack_frame_v4(payload[:cut])
+    # typed across the wire like every other registered engine error
+    hdr, _ = wire.unpack_reply(
+        wire.pack_reply("c", error=wire.WireFrameError("cut")))
+    assert isinstance(wire.typed_error(hdr), wire.WireFrameError)
+
+
+def test_wire_v4_version_skew_matrix(net, rng, fresh_registry):
+    """Rolling-upgrade matrix, pinned end-to-end: a v4 endpoint serves
+    against a v3-pinned worker (negotiation downgrades the framing per
+    the worker's advertised heartbeat ceiling), a v3-pinned endpoint
+    serves against a v4 worker (requests stay legacy; the worker
+    replies in kind), and a RAW v4 binary frame forced at the v3
+    worker is rejected with a typed WireVersionError — the only skew
+    that may fail, and it fails typed."""
+    from deeplearning4j_tpu.serving import wire
+    x = rng.standard_normal((1, N_IN)).astype(np.float32)
+    want = np.asarray(net.output(x))
+
+    # v4 router ↔ v3 worker: keeps serving, all frames legacy
+    eng = ParallelInference(net, max_batch_size=4, replicas=1)
+    broker = InMemoryBroker()
+    worker = EngineWorker(eng, broker, "skew-a", heartbeat_s=0.05,
+                          wire_version=3)
+    ep = RemoteEndpoint(broker, "skew-a", request_timeout_s=10.0)
+    try:
+        assert _spin_until(ep.alive, timeout=10)
+        assert ep.negotiated_wire() == 3  # downgraded by the heartbeat
+        np.testing.assert_array_equal(ep.submit(x).result(30), want)
+        # a raw v4 frame AT the v3 worker: typed rejection, live corr
+        fut = ep.submit(x)
+        corr = list(ep._pending)[0]
+        broker.publish("skew-a" + wire.REQ_SUFFIX, wire.pack_request_v4(
+            corr, ep.reply_topic, wire.KIND_CLASSIFY, x))
+        with pytest.raises(wire.WireVersionError):
+            fut.result(30)
+    finally:
+        ep.close()
+        worker.kill()
+        eng.shutdown(drain=False)
+
+    # v3 router ↔ v4 worker: requests stay legacy, replies in kind
+    eng = ParallelInference(net, max_batch_size=4, replicas=1)
+    broker = InMemoryBroker()
+    worker = EngineWorker(eng, broker, "skew-b", heartbeat_s=0.05)
+    ep = RemoteEndpoint(broker, "skew-b", request_timeout_s=10.0,
+                        wire_version=3)
+    try:
+        assert _spin_until(ep.alive, timeout=10)
+        assert ep.negotiated_wire() == 3
+        np.testing.assert_array_equal(ep.submit(x).result(30), want)
+    finally:
+        ep.close()
+        worker.kill()
+        eng.shutdown(drain=False)
+
+    # v4 ↔ v4: once the heartbeat proves the peer, the hot path goes
+    # binary (before the first heartbeat the endpoint stays legacy)
+    eng = ParallelInference(net, max_batch_size=4, replicas=1)
+    broker = InMemoryBroker()
+    worker = EngineWorker(eng, broker, "skew-c", heartbeat_s=0.05)
+    ep = RemoteEndpoint(broker, "skew-c", request_timeout_s=10.0)
+    try:
+        assert _spin_until(ep.alive, timeout=10)
+        assert ep.negotiated_wire() == 4
+        reg = monitor.get_registry()
+        before = reg.counter(monitor.WIRE_FRAMES_COUNTER,
+                             transport="v4").value
+        np.testing.assert_array_equal(ep.submit(x).result(30), want)
+        assert reg.counter(monitor.WIRE_FRAMES_COUNTER,
+                           transport="v4").value >= before + 2  # req+reply
+    finally:
+        ep.close()
+        worker.kill()
+        eng.shutdown(drain=False)
+
+
+def test_wire_v4_stream_coalesced_and_disagg_byte_exact(rng,
+                                                        fresh_registry):
+    """The v4 hot path end-to-end on a continuous-decode engine:
+    streamed tokens arrive through COALESCED burst frames (the
+    coalesced-chunks counter ticks; offsets stay gapless), and the
+    disagg prefill→decode handoff is BYTE-exact over raw v4 segments —
+    same dtype, same bytes, same tokens as the fused local run."""
+    from deeplearning4j_tpu.nn.generate import generate_eager
+    g = gpt(vocab_size=11, d_model=16, n_layers=2, num_heads=2,
+            max_len=64, compute_dtype="float32", learning_rate=0.01).init()
+    eng = ParallelInference(g, replicas=1, continuous=True,
+                            decode_slots=4, decode_burst=4,
+                            kv_block_size=4)
+    broker = InMemoryBroker()
+    worker = EngineWorker(eng, broker, "v4gpt", heartbeat_s=0.05)
+    ep = RemoteEndpoint(broker, "v4gpt", request_timeout_s=30.0,
+                        heartbeat_timeout_s=1.0)
+    try:
+        assert _spin_until(ep.alive, timeout=10)
+        assert _spin_until(lambda: ep.negotiated_wire() == 4, timeout=10)
+        prompt = rng.integers(0, 11, (1, 5))
+        want = generate_eager(g, prompt, 12)
+        coll = _Chunks()
+        got = ep.submit_generate(prompt, 12, on_tokens=coll).result(90)
+        np.testing.assert_array_equal(got, want)
+        assert coll.tokens() == [int(t) for t in want[0, 5:]]
+        reg = monitor.get_registry()
+        assert reg.family_total(monitor.WIRE_COALESCED_COUNTER) > 0
+        # disagg: shipped KV byte-exact over v4 framing
+        st = ep.submit_prefill(prompt).result(60)
+        local = eng.prefill_export(prompt.astype(np.int32))
+        assert np.asarray(st["kv"]).dtype == np.asarray(local["kv"]).dtype
+        assert np.asarray(st["kv"]).tobytes() == \
+            np.asarray(local["kv"]).tobytes()
+        np.testing.assert_array_equal(np.asarray(st["logits"]),
+                                      np.asarray(local["logits"]))
+        got2 = ep.submit_generate(
+            prompt, 12, kv_state={"kv": st["kv"], "logits": st["logits"],
+                                  "t_in": st["t_in"]}).result(90)
+        np.testing.assert_array_equal(got2, want)
+    finally:
+        ep.close()
+        worker.kill()
+        eng.shutdown(drain=False)
+
+
 # ------------------------------------------- stream metrics + healthz
 
 def test_stream_metric_schema_and_healthz_counts(rng, fresh_registry):
@@ -1165,7 +1330,11 @@ def test_stream_metric_schema_and_healthz_counts(rng, fresh_registry):
     for name in ("dl4j_stream_chunks_total",
                  "dl4j_session_migrations_total",
                  "dl4j_session_journal_bytes",
-                 "dl4j_router_resume_prefix_tokens_total"):
+                 "dl4j_router_resume_prefix_tokens_total",
+                 monitor.WIRE_FRAMES_COUNTER,
+                 monitor.WIRE_BYTES_COUNTER,
+                 monitor.WIRE_COALESCED_COUNTER,
+                 monitor.ROUTER_LOOP_LAG_HISTOGRAM):
         assert name in schema.KNOWN_DL4J_METRICS, name
     from deeplearning4j_tpu.faultinject import BurstKill
     g = gpt(vocab_size=11, d_model=16, n_layers=2, num_heads=2, max_len=64,
