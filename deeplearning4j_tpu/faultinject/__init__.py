@@ -39,7 +39,14 @@ Injector ↔ fault domain map:
 - :class:`MeshShrink` / :class:`ChipFailure` — chips dying out of the
   mesh plane mid-epoch (mesh domain: checkpoint fallback, MeshPlane
   rebuild from the survivors, ``restore_checkpoint(mesh=...)``
-  re-lowering, bitwise-deterministic resume on the smaller mesh).
+  re-lowering, bitwise-deterministic resume on the smaller mesh);
+- :class:`HostTierPressure` / :func:`run_hibernation_drill`
+  (``faultinject/chaos.py``) — host-RAM KV-tier budget squeezes and
+  the session-hibernation drill (KV-tiering domain: hibernate N
+  sessions, kill the pinned endpoint, resume every session on the
+  survivors down the host → shipped-blocks → journaled-prefix
+  exactness ladder, with the squeeze forcing the refusal/fallback
+  paths; zero leaked blocks on BOTH tiers after drain).
 """
 
 from __future__ import annotations
@@ -494,6 +501,64 @@ class WedgeEndpoint:
         self.heal()
 
 
+class HostTierPressure:
+    """Budget-squeeze injector for the paged pool's host-RAM tier (the
+    KV-tiering PR's ``set_host_budget`` seam): while active, the
+    targeted pools' host budgets shrink to ``budget`` blocks, so
+    swap-outs, prefix-cache demotions and shipped-block imports hit
+    the REFUSAL path (``swap_out``/``host_insert`` return None) and
+    the caller must take its pre-tier fallback — free, cache-drop, or
+    journaled re-prefill. Existing host entries are never dropped
+    (the pool's shrink contract), so hibernated sessions stay exact
+    under pressure; only NEW demotions are squeezed. Context-managed,
+    restoring the original budgets on exit::
+
+        with HostTierPressure(engine, budget=0):
+            ...  # every swap-out refused; resume must still be exact
+
+    Targets a ``PagedKVCachePool``, a ``ContinuousDecodeScheduler``,
+    or a live continuous ``ParallelInference`` (every lane pool of the
+    scheduler is squeezed). Deterministic by construction — no clocks,
+    no rng; the squeeze window is the ``with`` block."""
+
+    def __init__(self, target, budget: int = 0):
+        if hasattr(target, "set_host_budget"):
+            pools = [target]
+        elif hasattr(target, "_pools"):
+            pools = list(target._pools.values())
+        elif getattr(target, "_scheduler", None) is not None:
+            pools = list(target._scheduler._pools.values())
+        else:
+            raise ValueError(
+                "HostTierPressure needs a PagedKVCachePool, a "
+                "continuous scheduler, or a continuous engine with a "
+                "built scheduler")
+        self.pools = pools
+        self.budget = max(0, int(budget))
+        self._saved: list = []
+        self.active = False
+
+    def squeeze(self) -> "HostTierPressure":
+        if not self.active:
+            self._saved = [p.host_budget() for p in self.pools]
+            for p in self.pools:
+                p.set_host_budget(self.budget)
+            self.active = True
+        return self
+
+    def heal(self) -> None:
+        if self.active:
+            self.active = False
+            for p, old in zip(self.pools, self._saved):
+                p.set_host_budget(old)
+
+    def __enter__(self) -> "HostTierPressure":
+        return self.squeeze()
+
+    def __exit__(self, *exc) -> None:
+        self.heal()
+
+
 def kill_endpoint(fleet, name: str) -> str:
     """Process-kill injector for the serving fleet: abruptly stop the
     named endpoint's engine worker — consumed requests vanish without
@@ -573,5 +638,6 @@ from deeplearning4j_tpu.faultinject.chaos import (  # noqa: E402,F401
     ChaosEvent,
     ChaosSchedule,
     run_chaos_drill,
+    run_hibernation_drill,
     run_slice_drill,
 )

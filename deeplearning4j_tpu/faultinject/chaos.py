@@ -561,6 +561,193 @@ def run_chaos_drill(seed: int = 0, n_requests: int = 16, n_events: int = 4,
     }
 
 
+def run_hibernation_drill(seed: int = 0, n_sessions: int = 4,
+                          turn1: int = 5, total: int = 12,
+                          timeout_s: float = 120.0,
+                          per_try_timeout_s: float = 10.0
+                          ) -> Dict[str, Any]:
+    """The SESSION-HIBERNATION composed drill (the KV-tiering PR): a
+    3-endpoint fleet whose engines run the host-RAM tier
+    (``kv_host_blocks``), ``n_sessions`` concurrent sessions each
+    generate a first turn with ``hibernate=True`` (KV parks in the
+    origin's host tier; the worker SHIPS the payload to the router
+    before the terminal frame), then ONE seeded endpoint is killed
+    abruptly and every session resumes — those pinned to a survivor
+    ride the local swap-in rung, those pinned to the corpse ride the
+    shipped-payload rung on a survivor, and the second half of the
+    resumes run under :class:`~deeplearning4j_tpu.faultinject.
+    HostTierPressure` (every live pool's host budget squeezed to 0),
+    forcing the shipped-block landing dock to refuse so the restore
+    degrades to the journaled-prefix rung.
+
+    Invariants (the whole point — every rung is EXACT): all
+    ``n_sessions`` resumed outputs are bitwise what an uninterrupted
+    ``generate_eager`` run produces, streamed offsets are append-only
+    across the hibernation gap (dup=0, gap=0), the router's handle
+    table drains to empty, and every engine ever alive — the corpse
+    included — leaks ZERO blocks on BOTH tiers (device free==total,
+    host occupancy 0). The summary contains only seed-derived and
+    invariant-valued fields, so a passing drill replays bitwise —
+    the ``scripts/stress_faultinject.py --hibernation`` contract."""
+    import numpy as np
+
+    from deeplearning4j_tpu.faultinject import (HostTierPressure,
+                                                kill_endpoint)
+    from deeplearning4j_tpu.models.zoo.transformer import gpt
+    from deeplearning4j_tpu.nn.generate import generate_eager
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.serving import (InferenceRouter, LocalFleet,
+                                            ModelRegistry, RetryAfter)
+
+    vocab = 11
+    lm = gpt(vocab_size=vocab, d_model=16, n_layers=2, num_heads=2,
+             max_len=32, compute_dtype="float32", learning_rate=0.01,
+             seed=0).init()
+    rng = np.random.default_rng(int(seed) * 104729 + 7)
+    engines: List[ParallelInference] = []
+
+    def engine_factory():
+        mreg = ModelRegistry()
+        mreg.register("lm", net=lm)
+        eng = ParallelInference(registry=mreg, replicas=1,
+                                max_batch_size=8, max_latency_ms=1.0,
+                                queue_capacity=512, continuous=True,
+                                decode_slots=4, decode_burst=4,
+                                kv_block_size=4, prefix_cache=True,
+                                kv_host_blocks=64)
+        engines.append(eng)
+        return eng
+
+    router = InferenceRouter(per_try_timeout_s=per_try_timeout_s,
+                             eject_backoff_s=0.1, max_attempts=6)
+    fleet = LocalFleet(engine_factory, router=router, heartbeat_s=0.05,
+                       request_timeout_s=per_try_timeout_s,
+                       heartbeat_timeout_s=0.5)
+    for _ in range(3):
+        fleet.add_endpoint()
+    fleet.wait_ready(30)
+    names = fleet.names()
+    victim = names[random.Random(int(seed) * 7919 + 29).randrange(
+        len(names))]
+
+    sessions: List[Dict[str, Any]] = []
+    for i in range(int(n_sessions)):
+        t0 = int(rng.integers(3, 6))
+        prompt = rng.integers(1, vocab, (1, t0))
+        temp = 0.7 if i % 2 == 0 else 0.0
+        oracle = generate_eager(lm, prompt, int(total), temperature=temp,
+                                seed=int(seed) * 31 + i)
+        sessions.append({
+            "sid": f"hib-{seed}-{i}", "prompt": prompt, "temp": temp,
+            "seed": int(seed) * 31 + i,
+            "oracle": np.asarray(oracle), "coll": _StreamCollector()})
+
+    mismatches = dup_offsets = gap_events = 0
+    handles_shipped = 0
+    resumed = 0
+    pressure = None
+    try:
+        # ---- turn 1: hibernate every session -----------------------
+        futs = []
+        for s in sessions:
+            for _ in range(200):
+                try:
+                    futs.append(router.submit_generate(
+                        s["prompt"], int(turn1), temperature=s["temp"],
+                        seed=s["seed"], model="lm", session=s["sid"],
+                        hibernate=True, on_tokens=s["coll"]))
+                    break
+                except RetryAfter:
+                    time.sleep(0.05)
+        deadline = time.monotonic() + timeout_s
+        for s, f in zip(sessions, futs):
+            got = np.asarray(f.result(
+                timeout=max(0.1, deadline - time.monotonic())))
+            t0 = s["prompt"].shape[1]
+            if not np.array_equal(got, s["oracle"][:, :t0 + int(turn1)]):
+                mismatches += 1
+            if router.hibernation_handle(s["sid"]) is None:
+                mismatches += 1
+            elif "payload" in router.hibernation_handle(s["sid"]):
+                handles_shipped += 1
+
+        # ---- the outage: one endpoint dies with parked sessions ----
+        kill_endpoint(fleet, victim)
+
+        # ---- resume ALL sessions on whatever survives --------------
+        # second half under host-tier pressure: the survivors' landing
+        # docks refuse the shipped blocks, so those resumes MUST take
+        # the journaled-prefix rung — and stay exact
+        half = len(sessions) // 2
+        for j, s in enumerate(sessions):
+            if j == half:
+                pressure = [HostTierPressure(e, budget=0).squeeze()
+                            for e in engines
+                            if not e._closed
+                            and e._scheduler is not None]
+            fut = router.resume_generate(
+                s["sid"], int(total), model="lm",
+                temperature=s["temp"], seed=s["seed"],
+                on_tokens=s["coll"])
+            got = np.asarray(fut.result(
+                timeout=max(0.1, deadline - time.monotonic())))
+            if not np.array_equal(got, s["oracle"]):
+                mismatches += 1
+            t0 = s["prompt"].shape[1]
+            want = [int(t) for t in s["oracle"][0, t0:]]
+            if s["coll"].tokens != want:
+                mismatches += 1
+            dup_offsets += s["coll"].dups
+            gap_events += s["coll"].gaps
+            resumed += 1
+
+        # ---- both tiers drain to empty on every engine ever alive --
+        leaked = leaked_host = 0
+        for eng in engines:
+            if not eng._closed:
+                eng.drain(timeout=30)
+            sched = eng._scheduler
+            if sched is None:
+                continue
+            for c in sched.prefix_caches():
+                c.clear()
+            free_deadline = time.monotonic() + 10
+            while time.monotonic() < free_deadline:
+                st = sched.stats()
+                pool = st["pool"]
+                if (pool["blocks_free"] >= pool["blocks_total"]
+                        and st["kvtier"]["host_blocks_used"] == 0):
+                    break
+                time.sleep(0.02)
+            st = sched.stats()
+            leaked += int(st["pool"]["blocks_total"]
+                          - st["pool"]["blocks_free"])
+            leaked_host += int(st["kvtier"]["host_blocks_used"])
+        stranded_handles = len(router.hibernated_sessions())
+    finally:
+        for p in pressure or ():
+            p.heal()
+        try:
+            fleet.shutdown(drain=False)
+        except BaseException:
+            pass
+        router.close()
+
+    return {
+        "seed": int(seed),
+        "victim": victim,
+        "sessions": len(sessions),
+        "handles_shipped": handles_shipped,
+        "resumed": resumed,
+        "token_mismatches": mismatches,
+        "dup_offsets": dup_offsets,
+        "gap_events": gap_events,
+        "leaked_blocks": leaked,
+        "leaked_host_blocks": leaked_host,
+        "stranded_handles": stranded_handles,
+    }
+
+
 def run_slice_drill(seed: int = 0, n_requests: int = 12, n_events: int = 2,
                     max_new: int = 6, slice_width: int = 2,
                     n_slices: int = 2, timeout_s: float = 120.0,

@@ -167,6 +167,26 @@ PREFIXCACHE_CACHED_BLOCKS_GAUGE = "dl4j_prefixcache_cached_blocks"
 PREFIXCACHE_SHARED_BLOCKS_GAUGE = "dl4j_prefixcache_shared_blocks"
 PREFIXCACHE_SAVED_TOKENS_COUNTER = \
     "dl4j_prefixcache_saved_prefill_tokens_total"
+PREFIXCACHE_DEMOTIONS_COUNTER = "dl4j_prefixcache_demotions_total"
+
+# KV tiering plane (nn/kvpool.py host-RAM tier + serving/continuous.py
+# hibernation): block contents moved device→host (swap-outs: preempted
+# victims, end-of-turn hibernations, prefix-cache demotions) and
+# host→device (swap-ins: resumed sessions restoring without a
+# re-prefill), prefix-cache blocks demoted to the host tier instead of
+# dropped, sessions hibernated into durable handles at end-of-turn,
+# session restores by ``path=`` (host = local swap-in / ship = v4
+# raw-segment cross-endpoint / journal = prefix re-prefill fallback),
+# the live host-tier occupancy gauge (``pool=``), and the per-block
+# swap latency histogram (``dir=out|in``) that feeds the measured
+# H2D-vs-recompute resume crossover.
+KVTIER_SWAP_OUT_COUNTER = "dl4j_kvtier_swap_out_total"
+KVTIER_SWAP_IN_COUNTER = "dl4j_kvtier_swap_in_total"
+KVTIER_DEMOTIONS_COUNTER = "dl4j_kvtier_demotions_total"
+KVTIER_HIBERNATED_COUNTER = "dl4j_kvtier_hibernated_sessions_total"
+KVTIER_RESTORE_COUNTER = "dl4j_kvtier_restore_total"
+KVTIER_HOST_BLOCKS_GAUGE = "dl4j_kvtier_host_blocks"
+KVTIER_SWAP_LATENCY_HISTOGRAM = "dl4j_kvtier_swap_latency_ms"
 
 # Horizontal serving tier (serving/router.py InferenceRouter — the
 # fleet-level plane above ParallelInference): request volume by
@@ -320,6 +340,7 @@ TRACE_FLIGHT_DUMPS_COUNTER = "dl4j_trace_flight_dumps_total"
 # payloads so ``fleet_snapshot()`` merges fleet-wide window answers.
 # The per-model/per-owner resource-attribution families ride alongside:
 ATTR_KV_BYTE_SECONDS_GAUGE = "dl4j_attr_kv_byte_seconds"
+ATTR_KV_HOST_BYTE_SECONDS_GAUGE = "dl4j_attr_kv_host_byte_seconds"
 ATTR_PREFILL_TOKENS_COUNTER = "dl4j_attr_prefill_tokens_total"
 ATTR_DECODE_TOKENS_COUNTER = "dl4j_attr_decode_tokens_total"
 ATTR_QUEUE_MS_COUNTER = "dl4j_attr_queue_ms_total"

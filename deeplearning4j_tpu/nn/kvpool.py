@@ -75,16 +75,39 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.monitor import (
     ATTR_KV_BYTE_SECONDS_GAUGE,
+    ATTR_KV_HOST_BYTE_SECONDS_GAUGE,
     KVPOOL_ALLOC_FAILURES_COUNTER,
     KVPOOL_BLOCKS_FREE_GAUGE,
     KVPOOL_BLOCKS_TOTAL_GAUGE,
+    KVTIER_HOST_BLOCKS_GAUGE,
+    KVTIER_SWAP_IN_COUNTER,
+    KVTIER_SWAP_LATENCY_HISTOGRAM,
+    KVTIER_SWAP_OUT_COUNTER,
     QUANT_KV_BLOCKS_GAUGE,
     get_registry,
 )
 from deeplearning4j_tpu.nn.quantize import kv_qparams
+
+
+# Host-tier transfer programs: the block index rides as a TRACED device
+# scalar, so one compile per (pool-array shape, dtype) covers every
+# block id — swapping block 7 vs block 300 is the same executable (the
+# zero-steady-state-compile contract; ``warm_swap_programs`` primes
+# them against the trash block). Gather launches are async under jax's
+# dispatch model, so a swap-out's D2H materialization overlaps the
+# next burst instead of stalling it.
+@jax.jit
+def _gather_block(arr, idx):
+    return jnp.take(arr, idx, axis=0)
+
+
+@jax.jit
+def _scatter_block(arr, idx, val):
+    return arr.at[idx].set(val)
 
 #: Hashable KV layout a pool serves: (num_layers, heads, head_dim,
 #: block_size, dtype name, quant mode or ""). Lanes (model versions)
@@ -110,6 +133,15 @@ def pool_spec(num_layers: int, num_heads: int, head_dim: int,
 UNTAGGED_OWNER = "_untagged"
 
 
+class KVHostTierError(RuntimeError):
+    """Host-tier accounting violation — a double free or an operation
+    on an unknown host handle. A RuntimeError subclass (the same law
+    as the device tier's double-free raise) but TYPED, because the
+    host tier is reachable from the wire frame handlers (hibernation
+    import/export) and must cross the wire as itself, not degrade to
+    a generic EndpointError (``wire._typed_error_registry``)."""
+
+
 class PagedKVCachePool:
     """Fixed-size token-block KV pool shared by every sequence of a
     matching layout, with deterministic host-side alloc/free accounting.
@@ -128,7 +160,8 @@ class PagedKVCachePool:
                  num_heads: int, head_dim: int, dtype=jnp.float32,
                  device=None, name: str = "default", sharding=None,
                  quant: Optional[str] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 host_blocks: Optional[int] = None):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is the reserved trash "
@@ -191,10 +224,35 @@ class PagedKVCachePool:
         # adds holders; free_blocks() drops one reference per call and
         # only the last drop returns the block to the free list.
         self._refs: Dict[int, int] = {}
-        # cache-eviction seam: called (n_short) OUTSIDE the lock when
-        # alloc finds the free list short; returns blocks to the free
-        # list (via free_blocks) so the retry below can claim them
-        self._reclaimer = None
+        # cache-eviction seam: a CHAIN of ``fn(n_short)`` callbacks
+        # consulted in registration order (OUTSIDE the lock) when alloc
+        # finds the free list short; each returns blocks to the free
+        # list (via free_blocks / swap_out) so the retry below can
+        # claim them. The prefix cache registers demote-to-host BEFORE
+        # drop, pinning the exhaustion ladder: cache-demote →
+        # cache-drop → alloc failure.
+        self._reclaimers: List[Callable[[int], object]] = []
+        # ------------------------ host-RAM tier (CachedAttention-style)
+        # A budgeted second tier of block CONTENTS keyed by opaque host
+        # handles: swap_out copies a block's per-layer K/V (+ quant
+        # scales, bit-identically) out of the device arrays and frees
+        # the device block; swap_in allocates a fresh device block and
+        # scatters the content back. Host entries are refcounted and
+        # owner-tagged exactly like device blocks, so attribution and
+        # the leak audits extend per tier. ``host_blocks=None`` (or 0)
+        # disables the tier — every swap call then reports "no room"
+        # and callers fall back to the pre-tier paths.
+        self._host_budget = (None if host_blocks is None
+                             else max(0, int(host_blocks)))
+        self._host: Dict[int, Dict[str, object]] = {}
+        self._host_counter = 0
+        self._owner_host_refs: Dict[str, int] = {}
+        self._owner_host_bs: Dict[str, float] = {}
+        self._host_bs = 0.0
+        # measured per-block H2D restore cost (EWMA over swap_in calls)
+        # — the "swap vs recompute" crossover input the scheduler reads
+        self._swap_in_ms: Optional[float] = None
+        self._swap_out_ms: Optional[float] = None
         # ------- per-owner byte-second attribution (Autopilot-style) --
         # Each REFERENCE carries an owner tag (lane key, cache, …);
         # byte-seconds integrate lazily: every ref-changing op (and
@@ -227,6 +285,17 @@ class PagedKVCachePool:
                         self._owner_bs.get(owner, 0.0) + dt * refs * bb)
                     total_refs += refs
             self._pool_bs += dt * total_refs * bb
+            # host-tier residency bills SEPARATELY (host RAM is a
+            # different budget than device HBM), so the conservation
+            # law — Σ per-owner == pool total — holds per tier
+            host_refs = 0
+            for owner, refs in self._owner_host_refs.items():
+                if refs:
+                    self._owner_host_bs[owner] = (
+                        self._owner_host_bs.get(owner, 0.0)
+                        + dt * refs * bb)
+                    host_refs += refs
+            self._host_bs += dt * host_refs * bb
         self._attr_t = now
 
     # ------------------------------------------------------- accounting
@@ -260,13 +329,19 @@ class PagedKVCachePool:
         if n <= 0:
             return []
         got = self._try_alloc(n, owner)
-        if got is None and self._reclaimer is not None:
-            with self._lock:
-                short = n - len(self._free)
-            try:
-                self._reclaimer(short)
-            except BaseException:  # a broken evictor must not kill alloc
-                pass
+        if got is None and self._reclaimers:
+            # consult the chain in registration order (cache-demote
+            # before cache-drop), stopping as soon as the free list
+            # covers the request
+            for rec in list(self._reclaimers):
+                with self._lock:
+                    short = n - len(self._free)
+                if short <= 0:
+                    break
+                try:
+                    rec(short)
+                except BaseException:  # a broken evictor must not kill alloc
+                    pass
             got = self._try_alloc(n, owner)
         if got is None:
             with self._lock:
@@ -384,11 +459,275 @@ class PagedKVCachePool:
         self._publish()
 
     def register_reclaimer(self, fn) -> None:
-        """Install the eviction seam ``fn(n_short) -> int`` consulted
-        (outside the pool lock) when ``alloc`` finds the free list
-        short — the prefix cache registers itself here so its
-        cached-but-unreferenced blocks are reclaimable memory."""
-        self._reclaimer = fn
+        """Append an eviction seam ``fn(n_short) -> int`` to the
+        reclaimer CHAIN consulted (outside the pool lock, in
+        registration order) when ``alloc`` finds the free list short —
+        the prefix cache registers demote-to-host first and drop
+        second, so exhaustion demotes cold blocks before anything is
+        lost."""
+        self._reclaimers.append(fn)
+
+    # ----------------------------------------------------- host tier
+
+    @property
+    def host_enabled(self) -> bool:
+        """Whether the host-RAM tier is configured (``host_blocks``
+        > 0). Disabled pools refuse every swap, so pre-tier callers
+        keep their exact pre-tier behavior."""
+        return bool(self._host_budget)
+
+    def set_host_budget(self, host_blocks: Optional[int]) -> None:
+        """Resize the host-tier budget at runtime (the
+        ``faultinject.HostTierPressure`` seam). Shrinking below current
+        occupancy does not drop anything — existing entries stay valid;
+        new swap-outs are refused until occupancy falls under the new
+        budget."""
+        self._host_budget = (None if host_blocks is None
+                             else max(0, int(host_blocks)))
+        self._publish()
+
+    def host_blocks_used(self) -> int:
+        with self._lock:
+            return len(self._host)
+
+    def host_budget(self) -> Optional[int]:
+        return self._host_budget
+
+    def swap_out(self, ids: List[int],
+                 owner: Optional[str] = None) -> Optional[List[int]]:
+        """Demote block CONTENTS to the host tier: copy each listed
+        block's per-layer K/V (and quantized scale rows — the raw
+        stored bytes, so a quantized round trip is bit-identical by
+        construction) out of the device arrays, release the CALLER's
+        device reference (other holders keep theirs — the copy is
+        private), and return one opaque host handle per block at host
+        refcount 1. Returns None — and touches nothing — when the tier
+        is disabled or the budget cannot cover the batch; the caller
+        falls back to the pre-tier path (free / cache-drop /
+        re-prefill)."""
+        if not ids:
+            return []
+        tag = owner if owner is not None else UNTAGGED_OWNER
+        with self._lock:
+            if not self._host_budget or \
+                    len(self._host) + len(ids) > self._host_budget:
+                return None
+        t0 = time.perf_counter()
+        datas = []
+        for b in ids:
+            idx = jnp.asarray(int(b), jnp.int32)
+            datas.append([{comp: _gather_block(arr, idx)
+                           for comp, arr in entry.items()}
+                          for entry in self.layers])
+        handles: List[int] = []
+        with self._lock:
+            if len(self._host) + len(datas) > (self._host_budget or 0):
+                return None
+            self._tick_attr_locked()
+            for data in datas:
+                self._host_counter += 1
+                h = self._host_counter
+                self._host[h] = {"data": data, "refs": 1,
+                                 "owners": [tag]}
+                handles.append(h)
+            self._owner_host_refs[tag] = (
+                self._owner_host_refs.get(tag, 0) + len(handles))
+        self.free_blocks(ids, owner)
+        ms = (time.perf_counter() - t0) * 1e3
+        per_blk = ms / len(handles)
+        self._swap_out_ms = (per_blk if self._swap_out_ms is None else
+                             0.8 * self._swap_out_ms + 0.2 * per_blk)
+        reg = get_registry()
+        reg.counter(KVTIER_SWAP_OUT_COUNTER,
+                    "KV blocks demoted device→host (contents copied, "
+                    "device block freed)", pool=self.name).inc(len(handles))
+        reg.histogram(KVTIER_SWAP_LATENCY_HISTOGRAM,
+                      "Per-block KV tier swap latency (dir=out D2H, "
+                      "dir=in H2D — the resume-crossover input)",
+                      dir="out").observe(per_blk)
+        self._publish()
+        return handles
+
+    def swap_in(self, handles: List[int],
+                owner: Optional[str] = None) -> Optional[List[int]]:
+        """Promote host-tier contents back onto the device: allocate
+        one fresh device block per handle (the reclaimer chain runs
+        exactly as for any alloc), scatter the stored contents in, and
+        drop one host reference per handle (the last drop deletes the
+        entry). Returns the device block ids — private, refcount 1 —
+        or None (nothing consumed, handles stay valid) when the device
+        pool cannot cover the batch."""
+        if not handles:
+            return []
+        with self._lock:
+            for h in handles:
+                if int(h) not in self._host:
+                    raise KVHostTierError(
+                        f"pool {self.name!r}: swap_in of unknown host "
+                        f"handle {h} (double free?)")
+        t0 = time.perf_counter()
+        got = self.alloc(len(handles), owner)
+        if got is None:
+            return None
+        for h, b in zip(handles, got):
+            with self._lock:
+                data = self._host[int(h)]["data"]
+            idx = jnp.asarray(int(b), jnp.int32)
+            for li, per in enumerate(data):
+                layer = self.layers[li]
+                for comp, val in per.items():
+                    layer[comp] = _scatter_block(
+                        layer[comp], idx, jnp.asarray(val))
+        self.free_host(handles, owner)
+        ms = (time.perf_counter() - t0) * 1e3
+        per_blk = ms / len(handles)
+        self._swap_in_ms = (per_blk if self._swap_in_ms is None else
+                            0.8 * self._swap_in_ms + 0.2 * per_blk)
+        reg = get_registry()
+        reg.counter(KVTIER_SWAP_IN_COUNTER,
+                    "KV blocks promoted host→device (contents scattered "
+                    "into freshly allocated blocks)",
+                    pool=self.name).inc(len(handles))
+        reg.histogram(KVTIER_SWAP_LATENCY_HISTOGRAM,
+                      "Per-block KV tier swap latency (dir=out D2H, "
+                      "dir=in H2D — the resume-crossover input)",
+                      dir="in").observe(per_blk)
+        self._publish()
+        return got
+
+    def free_host(self, handles: List[int],
+                  owner: Optional[str] = None) -> None:
+        """Drop ONE host reference per handle; entries whose last
+        reference drops leave the tier (their budget slot frees).
+        Dropping an unknown handle is a double free and raises —
+        the same law as :meth:`free_blocks`, per tier."""
+        if not handles:
+            return
+        tag = owner if owner is not None else UNTAGGED_OWNER
+        with self._lock:
+            for h in handles:
+                if int(h) not in self._host:
+                    raise KVHostTierError(
+                        f"pool {self.name!r}: double free of host "
+                        f"handle {h}")
+            self._tick_attr_locked()
+            for h in handles:
+                e = self._host[int(h)]
+                owners = e["owners"]
+                if tag in owners:
+                    owners.remove(tag)
+                    billed = tag
+                elif UNTAGGED_OWNER in owners:
+                    owners.remove(UNTAGGED_OWNER)
+                    billed = UNTAGGED_OWNER
+                elif owners:
+                    billed = owners.pop()
+                else:
+                    billed = UNTAGGED_OWNER
+                held = self._owner_host_refs.get(billed, 0)
+                if held > 1:
+                    self._owner_host_refs[billed] = held - 1
+                else:
+                    self._owner_host_refs.pop(billed, None)
+                e["refs"] -= 1
+                if e["refs"] <= 0:
+                    del self._host[int(h)]
+        self._publish()
+
+    def share_host(self, handles: List[int],
+                   owner: Optional[str] = None) -> List[int]:
+        """Take one extra host reference per handle (a durable
+        hibernation handle pinned by both the engine record and an
+        in-flight export, say)."""
+        tag = owner if owner is not None else UNTAGGED_OWNER
+        with self._lock:
+            for h in handles:
+                if int(h) not in self._host:
+                    raise ValueError(
+                        f"pool {self.name!r}: cannot share unknown host "
+                        f"handle {h}")
+            self._tick_attr_locked()
+            for h in handles:
+                e = self._host[int(h)]
+                e["refs"] += 1
+                e["owners"].append(tag)
+            self._owner_host_refs[tag] = (
+                self._owner_host_refs.get(tag, 0) + len(handles))
+        return list(handles)
+
+    def host_export(self, handles: List[int]) -> List[Dict[str, np.ndarray]]:
+        """Materialize host entries for shipping (the v4 raw-segment
+        cross-endpoint restore): one flat ``{"k0": [bs,h,hd], "v0":
+        ..., "k_scale0": [bs,h], ...}`` dict per handle, numpy, the
+        raw stored bytes (quantized values ship quantized). References
+        are NOT consumed."""
+        out = []
+        for h in handles:
+            with self._lock:
+                data = self._host[int(h)]["data"]
+            flat = {}
+            for li, per in enumerate(data):
+                for comp, val in per.items():
+                    flat[f"{comp}{li}"] = np.asarray(val)
+            out.append(flat)
+        return out
+
+    def host_insert(self, blocks: List[Dict[str, np.ndarray]],
+                    owner: Optional[str] = None) -> Optional[List[int]]:
+        """Admit SHIPPED block contents (the :meth:`host_export`
+        layout) straight into the host tier — the landing dock of a
+        cross-endpoint restore: the receiving engine inserts the raw
+        segments here and the ordinary swap-in path finishes the
+        restore. Returns the new handles, or None when the tier is
+        disabled or over budget (the caller then falls back to the
+        journaled-prefix rung)."""
+        if not blocks:
+            return []
+        tag = owner if owner is not None else UNTAGGED_OWNER
+        datas = []
+        for flat in blocks:
+            per_layer: List[Dict[str, object]] = [
+                {} for _ in range(self.num_layers)]
+            for key, val in flat.items():
+                comp = key.rstrip("0123456789")
+                li = int(key[len(comp):])
+                per_layer[li][comp] = np.asarray(val)
+            datas.append(per_layer)
+        with self._lock:
+            if not self._host_budget or \
+                    len(self._host) + len(datas) > self._host_budget:
+                return None
+            self._tick_attr_locked()
+            handles = []
+            for data in datas:
+                self._host_counter += 1
+                h = self._host_counter
+                self._host[h] = {"data": data, "refs": 1,
+                                 "owners": [tag]}
+                handles.append(h)
+            self._owner_host_refs[tag] = (
+                self._owner_host_refs.get(tag, 0) + len(handles))
+        self._publish()
+        return handles
+
+    def swap_in_cost_ms(self) -> Optional[float]:
+        """Measured per-block H2D restore cost (EWMA; None until the
+        first swap_in) — one half of the scheduler's per-block
+        swap-vs-recompute resume crossover."""
+        return self._swap_in_ms
+
+    def warm_swap_programs(self) -> None:
+        """Prime the traced-index gather/scatter executables against
+        the trash block (block 0: accounting untouched, contents
+        disposable), so no steady-state swap ever traces+compiles —
+        the scheduler's warmup calls this when the tier is on."""
+        idx = jnp.asarray(0, jnp.int32)
+        for li, entry in enumerate(self.layers):
+            new = {}
+            for comp, arr in entry.items():
+                val = _gather_block(arr, idx)
+                new[comp] = _scatter_block(arr, idx, val)
+            self.layers[li] = new
 
     def shared_count(self) -> int:
         """Blocks currently held by more than one reference (live
@@ -407,6 +746,7 @@ class PagedKVCachePool:
             free = len(self._free)
             failures = self._alloc_failures
             shared = sum(1 for r in self._refs.values() if r > 1)
+            host_used = len(self._host)
         return {"blocks_total": self.total_blocks, "blocks_free": free,
                 "block_size": self.block_size,
                 "quant": self.quant,
@@ -414,7 +754,11 @@ class PagedKVCachePool:
                 "occupancy": ((self.total_blocks - free) / self.total_blocks
                               if self.total_blocks else 0.0),
                 "shared_blocks": shared,
-                "alloc_failures": failures}
+                "alloc_failures": failures,
+                "host_blocks_used": host_used,
+                "host_budget": self._host_budget or 0,
+                "host_occupancy": (host_used / self._host_budget
+                                   if self._host_budget else 0.0)}
 
     def attribution(self) -> Dict[str, object]:
         """Per-owner capacity bill: byte-seconds of pool references
@@ -428,14 +772,26 @@ class PagedKVCachePool:
             owners = dict(self._owner_bs)
             held = dict(self._owner_refs)
             total = self._pool_bs
+            host_owners = dict(self._owner_host_bs)
+            host_held = dict(self._owner_host_refs)
+            host_total = self._host_bs
         reg = get_registry()
         for owner, bs in owners.items():
             reg.gauge(ATTR_KV_BYTE_SECONDS_GAUGE,
                       "Cumulative KV-block byte-seconds held, per owner",
                       pool=self.name, owner=owner).set(bs)
+        for owner, bs in host_owners.items():
+            reg.gauge(ATTR_KV_HOST_BYTE_SECONDS_GAUGE,
+                      "Cumulative HOST-tier KV byte-seconds held, per "
+                      "owner (host RAM billed separately from device "
+                      "HBM — the conservation law holds per tier)",
+                      pool=self.name, owner=owner).set(bs)
         return {"pool": self.name, "block_bytes": self._block_bytes,
                 "byte_seconds": owners, "held_refs": held,
-                "total_byte_seconds": total}
+                "total_byte_seconds": total,
+                "host_byte_seconds": host_owners,
+                "held_host_refs": host_held,
+                "host_total_byte_seconds": host_total}
 
     def block_bytes(self) -> int:
         """Device bytes one logical block occupies across every layer's
@@ -482,6 +838,12 @@ class PagedKVCachePool:
         reg.gauge(KVPOOL_BLOCKS_FREE_GAUGE,
                   "KV cache blocks currently free in the paged pool",
                   pool=self.name).set(free)
+        if self._host_budget:
+            with self._lock:
+                host_used = len(self._host)
+            reg.gauge(KVTIER_HOST_BLOCKS_GAUGE,
+                      "KV blocks resident in the host-RAM tier",
+                      pool=self.name).set(host_used)
         if self.quant is not None:
             reg.gauge(QUANT_KV_BLOCKS_GAUGE,
                       "Allocatable blocks held in QUANTIZED (int8/fp8) "

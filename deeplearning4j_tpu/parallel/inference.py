@@ -371,6 +371,7 @@ class ParallelInference:
                  spec_tokens: int = 4,
                  spec_max_rows: Optional[int] = None,
                  draft_net=None,
+                 kv_host_blocks: Optional[int] = None,
                  slice_plane=None):
         if net is None and registry is None:
             raise ValueError("ParallelInference needs a net or a registry")
@@ -528,6 +529,15 @@ class ParallelInference:
         if (speculative or draft_net is not None) and not self.continuous:
             raise ValueError(
                 "speculative=/draft_net= ride the paged-pool scheduler: "
+                "build the engine with continuous=True")
+        # host-RAM KV tier (nn/kvpool.py): preempted/hibernated sessions
+        # swap their paged blocks to pinned host memory instead of
+        # freeing them, so resume is a D2H/H2D round trip — not a
+        # re-prefill — and end-of-turn hibernation survives the engine
+        self.kv_host_blocks = kv_host_blocks
+        if kv_host_blocks is not None and not self.continuous:
+            raise ValueError(
+                "kv_host_blocks= tiers the paged-pool scheduler: "
                 "build the engine with continuous=True")
         self._scheduler = None
         if self.slice_plane is not None:
@@ -765,6 +775,7 @@ class ParallelInference:
                 net=self.net, registry=self._registry, device=dev,
                 slots=self.decode_slots, burst_tokens=self.decode_burst,
                 block_size=self.kv_block_size, num_blocks=self.kv_blocks,
+                host_kv_blocks=self.kv_host_blocks,
                 kv_quant=self.kv_quant,
                 kv_bytes_budget=self.kv_bytes_budget,
                 queue_capacity=self._rq.maxsize,
@@ -789,7 +800,8 @@ class ParallelInference:
                         priority: int = 0,
                         on_tokens=None,
                         prefix: Optional[np.ndarray] = None,
-                        kv_state=None
+                        kv_state=None,
+                        hibernate: bool = False
                         ) -> "Future[np.ndarray]":
         """Enqueue one decode request (``prompt_ids``: [n, t0] int
         tokens); the Future resolves to the [n, t0 + max_new_tokens]
@@ -810,7 +822,17 @@ class ParallelInference:
         same contract, coarser granularity). ``prefix`` resumes a
         migrated stream from prompt + already-generated tokens; it
         rides the continuous scheduler's preempt/resume machinery and
-        therefore requires ``continuous=True``."""
+        therefore requires ``continuous=True``.
+
+        ``hibernate=True`` (continuous + ``kv_host_blocks`` engines)
+        swaps the session's KV blocks to the host tier at end-of-turn
+        instead of freeing them — the next ``submit_generate`` of the
+        same ``session`` restores them via swap-in rather than
+        re-prefilling. A ``kv_state`` dict carrying ``"blocks"`` is a
+        hibernation payload from another endpoint's
+        :meth:`hibernate_export`: it is imported into the local host
+        tier first, then the request resumes through the same swap-in
+        path."""
         if self._closed:
             raise EngineShutdown("ParallelInference is shut down")
         if self._slice_dead is not None:
@@ -827,11 +849,23 @@ class ParallelInference:
                                 "generate() requests").inc()
             with self._lock:
                 self._requests += 1
-            return self._continuous_scheduler().submit(
+            sched = self._continuous_scheduler()
+            if isinstance(kv_state, dict) and "blocks" in kv_state:
+                # shipped hibernation payload (cross-endpoint resume):
+                # seed the local host tier, then resume rides the SAME
+                # swap-in path a locally-hibernated session takes
+                sched.hibernate_import(
+                    session, kv_state["blocks"], kv_state["covered"],
+                    kv_state["tokens"], model=model, version=v,
+                    prompt=kv_state.get("prompt"),
+                    generated=kv_state.get("generated"))
+                kv_state = None
+            return sched.submit(
                 prompt_ids, max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_token=eos_token, seed=seed,
                 priority=priority, model=model, version=v, session=session,
-                on_tokens=on_tokens, prefix=prefix, kv_state=kv_state)
+                on_tokens=on_tokens, prefix=prefix, kv_state=kv_state,
+                hibernate=hibernate)
         if prefix is not None:
             raise ValueError(
                 "prefix resume rides the iteration-level preempt/resume "
@@ -840,6 +874,10 @@ class ParallelInference:
             raise ValueError(
                 "kv_state handoff rides the paged-pool scheduler: build "
                 "the engine with continuous=True")
+        if hibernate:
+            raise ValueError(
+                "hibernate=True parks KV in the paged pool's host tier: "
+                "build the engine with continuous=True and kv_host_blocks=")
         gen = self._generator() if mv is None else mv.generator()
         prompt = np.asarray(prompt_ids)
         if prompt.ndim != 2:
@@ -887,6 +925,58 @@ class ParallelInference:
         """Blocking facade over :meth:`submit_generate`."""
         return self.submit_generate(prompt_ids, max_new_tokens,
                                     **kwargs).result(timeout=timeout)
+
+    # --------------------------------------------- session hibernation
+
+    def _hibernation_scheduler(self):
+        if not self.continuous:
+            raise ValueError(
+                "session hibernation parks KV in the paged pool's host "
+                "tier: build the engine with continuous=True and "
+                "kv_host_blocks=")
+        return self._continuous_scheduler()
+
+    def hibernate_export(self, session: str) -> Optional[Dict]:
+        """Snapshot a hibernated session's host-tier KV as a portable
+        payload (non-consuming): per-block raw K/V + quantized scales,
+        the covered token journal, and the (model, version) lane — what
+        a router ships to a surviving endpoint so the session resumes
+        THERE bitwise after this endpoint dies. None if the session has
+        no hibernation record."""
+        if self._scheduler is None:
+            self._hibernation_scheduler()
+            return None
+        return self._hibernation_scheduler().hibernate_export(session)
+
+    def hibernate_import(self, session: str, blocks, covered: int,
+                         tokens, model: Optional[str] = None,
+                         version: Optional[int] = None,
+                         prompt=None, generated=None) -> bool:
+        """Seed the local host tier with a shipped hibernation payload
+        (:meth:`hibernate_export` from another endpoint) so the next
+        ``submit_generate(session=...)`` resumes via swap-in instead of
+        re-prefilling. Returns False when the host tier is disabled or
+        over budget (the caller falls back to journaled-prefix resume)."""
+        v = version
+        if model is not None and self._registry is not None:
+            v = self._registry.resolve(model, version)
+        return self._hibernation_scheduler().hibernate_import(
+            session, blocks, covered, tokens, model=model, version=v,
+            prompt=prompt, generated=generated)
+
+    def hibernate_release(self, session: str) -> bool:
+        """Drop a session's hibernation record and free its host-tier
+        blocks (the abandon path — resume consumes the record itself)."""
+        if self._scheduler is None:
+            self._hibernation_scheduler()
+            return False
+        return self._hibernation_scheduler().hibernate_release(session)
+
+    def hibernated_count(self) -> int:
+        """Live hibernated-session records parked in the host tier."""
+        if not self.continuous or self._scheduler is None:
+            return 0
+        return self._scheduler.hibernated_count()
 
     # --------------------------------------- disaggregated prefill
 

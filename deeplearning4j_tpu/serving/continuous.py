@@ -75,6 +75,8 @@ from deeplearning4j_tpu.monitor import (
     ATTR_DECODE_TOKENS_COUNTER,
     ATTR_PREFILL_TOKENS_COUNTER,
     ATTR_QUEUE_MS_COUNTER,
+    KVTIER_HIBERNATED_COUNTER,
+    KVTIER_RESTORE_COUNTER,
     SCHED_ACTIVE_GAUGE,
     SCHED_ADMITTED_COUNTER,
     SCHED_BURST_LATENCY_HISTOGRAM,
@@ -148,13 +150,14 @@ class _DecodeRequest:
     __slots__ = ("prompt", "n", "t_in", "max_new", "temperature", "top_k",
                  "top_p", "eos", "seed", "priority", "model", "version",
                  "session", "future", "rows_done", "t_submit", "t_first",
-                 "rows", "on_tokens", "prefix", "kv_state", "trace", "root")
+                 "rows", "on_tokens", "prefix", "kv_state", "hibernate",
+                 "trace", "root")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float,
                  top_k: int, top_p: float, eos: Optional[int], seed: int,
                  priority: int, model, version, session,
                  on_tokens=None, prefix: Optional[np.ndarray] = None,
-                 kv_state=None):
+                 kv_state=None, hibernate: bool = False):
         self.prompt = np.asarray(prompt, np.int64)
         self.n, self.t_in = self.prompt.shape
         self.max_new = int(max_new)
@@ -174,6 +177,10 @@ class _DecodeRequest:
         # into pool blocks and samples tok0 off the shipped logits
         # instead of running the prompt forward here
         self.kv_state = kv_state
+        # end-of-turn hibernation (host-tier sessions): instead of
+        # freeing the finished row's blocks, swap them out and file a
+        # durable session record a later turn restores via swap-in
+        self.hibernate = bool(hibernate)
         self.future: "Future[np.ndarray]" = Future()
         self.rows_done = 0
         self.t_submit = time.perf_counter()
@@ -197,7 +204,8 @@ class _Seq:
 
     __slots__ = ("req", "row", "fed", "generated", "key", "n_gen", "slot",
                  "blocks", "draft_blocks", "pos", "seq_id", "preemptions",
-                 "emitted", "t_queued", "carry")
+                 "emitted", "t_queued", "carry", "host_handles",
+                 "host_covered")
 
     def __init__(self, req: _DecodeRequest, row: int, key: np.ndarray,
                  seq_id: int):
@@ -219,6 +227,13 @@ class _Seq:
         # the unsalted admission draw would break sampled resume parity
         # (the uninterrupted run draws that clock index on a spec lane)
         self.carry: Optional[int] = None
+        # host-tier preempt-swap: a preemption with the host tier on
+        # swaps the victim's blocks out instead of freeing them; the
+        # handles (and the written-KV token count they cover) ride the
+        # queue and the next admission swaps them back in instead of
+        # re-prefilling (subject to the per-block crossover)
+        self.host_handles: Optional[List[int]] = None
+        self.host_covered = 0
         self.pos = 0
         self.seq_id = seq_id
         self.preemptions = 0
@@ -251,15 +266,20 @@ class _AdmitPlan:
     (released once the device copy lands), and the group ``sig`` that
     decides which admissions coalesce into one prefill dispatch."""
 
-    __slots__ = ("seq", "blocks", "start", "cow_src", "sig")
+    __slots__ = ("seq", "blocks", "start", "cow_src", "sig", "restored")
 
     def __init__(self, seq: _Seq, blocks: List[int], start: int,
-                 cow_src: Optional[int], sig: Tuple):
+                 cow_src: Optional[int], sig: Tuple,
+                 restored: bool = False):
         self.seq = seq
         self.blocks = blocks
         self.start = start
         self.cow_src = cow_src
         self.sig = sig
+        # host-tier swap-in restore: ``start`` tokens were restored
+        # from the host tier (not matched in the prefix cache) — the
+        # tail prefill treats both the same, the accounting must not
+        self.restored = restored
 
 
 class _Lane:
@@ -370,7 +390,8 @@ class ContinuousDecodeScheduler:
                  on_fatal=None, kv_quant: Optional[str] = None,
                  kv_bytes_budget: Optional[int] = None,
                  speculative: bool = False, spec_tokens: int = 4,
-                 spec_max_rows: Optional[int] = None, draft_net=None):
+                 spec_max_rows: Optional[int] = None, draft_net=None,
+                 host_kv_blocks: Optional[int] = None):
         if net is None and registry is None:
             raise ValueError(
                 "ContinuousDecodeScheduler needs a net or a registry")
@@ -406,6 +427,26 @@ class ContinuousDecodeScheduler:
             raise ValueError("kv_bytes_budget= and num_blocks= are "
                              "exclusive — the budget derives num_blocks")
         self._kv_bytes_budget = kv_bytes_budget
+        # KV tiering (CachedAttention/InfiniGen discipline): give every
+        # pool a host-RAM tier of ``host_kv_blocks`` blocks. Preemption
+        # and hibernating end-of-turn retires swap blocks OUT instead of
+        # freeing them, resumes swap back IN instead of re-prefilling
+        # (per-block H2D-vs-recompute crossover), and pool exhaustion
+        # demotes cold prefix-cache blocks to host before dropping any.
+        # None/0 = tier off: behavior is bit-for-bit the pre-tier path.
+        self._host_kv_blocks = (None if host_kv_blocks is None
+                                else max(0, int(host_kv_blocks)))
+        # durable hibernated sessions: session -> {handles, covered,
+        # tokens, lane, prompt, generated, imported}; host blocks held
+        # here intentionally survive drain (like the prefix cache) —
+        # release via resume or hibernate_release()
+        self._hibernated: Dict[str, Dict[str, Any]] = {}
+        self._hibernated_total = 0
+        self._preempt_swapouts = 0
+        self._swap_restores = 0
+        # prefill cost EWMA (ms per computed token) — the recompute
+        # side of the swap-in crossover; None until the first prefill
+        self._prefill_ms_per_token: Optional[float] = None
         self.queue_capacity = max(1, int(queue_capacity))
         # speculative decoding (Leviathan/Chen 2023): a cheap DRAFT net
         # proposes spec_tokens greedy/sampled tokens per round on its
@@ -536,7 +577,8 @@ class ContinuousDecodeScheduler:
                session: Optional[str] = None,
                on_tokens=None,
                prefix: Optional[np.ndarray] = None,
-               kv_state=None) -> "Future[np.ndarray]":
+               kv_state=None,
+               hibernate: bool = False) -> "Future[np.ndarray]":
         """Enqueue one decode request; the Future resolves to the
         [n, t0 + max_new_tokens] ids a solo ``net.generate`` of the
         same rows would return (greedy: token-for-token; sampled: the
@@ -579,6 +621,14 @@ class ContinuousDecodeScheduler:
             raise ValueError(
                 "kv_state ships the PROMPT's cache; a resume prefix "
                 "re-prefills — the two paths are exclusive")
+        if hibernate and session is None:
+            raise ValueError(
+                "hibernate=True files a durable SESSION record at "
+                "end-of-turn — it needs session=")
+        if hibernate and prompt.shape[0] != 1:
+            raise ValueError(
+                "hibernation is per-session: prompt must be [1, t0], "
+                f"got {prompt.shape}")
         if pre is not None and len(pre) >= max_new:
             # every token was already generated before the migration —
             # only the terminal frame was lost; synthesize it
@@ -602,7 +652,7 @@ class ContinuousDecodeScheduler:
             max(1, max_new - (0 if pre is None else len(pre))))
         req = _DecodeRequest(prompt, max_new, temperature, top_k, top_p,
                              eos_token, seed, priority, model, version,
-                             session, on_tokens, pre, kv_state)
+                             session, on_tokens, pre, kv_state, hibernate)
         self._trace_begin(req)
         keys = np.asarray(row_keys(req.seed, req.n))
         with self._cv:
@@ -670,6 +720,16 @@ class ContinuousDecodeScheduler:
             if agg["blocks_total"] else 0.0)
         out["pool"] = agg
         out["pools"] = pools
+        out["kvtier"] = {
+            "enabled": bool(self._host_kv_blocks),
+            "host_blocks_used": sum(p.get("host_blocks_used", 0)
+                                    for p in pools),
+            "host_budget": sum(p.get("host_budget", 0) for p in pools),
+            "hibernated_sessions": len(self._hibernated),
+            "hibernated_total": self._hibernated_total,
+            "preempt_swapouts": self._preempt_swapouts,
+            "swap_restores": self._swap_restores,
+        }
         if dpools:
             # the draft lane's pools stay OUT of the main aggregate so
             # a dual-lane leak audit can name which lane leaked
@@ -736,6 +796,15 @@ class ContinuousDecodeScheduler:
             else:
                 self._fail_everything(
                     EngineShutdown("scheduler shut down before dispatch"))
+        # hibernated sessions' host-tier entries die with the scheduler
+        # (a durable handle outlives the ENDPOINT only when the worker
+        # shipped it — the router's copy, not this one)
+        with self._lock:
+            recs = list(self._hibernated.values())
+            self._hibernated.clear()
+        for rec in recs:
+            self._lane_for(*rec["lane"]).pool.free_host(
+                rec["handles"], owner=_owner_key(rec["lane"]))
 
     def warmup(self, prompt_lengths, max_new_tokens: int = 1,
                model: Optional[str] = None,
@@ -944,6 +1013,13 @@ class ContinuousDecodeScheduler:
                                 np.zeros(rows, np.int32),
                                 np.full(rows, t_tail, np.int32),
                                 np.zeros((rows, tier), np.int32))[0])
+            if pool.host_enabled:
+                # swap gather/scatter run on the trash block — the
+                # steady-state ladder includes the tiering programs
+                pool.warm_swap_programs()
+                if lane.draft_pool is not None \
+                        and lane.draft_pool.host_enabled:
+                    lane.draft_pool.warm_swap_programs()
         self._warmed = True
         return int(reg.family_total(JIT_CACHE_MISS_COUNTER) - before)
 
@@ -1036,7 +1112,8 @@ class ContinuousDecodeScheduler:
                     else self.device,
                     sharding=kv_sharding,
                     name=model if model is not None else "decode",
-                    quant=self.kv_quant)
+                    quant=self.kv_quant,
+                    host_blocks=self._host_kv_blocks)
                 self._pools[spec] = pool
                 if self.prefix_cache:
                     from deeplearning4j_tpu.serving.prefixcache import \
@@ -1182,13 +1259,17 @@ class ContinuousDecodeScheduler:
                 return t
         return lane.mb
 
-    def _plan_blocks(self, lane: _Lane, seq: _Seq):
-        """Probe the prefix cache and claim every block this admission
-        needs. Returns an ``_AdmitPlan`` (blocks in table order,
-        matched ``start``, the pending COW source ref, and the group
-        signature), or None when the pool cannot cover it right now
-        (everything claimed was released — blocks return as running
-        rows retire)."""
+    def _plan_blocks(self, lane: _Lane, seq: _Seq,
+                     allow_restore: bool = True):
+        """Probe the host tier and the prefix cache and claim every
+        block this admission needs. Returns an ``_AdmitPlan`` (blocks
+        in table order, matched ``start``, the pending COW source ref,
+        and the group signature), or None when the pool cannot cover
+        it right now (everything claimed was released — blocks return
+        as running rows retire). ``allow_restore=False`` (non-anchor
+        group riders) defers host-tier restores to a round where the
+        sequence anchors — a restore consumed into a plan must never
+        be rolled back by a mere signature mismatch."""
         pool = lane.pool
         owner = _owner_key(lane.key)
         t_full = len(seq.fed)
@@ -1203,6 +1284,13 @@ class ContinuousDecodeScheduler:
             t_pad = lane.gen.prompt_bucket(t_full, max(1, seq.remaining))
             return _AdmitPlan(seq, got, 0, None,
                               ("ship", self._round_blocks(t_pad)))
+        if not allow_restore and self._has_host_state(seq):
+            return None
+        if allow_restore:
+            restored, plan = self._plan_host_restore(
+                lane, seq, owner, t_full, need_total)
+            if restored:
+                return plan
         cache = self._cache_of(lane)
         m, shared, partial = (0, [], None)
         if cache is not None:
@@ -1234,6 +1322,148 @@ class ContinuousDecodeScheduler:
         tier = self._tier_cover(lane, len(blocks))
         return _AdmitPlan(seq, blocks, m, partial,
                           ("tail", t_tail_pad, tier))
+
+    # ------------------------------------------------- host-tier restore
+
+    def _has_host_state(self, seq: _Seq) -> bool:
+        """Whether this sequence's admission could restore from the
+        host tier (preempt-swap handles on the seq, or a hibernated
+        record for its session)."""
+        if seq.host_handles:
+            return True
+        if seq.req.session is None:
+            return False
+        with self._lock:
+            return seq.req.session in self._hibernated
+
+    def _restore_cut(self, pool: PagedKVCachePool, handles: List[int],
+                     covered: int) -> int:
+        """The per-block H2D-vs-recompute crossover: walk the restored
+        prefix from its END and drop each block whose measured swap-in
+        cost exceeds recomputing its tokens at the measured prefill
+        rate (a partial tail block holds fewer tokens, so it loses
+        first). Restores are prefixes — dropping block i drops
+        everything after it too. Unmeasured on either side = swap
+        everything (the first restores are what produce the
+        measurements)."""
+        swap_ms = pool.swap_in_cost_ms()
+        per_tok = self._prefill_ms_per_token
+        keep = len(handles)
+        if not swap_ms or not per_tok:
+            return keep
+        bs = pool.block_size
+        while keep > 0:
+            toks = min(covered - (keep - 1) * bs, bs)
+            if toks > 0 and swap_ms <= toks * per_tok:
+                break
+            keep -= 1
+        return keep
+
+    def _plan_host_restore(self, lane: _Lane, seq: _Seq, owner: str,
+                           t_full: int, need_total: int):
+        """Try to source this admission's KV prefix from the HOST
+        tier: a preempt-swapped row carries its handles on the
+        sequence; a hibernated-session resume matches its durable
+        record by exact token prefix. Returns ``(handled, plan)``:
+        (False, None) = not a host restore — fall through to the
+        cache probe; (True, None) = restore pending but the pool
+        cannot cover it right now (handles kept — retry as rows
+        retire); (True, plan) = blocks claimed, prefix restored."""
+        pool = lane.pool
+        handles, covered, rec = seq.host_handles, seq.host_covered, None
+        if not handles and seq.req.session is not None:
+            with self._lock:
+                rec = self._hibernated.get(seq.req.session)
+            if rec is not None:
+                cov = int(rec["covered"])
+                if (rec["lane"] != lane.key or cov >= t_full
+                        or not np.array_equal(
+                            np.asarray(rec["tokens"], np.int64),
+                            np.asarray(seq.fed[:cov], np.int64))):
+                    # stale record: the resumed turn does not extend
+                    # the hibernated run — release it and re-prefill
+                    self._hibernate_drop(seq.req.session)
+                    rec = None
+                else:
+                    handles, covered = list(rec["handles"]), cov
+        if not handles:
+            return False, None
+        keep = self._restore_cut(pool, handles, covered)
+        drop = handles[keep:]
+        if keep < len(handles):
+            handles = handles[:keep]
+            covered = min(covered, keep * pool.block_size)
+            if rec is None:
+                # seq-owned handles: the crossover's verdict is final —
+                # release the dropped tail now (the tail prefill
+                # recomputes those tokens whether or not this plan
+                # lands this round)
+                pool.free_host(drop, owner=owner)
+                seq.host_handles = handles if handles else None
+                seq.host_covered = covered
+                drop = []
+        if keep == 0:
+            # recompute beats swapping for every block — abandon the
+            # restore entirely and admit through the normal paths
+            if rec is not None:
+                self._hibernate_drop(seq.req.session)
+            return False, None
+        fresh_need = need_total - len(handles)
+        got = pool.alloc(fresh_need, owner=owner) if fresh_need > 0 else []
+        if got is None:
+            return True, None
+        dev = pool.swap_in(handles, owner=owner)
+        if dev is None:
+            if got:
+                pool.free_blocks(got, owner=owner)
+            return True, None
+        if rec is not None:
+            with self._lock:
+                self._hibernated.pop(seq.req.session, None)
+            if drop:
+                pool.free_host(drop, owner=owner)
+        seq.host_handles, seq.host_covered = None, 0
+        with self._lock:
+            self._swap_restores += 1
+        path = "ship" if (rec is not None and rec.get("imported")) \
+            else "host"
+        get_registry().counter(
+            KVTIER_RESTORE_COUNTER,
+            "Sessions/rows restored from the KV tier, by restore-"
+            "ladder rung (host swap-in / cross-endpoint shipped / "
+            "journal re-prefill)", path=path).inc()
+        self.events.append(
+            f"swap_in seq={seq.seq_id} blocks={len(dev)} "
+            f"covered={covered} fresh={len(got)}")
+        blocks = dev + got
+        t_tail_pad = bucket_for(t_full - covered,
+                                bucket_sizes(lane.gen.max_context()))
+        tier = self._tier_cover(lane, len(blocks))
+        return True, _AdmitPlan(seq, blocks, covered, None,
+                                ("tail", t_tail_pad, tier),
+                                restored=True)
+
+    def _free_host_of(self, seq: _Seq) -> None:
+        """Release a dropped sequence's preempt-swap host handles
+        (every path that removes a queued sequence without admitting
+        it must come through here, or the host tier leaks)."""
+        if not seq.host_handles:
+            return
+        lane = self._lane_for(*self._lane_key(seq))
+        lane.pool.free_host(seq.host_handles, owner=_owner_key(lane.key))
+        seq.host_handles = None
+        seq.host_covered = 0
+
+    def _note_prefill_cost(self, tokens: int, dt_s: float) -> None:
+        """Feed the prefill-cost EWMA (ms per computed token) — the
+        recompute side of the swap-in crossover."""
+        if tokens <= 0 or dt_s <= 0:
+            return
+        ms = dt_s * 1e3 / tokens
+        with self._lock:
+            cur = self._prefill_ms_per_token
+            self._prefill_ms_per_token = (
+                ms if cur is None else 0.8 * cur + 0.2 * ms)
 
     def _rollback_plan(self, lane: _Lane, plan: "_AdmitPlan") -> None:
         owner = _owner_key(lane.key)
@@ -1270,6 +1500,7 @@ class ContinuousDecodeScheduler:
             if seq.req.future.done():
                 with self._lock:
                     self._queue.remove(seq)
+                self._free_host_of(seq)
                 continue
             lane = self._lane_for(*self._lane_key(seq))
             t_full = len(seq.fed)
@@ -1299,7 +1530,7 @@ class ContinuousDecodeScheduler:
                     break
                 if need > lane.pool.total_blocks or need > lane.mb:
                     continue  # it fails typed when it anchors
-                plan = self._plan_blocks(lane, seq)
+                plan = self._plan_blocks(lane, seq, allow_restore=False)
                 if plan is None:
                     break
                 if plan.sig != anchor[1]:
@@ -1366,6 +1597,8 @@ class ContinuousDecodeScheduler:
         # dl4j-lint: disable=hot-path-host-sync
         toks = np.asarray(rs(logits, keys, folds, temp, top_k, top_p))
         t1p = time.perf_counter()
+        self._note_prefill_cost(sum(len(s.fed) for s, _ in entries),
+                                t1p - t0p)
         self._trace_admitted(
             [(seq, {"bucket": t_pad, "rows": n, "computed": len(seq.fed)})
              for seq, _ in entries], t0p, t1p, "dense")
@@ -1452,6 +1685,8 @@ class ContinuousDecodeScheduler:
         # dl4j-lint: disable=hot-path-host-sync
         toks = np.asarray(rs(logits, keys, folds, temp, top_k, top_p))
         t1p = time.perf_counter()
+        self._note_prefill_cost(
+            sum(len(p.seq.fed) - p.start for p in entries), t1p - t0p)
         self._trace_admitted(
             [(p.seq, {"bucket": t_tail_pad, "tier": tier, "rows": n,
                       "computed": len(p.seq.fed) - p.start,
@@ -1462,7 +1697,9 @@ class ContinuousDecodeScheduler:
         for i, p in enumerate(entries):
             self._note_prefilled(p.seq, len(p.seq.fed) - p.start, t0p)
             if cache is not None:
-                cache.note_admitted(p.start)
+                # a host-tier restore's prefix came from the TIER, not
+                # the cache — it must not inflate cache-saved tokens
+                cache.note_admitted(0 if p.restored else p.start)
             self._install(lane, p.seq, p.blocks, int(toks[i]))
 
     def _prefill_shipped_batch(self, lane: _Lane, t_blk: int,
@@ -1888,9 +2125,11 @@ class ContinuousDecodeScheduler:
         if done0:
             # the prefill's first token already finished the row:
             # retire without ever occupying the slot
-            self._cache_insert(lane, seq)
-            lane.pool.free_blocks(seq.blocks, owner=_owner_key(lane.key))
-            seq.blocks = []
+            if not self._maybe_hibernate(lane, seq):
+                self._cache_insert(lane, seq)
+                lane.pool.free_blocks(seq.blocks,
+                                      owner=_owner_key(lane.key))
+                seq.blocks = []
             self._free_draft_blocks(lane, seq)
             self._retire_seq(lane, seq)
             return
@@ -1996,12 +2235,30 @@ class ContinuousDecodeScheduler:
         along, so the resumed tokens equal an uninterrupted run's."""
         lane = self._lane_for(*self._lane_key(seq))
         slot = seq.slot
-        # insert-before-free: with the prefix cache on, the victim's
-        # interior blocks survive as cached prefix — its resume then
-        # degrades to a table clone plus a short tail prefill
-        self._cache_insert(lane, seq)
-        lane.pool.free_blocks(seq.blocks, owner=_owner_key(lane.key))
-        seq.blocks = []
+        swapped = None
+        if lane.draft_gen is None and lane.pool.host_enabled \
+                and seq.pos > 0 and seq.blocks:
+            # host-tier preempt-swap (non-spec lanes; a spec victim's
+            # pending-carry keeps the cache/requeue path): the
+            # victim's written KV moves to host and its resume swaps
+            # back in instead of re-prefilling — subject to the
+            # per-block crossover at admission time
+            swapped = lane.pool.swap_out(seq.blocks,
+                                         owner=_owner_key(lane.key))
+        if swapped is not None:
+            seq.host_handles = swapped
+            seq.host_covered = seq.pos
+            seq.blocks = []
+            with self._lock:
+                self._preempt_swapouts += 1
+        else:
+            # insert-before-free: with the prefix cache on, the
+            # victim's interior blocks survive as cached prefix — its
+            # resume then degrades to a table clone plus a short tail
+            # prefill
+            self._cache_insert(lane, seq)
+            lane.pool.free_blocks(seq.blocks, owner=_owner_key(lane.key))
+            seq.blocks = []
         self._free_draft_blocks(lane, seq)
         if lane.draft_gen is not None and seq.n_gen > 0:
             # speculative pending-carry (see _Seq.carry): re-prefill
@@ -2406,10 +2663,11 @@ class ContinuousDecodeScheduler:
                 lane.pos[slot] = pos[slot]
                 lane.n_gen[slot] = n_gen[slot]
                 if bool(done[slot]):
-                    self._cache_insert(lane, seq)
-                    lane.pool.free_blocks(seq.blocks,
-                                          owner=_owner_key(lane.key))
-                    seq.blocks = []
+                    if not self._maybe_hibernate(lane, seq):
+                        self._cache_insert(lane, seq)
+                        lane.pool.free_blocks(seq.blocks,
+                                              owner=_owner_key(lane.key))
+                        seq.blocks = []
                     self._free_draft_blocks(lane, seq)
                     lane.clear_slot(slot)
                     seq.slot = None
@@ -2470,6 +2728,135 @@ class ContinuousDecodeScheduler:
         if req.t_first is None:
             req.t_first = time.perf_counter()
 
+    # ------------------------------------------------ session hibernation
+
+    def _maybe_hibernate(self, lane: _Lane, seq: _Seq) -> bool:
+        """End-of-turn hibernation: swap the finished row's blocks out
+        and file the durable session record (handles + the exact token
+        run they cover) a later same-session submit restores from.
+        Returns whether the blocks were taken — the caller then skips
+        the cache-insert/free path. Swap-out refusal (tier off, host
+        budget full) falls back to the normal retire: the journaled-
+        prefix rung still resumes the session, just slower."""
+        req = seq.req
+        if not req.hibernate or req.session is None:
+            return False
+        if not lane.pool.host_enabled or not seq.blocks or seq.pos <= 0:
+            return False
+        owner = _owner_key(lane.key)
+        handles = lane.pool.swap_out(seq.blocks, owner=owner)
+        if handles is None:
+            return False
+        seq.blocks = []
+        tokens = np.concatenate(
+            [req.prompt[seq.row].astype(np.int64),
+             np.asarray(seq.generated, np.int64)])[:seq.pos]
+        with self._lock:
+            old = self._hibernated.pop(req.session, None)
+            self._hibernated[req.session] = {
+                "handles": handles, "covered": int(seq.pos),
+                "tokens": tokens, "lane": lane.key,
+                "prompt": np.asarray(req.prompt[seq.row], np.int64),
+                "generated": np.asarray(seq.generated, np.int64),
+                "imported": False,
+            }
+            self._hibernated_total += 1
+        if old is not None:
+            self._lane_for(*old["lane"]).pool.free_host(
+                old["handles"], owner=_owner_key(old["lane"]))
+        get_registry().counter(
+            KVTIER_HIBERNATED_COUNTER,
+            "Sessions hibernated at end-of-turn (KV swapped to the "
+            "host tier, durable resume record filed)").inc()
+        self.events.append(
+            f"hibernate session={req.session} covered={seq.pos} "
+            f"blocks={len(handles)}")
+        return True
+
+    def _hibernate_drop(self, session: str) -> bool:
+        with self._lock:
+            rec = self._hibernated.pop(session, None)
+        if rec is None:
+            return False
+        self._lane_for(*rec["lane"]).pool.free_host(
+            rec["handles"], owner=_owner_key(rec["lane"]))
+        return True
+
+    def hibernated_count(self) -> int:
+        with self._lock:
+            return len(self._hibernated)
+
+    def hibernated_sessions(self) -> List[str]:
+        with self._lock:
+            return sorted(self._hibernated)
+
+    def hibernate_release(self, session: str) -> bool:
+        """Free a hibernated session's host blocks and drop its record
+        (the no-resume cleanup path). False when unknown."""
+        return self._hibernate_drop(session)
+
+    def hibernate_export(self, session: str) -> Optional[Dict[str, Any]]:
+        """Read a hibernated session's full restore payload — host
+        block contents (quantized components byte-exact), the covered
+        token run, and its lane — WITHOUT consuming the local record.
+        This is the cross-endpoint shipping source: the receiver
+        ``hibernate_import``s it and the resume then rides the same
+        local swap-in path a never-moved session uses."""
+        with self._lock:
+            rec = self._hibernated.get(session)
+        if rec is None:
+            return None
+        lane = self._lane_for(*rec["lane"])
+        return {
+            "blocks": lane.pool.host_export(rec["handles"]),
+            "covered": int(rec["covered"]),
+            "tokens": np.asarray(rec["tokens"], np.int64),
+            "prompt": np.asarray(rec["prompt"], np.int64),
+            "generated": np.asarray(rec["generated"], np.int64),
+            "model": rec["lane"][0],
+            "version": rec["lane"][1],
+        }
+
+    def hibernate_import(self, session: str, blocks, covered: int,
+                         tokens, model: Optional[str] = None,
+                         version: Optional[int] = None,
+                         prompt=None, generated=None) -> bool:
+        """File a SHIPPED hibernation payload into this scheduler's
+        host tier (cross-endpoint restore): the blocks land via
+        ``host_insert`` and the record looks exactly like a local
+        hibernation — the resume submit rides the same swap-in path,
+        no separate restore program. False when the tier is off or
+        over budget; the caller falls back to the journaled-prefix
+        rung."""
+        lane = self._lane_for(model, version)
+        if not lane.pool.host_enabled:
+            return False
+        owner = _owner_key(lane.key)
+        handles = lane.pool.host_insert(blocks, owner=owner)
+        if handles is None:
+            return False
+        # dl4j-lint: disable=hot-path-host-sync — control-plane import
+        # (once per restored session), host int64 token journal
+        tokens = np.asarray(tokens, np.int64)
+        with self._lock:
+            old = self._hibernated.pop(session, None)
+            self._hibernated[session] = {
+                "handles": handles, "covered": int(covered),
+                "tokens": tokens, "lane": lane.key,
+                "prompt": (tokens if prompt is None
+                           else np.asarray(prompt, np.int64)),
+                "generated": (np.zeros(0, np.int64) if generated is None
+                              else np.asarray(generated, np.int64)),
+                "imported": True,
+            }
+        if old is not None:
+            self._lane_for(*old["lane"]).pool.free_host(
+                old["handles"], owner=_owner_key(old["lane"]))
+        self.events.append(
+            f"hibernate_import session={session} covered={int(covered)} "
+            f"blocks={len(handles)}")
+        return True
+
     def _retire_seq(self, lane: _Lane, seq: _Seq) -> None:
         req = seq.req
         self._retired_rows += 1
@@ -2514,6 +2901,7 @@ class ContinuousDecodeScheduler:
     def _fail_seq(self, seq: _Seq, err: BaseException) -> None:
         req = seq.req
         self.events.append(f"fail seq={seq.seq_id} err={type(err).__name__}")
+        self._free_host_of(seq)
         if not req.future.done():
             reqtrace.finish_trace(req.root, outcome="error",
                                   error=type(err).__name__)
@@ -2521,8 +2909,11 @@ class ContinuousDecodeScheduler:
             self._count_resolved()
         # drop the request's other queued rows: the future already failed
         with self._lock:
-            for other in [s for s in self._queue if s.req is req]:
+            others = [s for s in self._queue if s.req is req]
+            for other in others:
                 self._queue.remove(other)
+        for other in others:
+            self._free_host_of(other)
         for lane in self._lanes.values():
             for slot in range(lane.slots):
                 s = lane.seqs[slot]
@@ -2546,6 +2937,7 @@ class ContinuousDecodeScheduler:
             self._queue.clear()
         failed = set()
         for seq in queued:
+            self._free_host_of(seq)
             if seq.req not in failed and not seq.req.future.done():
                 reqtrace.finish_trace(seq.req.root, outcome="error",
                                       error=type(err).__name__)

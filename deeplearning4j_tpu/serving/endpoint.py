@@ -120,14 +120,35 @@ class LocalEndpoint(EngineEndpoint):
     def submit_generate(self, prompt_ids, max_new_tokens,
                         timeout_s=None, model=None, version=None,
                         session=None, on_tokens=None, prefix=None,
-                        kv_state=None, **kwargs):
+                        kv_state=None, hibernate=False,
+                        on_hibernate=None, **kwargs):
         kw = {k: v for k, v in (("model", model), ("version", version),
                                 ("session", session),
                                 ("on_tokens", on_tokens),
                                 ("prefix", prefix),
                                 ("kv_state", kv_state)) if v is not None}
-        return self.engine.submit_generate(prompt_ids, max_new_tokens,
-                                           **kw, **kwargs)
+        if hibernate:
+            kw["hibernate"] = True
+        fut = self.engine.submit_generate(prompt_ids, max_new_tokens,
+                                          **kw, **kwargs)
+        if hibernate and on_hibernate is not None and session is not None:
+            # mirror the wire contract: the durable handle reaches the
+            # router when the turn retires, so the session survives
+            # even an in-process engine being shut down
+            def _ship(f):
+                if f.exception() is not None:
+                    return
+                try:
+                    hp = self.engine.hibernate_export(session)
+                except BaseException:
+                    return
+                if hp is not None:
+                    try:
+                        on_hibernate(hp)
+                    except BaseException:
+                        pass  # consumer bug; the turn already resolved
+            fut.add_done_callback(_ship)
+        return fut
 
     def submit_prefill(self, prompt_ids, timeout_s=None):
         fut: "Future[Dict[str, Any]]" = Future()
@@ -152,10 +173,11 @@ class LocalEndpoint(EngineEndpoint):
 
 
 class _Pending:
-    __slots__ = ("future", "deadline", "timeout", "on_tokens", "tensors")
+    __slots__ = ("future", "deadline", "timeout", "on_tokens", "tensors",
+                 "on_hibernate")
 
     def __init__(self, future: Future, deadline: float, timeout: float,
-                 on_tokens=None, tensors=None):
+                 on_tokens=None, tensors=None, on_hibernate=None):
         self.future = future
         self.deadline = deadline
         self.timeout = timeout   # per-chunk silence budget (streams)
@@ -163,6 +185,9 @@ class _Pending:
         # tagged tensor chunks assembled so far (wire v3 prefill: the
         # "kv" chunk lands here, the terminal reply completes the dict)
         self.tensors = tensors
+        # receives the durable hibernation handle a hibernate=True turn
+        # ships before its terminal reply
+        self.on_hibernate = on_hibernate
 
 
 class RemoteEndpoint(EngineEndpoint):
@@ -239,7 +264,8 @@ class RemoteEndpoint(EngineEndpoint):
                       on_tokens=None,
                       tensors=None,
                       send_tensors=None,
-                      wire_v: Optional[int] = None) -> "Future[np.ndarray]":
+                      wire_v: Optional[int] = None,
+                      on_hibernate=None) -> "Future[np.ndarray]":
         """``tensors`` is the INBOUND assembly dict (tagged chunks land
         there — prefill kv); ``send_tensors`` are OUTBOUND extra tensor
         segments, only meaningful when the negotiated framing is v4."""
@@ -252,7 +278,7 @@ class RemoteEndpoint(EngineEndpoint):
         deadline = time.monotonic() + timeout
         with self._lock:
             self._pending[corr] = _Pending(fut, deadline, timeout, on_tokens,
-                                           tensors)
+                                           tensors, on_hibernate)
         # propagate the caller's request-trace context across the wire
         # (thread-local → optional header field; older workers ignore it)
         tctx = reqtrace.current_trace()
@@ -287,7 +313,7 @@ class RemoteEndpoint(EngineEndpoint):
                         top_p: float = 0.0, eos_token: Optional[int] = None,
                         seed: int = 0, model=None, version=None,
                         session=None, on_tokens=None, prefix=None,
-                        kv_state=None):
+                        kv_state=None, hibernate=False, on_hibernate=None):
         gen = {"max_new": int(max_new_tokens), "temperature": temperature,
                "top_k": top_k, "top_p": top_p, "eos_token": eos_token,
                "seed": seed}
@@ -297,12 +323,26 @@ class RemoteEndpoint(EngineEndpoint):
             # a long stream never times out WHILE it is progressing
             gen["stream"] = True
         neg = self.negotiated_wire()
+        if hibernate:
+            gen["hibernate"] = True
         send_tensors: Optional[Dict[str, np.ndarray]] = None
         body = np.asarray(prompt_ids)
+        if isinstance(kv_state, dict) and "blocks" in kv_state:
+            # shipped hibernation payload (cross-endpoint resume): the
+            # host-tier blocks ride raw v4 segments back to the target
+            # worker; a v3 peer cannot carry them — drop the payload
+            # and let the prefix resume re-prefill (still exact, just
+            # the journal rung instead of swap-in)
+            if neg >= 4:
+                hib, hsegs = wire.hibernation_segments(kv_state)
+                gen["hib"] = hib
+                send_tensors = dict(hsegs)
+            kv_state = None
         if prefix is not None:
             if neg >= 4:
                 # v4: the resume prefix is a raw binary segment
-                send_tensors = {"prefix": np.asarray(prefix, np.int64)}
+                send_tensors = dict(send_tensors or {})
+                send_tensors["prefix"] = np.asarray(prefix, np.int64)
             else:
                 # resume request: the worker re-prefills prompt + prefix
                 # and continues the stream's PRNG clock (no
@@ -334,7 +374,8 @@ class RemoteEndpoint(EngineEndpoint):
         return self._submit_frame(wire.KIND_GENERATE,
                                   body, gen, timeout_s,
                                   model, version, session, on_tokens,
-                                  send_tensors=send_tensors, wire_v=neg)
+                                  send_tensors=send_tensors, wire_v=neg,
+                                  on_hibernate=on_hibernate)
 
     def submit_prefill(self, prompt_ids, timeout_s=None):
         """Wire-v3 disaggregated prefill: the worker replies with one
@@ -403,6 +444,23 @@ class RemoteEndpoint(EngineEndpoint):
 
     def _handle_event(self, ev: Dict[str, Any]) -> None:
         kind = ev["type"]
+        if kind == "hibernation":
+            # the session's durable handle, shipped before the terminal
+            # reply: hand it up (the router parks it) and refresh the
+            # silence deadline — the frame is proof of progress
+            with self._lock:
+                p = self._pending.get(ev.get("id"))
+                if p is not None:
+                    self._hb_at = time.monotonic()
+                    p.deadline = time.monotonic() + p.timeout
+            if p is not None and p.on_hibernate is not None:
+                try:
+                    p.on_hibernate(ev["payload"])
+                except BaseException as e:
+                    logger.warning(
+                        "endpoint %s: on_hibernate callback failed "
+                        "(%s: %s)", self.name, type(e).__name__, e)
+            return
         if kind == "tensor":
             # tagged tensor chunk (prefill kv): assemble WITHOUT
             # resolving, refresh the silence deadline

@@ -55,8 +55,10 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.monitor import (
+    KVTIER_DEMOTIONS_COUNTER,
     PREFIXCACHE_CACHED_BLOCKS_GAUGE,
     PREFIXCACHE_COW_COPIES_COUNTER,
+    PREFIXCACHE_DEMOTIONS_COUNTER,
     PREFIXCACHE_EVICTIONS_COUNTER,
     PREFIXCACHE_HITS_COUNTER,
     PREFIXCACHE_MISSES_COUNTER,
@@ -74,14 +76,16 @@ class _Node:
     reference per node."""
 
     __slots__ = ("nid", "lane", "block", "tokens", "fill", "parent",
-                 "pkey", "partial", "children", "partials", "last_used")
+                 "pkey", "partial", "children", "partials", "last_used",
+                 "host")
 
     def __init__(self, nid: int, lane, block: Optional[int],
                  tokens: Tuple[int, ...], fill: int,
                  parent: Optional["_Node"], partial: bool):
         self.nid = nid
         self.lane = lane
-        self.block = block          # None only for per-lane roots
+        self.block = block          # None for roots and host-resident nodes
+        self.host = None            # host-tier handle when demoted
         self.tokens = tokens
         self.fill = fill
         self.parent = parent
@@ -114,11 +118,18 @@ class PrefixCache:
         self._hits = 0
         self._misses = 0
         self._evictions = 0
+        self._demotions = 0
+        self._host_nodes = 0        # nodes resident in the host tier
         self._cow_copies = 0
         self._saved_tokens = 0
         self._inserted_runs = 0
         self._lock = threading.RLock()
         if register:
+            # the exhaustion ladder, pinned by registration order:
+            # cache-DEMOTE to the host tier first (nothing is lost),
+            # cache-DROP second, and only then does alloc fail. The
+            # demote rung no-ops on pools without a host tier.
+            pool.register_reclaimer(self.reclaim_demote)
             pool.register_reclaimer(self.reclaim)
 
     # ------------------------------------------------------------ probe
@@ -145,11 +156,21 @@ class PrefixCache:
             if root is not None:
                 cur = root
                 i = 0
+                # blocks are shared AS THE WALK MATCHES THEM (not at
+                # the end): a host-resident node's promotion below
+                # allocates device blocks, which may run the reclaimer
+                # chain — an already-matched block at refcount 1 could
+                # be evicted out from under us; at refcount 2 it is
+                # pinned by the caller's share and untouchable
                 while i + bs <= len(usable):
                     child = cur.children.get(tuple(usable[i:i + bs]))
                     if child is None:
                         break
                     child.last_used = self._clock
+                    if child.block is None \
+                            and not self._promote_locked(child):
+                        break  # host-resident, device full: match ends
+                    self.pool.share_blocks([child.block])
                     full_ids.append(child.block)
                     cur = child
                     i += bs
@@ -166,18 +187,15 @@ class PrefixCache:
                                     (cl == best_len and best is not None
                                      and pnode.nid < best.nid)):
                         best, best_len = pnode, cl
-                if best is not None:
+                if best is not None and (
+                        best.block is not None
+                        or self._promote_locked(best)):
                     best.last_used = self._clock
+                    self.pool.share_blocks([best.block])
                     partial_id = best.block
                     m = i + best_len
                 else:
                     m = i
-            if m > 0:
-                shared = full_ids + ([partial_id]
-                                     if partial_id is not None else [])
-                self.pool.share_blocks(shared)
-            else:
-                full_ids, partial_id = [], None
         self._publish()
         return m, full_ids, partial_id
 
@@ -278,6 +296,88 @@ class PrefixCache:
 
     # --------------------------------------------------------- eviction
 
+    def _promote_locked(self, node: _Node) -> bool:
+        """Swap a host-resident node's block back onto the device so a
+        match can share it. False (node untouched, handle still valid)
+        when the device pool cannot cover it even after reclaim."""
+        got = self.pool.swap_in([node.host])
+        if got is None:
+            return False
+        node.block = int(got[0])
+        node.host = None
+        self._host_nodes -= 1
+        self._nodes += 1
+        return True
+
+    def _pick_victim_locked(self) -> Optional[_Node]:
+        """Deterministic LRU victim: the device-resident node with NO
+        device-resident descendant (so the on-device radix chain never
+        dangles — host-resident children ride along) whose only
+        reference is the cache's; ties break on node id."""
+        victim: Optional[_Node] = None
+
+        def walk(node: _Node) -> bool:
+            nonlocal victim
+            has_dev = False
+            for ch in list(node.children.values()) \
+                    + list(node.partials.values()):
+                has_dev |= walk(ch)
+            if node.block is None:
+                return has_dev
+            if not has_dev and self.pool.ref_count(node.block) == 1 \
+                    and (victim is None or (node.last_used, node.nid)
+                         < (victim.last_used, victim.nid)):
+                victim = node
+            return True
+
+        for root in self._roots.values():
+            for ch in list(root.children.values()) \
+                    + list(root.partials.values()):
+                walk(ch)
+        return victim
+
+    def reclaim_demote(self, n: int) -> int:
+        """First rung of the exhaustion ladder: demote up to ``n``
+        cached-but-unreferenced blocks to the HOST tier (contents
+        preserved; the node stays in the radix tree and is matchable —
+        a later match swaps it back in). No-ops when the pool has no
+        host tier or its budget is full, letting the drop rung run."""
+        if not getattr(self.pool, "host_enabled", False):
+            return 0
+        with self._lock:
+            demoted = self._demote_locked(int(n))
+        self._publish()
+        return demoted
+
+    def _demote_locked(self, n: int) -> int:
+        demoted = 0
+        while demoted < n:
+            victim = self._pick_victim_locked()
+            if victim is None:
+                break
+            handles = self.pool.swap_out([victim.block])
+            if handles is None:
+                break  # host budget exhausted: the drop rung is next
+            victim.block = None
+            victim.host = handles[0]
+            self._nodes -= 1
+            self._host_nodes += 1
+            self._demotions += 1
+            demoted += 1
+        if demoted:
+            reg = get_registry()
+            reg.counter(
+                PREFIXCACHE_DEMOTIONS_COUNTER,
+                "Cached-but-unreferenced KV blocks demoted to the host "
+                "tier instead of dropped (contents preserved)",
+                pool=self.pool.name).inc(demoted)
+            reg.counter(
+                KVTIER_DEMOTIONS_COUNTER,
+                "KV blocks demoted device→host by exhaustion pressure "
+                "(the reclaimer chain's first rung)",
+                pool=self.pool.name).inc(demoted)
+        return demoted
+
     def reclaim(self, n: int) -> int:
         """The pool's reclaimer seam: evict up to ``n`` cached blocks
         whose ONLY reference is the cache's (deterministic LRU —
@@ -288,24 +388,22 @@ class PrefixCache:
         self._publish()
         return freed
 
+    def _drop_hosts_locked(self, node: _Node) -> None:
+        stack = list(node.children.values()) + list(node.partials.values())
+        node.children.clear()
+        node.partials.clear()
+        while stack:
+            ch = stack.pop()
+            stack.extend(ch.children.values())
+            stack.extend(ch.partials.values())
+            if ch.host is not None:
+                self.pool.free_host([ch.host])
+                self._host_nodes -= 1
+
     def _evict_locked(self, n: int) -> int:
         freed = 0
         while freed < n:
-            victim: Optional[_Node] = None
-            for root in self._roots.values():
-                stack = list(root.children.values()) \
-                    + list(root.partials.values())
-                while stack:
-                    node = stack.pop()
-                    if node.leaf():
-                        if self.pool.ref_count(node.block) == 1 and (
-                                victim is None
-                                or (node.last_used, node.nid)
-                                < (victim.last_used, victim.nid)):
-                            victim = node
-                    else:
-                        stack.extend(node.children.values())
-                        stack.extend(node.partials.values())
+            victim = self._pick_victim_locked()
             if victim is None:
                 break  # everything left is referenced or interior
             parent = victim.parent
@@ -313,6 +411,9 @@ class PrefixCache:
                 parent.partials.pop(victim.pkey, None)
             else:
                 parent.children.pop(victim.pkey, None)
+            # host-resident descendants leave the tree with the victim
+            # — their handles free, or they would leak the host budget
+            self._drop_hosts_locked(victim)
             self._nodes -= 1
             self._evictions += 1
             self.pool.free_blocks([victim.block])
@@ -338,10 +439,14 @@ class PrefixCache:
                     node = stack.pop()
                     stack.extend(node.children.values())
                     stack.extend(node.partials.values())
-                    self.pool.free_blocks([node.block])
+                    if node.host is not None:
+                        self.pool.free_host([node.host])
+                    else:
+                        self.pool.free_blocks([node.block])
                     released += 1
             self._roots.clear()
             self._nodes = 0
+            self._host_nodes = 0
         self._publish()
         return released
 
@@ -361,6 +466,8 @@ class PrefixCache:
                 "misses": misses,
                 "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
                 "evictions": self._evictions,
+                "demotions": self._demotions,
+                "host_blocks": self._host_nodes,
                 "cow_copies": self._cow_copies,
                 "saved_prefill_tokens": self._saved_tokens,
                 "inserted_runs": self._inserted_runs,

@@ -76,6 +76,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.monitor import (
+    KVTIER_RESTORE_COUNTER,
     REQ_PHASE_HISTOGRAM,
     REQ_SLO_BURN_COUNTER,
     REQ_TPOT_HISTOGRAM,
@@ -396,6 +397,12 @@ class InferenceRouter:
         self.prefix_affinity_tokens = max(0, int(prefix_affinity_tokens))
         self._prefix_owners: "OrderedDict[Tuple, str]" = OrderedDict()
         self._prefix_owners_cap = 4096
+        # durable session handles: session -> {"prompt", "output",
+        # "payload"?}. The prompt + full output journal make the
+        # last-resort re-prefill rung; the shipped host-tier payload
+        # (when the worker delivered one) makes the cross-endpoint
+        # swap-in rung — either way the session survives its endpoint
+        self._hibernated: Dict[str, Dict[str, Any]] = {}
         self._streams: set = set()      # in-flight streaming _Routed
         self._closed = False
         # the router's ONE clock: hedge timers and the wedge/journal
@@ -841,6 +848,7 @@ class InferenceRouter:
                         model: Optional[str] = None,
                         version: Optional[int] = None,
                         on_tokens=None,
+                        hibernate: bool = False,
                         **gen_kwargs) -> "Future[np.ndarray]":
         """Route one decode request; ``session=`` keeps every burst of
         a decode stream on the (endpoint, model, version) it started on
@@ -857,11 +865,39 @@ class InferenceRouter:
         re-submits prompt + received prefix as a resume request, so the
         surviving engine re-prefills only the prefix instead of
         re-generating it, and the delivered tokens are token-for-token
-        what an uninterrupted run would have produced."""
+        what an uninterrupted run would have produced.
+
+        ``hibernate=True`` (requires ``session=``) makes the turn file
+        a DURABLE session handle at end-of-turn: the serving engine
+        parks the session's KV in its host tier instead of freeing it,
+        and the router records the prompt + output journal plus — when
+        the worker ships one — the host-tier payload itself. A later
+        :meth:`resume_generate` restores the session on ANY endpoint
+        (swap-in when the pin survived, shipped blocks on a survivor,
+        journaled re-prefill as the last resort), token-for-token what
+        an uninterrupted run would have produced."""
+        if hibernate and session is None:
+            raise ValueError(
+                "hibernate=True files a durable session handle at "
+                "end-of-turn — it needs session=")
         gen = dict(gen_kwargs, max_new_tokens=int(max_new_tokens))
-        return self._route(np.asarray(prompt_ids), gen, "generate",
-                           deadline_ms, priority, session, model, version,
-                           on_tokens)
+        if hibernate:
+            gen["hibernate"] = True
+        fut = self._route(np.asarray(prompt_ids), gen, "generate",
+                          deadline_ms, priority, session, model, version,
+                          on_tokens)
+        if hibernate:
+            prompt = np.asarray(prompt_ids)
+
+            def _file(f):
+                # the journal half of the handle: prompt + full output,
+                # enough for the re-prefill rung even when no payload
+                # ever ships (v3 peer, over-budget tier)
+                if f.exception() is None:
+                    self._note_hibernated_turn(session, prompt,
+                                               np.asarray(f.result()))
+            fut.add_done_callback(_file)
+        return fut
 
     def stream(self, prompt_ids, max_new_tokens,
                timeout: Optional[float] = None, **kwargs):
@@ -893,8 +929,134 @@ class InferenceRouter:
         return self.submit_generate(prompt_ids, max_new_tokens,
                                     **kwargs).result(timeout=timeout)
 
+    # ------------------------------------------------------ hibernation
+
+    def _note_hibernated_turn(self, session: str, prompt: np.ndarray,
+                              output: np.ndarray) -> None:
+        with self._lock:
+            rec = self._hibernated.setdefault(session, {})
+            rec["prompt"] = np.asarray(prompt).reshape(1, -1)
+            rec["output"] = np.asarray(output).reshape(1, -1)
+
+    def _store_hibernation(self, session: str, payload) -> None:
+        """The worker shipped the session's host-tier payload (KV
+        blocks + token journal): park it — this is what makes resume
+        survive the endpoint's death without a re-prefill."""
+        with self._lock:
+            rec = self._hibernated.setdefault(session, {})
+            rec["payload"] = payload
+        mark("router_session_hibernated", session=session,
+             blocks=len(payload.get("blocks") or ()))
+
+    def hibernation_handle(self, session: str) -> Optional[Dict[str, Any]]:
+        """The durable handle of a hibernated session (None when the
+        session has none): ``prompt`` + ``output`` journal, plus the
+        shipped host-tier ``payload`` when the worker delivered one."""
+        with self._lock:
+            rec = self._hibernated.get(session)
+            return dict(rec) if rec is not None else None
+
+    def hibernated_sessions(self) -> List[str]:
+        with self._lock:
+            return sorted(self._hibernated)
+
+    def release_hibernated(self, session: str) -> bool:
+        """Drop a session's durable handle (the abandon path — a
+        resume consumes it itself)."""
+        with self._lock:
+            return self._hibernated.pop(session, None) is not None
+
+    def resume_generate(self, session: str, max_new_tokens: int,
+                        deadline_ms: Optional[float] = None,
+                        priority: str = "interactive",
+                        model: Optional[str] = None,
+                        version: Optional[int] = None,
+                        on_tokens=None,
+                        hibernate: bool = False,
+                        **gen_kwargs) -> "Future[np.ndarray]":
+        """Resume a hibernated session for its next turn, restoring its
+        KV state down a three-rung exactness ladder — every rung yields
+        the tokens an uninterrupted run would have produced:
+
+        1. **host** — the pinned endpoint is still alive: the request
+           routes back to it and its scheduler swaps the session's
+           blocks in from the host tier (no re-prefill);
+        2. **ship** — the pin is gone (endpoint died, drained, was
+           removed) but the worker shipped the host-tier payload at
+           hibernate time: the blocks ride the request to a SURVIVOR,
+           which seeds its own host tier and swaps in;
+        3. **journal** — no payload: the survivor re-prefills prompt +
+           journaled output (``dl4j_kvtier_restore_total``
+           ``path="journal"``), exact but costlier.
+
+        ``max_new_tokens`` counts ALL generated tokens of the session
+        including earlier turns' (the resume prefix) — the same
+        contract as a stream migration's resume. ``on_tokens`` offsets
+        continue where the hibernated turn left off (no gap, no
+        repeat). ``hibernate=True`` re-files the handle at this turn's
+        end, chaining turns indefinitely."""
+        with self._lock:
+            rec = dict(self._hibernated.get(session) or {})
+        if not rec or "output" not in rec:
+            raise KeyError(f"no hibernated session {session!r}")
+        prompt = rec["prompt"]
+        output = rec["output"]
+        t0 = prompt.shape[1]
+        prefix = np.asarray(output[0, t0:], np.int64)
+        pin = self._affinity.get(session)
+        pinned_alive = False
+        if pin is not None:
+            st0 = self._eps.get(pin[0])
+            pinned_alive = (
+                st0 is not None and st0.endpoint.alive()
+                and self._endpoint_state(st0) not in (
+                    wire.STATE_DRAINING, wire.STATE_STOPPED)
+                and not self._slice_degraded(st0) and not st0.wedged)
+            if model is None:
+                model = pin[1]
+        gen = dict(gen_kwargs, max_new_tokens=int(max_new_tokens))
+        if hibernate:
+            gen["hibernate"] = True
+        if prefix.size:
+            gen["prefix"] = prefix
+        path = "host"
+        if not pinned_alive:
+            with self._lock:
+                self._affinity.pop(session, None)  # re-pin on a survivor
+            payload = rec.get("payload")
+            if payload is not None:
+                # rung 2: the parked host-tier blocks ride the request
+                # to whichever endpoint admission picks
+                gen["kv_state"] = payload
+                path = "ship"
+            else:
+                path = "journal"
+                self._reg().counter(
+                    KVTIER_RESTORE_COUNTER,
+                    "Hibernated-session restores by path (host = local "
+                    "swap-in, ship = cross-endpoint shipped blocks, "
+                    "journal = re-prefill from the token journal)",
+                    path="journal").inc()
+        mark("router_session_resumed", session=session, path=path,
+             prefix=int(prefix.size))
+        fut = self._route(
+            prompt, gen, "generate", deadline_ms, priority, session,
+            model, version, on_tokens,
+            seed_received=(prefix.tolist() if on_tokens is not None
+                           else None))
+        with self._lock:
+            self._hibernated.pop(session, None)  # the resume consumed it
+        if hibernate:
+            def _file(f):
+                if f.exception() is None:
+                    self._note_hibernated_turn(session, prompt,
+                                               np.asarray(f.result()))
+            fut.add_done_callback(_file)
+        return fut
+
     def _route(self, x, gen, kind, deadline_ms, priority, session,
-               model=None, version=None, on_tokens=None):
+               model=None, version=None, on_tokens=None,
+               seed_received=None):
         if self._closed:
             raise RuntimeError("router is closed")
         if deadline_ms is None:
@@ -943,6 +1105,12 @@ class InferenceRouter:
                      else time.monotonic() + deadline_ms / 1e3,
                      priority, session, self.per_try_timeout,
                      model, version, on_tokens)
+        if seed_received:
+            # resumed session: the journal opens with the already-
+            # delivered tokens, so the engine's emission offsets (which
+            # start past the resume prefix) align — no false gap, and
+            # the dedupe ledger spans turns
+            rf.received.extend(int(t) for t in seed_received)
         rf.prefix_key = prefix_key
         rf.troot, rf.tctx = troot, tctx
         rf.deadline_ms = deadline_ms
@@ -1092,6 +1260,13 @@ class InferenceRouter:
             with reqtrace.use_trace(None if dspan is None else dspan.ctx):
                 if rf.kind == "generate":
                     g = dict(rf.gen)
+                    if g.get("hibernate") and rf.session is not None:
+                        # the worker ships the session's host-tier
+                        # payload before the terminal reply; parking it
+                        # here is what survives the endpoint's death
+                        g["on_hibernate"] = (
+                            lambda payload, s=rf.session:
+                            self._store_hibernation(s, payload))
                     if rf.on_tokens is not None:
                         g["on_tokens"] = (
                             lambda off, toks, e=epoch:
@@ -1418,8 +1593,13 @@ class InferenceRouter:
                 # while its heartbeats keep arriving
                 in_pool = False
                 healthy -= 1 if alive and not ejected else 0
+            # host-tier occupancy riding the same snapshot: the KV
+            # tiering view (/healthz surfaces it fleet-wide)
+            kvtier = (stats.get("scheduler") or {}).get("kvtier") \
+                if isinstance(stats.get("scheduler"), dict) else None
             eps[name] = {
                 "prefix_cache": prefix_cache,
+                "kvtier": kvtier if isinstance(kvtier, dict) else None,
                 "alive": alive,
                 "ejected": ejected,
                 "in_pool": in_pool,
@@ -1445,6 +1625,7 @@ class InferenceRouter:
         with self._lock:
             active_streams = len(self._streams)
             journal_tokens = sum(len(rf.received) for rf in self._streams)
+            hibernated = len(self._hibernated)
         # SLO attribution derived from the request traces: burn
         # outcomes per model, caller-observed TTFT tails, and the
         # per-phase decomposition (what /healthz surfaces so "which
@@ -1475,6 +1656,7 @@ class InferenceRouter:
             "degraded": healthy < len(eps) or healthy == 0,
             "queue_depth": queue_depth,
             "sessions": len(self._affinity),
+            "hibernated_sessions": hibernated,
             "active_streams": active_streams,
             "journal_bytes": 8 * journal_tokens,
             "migrations": int(reg.family_total(SESSION_MIGRATIONS_COUNTER)),

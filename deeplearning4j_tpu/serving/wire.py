@@ -385,6 +385,78 @@ def pack_tensor_chunk_v4(corr_id: str, tag: str,
     return pack_frame_v4(meta, [("t", np.asarray(tensor))], FRAME_TENSOR)
 
 
+#: tag marking a v4 tensor frame as a session-hibernation payload
+#: (host-tier KV blocks + token journal) rather than a plain tensor.
+HIBERNATE_TAG = "hib"
+
+
+def hibernation_segments(payload: Dict[str, Any]
+                         ) -> Tuple[Dict[str, Any],
+                                    List[Tuple[str, np.ndarray]]]:
+    """Flatten a hibernation payload (``hibernate_export`` layout:
+    per-block flat ``{"k0": ..., "v0": ..., "k_scale0": ...}`` dicts +
+    covered token journal) into (wire meta, raw tensor segments). The
+    block tensors ship as raw dtype-exact segments — quantized values
+    and their per-token scales ship quantized, so the restore is
+    bit-identical by construction."""
+    meta: Dict[str, Any] = {"covered": int(payload["covered"]),
+                            "nblocks": len(payload["blocks"])}
+    if payload.get("model") is not None:
+        meta["model"] = payload["model"]
+    if payload.get("version") is not None:
+        meta["version"] = int(payload["version"])
+    segs: List[Tuple[str, np.ndarray]] = [
+        ("tokens", np.asarray(payload["tokens"], np.int64))]
+    if payload.get("prompt") is not None:
+        segs.append(("prompt", np.asarray(payload["prompt"])))
+    if payload.get("generated") is not None:
+        segs.append(("gen", np.asarray(payload["generated"], np.int64)))
+    for i, blk in enumerate(payload["blocks"]):
+        for key in sorted(blk):
+            segs.append((f"b{i}.{key}", np.asarray(blk[key])))
+    return meta, segs
+
+
+def hibernation_from_segments(hib: Dict[str, Any],
+                              segs: Dict[str, np.ndarray]
+                              ) -> Dict[str, Any]:
+    """Reassemble :func:`hibernation_segments` output into the payload
+    dict ``hibernate_import`` / ``submit_generate(kv_state=...)``
+    consume. Tensors are COPIED out of the (zero-copy, read-only)
+    frame views — the payload outlives the frame buffer."""
+    blocks: List[Dict[str, np.ndarray]] = [
+        {} for _ in range(int(hib["nblocks"]))]
+    for tag, arr in segs.items():
+        if tag.startswith("b") and "." in tag:
+            idx, key = tag[1:].split(".", 1)
+            blocks[int(idx)][key] = np.array(arr)
+    payload: Dict[str, Any] = {
+        "blocks": blocks, "covered": int(hib["covered"]),
+        "tokens": np.array(segs["tokens"]),
+        "model": hib.get("model"), "version": hib.get("version")}
+    if "prompt" in segs:
+        payload["prompt"] = np.array(segs["prompt"])
+    if "gen" in segs:
+        payload["generated"] = np.array(segs["gen"])
+    return payload
+
+
+def pack_hibernation_v4(corr_id: str, payload: Dict[str, Any]) -> bytes:
+    """The hibernation-handle frame a worker ships AFTER a
+    ``hibernate=True`` turn retires (non-terminal, before the terminal
+    reply): the router parks it as the session's durable handle, so the
+    session survives this endpoint's death — resume on a survivor ships
+    the same segments back as request tensors. v4-only (multi-segment);
+    a v3 peer never receives one and falls back to journaled-prefix
+    resume. Raises ``ValueError`` when the session spans more blocks
+    than one frame's 255-segment budget — the caller skips shipping
+    and the journal rung covers resume."""
+    hib, segs = hibernation_segments(payload)
+    meta = {"id": corr_id, "ok": True, "chunk": True,
+            "tag": HIBERNATE_TAG, "hib": hib, "v": WIRE_VERSION}
+    return pack_frame_v4(meta, segs, FRAME_TENSOR)
+
+
 def pack_chunks_v4(entries: Sequence[Tuple[str, int, np.ndarray]]
                    ) -> bytes:
     """The COALESCED token-chunk frame: every (corr_id, offset,
@@ -410,6 +482,10 @@ def decode_reply_events(payload: bytes) -> List[Dict[str, Any]]:
       delta (a coalesced v4 frame yields several);
     - ``{"type": "tensor", "id", "tag", "tensor"}`` — tagged tensor
       chunk (disagg kv);
+    - ``{"type": "hibernation", "id", "payload"}`` — the durable
+      session handle a ``hibernate=True`` turn ships before its
+      terminal reply (host-tier KV blocks + token journal, reassembled
+      into the ``hibernate_import`` payload layout);
     - ``{"type": "terminal", "id", "header", "result"}`` — resolves
       the request (``header`` carries ok / typed-error fields).
 
@@ -419,6 +495,10 @@ def decode_reply_events(payload: bytes) -> List[Dict[str, Any]]:
         meta, segs = unpack_frame_v4(payload)
         if meta.get("chunk"):
             tag = meta.get("tag")
+            if tag == HIBERNATE_TAG and meta.get("hib") is not None:
+                return [{"type": "hibernation", "id": meta.get("id"),
+                         "payload": hibernation_from_segments(
+                             meta["hib"], segs)}]
             if tag is not None:
                 return [{"type": "tensor", "id": meta.get("id"),
                          "tag": tag, "tensor": segs.get("t")}]
@@ -547,6 +627,7 @@ def _typed_error_registry() -> Dict[str, Any]:
                                                        SliceDegraded)
     from deeplearning4j_tpu.serving.continuous import (DecodeBurstError,
                                                        KVPoolExhausted)
+    from deeplearning4j_tpu.nn.kvpool import KVHostTierError
     from deeplearning4j_tpu.serving.registry import (ModelQuarantined,
                                                      ModelUnavailable)
     from deeplearning4j_tpu.serving.router import RetryAfter
@@ -560,6 +641,7 @@ def _typed_error_registry() -> Dict[str, Any]:
         "RetryAfter": RetryAfter,
         "DecodeBurstError": DecodeBurstError,
         "KVPoolExhausted": KVPoolExhausted,
+        "KVHostTierError": KVHostTierError,
         "WireVersionError": WireVersionError,
         "WireFrameError": WireFrameError,
         "SliceDegraded": SliceDegraded,

@@ -155,6 +155,7 @@ class EngineWorker:
             # plus one wire_ingress span marking the hop boundary
             tctx = reqtrace.from_wire(header.get("trace"))
             t_ingress = now_us()
+            hib_session = None  # ship a hibernation handle at retire
             try:
                 if header.get("kind") == wire.KIND_PREFILL:
                     # disaggregated prefill: compute prompt KV + logits
@@ -202,6 +203,21 @@ class EngineWorker:
                                 "kv": x, "t_in": prompt.shape[1],
                                 "logits": np.asarray(g["logits"], np.float32)[None]}
                             x = prompt
+                    if g.get("hib"):
+                        # shipped hibernation payload (cross-endpoint
+                        # resume): raw segments reassemble into the
+                        # hibernate_import layout — the engine seeds
+                        # its host tier, then the ordinary swap-in
+                        # path finishes the restore
+                        kwargs["kv_state"] = wire.hibernation_from_segments(
+                            g["hib"], segs)
+                    if g.get("hibernate"):
+                        kwargs["hibernate"] = True
+                        # after the turn retires, ship the session's
+                        # durable handle back (v4 peers only — the
+                        # journal rung covers v3 resumes)
+                        if req_v4:
+                            hib_session = header.get("session")
                     if "prefix" in segs:
                         kwargs["prefix"] = np.asarray(segs["prefix"],
                                                       np.int64)
@@ -232,8 +248,9 @@ class EngineWorker:
                 tctx, "wire_ingress", t_ingress, now_us() - t_ingress,
                 kind=header.get("kind"), worker=self.name)
             fut.add_done_callback(
-                lambda f, c=corr, rt=reply_topic, v4=req_v4:
-                self._deliver(c, rt, f, v4))
+                lambda f, c=corr, rt=reply_topic, v4=req_v4,
+                hs=hib_session:
+                self._deliver(c, rt, f, v4, hs))
 
     def _make_stream_cb(self, corr, reply_topic, req_v4):
         """Build the per-stream token-delta callback. For a v4 caller
@@ -269,11 +286,26 @@ class EngineWorker:
         for topic, chunk_entries in by_topic.items():
             self._reply(topic, wire.pack_chunks_v4(chunk_entries))
 
-    def _deliver(self, corr, reply_topic, fut, v4=False):
+    def _deliver(self, corr, reply_topic, fut, v4=False,
+                 hib_session=None):
         if self._killed.is_set():
             return  # a killed worker answers nothing
         pack = wire.pack_reply_v4 if v4 else wire.pack_reply
         err = fut.exception()
+        if err is None and hib_session is not None:
+            # the durable handle precedes the terminal frame: by the
+            # time the caller sees the turn resolve, the router already
+            # holds everything a survivor needs to resume the session
+            # bitwise after this endpoint dies
+            try:
+                hp = self.engine.hibernate_export(hib_session)
+                if hp is not None:
+                    self._reply(reply_topic,
+                                wire.pack_hibernation_v4(corr, hp))
+            except ValueError:
+                # session spans more blocks than one frame carries —
+                # skip shipping; journaled-prefix resume stays exact
+                pass
         if err is None:
             payload = pack(corr, np.asarray(fut.result()))
         else:

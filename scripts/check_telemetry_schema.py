@@ -556,6 +556,22 @@ KNOWN_DL4J_METRICS = {
     "dl4j_spec_rejected_tokens_total",
     "dl4j_spec_accept_rate",
     "dl4j_spec_draft_latency_ms",
+    # KV tiering + session hibernation (nn/kvpool.py host-RAM tier +
+    # serving/continuous.py swap-aware scheduler + serving/router.py
+    # durable session handles): swap traffic both directions,
+    # prefix-cache demote-to-host rescues, host-tier occupancy and
+    # per-direction swap latency, hibernated-session volume, restores
+    # by exactness rung (label path=host|ship|journal), and host-tier
+    # byte-seconds attribution (label owner=)
+    "dl4j_kvtier_swap_out_total",
+    "dl4j_kvtier_swap_in_total",
+    "dl4j_kvtier_demotions_total",
+    "dl4j_kvtier_hibernated_sessions_total",
+    "dl4j_kvtier_restore_total",
+    "dl4j_kvtier_host_blocks",
+    "dl4j_kvtier_swap_latency_ms",
+    "dl4j_prefixcache_demotions_total",
+    "dl4j_attr_kv_host_byte_seconds",
 }
 
 

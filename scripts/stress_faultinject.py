@@ -32,8 +32,10 @@ windows, mesh-shrink drills, and the composed ChaosSchedule event
 clock, the prefix-cache refcount/COW/eviction accounting drill, and
 the slice-kill / slice-drill schedules, the quantized-pool ×
 prefix-cache accounting drill, the speculative-decoding dual-lane
-(draft + target) accounting drill, and the wire-v4 torn-frame /
-reassembly drill — sections 1–12) twice per seed
+(draft + target) accounting drill, the wire-v4 torn-frame /
+reassembly drill, and the host-tier (KV tiering) swap /
+budget-pressure / reclaimer-chain accounting drill — sections 1–13)
+twice per seed
 across rotating seeds and compares the full event logs bit-for-bit.
 It runs in milliseconds with no subprocess and no jax compute, so the
 tier-1 sweep carries it on every run; the full mode is the pre-merge /
@@ -48,6 +50,19 @@ KV blocks, unhealthy fleet) or ANY outcome drift between the two
 replays of one seed::
 
     python scripts/stress_faultinject.py --chaos --runs 3
+
+**Hibernation mode (CLI)** — ``--hibernation`` runs the
+SESSION-HIBERNATION drill
+(:func:`deeplearning4j_tpu.faultinject.chaos.run_hibernation_drill` —
+hibernate N sessions into the host KV tier, kill the seeded endpoint,
+resume every session on the survivors down the host → shipped-blocks
+→ journaled-prefix exactness ladder, the second half under
+``HostTierPressure``) twice per rotating seed in fresh subprocesses,
+failing on any invariant violation (token mismatches, dup/gap
+offsets, leaked blocks on either tier, stranded handles) or outcome
+drift between replays::
+
+    python scripts/stress_faultinject.py --hibernation --runs 3
 """
 
 from __future__ import annotations
@@ -508,6 +523,133 @@ def _scenario_log(seed: int) -> str:
                 list(ev["tokens"]) == [int(t) for t in toks]
                 for ev, (c, o, toks) in zip(evs, entries))
     events.append(f"wire coalesced n={len(evs)} exact={exact}")
+
+    # 13) host-tier (KV tiering) accounting drill: a seeded battery of
+    # swap_out / swap_in / host_export→host_insert (the shipped-blocks
+    # round trip) / free_host edges on a tiny tiered pool, with a
+    # deterministic HostTierPressure window mid-drill (budget squeezed
+    # to 0 ⇒ every demotion and landing-dock insert REFUSES and the
+    # caller takes its pre-tier fallback — the exactness ladder's
+    # degrade path), plus the reclaimer CHAIN consulted in
+    # registration order (demote-to-host before drop — the order the
+    # prefix cache registers). Both tiers must drain to empty, a
+    # host-side double free must raise, and the whole log replays
+    # bit-for-bit.
+    import zlib
+
+    from deeplearning4j_tpu.faultinject import HostTierPressure
+    hpool = PagedKVCachePool(11, 2, num_layers=1, num_heads=1, head_dim=2,
+                             name=f"ht{seed}", host_blocks=5)
+    rngH = np.random.default_rng(seed * 31 + 13)
+    hlive: List[list] = []      # device rows
+    hparked: List[list] = []    # host handle batches
+    squeeze = HostTierPressure(hpool, budget=0)
+    for i in range(30):
+        if i == 14:
+            squeeze.squeeze()
+            events.append(f"ht {i} squeeze budget={hpool.host_budget()}")
+        if i == 20:
+            squeeze.heal()
+            events.append(f"ht {i} heal budget={hpool.host_budget()}")
+        op = int(rngH.integers(0, 5))
+        if op == 0:
+            got = hpool.alloc(int(rngH.integers(1, 4)))
+            if got is None:
+                events.append(f"ht {i} admit-short")
+            else:
+                hlive.append(got)
+                events.append(f"ht {i} admit blocks={got}")
+        elif op == 1 and hlive:
+            blocks = hlive.pop(int(rngH.integers(0, len(hlive))))
+            hs = hpool.swap_out(blocks, owner="lm@v1")
+            if hs is None:
+                hlive.append(blocks)  # refusal: caller keeps device refs
+                events.append(f"ht {i} swapout-refused "
+                              f"used={hpool.host_blocks_used()}")
+            else:
+                hparked.append(hs)
+                events.append(f"ht {i} swapout handles={hs} "
+                              f"free={hpool.free_count}")
+        elif op == 2 and hparked:
+            hs = hparked.pop(int(rngH.integers(0, len(hparked))))
+            got = hpool.swap_in(hs, owner="lm@v1")
+            if got is None:
+                hparked.append(hs)  # handles stay valid on refusal
+                events.append(f"ht {i} swapin-short")
+            else:
+                hlive.append(got)
+                events.append(f"ht {i} swapin blocks={got} "
+                              f"used={hpool.host_blocks_used()}")
+        elif op == 3 and hparked:
+            hs = hparked[int(rngH.integers(0, len(hparked)))]
+            shipped = hpool.host_export(hs)
+            crc = zlib.crc32(b"".join(
+                v.tobytes() for b in shipped
+                for _, v in sorted(b.items())))
+            ins = hpool.host_insert(shipped, owner="ship")
+            if ins is None:
+                events.append(f"ht {i} insert-refused crc={crc}")
+            else:
+                back = zlib.crc32(b"".join(
+                    v.tobytes() for b in hpool.host_export(ins)
+                    for _, v in sorted(b.items())))
+                hparked.append(ins)
+                events.append(f"ht {i} shipped crc={crc} "
+                              f"byte_exact={crc == back} "
+                              f"used={hpool.host_blocks_used()}")
+        elif hparked:
+            hs = hparked.pop(int(rngH.integers(0, len(hparked))))
+            hpool.free_host(hs, owner="lm@v1")
+            events.append(f"ht {i} freehost "
+                          f"used={hpool.host_blocks_used()}")
+    squeeze.heal()
+    for blocks in hlive:
+        hpool.free_blocks(blocks)
+    doomed = list(hparked)
+    for hs in doomed:
+        hpool.free_host(hs)
+    try:
+        if doomed and doomed[0]:
+            hpool.free_host(doomed[0])
+            events.append("ht double-free MISSED")
+        else:
+            raise RuntimeError("no parked handles to double-free")
+    except RuntimeError:
+        events.append("ht double-free caught")
+    events.append(f"ht final free={hpool.free_count}/{hpool.total_blocks} "
+                  f"host_used={hpool.host_blocks_used()}")
+
+    # reclaimer-chain order: exhaustion consults the seams in
+    # registration order (demote first, drop second) and stops as soon
+    # as the free list covers the request
+    cpool = PagedKVCachePool(7, 2, num_layers=1, num_heads=1, head_dim=2,
+                             name=f"hc{seed}")
+    held = cpool.alloc(cpool.free_count)
+    chain: List[str] = []
+
+    def demote(n_short):
+        chain.append(f"demote({n_short})")
+        if held:
+            cpool.free_blocks([held.pop()])
+            return 1
+        return 0
+
+    def drop(n_short):
+        chain.append(f"drop({n_short})")
+        freed = len(held)
+        if held:
+            cpool.free_blocks(held)
+            held.clear()
+        return freed
+
+    cpool.register_reclaimer(demote)
+    cpool.register_reclaimer(drop)
+    got1 = cpool.alloc(1)
+    got3 = cpool.alloc(3)
+    events.append(f"ht chain={chain} got1={got1} got3={got3}")
+    cpool.free_blocks((got1 or []) + (got3 or []))
+    events.append(f"ht chain final free={cpool.free_count}"
+                  f"/{cpool.total_blocks}")
     return "\n".join(events)
 
 
@@ -598,6 +740,77 @@ def _run_chaos_subprocess(seed: int, n_requests: int,
             "stderr": proc.stderr[-2000:]}
 
 
+def _run_hibernation_subprocess(seed: int,
+                                n_sessions: int) -> Dict[str, object]:
+    """One hibernation drill in a fresh interpreter; returns its
+    invariant summary or a synthetic failure record."""
+    import json
+    code = (
+        "import json\n"
+        "from deeplearning4j_tpu.faultinject.chaos import "
+        "run_hibernation_drill\n"
+        f"out = run_hibernation_drill(seed={int(seed)}, "
+        f"n_sessions={int(n_sessions)})\n"
+        "print('HIB_JSON ' + json.dumps(out, sort_keys=True))\n")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONHASHSEED"] = str(seed)
+    proc = subprocess.run([sys.executable, "-c", code], cwd=_ROOT,
+                          capture_output=True, text=True, env=env,
+                          timeout=900)
+    for line in proc.stdout.splitlines():
+        if line.startswith("HIB_JSON "):
+            return json.loads(line[len("HIB_JSON "):])
+    return {"error": f"rc={proc.returncode}",
+            "stderr": proc.stderr[-2000:]}
+
+
+def run_hibernation(runs: int, seed_base: int,
+                    n_sessions: int = 4) -> int:
+    """The `hibernation` section: the session-hibernation drill twice
+    per seed in fresh subprocesses; fail on any invariant violation or
+    outcome drift between the two replays of one seed."""
+    bad = 0
+    for i in range(runs):
+        seed = seed_base + i
+        print(f"hibernation seed {seed} ({i + 1}/{runs}) ...", flush=True)
+        a = _run_hibernation_subprocess(seed, n_sessions)
+        b = _run_hibernation_subprocess(seed, n_sessions)
+        for run_id, out in (("run1", a), ("run2", b)):
+            if "error" in out:
+                print(f"  {run_id} DIED: {out}", file=sys.stderr)
+                bad += 1
+                continue
+            violations = [
+                k for k, want in (
+                    ("token_mismatches", 0), ("dup_offsets", 0),
+                    ("gap_events", 0), ("leaked_blocks", 0),
+                    ("leaked_host_blocks", 0), ("stranded_handles", 0))
+                if out.get(k) != want]
+            if out.get("resumed") != out.get("sessions"):
+                violations.append("resumed")
+            if out.get("handles_shipped") != out.get("sessions"):
+                violations.append("handles_shipped")
+            if violations:
+                print(f"  {run_id} INVARIANT VIOLATIONS {violations}: "
+                      f"{out}", file=sys.stderr)
+                bad += 1
+        if "error" not in a and "error" not in b and a != b:
+            drift = sorted(k for k in set(a) | set(b)
+                           if a.get(k) != b.get(k))
+            print(f"  OUTCOME DRIFT between replays of seed {seed}: "
+                  f"{drift}", file=sys.stderr)
+            bad += 1
+        elif "error" not in a:
+            print(f"  ok: {a['sessions']} sessions hibernated + "
+                  f"resumed across the death of {a['victim']}",
+                  flush=True)
+    if not bad:
+        print(f"ok: hibernation drill deterministic + invariant-clean "
+              f"over {runs} seeds x 2 fresh-process replays")
+    return 1 if bad else 0
+
+
 def run_chaos(runs: int, seed_base: int, n_requests: int = 14,
               n_events: int = 4) -> int:
     """The `chaos` section: run the composed drill TWICE per seed in
@@ -686,6 +899,12 @@ def main(argv=None) -> int:
                          "drift")
     ap.add_argument("--chaos-requests", type=int, default=14)
     ap.add_argument("--chaos-events", type=int, default=4)
+    ap.add_argument("--hibernation", action="store_true",
+                    help="run the session-hibernation drill in fresh "
+                         "subprocesses (2 replays per rotating seed), "
+                         "failing on invariant violations or outcome "
+                         "drift")
+    ap.add_argument("--hibernation-sessions", type=int, default=4)
     ap.add_argument("--pytest-args", nargs=argparse.REMAINDER, default=[],
                     help="extra args forwarded to pytest")
     args = ap.parse_args(argv)
@@ -694,6 +913,10 @@ def main(argv=None) -> int:
         return run_chaos(args.runs, args.seed_base,
                          n_requests=args.chaos_requests,
                          n_events=args.chaos_events)
+
+    if args.hibernation:
+        return run_hibernation(args.runs, args.seed_base,
+                               n_sessions=args.hibernation_sessions)
 
     if args.quick:
         problems = quick_check(

@@ -7,7 +7,10 @@ EXACTLY, under an integer logical clock) through seeded
 alloc/share/free interleavings including copy-on-write-style sharing;
 a shared block bills every holder; untagged references land in the
 visible ``_untagged`` bucket; mismatched-owner releases fall back
-without breaking refcounts. Above the pool, the scheduler's
+without breaking refcounts. The host tier (ISSUE 19) bills
+SEPARATELY — host RAM is a different budget than device HBM — and the
+same conservation law holds PER TIER through seeded interleavings of
+swap_out/swap_in/share_host/free_host alongside the device ops. Above the pool, the scheduler's
 ``attribution()`` block meters prefill/decode tokens and queue time
 per ``model[@vN]`` lane — a canary and its stable version bill
 SEPARATELY through a cutover — and ``ModelRegistry.attribution()``
@@ -60,9 +63,12 @@ def _pool(clock, num_blocks=16):
 
 
 def _conserved(pool):
-    """The conservation law, exact under the integer logical clock."""
+    """The conservation law, exact under the integer logical clock —
+    and it holds PER TIER (host RAM bills separately from device HBM)."""
     attr = pool.attribution()
     assert sum(attr["byte_seconds"].values()) == attr["total_byte_seconds"]
+    assert sum(attr["host_byte_seconds"].values()) == \
+        attr["host_total_byte_seconds"]
     return attr
 
 
@@ -119,6 +125,125 @@ def test_byte_seconds_conservation_seeded_interleaving(fresh_registry):
     total = attr["total_byte_seconds"]
     clock.tick(100)  # nobody holds anything: no further billing
     assert _conserved(pool)["total_byte_seconds"] == total
+
+
+def test_tiered_byte_seconds_conservation_seeded_interleaving(
+        fresh_registry):
+    """The 300-op battery with the host tier in play: seeded
+    interleavings of alloc/share/free AND swap_out/swap_in/free_host —
+    per-owner sums equal each tier's independently integrated total
+    EXACTLY at every step, and both tiers drain to zero held refs."""
+    clock = LogicalClock()
+    pool = PagedKVCachePool(16, 4, num_layers=2, num_heads=2, head_dim=8,
+                            clock=clock, host_blocks=10)
+    rng = np.random.default_rng(11)
+    owners = ["lm@v1", "lm@v2", "embed", None]
+    refs = {o: [] for o in owners}    # device references per owner
+    hrefs = {o: [] for o in owners}   # host handle references per owner
+    for _ in range(300):
+        clock.tick(int(rng.integers(0, 4)))
+        o = owners[rng.integers(0, len(owners))]
+        op = rng.integers(0, 6)
+        if op == 0:  # alloc 1-3 device blocks
+            got = pool.alloc(int(rng.integers(1, 4)), owner=o)
+            if got is not None:
+                refs[o].extend(got)
+        elif op == 1:  # share someone's live device block
+            donors = [d for d in owners if refs[d]]
+            if donors:
+                d = donors[rng.integers(0, len(donors))]
+                b = refs[d][rng.integers(0, len(refs[d]))]
+                pool.share_blocks([b], owner=o)
+                refs[o].append(b)
+        elif op == 2:  # free a random subset of device references
+            if refs[o]:
+                k = int(rng.integers(1, len(refs[o]) + 1))
+                idx = rng.choice(len(refs[o]), size=k, replace=False)
+                pool.free_blocks([refs[o][i] for i in idx], owner=o)
+                refs[o] = [b for i, b in enumerate(refs[o])
+                           if i not in set(idx.tolist())]
+        elif op == 3:  # demote: device refs -> host handles (preempt
+            if refs[o]:  # / end-of-turn shape); refusal touches nothing
+                k = int(rng.integers(1, min(3, len(refs[o])) + 1))
+                idx = rng.choice(len(refs[o]), size=k, replace=False)
+                got = pool.swap_out([refs[o][i] for i in idx], owner=o)
+                if got is not None:
+                    hrefs[o].extend(got)
+                    refs[o] = [b for i, b in enumerate(refs[o])
+                               if i not in set(idx.tolist())]
+        elif op == 4:  # promote: host handles -> device refs (resume
+            if hrefs[o]:  # shape); None = device full, handles stay
+                k = int(rng.integers(1, min(3, len(hrefs[o])) + 1))
+                idx = rng.choice(len(hrefs[o]), size=k, replace=False)
+                hs = [hrefs[o][i] for i in idx]
+                got = pool.swap_in(hs, owner=o)
+                if got is not None:
+                    refs[o].extend(got)
+                    hrefs[o] = [h for i, h in enumerate(hrefs[o])
+                                if i not in set(idx.tolist())]
+        else:  # free a random subset of host handles
+            if hrefs[o]:
+                k = int(rng.integers(1, len(hrefs[o]) + 1))
+                idx = rng.choice(len(hrefs[o]), size=k, replace=False)
+                pool.free_host([hrefs[o][i] for i in idx], owner=o)
+                hrefs[o] = [h for i, h in enumerate(hrefs[o])
+                            if i not in set(idx.tolist())]
+        attr = _conserved(pool)
+        held = {(t if t is not None else UNTAGGED_OWNER): len(r)
+                for t, r in refs.items() if r}
+        host_held = {(t if t is not None else UNTAGGED_OWNER): len(r)
+                     for t, r in hrefs.items() if r}
+        assert attr["held_refs"] == held
+        assert attr["held_host_refs"] == host_held
+    # drain BOTH tiers: meters freeze, blocks and budget all return
+    clock.tick(5)
+    for o in owners:
+        if refs[o]:
+            pool.free_blocks(refs[o], owner=o)
+        if hrefs[o]:
+            pool.free_host(hrefs[o], owner=o)
+    assert pool.free_count == pool.total_blocks
+    assert pool.host_blocks_used() == 0
+    attr = _conserved(pool)
+    assert attr["held_refs"] == {} and attr["held_host_refs"] == {}
+    dev_total, host_total = (attr["total_byte_seconds"],
+                             attr["host_total_byte_seconds"])
+    assert host_total > 0  # the battery really exercised the tier
+    clock.tick(100)
+    attr = _conserved(pool)
+    assert attr["total_byte_seconds"] == dev_total
+    assert attr["host_total_byte_seconds"] == host_total
+
+
+def test_host_tier_bills_separately_and_exactly(fresh_registry):
+    """Demotion moves the bill across tiers at the swap instant, a
+    shared host handle bills every holder, and a drained tier stops
+    billing — all exact under the logical clock."""
+    clock = LogicalClock()
+    pool = PagedKVCachePool(16, 4, num_layers=2, num_heads=2, head_dim=8,
+                            clock=clock, host_blocks=8)
+    bb = pool.block_bytes()
+    dev = pool.alloc(2, owner="stable")
+    clock.tick(10)                      # device: 2 refs x 10 s
+    h = pool.swap_out(dev, owner="stable")
+    assert h is not None
+    clock.tick(5)                       # host: 2 handles x 5 s
+    attr = _conserved(pool)
+    assert attr["byte_seconds"]["stable"] == 10 * 2 * bb
+    assert attr["host_byte_seconds"]["stable"] == 5 * 2 * bb
+    pool.share_host(h, owner="canary")  # durable-handle pin shape
+    clock.tick(3)
+    attr = _conserved(pool)
+    assert attr["host_byte_seconds"]["stable"] == (5 + 3) * 2 * bb
+    assert attr["host_byte_seconds"]["canary"] == 3 * 2 * bb
+    assert attr["held_host_refs"] == {"stable": 2, "canary": 2}
+    pool.free_host(h, owner="canary")
+    pool.free_host(h, owner="stable")
+    assert pool.host_blocks_used() == 0
+    attr = _conserved(pool)
+    host_total = attr["host_total_byte_seconds"]
+    clock.tick(50)                      # nobody holds anything
+    assert _conserved(pool)["host_total_byte_seconds"] == host_total
 
 
 def test_shared_block_bills_every_holder(fresh_registry):

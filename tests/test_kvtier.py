@@ -1,0 +1,538 @@
+"""KV tiering + session hibernation tests (ISSUE-19 battery).
+
+Exercises the host-RAM tier end to end, deterministic at every level:
+
+- **pool**: swap_out/swap_in round trips are bitwise (quantized values
+  and their per-token scales ride as raw stored bytes), budget refusal
+  touches nothing, double frees raise the typed
+  :class:`KVHostTierError`, the reclaimer CHAIN runs in registration
+  order (cache-demote before cache-drop) and stops once covered;
+- **wire**: hibernation payloads survive the v4 raw-segment frame
+  round trip bit-identically; a truncated frame raises the typed
+  :class:`WireFrameError`, never garbage;
+- **scheduler**: preemption swaps out instead of freeing and the
+  resumed stream is bitwise the uninterrupted run; end-of-turn
+  hibernation + resume swaps in instead of re-prefilling;
+  hibernate_export/hibernate_import moves a session across schedulers
+  bitwise; with the tier off, behavior is identical to pre-tier;
+- **engine**: ``kv_host_blocks`` requires continuous batching; local
+  resume restores via swap-in; a shipped ``kv_state`` payload restores
+  on a DIFFERENT engine bitwise;
+- **router**: the full three-rung restore ladder — host (pin alive),
+  shipped blocks (pin dead), journaled prefix (no payload) — each
+  bitwise vs the uninterrupted oracle with contiguous stream offsets
+  across the hibernation boundary, then a zero-leak audit of BOTH
+  tiers across every surviving engine.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.faultinject import HostTierPressure, kill_endpoint
+from deeplearning4j_tpu.models.zoo.transformer import gpt
+from deeplearning4j_tpu.nn.generate import generate_eager
+from deeplearning4j_tpu.nn.kvpool import KVHostTierError, PagedKVCachePool
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.serving import (InferenceRouter, LocalFleet,
+                                        ModelRegistry)
+from deeplearning4j_tpu.serving.continuous import ContinuousDecodeScheduler
+from deeplearning4j_tpu.serving.wire import (WireFrameError,
+                                             decode_reply_events,
+                                             pack_hibernation_v4,
+                                             unpack_frame_v4)
+
+pytestmark = pytest.mark.faultinject
+
+VOCAB = 11
+
+
+@pytest.fixture
+def fresh_registry():
+    prev = monitor.set_registry(monitor.MetricsRegistry())
+    yield monitor.get_registry()
+    monitor.set_registry(prev)
+
+
+@pytest.fixture(scope="module")
+def net():
+    return gpt(vocab_size=VOCAB, d_model=16, n_layers=2, num_heads=2,
+               max_len=32, compute_dtype="float32", learning_rate=0.01,
+               seed=0).init()
+
+
+def _drive(s, futs, max_steps=400):
+    for _ in range(max_steps):
+        if all(f.done() for f in futs):
+            return
+        s.step()
+    raise AssertionError(f"no convergence; events={list(s.events)}")
+
+
+# ------------------------------------------------------------ pool tier
+
+def _host_blocks_like(pool, rng, n):
+    """Synthetic block contents in the host_export flat layout, dtype-
+    exact for the pool (quantized pools get int storage + f32 scales)."""
+    out = []
+    shape = (pool.block_size, pool.num_heads, pool.head_dim)
+    for _ in range(n):
+        flat = {}
+        for li in range(pool.num_layers):
+            for comp in ("k", "v"):
+                if pool.quant is not None:
+                    flat[f"{comp}{li}"] = rng.integers(
+                        -120, 120, shape).astype(np.int8)
+                    flat[f"{comp}_scale{li}"] = rng.random(
+                        shape[:2]).astype(np.float32)
+                else:
+                    flat[f"{comp}{li}"] = rng.random(shape).astype(
+                        np.float32)
+        out.append(flat)
+    return out
+
+
+@pytest.mark.parametrize("quant", [None, "int8"])
+def test_host_roundtrip_bitwise(quant, rng):
+    """insert -> swap_in (H2D) -> swap_out (D2H) -> export returns the
+    exact stored bytes — quantized values AND scales bit-identical."""
+    pool = PagedKVCachePool(6, 4, num_layers=2, num_heads=2, head_dim=4,
+                            quant=quant, host_blocks=8, name="rt")
+    blocks = _host_blocks_like(pool, rng, 3)
+    h = pool.host_insert(blocks, owner="lm@v1")
+    assert h is not None and pool.host_blocks_used() == 3
+    dev = pool.swap_in(h, owner="lm@v1")
+    assert dev is not None and pool.host_blocks_used() == 0
+    h2 = pool.swap_out(dev, owner="lm@v1")
+    assert h2 is not None
+    assert pool.free_count == pool.total_blocks  # device refs released
+    out = pool.host_export(h2)
+    for got, want in zip(out, blocks):
+        assert sorted(got) == sorted(want)
+        for key in want:
+            assert got[key].dtype == want[key].dtype, key
+            np.testing.assert_array_equal(got[key], want[key], err_msg=key)
+    assert pool.swap_in_cost_ms() is not None  # EWMA primed
+    pool.free_host(h2, owner="lm@v1")
+    assert pool.host_blocks_used() == 0
+
+
+def test_host_budget_refusal_touches_nothing(rng):
+    pool = PagedKVCachePool(8, 4, num_layers=1, num_heads=1, head_dim=4,
+                            host_blocks=2, name="budget")
+    dev = pool.alloc(3, "lm@v1")
+    # batch over budget: refused atomically, device refs stay ours
+    assert pool.swap_out(dev, owner="lm@v1") is None
+    assert pool.free_count == pool.total_blocks - 3
+    assert pool.host_blocks_used() == 0
+    h = pool.swap_out(dev[:2], owner="lm@v1")
+    assert h is not None and pool.host_blocks_used() == 2
+    assert pool.swap_out(dev[2:], owner="lm@v1") is None  # tier full
+    # pressure squeeze: existing entries survive, NEW demotions refuse
+    with HostTierPressure(pool, budget=0):
+        assert pool.host_blocks_used() == 2
+        assert pool.swap_out(dev[2:], owner="lm@v1") is None
+        assert pool.host_insert(_host_blocks_like(pool, rng, 1)) is None
+    assert pool.host_budget() == 2  # healed
+    pool.free_host(h, owner="lm@v1")
+    pool.free_blocks(dev[2:], "lm@v1")
+    assert pool.free_count == pool.total_blocks
+
+
+def test_host_double_free_raises_typed():
+    pool = PagedKVCachePool(4, 2, num_layers=1, num_heads=1, head_dim=2,
+                            host_blocks=4, name="df")
+    dev = pool.alloc(1, "a")
+    (h,) = pool.swap_out(dev, owner="a")
+    pool.free_host([h], owner="a")
+    with pytest.raises(KVHostTierError):
+        pool.free_host([h], owner="a")
+    with pytest.raises(KVHostTierError):
+        pool.swap_in([h], owner="a")
+    # typed but still a RuntimeError: pre-tier catch sites keep working
+    assert issubclass(KVHostTierError, RuntimeError)
+
+
+def test_disabled_tier_refuses_until_budget_set(rng):
+    pool = PagedKVCachePool(4, 2, num_layers=1, num_heads=1, head_dim=2,
+                            name="off")
+    assert not pool.host_enabled
+    dev = pool.alloc(1, "a")
+    assert pool.swap_out(dev, owner="a") is None
+    assert pool.host_insert(_host_blocks_like(pool, rng, 1)) is None
+    pool.set_host_budget(4)
+    assert pool.host_enabled
+    h = pool.swap_out(dev, owner="a")
+    assert h is not None
+    pool.free_host(h, owner="a")
+
+
+def test_reclaimer_chain_registration_order_and_early_stop():
+    """The chain is consulted in registration order (cache-demote
+    before cache-drop) and stops as soon as the free list covers the
+    request — demotion satisfies small shortfalls without drops."""
+    pool = PagedKVCachePool(7, 2, num_layers=1, num_heads=1, head_dim=2,
+                            name="chain")
+    held = pool.alloc(pool.free_count, "cache")
+    calls = []
+
+    def demote(n_short):
+        calls.append(("demote", n_short))
+        pool.free_blocks(held[:1], "cache")
+        del held[:1]
+        return 1
+
+    def drop(n_short):
+        calls.append(("drop", n_short))
+        k = min(n_short, len(held))
+        pool.free_blocks(held[:k], "cache")
+        del held[:k]
+        return k
+
+    pool.register_reclaimer(demote)
+    pool.register_reclaimer(drop)
+    got1 = pool.alloc(1, "live")
+    assert got1 is not None
+    assert calls == [("demote", 1)]  # covered: drop never consulted
+    got3 = pool.alloc(3, "live")
+    assert got3 is not None
+    assert calls[1][0] == "demote" and calls[2][0] == "drop"
+    pool.free_blocks(got1 + got3, "live")
+    pool.free_blocks(held, "cache")
+    assert pool.free_count == pool.total_blocks
+
+
+# ------------------------------------------------------------ wire v4
+
+def test_hibernation_frame_roundtrip_bitwise(rng):
+    pool = PagedKVCachePool(4, 4, num_layers=2, num_heads=2, head_dim=4,
+                            quant="int8", host_blocks=4, name="wire")
+    payload = {
+        "blocks": _host_blocks_like(pool, rng, 2),
+        "covered": 7,
+        "tokens": np.arange(8, dtype=np.int64),
+        "model": "lm", "version": 3,
+        "prompt": rng.integers(1, VOCAB, (1, 4)),
+        "generated": np.arange(4, dtype=np.int64),
+    }
+    frame = pack_hibernation_v4("corr-9", payload)
+    events = decode_reply_events(frame)
+    hib = [e for e in events if e["type"] == "hibernation"]
+    assert len(hib) == 1 and hib[0]["id"] == "corr-9"
+    got = hib[0]["payload"]
+    assert got["covered"] == 7 and got["model"] == "lm"
+    assert got["version"] == 3
+    np.testing.assert_array_equal(got["tokens"], payload["tokens"])
+    np.testing.assert_array_equal(got["prompt"], payload["prompt"])
+    np.testing.assert_array_equal(got["generated"], payload["generated"])
+    assert len(got["blocks"]) == 2
+    for gb, wb in zip(got["blocks"], payload["blocks"]):
+        assert sorted(gb) == sorted(wb)
+        for key in wb:
+            assert gb[key].dtype == wb[key].dtype, key
+            np.testing.assert_array_equal(gb[key], wb[key], err_msg=key)
+    # payload outlives the frame buffer (copied out of the views)
+    assert got["blocks"][0]["k0"].flags.owndata or \
+        got["blocks"][0]["k0"].base is not frame
+    with pytest.raises(WireFrameError):
+        unpack_frame_v4(frame[4:-3])
+
+
+# ------------------------------------------------------- scheduler tier
+
+def test_scheduler_preempt_swaps_out_and_resumes_bitwise(net, rng):
+    """Tiny pool forces preemption; victims demote to host and resume
+    via swap-in — outputs bitwise the uninterrupted oracle, both tiers
+    drain to zero."""
+    s = ContinuousDecodeScheduler(net=net, slots=4, burst_tokens=4,
+                                  block_size=4, start=False, num_blocks=9,
+                                  host_kv_blocks=16)
+    prompts = [rng.integers(1, VOCAB, (1, 5)) for _ in range(3)]
+    oracle = [np.asarray(generate_eager(net, p, 12, seed=7,
+                                        temperature=0.8, top_k=5))
+              for p in prompts]
+    futs = [s.submit(p, 12, seed=7, temperature=0.8, top_k=5)
+            for p in prompts]
+    _drive(s, futs)
+    for f, o in zip(futs, oracle):
+        np.testing.assert_array_equal(np.asarray(f.result()), o)
+    st = s.stats()
+    assert st["preemptions"] > 0, "pool was supposed to be tight"
+    assert st["kvtier"]["preempt_swapouts"] > 0
+    assert st["kvtier"]["swap_restores"] > 0
+    assert st["pool"]["blocks_free"] == st["pool"]["blocks_total"]
+    assert st["kvtier"]["host_blocks_used"] == 0
+
+
+def test_scheduler_hibernate_resume_bitwise(net, rng):
+    s = ContinuousDecodeScheduler(net=net, slots=4, burst_tokens=4,
+                                  block_size=4, start=False,
+                                  host_kv_blocks=16)
+    p = rng.integers(1, VOCAB, (1, 4))
+    full = np.asarray(generate_eager(net, p, 14, seed=3, temperature=0.9,
+                                     top_k=4))
+    f1 = s.submit(p, 6, seed=3, temperature=0.9, top_k=4,
+                  session="sess-a", hibernate=True)
+    _drive(s, [f1])
+    turn1 = np.asarray(f1.result())
+    np.testing.assert_array_equal(turn1, full[:, :p.shape[1] + 6])
+    assert s.hibernated_count() == 1
+    assert s.stats()["kvtier"]["host_blocks_used"] > 0
+    # resume: same session, prefix = turn-1 generated tokens
+    pre = turn1[0, p.shape[1]:]
+    f2 = s.submit(p, 14, seed=3, temperature=0.9, top_k=4,
+                  session="sess-a", prefix=pre, hibernate=True)
+    _drive(s, [f2])
+    np.testing.assert_array_equal(np.asarray(f2.result()), full)
+    assert s.hibernated_count() == 1  # turn 2 re-hibernated
+    assert any(e.startswith("swap_in") for e in s.events), \
+        "resume must swap in, not re-prefill"
+    assert s.stats()["kvtier"]["swap_restores"] >= 1
+    assert s.hibernate_release("sess-a")
+    st = s.stats()
+    assert st["kvtier"]["host_blocks_used"] == 0
+    assert st["pool"]["blocks_free"] == st["pool"]["blocks_total"]
+
+
+def test_scheduler_export_import_cross_scheduler_bitwise(net, rng):
+    p = rng.integers(1, VOCAB, (1, 4))
+    full = np.asarray(generate_eager(net, p, 14, seed=3, temperature=0.9,
+                                     top_k=4))
+    s1 = ContinuousDecodeScheduler(net=net, slots=4, burst_tokens=4,
+                                   block_size=4, start=False,
+                                   host_kv_blocks=16)
+    f1 = s1.submit(p, 6, seed=3, temperature=0.9, top_k=4,
+                   session="sess-b", hibernate=True)
+    _drive(s1, [f1])
+    pre = np.asarray(f1.result())[0, p.shape[1]:]
+    payload = s1.hibernate_export("sess-b")
+    assert payload is not None and payload["covered"] == p.shape[1] + 6 - 1
+    s2 = ContinuousDecodeScheduler(net=net, slots=4, burst_tokens=4,
+                                   block_size=4, start=False,
+                                   host_kv_blocks=16)
+    assert s2.hibernate_import("sess-b", payload["blocks"],
+                               payload["covered"], payload["tokens"],
+                               model=payload["model"],
+                               version=payload["version"],
+                               prompt=payload["prompt"],
+                               generated=payload["generated"])
+    f2 = s2.submit(p, 14, seed=3, temperature=0.9, top_k=4,
+                   session="sess-b", prefix=pre)
+    _drive(s2, [f2])
+    np.testing.assert_array_equal(np.asarray(f2.result()), full)
+    assert any(e.startswith("swap_in") for e in s2.events)
+    assert s1.hibernate_release("sess-b")
+    st = s2.stats()
+    assert st["kvtier"]["host_blocks_used"] == 0
+    assert st["pool"]["blocks_free"] == st["pool"]["blocks_total"]
+    assert s1.stats()["kvtier"]["host_blocks_used"] == 0
+
+
+def test_scheduler_tier_off_is_pre_tier_behavior(net, rng):
+    s = ContinuousDecodeScheduler(net=net, slots=4, burst_tokens=4,
+                                  block_size=4, start=False, num_blocks=9)
+    prompts = [rng.integers(1, VOCAB, (1, 5)) for _ in range(3)]
+    oracle = [np.asarray(generate_eager(net, p, 12, seed=7,
+                                        temperature=0.8, top_k=5))
+              for p in prompts]
+    futs = [s.submit(p, 12, seed=7, temperature=0.8, top_k=5)
+            for p in prompts]
+    _drive(s, futs)
+    for f, o in zip(futs, oracle):
+        np.testing.assert_array_equal(np.asarray(f.result()), o)
+    assert s.stats()["kvtier"]["enabled"] is False
+
+
+# ---------------------------------------------------------- engine tier
+
+def test_engine_host_tier_requires_continuous(net):
+    with pytest.raises(ValueError, match="continuous"):
+        ParallelInference(net=net, kv_host_blocks=8)
+
+
+def test_engine_local_resume_and_cross_engine_ship(net, rng):
+    p = rng.integers(1, VOCAB, (1, 4))
+    full = np.asarray(generate_eager(net, p, 14, seed=3, temperature=0.9,
+                                     top_k=4))
+    eng = ParallelInference(net=net, continuous=True, decode_slots=4,
+                            decode_burst=4, kv_block_size=4,
+                            kv_host_blocks=16)
+    try:
+        f1 = eng.submit_generate(p, 6, seed=3, temperature=0.9, top_k=4,
+                                 session="s", hibernate=True)
+        turn1 = np.asarray(f1.result(timeout=120))
+        np.testing.assert_array_equal(turn1, full[:, :p.shape[1] + 6])
+        assert eng.hibernated_count() == 1
+        payload = eng.hibernate_export("s")
+        assert payload is not None
+        pre = turn1[0, p.shape[1]:]
+        f2 = eng.submit_generate(p, 14, seed=3, temperature=0.9, top_k=4,
+                                 session="s", prefix=pre)
+        np.testing.assert_array_equal(np.asarray(f2.result(timeout=120)),
+                                      full)
+        assert eng.hibernated_count() == 0
+        st = eng.stats()["scheduler"]["kvtier"]
+        assert st["swap_restores"] >= 1 and st["host_blocks_used"] == 0
+    finally:
+        eng.shutdown()
+    # the exported payload lands on a DIFFERENT engine via kv_state
+    eng2 = ParallelInference(net=net, continuous=True, decode_slots=4,
+                             decode_burst=4, kv_block_size=4,
+                             kv_host_blocks=16)
+    try:
+        f3 = eng2.submit_generate(p, 14, seed=3, temperature=0.9, top_k=4,
+                                  session="s", prefix=pre,
+                                  kv_state=payload)
+        np.testing.assert_array_equal(np.asarray(f3.result(timeout=120)),
+                                      full)
+        st = eng2.stats()["scheduler"]["kvtier"]
+        assert st["swap_restores"] >= 1 and st["host_blocks_used"] == 0
+    finally:
+        eng2.shutdown()
+
+
+# ---------------------------------------------------------- router tier
+
+class _Coll:
+    """Session-long stream collector: resume offsets CONTINUE from the
+    hibernated turn, so one collector spanning both turns must see
+    zero dups and zero gaps."""
+
+    def __init__(self):
+        self.tokens, self.dups, self.gaps = [], 0, 0
+
+    def __call__(self, off, toks):
+        for i, t in enumerate(np.asarray(toks).reshape(-1).tolist()):
+            idx = int(off) + i
+            if idx < len(self.tokens):
+                self.dups += 1
+            elif idx == len(self.tokens):
+                self.tokens.append(int(t))
+            else:
+                self.gaps += 1
+
+
+def test_router_restore_ladder_and_leak_audit(net, rng, fresh_registry):
+    """The acceptance scenario over a real broker fleet: hibernated
+    sessions resume bitwise through all three rungs — local swap-in,
+    shipped blocks after endpoint death, journaled prefix when no
+    payload exists — and every surviving engine drains BOTH tiers to
+    zero."""
+    engines = []
+
+    def factory():
+        mreg = ModelRegistry()
+        mreg.register("lm", net=net)
+        eng = ParallelInference(registry=mreg, replicas=1,
+                                max_batch_size=8, max_latency_ms=1.0,
+                                queue_capacity=512, continuous=True,
+                                decode_slots=4, decode_burst=4,
+                                kv_block_size=4, kv_host_blocks=32)
+        engines.append(eng)
+        return eng
+
+    router = InferenceRouter(per_try_timeout_s=15.0, eject_backoff_s=0.1,
+                             max_attempts=6)
+    fleet = LocalFleet(factory, router=router, heartbeat_s=0.05,
+                       request_timeout_s=15.0, heartbeat_timeout_s=0.5)
+    for _ in range(3):
+        fleet.add_endpoint()
+    assert fleet.wait_ready(30)
+
+    def oracle(p, n, seed):
+        return np.asarray(generate_eager(net, p, n, temperature=0.9,
+                                         seed=seed, top_k=4))
+
+    try:
+        # rung 1: host — pin alive, local swap-in restores
+        p = rng.integers(1, VOCAB, (1, 4))
+        full = oracle(p, 14, seed=21)
+        coll = _Coll()
+        t1 = np.asarray(router.generate(p, 6, temperature=0.9, seed=21,
+                                        top_k=4, model="lm", session="h",
+                                        hibernate=True, on_tokens=coll,
+                                        timeout=120))
+        np.testing.assert_array_equal(t1, full[:, :4 + 6])
+        handle = router.hibernation_handle("h")
+        assert handle is not None and "payload" in handle
+        assert router.hibernated_sessions() == ["h"]
+        assert router.fleet_snapshot()["hibernated_sessions"] == 1
+        got = np.asarray(router.resume_generate(
+            "h", 14, model="lm", temperature=0.9, seed=21, top_k=4,
+            on_tokens=coll).result(timeout=120))
+        np.testing.assert_array_equal(got, full)
+        assert coll.dups == 0 and coll.gaps == 0
+        assert coll.tokens == [int(t) for t in full[0, 4:]]
+        assert router.hibernation_handle("h") is None  # consumed
+        restores = sum(e._scheduler.stats()["kvtier"]["swap_restores"]
+                       for e in engines if e._scheduler is not None)
+        assert restores >= 1, "must restore via swap-in, not re-prefill"
+
+        # rung 2: ship — pin dead, payload rides to a survivor
+        p2 = rng.integers(1, VOCAB, (1, 5))
+        full2 = oracle(p2, 13, seed=22)
+        coll = _Coll()
+        t1 = np.asarray(router.generate(p2, 5, temperature=0.9, seed=22,
+                                        top_k=4, model="lm", session="s",
+                                        hibernate=True, on_tokens=coll,
+                                        timeout=120))
+        np.testing.assert_array_equal(t1, full2[:, :5 + 5])
+        assert "payload" in router.hibernation_handle("s")
+        pin_s = router._affinity.get("s")[0]
+        kill_endpoint(fleet, pin_s)
+        got = np.asarray(router.resume_generate(
+            "s", 13, model="lm", temperature=0.9, seed=22, top_k=4,
+            on_tokens=coll).result(timeout=120))
+        np.testing.assert_array_equal(got, full2)
+        assert coll.dups == 0 and coll.gaps == 0
+        assert router._affinity.get("s")[0] != pin_s  # re-pinned
+
+        # rung 3: journal — pin dead AND no payload (v3 peer) -> the
+        # journaled prefix re-prefills, still bitwise
+        p3 = rng.integers(1, VOCAB, (1, 4))
+        full3 = oracle(p3, 12, seed=23)
+        coll = _Coll()
+        t1 = np.asarray(router.generate(p3, 4, temperature=0.9, seed=23,
+                                        top_k=4, model="lm", session="j",
+                                        hibernate=True, on_tokens=coll,
+                                        timeout=120))
+        np.testing.assert_array_equal(t1, full3[:, :4 + 4])
+        with router._lock:
+            router._hibernated["j"].pop("payload", None)
+        pin_j = router._affinity.get("j")[0]
+        if pin_j != pin_s:  # may already be dead from rung 2
+            kill_endpoint(fleet, pin_j)
+        got = np.asarray(router.resume_generate(
+            "j", 12, model="lm", temperature=0.9, seed=23, top_k=4,
+            on_tokens=coll).result(timeout=120))
+        np.testing.assert_array_equal(got, full3)
+        assert coll.dups == 0 and coll.gaps == 0
+
+        # zero leaked blocks, both tiers, every engine still alive
+        for eng in engines:
+            if eng._closed:
+                continue
+            eng.drain(timeout=30)
+            sched = eng._scheduler
+            if sched is None:
+                continue
+            for c in sched.prefix_caches():
+                c.clear()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                st = sched.stats()
+                if (st["pool"]["blocks_free"] >= st["pool"]["blocks_total"]
+                        and st["kvtier"]["host_blocks_used"] == 0):
+                    break
+                time.sleep(0.02)
+            st = sched.stats()
+            assert st["pool"]["blocks_free"] == st["pool"]["blocks_total"]
+            assert st["kvtier"]["host_blocks_used"] == 0
+    finally:
+        try:
+            fleet.shutdown(drain=False)
+        except BaseException:
+            pass
+        router.close()
